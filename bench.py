@@ -1,0 +1,61 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line with the tracked headline metric.
+
+Protocol per BASELINE.md: PerformanceListener-equivalent semantics — iteration wall time
+with warm-up (compile) excluded, synthetic data (BenchmarkDataSetIterator-equivalent) to
+isolate compute from the input pipeline. Config: LeNet MNIST step-time (BASELINE.md
+tracked config #1; ResNet50 ImageNet images/sec lands when the zoo widens).
+
+The reference publishes no numbers (BASELINE.md), so vs_baseline is reported against the
+BASELINE.json north-star proxy when available, else null.
+"""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models.lenet import LeNet
+    from deeplearning4j_tpu.nn.updater.updaters import AdaDelta
+
+    batch = 128
+    warmup, iters = 5, 30
+
+    net = LeNet(num_labels=10, seed=42, dtype="float32").init()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(batch, 784).astype(np.float32))
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch)])
+
+    for _ in range(warmup):
+        net.fit_batch(x, y)
+    jax.block_until_ready(net.params_tree[0]["W"])
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        net.fit_batch(x, y)
+    jax.block_until_ready(net.params_tree[0]["W"])
+    dt = time.perf_counter() - t0
+
+    ms_per_iter = dt / iters * 1e3
+    samples_per_sec = batch * iters / dt
+    print(json.dumps({
+        "metric": "lenet_mnist_step_time",
+        "value": round(ms_per_iter, 3),
+        "unit": "ms/iter",
+        "vs_baseline": None,
+        "extra": {
+            "samples_per_sec": round(samples_per_sec, 1),
+            "batch": batch,
+            "device": str(jax.devices()[0]),
+            "params": net.num_params(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,28 @@
+"""deeplearning4j_tpu — a TPU-native deep learning framework with the capability surface
+of Deeplearning4j (reference: hafizusman530/deeplearning4j), redesigned for JAX/XLA:
+declarative configs trace to single XLA computations, autodiff replaces hand-written
+backprop, and parallelism is pjit/shard_map over a device mesh.
+"""
+from deeplearning4j_tpu.common.enums import (
+    Activation, BackpropType, CacheMode, ConvolutionMode, GradientNormalization,
+    LossFunction, OptimizationAlgorithm, PoolingType, WeightInit, WorkspaceMode)
+from deeplearning4j_tpu.nn.conf.configuration import (
+    ListBuilder, MultiLayerConfiguration, NeuralNetConfiguration)
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import BaseLayerConf
+from deeplearning4j_tpu.nn.conf.layers.feedforward import (
+    ActivationLayer, AutoEncoder, DenseLayer, DropoutLayer, EmbeddingLayer, LossLayer,
+    OutputLayer)
+from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+    Convolution1DLayer, ConvolutionLayer, GlobalPoolingLayer, Subsampling1DLayer,
+    SubsamplingLayer, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.conf.layers.normalization import (
+    BatchNormalization, LocalResponseNormalization)
+from deeplearning4j_tpu.nn.conf.layers.recurrent import (
+    GravesBidirectionalLSTM, GravesLSTM, LSTM, RnnOutputLayer)
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater.updaters import (
+    AdaDelta, AdaGrad, AdaMax, Adam, BaseUpdater, Nadam, Nesterovs, NoOp, RmsProp, Sgd)
+from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+
+__version__ = "0.1.0"

@@ -1,0 +1,74 @@
+"""DataSet / MultiDataSet containers.
+
+Parity: ND4J's DataSet/MultiDataSet consumed throughout the reference (features, labels,
+optional per-example/per-timestep masks). Arrays are host numpy or device jnp; the
+network's jitted step moves them to HBM on first use (async prefetch can pre-stage).
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+import numpy as np
+
+
+class DataSet:
+    def __init__(self, features, labels, features_mask=None, labels_mask=None):
+        self.features = features
+        self.labels = labels
+        self.features_mask = features_mask
+        self.labels_mask = labels_mask
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int):
+        a = DataSet(self.features[:n_train], self.labels[:n_train],
+                    None if self.features_mask is None else self.features_mask[:n_train],
+                    None if self.labels_mask is None else self.labels_mask[:n_train])
+        b = DataSet(self.features[n_train:], self.labels[n_train:],
+                    None if self.features_mask is None else self.features_mask[n_train:],
+                    None if self.labels_mask is None else self.labels_mask[n_train:])
+        return a, b
+
+    def shuffle(self, seed: Optional[int] = None):
+        rng = np.random.RandomState(seed)
+        idx = rng.permutation(self.num_examples())
+        self.features = np.asarray(self.features)[idx]
+        self.labels = np.asarray(self.labels)[idx]
+        if self.features_mask is not None:
+            self.features_mask = np.asarray(self.features_mask)[idx]
+        if self.labels_mask is not None:
+            self.labels_mask = np.asarray(self.labels_mask)[idx]
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        n = self.num_examples()
+        return [DataSet(self.features[i:i + batch_size], self.labels[i:i + batch_size],
+                        None if self.features_mask is None else self.features_mask[i:i + batch_size],
+                        None if self.labels_mask is None else self.labels_mask[i:i + batch_size])
+                for i in range(0, n, batch_size)]
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        f = np.concatenate([np.asarray(d.features) for d in datasets])
+        l = np.concatenate([np.asarray(d.labels) for d in datasets])
+        fm = None
+        lm = None
+        if datasets and datasets[0].features_mask is not None:
+            fm = np.concatenate([np.asarray(d.features_mask) for d in datasets])
+        if datasets and datasets[0].labels_mask is not None:
+            lm = np.concatenate([np.asarray(d.labels_mask) for d in datasets])
+        return DataSet(f, l, fm, lm)
+
+
+class MultiDataSet:
+    """Multiple-input/multiple-output container (ref ND4J MultiDataSet; consumed by
+    ComputationGraph.fit)."""
+
+    def __init__(self, features, labels, features_masks=None, labels_masks=None):
+        self.features = list(features) if isinstance(features, (list, tuple)) else [features]
+        self.labels = list(labels) if isinstance(labels, (list, tuple)) else [labels]
+        self.features_masks = features_masks
+        self.labels_masks = labels_masks
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])

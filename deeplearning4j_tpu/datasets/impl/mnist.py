@@ -1,0 +1,120 @@
+"""MNIST fetcher + iterator.
+
+Parity: ref deeplearning4j-core base/MnistFetcher.java (download+cache) and
+datasets/iterator/impl/MnistDataSetIterator.java, datasets/mnist/ (IDX readers).
+
+This environment has zero network egress, so the fetcher resolves data in order:
+1. real IDX files under $MNIST_DIR or ~/.deeplearning4j/mnist (same cache layout the
+   reference uses) — gzip or raw;
+2. a deterministic procedurally-generated digit set (class-dependent stroke patterns +
+   noise + jitter) with the same shapes/dtypes, adequate for convergence tests.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+
+def _read_idx(path: Path) -> np.ndarray:
+    opener = gzip.open if path.suffix == ".gz" else open
+    with opener(path, "rb") as f:
+        data = f.read()
+    zeros, dtype_code, ndim = data[0:2], data[2], data[3]
+    dims = struct.unpack(f">{ndim}I", data[4:4 + 4 * ndim])
+    return np.frombuffer(data, np.uint8, offset=4 + 4 * ndim).reshape(dims)
+
+
+def _find_idx(base: Path, names) -> Optional[Path]:
+    for n in names:
+        for suffix in ("", ".gz"):
+            p = base / (n + suffix)
+            if p.exists():
+                return p
+    return None
+
+
+def load_mnist(train: bool = True, num_examples: Optional[int] = None, seed: int = 123
+               ) -> Tuple[np.ndarray, np.ndarray]:
+    """Returns (images (n, 784) float32 in [0,1], labels (n,) int)."""
+    base = Path(os.environ.get("MNIST_DIR", "~/.deeplearning4j/mnist")).expanduser()
+    img_names = (["train-images-idx3-ubyte", "train-images.idx3-ubyte"] if train
+                 else ["t10k-images-idx3-ubyte", "t10k-images.idx3-ubyte"])
+    lbl_names = (["train-labels-idx1-ubyte", "train-labels.idx1-ubyte"] if train
+                 else ["t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"])
+    ip, lp = _find_idx(base, img_names), _find_idx(base, lbl_names)
+    if ip is not None and lp is not None:
+        imgs = _read_idx(ip).astype(np.float32) / 255.0
+        labels = _read_idx(lp).astype(np.int64)
+        imgs = imgs.reshape(imgs.shape[0], -1)
+    else:
+        n = num_examples or (8192 if train else 2048)
+        imgs, labels = _synthetic_digits(n, seed if train else seed + 1)
+    if num_examples is not None:
+        imgs, labels = imgs[:num_examples], labels[:num_examples]
+    return imgs, labels
+
+
+def _synthetic_digits(n: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic learnable stand-in: each class = fixed smooth prototype pattern,
+    samples add pixel noise and ±2px translation."""
+    rng = np.random.RandomState(seed)
+    proto_rng = np.random.RandomState(1234)  # prototypes fixed across train/test
+    protos = []
+    yy, xx = np.mgrid[0:28, 0:28]
+    for c in range(10):
+        img = np.zeros((28, 28), np.float32)
+        for _ in range(3):  # a few gaussian strokes per class
+            cy, cx = proto_rng.uniform(6, 22, 2)
+            sy, sx = proto_rng.uniform(2, 6, 2)
+            img += np.exp(-(((yy - cy) / sy) ** 2 + ((xx - cx) / sx) ** 2))
+        protos.append(np.clip(img / img.max(), 0, 1))
+    labels = rng.randint(0, 10, n)
+    imgs = np.zeros((n, 28, 28), np.float32)
+    for i, c in enumerate(labels):
+        dy, dx = rng.randint(-2, 3, 2)
+        img = np.roll(np.roll(protos[c], dy, axis=0), dx, axis=1)
+        img = img + rng.normal(0, 0.15, (28, 28)).astype(np.float32)
+        imgs[i] = np.clip(img, 0, 1)
+    return imgs.reshape(n, 784), labels.astype(np.int64)
+
+
+class MnistDataSetIterator(DataSetIterator):
+    """(ref datasets/iterator/impl/MnistDataSetIterator.java) — yields flat 784 features
+    + one-hot 10-class labels, matching InputType.convolutionalFlat consumption."""
+
+    def __init__(self, batch: int, train: bool = True, num_examples: Optional[int] = None,
+                 seed: int = 123, shuffle: bool = True):
+        self._batch = int(batch)
+        imgs, labels = load_mnist(train, num_examples, seed)
+        self.features = imgs
+        self.labels = np.eye(10, dtype=np.float32)[labels]
+        self._shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+
+    def __iter__(self):
+        n = self.features.shape[0]
+        idx = np.arange(n)
+        if self._shuffle:
+            np.random.RandomState(self._seed + self._epoch).shuffle(idx)
+        self._epoch += 1
+        for i in range(0, n - self._batch + 1, self._batch):
+            sel = idx[i:i + self._batch]
+            yield DataSet(self.features[sel], self.labels[sel])
+
+    def batch(self):
+        return self._batch
+
+    def total_outcomes(self):
+        return 10
+
+    def input_columns(self):
+        return 784

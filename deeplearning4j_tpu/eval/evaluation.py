@@ -1,0 +1,175 @@
+"""Classification evaluation: accuracy/precision/recall/F1 + confusion matrix.
+
+Parity: ref eval/Evaluation.java:72 and eval/ConfusionMatrix.java. Accumulates over
+minibatches (`eval` repeatedly), supports time-series predictions with label masks
+(ref evalTimeSeries / MaskedReductionUtil).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    def __init__(self, num_classes: int):
+        self.matrix = np.zeros((num_classes, num_classes), np.int64)
+
+    def add(self, actual: int, predicted: int, count: int = 1):
+        self.matrix[actual, predicted] += count
+
+    def get_count(self, actual: int, predicted: int) -> int:
+        return int(self.matrix[actual, predicted])
+
+    def to_csv(self) -> str:
+        n = self.matrix.shape[0]
+        lines = ["," + ",".join(str(i) for i in range(n))]
+        for i in range(n):
+            lines.append(f"{i}," + ",".join(str(x) for x in self.matrix[i]))
+        return "\n".join(lines)
+
+
+class Evaluation:
+    def __init__(self, num_classes: Optional[int] = None, labels: Optional[List[str]] = None):
+        self.num_classes = num_classes
+        self.label_names = labels
+        self.confusion: Optional[ConfusionMatrix] = None
+
+    def _ensure(self, n: int):
+        if self.confusion is None:
+            self.num_classes = self.num_classes or n
+            self.confusion = ConfusionMatrix(self.num_classes)
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:  # time series (batch, classes, time) → stack unmasked steps
+            b, c, t = labels.shape
+            lab2 = np.moveaxis(labels, 1, 2).reshape(-1, c)
+            pred2 = np.moveaxis(predictions, 1, 2).reshape(-1, c)
+            if mask is not None:
+                keep = np.asarray(mask).reshape(-1) > 0
+                lab2, pred2 = lab2[keep], pred2[keep]
+            return self.eval(lab2, pred2)
+        self._ensure(labels.shape[-1])
+        actual = np.argmax(labels, axis=-1)
+        predicted = np.argmax(predictions, axis=-1)
+        for a, p in zip(actual, predicted):
+            self.confusion.add(int(a), int(p))
+
+    # ---- metrics (ref Evaluation accuracy/precision/recall/f1) ----
+    def _tp(self, c):
+        return self.confusion.matrix[c, c]
+
+    def accuracy(self) -> float:
+        m = self.confusion.matrix
+        total = m.sum()
+        return float(np.trace(m)) / total if total else 0.0
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        m = self.confusion.matrix
+        if cls is not None:
+            denom = m[:, cls].sum()
+            return float(m[cls, cls]) / denom if denom else 0.0
+        vals = [self.precision(c) for c in range(m.shape[0]) if m[:, c].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        m = self.confusion.matrix
+        if cls is not None:
+            denom = m[cls, :].sum()
+            return float(m[cls, cls]) / denom if denom else 0.0
+        vals = [self.recall(c) for c in range(m.shape[0]) if m[c, :].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def false_positive_rate(self, cls: int) -> float:
+        m = self.confusion.matrix
+        fp = m[:, cls].sum() - m[cls, cls]
+        tn = m.sum() - m[cls, :].sum() - m[:, cls].sum() + m[cls, cls]
+        return float(fp) / (fp + tn) if (fp + tn) else 0.0
+
+    def stats(self) -> str:
+        m = self.confusion.matrix
+        lines = [
+            "========================Evaluation Metrics========================",
+            f" # of classes:    {m.shape[0]}",
+            f" Accuracy:        {self.accuracy():.4f}",
+            f" Precision:       {self.precision():.4f}",
+            f" Recall:          {self.recall():.4f}",
+            f" F1 Score:        {self.f1():.4f}",
+            "===================================================================",
+        ]
+        return "\n".join(lines)
+
+
+class RegressionEvaluation:
+    """Parity: ref eval/RegressionEvaluation.java — per-column MSE/MAE/RMSE/RSE/R^2."""
+
+    def __init__(self, n_columns: Optional[int] = None):
+        self.n = n_columns
+        self._sum_sq_err = None
+        self._sum_abs_err = None
+        self._sum_label = None
+        self._sum_label_sq = None
+        self._sum_pred = None
+        self._sum_pred_sq = None
+        self._sum_label_pred = None
+        self._count = 0
+
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels, np.float64)
+        predictions = np.asarray(predictions, np.float64)
+        if labels.ndim == 3:
+            b, c, t = labels.shape
+            labels = np.moveaxis(labels, 1, 2).reshape(-1, c)
+            predictions = np.moveaxis(predictions, 1, 2).reshape(-1, c)
+            if mask is not None:
+                keep = np.asarray(mask).reshape(-1) > 0
+                labels, predictions = labels[keep], predictions[keep]
+        if self._sum_sq_err is None:
+            self.n = self.n or labels.shape[-1]
+            z = np.zeros(self.n)
+            self._sum_sq_err = z.copy(); self._sum_abs_err = z.copy()
+            self._sum_label = z.copy(); self._sum_label_sq = z.copy()
+            self._sum_pred = z.copy(); self._sum_pred_sq = z.copy()
+            self._sum_label_pred = z.copy()
+        err = labels - predictions
+        self._sum_sq_err += np.sum(err ** 2, axis=0)
+        self._sum_abs_err += np.sum(np.abs(err), axis=0)
+        self._sum_label += labels.sum(axis=0)
+        self._sum_label_sq += (labels ** 2).sum(axis=0)
+        self._sum_pred += predictions.sum(axis=0)
+        self._sum_pred_sq += (predictions ** 2).sum(axis=0)
+        self._sum_label_pred += (labels * predictions).sum(axis=0)
+        self._count += labels.shape[0]
+
+    def mean_squared_error(self, col: int = 0) -> float:
+        return float(self._sum_sq_err[col] / self._count)
+
+    def mean_absolute_error(self, col: int = 0) -> float:
+        return float(self._sum_abs_err[col] / self._count)
+
+    def root_mean_squared_error(self, col: int = 0) -> float:
+        return float(np.sqrt(self.mean_squared_error(col)))
+
+    def correlation_r2(self, col: int = 0) -> float:
+        n = self._count
+        sl, sp = self._sum_label[col], self._sum_pred[col]
+        num = n * self._sum_label_pred[col] - sl * sp
+        den = np.sqrt((n * self._sum_label_sq[col] - sl ** 2) *
+                      (n * self._sum_pred_sq[col] - sp ** 2))
+        return float(num / den) if den else 0.0
+
+    def stats(self) -> str:
+        cols = range(self.n)
+        lines = ["Column    MSE            MAE            RMSE           R^2"]
+        for c in cols:
+            lines.append(f"col_{c}   {self.mean_squared_error(c):.6e}  "
+                         f"{self.mean_absolute_error(c):.6e}  "
+                         f"{self.root_mean_squared_error(c):.6e}  "
+                         f"{self.correlation_r2(c):.6f}")
+        return "\n".join(lines)

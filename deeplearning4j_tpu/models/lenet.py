@@ -1,0 +1,60 @@
+"""LeNet (ref deeplearning4j-zoo/.../zoo/model/LeNet.java:31).
+
+Same architecture: conv5x5(20,relu,Same) → maxpool2 → conv5x5(50,relu,Same) → maxpool2 →
+dense(500,relu) → softmax output; AdaDelta updater; convolutionalFlat input.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.enums import (
+    Activation, ConvolutionMode, LossFunction, PoolingType, WeightInit)
+from deeplearning4j_tpu.models.zoo_model import PretrainedType, ZooModel
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+    ConvolutionLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.layers.feedforward import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater.updaters import AdaDelta
+
+
+class LeNet(ZooModel):
+    def __init__(self, num_labels: int = 10, seed: int = 123,
+                 input_shape=(1, 28, 28), updater=None, dtype: str = "float32"):
+        super().__init__(num_labels, seed)
+        self.input_shape = tuple(input_shape)
+        self.updater = updater or AdaDelta()
+        self.dtype = dtype
+
+    def conf(self):
+        c, h, w = self.input_shape
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .activation(Activation.IDENTITY)
+                .weight_init(WeightInit.XAVIER)
+                .updater(self.updater)
+                .convolution_mode(ConvolutionMode.Same)
+                .dtype(self.dtype)
+                .list()
+                .layer(ConvolutionLayer(name="cnn1", n_in=c, n_out=20,
+                                        kernel_size=(5, 5), stride=(1, 1),
+                                        activation=Activation.RELU))
+                .layer(SubsamplingLayer(name="maxpool1", pooling_type=PoolingType.MAX,
+                                        kernel_size=(2, 2), stride=(2, 2)))
+                .layer(ConvolutionLayer(name="cnn2", n_out=50, kernel_size=(5, 5),
+                                        stride=(1, 1), activation=Activation.RELU))
+                .layer(SubsamplingLayer(name="maxpool2", pooling_type=PoolingType.MAX,
+                                        kernel_size=(2, 2), stride=(2, 2)))
+                .layer(DenseLayer(name="ffn1", n_out=500, activation=Activation.RELU))
+                .layer(OutputLayer(name="output", n_out=self.num_labels,
+                                   loss_fn=LossFunction.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.convolutional_flat(h, w, c))
+                .build())
+
+    def pretrained_url(self, pretrained_type):
+        if pretrained_type == PretrainedType.MNIST:
+            return "http://blob.deeplearning4j.org/models/lenet_dl4j_mnist_inference.zip"
+        return None
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()

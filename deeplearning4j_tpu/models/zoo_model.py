@@ -1,0 +1,55 @@
+"""Model zoo base class.
+
+Parity: ref deeplearning4j-zoo/.../zoo/ZooModel.java (initPretrained, pretrainedUrl,
+pretrainedChecksum) + ModelMetaData. Pretrained-weight download requires network access;
+`init_pretrained` loads from a local cache dir ($DL4J_TPU_ZOO_CACHE or
+~/.deeplearning4j_tpu/zoo) when the checkpoint file is present.
+"""
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional, Sequence
+
+
+class PretrainedType:
+    IMAGENET = "imagenet"
+    MNIST = "mnist"
+    CIFAR10 = "cifar10"
+    VGGFACE = "vggface"
+
+
+class ZooModel:
+    """Subclasses implement conf() (or graph_conf()) and init()."""
+
+    def __init__(self, num_labels: int = 1000, seed: int = 123):
+        self.num_labels = num_labels
+        self.seed = seed
+        self.input_shape: Sequence[int] = (3, 224, 224)
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        raise NotImplementedError
+
+    def pretrained_url(self, pretrained_type: str) -> Optional[str]:
+        return None
+
+    def pretrained_available(self, pretrained_type: str) -> bool:
+        return self._pretrained_path(pretrained_type).exists()
+
+    def _pretrained_path(self, pretrained_type: str) -> Path:
+        cache = Path(os.environ.get("DL4J_TPU_ZOO_CACHE",
+                                    "~/.deeplearning4j_tpu/zoo")).expanduser()
+        return cache / f"{type(self).__name__.lower()}_{pretrained_type}.zip"
+
+    def init_pretrained(self, pretrained_type: str = PretrainedType.IMAGENET):
+        path = self._pretrained_path(pretrained_type)
+        if not path.exists():
+            raise FileNotFoundError(
+                f"Pretrained weights for {type(self).__name__} ({pretrained_type}) not "
+                f"found at {path}; this environment has no network egress — place the "
+                f"checkpoint there manually")
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+        return ModelSerializer.restore(str(path))

@@ -1,0 +1,57 @@
+"""Activation functions as pure jnp functions.
+
+Parity with the reference's activation set (ND4J `IActivation` implementations referenced
+from nn/conf/layers via `Activation` enum). All are elementwise and fuse into adjacent
+matmuls under XLA — no hand-written derivatives needed (autodiff replaces the reference's
+per-activation backprop methods).
+"""
+from __future__ import annotations
+
+from typing import Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.enums import Activation
+
+ArrayFn = Callable[[jnp.ndarray], jnp.ndarray]
+
+
+def _rationaltanh(x):
+    # tanh approximation: 1.7159 * tanh(2x/3) (LeCun); reference uses a rational approx
+    # with the same saturation profile.
+    return 1.7159 * jnp.tanh(2.0 * x / 3.0)
+
+
+_ACTIVATIONS: dict[Activation, ArrayFn] = {
+    Activation.IDENTITY: lambda x: x,
+    Activation.RELU: jax.nn.relu,
+    Activation.RELU6: lambda x: jnp.clip(x, 0.0, 6.0),
+    Activation.LEAKYRELU: lambda x: jax.nn.leaky_relu(x, negative_slope=0.01),
+    Activation.TANH: jnp.tanh,
+    Activation.SIGMOID: jax.nn.sigmoid,
+    Activation.HARDSIGMOID: jax.nn.hard_sigmoid,
+    Activation.HARDTANH: lambda x: jnp.clip(x, -1.0, 1.0),
+    Activation.SOFTMAX: lambda x: jax.nn.softmax(x, axis=-1),
+    Activation.SOFTPLUS: jax.nn.softplus,
+    Activation.SOFTSIGN: jax.nn.soft_sign,
+    Activation.ELU: jax.nn.elu,
+    Activation.SELU: jax.nn.selu,
+    Activation.GELU: jax.nn.gelu,
+    Activation.SWISH: jax.nn.swish,
+    Activation.CUBE: lambda x: x ** 3,
+    Activation.RATIONALTANH: _rationaltanh,
+    Activation.RECTIFIEDTANH: lambda x: jnp.maximum(0.0, jnp.tanh(x)),
+}
+
+
+def get_activation(act: Union[Activation, str, None]) -> ArrayFn:
+    if act is None:
+        return _ACTIVATIONS[Activation.IDENTITY]
+    if isinstance(act, str):
+        act = Activation(act.lower())
+    return _ACTIVATIONS[act]
+
+
+def apply_activation(act, x: jnp.ndarray) -> jnp.ndarray:
+    return get_activation(act)(x)

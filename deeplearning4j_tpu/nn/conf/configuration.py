@@ -1,0 +1,390 @@
+"""NeuralNetConfiguration builder + MultiLayerConfiguration.
+
+Parity: ref nn/conf/NeuralNetConfiguration.java:72 (Builder; ListBuilder :220-244;
+toJson/fromJson :328-349) and nn/conf/MultiLayerConfiguration.java. Configs are pure data
+with JSON round-trip — the property that makes replica reconstruction and multi-process
+config shipping trivial (ref DefaultTrainer.java:255-257) — while execution is a single
+traced XLA computation built by the network classes.
+
+The ListBuilder performs the same two config-time passes as the reference:
+nIn inference from the running InputType, and automatic preprocessor insertion between
+layer families (ref InputTypeUtil / MultiLayerConfiguration.Builder#inputType handling).
+"""
+from __future__ import annotations
+
+import copy
+import dataclasses
+import json
+from dataclasses import dataclass, field as dc_field
+from typing import Any, Dict, List, Optional, Union
+
+from deeplearning4j_tpu.common.enums import (
+    Activation, BackpropType, CacheMode, GradientNormalization,
+    OptimizationAlgorithm, WeightInit, WorkspaceMode)
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import BaseLayerConf, FeedForwardLayerConf
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor, CnnToRnnPreProcessor, FeedForwardToCnnPreProcessor,
+    FeedForwardToRnnPreProcessor, InputPreProcessor, RnnToCnnPreProcessor,
+    RnnToFeedForwardPreProcessor)
+from deeplearning4j_tpu.nn.updater.updaters import BaseUpdater, Sgd, updater_from_name
+
+# Which input-kind each layer family expects; None = accepts anything as-is.
+_EXPECTED_KIND = {
+    "DenseLayer": "ff", "OutputLayer": "ff", "EmbeddingLayer": "ff",
+    "AutoEncoder": "ff", "CenterLossOutputLayer": "ff", "VariationalAutoencoder": "ff",
+    "RBM": "ff",
+    "ConvolutionLayer": "cnn", "SubsamplingLayer": "cnn", "ZeroPaddingLayer": "cnn",
+    "LocalResponseNormalization": "cnn", "SpaceToDepthLayer": "cnn", "Upsampling2D": "cnn",
+    "DepthwiseConvolutionLayer": "cnn", "SeparableConvolution2D": "cnn",
+    "Deconvolution2D": "cnn", "Cropping2D": "cnn",
+    "LSTM": "rnn", "GravesLSTM": "rnn", "GravesBidirectionalLSTM": "rnn",
+    "RnnOutputLayer": "rnn", "Convolution1DLayer": "rnn", "Subsampling1DLayer": "rnn",
+    "SimpleRnn": "rnn", "Bidirectional": "rnn", "LastTimeStep": "rnn",
+}
+
+
+def make_preprocessor(from_type: InputType, to_kind: str) -> Optional[InputPreProcessor]:
+    fk = from_type.kind
+    if fk == to_kind or (fk == "cnn_flat" and to_kind == "ff"):
+        return None
+    if fk == "cnn" and to_kind == "ff":
+        return CnnToFeedForwardPreProcessor(from_type.height, from_type.width,
+                                            from_type.channels)
+    if fk == "ff" and to_kind == "cnn":
+        raise ValueError("Cannot infer CNN dims from FF input; set an explicit "
+                         "FeedForwardToCnnPreProcessor")
+    if fk == "cnn_flat" and to_kind == "cnn":
+        return FeedForwardToCnnPreProcessor(from_type.height, from_type.width,
+                                            from_type.channels)
+    if fk == "rnn" and to_kind == "ff":
+        return RnnToFeedForwardPreProcessor()
+    if fk == "ff" and to_kind == "rnn":
+        return FeedForwardToRnnPreProcessor()
+    if fk == "cnn" and to_kind == "rnn":
+        return CnnToRnnPreProcessor(from_type.height, from_type.width, from_type.channels)
+    if fk == "rnn" and to_kind == "cnn":
+        raise ValueError("rnn→cnn requires explicit RnnToCnnPreProcessor dims")
+    return None
+
+
+@dataclass
+class GlobalConf:
+    """Network-wide defaults + training hyper-settings (subset of
+    NeuralNetConfiguration fields that aren't per-layer)."""
+    seed: int = 12345
+    optimization_algo: OptimizationAlgorithm = OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT
+    updater: Optional[dict] = None  # serialized BaseUpdater; default Sgd
+    max_num_line_search_iterations: int = 5
+    mini_batch: bool = True
+    minimize: bool = True
+    dtype: str = "float32"
+
+    def to_dict(self):
+        d = dataclasses.asdict(self)
+        d["optimization_algo"] = self.optimization_algo.value
+        return d
+
+    @staticmethod
+    def from_dict(d):
+        d = dict(d)
+        d["optimization_algo"] = OptimizationAlgorithm(d.get("optimization_algo", "sgd"))
+        return GlobalConf(**d)
+
+
+class MultiLayerConfiguration:
+    """Ordered layer stack + preprocessors + training-time settings
+    (ref nn/conf/MultiLayerConfiguration.java)."""
+
+    def __init__(self, layers: List[BaseLayerConf],
+                 preprocessors: Dict[int, InputPreProcessor],
+                 global_conf: GlobalConf,
+                 input_type: Optional[InputType] = None,
+                 backprop_type: BackpropType = BackpropType.Standard,
+                 tbptt_fwd_length: int = 20,
+                 tbptt_back_length: int = 20,
+                 pretrain: bool = False,
+                 backprop: bool = True):
+        self.layers = layers
+        self.preprocessors = preprocessors
+        self.global_conf = global_conf
+        self.input_type = input_type
+        self.backprop_type = backprop_type
+        self.tbptt_fwd_length = tbptt_fwd_length
+        self.tbptt_back_length = tbptt_back_length
+        self.pretrain = pretrain
+        self.backprop = backprop
+
+    # ---- serde (ref NeuralNetConfiguration.java:328-349 toJson/fromJson) ----
+    def to_dict(self) -> dict:
+        return {
+            "layers": [l.to_dict() for l in self.layers],
+            "preprocessors": {str(k): v.to_dict() for k, v in self.preprocessors.items()},
+            "global_conf": self.global_conf.to_dict(),
+            "input_type": self.input_type.to_dict() if self.input_type else None,
+            "backprop_type": self.backprop_type.value,
+            "tbptt_fwd_length": self.tbptt_fwd_length,
+            "tbptt_back_length": self.tbptt_back_length,
+            "pretrain": self.pretrain,
+            "backprop": self.backprop,
+        }
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(self.to_dict(), indent=kw.pop("indent", 2), **kw)
+
+    @staticmethod
+    def from_dict(d: dict) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration(
+            layers=[BaseLayerConf.from_dict(x) for x in d["layers"]],
+            preprocessors={int(k): InputPreProcessor.from_dict(v)
+                           for k, v in (d.get("preprocessors") or {}).items()},
+            global_conf=GlobalConf.from_dict(d["global_conf"]),
+            input_type=InputType.from_dict(d["input_type"]) if d.get("input_type") else None,
+            backprop_type=BackpropType(d.get("backprop_type", "standard")),
+            tbptt_fwd_length=d.get("tbptt_fwd_length", 20),
+            tbptt_back_length=d.get("tbptt_back_length", 20),
+            pretrain=d.get("pretrain", False),
+            backprop=d.get("backprop", True),
+        )
+
+    @staticmethod
+    def from_json(s: str) -> "MultiLayerConfiguration":
+        return MultiLayerConfiguration.from_dict(json.loads(s))
+
+    def get_updater(self) -> BaseUpdater:
+        if self.global_conf.updater is None:
+            return Sgd()
+        return BaseUpdater.from_dict(self.global_conf.updater)
+
+    def input_types_per_layer(self) -> List[InputType]:
+        """InputType *into* each layer (after its preprocessor)."""
+        if self.input_type is None:
+            raise ValueError("Configuration has no input type set")
+        cur = self.input_type
+        result = []
+        for i, layer in enumerate(self.layers):
+            if i in self.preprocessors:
+                cur = self.preprocessors[i].get_output_type(cur)
+            result.append(cur)
+            cur = layer.get_output_type(cur)
+        return result
+
+
+class NeuralNetConfiguration:
+    """Namespace matching the reference entry point: NeuralNetConfiguration.Builder."""
+
+    class Builder:
+        def __init__(self):
+            self._global = GlobalConf()
+            self._layer_defaults: Dict[str, Any] = {}
+
+        # ---- global training settings ----
+        def seed(self, s: int):
+            self._global.seed = int(s)
+            return self
+
+        def optimization_algo(self, algo: OptimizationAlgorithm):
+            self._global.optimization_algo = algo
+            return self
+        optimizationAlgo = optimization_algo
+
+        def updater(self, u: Union[BaseUpdater, str]):
+            if isinstance(u, str):
+                lr = self._layer_defaults.get("learning_rate", 0.1)
+                u = updater_from_name(u, learning_rate=lr)
+            self._global.updater = u.to_dict()
+            return self
+
+        def learning_rate(self, lr: float):
+            self._layer_defaults["learning_rate"] = float(lr)
+            if self._global.updater is not None:
+                self._global.updater["learning_rate"] = float(lr)
+            return self
+        learningRate = learning_rate
+
+        def mini_batch(self, b: bool):
+            self._global.mini_batch = bool(b)
+            return self
+
+        def dtype(self, dt: str):
+            self._global.dtype = dt
+            return self
+
+        def regularization(self, use: bool):  # API parity; l1/l2 values drive behavior
+            return self
+
+        # ---- per-layer defaults (applied where a layer didn't override) ----
+        def activation(self, a):
+            self._layer_defaults["activation"] = Activation(a) if isinstance(a, str) else a
+            return self
+
+        def weight_init(self, w):
+            self._layer_defaults["weight_init"] = WeightInit(w) if isinstance(w, str) else w
+            return self
+        weightInit = weight_init
+
+        def dist(self, d: dict):
+            self._layer_defaults["dist"] = d
+            return self
+
+        def bias_init(self, b: float):
+            self._layer_defaults["bias_init"] = float(b)
+            return self
+
+        def l1(self, v: float):
+            self._layer_defaults["l1"] = float(v)
+            return self
+
+        def l2(self, v: float):
+            self._layer_defaults["l2"] = float(v)
+            return self
+
+        def drop_out(self, v: float):
+            self._layer_defaults["dropout"] = float(v)
+            return self
+        dropOut = drop_out
+
+        def convolution_mode(self, m):
+            from deeplearning4j_tpu.common.enums import ConvolutionMode
+            self._layer_defaults["convolution_mode"] = (
+                ConvolutionMode(m) if isinstance(m, str) else m)
+            return self
+        convolutionMode = convolution_mode
+
+        def gradient_normalization(self, g: GradientNormalization):
+            self._layer_defaults["gradient_normalization"] = g
+            return self
+
+        def gradient_normalization_threshold(self, t: float):
+            self._layer_defaults["gradient_normalization_threshold"] = float(t)
+            return self
+
+        # no-op parity knobs (XLA owns memory/workspaces)
+        def training_workspace_mode(self, m: WorkspaceMode):
+            return self
+
+        def inference_workspace_mode(self, m: WorkspaceMode):
+            return self
+
+        def cache_mode(self, m: CacheMode):
+            return self
+
+        def iterations(self, n: int):  # legacy DL4J "iterations per fit call" — always 1
+            return self
+
+        def list(self) -> "ListBuilder":
+            return ListBuilder(self)
+
+        def graph_builder(self):
+            try:
+                from deeplearning4j_tpu.nn.conf.graph_configuration import GraphBuilder
+            except ImportError as e:
+                raise NotImplementedError(
+                    "ComputationGraph configuration is not available yet") from e
+            return GraphBuilder(self)
+        graphBuilder = graph_builder
+
+        def _apply_defaults(self, layer: BaseLayerConf) -> BaseLayerConf:
+            layer = copy.deepcopy(layer)
+            explicit = getattr(layer, "_explicit", set())
+            for k, v in self._layer_defaults.items():
+                if k == "learning_rate":
+                    continue
+                if hasattr(layer, k) and k not in explicit:
+                    setattr(layer, k, copy.deepcopy(v))
+            return layer
+
+
+class ListBuilder:
+    """Sequential-network builder (ref NeuralNetConfiguration.ListBuilder :220-244)."""
+
+    def __init__(self, parent: NeuralNetConfiguration.Builder):
+        self._parent = parent
+        self._layers: Dict[int, BaseLayerConf] = {}
+        self._preprocessors: Dict[int, InputPreProcessor] = {}
+        self._input_type: Optional[InputType] = None
+        self._backprop_type = BackpropType.Standard
+        self._tbptt_fwd = 20
+        self._tbptt_back = 20
+        self._pretrain = False
+        self._backprop = True
+
+    def layer(self, index_or_layer, layer: Optional[BaseLayerConf] = None):
+        if layer is None:
+            index, layer = len(self._layers), index_or_layer
+        else:
+            index = int(index_or_layer)
+        self._layers[index] = layer
+        return self
+
+    def input_pre_processor(self, index: int, pp: InputPreProcessor):
+        self._preprocessors[int(index)] = pp
+        return self
+    inputPreProcessor = input_pre_processor
+
+    def set_input_type(self, it: InputType):
+        self._input_type = it
+        return self
+    setInputType = set_input_type
+
+    def backprop_type(self, t: BackpropType):
+        self._backprop_type = t
+        return self
+    backpropType = backprop_type
+
+    def t_bptt_forward_length(self, n: int):
+        self._tbptt_fwd = int(n)
+        return self
+    tBPTTForwardLength = t_bptt_forward_length
+
+    def t_bptt_backward_length(self, n: int):
+        self._tbptt_back = int(n)
+        return self
+    tBPTTBackwardLength = t_bptt_backward_length
+
+    def pretrain(self, b: bool):
+        self._pretrain = bool(b)
+        return self
+
+    def backprop(self, b: bool):
+        self._backprop = bool(b)
+        return self
+
+    def build(self) -> MultiLayerConfiguration:
+        n = len(self._layers)
+        layers = []
+        for i in range(n):
+            if i not in self._layers:
+                raise ValueError(f"Missing layer index {i}")
+            layers.append(self._parent._apply_defaults(self._layers[i]))
+
+        if self._input_type is not None:
+            cur = self._input_type
+            if cur.kind == "cnn_flat":
+                # reference behavior: flat CNN input auto-reshapes to NCHW at layer 0
+                expected0 = _EXPECTED_KIND.get(type(layers[0]).__name__)
+                if expected0 == "cnn" and 0 not in self._preprocessors:
+                    self._preprocessors[0] = FeedForwardToCnnPreProcessor(
+                        cur.height, cur.width, cur.channels)
+            for i, layer in enumerate(layers):
+                expected = _EXPECTED_KIND.get(type(layer).__name__)
+                if i not in self._preprocessors and expected is not None:
+                    pp = make_preprocessor(cur, expected)
+                    if pp is not None:
+                        self._preprocessors[i] = pp
+                if i in self._preprocessors:
+                    cur = self._preprocessors[i].get_output_type(cur)
+                layer.set_n_in(cur, override=False)
+                cur = layer.get_output_type(cur)
+
+        gc = self._parent._global
+        # propagate builder-level learning rate into the default updater
+        if gc.updater is None and "learning_rate" in self._parent._layer_defaults:
+            gc = copy.deepcopy(gc)
+            gc.updater = Sgd(
+                learning_rate=self._parent._layer_defaults["learning_rate"]).to_dict()
+        return MultiLayerConfiguration(
+            layers=layers, preprocessors=dict(self._preprocessors), global_conf=gc,
+            input_type=self._input_type, backprop_type=self._backprop_type,
+            tbptt_fwd_length=self._tbptt_fwd, tbptt_back_length=self._tbptt_back,
+            pretrain=self._pretrain, backprop=self._backprop)

@@ -1,0 +1,180 @@
+"""Dense / output / embedding / activation / dropout / autoencoder layers.
+
+Parity: ref nn/conf/layers/{DenseLayer,OutputLayer,LossLayer,EmbeddingLayer,
+ActivationLayer,DropoutLayer,AutoEncoder}.java and their implementations under
+nn/layers/feedforward/. Forward math is a single fused matmul+bias+activation per layer —
+XLA maps it straight onto the MXU.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.enums import Activation, LossFunction, WeightInit
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import (
+    BaseLayerConf, FeedForwardLayerConf, register_layer)
+from deeplearning4j_tpu.nn.losses import compute_loss
+
+
+@register_layer
+@dataclass
+class DenseLayer(FeedForwardLayerConf):
+    """Fully connected layer (ref nn/layers/feedforward/dense/DenseLayer.java)."""
+    has_bias: bool = True
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kw, _ = jax.random.split(key)
+        p = {"W": self._winit(kw, (self.n_in, self.n_out), self.n_in, self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act(z), state, mask
+
+
+@register_layer
+@dataclass
+class OutputLayer(DenseLayer):
+    """Dense + loss head (ref nn/conf/layers/OutputLayer.java). `compute_score` consumes
+    pre-activations so softmax+MCXENT stays numerically fused."""
+    loss_fn: LossFunction = LossFunction.MCXENT
+    activation: Activation = Activation.SOFTMAX
+
+    def is_output_layer(self):
+        return True
+
+    def preout(self, params, x):
+        z = x @ params["W"]
+        if self.has_bias:
+            z = z + params["b"]
+        return z
+
+    def compute_score(self, params, x, labels, mask=None):
+        return compute_loss(self.loss_fn, labels, self.preout(params, x),
+                            self.activation, mask)
+
+
+@register_layer
+@dataclass
+class LossLayer(BaseLayerConf):
+    """Parameterless loss head (ref nn/conf/layers/LossLayer.java)."""
+    loss_fn: LossFunction = LossFunction.MCXENT
+    activation: Activation = Activation.SOFTMAX
+
+    def is_output_layer(self):
+        return True
+
+    def has_params(self):
+        return False
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        return self._act(x), state, mask
+
+    def preout(self, params, x):
+        return x
+
+    def compute_score(self, params, x, labels, mask=None):
+        return compute_loss(self.loss_fn, labels, x, self.activation, mask)
+
+
+@register_layer
+@dataclass
+class EmbeddingLayer(FeedForwardLayerConf):
+    """Index → vector lookup (ref nn/layers/feedforward/embedding/EmbeddingLayer.java).
+    Input: (batch, 1) or (batch,) integer indices. On TPU this lowers to a gather —
+    no one-hot matmul."""
+    has_bias: bool = True
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        p = {"W": self._winit(key, (self.n_in, self.n_out), self.n_in, self.n_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        idx = x.astype(jnp.int32)
+        if idx.ndim == 2:
+            idx = idx[:, 0]
+        z = params["W"][idx]
+        if self.has_bias:
+            z = z + params["b"]
+        return self._act(z), state, mask
+
+
+@register_layer
+@dataclass
+class ActivationLayer(BaseLayerConf):
+    """Pure activation (ref nn/conf/layers/ActivationLayer.java)."""
+    def has_params(self):
+        return False
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        return self._act(x), state, mask
+
+
+@register_layer
+@dataclass
+class DropoutLayer(BaseLayerConf):
+    """Dropout as an explicit layer (ref nn/conf/layers/DropoutLayer.java). The `dropout`
+    field (retain prob) is applied by the network's input-dropout pass; this layer is
+    identity at inference."""
+    dropout: float = 0.5
+
+    def has_params(self):
+        return False
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        return self._act(x), state, mask
+
+
+@register_layer
+@dataclass
+class AutoEncoder(FeedForwardLayerConf):
+    """Denoising autoencoder layer (ref nn/layers/feedforward/autoencoder/AutoEncoder.java).
+    Supervised forward = encoder only; `reconstruct` gives decode path; pretraining uses
+    reconstruction loss with input corruption."""
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+    pretrain_loss: LossFunction = LossFunction.MSE
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kw, _ = jax.random.split(key)
+        return {
+            "W": self._winit(kw, (self.n_in, self.n_out), self.n_in, self.n_out, dtype),
+            "b": jnp.full((self.n_out,), self.bias_init, dtype),
+            "vb": jnp.zeros((self.n_in,), dtype),  # visible bias for decode
+        }
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        return self._act(x @ params["W"] + params["b"]), state, mask
+
+    def encode(self, params, x):
+        return self._act(x @ params["W"] + params["b"])
+
+    def decode(self, params, h):
+        return self._act(h @ params["W"].T + params["vb"])
+
+    def pretrain_score(self, params, x, rng):
+        xc = x
+        if self.corruption_level > 0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level, x.shape)
+            xc = jnp.where(keep, x, 0.0)
+        recon_z = self.encode(params, xc) @ params["W"].T + params["vb"]
+        return compute_loss(self.pretrain_loss, x, recon_z, self.activation)

@@ -1,0 +1,100 @@
+"""Batch normalization and local response normalization.
+
+Parity: ref nn/layers/normalization/{BatchNormalization,LocalResponseNormalization}.java
+(+ cuDNN helpers BatchNormalizationHelper / LocalResponseNormalizationHelper — here XLA
+fuses the whole normalization into neighbouring ops, so no helper seam is needed).
+Running mean/var live in the network's mutable `state` pytree and are updated functionally
+inside the jitted train step.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import jax.numpy as jnp
+from dataclasses import field
+
+from deeplearning4j_tpu.common.enums import Activation
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import (
+    BaseLayerConf, FeedForwardLayerConf, register_layer)
+
+
+@register_layer
+@dataclass
+class BatchNormalization(FeedForwardLayerConf):
+    """BN over features (FF input) or channels (CNN input, NCHW axis 1)."""
+    decay: float = 0.9  # running-average momentum (ref BatchNormalization decay param)
+    eps: float = 1e-5
+    gamma: float = 1.0
+    beta: float = 0.0
+    lock_gamma_beta: bool = False
+
+    def set_n_in(self, input_type, override=False):
+        if self.n_in == 0 or override:
+            self.n_in = input_type.size  # channels for CNN, size for FF
+        if self.n_out == 0 or override:
+            self.n_out = self.n_in
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        n = self.n_in
+        if self.lock_gamma_beta:
+            return {}
+        return {"gamma_w": jnp.full((n,), self.gamma, dtype),
+                "beta": jnp.full((n,), self.beta, dtype)}
+
+    def init_state(self, input_type, dtype=jnp.float32):
+        n = self.n_in
+        return {"mean": jnp.zeros((n,), dtype), "var": jnp.ones((n,), dtype)}
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        if x.ndim == 4:
+            axes, shape = (0, 2, 3), (1, -1, 1, 1)
+        elif x.ndim == 3:
+            axes, shape = (0, 2), (1, -1, 1)
+        else:
+            axes, shape = (0,), (1, -1)
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            d = self.decay
+            new_state = {"mean": d * state["mean"] + (1 - d) * mean,
+                         "var": d * state["var"] + (1 - d) * var}
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        xhat = (x - mean.reshape(shape)) / jnp.sqrt(var.reshape(shape) + self.eps)
+        if self.lock_gamma_beta:
+            out = self.gamma * xhat + self.beta
+        else:
+            out = params["gamma_w"].reshape(shape) * xhat + params["beta"].reshape(shape)
+        return self._act(out), new_state, mask
+
+
+@register_layer
+@dataclass
+class LocalResponseNormalization(BaseLayerConf):
+    """Cross-channel LRN (ref nn/layers/normalization/LocalResponseNormalization.java):
+    out = x / (k + alpha*sum_{j in window} x_j^2)^beta over the channel axis."""
+    k: float = 2.0
+    n: float = 5.0
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def has_params(self):
+        return False
+
+    def get_output_type(self, input_type):
+        return input_type
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        half = int(self.n) // 2
+        sq = jnp.square(x)
+        # windowed sum over channels: pad then sliding sum (static window → XLA fuses)
+        padded = jnp.pad(sq, ((0, 0), (half, half), (0, 0), (0, 0)))
+        acc = sum(padded[:, i:i + x.shape[1]] for i in range(2 * half + 1))
+        denom = (self.k + self.alpha * acc) ** self.beta
+        return self._act(x / denom), state, mask

@@ -1,0 +1,175 @@
+"""Input preprocessors: shape adapters between layer families.
+
+Parity: ref nn/conf/preprocessor/{CnnToFeedForwardPreProcessor,FeedForwardToCnnPreProcessor,
+CnnToRnnPreProcessor,RnnToCnnPreProcessor,FeedForwardToRnnPreProcessor,
+RnnToFeedForwardPreProcessor,ComposableInputPreProcessor}.java. In the reference these also
+implement `backprop` (reverse reshape); autodiff makes that unnecessary here — each is a
+pure reshape/transpose that XLA folds into layout assignment.
+
+Layouts: FF (batch, size); CNN (batch, c, h, w); RNN (batch, size, time).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+
+PREPROCESSOR_REGISTRY: dict[str, type] = {}
+
+
+def register_preprocessor(cls):
+    PREPROCESSOR_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+@dataclass
+class InputPreProcessor:
+    def preprocess(self, x: jnp.ndarray) -> jnp.ndarray:
+        raise NotImplementedError
+
+    def get_output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def feed_forward_mask(self, mask, minibatch_size=None):
+        return mask
+
+    def to_dict(self):
+        import dataclasses
+        d = dataclasses.asdict(self)
+        d["@class"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "InputPreProcessor":
+        d = dict(d)
+        cls = PREPROCESSOR_REGISTRY[d.pop("@class")]
+        if "processors" in d:
+            d["processors"] = tuple(InputPreProcessor.from_dict(p) if isinstance(p, dict)
+                                    else p for p in d["processors"])
+        for k, v in list(d.items()):
+            if isinstance(v, list):
+                d[k] = tuple(v)
+        return cls(**d)
+
+
+@register_preprocessor
+@dataclass
+class CnnToFeedForwardPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def preprocess(self, x):
+        return x.reshape(x.shape[0], -1)
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(input_type.flat_size())
+
+
+@register_preprocessor
+@dataclass
+class FeedForwardToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def preprocess(self, x):
+        if x.ndim == 4:
+            return x
+        return x.reshape(x.shape[0], self.channels, self.height, self.width)
+
+    def get_output_type(self, input_type):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preprocessor
+@dataclass
+class RnnToFeedForwardPreProcessor(InputPreProcessor):
+    """(batch, size, time) → (batch*time, size) — stacks timesteps
+    (ref RnnToFeedForwardPreProcessor.java)."""
+
+    def preprocess(self, x):
+        # (b, s, t) → (b, t, s) → (b*t, s)
+        return jnp.moveaxis(x, 1, 2).reshape(-1, x.shape[1])
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(input_type.size)
+
+    def feed_forward_mask(self, mask, minibatch_size=None):
+        return None if mask is None else mask.reshape(-1)
+
+
+@register_preprocessor
+@dataclass
+class FeedForwardToRnnPreProcessor(InputPreProcessor):
+    """(batch*time, size) → (batch, size, time); requires the original minibatch size,
+    threaded through by the network at call time."""
+    minibatch: int = 0  # set dynamically at forward time
+
+    def preprocess(self, x, minibatch: Optional[int] = None):
+        b = minibatch or self.minibatch
+        t = x.shape[0] // b
+        return jnp.moveaxis(x.reshape(b, t, x.shape[1]), 1, 2)
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(input_type.size)
+
+
+@register_preprocessor
+@dataclass
+class CnnToRnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def preprocess(self, x):
+        # reference semantics: each example in the (possibly time-stacked) batch flattens;
+        # used under RNN nets where batch = b*t handled by surrounding net
+        return x.reshape(x.shape[0], -1)
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(input_type.flat_size())
+
+
+@register_preprocessor
+@dataclass
+class RnnToCnnPreProcessor(InputPreProcessor):
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def preprocess(self, x):
+        # (b, s, t) → (b*t, c, h, w)
+        b, s, t = x.shape
+        return jnp.moveaxis(x, 1, 2).reshape(b * t, self.channels, self.height, self.width)
+
+    def get_output_type(self, input_type):
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+
+@register_preprocessor
+@dataclass
+class ComposableInputPreProcessor(InputPreProcessor):
+    processors: tuple = ()
+
+    def preprocess(self, x):
+        for p in self.processors:
+            x = p.preprocess(x)
+        return x
+
+    def get_output_type(self, input_type):
+        for p in self.processors:
+            input_type = p.get_output_type(input_type)
+        return input_type
+
+    def to_dict(self):
+        return {"@class": type(self).__name__,
+                "processors": [p.to_dict() for p in self.processors]}
+
+    @staticmethod
+    def from_composable_dict(d):
+        return ComposableInputPreProcessor(
+            processors=tuple(InputPreProcessor.from_dict(p) for p in d["processors"]))

@@ -1,0 +1,114 @@
+"""Loss functions.
+
+Parity with the reference's ILossFunction set (consumed by output layers; ref
+nn/conf/layers OutputLayer / LossLayer configs). Each loss takes the *pre-activation*
+output `z` of the output layer plus the layer's activation, so that
+softmax+MCXENT and sigmoid+XENT use numerically-stable fused forms — the same
+special-casing the reference does inside LossMCXENT/LossBinaryXENT.
+
+Conventions (matching the reference scoring semantics):
+- per-example loss is summed over output dimensions;
+- `score` is the mean over examples (plus any L1/L2 regularization terms added by the
+  network);
+- `mask` is broadcastable to the label shape; masked-out entries contribute zero and
+  the example-mean divides by the number of *unmasked* examples (time-series masking,
+  ref util/MaskedReductionUtil.java).
+"""
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.enums import Activation, LossFunction
+from deeplearning4j_tpu.nn.activations import apply_activation
+
+_EPS = 1e-7
+
+
+def _sum_per_example(x: jnp.ndarray) -> jnp.ndarray:
+    """Sum all dims except the leading (example) dim."""
+    return jnp.sum(x.reshape(x.shape[0], -1), axis=-1)
+
+
+def compute_loss(
+    loss_fn: Union[LossFunction, str],
+    labels: jnp.ndarray,
+    z: jnp.ndarray,
+    activation: Union[Activation, str, None],
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """Mean-over-examples scalar loss from pre-activations `z`."""
+    if isinstance(loss_fn, str):
+        loss_fn = LossFunction(loss_fn.lower())
+    if isinstance(activation, str):
+        activation = Activation(activation.lower())
+
+    per_elem = None  # elementwise loss (same shape as labels)
+
+    if loss_fn in (LossFunction.MCXENT, LossFunction.NEGATIVELOGLIKELIHOOD):
+        if activation == Activation.SOFTMAX:
+            logp = jax.nn.log_softmax(z, axis=-1)
+            per_elem = -labels * logp
+        else:
+            out = jnp.clip(apply_activation(activation, z), _EPS, 1.0 - _EPS)
+            per_elem = -labels * jnp.log(out)
+    elif loss_fn == LossFunction.SPARSE_MCXENT:
+        # labels are integer class ids with shape out.shape[:-1]
+        logp = jax.nn.log_softmax(z, axis=-1)
+        nll = -jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)
+        per_elem = nll[..., 0:1] if nll.ndim == z.ndim else nll
+    elif loss_fn == LossFunction.XENT:
+        if activation == Activation.SIGMOID:
+            # stable: max(z,0) - z*y + log(1+exp(-|z|))
+            per_elem = jnp.maximum(z, 0) - z * labels + jnp.log1p(jnp.exp(-jnp.abs(z)))
+        else:
+            out = jnp.clip(apply_activation(activation, z), _EPS, 1.0 - _EPS)
+            per_elem = -(labels * jnp.log(out) + (1 - labels) * jnp.log1p(-out))
+    else:
+        out = apply_activation(activation, z)
+        if loss_fn == LossFunction.MSE:
+            per_elem = jnp.square(labels - out)
+        elif loss_fn == LossFunction.L2:
+            per_elem = jnp.square(labels - out)
+        elif loss_fn == LossFunction.L1:
+            per_elem = jnp.abs(labels - out)
+        elif loss_fn == LossFunction.HINGE:
+            # labels in {-1, +1}
+            per_elem = jnp.maximum(0.0, 1.0 - labels * out)
+        elif loss_fn == LossFunction.SQUARED_HINGE:
+            per_elem = jnp.square(jnp.maximum(0.0, 1.0 - labels * out))
+        elif loss_fn == LossFunction.KL_DIVERGENCE:
+            lc = jnp.clip(labels, _EPS, 1.0)
+            oc = jnp.clip(out, _EPS, 1.0)
+            per_elem = lc * (jnp.log(lc) - jnp.log(oc))
+        elif loss_fn == LossFunction.POISSON:
+            per_elem = out - labels * jnp.log(jnp.clip(out, _EPS, None))
+        elif loss_fn == LossFunction.MEAN_ABSOLUTE_PERCENTAGE_ERROR:
+            per_elem = 100.0 * jnp.abs((labels - out) / jnp.clip(jnp.abs(labels), _EPS, None))
+        elif loss_fn == LossFunction.MEAN_SQUARED_LOGARITHMIC_ERROR:
+            per_elem = jnp.square(jnp.log1p(jnp.clip(out, -1 + _EPS, None))
+                                  - jnp.log1p(jnp.clip(labels, -1 + _EPS, None)))
+        elif loss_fn == LossFunction.COSINE_PROXIMITY:
+            ln = labels / jnp.clip(jnp.linalg.norm(labels, axis=-1, keepdims=True), _EPS)
+            on = out / jnp.clip(jnp.linalg.norm(out, axis=-1, keepdims=True), _EPS)
+            # per-example; broadcast back to elementwise/num-outputs not meaningful here
+            per_ex = -jnp.sum((ln * on).reshape(labels.shape[0], -1), axis=-1)
+            if mask is not None:
+                m = jnp.broadcast_to(mask.reshape(mask.shape[0], -1)[:, :1], per_ex.shape)
+                per_ex = per_ex * m
+                return jnp.sum(per_ex) / jnp.clip(jnp.sum(m), 1.0)
+            return jnp.mean(per_ex)
+        else:
+            raise ValueError(f"Unsupported loss function: {loss_fn}")
+
+    if mask is not None:
+        m = jnp.broadcast_to(mask.reshape(mask.shape + (1,) * (per_elem.ndim - mask.ndim)),
+                             per_elem.shape).astype(per_elem.dtype)
+        per_elem = per_elem * m
+        # normalize by number of unmasked "examples" — for RNN losses each (example,
+        # timestep) with mask=1 counts as one scoring unit (ref masked scoring semantics)
+        denom = jnp.clip(jnp.sum(m) / max(1, per_elem.shape[-1]), 1.0)
+        return jnp.sum(per_elem) / denom
+    return jnp.mean(_sum_per_example(per_elem))

@@ -1,0 +1,269 @@
+"""Updaters (optimizer state machines).
+
+Parity with the reference's IUpdater set (applied by nn/updater/BaseMultiLayerUpdater.java:38
+over the flat gradient view; actual math lives in ND4J's updater classes). Here each updater
+is a small config object with pure functions:
+
+    init(params)                      -> state pytree (same structure as params)
+    update(grads, state, params, t)   -> (updates, new_state)
+
+`updates` is the step to *subtract* from params. Everything is jit-traceable; the whole
+updater application fuses into the training step XLA computation. State flattens to a single
+vector for checkpointing (updaterState.bin parity, ref util/ModelSerializer.java:39-115).
+
+Learning-rate schedules (ref LearningRatePolicy) are supported via the `schedule` hook:
+a (base_lr, step) -> lr function; `t` is the global iteration counter.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, asdict
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+UPDATER_REGISTRY: dict[str, type] = {}
+
+
+def register_updater(cls):
+    UPDATER_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def _tree_zeros(params):
+    return jax.tree_util.tree_map(jnp.zeros_like, params)
+
+
+def schedule_lr(lr, schedule: Optional[dict], t):
+    """Apply a learning-rate policy dict {type, decay_rate, steps, power,...}."""
+    if not schedule:
+        return lr
+    kind = str(schedule.get("type", "none")).lower()
+    t = jnp.asarray(t, jnp.float32)
+    if kind in ("none",):
+        return lr
+    if kind == "exponential":
+        return lr * schedule.get("decay_rate", 0.99) ** t
+    if kind == "step":
+        steps = float(schedule.get("steps", 1000))
+        return lr * schedule.get("decay_rate", 0.1) ** jnp.floor(t / steps)
+    if kind == "inverse":
+        gamma = float(schedule.get("gamma", 1e-3))
+        power = float(schedule.get("power", 0.75))
+        return lr * (1.0 + gamma * t) ** (-power)
+    if kind == "poly":
+        power = float(schedule.get("power", 1.0))
+        max_iter = float(schedule.get("max_iter", 10000))
+        return lr * (1.0 - jnp.minimum(t / max_iter, 1.0)) ** power
+    if kind == "sigmoid":
+        gamma = float(schedule.get("gamma", 1e-2))
+        steps = float(schedule.get("steps", 1000))
+        return lr / (1.0 + jnp.exp(-gamma * (t - steps)))
+    raise ValueError(f"Unknown lr schedule: {kind}")
+
+
+@dataclass
+class BaseUpdater:
+    learning_rate: float = 1e-3
+    schedule: Optional[dict] = None
+
+    def lr(self, t):
+        return schedule_lr(self.learning_rate, self.schedule, t)
+
+    def init(self, params):
+        return {}
+
+    def update(self, grads, state, params, t):
+        raise NotImplementedError
+
+    # ---- serde ----
+    def to_dict(self):
+        d = asdict(self)
+        d["@class"] = type(self).__name__
+        return d
+
+    @staticmethod
+    def from_dict(d: dict) -> "BaseUpdater":
+        d = dict(d)
+        cls = UPDATER_REGISTRY[d.pop("@class")]
+        return cls(**d)
+
+
+@register_updater
+@dataclass
+class Sgd(BaseUpdater):
+    learning_rate: float = 0.1
+
+    def update(self, grads, state, params, t):
+        lr = self.lr(t)
+        return jax.tree_util.tree_map(lambda g: lr * g, grads), state
+
+
+@register_updater
+@dataclass
+class NoOp(BaseUpdater):
+    def update(self, grads, state, params, t):
+        return jax.tree_util.tree_map(jnp.zeros_like, grads), state
+
+
+@register_updater
+@dataclass
+class Nesterovs(BaseUpdater):
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+
+    def init(self, params):
+        return {"v": _tree_zeros(params)}
+
+    def update(self, grads, state, params, t):
+        lr = self.lr(t)
+        mu = self.momentum
+        v = state["v"]
+        # DL4J Nesterov form: vNew = mu*v - lr*g; update = -(mu*vNew - lr*g) → subtracted
+        v_new = jax.tree_util.tree_map(lambda vi, g: mu * vi - lr * g, v, grads)
+        updates = jax.tree_util.tree_map(lambda vn, g: -(mu * vn - lr * g), v_new, grads)
+        return updates, {"v": v_new}
+
+
+@register_updater
+@dataclass
+class Adam(BaseUpdater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"m": _tree_zeros(params), "v": _tree_zeros(params)}
+
+    def update(self, grads, state, params, t):
+        lr = self.lr(t)
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        tt = jnp.asarray(t, jnp.float32) + 1.0
+        m = jax.tree_util.tree_map(lambda mi, g: b1 * mi + (1 - b1) * g, state["m"], grads)
+        v = jax.tree_util.tree_map(lambda vi, g: b2 * vi + (1 - b2) * g * g, state["v"], grads)
+        alpha = lr * jnp.sqrt(1 - b2 ** tt) / (1 - b1 ** tt)
+        updates = jax.tree_util.tree_map(
+            lambda mi, vi: alpha * mi / (jnp.sqrt(vi) + eps), m, v)
+        return updates, {"m": m, "v": v}
+
+
+@register_updater
+@dataclass
+class AdaMax(BaseUpdater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"m": _tree_zeros(params), "u": _tree_zeros(params)}
+
+    def update(self, grads, state, params, t):
+        lr = self.lr(t)
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        tt = jnp.asarray(t, jnp.float32) + 1.0
+        m = jax.tree_util.tree_map(lambda mi, g: b1 * mi + (1 - b1) * g, state["m"], grads)
+        u = jax.tree_util.tree_map(lambda ui, g: jnp.maximum(b2 * ui, jnp.abs(g)), state["u"], grads)
+        alpha = lr / (1 - b1 ** tt)
+        updates = jax.tree_util.tree_map(lambda mi, ui: alpha * mi / (ui + eps), m, u)
+        return updates, {"m": m, "u": u}
+
+
+@register_updater
+@dataclass
+class Nadam(BaseUpdater):
+    learning_rate: float = 1e-3
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"m": _tree_zeros(params), "v": _tree_zeros(params)}
+
+    def update(self, grads, state, params, t):
+        lr = self.lr(t)
+        b1, b2, eps = self.beta1, self.beta2, self.epsilon
+        tt = jnp.asarray(t, jnp.float32) + 1.0
+        m = jax.tree_util.tree_map(lambda mi, g: b1 * mi + (1 - b1) * g, state["m"], grads)
+        v = jax.tree_util.tree_map(lambda vi, g: b2 * vi + (1 - b2) * g * g, state["v"], grads)
+        m_hat = jax.tree_util.tree_map(
+            lambda mi, g: b1 * mi / (1 - b1 ** (tt + 1)) + (1 - b1) * g / (1 - b1 ** tt),
+            m, grads)
+        v_hat = jax.tree_util.tree_map(lambda vi: vi / (1 - b2 ** tt), v)
+        updates = jax.tree_util.tree_map(
+            lambda mh, vh: lr * mh / (jnp.sqrt(vh) + eps), m_hat, v_hat)
+        return updates, {"m": m, "v": v}
+
+
+@register_updater
+@dataclass
+class AdaGrad(BaseUpdater):
+    learning_rate: float = 0.1
+    epsilon: float = 1e-6
+
+    def init(self, params):
+        return {"h": _tree_zeros(params)}
+
+    def update(self, grads, state, params, t):
+        lr = self.lr(t)
+        h = jax.tree_util.tree_map(lambda hi, g: hi + g * g, state["h"], grads)
+        updates = jax.tree_util.tree_map(
+            lambda hi, g: lr * g / (jnp.sqrt(hi) + self.epsilon), h, grads)
+        return updates, {"h": h}
+
+
+@register_updater
+@dataclass
+class RmsProp(BaseUpdater):
+    learning_rate: float = 1e-1
+    rms_decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def init(self, params):
+        return {"g2": _tree_zeros(params)}
+
+    def update(self, grads, state, params, t):
+        lr = self.lr(t)
+        d = self.rms_decay
+        g2 = jax.tree_util.tree_map(lambda si, g: d * si + (1 - d) * g * g, state["g2"], grads)
+        updates = jax.tree_util.tree_map(
+            lambda si, g: lr * g / (jnp.sqrt(si + self.epsilon)), g2, grads)
+        return updates, {"g2": g2}
+
+
+@register_updater
+@dataclass
+class AdaDelta(BaseUpdater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+    learning_rate: float = 1.0  # unused by the algorithm; kept for API parity
+
+    def init(self, params):
+        return {"g2": _tree_zeros(params), "dx2": _tree_zeros(params)}
+
+    def update(self, grads, state, params, t):
+        rho, eps = self.rho, self.epsilon
+        g2 = jax.tree_util.tree_map(lambda si, g: rho * si + (1 - rho) * g * g,
+                                    state["g2"], grads)
+        updates = jax.tree_util.tree_map(
+            lambda si, di, g: jnp.sqrt(di + eps) / jnp.sqrt(si + eps) * g,
+            g2, state["dx2"], grads)
+        dx2 = jax.tree_util.tree_map(lambda di, u: rho * di + (1 - rho) * u * u,
+                                     state["dx2"], updates)
+        return updates, {"g2": g2, "dx2": dx2}
+
+
+def updater_from_name(name: str, learning_rate: float = 0.1, **kw) -> BaseUpdater:
+    """DL4J `Updater` enum-style construction (ref nn/conf/Updater.java)."""
+    name = name.upper()
+    table = {
+        "SGD": Sgd, "ADAM": Adam, "ADAMAX": AdaMax, "NADAM": Nadam,
+        "ADADELTA": AdaDelta, "NESTEROVS": Nesterovs, "ADAGRAD": AdaGrad,
+        "RMSPROP": RmsProp, "NONE": NoOp, "CUSTOM": Sgd,
+    }
+    cls = table[name]
+    if cls is AdaDelta:
+        kw.pop("learning_rate", None)
+        return AdaDelta(**kw)
+    return cls(learning_rate=learning_rate, **kw)

@@ -1,0 +1,102 @@
+"""Weight initialization schemes.
+
+Parity with ref nn/weights/WeightInit.java:47-48 and WeightInitUtil.java: each scheme is a
+function of (fan_in, fan_out, shape). `DISTRIBUTION` takes a distribution config dict
+(mirroring nn/conf/distribution/*Distribution classes).
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.enums import WeightInit
+
+
+def init_weights(
+    key: jax.Array,
+    shape: Sequence[int],
+    fan_in: float,
+    fan_out: float,
+    weight_init,
+    distribution: Optional[dict] = None,
+    dtype=jnp.float32,
+) -> jnp.ndarray:
+    if isinstance(weight_init, str):
+        weight_init = WeightInit(weight_init.lower())
+    shape = tuple(int(s) for s in shape)
+    fi, fo = float(fan_in), float(fan_out)
+
+    def normal(std):
+        return std * jax.random.normal(key, shape, dtype)
+
+    def uniform(limit):
+        return jax.random.uniform(key, shape, dtype, minval=-limit, maxval=limit)
+
+    w = weight_init
+    if w == WeightInit.ZERO:
+        return jnp.zeros(shape, dtype)
+    if w == WeightInit.ONES:
+        return jnp.ones(shape, dtype)
+    if w == WeightInit.IDENTITY:
+        if len(shape) == 2 and shape[0] == shape[1]:
+            return jnp.eye(shape[0], dtype=dtype)
+        raise ValueError("IDENTITY weight init requires square 2d shape")
+    if w == WeightInit.NORMAL:
+        return normal(1.0 / math.sqrt(max(fi, 1.0)))
+    if w == WeightInit.LECUN_NORMAL:
+        return normal(math.sqrt(1.0 / max(fi, 1.0)))
+    if w == WeightInit.LECUN_UNIFORM:
+        return uniform(math.sqrt(3.0 / max(fi, 1.0)))
+    if w == WeightInit.UNIFORM:
+        a = 1.0 / math.sqrt(max(fi, 1.0))
+        return uniform(a)
+    if w in (WeightInit.XAVIER, WeightInit.XAVIER_LEGACY):
+        return normal(math.sqrt(2.0 / max(fi + fo, 1.0)))
+    if w == WeightInit.XAVIER_UNIFORM:
+        return uniform(math.sqrt(6.0 / max(fi + fo, 1.0)))
+    if w == WeightInit.XAVIER_FAN_IN:
+        return normal(math.sqrt(1.0 / max(fi, 1.0)))
+    if w == WeightInit.RELU:
+        return normal(math.sqrt(2.0 / max(fi, 1.0)))
+    if w == WeightInit.RELU_UNIFORM:
+        return uniform(math.sqrt(6.0 / max(fi, 1.0)))
+    if w == WeightInit.SIGMOID_UNIFORM:
+        return uniform(4.0 * math.sqrt(6.0 / max(fi + fo, 1.0)))
+    if w in (WeightInit.VAR_SCALING_NORMAL_FAN_IN, WeightInit.VAR_SCALING_UNIFORM_FAN_IN):
+        scale = max(fi, 1.0)
+    elif w in (WeightInit.VAR_SCALING_NORMAL_FAN_OUT, WeightInit.VAR_SCALING_UNIFORM_FAN_OUT):
+        scale = max(fo, 1.0)
+    elif w in (WeightInit.VAR_SCALING_NORMAL_FAN_AVG, WeightInit.VAR_SCALING_UNIFORM_FAN_AVG):
+        scale = max((fi + fo) / 2.0, 1.0)
+    elif w == WeightInit.DISTRIBUTION:
+        return _from_distribution(key, shape, distribution or {}, dtype)
+    else:
+        raise ValueError(f"Unsupported weight init: {w}")
+
+    if "uniform" in w.value:
+        return uniform(math.sqrt(3.0 / scale))
+    return normal(math.sqrt(1.0 / scale))
+
+
+def _from_distribution(key, shape, dist: dict, dtype):
+    kind = str(dist.get("type", "normal")).lower()
+    if kind in ("normal", "gaussian"):
+        mean = float(dist.get("mean", 0.0))
+        std = float(dist.get("std", dist.get("stddev", 1.0)))
+        return mean + std * jax.random.normal(key, shape, dtype)
+    if kind == "uniform":
+        lo = float(dist.get("lower", -1.0))
+        hi = float(dist.get("upper", 1.0))
+        return jax.random.uniform(key, shape, dtype, minval=lo, maxval=hi)
+    if kind == "binomial":
+        n = int(dist.get("n", dist.get("numberOfTrials", 1)))
+        p = float(dist.get("p", dist.get("probabilityOfSuccess", 0.5)))
+        return jax.random.binomial(key, n, p, shape=shape).astype(dtype)
+    if kind == "truncated_normal":
+        mean = float(dist.get("mean", 0.0))
+        std = float(dist.get("std", 1.0))
+        return mean + std * jax.random.truncated_normal(key, -2.0, 2.0, shape, dtype)
+    raise ValueError(f"Unsupported distribution: {kind}")

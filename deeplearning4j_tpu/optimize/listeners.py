@@ -1,0 +1,124 @@
+"""Training listeners.
+
+Parity: ref optimize/api/{IterationListener,TrainingListener}.java:17-71 and
+optimize/listeners/{ScoreIterationListener,PerformanceListener.java:21 (:118-124),
+CollectScoresIterationListener,TimeIterationListener,EvaluativeListener}.
+"""
+from __future__ import annotations
+
+import time
+from typing import List, Optional, Tuple
+
+
+class IterationListener:
+    def iteration_done(self, model, iteration: int):
+        pass
+
+
+class TrainingListener(IterationListener):
+    def on_epoch_start(self, model):
+        pass
+
+    def on_epoch_end(self, model):
+        pass
+
+    def on_forward_pass(self, model, activations):
+        pass
+
+    def on_gradient_calculation(self, model):
+        pass
+
+    def on_backward_pass(self, model):
+        pass
+
+
+class ScoreIterationListener(IterationListener):
+    """Log score every N iterations (ref ScoreIterationListener)."""
+
+    def __init__(self, print_iterations: int = 10):
+        self.print_iterations = max(1, int(print_iterations))
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.print_iterations == 0:
+            print(f"Score at iteration {iteration} is {model.score()}")
+
+
+class PerformanceListener(IterationListener):
+    """Iteration time / samples/sec / batches/sec, ETL time separated
+    (ref PerformanceListener.java:118-124)."""
+
+    def __init__(self, frequency: int = 1, report: bool = True):
+        self.frequency = max(1, int(frequency))
+        self.report = report
+        self._last = None
+        self.history: List[dict] = []
+
+    def iteration_done(self, model, iteration: int):
+        now = time.time()
+        if self._last is not None and iteration % self.frequency == 0:
+            dt = now - self._last
+            batch = getattr(model, "_last_batch_size", None)
+            rec = {
+                "iteration": iteration,
+                "ms": dt * 1e3,
+                "batches_per_sec": 1.0 / dt if dt > 0 else float("inf"),
+                "samples_per_sec": (batch / dt) if (batch and dt > 0) else None,
+                "etl_ms": getattr(model, "last_etl_ms", 0.0),
+            }
+            self.history.append(rec)
+            if self.report:
+                sps = f", samples/sec: {rec['samples_per_sec']:.1f}" if rec["samples_per_sec"] else ""
+                print(f"iteration {iteration}; iteration time: {rec['ms']:.2f} ms; "
+                      f"ETL: {rec['etl_ms']:.2f} ms{sps}")
+        self._last = now
+
+
+class CollectScoresIterationListener(IterationListener):
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, int(frequency))
+        self.scores: List[Tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, model.score()))
+
+
+class TimeIterationListener(IterationListener):
+    """ETA logging based on expected total iterations (ref TimeIterationListener)."""
+
+    def __init__(self, total_iterations: int, frequency: int = 10):
+        self.total = int(total_iterations)
+        self.frequency = max(1, int(frequency))
+        self.start = time.time()
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency == 0 and iteration > 0:
+            elapsed = time.time() - self.start
+            per = elapsed / iteration
+            remaining = per * max(0, self.total - iteration)
+            print(f"iteration {iteration}/{self.total}; ETA {remaining:.0f}s")
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation on a held-out iterator (ref EvaluativeListener)."""
+
+    def __init__(self, iterator, frequency: int = 100):
+        self.iterator = iterator
+        self.frequency = max(1, int(frequency))
+        self.last_evaluation = None
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency == 0:
+            self.last_evaluation = model.evaluate(self.iterator)
+            print(self.last_evaluation.stats())
+
+
+class SleepyTrainingListener(TrainingListener):
+    """Throttling listener (ref SleepyTrainingListener) — mainly for tests."""
+
+    def __init__(self, sleep_ms: float = 0.0):
+        self.sleep_ms = sleep_ms
+
+    def iteration_done(self, model, iteration: int):
+        if self.sleep_ms > 0:
+            time.sleep(self.sleep_ms / 1e3)

@@ -1,0 +1,43 @@
+"""Flat parameter views.
+
+Parity with the reference's single-contiguous-buffer design (ref nn/api/Model.java:135
+setParamsViewArray; SURVEY §1 "flat parameter views"): every network exposes its params
+(and updater state) as ONE flat vector. Here params live as a pytree for XLA (which is what
+the compiler wants — donation/aliasing per leaf), and the flat view is a pure
+flatten/unflatten bijection used by checkpointing, parameter averaging and the
+gradient-sharing API. Ordering is deterministic: layer index order, then param-dict
+insertion order (each layer class inserts keys in a fixed order).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def flatten_params(params: Any) -> jnp.ndarray:
+    """Pytree → single flat vector (row-major per leaf, deterministic leaf order)."""
+    leaves = jax.tree_util.tree_leaves(params)
+    if not leaves:
+        return jnp.zeros((0,), jnp.float32)
+    return jnp.concatenate([jnp.ravel(l) for l in leaves])
+
+
+def unflatten_params(template: Any, flat: jnp.ndarray) -> Any:
+    """Inverse of flatten_params given a pytree of the same structure/shapes."""
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    out = []
+    pos = 0
+    for l in leaves:
+        n = int(np.prod(l.shape)) if l.ndim else 1
+        out.append(jnp.reshape(flat[pos:pos + n], l.shape).astype(l.dtype))
+        pos += n
+    if pos != flat.shape[0]:
+        raise ValueError(f"Flat vector length {flat.shape[0]} != params size {pos}")
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def num_params(params: Any) -> int:
+    return sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))

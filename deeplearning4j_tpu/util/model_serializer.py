@@ -1,0 +1,88 @@
+"""Model checkpointing: zip container with config JSON + flat parameter/updater vectors.
+
+Parity: ref util/ModelSerializer.java:39-115 — the zip holds `configuration.json`,
+`coefficients.bin` (flat params) and `updaterState.bin` (flat updater state). Because both
+are single flat vectors (flat-view design, SURVEY §1), save/restore is two array writes.
+Additions over the reference: `state.bin` (batchnorm running stats — the reference stores
+these inside params) and `metadata.json` (dtype, step counter, format version).
+"""
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+from typing import Optional
+
+import numpy as np
+
+FORMAT_VERSION = 1
+
+
+def _write_array(zf: zipfile.ZipFile, name: str, arr) -> None:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    zf.writestr(name, buf.getvalue())
+
+
+def _read_array(zf: zipfile.ZipFile, name: str) -> Optional[np.ndarray]:
+    try:
+        data = zf.read(name)
+    except KeyError:
+        return None
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+class ModelSerializer:
+    @staticmethod
+    def write_model(net, path: str, save_updater: bool = True) -> None:
+        from deeplearning4j_tpu.util.flat_params import flatten_params
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            kind = type(net).__name__
+            zf.writestr("configuration.json", net.conf.to_json())
+            _write_array(zf, "coefficients.bin", net.params())
+            if save_updater:
+                _write_array(zf, "updaterState.bin", net.get_updater_state_view())
+            _write_array(zf, "state.bin", flatten_params(net.state_tree))
+            zf.writestr("metadata.json", json.dumps({
+                "format_version": FORMAT_VERSION,
+                "network_class": kind,
+                "dtype": str(net.dtype),
+                "step": net._step,
+            }))
+
+    writeModel = write_model
+
+    @staticmethod
+    def restore(path: str, load_updater: bool = True):
+        from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        from deeplearning4j_tpu.util.flat_params import unflatten_params
+        with zipfile.ZipFile(path, "r") as zf:
+            meta = json.loads(zf.read("metadata.json"))
+            conf_json = zf.read("configuration.json").decode()
+            kind = meta.get("network_class", "MultiLayerNetwork")
+            if kind == "ComputationGraph":
+                from deeplearning4j_tpu.nn.graph.computation_graph import (
+                    ComputationGraph)
+                from deeplearning4j_tpu.nn.conf.graph_configuration import (
+                    ComputationGraphConfiguration)
+                net = ComputationGraph(
+                    ComputationGraphConfiguration.from_json(conf_json))
+            else:
+                net = MultiLayerNetwork(MultiLayerConfiguration.from_json(conf_json))
+            net.init()
+            coeff = _read_array(zf, "coefficients.bin")
+            if coeff is not None and coeff.size:
+                net.set_params(coeff)
+            if load_updater:
+                upd = _read_array(zf, "updaterState.bin")
+                if upd is not None and upd.size:
+                    net.set_updater_state_view(upd)
+            st = _read_array(zf, "state.bin")
+            if st is not None and st.size:
+                net.state_tree = unflatten_params(net.state_tree, st)
+            net._step = int(meta.get("step", 0))
+        return net
+
+    restoreMultiLayerNetwork = restore
+    restoreComputationGraph = restore

@@ -1,0 +1,16 @@
+"""Test configuration: force an 8-virtual-device CPU mesh (the reference's `local[N]`
+Spark-test analog, SURVEY §4.5) and float64 support for gradient checks.
+
+Note: the environment's sitecustomize imports jax at interpreter startup with the real
+TPU platform registered, so env-var overrides are too late — use jax.config directly.
+"""
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

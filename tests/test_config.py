@@ -1,0 +1,109 @@
+"""Config system tests: builder, JSON round-trip, nIn inference, preprocessor insertion.
+(ref test strategy SURVEY §4.2 — nn/conf config validation + serde suites)"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    Activation, BatchNormalization, ConvolutionLayer, DenseLayer, GravesLSTM, InputType,
+    LossFunction, MultiLayerConfiguration, NeuralNetConfiguration, OutputLayer,
+    RnnOutputLayer, Sgd, SubsamplingLayer, WeightInit, Adam)
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    CnnToFeedForwardPreProcessor, FeedForwardToCnnPreProcessor)
+
+
+def build_lenet_style_conf():
+    return (NeuralNetConfiguration.Builder()
+            .seed(7).weight_init(WeightInit.XAVIER).activation(Activation.RELU)
+            .updater(Adam(learning_rate=1e-3))
+            .list()
+            .layer(ConvolutionLayer(n_out=6, kernel_size=(5, 5), stride=(1, 1)))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(ConvolutionLayer(n_out=16, kernel_size=(5, 5)))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(DenseLayer(n_out=120))
+            .layer(OutputLayer(n_out=10, loss_fn=LossFunction.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.convolutional(28, 28, 1))
+            .build())
+
+
+def test_nin_inference_and_preprocessors():
+    conf = build_lenet_style_conf()
+    # conv nIn from channels
+    assert conf.layers[0].n_in == 1
+    assert conf.layers[2].n_in == 6
+    # dense nIn = flattened conv output: 28→24→12→8→4 spatial, 16 channels
+    assert conf.layers[4].n_in == 16 * 4 * 4
+    assert conf.layers[5].n_in == 120
+    # CnnToFF preprocessor auto-inserted before the dense layer
+    assert isinstance(conf.preprocessors[4], CnnToFeedForwardPreProcessor)
+
+
+def test_json_round_trip():
+    conf = build_lenet_style_conf()
+    js = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(js)
+    assert conf2.to_json() == js
+    assert type(conf2.layers[0]).__name__ == "ConvolutionLayer"
+    assert conf2.layers[0].kernel_size == (5, 5)
+    assert conf2.layers[5].loss_fn == LossFunction.MCXENT
+    u = conf2.get_updater()
+    assert type(u).__name__ == "Adam"
+    assert u.learning_rate == pytest.approx(1e-3)
+
+
+def test_global_defaults_applied():
+    conf = (NeuralNetConfiguration.Builder()
+            .activation(Activation.TANH).weight_init(WeightInit.RELU).l2(1e-4)
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=3))
+            .layer(DenseLayer(n_in=3, n_out=3, activation=Activation.SIGMOID))
+            .layer(OutputLayer(n_in=3, n_out=2))
+            .set_input_type(InputType.feed_forward(4))
+            .build())
+    assert conf.layers[0].activation == Activation.TANH
+    assert conf.layers[1].activation == Activation.SIGMOID  # layer override wins
+    assert conf.layers[0].weight_init == WeightInit.RELU
+    assert conf.layers[0].l2 == 1e-4
+    # reference semantics: the global default applies to every layer that didn't set
+    # the field explicitly — including output layers (zoo models always set the output
+    # activation explicitly for this reason)
+    assert conf.layers[2].activation == Activation.TANH
+
+
+def test_rnn_conf():
+    conf = (NeuralNetConfiguration.Builder()
+            .list()
+            .layer(GravesLSTM(n_out=8))
+            .layer(RnnOutputLayer(n_out=4))
+            .set_input_type(InputType.recurrent(5))
+            .build())
+    assert conf.layers[0].n_in == 5
+    assert conf.layers[1].n_in == 8
+    js = conf.to_json()
+    conf2 = MultiLayerConfiguration.from_json(js)
+    assert conf2.layers[0].peephole is True
+
+
+def test_cnn_flat_input():
+    conf = (NeuralNetConfiguration.Builder()
+            .list()
+            .layer(ConvolutionLayer(n_out=3, kernel_size=(3, 3)))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.convolutional_flat(8, 8, 1))
+            .build())
+    assert isinstance(conf.preprocessors[0], FeedForwardToCnnPreProcessor)
+    assert conf.layers[0].n_in == 1
+    assert conf.layers[1].n_in == 3 * 6 * 6
+
+
+def test_strict_mode_raises():
+    with pytest.raises(ValueError):
+        (NeuralNetConfiguration.Builder()
+         .list()
+         .layer(ConvolutionLayer(n_out=3, kernel_size=(3, 3), stride=(4, 4),
+                                 convolution_mode=__import__(
+                                     "deeplearning4j_tpu").ConvolutionMode.Strict))
+         .layer(OutputLayer(n_out=2))
+         .set_input_type(InputType.convolutional(10, 10, 1))
+         .build())

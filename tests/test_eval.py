@@ -1,0 +1,47 @@
+"""Evaluation metrics (ref eval/Evaluation.java:72, RegressionEvaluation.java)."""
+import numpy as np
+
+from deeplearning4j_tpu.eval.evaluation import (
+    ConfusionMatrix, Evaluation, RegressionEvaluation)
+
+
+def test_evaluation_perfect():
+    ev = Evaluation()
+    labels = np.eye(3)[[0, 1, 2, 0, 1]]
+    ev.eval(labels, labels)
+    assert ev.accuracy() == 1.0
+    assert ev.f1() == 1.0
+
+
+def test_evaluation_known_values():
+    ev = Evaluation()
+    labels = np.eye(2)[[0, 0, 1, 1]]
+    preds = np.eye(2)[[0, 1, 1, 1]]
+    ev.eval(labels, preds)
+    assert ev.accuracy() == 0.75
+    assert ev.recall(0) == 0.5
+    assert ev.precision(1) == 2 / 3
+    assert ev.confusion.get_count(0, 1) == 1
+
+
+def test_evaluation_time_series_masked():
+    ev = Evaluation()
+    labels = np.zeros((1, 2, 3))
+    preds = np.zeros((1, 2, 3))
+    labels[0, 0, :] = 1
+    preds[0, 0, 0] = 1; preds[0, 1, 1] = 1; preds[0, 1, 2] = 1
+    mask = np.array([[1, 1, 0]])
+    ev.eval(labels, preds, mask=mask)
+    assert ev.confusion.matrix.sum() == 2  # masked step excluded
+    assert ev.accuracy() == 0.5
+
+
+def test_regression_evaluation():
+    re = RegressionEvaluation()
+    labels = np.array([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]])
+    preds = labels + np.array([[0.5, -0.5], [0.5, -0.5], [0.5, -0.5]])
+    re.eval(labels, preds)
+    assert abs(re.mean_squared_error(0) - 0.25) < 1e-9
+    assert abs(re.mean_absolute_error(1) - 0.5) < 1e-9
+    assert re.correlation_r2(0) > 0.99
+    assert "RMSE" in re.stats()

@@ -1,0 +1,159 @@
+"""Numeric gradient checks — the backbone of the suite (ref SURVEY §4.1:
+deeplearning4j-core gradientcheck/* — GradientCheckTests, CNNGradientCheckTest,
+LSTMGradientCheckTests, BNGradientCheckTest, GradientCheckTestsMasking, etc.).
+All nets run in float64 with central differences (eps=1e-4, tol≈1e-5)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    Activation, BatchNormalization, ConvolutionLayer, DenseLayer, EmbeddingLayer,
+    GlobalPoolingLayer, GravesBidirectionalLSTM, GravesLSTM, InputType, LossFunction,
+    LSTM, LocalResponseNormalization, MultiLayerNetwork, NeuralNetConfiguration,
+    OutputLayer, PoolingType, RnnOutputLayer, Sgd, SubsamplingLayer, WeightInit)
+from deeplearning4j_tpu.gradientcheck import check_gradients
+
+RNG = np.random.RandomState(12345)
+
+
+def build(layers, input_type, l1=0.0, l2=0.0):
+    b = (NeuralNetConfiguration.Builder()
+         .seed(12345).weight_init(WeightInit.XAVIER).activation(Activation.TANH)
+         .updater(Sgd(learning_rate=0.1)).dtype("float64").l1(l1).l2(l2)
+         .list())
+    for l in layers:
+        b.layer(l)
+    conf = b.set_input_type(input_type).build()
+    net = MultiLayerNetwork(conf)
+    net.init()
+    return net
+
+
+def onehot(classes, n):
+    return np.eye(n)[classes]
+
+
+def test_mlp_gradients():
+    net = build([DenseLayer(n_out=6), DenseLayer(n_out=5, activation=Activation.SIGMOID),
+                 OutputLayer(n_out=3)], InputType.feed_forward(4))
+    x = RNG.rand(6, 4)
+    y = onehot(RNG.randint(0, 3, 6), 3)
+    assert check_gradients(net, x, y)
+
+
+def test_mlp_l1_l2_gradients():
+    net = build([DenseLayer(n_out=5), OutputLayer(n_out=3)],
+                InputType.feed_forward(4), l1=1e-2, l2=1e-2)
+    x = RNG.rand(5, 4)
+    y = onehot(RNG.randint(0, 3, 5), 3)
+    assert check_gradients(net, x, y)
+
+
+def test_mse_identity_gradients():
+    net = build([DenseLayer(n_out=6),
+                 OutputLayer(n_out=2, loss_fn=LossFunction.MSE,
+                             activation=Activation.IDENTITY)],
+                InputType.feed_forward(3))
+    x = RNG.rand(5, 3)
+    y = RNG.rand(5, 2)
+    assert check_gradients(net, x, y)
+
+
+def test_cnn_gradients():
+    net = build([ConvolutionLayer(n_out=3, kernel_size=(2, 2), stride=(1, 1),
+                                  activation=Activation.RELU),
+                 SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)),
+                 OutputLayer(n_out=2)],
+                InputType.convolutional(6, 6, 2))
+    x = RNG.rand(4, 2, 6, 6) * 2 - 1
+    y = onehot(RNG.randint(0, 2, 4), 2)
+    # relu kink: use generous min_abs and subset for speed
+    assert check_gradients(net, x, y, subset=60, max_rel_error=1e-4)
+
+
+def test_cnn_avg_pool_gradients():
+    net = build([ConvolutionLayer(n_out=2, kernel_size=(3, 3)),
+                 SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2),
+                                  pooling_type=PoolingType.AVG),
+                 OutputLayer(n_out=2)],
+                InputType.convolutional(7, 7, 1))
+    x = RNG.rand(3, 1, 7, 7)
+    y = onehot(RNG.randint(0, 2, 3), 2)
+    assert check_gradients(net, x, y, subset=60)
+
+
+def test_batchnorm_gradients():
+    net = build([DenseLayer(n_out=6), BatchNormalization(),
+                 OutputLayer(n_out=3)], InputType.feed_forward(4))
+    x = RNG.rand(8, 4)
+    y = onehot(RNG.randint(0, 3, 8), 3)
+    assert check_gradients(net, x, y)
+
+
+def test_lrn_gradients():
+    net = build([ConvolutionLayer(n_out=4, kernel_size=(2, 2)),
+                 LocalResponseNormalization(),
+                 OutputLayer(n_out=2)], InputType.convolutional(5, 5, 1))
+    x = RNG.rand(3, 1, 5, 5)
+    y = onehot(RNG.randint(0, 2, 3), 2)
+    assert check_gradients(net, x, y, subset=60)
+
+
+def test_lstm_gradients():
+    net = build([LSTM(n_out=4), RnnOutputLayer(n_out=3)], InputType.recurrent(3))
+    x = RNG.rand(2, 3, 5)
+    y = np.zeros((2, 3, 5))
+    for b in range(2):
+        for t in range(5):
+            y[b, RNG.randint(0, 3), t] = 1.0
+    assert check_gradients(net, x, y)
+
+
+def test_graves_lstm_gradients():
+    net = build([GravesLSTM(n_out=3), RnnOutputLayer(n_out=2)], InputType.recurrent(2))
+    x = RNG.rand(2, 2, 4)
+    y = np.zeros((2, 2, 4))
+    for b in range(2):
+        for t in range(4):
+            y[b, RNG.randint(0, 2), t] = 1.0
+    assert check_gradients(net, x, y)
+
+
+def test_bidirectional_lstm_gradients():
+    net = build([GravesBidirectionalLSTM(n_out=3), RnnOutputLayer(n_out=2)],
+                InputType.recurrent(2))
+    x = RNG.rand(2, 2, 4)
+    y = np.zeros((2, 2, 4))
+    for b in range(2):
+        for t in range(4):
+            y[b, RNG.randint(0, 2), t] = 1.0
+    assert check_gradients(net, x, y, subset=80)
+
+
+def test_lstm_masking_gradients():
+    """ref GradientCheckTestsMasking — per-timestep masks flow through loss."""
+    net = build([GravesLSTM(n_out=3), RnnOutputLayer(n_out=2)], InputType.recurrent(2))
+    x = RNG.rand(2, 2, 5)
+    y = np.zeros((2, 2, 5))
+    for b in range(2):
+        for t in range(5):
+            y[b, RNG.randint(0, 2), t] = 1.0
+    fmask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float64)
+    assert check_gradients(net, x, y, fmask=fmask, lmask=fmask)
+
+
+def test_global_pooling_masked_gradients():
+    net = build([GravesLSTM(n_out=3),
+                 GlobalPoolingLayer(pooling_type=PoolingType.AVG),
+                 OutputLayer(n_out=2)], InputType.recurrent(2))
+    x = RNG.rand(2, 2, 5)
+    y = onehot(RNG.randint(0, 2, 2), 2)
+    fmask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float64)
+    assert check_gradients(net, x, y, fmask=fmask)
+
+
+def test_embedding_gradients():
+    net = build([EmbeddingLayer(n_in=5, n_out=4), DenseLayer(n_out=4),
+                 OutputLayer(n_out=3)], InputType.feed_forward(5))
+    x = RNG.randint(0, 5, (6, 1)).astype(np.float64)
+    y = onehot(RNG.randint(0, 3, 6), 3)
+    assert check_gradients(net, x, y)

@@ -1,0 +1,145 @@
+"""Training-loop behavior: convergence, updaters, tBPTT, masks, listeners.
+(ref SURVEY §4.2 layer/network behavior suites)"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    Activation, Adam, AdaDelta, AdaGrad, AdaMax, DataSet, DenseLayer, GravesLSTM,
+    InputType, LossFunction, LSTM, MultiLayerNetwork, Nadam, NeuralNetConfiguration,
+    Nesterovs, OutputLayer, RmsProp, RnnOutputLayer, Sgd, WeightInit, BackpropType)
+from deeplearning4j_tpu.datasets.iterators import (
+    BenchmarkDataSetIterator, ListDataSetIterator)
+from deeplearning4j_tpu.optimize.listeners import (
+    CollectScoresIterationListener, PerformanceListener)
+
+RNG = np.random.RandomState(7)
+
+
+def xor_data(n=64):
+    x = RNG.randint(0, 2, (n, 2)).astype(np.float64)
+    y_cls = (x[:, 0].astype(int) ^ x[:, 1].astype(int))
+    y = np.eye(2)[y_cls]
+    return x, y
+
+
+def mlp(updater, seed=1):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).weight_init(WeightInit.XAVIER).activation(Activation.TANH)
+            .updater(updater).dtype("float64")
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(2))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.mark.parametrize("updater", [
+    Sgd(learning_rate=0.5), Adam(learning_rate=0.05), Nesterovs(learning_rate=0.1),
+    AdaGrad(learning_rate=0.2), RmsProp(learning_rate=0.02), AdaDelta(),
+    AdaMax(learning_rate=0.05), Nadam(learning_rate=0.05)])
+def test_updaters_learn_xor(updater):
+    x, y = xor_data()
+    net = mlp(updater)
+    s0 = net.score(DataSet(x, y))
+    for _ in range(150):
+        net.fit(x, y)
+    s1 = net.score(DataSet(x, y))
+    assert s1 < s0 * 0.6, f"{type(updater).__name__}: {s0} -> {s1}"
+
+
+def test_iterator_fit_and_listeners():
+    x, y = xor_data(32)
+    it = ListDataSetIterator([DataSet(x, y)], batch=8)
+    net = mlp(Adam(learning_rate=0.05))
+    scores = CollectScoresIterationListener()
+    perf = PerformanceListener(frequency=1, report=False)
+    net.set_listeners(scores, perf)
+    net.fit(it, epochs=5)
+    assert len(scores.scores) == 20  # 4 batches * 5 epochs
+    assert scores.scores[-1][1] < scores.scores[0][1]
+
+
+def test_rnn_fit_and_rnn_time_step():
+    # learn to echo input class at each timestep
+    n, t = 16, 6
+    x = np.zeros((n, 2, t))
+    cls = RNG.randint(0, 2, (n, t))
+    y = np.zeros((n, 2, t))
+    for i in range(n):
+        for j in range(t):
+            x[i, cls[i, j], j] = 1.0
+            y[i, cls[i, j], j] = 1.0
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(3).updater(Adam(learning_rate=0.05)).dtype("float64")
+            .list()
+            .layer(LSTM(n_out=8, activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(2))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    for _ in range(60):
+        net.fit(x, y)
+    out = np.asarray(net.output(x))
+    acc = (out.argmax(axis=1) == y.argmax(axis=1)).mean()
+    assert acc > 0.95
+    # streaming single-step inference matches full forward
+    net.rnn_clear_previous_state()
+    outs = [np.asarray(net.rnn_time_step(x[:, :, j])) for j in range(t)]
+    stream = np.stack(outs, axis=2)
+    np.testing.assert_allclose(stream, out, rtol=1e-6, atol=1e-8)
+
+
+def test_tbptt_runs_and_learns():
+    n, t = 8, 12
+    x = RNG.rand(n, 2, t)
+    y = np.zeros((n, 2, t))
+    y[:, 0, :] = (x[:, 0, :] > 0.5)
+    y[:, 1, :] = 1 - y[:, 0, :]
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(3).updater(Adam(learning_rate=0.05)).dtype("float64")
+            .list()
+            .layer(GravesLSTM(n_out=6))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(2))
+            .backprop_type(BackpropType.TruncatedBPTT)
+            .t_bptt_forward_length(4)
+            .t_bptt_backward_length(4)
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    ds = DataSet(x, y)
+    s0 = net.score(ds)
+    for _ in range(30):
+        net.fit(ds)
+    assert net.score(ds) < s0 * 0.8
+
+
+def test_flat_param_view_round_trip():
+    net = mlp(Sgd(learning_rate=0.1))
+    flat = np.asarray(net.params())
+    assert flat.shape == (net.num_params(),)
+    mutated = flat + 1.0
+    net.set_params(mutated)
+    np.testing.assert_allclose(np.asarray(net.params()), mutated)
+
+
+def test_clone_reproduces_outputs():
+    x, y = xor_data(16)
+    net = mlp(Adam(learning_rate=0.05))
+    net.fit(x, y)
+    other = net.clone()
+    np.testing.assert_allclose(np.asarray(other.output(x)),
+                               np.asarray(net.output(x)), rtol=1e-7)
+
+
+def test_benchmark_iterator():
+    it = BenchmarkDataSetIterator((4, 3), 2, 5)
+    net = (MultiLayerNetwork((NeuralNetConfiguration.Builder()
+                              .updater(Sgd(learning_rate=0.1)).dtype("float64")
+                              .list()
+                              .layer(DenseLayer(n_out=4))
+                              .layer(OutputLayer(n_out=2))
+                              .set_input_type(InputType.feed_forward(3))
+                              .build())).init())
+    net.fit(it, epochs=1)
+    assert net._step == 5

@@ -1,0 +1,26 @@
+"""Zoo instantiation + training smoke (ref deeplearning4j-zoo TestInstantiation.java —
+build every zoo model, run fit/output on random or fetched data)."""
+import numpy as np
+
+from deeplearning4j_tpu.datasets.impl.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.models.lenet import LeNet
+from deeplearning4j_tpu.nn.updater.updaters import Adam
+
+
+def test_lenet_builds_and_shapes():
+    net = LeNet(num_labels=10, seed=7).init()
+    assert net.num_params() > 1e6
+    x = np.random.RandomState(0).rand(4, 784).astype(np.float32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (4, 10)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+
+def test_lenet_mnist_converges():
+    """LeNet learns the MNIST(-stand-in) training set (gate from SURVEY §7 stage 3)."""
+    net = LeNet(num_labels=10, seed=7, updater=Adam(learning_rate=1e-3)).init()
+    it = MnistDataSetIterator(batch=64, train=True, num_examples=512)
+    net.fit(it, epochs=3)
+    test_it = MnistDataSetIterator(batch=64, train=False, num_examples=256)
+    ev = net.evaluate(test_it)
+    assert ev.accuracy() > 0.9, ev.stats()
