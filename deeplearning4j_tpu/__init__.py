@@ -21,6 +21,13 @@ from deeplearning4j_tpu.nn.conf.layers.normalization import (
 from deeplearning4j_tpu.nn.conf.layers.recurrent import (
     GravesBidirectionalLSTM, GravesLSTM, LSTM, RnnOutputLayer)
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.conf.graph_configuration import (
+    ComputationGraphConfiguration, GraphBuilder)
+from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.graph.vertices import (
+    DuplicateToTimeSeriesVertex, ElementWiseVertex, GraphVertex, L2NormalizeVertex,
+    L2Vertex, LastTimeStepVertex, MergeVertex, PoolHelperVertex, ReshapeVertex,
+    ScaleVertex, ShiftVertex, StackVertex, SubsetVertex, UnstackVertex)
 from deeplearning4j_tpu.nn.updater.updaters import (
     AdaDelta, AdaGrad, AdaMax, Adam, BaseUpdater, Nadam, Nesterovs, NoOp, RmsProp, Sgd)
 from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet

@@ -28,8 +28,13 @@ def check_gradients(net, x, y, *, epsilon: float = 1e-6, max_rel_error: float = 
     `net` must expose params_tree/state_tree/_loss_fn — both MultiLayerNetwork and
     ComputationGraph do.
     """
-    x = jnp.asarray(x, net.dtype)
-    y = jnp.asarray(y, net.dtype)
+    def _cast(v):
+        if isinstance(v, (list, tuple)):
+            return tuple(jnp.asarray(i, net.dtype) for i in v)
+        return jnp.asarray(v, net.dtype)
+
+    x = _cast(x)
+    y = _cast(y)
     template = net.params_tree
     state = net.state_tree
 

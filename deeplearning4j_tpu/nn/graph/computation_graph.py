@@ -1,0 +1,357 @@
+"""ComputationGraph: the DAG network.
+
+Parity: ref nn/graph/ComputationGraph.java (3,234 LoC) — topological sort (:393),
+init + param views (:418-470), fit (:852, :972-1055), feedForward in topo order
+(:1403-1498), calcBackpropGradients (:1604), multi-input/multi-output. TPU-first
+redesign: the topological-order interpreter with its per-vertex workspace choreography
+disappears — the DAG is traced once into a single XLA computation (topo order fixed at
+config time) and jax.grad provides the backward pass; the jitted train step donates
+params/opt-state.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.common.enums import BackpropType
+from deeplearning4j_tpu.nn.conf.graph_configuration import ComputationGraphConfiguration
+from deeplearning4j_tpu.nn.conf.layers.base import BaseLayerConf, apply_dropout
+from deeplearning4j_tpu.nn.multilayer import _normalize_gradients
+from deeplearning4j_tpu.nn.updater.updaters import BaseUpdater
+from deeplearning4j_tpu.util.flat_params import flatten_params, num_params, unflatten_params
+
+
+def _as_list(x) -> List:
+    if x is None:
+        return []
+    if isinstance(x, (list, tuple)):
+        return list(x)
+    return [x]
+
+
+class ComputationGraph:
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        # layer nodes in topo order define the flat-param-view ordering
+        self.layer_names: List[str] = [n for n in conf.topo_order
+                                       if conf.nodes[n].kind == "layer"]
+        self.params_tree: List[Dict[str, jnp.ndarray]] = []
+        self.state_tree: List[Dict[str, Any]] = []
+        self._updaters: List[BaseUpdater] = []
+        self._opt_state: List[Any] = []
+        self._step = 0
+        self._score = float("nan")
+        self._listeners: List[Any] = []
+        self._rng = None
+        self._initialized = False
+        self._train_step_fn = None
+        self._accumulator = None
+        self._last_etl_ms = 0.0
+        self.dtype = jnp.dtype(conf.global_conf.dtype)
+
+    # ------------------------------------------------------------------ init
+    def init(self, params: Optional[Sequence[Dict[str, jnp.ndarray]]] = None):
+        gc = self.conf.global_conf
+        key = jax.random.PRNGKey(gc.seed)
+        self._rng = jax.random.PRNGKey(gc.seed + 1)
+        in_types = self.conf.node_input_types()
+        self.params_tree, self.state_tree = [], []
+        for idx, name in enumerate(self.layer_names):
+            layer = self.conf.nodes[name].conf
+            key, sub = jax.random.split(key)
+            it = in_types[name][0]
+            if params is not None:
+                p = {k: jnp.array(v, copy=True) for k, v in params[idx].items()}
+            else:
+                p = layer.init_params(sub, it, self.dtype) if layer.has_params() else {}
+            self.params_tree.append(p)
+            self.state_tree.append(layer.init_state(it, self.dtype))
+
+        global_updater = self.conf.get_updater()
+        self._updaters = []
+        for name in self.layer_names:
+            layer = self.conf.nodes[name].conf
+            if layer.updater is not None:
+                self._updaters.append(BaseUpdater.from_dict(layer.updater))
+            else:
+                self._updaters.append(global_updater)
+        self._opt_state = [u.init(p) for u, p in zip(self._updaters, self.params_tree)]
+        self._initialized = True
+        self._train_step_fn = None
+        return self
+
+    @property
+    def layers(self) -> List[BaseLayerConf]:
+        return [self.conf.nodes[n].conf for n in self.layer_names]
+
+    # ----------------------------------------------------------- flat views
+    def params(self) -> jnp.ndarray:
+        return flatten_params(self.params_tree)
+
+    def set_params(self, flat):
+        self.params_tree = unflatten_params(self.params_tree, jnp.asarray(flat))
+
+    def num_params(self) -> int:
+        return num_params(self.params_tree)
+
+    def get_updater_state_view(self):
+        return flatten_params(self._opt_state)
+
+    def set_updater_state_view(self, flat):
+        self._opt_state = unflatten_params(self._opt_state, jnp.asarray(flat))
+
+    # ------------------------------------------------------------- forward
+    def _forward_all(self, params_tree, state_tree, inputs: List[jnp.ndarray], *,
+                     train: bool, rng=None, fmasks: Optional[List] = None,
+                     stop_at_scores: bool = False, labels=None, lmasks=None):
+        """Trace the whole DAG in topo order. If stop_at_scores, output-layer nodes
+        contribute their loss instead of activations. Returns
+        (activations dict, new_states list, total_loss or None)."""
+        nodes = self.conf.nodes
+        fmasks = fmasks or [None] * len(self.conf.inputs)
+        values: Dict[str, jnp.ndarray] = dict(zip(self.conf.inputs, inputs))
+        masks: Dict[str, Optional[jnp.ndarray]] = dict(zip(self.conf.inputs, fmasks))
+        new_states = [None] * len(self.layer_names)
+        layer_idx = {n: i for i, n in enumerate(self.layer_names)}
+        label_map = {}
+        lmask_map = {}
+        if labels is not None:
+            label_map = dict(zip(self.conf.outputs, labels))
+            lmask_map = dict(zip(self.conf.outputs, lmasks or [None] * len(labels)))
+        total_loss = jnp.asarray(0.0, self.dtype) if stop_at_scores else None
+
+        for name in self.conf.topo_order:
+            node = nodes[name]
+            in_vals = [values[i] for i in node.inputs]
+            in_masks = [masks.get(i) for i in node.inputs]
+            if node.kind == "vertex":
+                out, m = node.conf.forward(in_vals, in_masks)
+                values[name], masks[name] = out, m
+                continue
+            layer = node.conf
+            i = layer_idx[name]
+            cur, mask = in_vals[0], in_masks[0]
+            if node.preprocessor is not None:
+                cur = node.preprocessor.preprocess(cur)
+                mask = node.preprocessor.feed_forward_mask(mask)
+            if train and layer.dropout > 0 and rng is not None:
+                rng, sub = jax.random.split(rng)
+                cur = apply_dropout(cur, layer.dropout, sub)
+            lrng = None
+            if rng is not None:
+                rng, lrng = jax.random.split(rng)
+            if stop_at_scores and name in label_map and layer.is_output_layer():
+                lm = lmask_map.get(name)
+                if lm is None and mask is not None and cur.ndim == 3:
+                    lm = mask
+                total_loss = total_loss + layer.compute_score(
+                    params_tree[i], cur, label_map[name], lm)
+                new_states[i] = state_tree[i]
+                # still produce activation in case downstream nodes consume it
+                out, ns, m = layer.forward(params_tree[i], state_tree[i], cur,
+                                           train=train, rng=lrng, mask=mask)
+                values[name], masks[name] = out, m
+            else:
+                out, ns, m = layer.forward(params_tree[i], state_tree[i], cur,
+                                           train=train, rng=lrng, mask=mask)
+                new_states[i] = ns
+                values[name], masks[name] = out, m
+        return values, new_states, total_loss
+
+    def output(self, *inputs, train: bool = False) -> Union[jnp.ndarray, List[jnp.ndarray]]:
+        """Inference forward; returns one array per configured output
+        (single array if one output) (ref ComputationGraph.output)."""
+        self._check_init()
+        ins = [jnp.asarray(x, self.dtype) for x in inputs]
+        values, _, _ = self._forward_all(self.params_tree, self.state_tree, ins,
+                                         train=train)
+        outs = [values[o] for o in self.conf.outputs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def feed_forward(self, *inputs, train: bool = False) -> Dict[str, jnp.ndarray]:
+        """All node activations by name."""
+        self._check_init()
+        ins = [jnp.asarray(x, self.dtype) for x in inputs]
+        values, _, _ = self._forward_all(self.params_tree, self.state_tree, ins,
+                                         train=train)
+        return values
+
+    # ------------------------------------------------------------- loss
+    def _loss_fn(self, params_tree, state_tree, x, y, fmask, lmask, rng, train=True,
+                 rnn_init_states=None):
+        inputs = _as_list(x)
+        labels = _as_list(y)
+        fmasks = _as_list(fmask) if fmask is not None else None
+        lmasks = _as_list(lmask) if lmask is not None else None
+        _, new_states, loss = self._forward_all(
+            params_tree, state_tree, inputs, train=train, rng=rng, fmasks=fmasks,
+            stop_at_scores=True, labels=labels, lmasks=lmasks)
+        reg = sum((self.conf.nodes[n].conf.regularization_score(p)
+                   for n, p in zip(self.layer_names, params_tree)), jnp.asarray(0.0))
+        return loss + reg, (new_states, None)
+
+    # ------------------------------------------------------------- training
+    def _build_train_step(self):
+        updaters = self._updaters
+        layer_confs = self.layers
+
+        def train_step(params_tree, opt_state, state_tree, step, rng, x, y, fmask, lmask):
+            (loss, (new_states, _)), grads = jax.value_and_grad(
+                self._loss_fn, has_aux=True)(params_tree, state_tree, x, y, fmask,
+                                             lmask, rng, True, None)
+            new_params, new_opt = [], []
+            for i, (layer, u) in enumerate(zip(layer_confs, updaters)):
+                g = _normalize_gradients(layer, grads[i])
+                upd, st = u.update(g, opt_state[i], params_tree[i], step)
+                new_params.append(jax.tree_util.tree_map(
+                    lambda p, du: p - du, params_tree[i], upd))
+                new_opt.append(st)
+            return new_params, new_opt, new_states, loss
+
+        self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2))
+        return self._train_step_fn
+
+    def fit_batch(self, x, y, fmask=None, lmask=None):
+        self._check_init()
+        x = tuple(jnp.asarray(v, self.dtype) for v in _as_list(x))
+        y = tuple(jnp.asarray(v, self.dtype) for v in _as_list(y))
+        fmask = None if fmask is None else tuple(_as_list(fmask))
+        lmask = None if lmask is None else tuple(_as_list(lmask))
+        if self._train_step_fn is None:
+            self._build_train_step()
+        self._rng, sub = jax.random.split(self._rng)
+
+        if self._accumulator is not None:
+            return self._fit_batch_accumulated(x, y, fmask, lmask, sub)
+
+        new_params, new_opt, new_states, loss = self._train_step_fn(
+            self.params_tree, self._opt_state, self.state_tree,
+            jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask)
+        self.params_tree = new_params
+        self._opt_state = new_opt
+        self.state_tree = new_states
+        self._step += 1
+        self._score = loss
+        for lst in self._listeners:
+            lst.iteration_done(self, self._step)
+
+    def _fit_batch_accumulated(self, x, y, fmask, lmask, sub):
+        (loss, (new_states, _)), grads = jax.value_and_grad(
+            self._loss_fn, has_aux=True)(self.params_tree, self.state_tree,
+                                         x, y, fmask, lmask, sub, True, None)
+        self.state_tree = new_states
+        self._accumulator.store_update(flatten_params(grads))
+        grads = unflatten_params(grads, self._accumulator.get_update())
+        for i, (layer, u) in enumerate(zip(self.layers, self._updaters)):
+            g = _normalize_gradients(layer, grads[i])
+            upd, st = u.update(g, self._opt_state[i], self.params_tree[i], self._step)
+            self.params_tree[i] = jax.tree_util.tree_map(
+                lambda p, du: p - du, self.params_tree[i], upd)
+            self._opt_state[i] = st
+        self._step += 1
+        self._score = loss
+        for lst in self._listeners:
+            lst.iteration_done(self, self._step)
+
+    def fit(self, data, labels=None, epochs: int = 1):
+        """fit(x(s), y(s)) | fit(DataSet/MultiDataSet) | fit(iterator[, epochs])
+        (ref ComputationGraph.fit :852/:972)."""
+        import time
+        from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+        self._check_init()
+        if labels is not None:
+            for _ in range(epochs):
+                self.fit_batch(data, labels)
+            return self
+        if isinstance(data, (DataSet, MultiDataSet)):
+            for _ in range(epochs):
+                self._fit_one(data)
+            return self
+        from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
+        for _ in range(epochs):
+            for lst in self._listeners:
+                if hasattr(lst, "on_epoch_start"):
+                    lst.on_epoch_start(self)
+            it = data
+            if hasattr(it, "reset"):
+                it.reset()
+            if getattr(it, "async_supported", True):
+                it = AsyncDataSetIterator(it)
+            t0 = time.time()
+            for ds in it:
+                self._last_etl_ms = (time.time() - t0) * 1e3
+                self._fit_one(ds)
+                t0 = time.time()
+            for lst in self._listeners:
+                if hasattr(lst, "on_epoch_end"):
+                    lst.on_epoch_end(self)
+        return self
+
+    def _fit_one(self, ds):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        if isinstance(ds, MultiDataSet):
+            self.fit_batch(ds.features, ds.labels, ds.features_masks, ds.labels_masks)
+        else:
+            self.fit_batch(ds.features, ds.labels, ds.features_mask, ds.labels_mask)
+
+    # ------------------------------------------------------------- scoring
+    def score(self, ds=None, training: bool = False) -> float:
+        self._check_init()
+        if ds is None:
+            return float(self._score)
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        if isinstance(ds, MultiDataSet):
+            x, y, fm, lm = ds.features, ds.labels, ds.features_masks, ds.labels_masks
+        else:
+            x, y, fm, lm = ds.features, ds.labels, ds.features_mask, ds.labels_mask
+        x = tuple(jnp.asarray(v, self.dtype) for v in _as_list(x))
+        y = tuple(jnp.asarray(v, self.dtype) for v in _as_list(y))
+        loss, _ = self._loss_fn(self.params_tree, self.state_tree, x, y,
+                                fm, lm, None, training, None)
+        return float(loss)
+
+    def gradient_and_score(self, x, y, fmask=None, lmask=None):
+        self._check_init()
+        x = tuple(jnp.asarray(v, self.dtype) for v in _as_list(x))
+        y = tuple(jnp.asarray(v, self.dtype) for v in _as_list(y))
+        (loss, _), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
+            self.params_tree, self.state_tree, x, y, fmask, lmask, None, True, None)
+        return flatten_params(grads), float(loss)
+
+    # ------------------------------------------------------------- misc
+    def evaluate(self, iterator):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        ev = Evaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out = self.output(*_as_list(ds.features))
+            out0 = out[0] if isinstance(out, list) else out
+            labels = _as_list(ds.labels)[0]
+            mask = ds.labels_mask if hasattr(ds, "labels_mask") else None
+            ev.eval(labels, np.asarray(out0), mask=mask)
+        return ev
+
+    def set_listeners(self, *listeners):
+        self._listeners = list(listeners)
+
+    def set_gradients_accumulator(self, acc):
+        self._accumulator = acc
+
+    def clone(self) -> "ComputationGraph":
+        other = ComputationGraph(
+            ComputationGraphConfiguration.from_json(self.conf.to_json()))
+        other.init(params=self.params_tree)
+        other.set_updater_state_view(self.get_updater_state_view())
+        return other
+
+    def _check_init(self):
+        if not self._initialized:
+            raise RuntimeError("Call init() before using the network")
+
+    @property
+    def last_etl_ms(self):
+        return self._last_etl_ms

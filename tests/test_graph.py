@@ -1,0 +1,187 @@
+"""ComputationGraph tests (ref SURVEY §4: nn/graph suites +
+GradientCheckTestsComputationGraph)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    Activation, Adam, ComputationGraph, ComputationGraphConfiguration, DenseLayer,
+    ElementWiseVertex, GravesLSTM, InputType, LastTimeStepVertex, LossFunction,
+    MergeVertex, MultiDataSet, NeuralNetConfiguration, OutputLayer, RnnOutputLayer,
+    ScaleVertex, Sgd, SubsetVertex, WeightInit, L2NormalizeVertex)
+from deeplearning4j_tpu.gradientcheck import check_gradients
+
+RNG = np.random.RandomState(99)
+
+
+def builder():
+    return (NeuralNetConfiguration.Builder()
+            .seed(99).weight_init(WeightInit.XAVIER).activation(Activation.TANH)
+            .updater(Sgd(learning_rate=0.1)).dtype("float64")
+            .graph_builder())
+
+
+def test_simple_chain_matches_mln_shape():
+    conf = (builder()
+            .add_inputs("in")
+            .add_layer("d0", DenseLayer(n_out=6), "in")
+            .add_layer("out", OutputLayer(n_out=3, activation=Activation.SOFTMAX), "d0")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    g = ComputationGraph(conf).init()
+    x = RNG.rand(5, 4)
+    out = np.asarray(g.output(x))
+    assert out.shape == (5, 3)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-8)
+
+
+def test_graph_json_round_trip():
+    conf = (builder()
+            .add_inputs("a", "b")
+            .add_layer("d1", DenseLayer(n_out=5), "a")
+            .add_layer("d2", DenseLayer(n_out=5), "b")
+            .add_vertex("merge", MergeVertex(), "d1", "d2")
+            .add_vertex("scaled", ScaleVertex(scale_factor=0.5), "merge")
+            .add_layer("out", OutputLayer(n_out=2, activation=Activation.SOFTMAX),
+                       "scaled")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3), InputType.feed_forward(4))
+            .build())
+    js = conf.to_json()
+    conf2 = ComputationGraphConfiguration.from_json(js)
+    assert conf2.to_json() == js
+    assert conf2.nodes["d1"].conf.n_in == 3
+    assert conf2.nodes["out"].conf.n_in == 10
+    g = ComputationGraph(conf2).init()
+    out = g.output(RNG.rand(3, 3), RNG.rand(3, 4))
+    assert np.asarray(out).shape == (3, 2)
+
+
+def test_multi_input_merge_gradients():
+    conf = (builder()
+            .add_inputs("a", "b")
+            .add_layer("d1", DenseLayer(n_out=4), "a")
+            .add_layer("d2", DenseLayer(n_out=4), "b")
+            .add_vertex("merge", MergeVertex(), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=3, activation=Activation.SOFTMAX),
+                       "merge")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3), InputType.feed_forward(2))
+            .build())
+    g = ComputationGraph(conf).init()
+    x = (RNG.rand(4, 3), RNG.rand(4, 2))
+    y = np.eye(3)[RNG.randint(0, 3, 4)]
+    assert check_gradients(g, x, (y,))
+
+
+def test_elementwise_residual_gradients():
+    """skip-connection graph (the ResNet pattern)."""
+    conf = (builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=4), "in")
+            .add_layer("d2", DenseLayer(n_out=4), "d1")
+            .add_vertex("residual", ElementWiseVertex(op="Add"), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=2, activation=Activation.SOFTMAX),
+                       "residual")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    g = ComputationGraph(conf).init()
+    x = RNG.rand(4, 4)
+    y = np.eye(2)[RNG.randint(0, 2, 4)]
+    assert check_gradients(g, x, (y,))
+
+
+def test_multi_output_gradients():
+    conf = (builder()
+            .add_inputs("in")
+            .add_layer("shared", DenseLayer(n_out=6), "in")
+            .add_layer("out1", OutputLayer(n_out=2, activation=Activation.SOFTMAX),
+                       "shared")
+            .add_layer("out2", OutputLayer(n_out=3, loss_fn=LossFunction.MSE,
+                                           activation=Activation.IDENTITY), "shared")
+            .set_outputs("out1", "out2")
+            .set_input_types(InputType.feed_forward(4))
+            .build())
+    g = ComputationGraph(conf).init()
+    x = RNG.rand(4, 4)
+    y1 = np.eye(2)[RNG.randint(0, 2, 4)]
+    y2 = RNG.rand(4, 3)
+    assert check_gradients(g, x, (y1, y2))
+    outs = g.output(x)
+    assert len(outs) == 2 and outs[0].shape == (4, 2) and outs[1].shape == (4, 3)
+
+
+def test_rnn_vertices_gradients():
+    """LastTimeStep + rnn output — the seq2class pattern."""
+    conf = (builder()
+            .add_inputs("seq")
+            .add_layer("lstm", GravesLSTM(n_out=4), "seq")
+            .add_vertex("last", LastTimeStepVertex(), "lstm")
+            .add_layer("out", OutputLayer(n_out=2, activation=Activation.SOFTMAX),
+                       "last")
+            .set_outputs("out")
+            .set_input_types(InputType.recurrent(3))
+            .build())
+    g = ComputationGraph(conf).init()
+    x = RNG.rand(2, 3, 5)
+    y = np.eye(2)[RNG.randint(0, 2, 2)]
+    assert check_gradients(g, x, (y,), subset=60)
+
+
+def test_graph_training_learns():
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(99).weight_init(WeightInit.XAVIER).activation(Activation.TANH)
+            .updater(Adam(learning_rate=0.05)).dtype("float64")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=8), "in")
+            .add_layer("out", OutputLayer(n_out=2, activation=Activation.SOFTMAX), "d1")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(2))
+            .build())
+    g = ComputationGraph(conf).init()
+    # use Adam for speed
+    x = RNG.randint(0, 2, (64, 2)).astype(np.float64)
+    y = np.eye(2)[(x[:, 0].astype(int) ^ x[:, 1].astype(int))]
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    s0 = g.score(DataSet(x, y))
+    for _ in range(200):
+        g.fit(x, y)
+    assert g.score(DataSet(x, y)) < s0 * 0.5
+
+
+def test_graph_clone_and_serialization(tmp_path):
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+    conf = (builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=5), "in")
+            .add_vertex("norm", L2NormalizeVertex(), "d1")
+            .add_layer("out", OutputLayer(n_out=2, activation=Activation.SOFTMAX),
+                       "norm")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3))
+            .build())
+    g = ComputationGraph(conf).init()
+    x = RNG.rand(4, 3)
+    g2 = g.clone()
+    np.testing.assert_allclose(np.asarray(g2.output(x)), np.asarray(g.output(x)))
+    path = str(tmp_path / "graph.zip")
+    ModelSerializer.write_model(g, path)
+    g3 = ModelSerializer.restore(path)
+    assert isinstance(g3, ComputationGraph)
+    np.testing.assert_allclose(np.asarray(g3.output(x)), np.asarray(g.output(x)))
+
+
+def test_subset_vertex():
+    conf = (builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=6), "in")
+            .add_vertex("sub", SubsetVertex(from_idx=1, to_idx=3), "d1")
+            .add_layer("out", OutputLayer(n_out=2, activation=Activation.SOFTMAX), "sub")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(3))
+            .build())
+    assert conf.nodes["out"].conf.n_in == 3
+    g = ComputationGraph(conf).init()
+    assert np.asarray(g.output(RNG.rand(2, 3))).shape == (2, 2)
