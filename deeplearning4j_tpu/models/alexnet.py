@@ -1,0 +1,79 @@
+"""AlexNet (ref deeplearning4j-zoo/.../zoo/model/AlexNet.java:41).
+
+Mirrors the reference's single-stream variant (AlexNet.java:85-129): conv11x11/4 → LRN →
+maxpool3/2 → conv5x5(s2,p2,192) → maxpool → conv3x3(384) → conv3x3(256) → conv3x3(256) →
+maxpool3/7 → dense4096(drop0.5) ×2 → softmax; Nesterovs lr 1e-2, N(0,0.01) weights,
+l2 5e-4, bias 1 on the deep layers.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.enums import (
+    Activation, LossFunction, PoolingType, WeightInit)
+from deeplearning4j_tpu.models.zoo_model import ZooModel
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+    ConvolutionLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.layers.feedforward import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.layers.normalization import LocalResponseNormalization
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater.updaters import Nesterovs
+
+
+class AlexNet(ZooModel):
+    def __init__(self, num_labels: int = 1000, seed: int = 123,
+                 input_shape=(3, 224, 224), updater=None, dtype: str = "float32"):
+        super().__init__(num_labels, seed)
+        self.input_shape = tuple(input_shape)
+        self.updater = updater or Nesterovs(learning_rate=1e-2, momentum=0.9)
+        self.dtype = dtype
+
+    def conf(self):
+        c, h, w = self.input_shape
+        non_zero_bias = 1.0
+        drop = 0.5
+        dense_dist = {"type": "normal", "mean": 0.0, "std": 0.005}
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .weight_init(WeightInit.DISTRIBUTION)
+                .dist({"type": "normal", "mean": 0.0, "std": 0.01})
+                .activation(Activation.RELU)
+                .updater(self.updater)
+                .l2(5e-4)
+                .dtype(self.dtype)
+                .list()
+                .layer(ConvolutionLayer(name="cnn1", n_in=c, n_out=64,
+                                        kernel_size=(11, 11), stride=(4, 4),
+                                        padding=(3, 3)))
+                .layer(LocalResponseNormalization(name="lrn1"))
+                .layer(SubsamplingLayer(name="maxpool1", pooling_type=PoolingType.MAX,
+                                        kernel_size=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(name="cnn2", n_out=192, kernel_size=(5, 5),
+                                        stride=(2, 2), padding=(2, 2),
+                                        bias_init=non_zero_bias))
+                .layer(SubsamplingLayer(name="maxpool2", pooling_type=PoolingType.MAX,
+                                        kernel_size=(3, 3), stride=(2, 2)))
+                .layer(ConvolutionLayer(name="cnn3", n_out=384, kernel_size=(3, 3),
+                                        stride=(1, 1), padding=(1, 1)))
+                .layer(ConvolutionLayer(name="cnn4", n_out=256, kernel_size=(3, 3),
+                                        stride=(1, 1), padding=(1, 1),
+                                        bias_init=non_zero_bias))
+                .layer(ConvolutionLayer(name="cnn5", n_out=256, kernel_size=(3, 3),
+                                        stride=(1, 1), padding=(1, 1),
+                                        bias_init=non_zero_bias))
+                .layer(SubsamplingLayer(name="maxpool3", pooling_type=PoolingType.MAX,
+                                        kernel_size=(3, 3), stride=(7, 7)))
+                .layer(DenseLayer(name="ffn1", n_out=4096, dist=dense_dist,
+                                  bias_init=non_zero_bias, dropout=drop,
+                                  weight_init=WeightInit.DISTRIBUTION))
+                .layer(DenseLayer(name="ffn2", n_out=4096, dist=dense_dist,
+                                  bias_init=non_zero_bias, dropout=drop,
+                                  weight_init=WeightInit.DISTRIBUTION))
+                .layer(OutputLayer(name="output", n_out=self.num_labels,
+                                   loss_fn=LossFunction.NEGATIVELOGLIKELIHOOD,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.convolutional(h, w, c))
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()

@@ -1,0 +1,138 @@
+"""ResNet50 (ref deeplearning4j-zoo/.../zoo/model/ResNet50.java:33).
+
+Mirrors the reference graph exactly: stem (zeropad3 → conv7x7/2 → BN → relu →
+maxpool3x3/2), stages 2-5 of conv/identity bottleneck blocks with ElementWiseVertex(Add)
+shortcuts, max-pool 3x3 head (the reference uses MAX there, ResNet50.java:216-218),
+softmax output with NLL loss; RmsProp(0.1, 0.96) updater, N(0, 0.5) weight init,
+l1=1e-7 l2=5e-5, Truncate convolution mode.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.enums import (
+    Activation, ConvolutionMode, LossFunction, PoolingType, WeightInit)
+from deeplearning4j_tpu.models.zoo_model import PretrainedType, ZooModel
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+    ConvolutionLayer, SubsamplingLayer, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.conf.layers.feedforward import ActivationLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.layers.normalization import BatchNormalization
+from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.graph.vertices import ElementWiseVertex
+from deeplearning4j_tpu.nn.updater.updaters import RmsProp
+
+
+class ResNet50(ZooModel):
+    def __init__(self, num_labels: int = 1000, seed: int = 123,
+                 input_shape=(3, 224, 224), updater=None, dtype: str = "float32"):
+        super().__init__(num_labels, seed)
+        self.input_shape = tuple(input_shape)
+        self.updater = updater or RmsProp(learning_rate=0.1, rms_decay=0.96)
+        self.dtype = dtype
+
+    # ---- blocks (ref ResNet50.java identityBlock :90-125 / convBlock :127-172) ----
+    def _identity_block(self, g, kernel, filters, stage, block, inp):
+        conv = f"res{stage}{block}_branch"
+        bn = f"bn{stage}{block}_branch"
+        act = f"act{stage}{block}_branch"
+        short = f"short{stage}{block}_branch"
+        relu = ActivationLayer(activation=Activation.RELU)
+        (g.add_layer(conv + "2a", ConvolutionLayer(n_out=filters[0], kernel_size=(1, 1)), inp)
+          .add_layer(bn + "2a", BatchNormalization(), conv + "2a")
+          .add_layer(act + "2a", relu, bn + "2a")
+          .add_layer(conv + "2b", ConvolutionLayer(n_out=filters[1], kernel_size=kernel,
+                                                   convolution_mode=ConvolutionMode.Same),
+                     act + "2a")
+          .add_layer(bn + "2b", BatchNormalization(), conv + "2b")
+          .add_layer(act + "2b", relu, bn + "2b")
+          .add_layer(conv + "2c", ConvolutionLayer(n_out=filters[2], kernel_size=(1, 1)),
+                     act + "2b")
+          .add_layer(bn + "2c", BatchNormalization(), conv + "2c")
+          .add_vertex(short, ElementWiseVertex(op="Add"), bn + "2c", inp)
+          .add_layer(conv, relu, short))
+        return conv
+
+    def _conv_block(self, g, kernel, filters, stage, block, inp, stride=(2, 2)):
+        conv = f"res{stage}{block}_branch"
+        bn = f"bn{stage}{block}_branch"
+        act = f"act{stage}{block}_branch"
+        short = f"short{stage}{block}_branch"
+        relu = ActivationLayer(activation=Activation.RELU)
+        (g.add_layer(conv + "2a", ConvolutionLayer(n_out=filters[0], kernel_size=(1, 1),
+                                                   stride=stride), inp)
+          .add_layer(bn + "2a", BatchNormalization(), conv + "2a")
+          .add_layer(act + "2a", relu, bn + "2a")
+          .add_layer(conv + "2b", ConvolutionLayer(n_out=filters[1], kernel_size=kernel,
+                                                   convolution_mode=ConvolutionMode.Same),
+                     act + "2a")
+          .add_layer(bn + "2b", BatchNormalization(), conv + "2b")
+          .add_layer(act + "2b", relu, bn + "2b")
+          .add_layer(conv + "2c", ConvolutionLayer(n_out=filters[2], kernel_size=(1, 1)),
+                     act + "2b")
+          .add_layer(bn + "2c", BatchNormalization(), conv + "2c")
+          .add_layer(conv + "1", ConvolutionLayer(n_out=filters[2], kernel_size=(1, 1),
+                                                  stride=stride), inp)
+          .add_layer(bn + "1", BatchNormalization(), conv + "1")
+          .add_vertex(short, ElementWiseVertex(op="Add"), bn + "2c", bn + "1")
+          .add_layer(conv, relu, short))
+        return conv
+
+    def graph_builder(self):
+        c, h, w = self.input_shape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .activation(Activation.IDENTITY)
+             .updater(self.updater)
+             .weight_init(WeightInit.DISTRIBUTION)
+             .dist({"type": "normal", "mean": 0.0, "std": 0.5})
+             .l1(1e-7).l2(5e-5)
+             .convolution_mode(ConvolutionMode.Truncate)
+             .dtype(self.dtype)
+             .graph_builder())
+        relu = ActivationLayer(activation=Activation.RELU)
+        (g.add_inputs("input")
+          .add_layer("stem-zero", ZeroPaddingLayer(pad=(3, 3, 3, 3)), "input")
+          .add_layer("stem-cnn1", ConvolutionLayer(n_out=64, kernel_size=(7, 7),
+                                                   stride=(2, 2)), "stem-zero")
+          .add_layer("stem-batch1", BatchNormalization(), "stem-cnn1")
+          .add_layer("stem-act1", relu, "stem-batch1")
+          .add_layer("stem-maxpool1", SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                                       kernel_size=(3, 3),
+                                                       stride=(2, 2)), "stem-act1"))
+
+        x = self._conv_block(g, (3, 3), (64, 64, 256), "2", "a", "stem-maxpool1",
+                             stride=(2, 2))
+        x = self._identity_block(g, (3, 3), (64, 64, 256), "2", "b", x)
+        x = self._identity_block(g, (3, 3), (64, 64, 256), "2", "c", x)
+
+        x = self._conv_block(g, (3, 3), (128, 128, 512), "3", "a", x)
+        for b in "bcd":
+            x = self._identity_block(g, (3, 3), (128, 128, 512), "3", b, x)
+
+        x = self._conv_block(g, (3, 3), (256, 256, 1024), "4", "a", x)
+        for b in "bcdef":
+            x = self._identity_block(g, (3, 3), (256, 256, 1024), "4", b, x)
+
+        x = self._conv_block(g, (3, 3), (512, 512, 2048), "5", "a", x)
+        x = self._identity_block(g, (3, 3), (512, 512, 2048), "5", "b", x)
+        x = self._identity_block(g, (3, 3), (512, 512, 2048), "5", "c", x)
+
+        (g.add_layer("avgpool", SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                                 kernel_size=(3, 3), stride=(1, 1)), x)
+          .add_layer("output", OutputLayer(n_out=self.num_labels,
+                                           loss_fn=LossFunction.NEGATIVELOGLIKELIHOOD,
+                                           activation=Activation.SOFTMAX), "avgpool")
+          .set_outputs("output")
+          .set_input_types(InputType.convolutional(h, w, c)))
+        return g
+
+    def conf(self):
+        return self.graph_builder().build()
+
+    def pretrained_url(self, pretrained_type):
+        if pretrained_type == PretrainedType.IMAGENET:
+            return "http://blob.deeplearning4j.org/models/resnet50_dl4j_inference.zip"
+        return None
+
+    def init(self) -> ComputationGraph:
+        return ComputationGraph(self.conf()).init()

@@ -1,0 +1,120 @@
+"""SimpleCNN + TextGenerationLSTM zoo models.
+
+SimpleCNN (ref deeplearning4j-zoo/.../zoo/model/SimpleCNN.java:70-132): Same-mode conv/BN
+blocks (7x7-16 ×2, 5x5-32 ×2, 3x3-64 ×2, 3x3-128 ×2, 3x3-256 + 3x3-numLabels), relu
+ActivationLayers, AVG pools + Dropout between blocks, GlobalPooling(AVG) head. The
+reference ends with a bare softmax ActivationLayer (SimpleCNN.java:130); here that final
+softmax is a LossLayer(MCXENT, softmax) so the model is trainable end-to-end — identical
+inference behavior.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.enums import (
+    Activation, ConvolutionMode, LossFunction, PoolingType, WeightInit)
+from deeplearning4j_tpu.models.zoo_model import ZooModel
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+    ConvolutionLayer, GlobalPoolingLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.layers.feedforward import (
+    ActivationLayer, DropoutLayer, LossLayer)
+from deeplearning4j_tpu.nn.conf.layers.normalization import BatchNormalization
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater.updaters import AdaDelta
+
+
+class SimpleCNN(ZooModel):
+    def __init__(self, num_labels: int = 10, seed: int = 123,
+                 input_shape=(3, 48, 48), updater=None, dtype: str = "float32"):
+        super().__init__(num_labels, seed)
+        self.input_shape = tuple(input_shape)
+        self.updater = updater or AdaDelta()
+        self.dtype = dtype
+
+    def conf(self):
+        c, h, w = self.input_shape
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .activation(Activation.IDENTITY)
+             .weight_init(WeightInit.RELU)
+             .updater(self.updater)
+             .convolution_mode(ConvolutionMode.Same)
+             .dtype(self.dtype)
+             .list())
+        relu = lambda: ActivationLayer(activation=Activation.RELU)
+
+        def block(k, width, pool=True):
+            b.layer(ConvolutionLayer(n_out=width, kernel_size=(k, k)))
+            b.layer(BatchNormalization())
+            b.layer(ConvolutionLayer(n_out=width, kernel_size=(k, k)))
+            b.layer(BatchNormalization())
+            b.layer(relu())
+            if pool:
+                b.layer(SubsamplingLayer(pooling_type=PoolingType.AVG,
+                                         kernel_size=(2, 2), stride=(2, 2)))
+                b.layer(DropoutLayer(dropout=0.5))
+
+        b.layer(ConvolutionLayer(name="image_array", n_in=c, n_out=16,
+                                 kernel_size=(7, 7)))
+        b.layer(BatchNormalization())
+        b.layer(ConvolutionLayer(n_out=16, kernel_size=(7, 7)))
+        b.layer(BatchNormalization())
+        b.layer(relu())
+        b.layer(SubsamplingLayer(pooling_type=PoolingType.AVG, kernel_size=(2, 2),
+                                 stride=(2, 2)))
+        b.layer(DropoutLayer(dropout=0.5))
+        block(5, 32)
+        block(3, 64)
+        block(3, 128)
+        # block 5 (ref :118-130)
+        b.layer(ConvolutionLayer(n_out=256, kernel_size=(3, 3)))
+        b.layer(BatchNormalization())
+        b.layer(ConvolutionLayer(n_out=self.num_labels, kernel_size=(3, 3)))
+        b.layer(GlobalPoolingLayer(pooling_type=PoolingType.AVG))
+        b.layer(LossLayer(loss_fn=LossFunction.MCXENT, activation=Activation.SOFTMAX))
+        return b.set_input_type(InputType.convolutional(h, w, c)).build()
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()
+
+
+class TextGenerationLSTM(ZooModel):
+    """(ref zoo/model/TextGenerationLSTM.java:81-87) — char-RNN: GravesLSTM(256) ×2 →
+    RnnOutputLayer(MCXENT softmax), truncated BPTT 50/50, gradient norm clipping."""
+
+    def __init__(self, total_unique_characters: int = 47, seed: int = 123,
+                 max_length: int = 40, updater=None, dtype: str = "float32"):
+        super().__init__(total_unique_characters, seed)
+        self.max_length = max_length
+        self.updater = updater
+        self.dtype = dtype
+
+    def conf(self):
+        from deeplearning4j_tpu.common.enums import BackpropType, GradientNormalization
+        from deeplearning4j_tpu.nn.conf.layers.recurrent import (
+            GravesLSTM, RnnOutputLayer)
+        from deeplearning4j_tpu.nn.updater.updaters import RmsProp
+        return (NeuralNetConfiguration.Builder()
+                .seed(self.seed)
+                .l2(0.001)
+                .weight_init(WeightInit.XAVIER)
+                .updater(self.updater or RmsProp(learning_rate=0.1))
+                .gradient_normalization(
+                    GradientNormalization.ClipElementWiseAbsoluteValue)
+                .gradient_normalization_threshold(1.0)
+                .dtype(self.dtype)
+                .list()
+                .layer(GravesLSTM(n_in=self.num_labels, n_out=256,
+                                  activation=Activation.TANH))
+                .layer(GravesLSTM(n_out=256, activation=Activation.TANH))
+                .layer(RnnOutputLayer(n_out=self.num_labels,
+                                      loss_fn=LossFunction.MCXENT,
+                                      activation=Activation.SOFTMAX))
+                .set_input_type(InputType.recurrent(self.num_labels))
+                .backprop_type(BackpropType.TruncatedBPTT)
+                .t_bptt_forward_length(50)
+                .t_bptt_backward_length(50)
+                .build())
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()

@@ -1,0 +1,70 @@
+"""VGG16 / VGG19 (ref deeplearning4j-zoo/.../zoo/model/VGG16.java:35, VGG19.java).
+
+Mirrors the reference zoo configs: 3x3 pad-1 conv stacks (2-2-3-3-3 for VGG16,
+2-2-4-4-4 for VGG19) with 2x2/2 max-pools, then softmax output directly from the last
+pool (the reference comments out the classic FC-4096 pair — VGG16.java:147-151);
+pretrained Keras-imported VGG16 keeps its FC layers via the importer instead.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.enums import (
+    Activation, LossFunction, PoolingType, WeightInit)
+from deeplearning4j_tpu.models.zoo_model import PretrainedType, ZooModel
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+    ConvolutionLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.layers.feedforward import OutputLayer
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater.updaters import Nesterovs
+
+
+class VGG16(ZooModel):
+    BLOCKS = (2, 2, 3, 3, 3)
+
+    def __init__(self, num_labels: int = 1000, seed: int = 123,
+                 input_shape=(3, 224, 224), updater=None, dtype: str = "float32"):
+        super().__init__(num_labels, seed)
+        self.input_shape = tuple(input_shape)
+        self.updater = updater or Nesterovs(learning_rate=1e-2, momentum=0.9)
+        self.dtype = dtype
+
+    def conf(self):
+        c, h, w = self.input_shape
+        widths = (64, 128, 256, 512, 512)
+        b = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .activation(Activation.RELU)
+             .weight_init(WeightInit.RELU)
+             .updater(self.updater)
+             .dtype(self.dtype)
+             .list())
+        for block, (n_convs, width) in enumerate(zip(self.BLOCKS, widths), start=1):
+            for ci in range(n_convs):
+                b.layer(ConvolutionLayer(name=f"conv{block}_{ci + 1}", n_out=width,
+                                         kernel_size=(3, 3), padding=(1, 1)))
+            b.layer(SubsamplingLayer(name=f"pool{block}",
+                                     pooling_type=PoolingType.MAX,
+                                     kernel_size=(2, 2), stride=(2, 2)))
+        b.layer(OutputLayer(name="output", n_out=self.num_labels,
+                            loss_fn=LossFunction.NEGATIVELOGLIKELIHOOD,
+                            activation=Activation.SOFTMAX))
+        return b.set_input_type(InputType.convolutional(h, w, c)).build()
+
+    def pretrained_url(self, pretrained_type):
+        if pretrained_type == PretrainedType.IMAGENET:
+            return "http://blob.deeplearning4j.org/models/vgg16_dl4j_inference.zip"
+        if pretrained_type == PretrainedType.VGGFACE:
+            return "http://blob.deeplearning4j.org/models/vgg16_dl4j_vggface_inference.zip"
+        return None
+
+    def init(self) -> MultiLayerNetwork:
+        return MultiLayerNetwork(self.conf()).init()
+
+
+class VGG19(VGG16):
+    """(ref zoo/model/VGG19.java) — same family, 2-2-4-4-4 conv stacks."""
+    BLOCKS = (2, 2, 4, 4, 4)
+
+    def pretrained_url(self, pretrained_type):
+        return None

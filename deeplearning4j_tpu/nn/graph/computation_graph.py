@@ -256,6 +256,60 @@ class ComputationGraph:
         for lst in self._listeners:
             lst.iteration_done(self, self._step)
 
+    def fit_on_device(self, x, y, steps: Optional[int] = None, fmask=None, lmask=None):
+        """Jitted lax.scan training loop (see MultiLayerNetwork.fit_on_device).
+        Benchmark mode only here: the same batch is reused `steps` times."""
+        self._check_init()
+        x = tuple(jnp.asarray(v, self.dtype) for v in _as_list(x))
+        y = tuple(jnp.asarray(v, self.dtype) for v in _as_list(y))
+        updaters = self._updaters
+        layer_confs = self.layers
+        if steps is None:
+            raise ValueError("steps is required (single-batch device loop)")
+
+        def body(carry, _):
+            params, opt, states, step, rng = carry
+            rng, sub = jax.random.split(rng)
+
+            def loss_fn(p):
+                loss, (ns, _) = self._loss_fn(p, states, x, y, fmask, lmask, sub,
+                                              True, None)
+                return loss, ns
+
+            (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            newp, newo = [], []
+            for i, (layer, u) in enumerate(zip(layer_confs, updaters)):
+                g = _normalize_gradients(layer, grads[i])
+                upd, st = u.update(g, opt[i], params[i], step)
+                newp.append(jax.tree_util.tree_map(lambda p, d: p - d, params[i], upd))
+                newo.append(st)
+            return (newp, newo, ns, step + 1, rng), loss
+
+        import functools
+
+        cache_key = ("cg", int(steps), tuple(v.shape for v in x),
+                     tuple(v.shape for v in y))
+        if not hasattr(self, "_device_loop_cache"):
+            self._device_loop_cache = {}
+        run = self._device_loop_cache.get(cache_key)
+        if run is None:
+            @functools.partial(jax.jit, donate_argnums=(0, 1, 2),
+                               static_argnames=("n",))
+            def run(params, opt, states, step, rng, n):
+                carry, losses = jax.lax.scan(body, (params, opt, states, step, rng),
+                                             None, length=n)
+                return carry, losses
+            self._device_loop_cache[cache_key] = run
+
+        self._rng, sub = jax.random.split(self._rng)
+        (self.params_tree, self._opt_state, self.state_tree, _, _), losses = run(
+            self.params_tree, self._opt_state, self.state_tree,
+            jnp.asarray(self._step, jnp.int32), sub, int(steps))
+        self._step += int(steps)
+        losses = np.asarray(losses)
+        self._score = float(losses[-1])
+        return losses
+
     def fit(self, data, labels=None, epochs: int = 1):
         """fit(x(s), y(s)) | fit(DataSet/MultiDataSet) | fit(iterator[, epochs])
         (ref ComputationGraph.fit :852/:972)."""

@@ -313,6 +313,66 @@ class MultiLayerNetwork:
             lst.iteration_done(self, self._step)
         return final_rnn
 
+    def fit_on_device(self, x, y, steps: Optional[int] = None, fmask=None, lmask=None):
+        """Run many training steps as ONE jitted lax.scan on device — no per-step host
+        dispatch. TPU-idiomatic epoch runner: if x/y carry a leading step axis
+        (steps, batch, ...) each scan step consumes its own minibatch; otherwise the
+        same batch is reused `steps` times (benchmark mode). Returns the per-step loss
+        array (one host transfer at the end)."""
+        self._check_init()
+        x = jnp.asarray(x, self.dtype)
+        y = jnp.asarray(y, self.dtype)
+        updaters = self._updaters
+        layers = self.layers
+        per_step_data = steps is None
+        if per_step_data:
+            steps = x.shape[0]
+
+        def body(carry, xs):
+            params, opt, states, step, rng = carry
+            bx, by = xs if per_step_data else (x, y)
+            rng, sub = jax.random.split(rng)
+
+            def loss_fn(p):
+                loss, (ns, _) = self._loss_fn(p, states, bx, by, fmask, lmask, sub,
+                                              True, None)
+                return loss, ns
+
+            (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            newp, newo = [], []
+            for i, (layer, u) in enumerate(zip(layers, updaters)):
+                g = _normalize_gradients(layer, grads[i])
+                upd, st = u.update(g, opt[i], params[i], step)
+                newp.append(jax.tree_util.tree_map(lambda p, d: p - d, params[i], upd))
+                newo.append(st)
+            return (newp, newo, ns, step + 1, rng), loss
+
+        cache_key = ("mln", per_step_data, int(steps),
+                     tuple(x.shape), tuple(y.shape),
+                     None if fmask is None else tuple(np.shape(fmask)),
+                     None if lmask is None else tuple(np.shape(lmask)))
+        if not hasattr(self, "_device_loop_cache"):
+            self._device_loop_cache = {}
+        run = self._device_loop_cache.get(cache_key)
+        if run is None:
+            @functools.partial(jax.jit, donate_argnums=(0, 1, 2),
+                               static_argnames=("n",))
+            def run(params, opt, states, step, rng, x, y, n):
+                xs = (x, y) if per_step_data else None
+                carry, losses = jax.lax.scan(body, (params, opt, states, step, rng),
+                                             xs, length=n)
+                return carry, losses
+            self._device_loop_cache[cache_key] = run
+
+        self._rng, sub = jax.random.split(self._rng)
+        (self.params_tree, self._opt_state, self.state_tree, _, _), losses = run(
+            self.params_tree, self._opt_state, self.state_tree,
+            jnp.asarray(self._step, jnp.int32), sub, x, y, int(steps))
+        self._step += int(steps)
+        losses = np.asarray(losses)
+        self._score = float(losses[-1])
+        return losses
+
     def fit(self, data, labels=None, epochs: int = 1):
         """fit(x, y) | fit(DataSet) | fit(DataSetIterator[, epochs])
         (ref MultiLayerNetwork.fit :1149)."""

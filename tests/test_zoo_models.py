@@ -1,0 +1,64 @@
+"""Zoo instantiation (ref deeplearning4j-zoo TestInstantiation.java): build every model,
+check param counts and shape inference. Forward/fit on the big CNNs runs on the TPU via
+bench.py; CPU tests stay config-level (1 host core)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import (
+    AlexNet, LeNet, ModelSelector, ResNet50, SimpleCNN, TextGenerationLSTM, VGG16, VGG19)
+
+
+def test_model_selector():
+    m = ModelSelector.select("lenet", num_labels=10)
+    assert isinstance(m, LeNet)
+    with pytest.raises(ValueError):
+        ModelSelector.select("nope")
+
+
+def test_resnet50_conf():
+    r = ResNet50(num_labels=1000)
+    conf = r.conf()
+    assert len(conf.nodes) == 175
+    # bottleneck wiring: shortcut adds exist for each block
+    assert conf.nodes["short2a_branch"].inputs == ["bn2a_branch2c", "bn2a_branch1"]
+    net = r.init()
+    assert net.num_params() > 25e6
+
+
+def test_vgg16_vgg19_conf():
+    v16 = VGG16(num_labels=1000).init()
+    v19 = VGG19(num_labels=1000).init()
+    assert v19.num_params() > v16.num_params() > 30e6
+    assert len(v19.layers) == len(v16.layers) + 3
+
+
+def test_alexnet_dense_nin_matches_reference():
+    a = AlexNet(num_labels=1000)
+    conf = a.conf()
+    # ref AlexNet.java:122 — ffn1 nIn must come out to 256 (1x1 spatial x 256 ch)
+    dense = [l for l in conf.layers if type(l).__name__ == "DenseLayer"]
+    assert dense[0].n_in == 256
+
+
+def test_simplecnn_fit_small():
+    net = SimpleCNN(num_labels=4, input_shape=(1, 16, 16), dtype="float64").init()
+    rng = np.random.RandomState(0)
+    x = rng.rand(4, 1, 16, 16)
+    y = np.eye(4)[rng.randint(0, 4, 4)]
+    net.fit(x, y)
+    out = np.asarray(net.output(x))
+    assert out.shape == (4, 4)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-6)
+
+
+def test_textgen_lstm_tbptt():
+    net = TextGenerationLSTM(total_unique_characters=12, dtype="float64").init()
+    rng = np.random.RandomState(0)
+    t = 60  # > tbptt length of 50 → exercises segmenting
+    x = np.zeros((2, 12, t)); y = np.zeros((2, 12, t))
+    for b in range(2):
+        for j in range(t):
+            c = rng.randint(0, 12)
+            x[b, c, j] = 1; y[b, (c + 1) % 12, j] = 1
+    net.fit(x, y)
+    assert np.isfinite(net.score())
