@@ -1,0 +1,10 @@
+"""Parallelism: data-parallel training over a device mesh, batched inference, and
+gradient-sharing accumulators (ref deeplearning4j-scaleout; SURVEY §2.3)."""
+from deeplearning4j_tpu.parallel.accumulation import (
+    BasicGradientsAccumulator, EncodedGradientsAccumulator, GradientsAccumulator,
+    threshold_encode)
+from deeplearning4j_tpu.parallel.mesh import (
+    batch_sharded, make_mesh, replica_stacked, replicated)
+from deeplearning4j_tpu.parallel.parallel_inference import (
+    InferenceMode, ParallelInference)
+from deeplearning4j_tpu.parallel.parallel_wrapper import ParallelWrapper, TrainingMode

@@ -1,0 +1,127 @@
+"""ParallelInference: batched/sharded inference serving.
+
+Parity: ref parallelism/ParallelInference.java:33-122 — modes SEQUENTIAL (each request
+runs as-is) and BATCHED (requests aggregate up to batch_limit before one device call,
+via BatchedInferenceObservable). TPU-first: replicas-as-threads become one jitted forward
+sharded over the mesh batch axis; request aggregation stays host-side with the same
+observable-style future API.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class InferenceMode:
+    SEQUENTIAL = "sequential"
+    BATCHED = "batched"
+
+
+class _Observable:
+    """Future-style result holder (ref inference/observers/*Observable)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value = None
+        self._error: Optional[BaseException] = None
+
+    def _set(self, value):
+        self._value = value
+        self._event.set()
+
+    def _set_error(self, e: BaseException):
+        self._error = e
+        self._event.set()
+
+    def get(self, timeout: Optional[float] = None):
+        if not self._event.wait(timeout):
+            raise TimeoutError("inference result not ready")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class ParallelInference:
+    def __init__(self, model, inference_mode: str = InferenceMode.BATCHED,
+                 batch_limit: int = 32, queue_limit: int = 64, workers: int = 1,
+                 mesh=None, max_wait_ms: float = 5.0):
+        self.model = model
+        self.inference_mode = inference_mode
+        self.batch_limit = int(batch_limit)
+        self.queue_limit = int(queue_limit)
+        self.mesh = mesh
+        self.max_wait_ms = max_wait_ms
+        self._queue: "queue.Queue" = queue.Queue(maxsize=self.queue_limit)
+        self._shutdown = threading.Event()
+        self._worker = None
+        if inference_mode == InferenceMode.BATCHED:
+            self._worker = threading.Thread(target=self._batch_loop, daemon=True)
+            self._worker.start()
+
+    # ---------------- public API (ref ParallelInference.output) ----------------
+    def output(self, x) -> np.ndarray:
+        """Synchronous single-request inference."""
+        if self.inference_mode == InferenceMode.SEQUENTIAL:
+            return np.asarray(self._run(np.asarray(x)))
+        obs = self.output_async(x)
+        return obs.get()
+
+    def output_async(self, x) -> _Observable:
+        obs = _Observable()
+        if self.inference_mode == InferenceMode.SEQUENTIAL:
+            try:
+                obs._set(np.asarray(self._run(np.asarray(x))))
+            except BaseException as e:
+                obs._set_error(e)
+            return obs
+        self._queue.put((np.asarray(x), obs))
+        return obs
+
+    def shutdown(self):
+        self._shutdown.set()
+
+    # ---------------- internals ----------------
+    def _run(self, batch: np.ndarray):
+        if self.mesh is not None:
+            batch = jax.device_put(jnp.asarray(batch, self.model.dtype),
+                                   NamedSharding(self.mesh, P("data")))
+        out = self.model.output(batch)
+        return out[0] if isinstance(out, list) else out
+
+    def _batch_loop(self):
+        """Aggregate requests up to batch_limit, run one device call, scatter results
+        (ref BatchedInferenceObservable)."""
+        while not self._shutdown.is_set():
+            try:
+                first = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            pending: List = [first]
+            total = first[0].shape[0]
+            deadline = self.max_wait_ms / 1e3
+            import time
+            t0 = time.time()
+            while total < self.batch_limit and (time.time() - t0) < deadline:
+                try:
+                    item = self._queue.get(timeout=deadline / 4)
+                    pending.append(item)
+                    total += item[0].shape[0]
+                except queue.Empty:
+                    break
+            try:
+                big = np.concatenate([p[0] for p in pending], axis=0)
+                out = np.asarray(self._run(big))
+                pos = 0
+                for arr, obs in pending:
+                    n = arr.shape[0]
+                    obs._set(out[pos:pos + n])
+                    pos += n
+            except BaseException as e:
+                for _, obs in pending:
+                    obs._set_error(e)

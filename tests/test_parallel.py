@@ -1,0 +1,152 @@
+"""ParallelWrapper / accumulator tests on the 8-virtual-device CPU mesh — the
+`local[N]` analog of the reference's Spark/ParallelWrapper suites (SURVEY §4.5)."""
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu import (
+    Activation, Adam, DataSet, DenseLayer, InputType, MultiLayerNetwork,
+    NeuralNetConfiguration, OutputLayer, Sgd, WeightInit)
+from deeplearning4j_tpu.parallel.accumulation import (
+    BasicGradientsAccumulator, EncodedGradientsAccumulator, threshold_encode)
+from deeplearning4j_tpu.parallel.parallel_wrapper import ParallelWrapper, TrainingMode
+
+RNG = np.random.RandomState(5)
+
+
+def make_net(seed=3, lr=0.05):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).weight_init(WeightInit.XAVIER).activation(Activation.TANH)
+            .updater(Adam(learning_rate=lr)).dtype("float64")
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(2))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def xor(n):
+    x = RNG.randint(0, 2, (n, 2)).astype(np.float64)
+    y = np.eye(2)[(x[:, 0].astype(int) ^ x[:, 1].astype(int))]
+    return x, y
+
+
+def test_threshold_encode():
+    u = np.array([0.5, -0.3, 0.0005, -0.0002, 2.0])
+    res = np.zeros(5)
+    msg, new_res = threshold_encode(u, res, 1e-3)
+    np.testing.assert_allclose(np.asarray(msg), [1e-3, -1e-3, 0, 0, 1e-3])
+    # residual keeps the un-sent remainder; resending eventually transmits everything
+    np.testing.assert_allclose(np.asarray(new_res), [0.499, -0.299, 0.0005, -0.0002, 1.999])
+
+
+def test_encoded_accumulator_residual_carryover():
+    acc = EncodedGradientsAccumulator(threshold=1e-2)
+    g = np.full(4, 6e-3)
+    acc.store_update(g)
+    first = np.asarray(acc.get_update())
+    np.testing.assert_allclose(first, 0.0)  # below threshold: nothing sent
+    acc.store_update(g)  # residual 6e-3 + 6e-3 crosses threshold
+    second = np.asarray(acc.get_update())
+    np.testing.assert_allclose(second, 1e-2)
+
+
+def test_basic_accumulator():
+    acc = BasicGradientsAccumulator()
+    acc.store_update(np.array([1.0, 2.0]))
+    acc.store_update(np.array([3.0, 4.0]))
+    np.testing.assert_allclose(np.asarray(acc.get_update()), [2.0, 3.0])
+
+
+def test_averaging_af1_identical_shards_matches_single_device():
+    """Each replica sees the same batch → af=1 averaging must equal single-device."""
+    x, y = xor(8)
+    x_rep = np.concatenate([x] * 8)
+    y_rep = np.concatenate([y] * 8)
+
+    single = make_net(seed=11)
+    for _ in range(5):
+        single.fit(x, y)
+
+    net = make_net(seed=11)
+    pw = (ParallelWrapper.Builder(net).workers(8)
+          .training_mode(TrainingMode.AVERAGING).averaging_frequency(1).build())
+    for _ in range(5):
+        pw.fit(x_rep, y_rep)
+
+    np.testing.assert_allclose(np.asarray(net.params()),
+                               np.asarray(single.params()), rtol=1e-8, atol=1e-10)
+
+
+def test_shared_gradients_replicas_stay_identical_and_learn():
+    x, y = xor(64)
+    net = make_net(seed=4, lr=0.02)
+    pw = (ParallelWrapper.Builder(net).workers(8)
+          .training_mode(TrainingMode.SHARED_GRADIENTS)
+          .gradients_threshold(1e-3).build())
+    s0 = net.score(DataSet(x, y))
+    for _ in range(60):
+        pw.fit(x, y)
+    # replicas must agree exactly (same aggregated message applied everywhere)
+    params_repl = pw._carry[0] if pw._carry else None
+    if params_repl is not None:
+        p0 = np.asarray(jax.tree_util.tree_leaves(params_repl)[0])
+        for r in range(1, 8):
+            np.testing.assert_allclose(p0[r], p0[0], rtol=1e-12)
+    assert net.score(DataSet(x, y)) < s0 * 0.8
+
+
+def test_averaging_with_frequency_learns():
+    x, y = xor(64)
+    net = make_net(seed=6)
+    pw = (ParallelWrapper.Builder(net).workers(8)
+          .training_mode(TrainingMode.AVERAGING).averaging_frequency(4).build())
+    s0 = net.score(DataSet(x, y))
+    for _ in range(40):
+        pw.fit(x, y)
+    assert net.score(DataSet(x, y)) < s0 * 0.8
+
+
+def test_batch_not_divisible_raises():
+    x, y = xor(10)
+    net = make_net()
+    pw = ParallelWrapper.Builder(net).workers(8).build()
+    with pytest.raises(ValueError):
+        pw.fit(x, y)
+
+
+def test_iterator_path_and_write_back():
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    x, y = xor(32)
+    it = ListDataSetIterator([DataSet(x, y)], batch=16)
+    net = make_net(seed=8)
+    pw = (ParallelWrapper.Builder(net).workers(8)
+          .training_mode(TrainingMode.SHARED_GRADIENTS).build())
+    pw.fit(it, epochs=3)
+    assert net._step == 6
+    out = np.asarray(net.output(x))
+    assert out.shape == (32, 2)
+
+
+def test_parallel_inference_batched():
+    from deeplearning4j_tpu.parallel.parallel_inference import (
+        InferenceMode, ParallelInference)
+    net = make_net()
+    x, _ = xor(16)
+    direct = np.asarray(net.output(x))
+    pi = ParallelInference(net, inference_mode=InferenceMode.BATCHED, batch_limit=8)
+    obs = [pi.output_async(x[i:i + 4]) for i in range(0, 16, 4)]
+    got = np.concatenate([o.get(timeout=30) for o in obs])
+    np.testing.assert_allclose(got, direct, rtol=1e-10)
+    pi.shutdown()
+
+
+def test_parallel_inference_sequential():
+    from deeplearning4j_tpu.parallel.parallel_inference import (
+        InferenceMode, ParallelInference)
+    net = make_net()
+    x, _ = xor(8)
+    pi = ParallelInference(net, inference_mode=InferenceMode.SEQUENTIAL)
+    np.testing.assert_allclose(pi.output(x), np.asarray(net.output(x)), rtol=1e-12)
