@@ -1,0 +1,302 @@
+"""Early stopping: config + trainer + savers + termination conditions.
+
+Parity: ref earlystopping/ — EarlyStoppingConfiguration (Builder),
+BaseEarlyStoppingTrainer.java:100-225 (epoch loop with score calc + iteration/epoch
+termination checks), saver/{InMemoryModelSaver,LocalFileModelSaver}, scorecalc/
+DataSetLossCalculator, termination/ (MaxEpochs, BestScoreEpoch, MaxTime, MaxScore,
+ScoreImprovementEpoch, InvalidScore — the reference's NaN sentinel, SURVEY §5
+"failure detection").
+"""
+from __future__ import annotations
+
+import math
+import os
+import time
+from typing import Any, Callable, List, Optional
+
+
+# ---------------------------------------------------------------- score calculators
+class DataSetLossCalculator:
+    """(ref scorecalc/DataSetLossCalculator.java) — average loss over an iterator."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, net) -> float:
+        total, n = 0.0, 0
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        for ds in self.iterator:
+            total += net.score(ds) * ds.num_examples()
+            n += ds.num_examples()
+        if n == 0:
+            raise ValueError("Empty iterator in DataSetLossCalculator")
+        return total / n if self.average else total
+
+
+# ---------------------------------------------------------------- termination conditions
+class EpochTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, epoch: int, score: float) -> bool:
+        raise NotImplementedError
+
+
+class IterationTerminationCondition:
+    def initialize(self):
+        pass
+
+    def terminate(self, last_score: float) -> bool:
+        raise NotImplementedError
+
+
+class MaxEpochsTerminationCondition(EpochTerminationCondition):
+    def __init__(self, max_epochs: int):
+        self.max_epochs = int(max_epochs)
+
+    def terminate(self, epoch, score):
+        return epoch + 1 >= self.max_epochs
+
+
+class BestScoreEpochTerminationCondition(EpochTerminationCondition):
+    """Stop once score reaches a target value (ref BestScoreEpochTerminationCondition)."""
+
+    def __init__(self, best_expected_score: float):
+        self.best_expected_score = float(best_expected_score)
+
+    def terminate(self, epoch, score):
+        return score <= self.best_expected_score
+
+
+class ScoreImprovementEpochTerminationCondition(EpochTerminationCondition):
+    """Stop after N epochs without improvement (ref ScoreImprovementEpochTC)."""
+
+    def __init__(self, max_epochs_without_improvement: int, min_improvement: float = 0.0):
+        self.patience = int(max_epochs_without_improvement)
+        self.min_improvement = float(min_improvement)
+        self._best = math.inf
+        self._bad_epochs = 0
+
+    def initialize(self):
+        self._best = math.inf
+        self._bad_epochs = 0
+
+    def terminate(self, epoch, score):
+        if score < self._best - self.min_improvement:
+            self._best = score
+            self._bad_epochs = 0
+        else:
+            self._bad_epochs += 1
+        return self._bad_epochs > self.patience
+
+
+class MaxTimeIterationTerminationCondition(IterationTerminationCondition):
+    def __init__(self, max_seconds: float):
+        self.max_seconds = float(max_seconds)
+        self._start = None
+
+    def initialize(self):
+        self._start = time.time()
+
+    def terminate(self, last_score):
+        return (time.time() - self._start) > self.max_seconds
+
+
+class MaxScoreIterationTerminationCondition(IterationTerminationCondition):
+    """Terminate if score exceeds a bound (divergence guard)."""
+
+    def __init__(self, max_score: float):
+        self.max_score = float(max_score)
+
+    def terminate(self, last_score):
+        return last_score > self.max_score
+
+
+class InvalidScoreIterationTerminationCondition(IterationTerminationCondition):
+    """NaN/Inf divergence sentinel (ref InvalidScoreIterationTerminationCondition —
+    the reference's only built-in failure detection, SURVEY §5)."""
+
+    def terminate(self, last_score):
+        return math.isnan(last_score) or math.isinf(last_score)
+
+
+# ---------------------------------------------------------------- savers
+class InMemoryModelSaver:
+    def __init__(self):
+        self._best = None
+        self._latest = None
+
+    def save_best_model(self, net, score):
+        self._best = net.clone()
+
+    def save_latest_model(self, net, score):
+        self._latest = net.clone()
+
+    def get_best_model(self):
+        return self._best
+
+    def get_latest_model(self):
+        return self._latest
+
+
+class LocalFileModelSaver:
+    """(ref saver/LocalFileModelSaver.java) — bestModel.bin / latestModel.bin zips."""
+
+    def __init__(self, directory: str):
+        self.directory = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def _save(self, net, name):
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+        ModelSerializer.write_model(net, os.path.join(self.directory, name))
+
+    def _load(self, name):
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+        path = os.path.join(self.directory, name)
+        return ModelSerializer.restore(path) if os.path.exists(path) else None
+
+    def save_best_model(self, net, score):
+        self._save(net, "bestModel.bin")
+
+    def save_latest_model(self, net, score):
+        self._save(net, "latestModel.bin")
+
+    def get_best_model(self):
+        return self._load("bestModel.bin")
+
+    def get_latest_model(self):
+        return self._load("latestModel.bin")
+
+
+# ---------------------------------------------------------------- config + result
+class EarlyStoppingConfiguration:
+    def __init__(self, score_calculator, model_saver=None,
+                 epoch_termination_conditions: Optional[List] = None,
+                 iteration_termination_conditions: Optional[List] = None,
+                 evaluate_every_n_epochs: int = 1, save_last_model: bool = False):
+        self.score_calculator = score_calculator
+        self.model_saver = model_saver or InMemoryModelSaver()
+        self.epoch_conditions = epoch_termination_conditions or []
+        self.iteration_conditions = iteration_termination_conditions or []
+        self.evaluate_every_n_epochs = max(1, int(evaluate_every_n_epochs))
+        self.save_last_model = save_last_model
+
+    class Builder:
+        def __init__(self):
+            self._kw = dict(score_calculator=None)
+
+        def score_calculator(self, sc):
+            self._kw["score_calculator"] = sc
+            return self
+        scoreCalculator = score_calculator
+
+        def model_saver(self, s):
+            self._kw["model_saver"] = s
+            return self
+        modelSaver = model_saver
+
+        def epoch_termination_conditions(self, *conds):
+            self._kw["epoch_termination_conditions"] = list(conds)
+            return self
+        epochTerminationConditions = epoch_termination_conditions
+
+        def iteration_termination_conditions(self, *conds):
+            self._kw["iteration_termination_conditions"] = list(conds)
+            return self
+        iterationTerminationConditions = iteration_termination_conditions
+
+        def evaluate_every_n_epochs(self, n: int):
+            self._kw["evaluate_every_n_epochs"] = int(n)
+            return self
+        evaluateEveryNEpochs = evaluate_every_n_epochs
+
+        def save_last_model(self, b: bool):
+            self._kw["save_last_model"] = bool(b)
+            return self
+
+        def build(self):
+            return EarlyStoppingConfiguration(**self._kw)
+
+
+class EarlyStoppingResult:
+    def __init__(self, termination_reason: str, termination_details: str,
+                 score_vs_epoch: dict, best_model_epoch: int, best_model_score: float,
+                 total_epochs: int, best_model):
+        self.termination_reason = termination_reason
+        self.termination_details = termination_details
+        self.score_vs_epoch = score_vs_epoch
+        self.best_model_epoch = best_model_epoch
+        self.best_model_score = best_model_score
+        self.total_epochs = total_epochs
+        self.best_model = best_model
+
+    def get_best_model(self):
+        return self.best_model
+
+
+# ---------------------------------------------------------------- trainer
+class EarlyStoppingTrainer:
+    """(ref trainer/BaseEarlyStoppingTrainer.java:100-225) — works for both
+    MultiLayerNetwork and ComputationGraph (the reference has a Graph variant class;
+    here one trainer serves both)."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, net, train_iterator):
+        self.config = config
+        self.net = net
+        self.iterator = train_iterator
+
+    def fit(self) -> EarlyStoppingResult:
+        cfg = self.config
+        for c in cfg.epoch_conditions + cfg.iteration_conditions:
+            c.initialize()
+        score_vs_epoch = {}
+        best_score, best_epoch = math.inf, -1
+        epoch = 0
+        reason, details = "Unknown", ""
+        while True:
+            # one training epoch with per-iteration termination checks
+            if hasattr(self.iterator, "reset"):
+                self.iterator.reset()
+            terminated = False
+            for ds in self.iterator:
+                self.net.fit(ds)
+                last = self.net.score()
+                for c in cfg.iteration_conditions:
+                    if c.terminate(last):
+                        reason = "IterationTerminationCondition"
+                        details = type(c).__name__
+                        terminated = True
+                        break
+                if terminated:
+                    break
+            if terminated:
+                break
+
+            if epoch % cfg.evaluate_every_n_epochs == 0:
+                score = cfg.score_calculator.calculate_score(self.net)
+                score_vs_epoch[epoch] = score
+                if score < best_score:
+                    best_score, best_epoch = score, epoch
+                    cfg.model_saver.save_best_model(self.net, score)
+                if cfg.save_last_model:
+                    cfg.model_saver.save_latest_model(self.net, score)
+                stop = False
+                for c in cfg.epoch_conditions:
+                    if c.terminate(epoch, score):
+                        reason = "EpochTerminationCondition"
+                        details = type(c).__name__
+                        stop = True
+                        break
+                if stop:
+                    break
+            epoch += 1
+
+        best = cfg.model_saver.get_best_model() or self.net
+        return EarlyStoppingResult(reason, details, score_vs_epoch, best_epoch,
+                                   best_score, epoch + 1, best)
+
+
+# alias matching reference naming (EarlyStoppingGraphTrainer)
+EarlyStoppingGraphTrainer = EarlyStoppingTrainer

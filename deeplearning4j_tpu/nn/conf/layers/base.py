@@ -83,6 +83,7 @@ class BaseLayerConf:
     l2_bias: float = 0.0
     dropout: float = 0.0  # retain probability; 0 disables (ref util/Dropout.java semantics)
     updater: Optional[dict] = None  # per-layer updater override (serialized BaseUpdater)
+    frozen: bool = False  # FrozenLayer semantics (ref nn/layers/FrozenLayer.java)
     gradient_normalization: GradientNormalization = GradientNormalization.NoNormalization
     gradient_normalization_threshold: float = 1.0
 
@@ -119,6 +120,8 @@ class BaseLayerConf:
     # ---------------- regularization ----------------
     def regularization_score(self, params: Dict[str, jnp.ndarray]) -> jnp.ndarray:
         s = jnp.asarray(0.0, jnp.float32)
+        if self.frozen:
+            return s  # frozen layers contribute no regularization (FrozenLayer)
         for k, p in params.items():
             is_weight = any(k.startswith(pref) for pref in WEIGHT_KEY_PREFIXES)
             l1 = self.l1 if is_weight else self.l1_bias

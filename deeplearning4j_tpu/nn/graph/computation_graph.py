@@ -74,7 +74,10 @@ class ComputationGraph:
         self._updaters = []
         for name in self.layer_names:
             layer = self.conf.nodes[name].conf
-            if layer.updater is not None:
+            if layer.frozen:
+                from deeplearning4j_tpu.nn.updater.updaters import NoOp
+                self._updaters.append(NoOp())  # FrozenLayer: params never step
+            elif layer.updater is not None:
                 self._updaters.append(BaseUpdater.from_dict(layer.updater))
             else:
                 self._updaters.append(global_updater)

@@ -96,7 +96,10 @@ class MultiLayerNetwork:
         global_updater = self.conf.get_updater()
         self._updaters = []
         for layer in self.layers:
-            if layer.updater is not None:
+            if layer.frozen:
+                from deeplearning4j_tpu.nn.updater.updaters import NoOp
+                self._updaters.append(NoOp())  # FrozenLayer: params never step
+            elif layer.updater is not None:
                 self._updaters.append(BaseUpdater.from_dict(layer.updater))
             else:
                 self._updaters.append(global_updater)

@@ -1,0 +1,267 @@
+"""Transfer learning: rebuild networks with frozen layers / replaced heads / changed nOut.
+
+Parity: ref nn/transferlearning/TransferLearning.java:35 (Builder :37, GraphBuilder :452),
+FineTuneConfiguration.java, TransferLearningHelper.java (featurize-and-train split),
+nn/layers/FrozenLayer.java. Frozen layers are realized by a `frozen` flag on the layer
+conf — their updater becomes NoOp and they drop out of regularization, while still
+tracing into the same XLA forward (no separate wrapper layer needed).
+"""
+from __future__ import annotations
+
+import copy
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.enums import WeightInit
+from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.conf.layers.base import BaseLayerConf
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+
+class FineTuneConfiguration:
+    """Global-override bundle applied to every non-frozen layer
+    (ref FineTuneConfiguration.java)."""
+
+    def __init__(self, updater=None, learning_rate: Optional[float] = None,
+                 activation=None, weight_init=None, l1: Optional[float] = None,
+                 l2: Optional[float] = None, dropout: Optional[float] = None,
+                 seed: Optional[int] = None):
+        self.updater = updater
+        self.learning_rate = learning_rate
+        self.activation = activation
+        self.weight_init = weight_init
+        self.l1 = l1
+        self.l2 = l2
+        self.dropout = dropout
+        self.seed = seed
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def updater(self, u):
+            self._kw["updater"] = u
+            return self
+
+        def learning_rate(self, lr):
+            self._kw["learning_rate"] = lr
+            return self
+        learningRate = learning_rate
+
+        def activation(self, a):
+            self._kw["activation"] = a
+            return self
+
+        def weight_init(self, w):
+            self._kw["weight_init"] = w
+            return self
+        weightInit = weight_init
+
+        def l1(self, v):
+            self._kw["l1"] = v
+            return self
+
+        def l2(self, v):
+            self._kw["l2"] = v
+            return self
+
+        def drop_out(self, v):
+            self._kw["dropout"] = v
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = s
+            return self
+
+        def build(self):
+            return FineTuneConfiguration(**self._kw)
+
+    def apply_to(self, layer: BaseLayerConf):
+        if layer.frozen:
+            return
+        if self.activation is not None:
+            layer.activation = self.activation
+        if self.weight_init is not None:
+            layer.weight_init = self.weight_init
+        if self.l1 is not None:
+            layer.l1 = self.l1
+        if self.l2 is not None:
+            layer.l2 = self.l2
+        if self.dropout is not None:
+            layer.dropout = self.dropout
+
+
+class TransferLearning:
+    class Builder:
+        """(ref TransferLearning.Builder :37)"""
+
+        def __init__(self, net: MultiLayerNetwork):
+            self._net = net
+            self._conf = MultiLayerConfiguration.from_json(net.conf.to_json())
+            self._params: List[Dict] = [dict(p) for p in net.params_tree]
+            self._fine_tune: Optional[FineTuneConfiguration] = None
+            self._freeze_until: Optional[int] = None
+            self._nout_changes: List = []  # (layer_idx, n_out, weight_init)
+            self._removed_from_output = 0
+            self._appended: List[BaseLayerConf] = []
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+        fineTuneConfiguration = fine_tune_configuration
+
+        def set_feature_extractor(self, layer_idx: int):
+            """Freeze layers [0..layer_idx] (ref setFeatureExtractor)."""
+            self._freeze_until = int(layer_idx)
+            return self
+        setFeatureExtractor = set_feature_extractor
+
+        def nout_replace(self, layer_idx: int, n_out: int,
+                         weight_init=WeightInit.XAVIER):
+            """Change layer nOut, re-initializing it and the next layer's nIn
+            (ref nOutReplace)."""
+            self._nout_changes.append((int(layer_idx), int(n_out), weight_init))
+            return self
+        nOutReplace = nout_replace
+
+        def remove_output_layer(self):
+            self._removed_from_output += 1
+            return self
+        removeOutputLayer = remove_output_layer
+
+        def remove_layers_from_output(self, n: int):
+            self._removed_from_output += int(n)
+            return self
+        removeLayersFromOutput = remove_layers_from_output
+
+        def add_layer(self, layer: BaseLayerConf):
+            self._appended.append(layer)
+            return self
+        addLayer = add_layer
+
+        def build(self) -> MultiLayerNetwork:
+            conf = self._conf
+            layers = conf.layers
+            params = self._params
+            reinit: set = set()
+
+            # 1. remove layers from the output end
+            for _ in range(self._removed_from_output):
+                layers.pop()
+                params.pop()
+
+            # 2. append new layers (nIn inferred from current output type)
+            if self._appended:
+                input_types = _types_through(conf, len(layers))
+                cur = input_types[-1]
+                for layer in self._appended:
+                    layer.set_n_in(cur, override=False)
+                    layers.append(layer)
+                    params.append(None)  # to be initialized
+                    reinit.add(len(layers) - 1)
+                    cur = layer.get_output_type(cur)
+
+            # 3. nOut replacement (+ next layer nIn)
+            for idx, n_out, w in self._nout_changes:
+                layers[idx].n_out = n_out
+                layers[idx].weight_init = w
+                reinit.add(idx)
+                if idx + 1 < len(layers) and hasattr(layers[idx + 1], "n_in"):
+                    layers[idx + 1].n_in = 0  # re-infer
+                    reinit.add(idx + 1)
+
+            # 4. freeze
+            if self._freeze_until is not None:
+                for i in range(self._freeze_until + 1):
+                    layers[i].frozen = True
+
+            # 5. fine-tune overrides
+            if self._fine_tune is not None:
+                ft = self._fine_tune
+                for layer in layers:
+                    ft.apply_to(layer)
+                if ft.updater is not None:
+                    conf.global_conf.updater = ft.updater.to_dict()
+                if ft.seed is not None:
+                    conf.global_conf.seed = ft.seed
+
+            # re-run shape inference to fix nIn chain
+            if conf.input_type is not None:
+                cur = conf.input_type
+                for i, layer in enumerate(layers):
+                    if i in conf.preprocessors:
+                        cur = conf.preprocessors[i].get_output_type(cur)
+                    if i in reinit and hasattr(layer, "n_in"):
+                        layer.n_in = 0
+                    layer.set_n_in(cur, override=False)
+                    cur = layer.get_output_type(cur)
+            # drop preprocessors beyond the new depth
+            conf.preprocessors = {k: v for k, v in conf.preprocessors.items()
+                                  if k < len(layers)}
+
+            new_net = MultiLayerNetwork(conf)
+            new_net.init()
+            # copy old params where kept
+            for i, p in enumerate(params):
+                if p is not None and i not in reinit:
+                    new_net.params_tree[i] = {
+                        k: jnp.array(v, copy=True) for k, v in p.items()}
+            new_net._opt_state = [u.init(p) for u, p in
+                                  zip(new_net._updaters, new_net.params_tree)]
+            return new_net
+
+
+def _types_through(conf: MultiLayerConfiguration, upto: int):
+    cur = conf.input_type
+    types = [cur]
+    for i, layer in enumerate(conf.layers[:upto]):
+        if i in conf.preprocessors:
+            cur = conf.preprocessors[i].get_output_type(cur)
+        cur = layer.get_output_type(cur)
+        types.append(cur)
+    return types
+
+
+class TransferLearningHelper:
+    """Featurize-and-train on the unfrozen tail (ref TransferLearningHelper.java):
+    run inputs through the frozen prefix once, then train only the unfrozen subnetwork
+    on the cached features."""
+
+    def __init__(self, net: MultiLayerNetwork, frozen_until: Optional[int] = None):
+        if frozen_until is not None:
+            net = TransferLearning.Builder(net).set_feature_extractor(frozen_until).build()
+        self.net = net
+        frozen_idx = [i for i, l in enumerate(net.layers) if l.frozen]
+        self.split = (max(frozen_idx) + 1) if frozen_idx else 0
+
+    def featurize(self, ds):
+        """DataSet → features at the frozen/unfrozen boundary."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        acts = self.net.feed_forward(ds.features, train=False)
+        return DataSet(acts[self.split], ds.labels, ds.features_mask, ds.labels_mask)
+
+    def unfrozen_graph(self) -> MultiLayerNetwork:
+        """The trainable tail as its own network sharing parameter values."""
+        conf = MultiLayerConfiguration.from_json(self.net.conf.to_json())
+        tail_layers = conf.layers[self.split:]
+        input_types = _types_through(self.net.conf, self.split)
+        sub_conf = MultiLayerConfiguration(
+            layers=tail_layers,
+            preprocessors={k - self.split: v for k, v in conf.preprocessors.items()
+                           if k >= self.split},
+            global_conf=conf.global_conf,
+            input_type=input_types[-1])
+        sub = MultiLayerNetwork(sub_conf)
+        sub.init(params=self.net.params_tree[self.split:])
+        return sub
+
+    def fit_featurized(self, ds):
+        """Train the unfrozen tail directly inside the full net (featurized input)."""
+        sub = self.unfrozen_graph()
+        sub.fit(ds.features, ds.labels)
+        # write trained tail params back
+        for i, p in enumerate(sub.params_tree):
+            self.net.params_tree[self.split + i] = p
+        return self.net
