@@ -98,7 +98,8 @@ def compute_loss(
             if mask is not None:
                 m = jnp.broadcast_to(mask.reshape(mask.shape[0], -1)[:, :1], per_ex.shape)
                 per_ex = per_ex * m
-                return jnp.sum(per_ex) / jnp.clip(jnp.sum(m), 1.0)
+                # same policy as every other masked loss: divide by minibatch size
+                return jnp.sum(per_ex) / per_ex.shape[0]
             return jnp.mean(per_ex)
         else:
             raise ValueError(f"Unsupported loss function: {loss_fn}")
@@ -107,8 +108,9 @@ def compute_loss(
         m = jnp.broadcast_to(mask.reshape(mask.shape + (1,) * (per_elem.ndim - mask.ndim)),
                              per_elem.shape).astype(per_elem.dtype)
         per_elem = per_elem * m
-        # normalize by number of unmasked "examples" — for RNN losses each (example,
-        # timestep) with mask=1 counts as one scoring unit (ref masked scoring semantics)
-        denom = jnp.clip(jnp.sum(m) / max(1, per_elem.shape[-1]), 1.0)
-        return jnp.sum(per_elem) / denom
+        # Reference scoring semantics: sum masked loss over all outputs/timesteps,
+        # divide by MINIBATCH size (matches the unmasked branch below, which also
+        # normalizes by examples only — so masked and unmasked training see the same
+        # effective loss scale / learning rate).
+        return jnp.sum(per_elem) / per_elem.shape[0]
     return jnp.mean(_sum_per_example(per_elem))

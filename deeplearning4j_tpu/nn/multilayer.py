@@ -55,6 +55,28 @@ def _normalize_gradients(layer: BaseLayerConf, grads: Dict[str, jnp.ndarray]):
     return out
 
 
+def _compute_updates(layers, updaters, grads, opt_state, params_tree, step):
+    """Per-layer: normalize gradients, run the stateful updater.
+    Returns (updates, new_opt_state) — the single shared implementation of the
+    reference's Solver/updater step, used by every training path."""
+    upds, new_opt = [], []
+    for i, (layer, u) in enumerate(zip(layers, updaters)):
+        g = _normalize_gradients(layer, grads[i])
+        upd, st = u.update(g, opt_state[i], params_tree[i], step)
+        upds.append(upd)
+        new_opt.append(st)
+    return upds, new_opt
+
+
+def _apply_updates(layers, updaters, grads, opt_state, params_tree, step):
+    """params' = params - updater(grads) for every layer."""
+    upds, new_opt = _compute_updates(layers, updaters, grads, opt_state,
+                                     params_tree, step)
+    new_params = [jax.tree_util.tree_map(lambda p, d: p - d, pt, ut)
+                  for pt, ut in zip(params_tree, upds)]
+    return new_params, new_opt
+
+
 class MultiLayerNetwork:
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
@@ -251,13 +273,8 @@ class MultiLayerNetwork:
             (loss, (new_states, final_rnn)), grads = jax.value_and_grad(
                 self._loss_fn, has_aux=True)(params_tree, state_tree, x, y, fmask,
                                              lmask, rng, True, rnn_init_states)
-            new_params, new_opt = [], []
-            for i, (layer, u) in enumerate(zip(layers, updaters)):
-                g = _normalize_gradients(layer, grads[i])
-                upd, st = u.update(g, opt_state[i], params_tree[i], step)
-                new_params.append(jax.tree_util.tree_map(
-                    lambda p, du: p - du, params_tree[i], upd))
-                new_opt.append(st)
+            new_params, new_opt = _apply_updates(layers, updaters, grads,
+                                                 opt_state, params_tree, step)
             return new_params, new_opt, new_states, loss, final_rnn
 
         # donate params/opt-state/bn-state buffers: in-place update on device
@@ -304,12 +321,9 @@ class MultiLayerNetwork:
         self._accumulator.store_update(flat_grads)
         agg = self._accumulator.get_update()
         grads = unflatten_params(grads, agg)
-        for i, (layer, u) in enumerate(zip(self.layers, self._updaters)):
-            g = _normalize_gradients(layer, grads[i])
-            upd, st = u.update(g, self._opt_state[i], self.params_tree[i], self._step)
-            self.params_tree[i] = jax.tree_util.tree_map(
-                lambda p, du: p - du, self.params_tree[i], upd)
-            self._opt_state[i] = st
+        self.params_tree, self._opt_state = _apply_updates(
+            self.layers, self._updaters, grads, self._opt_state, self.params_tree,
+            self._step)
         self._step += 1
         self._score = loss
         for lst in self._listeners:
@@ -325,42 +339,41 @@ class MultiLayerNetwork:
         self._check_init()
         x = jnp.asarray(x, self.dtype)
         y = jnp.asarray(y, self.dtype)
-        updaters = self._updaters
-        layers = self.layers
         per_step_data = steps is None
         if per_step_data:
             steps = x.shape[0]
 
-        def body(carry, xs):
-            params, opt, states, step, rng = carry
-            bx, by = xs if per_step_data else (x, y)
-            rng, sub = jax.random.split(rng)
-
-            def loss_fn(p):
-                loss, (ns, _) = self._loss_fn(p, states, bx, by, fmask, lmask, sub,
-                                              True, None)
-                return loss, ns
-
-            (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
-            newp, newo = [], []
-            for i, (layer, u) in enumerate(zip(layers, updaters)):
-                g = _normalize_gradients(layer, grads[i])
-                upd, st = u.update(g, opt[i], params[i], step)
-                newp.append(jax.tree_util.tree_map(lambda p, d: p - d, params[i], upd))
-                newo.append(st)
-            return (newp, newo, ns, step + 1, rng), loss
-
-        cache_key = ("mln", per_step_data, int(steps),
-                     tuple(x.shape), tuple(y.shape),
-                     None if fmask is None else tuple(np.shape(fmask)),
-                     None if lmask is None else tuple(np.shape(lmask)))
+        # Cache keyed on the static loop mode only; ALL data (x/y/masks) is passed as
+        # jit arguments so the traced computation never captures a batch as a constant
+        # (a warm cache must not replay the first call's data). jax.jit's own aval
+        # cache handles shape/dtype/None changes.
+        cache_key = ("mln", per_step_data)
         if not hasattr(self, "_device_loop_cache"):
             self._device_loop_cache = {}
         run = self._device_loop_cache.get(cache_key)
         if run is None:
+            updaters = self._updaters
+            layers = self.layers
+
             @functools.partial(jax.jit, donate_argnums=(0, 1, 2),
                                static_argnames=("n",))
-            def run(params, opt, states, step, rng, x, y, n):
+            def run(params, opt, states, step, rng, x, y, fmask, lmask, n):
+                def body(carry, xs):
+                    params_c, opt_c, states_c, step_c, rng_c = carry
+                    bx, by = xs if per_step_data else (x, y)
+                    rng_c, sub = jax.random.split(rng_c)
+
+                    def loss_fn(p):
+                        loss, (ns, _) = self._loss_fn(p, states_c, bx, by, fmask,
+                                                      lmask, sub, True, None)
+                        return loss, ns
+
+                    (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                        params_c)
+                    newp, newo = _apply_updates(layers, updaters, grads, opt_c,
+                                                params_c, step_c)
+                    return (newp, newo, ns, step_c + 1, rng_c), loss
+
                 xs = (x, y) if per_step_data else None
                 carry, losses = jax.lax.scan(body, (params, opt, states, step, rng),
                                              xs, length=n)
@@ -370,7 +383,7 @@ class MultiLayerNetwork:
         self._rng, sub = jax.random.split(self._rng)
         (self.params_tree, self._opt_state, self.state_tree, _, _), losses = run(
             self.params_tree, self._opt_state, self.state_tree,
-            jnp.asarray(self._step, jnp.int32), sub, x, y, int(steps))
+            jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask, n=int(steps))
         self._step += int(steps)
         losses = np.asarray(losses)
         self._score = float(losses[-1])

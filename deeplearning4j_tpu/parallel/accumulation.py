@@ -32,7 +32,9 @@ class GradientsAccumulator:
     """Base API (ref accumulation/GradientsAccumulator.java): store updates, hand back
     the aggregated update to apply."""
 
-    def store_update(self, flat_grads: jnp.ndarray) -> None:
+    def store_update(self, flat_grads: jnp.ndarray, party: int = 0) -> None:
+        """Store one worker's update. `party` identifies the worker so stateful
+        encoders keep per-worker residuals (ref: one EncodingHandler per trainer)."""
         raise NotImplementedError
 
     def get_update(self) -> jnp.ndarray:
@@ -50,7 +52,7 @@ class BasicGradientsAccumulator(GradientsAccumulator):
         self.parties = parties
         self._stored = []
 
-    def store_update(self, flat_grads):
+    def store_update(self, flat_grads, party: int = 0):
         self._stored.append(flat_grads)
 
     def get_update(self):
@@ -79,14 +81,17 @@ class EncodedGradientsAccumulator(GradientsAccumulator):
         self.threshold = float(threshold)
         self.threshold_decay = float(threshold_decay)
         self.min_threshold = float(min_threshold)
-        self._residual: Optional[jnp.ndarray] = None
+        # one residual per party: each worker owns its own encoder state
+        # (ref: one EncodingHandler instance per trainer thread)
+        self._residuals: dict = {}
         self._stored = []
 
-    def store_update(self, flat_grads):
-        if self._residual is None:
-            self._residual = jnp.zeros_like(flat_grads)
-        message, self._residual = threshold_encode(flat_grads, self._residual,
-                                                   self.threshold)
+    def store_update(self, flat_grads, party: int = 0):
+        residual = self._residuals.get(party)
+        if residual is None:
+            residual = jnp.zeros_like(flat_grads)
+        message, self._residuals[party] = threshold_encode(flat_grads, residual,
+                                                           self.threshold)
         self._stored.append(message)
         self.threshold = max(self.min_threshold,
                              self.threshold * self.threshold_decay)
@@ -102,4 +107,4 @@ class EncodedGradientsAccumulator(GradientsAccumulator):
 
     def reset(self):
         self._stored = []
-        self._residual = None
+        self._residuals = {}

@@ -11,10 +11,19 @@ XLA computation, and the averaging/gradient-sharing collectives ride ICI:
 - AVERAGING (DP-1): replicas step independently; every `averaging_frequency` steps
   params AND updater state are pmean'd across the mesh (exact
   Nd4j.averageAndPropagate + averageUpdatersState semantics).
-- SHARED_GRADIENTS (DP-2): each step, every replica's update is threshold-quantized
-  (with residual, ref EncodingHandler) and psum'd — the synchronous rendering of the
-  reference's async accumulator exchange (documented delta: no staleness).
-- CUSTOM: caller-provided GradientsAccumulator applied host-side.
+- SHARED_GRADIENTS (DP-2): each step, every replica applies its own (stateful) updater
+  to its raw gradients, threshold-quantizes the resulting *update* (with residual, ref
+  EncodingHandler encodes post-updater updates), psums the messages, and subtracts the
+  aggregate from params — the synchronous rendering of the reference's async
+  accumulator exchange (documented delta: no staleness).
+- CUSTOM: caller-provided GradientsAccumulator applied host-side — per-replica
+  gradients are computed on-mesh, stored into the accumulator, and the aggregated
+  update is stepped through the updater identically on every replica
+  (ref DefaultTrainer + StochasticGradientDescent.java:66-74 accumulator hook).
+
+BatchNormalization running statistics (state_tree) are pmean'd across replicas at every
+sync point, mirroring how DL4J's parameter averaging covers BN stats (they live in
+params there).
 
 Replicas hold identical params after fit(); the wrapped net receives replica-0's
 (post-averaging) state, mirroring how ParallelWrapper writes back into the original
@@ -31,7 +40,8 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deeplearning4j_tpu.nn.multilayer import _normalize_gradients
+from deeplearning4j_tpu.nn.multilayer import (
+    _apply_updates, _compute_updates, _normalize_gradients)
 from deeplearning4j_tpu.parallel.accumulation import threshold_encode
 from deeplearning4j_tpu.parallel.mesh import make_mesh
 
@@ -48,7 +58,16 @@ class ParallelWrapper:
                  training_mode: str = TrainingMode.SHARED_GRADIENTS,
                  gradients_threshold: float = 1e-3,
                  report_score_after_averaging: bool = True,
-                 mesh: Optional[Mesh] = None):
+                 mesh: Optional[Mesh] = None,
+                 accumulator=None):
+        if training_mode not in (TrainingMode.AVERAGING,
+                                 TrainingMode.SHARED_GRADIENTS,
+                                 TrainingMode.CUSTOM):
+            raise ValueError(f"Unknown training mode: {training_mode!r}")
+        if training_mode == TrainingMode.CUSTOM and accumulator is None:
+            raise ValueError(
+                "TrainingMode.CUSTOM requires a GradientsAccumulator "
+                "(ref ParallelWrapper custom FancyBlockingQueue/accumulator wiring)")
         self.model = model
         self.mesh = mesh or make_mesh(workers)
         self.workers = int(np.prod(list(self.mesh.shape.values())))
@@ -57,6 +76,7 @@ class ParallelWrapper:
         self.training_mode = training_mode
         self.gradients_threshold = float(gradients_threshold)
         self.report_score_after_averaging = report_score_after_averaging
+        self.accumulator = accumulator
         self._carry = None  # (params_repl, opt_repl, states_repl, residual, step)
         self._step_fn = None
         self._score = float("nan")
@@ -98,6 +118,17 @@ class ParallelWrapper:
         mesh = self.mesh
         from deeplearning4j_tpu.util.flat_params import flatten_params, unflatten_params
 
+        if mode == TrainingMode.CUSTOM:
+            self._build_custom_step()
+            return
+
+        def _pmean_floats(tree):
+            """Average float leaves across replicas (BN running stats); leave
+            non-float state (counters/flags) as replica-local."""
+            return jax.tree_util.tree_map(
+                lambda a: lax.pmean(a, "data")
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a, tree)
+
         def per_replica_step(params, opt, states, residual, step, rng, bx, by, bfm, blm):
             # strip the leading per-replica axis added by shard_map
             params, opt, states = jax.tree_util.tree_map(
@@ -116,35 +147,39 @@ class ParallelWrapper:
                 loss_fn, has_aux=True)(params)
 
             if mode == TrainingMode.SHARED_GRADIENTS:
-                flat = flatten_params(grads)
-                msg, residual = threshold_encode(flat, residual, thr)
-                # every replica applies the SUM of all replicas' messages — the
-                # reference applies each worker's sparse update individually
-                # (EncodedGradientsAccumulator), which sums, not averages
-                agg = lax.psum(msg, "data")
-                grads = unflatten_params(grads, agg)
-
-            new_params, new_opt = [], []
-            for i, (layer, u) in enumerate(zip(layers, updaters)):
-                g = _normalize_gradients(layer, grads[i])
-                upd, st = u.update(g, opt[i], params[i], step)
-                new_params.append(jax.tree_util.tree_map(
-                    lambda p, d: p - d, params[i], upd))
-                new_opt.append(st)
-
-            if mode == TrainingMode.AVERAGING:
+                # EncodingHandler semantics: each replica applies its own stateful
+                # updater to its raw gradients, the resulting *update* is threshold-
+                # encoded; every replica then subtracts the SUM of all replicas'
+                # sparse messages (EncodedGradientsAccumulator sums, not averages).
+                upds, new_opt = _compute_updates(layers, updaters, grads, opt,
+                                                 params, step)
+                flat_upd = flatten_params(upds)
+                msg, residual = threshold_encode(flat_upd, residual, thr)
+                agg = unflatten_params(upds, lax.psum(msg, "data"))
+                new_params = [jax.tree_util.tree_map(lambda p, d: p - d,
+                                                     params[i], agg[i])
+                              for i in range(len(layers))]
+                new_states = _pmean_floats(new_states)
+            else:  # AVERAGING
+                new_params, new_opt = _apply_updates(layers, updaters, grads, opt,
+                                                     params, step)
                 n = lax.psum(1, "data")
 
                 def avg(tree):
                     return jax.tree_util.tree_map(
                         lambda a: lax.psum(a, "data") / n, tree)
 
+                def sync(t):
+                    (p, o), s = t
+                    return avg((p, o)), _pmean_floats(s)
+
                 if af == 1:
-                    new_params, new_opt = avg((new_params, new_opt))
+                    (new_params, new_opt), new_states = sync(
+                        ((new_params, new_opt), new_states))
                 else:
-                    new_params, new_opt = lax.cond(
-                        (step + 1) % af == 0, avg, lambda t: t,
-                        (new_params, new_opt))
+                    (new_params, new_opt), new_states = lax.cond(
+                        (step + 1) % af == 0, sync, lambda t: t,
+                        ((new_params, new_opt), new_states))
 
             mean_loss = lax.psum(loss, "data") / lax.psum(1, "data")
             out = (jax.tree_util.tree_map(lambda a: a[None], (new_params, new_opt,
@@ -171,6 +206,73 @@ class ParallelWrapper:
                 bx, by, bfm, blm)
             new_params, new_opt, new_states = trees
             return (new_params, new_opt, new_states, new_residual, step + 1), loss
+
+        self._step_fn = step_fn
+
+    def _build_custom_step(self):
+        """CUSTOM mode: per-replica gradients computed on-mesh, aggregated through the
+        caller's GradientsAccumulator host-side, and the aggregated gradient stepped
+        through the updater identically on every replica (so replicas stay in sync)."""
+        net = self.model
+        updaters = net._updaters
+        layers = net.layers
+        mesh = self.mesh
+        from deeplearning4j_tpu.util.flat_params import flatten_params, unflatten_params
+
+        def per_replica_grads(params, opt, states, residual, step, rng, bx, by,
+                              bfm, blm):
+            params, opt, states = jax.tree_util.tree_map(
+                lambda a: a[0], (params, opt, states))
+            rng = jax.random.fold_in(rng, lax.axis_index("data"))
+
+            def loss_fn(p):
+                loss, (ns, _) = net._loss_fn(p, states, bx, by, bfm, blm, rng,
+                                             True, None)
+                return loss, ns
+
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            # sync BN running stats across replicas (float leaves only)
+            new_states = jax.tree_util.tree_map(
+                lambda a: lax.pmean(a, "data")
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a,
+                new_states)
+            flat = flatten_params(grads)
+            mean_loss = lax.psum(loss, "data") / lax.psum(1, "data")
+            return (flat[None], jax.tree_util.tree_map(lambda a: a[None], new_states),
+                    mean_loss)
+
+        repl_spec = P("data")
+        grads_shmapped = jax.shard_map(
+            per_replica_grads, mesh=mesh,
+            in_specs=(repl_spec, repl_spec, repl_spec, None, P(), P(),
+                      P("data"), P("data"), P("data"), P("data")),
+            out_specs=(repl_spec, repl_spec, P()),
+            check_vma=False)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def apply_agg(params_repl, opt_repl, agg_flat, step):
+            """Apply one aggregated flat gradient through the updater on replica-0
+            params, then rebroadcast to all replicas (they are identical)."""
+            params = jax.tree_util.tree_map(lambda a: a[0], params_repl)
+            opt = jax.tree_util.tree_map(lambda a: a[0], opt_repl)
+            grads = unflatten_params(params, agg_flat)
+            new_params, new_opt = _apply_updates(layers, updaters, grads, opt,
+                                                 params, step)
+            R = self.workers
+            return jax.tree_util.tree_map(
+                lambda a: jnp.broadcast_to(a[None], (R,) + a.shape),
+                (new_params, new_opt))
+
+        def step_fn(carry, rng, bx, by, bfm, blm):
+            params_repl, opt_repl, states_repl, _, step = carry
+            flat_grads, new_states, loss = grads_shmapped(
+                params_repl, opt_repl, states_repl, None, step, rng, bx, by, bfm, blm)
+            for r in range(self.workers):
+                self.accumulator.store_update(flat_grads[r], party=r)
+            agg = self.accumulator.get_update()
+            new_params, new_opt = apply_agg(params_repl, opt_repl, agg, step)
+            return (new_params, new_opt, new_states, None, step + 1), loss
 
         self._step_fn = step_fn
 
@@ -278,6 +380,13 @@ class ParallelWrapper:
         def mesh(self, m: Mesh):
             self._kw["mesh"] = m
             return self
+
+        def gradients_accumulator(self, acc):
+            """Caller-provided GradientsAccumulator for TrainingMode.CUSTOM
+            (ref ParallelWrapper.Builder.gradientsAccumulator)."""
+            self._kw["accumulator"] = acc
+            return self
+        gradientsAccumulator = gradients_accumulator
 
         def build(self) -> "ParallelWrapper":
             return ParallelWrapper(self._model, **self._kw)

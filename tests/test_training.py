@@ -143,3 +143,40 @@ def test_benchmark_iterator():
                               .build())).init())
     net.fit(it, epochs=1)
     assert net._step == 5
+
+
+def test_fit_on_device_warm_cache_uses_new_data():
+    """Regression: a warm shape-cache must not replay the first call's batch
+    (the scan body used to capture x/y as traced constants)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu import (
+        Activation, DenseLayer, InputType, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer, Sgd, WeightInit)
+
+    def fresh():
+        conf = (NeuralNetConfiguration.Builder()
+                .seed(11).weight_init(WeightInit.XAVIER)
+                .updater(Sgd(learning_rate=0.5))
+                .list()
+                .layer(DenseLayer(n_out=4, activation=Activation.TANH))
+                .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX))
+                .set_input_type(InputType.feed_forward(3))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    rng = np.random.RandomState(0)
+    xa = rng.rand(8, 3).astype(np.float64)
+    ya = np.eye(2)[rng.randint(0, 2, 8)]
+    xb = rng.rand(8, 3).astype(np.float64)
+    yb = np.eye(2)[rng.randint(0, 2, 8)]
+
+    # net1: warm the cache on (xa, ya), then train on (xb, yb)
+    net1 = fresh()
+    net1.fit_on_device(xa, ya, steps=3)
+    net1.fit_on_device(xb, yb, steps=3)
+    # net2: same steps but second call also on (xa, ya) — must differ from net1
+    net2 = fresh()
+    net2.fit_on_device(xa, ya, steps=3)
+    net2.fit_on_device(xa, ya, steps=3)
+    assert not np.allclose(np.asarray(net1.params()), np.asarray(net2.params())), \
+        "warm cache ignored the new batch"
