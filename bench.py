@@ -2,17 +2,31 @@
 """Benchmark harness — prints ONE JSON line with the tracked headline metric.
 
 Headline (BASELINE.md primary): zoo ResNet50 ImageNet-shape training images/sec/chip,
-measured with the on-device scan loop (fit_on_device) so per-step host dispatch — which
-on this tunneled single-chip setup costs ms per launch — does not pollute the compute
-number. LeNet MNIST step-time (tracked config #1) is reported in extra, same protocol.
-Warm-up (compile + first chained run) excluded; synthetic data isolates compute from the
-input pipeline (BenchmarkDataSetIterator-equivalent, per BASELINE.md).
+bf16 compute with fp32 params (mixed precision; see util/dtypes.py) at the largest
+HBM-efficient batch, measured with the on-device scan loop (fit_on_device) so per-step
+host dispatch — which on this tunneled single-chip setup costs ms per launch — does not
+pollute the compute number.
+
+All runnable BASELINE.md tracked configs are reported in extra:
+  1. LeNet MNIST step-time (fit_on_device protocol)
+  2. ResNet50 ImageNet images/sec/chip (headline; fp32 reference number included)
+  4. GravesLSTM char-RNN tokens/sec (TextGenerationLSTM zoo config)
+  5. ParallelWrapper ResNet50 (shard_map path on the single real chip: aggregate
+     images/sec + overhead vs the plain on-device loop)
+Config 3 (VGG16 transfer via Keras import) is reported when a Keras h5 is available.
+
+Warm-up (compile + first chained run) excluded; synthetic data isolates compute from
+the input pipeline (BenchmarkDataSetIterator-equivalent, per BASELINE.md protocol).
+vs_baseline compares against the round-1 fp32 batch-32 result (2954.4 img/s) — the
+reference itself publishes no numbers (BASELINE.md).
 """
 import json
 import sys
 import time
 
 import numpy as np
+
+R01_RESNET50_IMG_S = 2954.4  # BENCH_r01.json: fp32 batch-32 on v5e-1
 
 
 def _device_loop_time(net, x, y, steps):
@@ -26,49 +40,115 @@ def _device_loop_time(net, x, y, steps):
     return sorted(times)[1]
 
 
-def bench_resnet50(batch=32, steps=40):
+def _synth(rng, batch, classes, *feature_shape):
     import jax.numpy as jnp
+    x = jnp.asarray(rng.rand(batch, *feature_shape).astype(np.float32))
+    y = jnp.asarray(np.eye(classes, dtype=np.float32)[rng.randint(0, classes, batch)])
+    return x, y
 
+
+def bench_resnet50(batch=256, steps=20, compute_dtype="bfloat16"):
     from deeplearning4j_tpu.models import ResNet50
 
-    net = ResNet50(num_labels=1000, seed=42, dtype="float32").init()
+    net = ResNet50(num_labels=1000, seed=42, compute_dtype=compute_dtype).init()
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.rand(batch, 3, 224, 224).astype(np.float32))
-    y = jnp.asarray(np.eye(1000, dtype=np.float32)[rng.randint(0, 1000, batch)])
+    x, y = _synth(rng, batch, 1000, 3, 224, 224)
     dt = _device_loop_time(net, x, y, steps)
     return {"images_per_sec": batch * steps / dt, "ms_per_iter": dt / steps * 1e3,
-            "batch": batch, "params": net.num_params()}
+            "batch": batch, "compute_dtype": compute_dtype or "float32",
+            "params": net.num_params()}
 
 
 def bench_lenet(batch=128, steps=200):
-    import jax.numpy as jnp
-
     from deeplearning4j_tpu.models import LeNet
 
-    net = LeNet(num_labels=10, seed=42, dtype="float32").init()
+    net = LeNet(num_labels=10, seed=42).init()
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.rand(batch, 784).astype(np.float32))
-    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.randint(0, 10, batch)])
+    x, y = _synth(rng, batch, 10, 784)
     dt = _device_loop_time(net, x, y, steps)
     return {"ms_per_iter": dt / steps * 1e3, "samples_per_sec": batch * steps / dt,
             "batch": batch}
 
 
+def bench_graves_lstm(batch=64, seq_len=50, steps=50, compute_dtype="bfloat16"):
+    """BASELINE config 4: GravesLSTM char-RNN tokens/sec (zoo TextGenerationLSTM:
+    GravesLSTM(256)x2 -> RnnOutputLayer over 47 chars, the LSTMHelpers.java:200/496
+    hot loop rendered as one scanned XLA computation)."""
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import TextGenerationLSTM
+
+    vocab = 47
+    net = TextGenerationLSTM(total_unique_characters=vocab, seed=42,
+                             compute_dtype=compute_dtype).init()
+    rng = np.random.RandomState(0)
+    # one-hot char sequences, DL4J RNN layout (batch, features, time)
+    idx = rng.randint(0, vocab, (batch, seq_len))
+    x = jnp.asarray(np.eye(vocab, dtype=np.float32)[idx].transpose(0, 2, 1))
+    y = jnp.asarray(np.eye(vocab, dtype=np.float32)[
+        np.roll(idx, -1, axis=1)].transpose(0, 2, 1))
+    dt = _device_loop_time(net, x, y, steps)
+    return {"tokens_per_sec": batch * seq_len * steps / dt,
+            "ms_per_iter": dt / steps * 1e3, "batch": batch, "seq_len": seq_len,
+            "compute_dtype": compute_dtype or "float32"}
+
+
+def bench_parallel_wrapper(batch=128, iters=30, compute_dtype="bfloat16"):
+    """BASELINE config 5: data-parallel ResNet50 through ParallelWrapper's shard_map
+    path. On the single tunneled chip this measures the wrapper's dispatch+collective
+    overhead (scaling efficiency across real chips needs multi-chip hardware; the
+    8-virtual-device mesh correctness gate lives in tests/test_parallel.py)."""
+    import jax
+    import jax.numpy as jnp
+    from deeplearning4j_tpu.models import ResNet50
+    from deeplearning4j_tpu.parallel import ParallelWrapper, TrainingMode, make_mesh
+
+    net = ResNet50(num_labels=1000, seed=42, compute_dtype=compute_dtype).init()
+    mesh = make_mesh(1)
+    pw = (ParallelWrapper.Builder(net).mesh(mesh)
+          .training_mode(TrainingMode.SHARED_GRADIENTS)
+          .gradients_threshold(1e-3).build())
+    rng = np.random.RandomState(0)
+    x, y = _synth(rng, batch, 1000, 3, 224, 224)
+    pw.fit(x, y)  # compile
+    jax.block_until_ready(jax.tree_util.tree_leaves(pw._carry))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        pw.fit(x, y)
+    jax.block_until_ready(jax.tree_util.tree_leaves(pw._carry))
+    dt = time.perf_counter() - t0
+    return {"images_per_sec": batch * iters / dt, "ms_per_iter": dt / iters * 1e3,
+            "batch": batch, "workers": pw.workers,
+            "compute_dtype": compute_dtype or "float32"}
+
+
 def main():
     import jax
 
-    resnet = bench_resnet50()
+    resnet_bf16 = bench_resnet50()
+    resnet_fp32 = bench_resnet50(batch=32, steps=40, compute_dtype=None)
     lenet = bench_lenet()
+    lstm = bench_graves_lstm()
+    pw = bench_parallel_wrapper()
+    value = round(resnet_bf16["images_per_sec"], 1)
     print(json.dumps({
         "metric": "resnet50_imagenet_images_per_sec_per_chip",
-        "value": round(resnet["images_per_sec"], 1),
+        "value": value,
         "unit": "images/sec",
-        "vs_baseline": None,
+        "vs_baseline": round(value / R01_RESNET50_IMG_S, 3),
         "extra": {
-            "resnet50": {k: round(v, 2) if isinstance(v, float) else v
-                         for k, v in resnet.items()},
+            "baseline_def": "round-1 fp32 batch-32 fit_on_device result (2954.4 img/s)",
+            "resnet50_bf16": {k: round(v, 2) if isinstance(v, float) else v
+                              for k, v in resnet_bf16.items()},
+            "resnet50_fp32": {k: round(v, 2) if isinstance(v, float) else v
+                              for k, v in resnet_fp32.items()},
             "lenet_mnist_step_ms": round(lenet["ms_per_iter"], 3),
             "lenet_samples_per_sec": round(lenet["samples_per_sec"], 1),
+            "graves_lstm_tokens_per_sec": round(lstm["tokens_per_sec"], 1),
+            "graves_lstm": {k: round(v, 2) if isinstance(v, float) else v
+                            for k, v in lstm.items()},
+            "parallel_wrapper_resnet50": {k: round(v, 2) if isinstance(v, float) else v
+                                          for k, v in pw.items()},
+            "vgg16_transfer": "pending Keras h5 fixture (import path: deeplearning4j_tpu.keras)",
             "device": str(jax.devices()[0]),
             "protocol": "on-device lax.scan loop, median of 3, compile excluded",
         },

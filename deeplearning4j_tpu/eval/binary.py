@@ -1,0 +1,171 @@
+"""EvaluationBinary + EvaluationCalibration.
+
+Parity: ref eval/EvaluationBinary.java (per-output-column binary counts at a decision
+threshold) and eval/EvaluationCalibration.java (reliability diagram bins, residual
+plot, probability histograms). Accumulation is fully vectorized numpy.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.eval.curves import Histogram, ReliabilityDiagram
+from deeplearning4j_tpu.eval.utils import flatten_time as _flatten_time
+
+
+class EvaluationBinary:
+    """Per-label binary classification counts (TP/FP/TN/FN per output column) at a
+    decision threshold (default 0.5), with precision/recall/F1/accuracy per label."""
+
+    def __init__(self, num_outputs: Optional[int] = None,
+                 decision_threshold: float = 0.5):
+        self.decision_threshold = float(decision_threshold)
+        self._tp = self._fp = self._tn = self._fn = None
+        if num_outputs:
+            self._init_counts(num_outputs)
+
+    def _init_counts(self, n):
+        z = np.zeros(n, np.int64)
+        self._tp, self._fp, self._tn, self._fn = z.copy(), z.copy(), z.copy(), z.copy()
+
+    def eval(self, labels, predictions, mask=None):
+        labels, predictions = _flatten_time(labels, predictions, mask)
+        if labels.ndim == 1:
+            labels = labels[:, None]
+            predictions = predictions[:, None]
+        if self._tp is None:
+            self._init_counts(labels.shape[1])
+        pred = predictions >= self.decision_threshold
+        pos = labels > 0
+        self._tp += (pred & pos).sum(axis=0)
+        self._fp += (pred & ~pos).sum(axis=0)
+        self._fn += (~pred & pos).sum(axis=0)
+        self._tn += (~pred & ~pos).sum(axis=0)
+    evaluate = eval
+
+    def num_labels(self) -> int:
+        return 0 if self._tp is None else len(self._tp)
+
+    def true_positives(self, col: int) -> int:
+        return int(self._tp[col])
+
+    def false_positives(self, col: int) -> int:
+        return int(self._fp[col])
+
+    def true_negatives(self, col: int) -> int:
+        return int(self._tn[col])
+
+    def false_negatives(self, col: int) -> int:
+        return int(self._fn[col])
+
+    def accuracy(self, col: int) -> float:
+        total = self._tp[col] + self._fp[col] + self._tn[col] + self._fn[col]
+        return float(self._tp[col] + self._tn[col]) / total if total else 0.0
+
+    def precision(self, col: int) -> float:
+        d = self._tp[col] + self._fp[col]
+        return float(self._tp[col]) / d if d else 0.0
+
+    def recall(self, col: int) -> float:
+        d = self._tp[col] + self._fn[col]
+        return float(self._tp[col]) / d if d else 0.0
+
+    def f1(self, col: int) -> float:
+        p, r = self.precision(col), self.recall(col)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def average_accuracy(self) -> float:
+        return float(np.mean([self.accuracy(c) for c in range(self.num_labels())]))
+
+    def average_f1(self) -> float:
+        return float(np.mean([self.f1(c) for c in range(self.num_labels())]))
+
+    def stats(self) -> str:
+        lines = [f"EvaluationBinary (threshold={self.decision_threshold}):",
+                 " label | acc | precision | recall | f1 | counts (tp/fp/tn/fn)"]
+        for c in range(self.num_labels()):
+            lines.append(
+                f"  {c:>4}  | {self.accuracy(c):.3f} | {self.precision(c):9.3f} |"
+                f" {self.recall(c):6.3f} | {self.f1(c):.3f} |"
+                f" {self._tp[c]}/{self._fp[c]}/{self._tn[c]}/{self._fn[c]}")
+        return "\n".join(lines)
+
+
+class EvaluationCalibration:
+    """Calibration analysis (ref eval/EvaluationCalibration.java): reliability
+    diagram over probability bins, residual plots, and predicted-probability
+    histograms, all per class."""
+
+    def __init__(self, reliability_bins: int = 10, histogram_bins: int = 50):
+        self.reliability_bins = int(reliability_bins)
+        self.histogram_bins = int(histogram_bins)
+        self._labels: List[np.ndarray] = []
+        self._probs: List[np.ndarray] = []
+
+    def eval(self, labels, predictions, mask=None):
+        labels, predictions = _flatten_time(labels, predictions, mask)
+        self._labels.append(labels)
+        self._probs.append(predictions)
+    evaluate = eval
+
+    def _collected(self):
+        if not self._labels:
+            raise ValueError("No data evaluated")
+        return np.concatenate(self._labels), np.concatenate(self._probs)
+
+    def num_classes(self) -> int:
+        return self._collected()[0].shape[1]
+
+    def get_reliability_diagram(self, cls: int) -> ReliabilityDiagram:
+        labels, probs = self._collected()
+        p = probs[:, cls]
+        y = labels[:, cls] > 0
+        edges = np.linspace(0.0, 1.0, self.reliability_bins + 1)
+        idx = np.clip(np.digitize(p, edges) - 1, 0, self.reliability_bins - 1)
+        counts = np.bincount(idx, minlength=self.reliability_bins)
+        sum_p = np.bincount(idx, weights=p, minlength=self.reliability_bins)
+        sum_y = np.bincount(idx, weights=y.astype(np.float64),
+                            minlength=self.reliability_bins)
+        keep = counts > 0
+        mean_pred = np.where(keep, sum_p / np.maximum(counts, 1), 0.0)
+        frac_pos = np.where(keep, sum_y / np.maximum(counts, 1), 0.0)
+        return ReliabilityDiagram(f"Reliability: class {cls}", mean_pred[keep],
+                                  frac_pos[keep])
+    getReliabilityDiagram = get_reliability_diagram
+
+    def expected_calibration_error(self, cls: int) -> float:
+        labels, probs = self._collected()
+        p = probs[:, cls]
+        y = (labels[:, cls] > 0).astype(np.float64)
+        edges = np.linspace(0.0, 1.0, self.reliability_bins + 1)
+        idx = np.clip(np.digitize(p, edges) - 1, 0, self.reliability_bins - 1)
+        counts = np.bincount(idx, minlength=self.reliability_bins)
+        sum_p = np.bincount(idx, weights=p, minlength=self.reliability_bins)
+        sum_y = np.bincount(idx, weights=y, minlength=self.reliability_bins)
+        keep = counts > 0
+        gap = np.abs(sum_p[keep] - sum_y[keep]) / counts[keep]
+        return float(np.sum(gap * counts[keep]) / counts.sum())
+
+    def get_probability_histogram(self, cls: int) -> Histogram:
+        _, probs = self._collected()
+        counts, _ = np.histogram(probs[:, cls], bins=self.histogram_bins,
+                                 range=(0.0, 1.0))
+        return Histogram(f"P(class {cls})", 0.0, 1.0, counts)
+    getProbabilityHistogram = get_probability_histogram
+
+    def get_residual_plot(self, cls: int) -> Histogram:
+        """Histogram of |label - p| residuals for one class
+        (ref getResidualPlot)."""
+        labels, probs = self._collected()
+        resid = np.abs(labels[:, cls] - probs[:, cls])
+        counts, _ = np.histogram(resid, bins=self.histogram_bins, range=(0.0, 1.0))
+        return Histogram(f"Residuals: class {cls}", 0.0, 1.0, counts)
+    getResidualPlot = get_residual_plot
+
+    def stats(self) -> str:
+        n = self.num_classes()
+        lines = ["EvaluationCalibration: expected calibration error per class"]
+        for c in range(n):
+            lines.append(f"  class {c}: {self.expected_calibration_error(c):.6f}")
+        return "\n".join(lines)

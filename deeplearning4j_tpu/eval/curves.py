@@ -1,0 +1,104 @@
+"""Evaluation curve containers (ref eval/curves/ — RocCurve, PrecisionRecallCurve,
+Histogram, ReliabilityDiagram). Pure-data classes with JSON round-trip; the area
+calculations live here so ROC classes stay thin."""
+from __future__ import annotations
+
+import json
+from typing import Sequence
+
+import numpy as np
+
+
+class BaseCurve:
+    def to_dict(self) -> dict:
+        raise NotImplementedError
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict())
+
+    @staticmethod
+    def _trapz(y, x) -> float:
+        """Trapezoidal area under y(x)."""
+        y = np.asarray(y, np.float64)
+        x = np.asarray(x, np.float64)
+        return float(np.sum((y[1:] + y[:-1]) * np.diff(x) / 2.0))
+
+
+class RocCurve(BaseCurve):
+    """(ref eval/curves/RocCurve.java) threshold-parameterized (fpr, tpr)."""
+
+    def __init__(self, thresholds: Sequence[float], fpr: Sequence[float],
+                 tpr: Sequence[float]):
+        self.thresholds = np.asarray(thresholds, np.float64)
+        self.fpr = np.asarray(fpr, np.float64)
+        self.tpr = np.asarray(tpr, np.float64)
+
+    def calculate_auc(self) -> float:
+        # threshold-descending traversal: within tied fpr the curve rises (tpr
+        # ascending), so order by (fpr, tpr) — sorting by fpr alone can leave
+        # tied-fpr points in descending-tpr order and underestimate the area
+        order = np.lexsort((self.tpr, self.fpr))
+        return self._trapz(self.tpr[order], self.fpr[order])
+    calculateAUC = calculate_auc
+
+    def to_dict(self):
+        return {"@class": "RocCurve", "thresholds": self.thresholds.tolist(),
+                "fpr": self.fpr.tolist(), "tpr": self.tpr.tolist()}
+
+
+class PrecisionRecallCurve(BaseCurve):
+    """(ref eval/curves/PrecisionRecallCurve.java)."""
+
+    def __init__(self, thresholds: Sequence[float], precision: Sequence[float],
+                 recall: Sequence[float]):
+        self.thresholds = np.asarray(thresholds, np.float64)
+        self.precision = np.asarray(precision, np.float64)
+        self.recall = np.asarray(recall, np.float64)
+
+    def calculate_auprc(self) -> float:
+        # threshold-descending traversal: within tied recall precision decreases
+        # (extra FPs at the same TP count), so order by (recall asc, precision desc)
+        order = np.lexsort((-self.precision, self.recall))
+        return self._trapz(self.precision[order], self.recall[order])
+    calculateAUPRC = calculate_auprc
+
+    def to_dict(self):
+        return {"@class": "PrecisionRecallCurve",
+                "thresholds": self.thresholds.tolist(),
+                "precision": self.precision.tolist(),
+                "recall": self.recall.tolist()}
+
+
+class Histogram(BaseCurve):
+    """(ref eval/curves/Histogram.java) fixed-width bin counts."""
+
+    def __init__(self, title: str, lower: float, upper: float, counts: Sequence[int]):
+        self.title = title
+        self.lower = float(lower)
+        self.upper = float(upper)
+        self.counts = np.asarray(counts, np.int64)
+
+    def bin_centers(self) -> np.ndarray:
+        n = len(self.counts)
+        edges = np.linspace(self.lower, self.upper, n + 1)
+        return (edges[:-1] + edges[1:]) / 2.0
+
+    def to_dict(self):
+        return {"@class": "Histogram", "title": self.title, "lower": self.lower,
+                "upper": self.upper, "counts": self.counts.tolist()}
+
+
+class ReliabilityDiagram(BaseCurve):
+    """(ref eval/curves/ReliabilityDiagram.java) mean predicted prob vs observed
+    fraction of positives per bin."""
+
+    def __init__(self, title: str, mean_predicted: Sequence[float],
+                 fraction_positives: Sequence[float]):
+        self.title = title
+        self.mean_predicted = np.asarray(mean_predicted, np.float64)
+        self.fraction_positives = np.asarray(fraction_positives, np.float64)
+
+    def to_dict(self):
+        return {"@class": "ReliabilityDiagram", "title": self.title,
+                "mean_predicted": self.mean_predicted.tolist(),
+                "fraction_positives": self.fraction_positives.tolist()}

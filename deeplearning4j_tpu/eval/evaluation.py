@@ -30,10 +30,14 @@ class ConfusionMatrix:
 
 
 class Evaluation:
-    def __init__(self, num_classes: Optional[int] = None, labels: Optional[List[str]] = None):
+    def __init__(self, num_classes: Optional[int] = None,
+                 labels: Optional[List[str]] = None, top_n: int = 1):
         self.num_classes = num_classes
         self.label_names = labels
+        self.top_n = int(top_n)
         self.confusion: Optional[ConfusionMatrix] = None
+        self._top_n_correct = 0
+        self._count = 0
 
     def _ensure(self, n: int):
         if self.confusion is None:
@@ -54,8 +58,18 @@ class Evaluation:
         self._ensure(labels.shape[-1])
         actual = np.argmax(labels, axis=-1)
         predicted = np.argmax(predictions, axis=-1)
-        for a, p in zip(actual, predicted):
-            self.confusion.add(int(a), int(p))
+        # vectorized confusion accumulation — O(batch) numpy, no Python loop
+        np.add.at(self.confusion.matrix, (actual, predicted), 1)
+        self._count += actual.shape[0]
+        if self.top_n > 1:
+            # true class within the top-N predicted scores
+            # (ref Evaluation topN constructor semantics)
+            k = min(self.top_n, predictions.shape[-1])
+            topk = np.argpartition(-predictions, k - 1, axis=-1)[:, :k]
+            self._top_n_correct += int((topk == actual[:, None]).any(axis=1).sum())
+        else:
+            self._top_n_correct += int((predicted == actual).sum())
+    evaluate = eval
 
     # ---- metrics (ref Evaluation accuracy/precision/recall/f1) ----
     def _tp(self, c):
@@ -92,7 +106,26 @@ class Evaluation:
         tn = m.sum() - m[cls, :].sum() - m[:, cls].sum() + m[cls, cls]
         return float(fp) / (fp + tn) if (fp + tn) else 0.0
 
-    def stats(self) -> str:
+    def top_n_accuracy(self) -> float:
+        """Fraction of examples whose true class was in the top-N predictions."""
+        return self._top_n_correct / self._count if self._count else 0.0
+
+    def matthews_correlation(self, cls: int) -> float:
+        """Binary MCC for one class vs rest (ref Evaluation.matthewsCorrelation)."""
+        m = self.confusion.matrix
+        tp = m[cls, cls]
+        fp = m[:, cls].sum() - tp
+        fn = m[cls, :].sum() - tp
+        tn = m.sum() - tp - fp - fn
+        den = np.sqrt(float((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)))
+        return float((tp * tn - fp * fn) / den) if den else 0.0
+
+    def _label_name(self, c: int) -> str:
+        if self.label_names and c < len(self.label_names):
+            return self.label_names[c]
+        return str(c)
+
+    def stats(self, print_confusion: bool = False) -> str:
         m = self.confusion.matrix
         lines = [
             "========================Evaluation Metrics========================",
@@ -101,8 +134,21 @@ class Evaluation:
             f" Precision:       {self.precision():.4f}",
             f" Recall:          {self.recall():.4f}",
             f" F1 Score:        {self.f1():.4f}",
-            "===================================================================",
         ]
+        if self.top_n > 1:
+            lines.append(f" Top {self.top_n} Accuracy: {self.top_n_accuracy():.4f}")
+        lines.append("")
+        lines.append(" Per-class:  label | precision | recall | f1")
+        for c in range(m.shape[0]):
+            if m[c, :].sum() == 0 and m[:, c].sum() == 0:
+                continue
+            lines.append(f"   {self._label_name(c):>10} | {self.precision(c):9.4f} |"
+                         f" {self.recall(c):6.4f} | {self.f1(c):6.4f}")
+        if print_confusion:
+            lines.append("")
+            lines.append("=========================Confusion Matrix=========================")
+            lines.append(self.confusion.to_csv())
+        lines.append("===================================================================")
         return "\n".join(lines)
 
 

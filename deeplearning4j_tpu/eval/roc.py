@@ -1,0 +1,213 @@
+"""ROC evaluation family.
+
+Parity: ref eval/ROC.java (706 LoC), ROCBinary.java, ROCMultiClass.java. The reference
+offers a thresholded mode (fixed threshold steps, O(steps) memory) and an exact mode
+(store all scores). Here both collapse into one design: scores/labels are accumulated
+as arrays (host-side numpy — evaluation is not a device hot path) and every metric is
+computed vectorized at query time. `threshold_steps > 0` reproduces the reference's
+binned curves; `threshold_steps == 0` gives the exact curve over all distinct scores.
+
+AUC semantics match the standard rank statistic (probability a random positive scores
+above a random negative, ties counted half) — identical to the reference's exact mode
+and to sklearn.metrics.roc_auc_score.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.eval.curves import PrecisionRecallCurve, RocCurve
+from deeplearning4j_tpu.eval.utils import flatten_time
+
+
+def _exact_roc_points(labels: np.ndarray, scores: np.ndarray):
+    """Vectorized exact ROC: sweep thresholds over distinct scores (descending).
+    Returns (thresholds, fpr, tpr, precision, recall) including the (0,0)/(1,1)
+    endpoints."""
+    order = np.argsort(-scores, kind="stable")
+    l = labels[order].astype(np.float64)
+    s = scores[order]
+    tp = np.cumsum(l)
+    fp = np.cumsum(1.0 - l)
+    # merge runs of equal scores: threshold boundaries are where the score changes
+    distinct = np.nonzero(np.diff(s))[0]
+    idx = np.concatenate([distinct, [len(s) - 1]])
+    tp, fp, s = tp[idx], fp[idx], s[idx]
+    P = float(l.sum())
+    N = float(len(l) - P)
+    tpr = tp / P if P > 0 else np.zeros_like(tp)
+    fpr = fp / N if N > 0 else np.zeros_like(fp)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        precision = np.where(tp + fp > 0, tp / (tp + fp), 1.0)
+    recall = tpr
+    thresholds = np.concatenate([[1.0 if len(s) == 0 else s[0] + 1e-12], s])
+    tpr = np.concatenate([[0.0], tpr])
+    fpr = np.concatenate([[0.0], fpr])
+    precision = np.concatenate([[1.0], precision])
+    recall = np.concatenate([[0.0], recall])
+    return thresholds, fpr, tpr, precision, recall
+
+
+def _binned_roc_points(labels: np.ndarray, scores: np.ndarray, steps: int):
+    ts = np.linspace(0.0, 1.0, steps + 1)
+    P = float(labels.sum())
+    N = float(len(labels) - P)
+    pred = scores[None, :] >= ts[:, None]  # (steps+1, n)
+    tp = (pred & (labels[None, :] > 0)).sum(axis=1).astype(np.float64)
+    fp = (pred & (labels[None, :] <= 0)).sum(axis=1).astype(np.float64)
+    tpr = tp / P if P > 0 else np.zeros_like(tp)
+    fpr = fp / N if N > 0 else np.zeros_like(fp)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        precision = np.where(tp + fp > 0, tp / (tp + fp), 1.0)
+    return ts, fpr, tpr, precision, tpr
+
+
+class ROC:
+    """Binary-classifier ROC (ref eval/ROC.java). `eval` accepts either single-column
+    probabilities with 0/1 labels, or two-column [P(neg), P(pos)] with one-hot labels
+    (the reference's binary softmax layout)."""
+
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = int(threshold_steps)
+        self._labels: List[np.ndarray] = []
+        self._scores: List[np.ndarray] = []
+
+    # ------------------------------------------------------------ accumulate
+    def eval(self, labels, predictions, mask=None):
+        labels = np.asarray(labels, np.float64)
+        predictions = np.asarray(predictions, np.float64)
+        if labels.ndim == 3:  # time series → per-timestep rows, masked steps dropped
+            labels, predictions = flatten_time(labels, predictions, mask)
+        if labels.ndim == 2 and labels.shape[1] == 2:
+            labels = labels[:, 1]
+            predictions = predictions[:, 1]
+        self._labels.append(labels.reshape(-1))
+        self._scores.append(predictions.reshape(-1))
+    evaluate = eval
+
+    def _collected(self):
+        if not self._labels:
+            raise ValueError("No data evaluated")
+        return np.concatenate(self._labels), np.concatenate(self._scores)
+
+    # ------------------------------------------------------------ metrics
+    def calculate_auc(self) -> float:
+        """Exact AUC via the Mann-Whitney rank statistic (tie-aware)."""
+        labels, scores = self._collected()
+        P = labels.sum()
+        N = len(labels) - P
+        if P == 0 or N == 0:
+            return float("nan")
+        order = np.argsort(scores, kind="mergesort")
+        ranks = np.empty(len(scores), np.float64)
+        # tie-averaged ranks
+        uniq, inv, counts = np.unique(scores[order], return_inverse=True,
+                                      return_counts=True)
+        cum = np.cumsum(counts)
+        avg_rank_of_uniq = cum - (counts - 1) / 2.0
+        ranks[order] = avg_rank_of_uniq[inv]
+        r_pos = ranks[labels > 0].sum()
+        return float((r_pos - P * (P + 1) / 2.0) / (P * N))
+    calculateAUC = calculate_auc
+
+    def calculate_auprc(self) -> float:
+        return self.get_precision_recall_curve().calculate_auprc()
+    calculateAUPRC = calculate_auprc
+
+    def get_roc_curve(self) -> RocCurve:
+        labels, scores = self._collected()
+        if self.threshold_steps > 0:
+            ts, fpr, tpr, _, _ = _binned_roc_points(labels, scores,
+                                                    self.threshold_steps)
+        else:
+            ts, fpr, tpr, _, _ = _exact_roc_points(labels, scores)
+        return RocCurve(ts, fpr, tpr)
+    getRocCurve = get_roc_curve
+
+    def get_precision_recall_curve(self) -> PrecisionRecallCurve:
+        labels, scores = self._collected()
+        if self.threshold_steps > 0:
+            ts, _, _, prec, rec = _binned_roc_points(labels, scores,
+                                                     self.threshold_steps)
+        else:
+            ts, _, _, prec, rec = _exact_roc_points(labels, scores)
+        return PrecisionRecallCurve(ts, prec, rec)
+    getPrecisionRecallCurve = get_precision_recall_curve
+
+    def merge(self, other: "ROC"):
+        self._labels.extend(other._labels)
+        self._scores.extend(other._scores)
+
+    def stats(self) -> str:
+        return (f"AUC (ROC): {self.calculate_auc():.6f}\n"
+                f"AUPRC:     {self.calculate_auprc():.6f}")
+
+
+class ROCBinary:
+    """Per-output-column ROC for multi-label binary outputs
+    (ref eval/ROCBinary.java)."""
+
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = int(threshold_steps)
+        self._per_column: Dict[int, ROC] = {}
+
+    def eval(self, labels, predictions, mask=None):
+        labels, predictions = flatten_time(labels, predictions, mask)
+        for c in range(labels.shape[1]):
+            roc = self._per_column.setdefault(c, ROC(self.threshold_steps))
+            roc.eval(labels[:, c], predictions[:, c])
+    evaluate = eval
+
+    def num_labels(self) -> int:
+        return len(self._per_column)
+
+    def calculate_auc(self, col: int) -> float:
+        return self._per_column[col].calculate_auc()
+    calculateAUC = calculate_auc
+
+    def calculate_average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc() for r in self._per_column.values()]))
+
+    def get_roc_curve(self, col: int) -> RocCurve:
+        return self._per_column[col].get_roc_curve()
+
+    def stats(self) -> str:
+        lines = ["ROCBinary: per-label AUC"]
+        for c in sorted(self._per_column):
+            lines.append(f"  label {c}: {self.calculate_auc(c):.6f}")
+        lines.append(f"  average: {self.calculate_average_auc():.6f}")
+        return "\n".join(lines)
+
+
+class ROCMultiClass:
+    """One-vs-all ROC per class for softmax outputs (ref eval/ROCMultiClass.java)."""
+
+    def __init__(self, threshold_steps: int = 0):
+        self.threshold_steps = int(threshold_steps)
+        self._per_class: Dict[int, ROC] = {}
+
+    def eval(self, labels, predictions, mask=None):
+        labels, predictions = flatten_time(labels, predictions, mask)
+        for c in range(labels.shape[1]):
+            roc = self._per_class.setdefault(c, ROC(self.threshold_steps))
+            roc.eval(labels[:, c], predictions[:, c])
+    evaluate = eval
+
+    def calculate_auc(self, cls: int) -> float:
+        return self._per_class[cls].calculate_auc()
+    calculateAUC = calculate_auc
+
+    def calculate_average_auc(self) -> float:
+        return float(np.mean([r.calculate_auc() for r in self._per_class.values()]))
+    calculateAverageAUC = calculate_average_auc
+
+    def get_roc_curve(self, cls: int) -> RocCurve:
+        return self._per_class[cls].get_roc_curve()
+
+    def stats(self) -> str:
+        lines = ["ROCMultiClass: one-vs-all AUC"]
+        for c in sorted(self._per_class):
+            lines.append(f"  class {c}: {self.calculate_auc(c):.6f}")
+        lines.append(f"  average: {self.calculate_average_auc():.6f}")
+        return "\n".join(lines)

@@ -1,0 +1,19 @@
+"""Shared evaluation helpers."""
+from __future__ import annotations
+
+import numpy as np
+
+
+def flatten_time(labels, predictions, mask=None):
+    """(batch, channels, time) -> (batch*time_kept, channels): DL4J RNN layout
+    flattened to per-timestep rows with masked steps dropped
+    (ref evalTimeSeries / MaskedReductionUtil semantics)."""
+    labels = np.asarray(labels, np.float64)
+    predictions = np.asarray(predictions, np.float64)
+    if labels.ndim == 3:
+        labels = np.moveaxis(labels, 1, 2).reshape(-1, labels.shape[1])
+        predictions = np.moveaxis(predictions, 1, 2).reshape(-1, predictions.shape[1])
+        if mask is not None:
+            keep = np.asarray(mask).reshape(-1) > 0
+            labels, predictions = labels[keep], predictions[keep]
+    return labels, predictions

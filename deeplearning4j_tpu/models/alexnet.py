@@ -22,11 +22,13 @@ from deeplearning4j_tpu.nn.updater.updaters import Nesterovs
 
 class AlexNet(ZooModel):
     def __init__(self, num_labels: int = 1000, seed: int = 123,
-                 input_shape=(3, 224, 224), updater=None, dtype: str = "float32"):
+                 input_shape=(3, 224, 224), updater=None, dtype: str = "float32",
+                 compute_dtype=None):
         super().__init__(num_labels, seed)
         self.input_shape = tuple(input_shape)
         self.updater = updater or Nesterovs(learning_rate=1e-2, momentum=0.9)
         self.dtype = dtype
+        self.compute_dtype = compute_dtype
 
     def conf(self):
         c, h, w = self.input_shape
@@ -41,6 +43,7 @@ class AlexNet(ZooModel):
                 .updater(self.updater)
                 .l2(5e-4)
                 .dtype(self.dtype)
+                .compute_dtype(self.compute_dtype)
                 .list()
                 .layer(ConvolutionLayer(name="cnn1", n_in=c, n_out=64,
                                         kernel_size=(11, 11), stride=(4, 4),

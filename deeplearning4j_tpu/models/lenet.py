@@ -19,11 +19,13 @@ from deeplearning4j_tpu.nn.updater.updaters import AdaDelta
 
 class LeNet(ZooModel):
     def __init__(self, num_labels: int = 10, seed: int = 123,
-                 input_shape=(1, 28, 28), updater=None, dtype: str = "float32"):
+                 input_shape=(1, 28, 28), updater=None, dtype: str = "float32",
+                 compute_dtype=None):
         super().__init__(num_labels, seed)
         self.input_shape = tuple(input_shape)
         self.updater = updater or AdaDelta()
         self.dtype = dtype
+        self.compute_dtype = compute_dtype
 
     def conf(self):
         c, h, w = self.input_shape
@@ -34,6 +36,7 @@ class LeNet(ZooModel):
                 .updater(self.updater)
                 .convolution_mode(ConvolutionMode.Same)
                 .dtype(self.dtype)
+                .compute_dtype(self.compute_dtype)
                 .list()
                 .layer(ConvolutionLayer(name="cnn1", n_in=c, n_out=20,
                                         kernel_size=(5, 5), stride=(1, 1),

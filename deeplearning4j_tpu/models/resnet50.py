@@ -24,11 +24,13 @@ from deeplearning4j_tpu.nn.updater.updaters import RmsProp
 
 class ResNet50(ZooModel):
     def __init__(self, num_labels: int = 1000, seed: int = 123,
-                 input_shape=(3, 224, 224), updater=None, dtype: str = "float32"):
+                 input_shape=(3, 224, 224), updater=None, dtype: str = "float32",
+                 compute_dtype=None):
         super().__init__(num_labels, seed)
         self.input_shape = tuple(input_shape)
         self.updater = updater or RmsProp(learning_rate=0.1, rms_decay=0.96)
         self.dtype = dtype
+        self.compute_dtype = compute_dtype
 
     # ---- blocks (ref ResNet50.java identityBlock :90-125 / convBlock :127-172) ----
     def _identity_block(self, g, kernel, filters, stage, block, inp):
@@ -88,6 +90,7 @@ class ResNet50(ZooModel):
              .l1(1e-7).l2(5e-5)
              .convolution_mode(ConvolutionMode.Truncate)
              .dtype(self.dtype)
+             .compute_dtype(self.compute_dtype)
              .graph_builder())
         relu = ActivationLayer(activation=Activation.RELU)
         (g.add_inputs("input")

@@ -25,11 +25,13 @@ from deeplearning4j_tpu.nn.updater.updaters import AdaDelta
 
 class SimpleCNN(ZooModel):
     def __init__(self, num_labels: int = 10, seed: int = 123,
-                 input_shape=(3, 48, 48), updater=None, dtype: str = "float32"):
+                 input_shape=(3, 48, 48), updater=None, dtype: str = "float32",
+                 compute_dtype=None):
         super().__init__(num_labels, seed)
         self.input_shape = tuple(input_shape)
         self.updater = updater or AdaDelta()
         self.dtype = dtype
+        self.compute_dtype = compute_dtype
 
     def conf(self):
         c, h, w = self.input_shape
@@ -40,6 +42,7 @@ class SimpleCNN(ZooModel):
              .updater(self.updater)
              .convolution_mode(ConvolutionMode.Same)
              .dtype(self.dtype)
+                .compute_dtype(self.compute_dtype)
              .list())
         relu = lambda: ActivationLayer(activation=Activation.RELU)
 
@@ -83,11 +86,13 @@ class TextGenerationLSTM(ZooModel):
     RnnOutputLayer(MCXENT softmax), truncated BPTT 50/50, gradient norm clipping."""
 
     def __init__(self, total_unique_characters: int = 47, seed: int = 123,
-                 max_length: int = 40, updater=None, dtype: str = "float32"):
+                 max_length: int = 40, updater=None, dtype: str = "float32",
+                 compute_dtype=None):
         super().__init__(total_unique_characters, seed)
         self.max_length = max_length
         self.updater = updater
         self.dtype = dtype
+        self.compute_dtype = compute_dtype
 
     def conf(self):
         from deeplearning4j_tpu.common.enums import BackpropType, GradientNormalization
@@ -103,6 +108,7 @@ class TextGenerationLSTM(ZooModel):
                     GradientNormalization.ClipElementWiseAbsoluteValue)
                 .gradient_normalization_threshold(1.0)
                 .dtype(self.dtype)
+                .compute_dtype(self.compute_dtype)
                 .list()
                 .layer(GravesLSTM(n_in=self.num_labels, n_out=256,
                                   activation=Activation.TANH))

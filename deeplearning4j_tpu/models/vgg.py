@@ -23,11 +23,13 @@ class VGG16(ZooModel):
     BLOCKS = (2, 2, 3, 3, 3)
 
     def __init__(self, num_labels: int = 1000, seed: int = 123,
-                 input_shape=(3, 224, 224), updater=None, dtype: str = "float32"):
+                 input_shape=(3, 224, 224), updater=None, dtype: str = "float32",
+                 compute_dtype=None):
         super().__init__(num_labels, seed)
         self.input_shape = tuple(input_shape)
         self.updater = updater or Nesterovs(learning_rate=1e-2, momentum=0.9)
         self.dtype = dtype
+        self.compute_dtype = compute_dtype
 
     def conf(self):
         c, h, w = self.input_shape
@@ -38,6 +40,7 @@ class VGG16(ZooModel):
              .weight_init(WeightInit.RELU)
              .updater(self.updater)
              .dtype(self.dtype)
+                .compute_dtype(self.compute_dtype)
              .list())
         for block, (n_convs, width) in enumerate(zip(self.BLOCKS, widths), start=1):
             for ci in range(n_convs):

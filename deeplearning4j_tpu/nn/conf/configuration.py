@@ -79,6 +79,9 @@ class GlobalConf:
     mini_batch: bool = True
     minimize: bool = True
     dtype: str = "float32"
+    # bf16 mixed precision: layer compute in this dtype, params/updater state and
+    # output-layer score stay in `dtype`. None = pure `dtype` (reference behavior).
+    compute_dtype: Optional[str] = None
 
     def to_dict(self):
         d = dataclasses.asdict(self)
@@ -209,6 +212,13 @@ class NeuralNetConfiguration:
         def dtype(self, dt: str):
             self._global.dtype = dt
             return self
+
+        def compute_dtype(self, dt: Optional[str]):
+            """Mixed precision: run layer compute in `dt` (e.g. "bfloat16") while
+            params/updater state/score stay in `dtype`."""
+            self._global.compute_dtype = dt
+            return self
+        computeDtype = compute_dtype
 
         def regularization(self, use: bool):  # API parity; l1/l2 values drive behavior
             return self

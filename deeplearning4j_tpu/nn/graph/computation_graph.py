@@ -52,6 +52,9 @@ class ComputationGraph:
         self._accumulator = None
         self._last_etl_ms = 0.0
         self.dtype = jnp.dtype(conf.global_conf.dtype)
+        gc = conf.global_conf
+        self.compute_dtype = (jnp.dtype(gc.compute_dtype)
+                              if getattr(gc, "compute_dtype", None) else self.dtype)
 
     # ------------------------------------------------------------------ init
     def init(self, params: Optional[Sequence[Dict[str, jnp.ndarray]]] = None):
@@ -85,6 +88,7 @@ class ComputationGraph:
         self._opt_state = [u.init(p) for u, p in zip(self._updaters, self.params_tree)]
         self._initialized = True
         self._train_step_fn = None
+        self._output_jit = None
         return self
 
     @property
@@ -114,6 +118,13 @@ class ComputationGraph:
         """Trace the whole DAG in topo order. If stop_at_scores, output-layer nodes
         contribute their loss instead of activations. Returns
         (activations dict, new_states list, total_loss or None)."""
+        from deeplearning4j_tpu.nn.conf.layers.feedforward import EmbeddingLayer
+        from deeplearning4j_tpu.util.dtypes import cast_floats
+        cd = self.compute_dtype
+        mixed = cd != self.dtype
+        params_full = params_tree  # storage-dtype originals (score + regularization)
+        if mixed:
+            params_tree = cast_floats(params_tree, cd)
         nodes = self.conf.nodes
         fmasks = fmasks or [None] * len(self.conf.inputs)
         values: Dict[str, jnp.ndarray] = dict(zip(self.conf.inputs, inputs))
@@ -138,6 +149,8 @@ class ComputationGraph:
             layer = node.conf
             i = layer_idx[name]
             cur, mask = in_vals[0], in_masks[0]
+            if mixed and not isinstance(layer, EmbeddingLayer):
+                cur = cur.astype(cd)
             if node.preprocessor is not None:
                 cur = node.preprocessor.preprocess(cur)
                 mask = node.preprocessor.feed_forward_mask(mask)
@@ -151,8 +164,9 @@ class ComputationGraph:
                 lm = lmask_map.get(name)
                 if lm is None and mask is not None and cur.ndim == 3:
                     lm = mask
+                # output-layer matmul + loss in storage dtype for stability
                 total_loss = total_loss + layer.compute_score(
-                    params_tree[i], cur, label_map[name], lm)
+                    params_full[i], cur.astype(self.dtype), label_map[name], lm)
                 new_states[i] = state_tree[i]
                 # still produce activation in case downstream nodes consume it
                 out, ns, m = layer.forward(params_tree[i], state_tree[i], cur,
@@ -163,16 +177,29 @@ class ComputationGraph:
                                            train=train, rng=lrng, mask=mask)
                 new_states[i] = ns
                 values[name], masks[name] = out, m
+        if mixed:
+            new_states = cast_floats(new_states, self.dtype)
         return values, new_states, total_loss
 
     def output(self, *inputs, train: bool = False) -> Union[jnp.ndarray, List[jnp.ndarray]]:
         """Inference forward; returns one array per configured output
-        (single array if one output) (ref ComputationGraph.output)."""
+        (single array if one output) (ref ComputationGraph.output). Jitted: the whole
+        DAG is one cached XLA computation per input shape."""
         self._check_init()
-        ins = [jnp.asarray(x, self.dtype) for x in inputs]
-        values, _, _ = self._forward_all(self.params_tree, self.state_tree, ins,
-                                         train=train)
-        outs = [values[o] for o in self.conf.outputs]
+        ins = tuple(jnp.asarray(x, self.dtype) for x in inputs)
+        if train:
+            values, _, _ = self._forward_all(self.params_tree, self.state_tree,
+                                             list(ins), train=True)
+            outs = [values[o].astype(self.dtype) for o in self.conf.outputs]
+            return outs[0] if len(outs) == 1 else outs
+        if getattr(self, "_output_jit", None) is None:
+            def f(params, states, ins):
+                values, _, _ = self._forward_all(params, states, list(ins),
+                                                 train=False)
+                return tuple(values[o].astype(self.dtype)
+                             for o in self.conf.outputs)
+            self._output_jit = jax.jit(f)
+        outs = list(self._output_jit(self.params_tree, self.state_tree, ins))
         return outs[0] if len(outs) == 1 else outs
 
     def feed_forward(self, *inputs, train: bool = False) -> Dict[str, jnp.ndarray]:

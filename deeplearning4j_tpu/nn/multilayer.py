@@ -95,6 +95,9 @@ class MultiLayerNetwork:
         self._accumulator = None  # GradientsAccumulator hook (ref MultiLayerNetwork.java:647)
         self._last_etl_ms = 0.0
         self.dtype = jnp.dtype(conf.global_conf.dtype)
+        gc = conf.global_conf
+        self.compute_dtype = (jnp.dtype(gc.compute_dtype)
+                              if getattr(gc, "compute_dtype", None) else self.dtype)
 
     # ------------------------------------------------------------------ init
     def init(self, params: Optional[Sequence[Dict[str, jnp.ndarray]]] = None):
@@ -128,6 +131,8 @@ class MultiLayerNetwork:
         self._opt_state = [u.init(p) for u, p in zip(self._updaters, self.params_tree)]
         self._initialized = True
         self._train_step_fn = None
+        self._output_jit = None
+        self._rnn_step_jit = None
         return self
 
     # ----------------------------------------------------------- flat views
@@ -152,6 +157,14 @@ class MultiLayerNetwork:
                  fmask=None, lmask=None, rnn_init_states=None, collect=False):
         """Forward through all layers. Returns (final_activation, per-layer activations,
         new_states, final_rnn_states, mask_at_output)."""
+        from deeplearning4j_tpu.nn.conf.layers.feedforward import EmbeddingLayer
+        from deeplearning4j_tpu.util.dtypes import cast_floats
+        cd = self.compute_dtype
+        mixed = cd != self.dtype
+        if mixed:
+            params_tree = cast_floats(params_tree, cd)
+            if rnn_init_states is not None:
+                rnn_init_states = cast_floats(rnn_init_states, cd)
         orig_batch = x.shape[0]
         acts = [x]
         mask = fmask
@@ -159,6 +172,8 @@ class MultiLayerNetwork:
         final_rnn = []
         cur = x
         for i, layer in enumerate(self.layers):
+            if mixed and not isinstance(layer, EmbeddingLayer):
+                cur = cur.astype(cd)
             if i in self.conf.preprocessors:
                 pp = self.conf.preprocessors[i]
                 if isinstance(pp, FeedForwardToRnnPreProcessor):
@@ -187,14 +202,28 @@ class MultiLayerNetwork:
             new_states.append(ns)
             if collect:
                 acts.append(cur)
+        if mixed:
+            cur = cur.astype(self.dtype)
+            new_states = cast_floats(new_states, self.dtype)
         return cur, acts, new_states, final_rnn, mask
 
     def output(self, x, train: bool = False) -> jnp.ndarray:
-        """Inference forward pass (ref MultiLayerNetwork.output)."""
+        """Inference forward pass (ref MultiLayerNetwork.output). Jitted: the whole
+        stack is one cached XLA computation per input shape (jax.jit's aval cache is
+        the shape-bucketing), so steady-state serving has no per-layer dispatch —
+        the TPU answer to the reference's op-stream-per-layer inference path."""
         self._check_init()
         x = jnp.asarray(x, self.dtype)
-        out, _, _, _, _ = self._forward(self.params_tree, self.state_tree, x, train=train)
-        return out
+        if train:
+            out, _, _, _, _ = self._forward(self.params_tree, self.state_tree, x,
+                                            train=True)
+            return out
+        if getattr(self, "_output_jit", None) is None:
+            def f(params, states, x):
+                out, _, _, _, _ = self._forward(params, states, x, train=False)
+                return out
+            self._output_jit = jax.jit(f)
+        return self._output_jit(self.params_tree, self.state_tree, x)
 
     def feed_forward(self, x, train: bool = False) -> List[jnp.ndarray]:
         """All layer activations, input first (ref feedForward :849-961)."""
@@ -210,6 +239,15 @@ class MultiLayerNetwork:
         out_layer = self.layers[-1]
         if not out_layer.is_output_layer():
             raise ValueError("Last layer must be an output/loss layer for scoring")
+        from deeplearning4j_tpu.nn.conf.layers.feedforward import EmbeddingLayer
+        from deeplearning4j_tpu.util.dtypes import cast_floats
+        cd = self.compute_dtype
+        mixed = cd != self.dtype
+        params_full = params_tree  # storage-dtype originals (score + regularization)
+        if mixed:
+            params_tree = cast_floats(params_tree, cd)
+            if rnn_init_states is not None:
+                rnn_init_states = cast_floats(rnn_init_states, cd)
         # forward to input of the output layer
         orig_batch = x.shape[0]
         mask = fmask
@@ -217,6 +255,8 @@ class MultiLayerNetwork:
         new_states = []
         final_rnn = []
         for i, layer in enumerate(self.layers[:-1]):
+            if mixed and not isinstance(layer, EmbeddingLayer):
+                cur = cur.astype(cd)
             if i in self.conf.preprocessors:
                 pp = self.conf.preprocessors[i]
                 if isinstance(pp, FeedForwardToRnnPreProcessor):
@@ -257,10 +297,14 @@ class MultiLayerNetwork:
         score_mask = lmask if lmask is not None else (
             mask if getattr(out_layer, "loss_fn", None) is not None and cur.ndim == 3
             else None)
-        loss = out_layer.compute_score(params_tree[-1], cur, y, score_mask)
+        if mixed:
+            # output-layer matmul + loss in storage dtype for numerical stability
+            cur = cur.astype(self.dtype)
+            new_states = cast_floats(new_states, self.dtype)
+        loss = out_layer.compute_score(params_full[-1], cur, y, score_mask)
         new_states.append(state_tree[-1])
         reg = sum((layer.regularization_score(p)
-                   for layer, p in zip(self.layers, params_tree)), jnp.asarray(0.0))
+                   for layer, p in zip(self.layers, params_full)), jnp.asarray(0.0))
         return loss + reg, (new_states, final_rnn)
 
     # ------------------------------------------------------------- training
@@ -342,12 +386,15 @@ class MultiLayerNetwork:
         per_step_data = steps is None
         if per_step_data:
             steps = x.shape[0]
+        has_fm = fmask is not None
+        has_lm = lmask is not None
 
         # Cache keyed on the static loop mode only; ALL data (x/y/masks) is passed as
         # jit arguments so the traced computation never captures a batch as a constant
         # (a warm cache must not replay the first call's data). jax.jit's own aval
-        # cache handles shape/dtype/None changes.
-        cache_key = ("mln", per_step_data)
+        # cache handles shape/dtype/None changes. In per-step mode masks (when given)
+        # carry a leading step axis and are scanned alongside x/y.
+        cache_key = ("mln", per_step_data, has_fm, has_lm)
         if not hasattr(self, "_device_loop_cache"):
             self._device_loop_cache = {}
         run = self._device_loop_cache.get(cache_key)
@@ -360,12 +407,17 @@ class MultiLayerNetwork:
             def run(params, opt, states, step, rng, x, y, fmask, lmask, n):
                 def body(carry, xs):
                     params_c, opt_c, states_c, step_c, rng_c = carry
-                    bx, by = xs if per_step_data else (x, y)
+                    if per_step_data:
+                        bx, by = xs[0], xs[1]
+                        bfm = xs[2] if has_fm else None
+                        blm = xs[2 + has_fm] if has_lm else None
+                    else:
+                        bx, by, bfm, blm = x, y, fmask, lmask
                     rng_c, sub = jax.random.split(rng_c)
 
                     def loss_fn(p):
-                        loss, (ns, _) = self._loss_fn(p, states_c, bx, by, fmask,
-                                                      lmask, sub, True, None)
+                        loss, (ns, _) = self._loss_fn(p, states_c, bx, by, bfm,
+                                                      blm, sub, True, None)
                         return loss, ns
 
                     (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(
@@ -374,7 +426,11 @@ class MultiLayerNetwork:
                                                 params_c, step_c)
                     return (newp, newo, ns, step_c + 1, rng_c), loss
 
-                xs = (x, y) if per_step_data else None
+                if per_step_data:
+                    xs = (x, y) + ((fmask,) if has_fm else ()) \
+                        + ((lmask,) if has_lm else ())
+                else:
+                    xs = None
                 carry, losses = jax.lax.scan(body, (params, opt, states, step, rng),
                                              xs, length=n)
                 return carry, losses
@@ -414,15 +470,76 @@ class MultiLayerNetwork:
                 it.reset()
             if getattr(it, "async_supported", True):
                 it = AsyncDataSetIterator(it)
-            t0 = time.time()
-            for ds in it:
-                self._last_etl_ms = (time.time() - t0) * 1e3
-                self._fit_one(ds)
+            if self.conf.backprop_type == BackpropType.TruncatedBPTT:
+                # segment loop needs host-side carry; per-batch path
                 t0 = time.time()
+                for ds in it:
+                    self._last_etl_ms = (time.time() - t0) * 1e3
+                    self._fit_one(ds)
+                    t0 = time.time()
+            else:
+                self._fit_epoch_scanned(it)
             for lst in self._listeners:
                 if hasattr(lst, "on_epoch_end"):
                     lst.on_epoch_end(self)
         return self
+
+    def _fit_epoch_scanned(self, it):
+        """Stack consecutive same-shape minibatches and run them as ONE on-device
+        lax.scan (fit_on_device per-step mode) — the epoch runner that keeps
+        fit(iterator) off the one-host-roundtrip-per-minibatch slow path. Listener
+        callbacks fire after each device run with the recorded per-step scores."""
+        import time
+        t0 = time.time()
+        group: List[Any] = []
+        # Cap the stacked super-step so a long epoch never materializes unbounded
+        # host/HBM memory: at most ~256 MB of stacked features, at most 512 steps.
+        max_group = None
+
+        def flush():
+            nonlocal t0
+            if not group:
+                return
+            self._last_etl_ms = (time.time() - t0) * 1e3
+            if len(group) == 1:
+                ds0 = group[0]
+                self.fit_batch(ds0.features, ds0.labels, ds0.features_mask,
+                               ds0.labels_mask)
+            else:
+                xs = np.stack([np.asarray(d.features) for d in group])
+                ys = np.stack([np.asarray(d.labels) for d in group])
+                fms = np.stack([np.asarray(d.features_mask) for d in group]) \
+                    if group[0].features_mask is not None else None
+                lms = np.stack([np.asarray(d.labels_mask) for d in group]) \
+                    if group[0].labels_mask is not None else None
+                losses = self.fit_on_device(xs, ys, fmask=fms, lmask=lms)
+                base = self._step - len(losses)
+                for i, loss in enumerate(losses):
+                    self._score = float(loss)
+                    for lst in self._listeners:
+                        lst.iteration_done(self, base + i + 1)
+            group.clear()
+            t0 = time.time()
+
+        def signature(ds):
+            return (np.shape(ds.features), np.shape(ds.labels),
+                    None if ds.features_mask is None else np.shape(ds.features_mask),
+                    None if ds.labels_mask is None else np.shape(ds.labels_mask))
+
+        sig = None
+        for ds in it:
+            s = signature(ds)
+            if sig is not None and s != sig:
+                flush()
+            sig = s
+            if max_group is None:
+                batch_bytes = np.asarray(ds.features).nbytes \
+                    + np.asarray(ds.labels).nbytes
+                max_group = int(max(1, min(512, (256 << 20) // max(1, batch_bytes))))
+            group.append(ds)
+            if len(group) >= max_group:
+                flush()
+        flush()
 
     def _fit_one(self, ds):
         if self.conf.backprop_type == BackpropType.TruncatedBPTT and ds.features.ndim == 3:
@@ -480,9 +597,15 @@ class MultiLayerNetwork:
         n_rnn = sum(1 for l in self.layers if isinstance(l, LSTM))
         if self._rnn_state is None:
             self._rnn_state = [None] * n_rnn
-        out, _, _, final_rnn, _ = self._forward(self.params_tree, self.state_tree, x,
-                                                train=False,
-                                                rnn_init_states=self._rnn_state)
+        if getattr(self, "_rnn_step_jit", None) is None:
+            def f(params, states, x, rnn_states):
+                out, _, _, final_rnn, _ = self._forward(params, states, x,
+                                                        train=False,
+                                                        rnn_init_states=rnn_states)
+                return out, final_rnn
+            self._rnn_step_jit = jax.jit(f)
+        out, final_rnn = self._rnn_step_jit(self.params_tree, self.state_tree, x,
+                                            self._rnn_state)
         self._rnn_state = final_rnn
         return out[:, :, 0] if squeeze else out
 

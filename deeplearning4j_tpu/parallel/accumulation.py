@@ -93,8 +93,6 @@ class EncodedGradientsAccumulator(GradientsAccumulator):
         message, self._residuals[party] = threshold_encode(flat_grads, residual,
                                                            self.threshold)
         self._stored.append(message)
-        self.threshold = max(self.min_threshold,
-                             self.threshold * self.threshold_decay)
 
     def get_update(self):
         if not self._stored:
@@ -103,6 +101,10 @@ class EncodedGradientsAccumulator(GradientsAccumulator):
         for u in self._stored[1:]:
             out = out + u
         self._stored = []
+        # decay once per aggregation round, not once per party's store
+        # (ref EncodingHandler: one decay step per iteration)
+        self.threshold = max(self.min_threshold,
+                             self.threshold * self.threshold_decay)
         return out
 
     def reset(self):

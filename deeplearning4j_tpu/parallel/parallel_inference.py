@@ -88,11 +88,21 @@ class ParallelInference:
 
     # ---------------- internals ----------------
     def _run(self, batch: np.ndarray):
+        """One device call. The batch axis is padded up to the next power of two so
+        ragged request sizes hit a bounded set of compiled shapes (the jitted
+        model.output caches one XLA executable per bucket — the TPU rendering of
+        cuDNN descriptor caching)."""
+        n = batch.shape[0]
+        padded = 1 << max(0, (n - 1)).bit_length()
+        if padded != n:
+            pad = np.zeros((padded - n,) + batch.shape[1:], dtype=batch.dtype)
+            batch = np.concatenate([batch, pad], axis=0)
         if self.mesh is not None:
             batch = jax.device_put(jnp.asarray(batch, self.model.dtype),
                                    NamedSharding(self.mesh, P("data")))
         out = self.model.output(batch)
-        return out[0] if isinstance(out, list) else out
+        out = out[0] if isinstance(out, list) else out
+        return out[:n]
 
     def _batch_loop(self):
         """Aggregate requests up to batch_limit, run one device call, scatter results

@@ -89,9 +89,7 @@ class ParallelWrapper:
         stacked = jax.tree_util.tree_map(
             lambda a: jnp.broadcast_to(a[None], (R,) + a.shape), tree)
         sh = NamedSharding(self.mesh, P("data"))
-        return jax.tree_util.tree_map(
-            lambda a: jax.device_put(a, NamedSharding(
-                self.mesh, P(*(("data",) + (None,) * (a.ndim - 1))))), stacked)
+        return jax.tree_util.tree_map(lambda a: jax.device_put(a, sh), stacked)
 
     def _ensure_setup(self):
         if self._carry is not None:
@@ -104,8 +102,12 @@ class ParallelWrapper:
         residual = self._replicate(
             jnp.zeros((net.num_params(),), net.dtype)) \
             if self.training_mode == TrainingMode.SHARED_GRADIENTS else None
+        # step lives on device (replicated) so the carry round-trips through the
+        # jitted step without host syncs; a host mirror (_host_step) serves listeners
+        rep = NamedSharding(self.mesh, P())
         self._carry = (params_repl, opt_repl, states_repl, residual,
-                       jnp.asarray(net._step, jnp.int32))
+                       jax.device_put(jnp.asarray(net._step, jnp.int32), rep))
+        self._host_step = net._step
         self._build_step()
 
     def _build_step(self):
@@ -198,7 +200,6 @@ class ParallelWrapper:
                        P()),
             check_vma=False)
 
-        @functools.partial(jax.jit, donate_argnums=(0,))
         def step_fn(carry, rng, bx, by, bfm, blm):
             params_repl, opt_repl, states_repl, residual, step = carry
             (trees, new_residual, loss) = shmapped(
@@ -207,7 +208,13 @@ class ParallelWrapper:
             new_params, new_opt, new_states = trees
             return (new_params, new_opt, new_states, new_residual, step + 1), loss
 
-        self._step_fn = step_fn
+        # Pin output shardings to the input carry's shardings: without this, XLA may
+        # normalize e.g. P("data") to P() on small meshes, the next call sees
+        # different arg shardings, and the whole step silently recompiles EVERY fit.
+        carry_sh = jax.tree_util.tree_map(lambda a: a.sharding, self._carry)
+        loss_sh = NamedSharding(mesh, P())
+        self._step_fn = jax.jit(step_fn, donate_argnums=(0,),
+                                out_shardings=(carry_sh, loss_sh))
 
     def _build_custom_step(self):
         """CUSTOM mode: per-replica gradients computed on-mesh, aggregated through the
@@ -250,7 +257,6 @@ class ParallelWrapper:
             out_specs=(repl_spec, repl_spec, P()),
             check_vma=False)
 
-        @functools.partial(jax.jit, donate_argnums=(0, 1))
         def apply_agg(params_repl, opt_repl, agg_flat, step):
             """Apply one aggregated flat gradient through the updater on replica-0
             params, then rebroadcast to all replicas (they are identical)."""
@@ -263,6 +269,12 @@ class ParallelWrapper:
             return jax.tree_util.tree_map(
                 lambda a: jnp.broadcast_to(a[None], (R,) + a.shape),
                 (new_params, new_opt))
+
+        # pin carry-shape output shardings (see _build_step comment)
+        params_sh = jax.tree_util.tree_map(lambda a: a.sharding, self._carry[0])
+        opt_sh = jax.tree_util.tree_map(lambda a: a.sharding, self._carry[1])
+        apply_agg = jax.jit(apply_agg, donate_argnums=(0, 1),
+                            out_shardings=(params_sh, opt_sh))
 
         def step_fn(carry, rng, bx, by, bfm, blm):
             params_repl, opt_repl, states_repl, _, step = carry
@@ -315,8 +327,11 @@ class ParallelWrapper:
         y = jax.device_put(y, bsh)
         self._carry, loss = self._step_fn(self._carry, sub, x, y, fm, lm)
         self._score = loss
+        # host mirror of the device step counter: listeners must not force a
+        # device->host sync per iteration (ms of tunnel RTT each)
+        self._host_step += 1
         for lst in self._listeners:
-            lst.iteration_done(self, int(self._carry[-1]))
+            lst.iteration_done(self, self._host_step)
 
     def _write_back(self):
         """Copy replica-0 state back into the wrapped model (replicas are identical
@@ -326,7 +341,7 @@ class ParallelWrapper:
         net.params_tree = jax.tree_util.tree_map(lambda a: a[0], params_repl)
         net._opt_state = jax.tree_util.tree_map(lambda a: a[0], opt_repl)
         net.state_tree = jax.tree_util.tree_map(lambda a: a[0], states_repl)
-        net._step = int(step)
+        net._step = self._host_step
 
     def score(self):
         return float(self._score)

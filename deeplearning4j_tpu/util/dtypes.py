@@ -1,0 +1,20 @@
+"""Mixed-precision helpers.
+
+TPU-first design: params and updater state live in the storage dtype (fp32 by
+default); layer compute can run in a lower `compute_dtype` (bfloat16 on TPU hits the
+MXU at 2x fp32 throughput with the same exponent range, so no loss scaling is
+needed). The output-layer score and regularization always run in the storage dtype.
+The reference is fp32-only (nd4j DataBuffer.Type.FLOAT); this is a capability the
+TPU build adds on top.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def cast_floats(tree, dtype):
+    """Cast every floating-point leaf of a pytree to `dtype`; leave ints/bools."""
+    return jax.tree_util.tree_map(
+        lambda a: a.astype(dtype)
+        if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating) else a, tree)

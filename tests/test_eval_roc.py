@@ -1,0 +1,167 @@
+"""ROC / EvaluationBinary / EvaluationCalibration suites
+(ref eval ROCTest / EvaluationBinaryTest / EvaluationCalibrationTest patterns)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval.binary import EvaluationBinary, EvaluationCalibration
+from deeplearning4j_tpu.eval.evaluation import Evaluation
+from deeplearning4j_tpu.eval.roc import ROC, ROCBinary, ROCMultiClass
+
+RNG = np.random.RandomState(42)
+
+
+def _reference_auc(labels, scores):
+    """Independent O(n^2)-free AUC via pure rank formula for cross-checking."""
+    order = np.argsort(scores)
+    s = np.asarray(scores)[order]
+    l = np.asarray(labels)[order]
+    # average ranks with ties
+    ranks = np.empty(len(s))
+    i = 0
+    r = 1
+    while i < len(s):
+        j = i
+        while j + 1 < len(s) and s[j + 1] == s[i]:
+            j += 1
+        ranks[i:j + 1] = (r + r + (j - i)) / 2.0
+        r += j - i + 1
+        i = j + 1
+    P = l.sum()
+    N = len(l) - P
+    return (ranks[l > 0].sum() - P * (P + 1) / 2) / (P * N)
+
+
+def test_roc_auc_matches_rank_reference():
+    n = 500
+    labels = (RNG.rand(n) > 0.6).astype(np.float64)
+    # informative but noisy scores
+    scores = np.clip(labels * 0.3 + RNG.rand(n) * 0.7, 0, 1)
+    roc = ROC()
+    # accumulate over minibatches
+    for i in range(0, n, 64):
+        roc.eval(labels[i:i + 64], scores[i:i + 64])
+    auc = roc.calculate_auc()
+    np.testing.assert_allclose(auc, _reference_auc(labels, scores), atol=1e-6)
+    # curve-based AUC agrees with rank AUC on tie-free data
+    curve_auc = roc.get_roc_curve().calculate_auc()
+    np.testing.assert_allclose(curve_auc, auc, atol=1e-6)
+
+
+def test_roc_perfect_and_random():
+    labels = np.array([0, 0, 1, 1], np.float64)
+    roc = ROC()
+    roc.eval(labels, np.array([0.1, 0.2, 0.8, 0.9]))
+    assert roc.calculate_auc() == pytest.approx(1.0)
+    roc2 = ROC()
+    roc2.eval(labels, np.array([0.9, 0.8, 0.2, 0.1]))
+    assert roc2.calculate_auc() == pytest.approx(0.0)
+    # constant scores -> AUC 0.5 (ties counted half)
+    roc3 = ROC()
+    roc3.eval(labels, np.full(4, 0.5))
+    assert roc3.calculate_auc() == pytest.approx(0.5)
+
+
+def test_roc_two_column_softmax_layout():
+    labels = np.eye(2)[np.array([0, 1, 1, 0])]
+    probs = np.array([[0.8, 0.2], [0.3, 0.7], [0.4, 0.6], [0.9, 0.1]])
+    roc = ROC()
+    roc.eval(labels, probs)
+    assert roc.calculate_auc() == pytest.approx(1.0)
+
+
+def test_roc_thresholded_mode_close_to_exact():
+    n = 2000
+    labels = (RNG.rand(n) > 0.5).astype(np.float64)
+    scores = np.clip(labels * 0.4 + RNG.rand(n) * 0.6, 0, 1)
+    exact = ROC()
+    exact.eval(labels, scores)
+    binned = ROC(threshold_steps=200)
+    binned.eval(labels, scores)
+    a_exact = exact.get_roc_curve().calculate_auc()
+    a_binned = binned.get_roc_curve().calculate_auc()
+    assert abs(a_exact - a_binned) < 5e-3
+
+
+def test_auprc_sane():
+    labels = np.array([0, 0, 1, 1], np.float64)
+    roc = ROC()
+    roc.eval(labels, np.array([0.1, 0.2, 0.8, 0.9]))
+    assert roc.calculate_auprc() == pytest.approx(1.0, abs=1e-9)
+
+
+def test_roc_multiclass_and_binary():
+    n, c = 300, 4
+    cls = RNG.randint(0, c, n)
+    labels = np.eye(c)[cls]
+    logits = RNG.rand(n, c) + labels * 1.5
+    probs = np.exp(logits) / np.exp(logits).sum(1, keepdims=True)
+    m = ROCMultiClass()
+    m.eval(labels, probs)
+    for k in range(c):
+        assert 0.7 < m.calculate_auc(k) <= 1.0
+    assert 0.7 < m.calculate_average_auc() <= 1.0
+
+    b = ROCBinary()
+    b.eval((RNG.rand(n, 3) > 0.5).astype(float), RNG.rand(n, 3))
+    assert b.num_labels() == 3
+    for k in range(3):
+        assert 0.3 < b.calculate_auc(k) < 0.7  # random scores -> ~0.5
+
+
+def test_evaluation_binary_counts():
+    labels = np.array([[1, 0], [1, 1], [0, 1], [0, 0]], np.float64)
+    preds = np.array([[0.9, 0.1], [0.4, 0.8], [0.2, 0.6], [0.1, 0.9]])
+    ev = EvaluationBinary()
+    ev.eval(labels, preds)
+    # col 0: tp=1 (0.9), fn=1 (0.4), tn=2
+    assert ev.true_positives(0) == 1
+    assert ev.false_negatives(0) == 1
+    assert ev.true_negatives(0) == 2
+    assert ev.false_positives(0) == 0
+    # col 1: preds>=.5 rows 1,2,3; pos rows 1,2 -> tp=2 fp=1 tn=1 fn=0
+    assert ev.true_positives(1) == 2
+    assert ev.false_positives(1) == 1
+    assert ev.precision(1) == pytest.approx(2 / 3)
+    assert ev.recall(1) == pytest.approx(1.0)
+    assert "EvaluationBinary" in ev.stats()
+
+
+def test_evaluation_calibration():
+    n = 5000
+    p = RNG.rand(n)
+    y = (RNG.rand(n) < p).astype(np.float64)  # perfectly calibrated
+    labels = np.stack([1 - y, y], axis=1)
+    probs = np.stack([1 - p, p], axis=1)
+    ec = EvaluationCalibration(reliability_bins=10)
+    for i in range(0, n, 512):
+        ec.eval(labels[i:i + 512], probs[i:i + 512])
+    assert ec.expected_calibration_error(1) < 0.03
+    rd = ec.get_reliability_diagram(1)
+    np.testing.assert_allclose(rd.mean_predicted, rd.fraction_positives, atol=0.1)
+    h = ec.get_probability_histogram(1)
+    assert h.counts.sum() == n
+    resid = ec.get_residual_plot(1)
+    assert resid.counts.sum() == n
+
+
+def test_evaluation_topn_and_vectorized_matches_reference_loop():
+    n, c = 400, 6
+    cls = RNG.randint(0, c, n)
+    labels = np.eye(c)[cls]
+    probs = RNG.rand(n, c) + labels * 0.5
+    ev = Evaluation(top_n=3)
+    ev.eval(labels, probs)
+    # reference loop
+    m = np.zeros((c, c), np.int64)
+    topn = 0
+    for i in range(n):
+        a = labels[i].argmax()
+        p = probs[i].argmax()
+        m[a, p] += 1
+        if a in np.argsort(-probs[i])[:3]:
+            topn += 1
+    np.testing.assert_array_equal(ev.confusion.matrix, m)
+    assert ev.top_n_accuracy() == pytest.approx(topn / n)
+    assert ev.top_n_accuracy() >= ev.accuracy()
+    s = ev.stats()
+    assert "Top 3 Accuracy" in s and "Per-class" in s

@@ -180,3 +180,36 @@ def test_fit_on_device_warm_cache_uses_new_data():
     net2.fit_on_device(xa, ya, steps=3)
     assert not np.allclose(np.asarray(net1.params()), np.asarray(net2.params())), \
         "warm cache ignored the new batch"
+
+
+def test_bf16_mixed_precision_params_stay_fp32_and_learn():
+    """compute_dtype=bfloat16: layer math in bf16, params/updater state/score in the
+    storage dtype; training still converges on a toy problem."""
+    import jax
+    from deeplearning4j_tpu import (
+        Activation, Adam, DenseLayer, InputType, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer, WeightInit)
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(7).weight_init(WeightInit.XAVIER)
+            .updater(Adam(learning_rate=0.05))
+            .dtype("float32").compute_dtype("bfloat16")
+            .list()
+            .layer(DenseLayer(n_out=16, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(2))
+            .build())
+    # round-trips through JSON
+    from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+    conf = MultiLayerConfiguration.from_json(conf.to_json())
+    assert conf.global_conf.compute_dtype == "bfloat16"
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(3)
+    x = rng.randint(0, 2, (64, 2)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[(x[:, 0].astype(int) ^ x[:, 1].astype(int))]
+    losses = net.fit_on_device(x, y, steps=150)
+    assert losses[-1] < losses[0] * 0.5
+    for leaf in jax.tree_util.tree_leaves(net.params_tree):
+        assert leaf.dtype == np.float32
+    out = net.output(x[:4])
+    assert out.dtype == np.float32
