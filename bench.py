@@ -121,6 +121,99 @@ def bench_parallel_wrapper(batch=128, iters=30, compute_dtype="bfloat16"):
             "compute_dtype": compute_dtype or "float32"}
 
 
+def _write_vgg16_h5(path):
+    """Generate a Keras-2.x-format VGG16 h5 (random weights) — the no-egress stand-in
+    for the Keras VGG16 download the reference's TrainedModels.VGG16 performs."""
+    import json
+
+    import h5py
+
+    convs = [(64, 2), (128, 2), (256, 3), (512, 3), (512, 3)]
+    layers = []
+    weights = {}
+    rng = np.random.RandomState(7)
+    cin = 3
+    first = True
+    for bi, (f, n) in enumerate(convs, start=1):
+        for ci in range(1, n + 1):
+            name = f"block{bi}_conv{ci}"
+            cfg = {"name": name, "filters": f, "kernel_size": [3, 3],
+                   "padding": "same", "activation": "relu"}
+            if first:
+                cfg["batch_input_shape"] = [None, 224, 224, 3]
+                first = False
+            layers.append({"class_name": "Conv2D", "config": cfg})
+            weights[name] = [
+                (f"{name}/kernel:0",
+                 (rng.randn(3, 3, cin, f) * 0.05).astype(np.float32)),
+                (f"{name}/bias:0", np.zeros(f, np.float32))]
+            cin = f
+        layers.append({"class_name": "MaxPooling2D",
+                       "config": {"name": f"block{bi}_pool", "pool_size": [2, 2],
+                                  "strides": [2, 2]}})
+    layers.append({"class_name": "Flatten", "config": {"name": "flatten"}})
+    for name, (nin, nout) in [("fc1", (25088, 4096)), ("fc2", (4096, 4096)),
+                              ("predictions", (4096, 1000))]:
+        act = "softmax" if name == "predictions" else "relu"
+        layers.append({"class_name": "Dense",
+                       "config": {"name": name, "units": nout, "activation": act}})
+        weights[name] = [
+            (f"{name}/kernel:0", (rng.randn(nin, nout) * 0.01).astype(np.float32)),
+            (f"{name}/bias:0", np.zeros(nout, np.float32))]
+
+    model_config = {"class_name": "Sequential",
+                    "config": {"name": "vgg16", "layers": layers}}
+    with h5py.File(path, "w") as hf:
+        hf.attrs["model_config"] = json.dumps(model_config).encode()
+        mw = hf.create_group("model_weights")
+        mw.attrs["layer_names"] = np.array([n.encode() for n in weights], dtype="S64")
+        for lname, ws in weights.items():
+            g = mw.create_group(lname)
+            g.attrs["weight_names"] = np.array([wn.encode() for wn, _ in ws],
+                                               dtype="S64")
+            for wn, arr in ws:
+                g.create_dataset(wn, data=arr)
+
+
+def bench_vgg16_transfer(batch=32, steps=10, num_classes=10):
+    """BASELINE config 3: Keras VGG16 import -> TransferLearning (freeze features,
+    replace 1000-way head) -> train. Reports import-to-first-step time + images/sec
+    (ref KerasModelImport.java + TransferLearning.java:35)."""
+    import os
+    import tempfile
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.keras import KerasModelImport
+    from deeplearning4j_tpu.nn.transferlearning import (
+        FineTuneConfiguration, TransferLearning)
+    from deeplearning4j_tpu.nn.updater.updaters import Nesterovs
+
+    with tempfile.TemporaryDirectory() as td:
+        path = os.path.join(td, "vgg16.h5")
+        _write_vgg16_h5(path)
+        t_import = time.perf_counter()
+        net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+        tuned = (TransferLearning.Builder(net)
+                 .fine_tune_configuration(
+                     FineTuneConfiguration(updater=Nesterovs(learning_rate=5e-5)))
+                 .set_feature_extractor(17)  # freeze conv blocks (13 conv + 5 pool)
+                 .nout_replace(20, num_classes)
+                 .build())
+        tuned.compute_dtype = jnp.dtype("bfloat16")
+        rng = np.random.RandomState(0)
+        x, y = _synth(rng, batch, num_classes, 3, 224, 224)
+        tuned.fit_batch(x, y)  # compile + first step
+        jax.block_until_ready(jax.tree_util.tree_leaves(tuned.params_tree))
+        import_to_first_step_s = time.perf_counter() - t_import
+        dt = _device_loop_time(tuned, x, y, steps)
+        return {"images_per_sec": batch * steps / dt,
+                "ms_per_iter": dt / steps * 1e3, "batch": batch,
+                "import_to_first_step_s": import_to_first_step_s,
+                "params": tuned.num_params()}
+
+
 def main():
     import jax
 
@@ -129,6 +222,10 @@ def main():
     lenet = bench_lenet()
     lstm = bench_graves_lstm()
     pw = bench_parallel_wrapper()
+    try:
+        vgg = bench_vgg16_transfer()
+    except Exception as e:  # keep the headline robust to fixture issues
+        vgg = {"error": f"{type(e).__name__}: {e}"}
     value = round(resnet_bf16["images_per_sec"], 1)
     print(json.dumps({
         "metric": "resnet50_imagenet_images_per_sec_per_chip",
@@ -148,7 +245,8 @@ def main():
                             for k, v in lstm.items()},
             "parallel_wrapper_resnet50": {k: round(v, 2) if isinstance(v, float) else v
                                           for k, v in pw.items()},
-            "vgg16_transfer": "pending Keras h5 fixture (import path: deeplearning4j_tpu.keras)",
+            "vgg16_transfer": {k: round(v, 2) if isinstance(v, float) else v
+                               for k, v in vgg.items()},
             "device": str(jax.devices()[0]),
             "protocol": "on-device lax.scan loop, median of 3, compile excluded",
         },

@@ -1,0 +1,5 @@
+"""Keras model import (ref deeplearning4j-modelimport)."""
+from deeplearning4j_tpu.keras.hdf5 import Hdf5Archive
+from deeplearning4j_tpu.keras.model_import import KerasModelImport
+
+__all__ = ["Hdf5Archive", "KerasModelImport"]

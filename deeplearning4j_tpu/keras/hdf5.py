@@ -1,0 +1,75 @@
+"""HDF5 archive access for Keras model files.
+
+Parity: ref modelimport/keras/Hdf5Archive.java (JavaCPP-hdf5-backed reader). Here the
+archive is h5py-backed; the API mirrors the reference's: read JSON attributes
+(model_config / training_config) and per-layer weight arrays.
+"""
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+def _decode(v):
+    if isinstance(v, bytes):
+        return v.decode("utf-8")
+    return v
+
+
+class Hdf5Archive:
+    def __init__(self, path: str):
+        import h5py
+        self.path = path
+        self.f = h5py.File(path, "r")
+
+    def close(self):
+        self.f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # ---------------- attributes ----------------
+    def read_attribute_as_json(self, name: str) -> Optional[dict]:
+        if name not in self.f.attrs:
+            return None
+        return json.loads(_decode(self.f.attrs[name]))
+
+    def has_attribute(self, name: str) -> bool:
+        return name in self.f.attrs
+
+    # ---------------- weights ----------------
+    def _weights_root(self):
+        return self.f["model_weights"] if "model_weights" in self.f else self.f
+
+    def layer_names(self) -> List[str]:
+        root = self._weights_root()
+        if "layer_names" in root.attrs:
+            return [_decode(n) for n in root.attrs["layer_names"]]
+        return list(root.keys())
+
+    def layer_weights(self, layer_name: str) -> List[np.ndarray]:
+        """All weight arrays for one layer, in the file's stored order (the order
+        Keras' layer.get_weights() used)."""
+        root = self._weights_root()
+        if layer_name not in root:
+            return []
+        grp = root[layer_name]
+        names = None
+        if "weight_names" in grp.attrs:
+            names = [_decode(n) for n in grp.attrs["weight_names"]]
+        if not names:
+            # legacy param_0/param_1 layout (Keras 1.x theano-era files)
+            names = sorted(k for k in grp.keys())
+        out = []
+        for n in names:
+            node = grp
+            for part in n.split("/"):
+                if part in node:
+                    node = node[part]
+            out.append(np.asarray(node))
+        return out

@@ -1,0 +1,266 @@
+"""Keras layer converters: Keras layer config dict -> framework layer conf + weight
+mapping.
+
+Parity: ref modelimport/keras/layers/ (16 converters: KerasDense, KerasConvolution,
+KerasPooling, KerasBatchNormalization, KerasLstm, KerasActivation, KerasDropout,
+KerasFlatten, KerasZeroPadding, KerasEmbedding, KerasGlobalPooling, ...). The
+reference's per-class wrapper objects collapse into converter functions returning the
+declarative layer conf; weight-shape translation handles both dim orderings:
+
+- Dense kernel (in, out) -> W (n_in, n_out) unchanged.
+- Conv2D kernel channels_last (kh, kw, in, out) -> OIHW transpose (3, 2, 0, 1);
+  channels_first/theano (out, in, kh, kw) -> unchanged.
+- LSTM fused kernel gate order (i, f, c, o) in Keras -> (i, f, o, g) here.
+- BatchNormalization [gamma, beta, moving_mean, moving_var] -> params
+  {gamma_w, beta} + state {mean, var}.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.common.enums import (
+    Activation, ConvolutionMode, LossFunction, PoolingType)
+from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+    ConvolutionLayer, GlobalPoolingLayer, SubsamplingLayer, ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.conf.layers.feedforward import (
+    ActivationLayer, DenseLayer, DropoutLayer, EmbeddingLayer, OutputLayer)
+from deeplearning4j_tpu.nn.conf.layers.normalization import BatchNormalization
+from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM
+
+# keras activation name -> framework Activation
+ACTIVATIONS = {
+    "relu": Activation.RELU,
+    "softmax": Activation.SOFTMAX,
+    "sigmoid": Activation.SIGMOID,
+    "tanh": Activation.TANH,
+    "linear": Activation.IDENTITY,
+    "hard_sigmoid": Activation.HARDSIGMOID,
+    "elu": Activation.ELU,
+    "softplus": Activation.SOFTPLUS,
+    "softsign": Activation.SOFTSIGN,
+    "selu": Activation.SELU,
+    "leaky_relu": Activation.LEAKYRELU,
+}
+
+LOSSES = {
+    "categorical_crossentropy": LossFunction.MCXENT,
+    "binary_crossentropy": LossFunction.XENT,
+    "mean_squared_error": LossFunction.MSE,
+    "mse": LossFunction.MSE,
+    "mean_absolute_error": LossFunction.L1,
+    "mae": LossFunction.L1,
+    "kullback_leibler_divergence": LossFunction.KL_DIVERGENCE,
+    "poisson": LossFunction.POISSON,
+    "cosine_proximity": LossFunction.COSINE_PROXIMITY,
+    "sparse_categorical_crossentropy": LossFunction.MCXENT,
+}
+
+
+def keras_activation(name: Optional[str]) -> Activation:
+    if not name:
+        return Activation.IDENTITY
+    if name not in ACTIVATIONS:
+        raise ValueError(f"Unsupported Keras activation: {name!r}")
+    return ACTIVATIONS[name]
+
+
+def keras_loss(name: str) -> LossFunction:
+    if name not in LOSSES:
+        raise ValueError(f"Unsupported Keras loss: {name!r}")
+    return LOSSES[name]
+
+
+def _pair(v) -> Tuple[int, int]:
+    if isinstance(v, (list, tuple)):
+        return int(v[0]), int(v[1] if len(v) > 1 else v[0])
+    return int(v), int(v)
+
+
+def _border_mode(cfg) -> ConvolutionMode:
+    mode = cfg.get("padding", cfg.get("border_mode", "valid"))
+    return ConvolutionMode.Same if mode == "same" else ConvolutionMode.Truncate
+
+
+def _channels_last(cfg, default="channels_last") -> bool:
+    fmt = cfg.get("data_format", cfg.get("dim_ordering", default))
+    return fmt in ("channels_last", "tf")
+
+
+class KerasLayerConversion:
+    """One converted layer: the framework layer conf (None for structural layers like
+    Flatten/InputLayer) plus how to map its Keras weight list."""
+
+    def __init__(self, layer=None, weight_mapper=None, is_flatten=False,
+                 is_input=False):
+        self.layer = layer
+        self.weight_mapper = weight_mapper  # list[np.ndarray] -> (params, state)
+        self.is_flatten = is_flatten
+        self.is_input = is_input
+
+
+def _dense_weights(ws):
+    p = {"W": np.asarray(ws[0])}
+    if len(ws) > 1:
+        p["b"] = np.asarray(ws[1]).reshape(-1)
+    return p, {}
+
+
+def convert_dense(cfg, channels_last=True, as_output=None, rnn_stream=False):
+    units = int(cfg.get("units", cfg.get("output_dim")))
+    act = keras_activation(cfg.get("activation"))
+    has_bias = cfg.get("use_bias", cfg.get("bias", True))
+    if as_output is not None:
+        if rnn_stream:
+            # Keras Dense on a sequence applies per timestep
+            from deeplearning4j_tpu.nn.conf.layers.recurrent import RnnOutputLayer
+            layer = RnnOutputLayer(n_out=units, activation=act, loss_fn=as_output,
+                                   has_bias=has_bias)
+        else:
+            layer = OutputLayer(n_out=units, activation=act, loss_fn=as_output,
+                                has_bias=has_bias)
+    else:
+        layer = DenseLayer(n_out=units, activation=act, has_bias=has_bias)
+    return KerasLayerConversion(layer, _dense_weights)
+
+
+def convert_conv2d(cfg, channels_last=True):
+    filters = int(cfg.get("filters", cfg.get("nb_filter")))
+    if "kernel_size" in cfg:
+        kernel = _pair(cfg["kernel_size"])
+    else:  # keras 1: nb_row/nb_col
+        kernel = (int(cfg["nb_row"]), int(cfg["nb_col"]))
+    stride = _pair(cfg.get("strides", cfg.get("subsample", (1, 1))))
+    cl = _channels_last(cfg)
+    layer = ConvolutionLayer(
+        n_out=filters, kernel_size=kernel, stride=stride,
+        convolution_mode=_border_mode(cfg),
+        activation=keras_activation(cfg.get("activation")),
+        has_bias=cfg.get("use_bias", cfg.get("bias", True)))
+
+    def mapper(ws):
+        k = np.asarray(ws[0])
+        if k.ndim == 4 and cl:
+            k = k.transpose(3, 2, 0, 1)  # HWIO -> OIHW
+        p = {"W": k}
+        if len(ws) > 1:
+            p["b"] = np.asarray(ws[1]).reshape(-1)
+        return p, {}
+
+    return KerasLayerConversion(layer, mapper)
+
+
+def convert_pooling(cfg, class_name, channels_last=True):
+    pool = PoolingType.MAX if "Max" in class_name else PoolingType.AVG
+    kernel = _pair(cfg.get("pool_size", (2, 2)))
+    stride = _pair(cfg.get("strides") or cfg.get("pool_size", (2, 2)))
+    layer = SubsamplingLayer(pooling_type=pool, kernel_size=kernel, stride=stride,
+                             convolution_mode=_border_mode(cfg))
+    return KerasLayerConversion(layer)
+
+
+def convert_global_pooling(cfg, class_name):
+    pool = PoolingType.MAX if "Max" in class_name else PoolingType.AVG
+    return KerasLayerConversion(GlobalPoolingLayer(pooling_type=pool))
+
+
+def convert_batchnorm(cfg, channels_last=True):
+    layer = BatchNormalization(eps=float(cfg.get("epsilon", 1e-3)),
+                               decay=float(cfg.get("momentum", 0.99)))
+
+    def mapper(ws):
+        gamma, beta, mean, var = (np.asarray(w).reshape(-1) for w in ws[:4])
+        return {"gamma_w": gamma, "beta": beta}, {"mean": mean, "var": var}
+
+    return KerasLayerConversion(layer, mapper)
+
+
+def convert_activation(cfg):
+    return KerasLayerConversion(
+        ActivationLayer(activation=keras_activation(cfg.get("activation"))))
+
+
+def convert_dropout(cfg):
+    rate = float(cfg.get("rate", cfg.get("p", 0.5)))
+    # our dropout field is RETAIN probability (ref util/Dropout.java semantics)
+    return KerasLayerConversion(DropoutLayer(dropout=1.0 - rate))
+
+
+def convert_zero_padding(cfg):
+    pad = cfg.get("padding", (1, 1))
+    if isinstance(pad, (list, tuple)) and len(pad) == 2 \
+            and isinstance(pad[0], (list, tuple)):
+        (t, b), (l, r) = pad
+    else:
+        ph, pw = _pair(pad)
+        t = b = ph
+        l = r = pw
+    return KerasLayerConversion(ZeroPaddingLayer(pad=(int(t), int(b), int(l), int(r))))
+
+
+def convert_lstm(cfg):
+    units = int(cfg.get("units", cfg.get("output_dim")))
+    layer = LSTM(n_out=units,
+                 activation=keras_activation(cfg.get("activation", "tanh")),
+                 gate_activation=keras_activation(
+                     cfg.get("recurrent_activation",
+                             cfg.get("inner_activation", "hard_sigmoid"))),
+                 forget_gate_bias_init=1.0 if cfg.get("unit_forget_bias", True) else 0.0)
+
+    def mapper(ws):
+        # keras fused: kernel (in, 4u) / recurrent (u, 4u) / bias (4u,) with gate
+        # blocks (i, f, c, o); this framework uses (i, f, o, g=c)
+        def permute(m):
+            blocks = np.split(np.asarray(m), 4, axis=-1)
+            i, f, c, o = blocks
+            return np.concatenate([i, f, o, c], axis=-1)
+        p = {"W": permute(ws[0]), "RW": permute(ws[1])}
+        p["b"] = permute(ws[2].reshape(1, -1)).reshape(-1) if len(ws) > 2 \
+            else np.zeros(4 * units, np.float32)
+        return p, {}
+
+    return KerasLayerConversion(layer, mapper)
+
+
+def convert_embedding(cfg):
+    layer = EmbeddingLayer(n_in=int(cfg.get("input_dim")),
+                           n_out=int(cfg.get("output_dim")), has_bias=False)
+
+    def mapper(ws):
+        return {"W": np.asarray(ws[0])}, {}
+
+    return KerasLayerConversion(layer, mapper)
+
+
+def convert_layer(class_name: str, cfg: dict, as_output=None,
+                  rnn_stream=False) -> KerasLayerConversion:
+    """Dispatch one Keras layer config to its converter
+    (ref KerasLayer.getKerasLayerFromConfig registry)."""
+    if class_name in ("Dense",):
+        return convert_dense(cfg, as_output=as_output, rnn_stream=rnn_stream)
+    if class_name in ("Conv2D", "Convolution2D"):
+        return convert_conv2d(cfg)
+    if class_name in ("MaxPooling2D", "AveragePooling2D"):
+        return convert_pooling(cfg, class_name)
+    if class_name in ("GlobalMaxPooling2D", "GlobalAveragePooling2D",
+                      "GlobalMaxPooling1D", "GlobalAveragePooling1D"):
+        return convert_global_pooling(cfg, class_name)
+    if class_name == "BatchNormalization":
+        return convert_batchnorm(cfg)
+    if class_name == "Activation":
+        return convert_activation(cfg)
+    if class_name in ("Dropout", "SpatialDropout2D"):
+        return convert_dropout(cfg)
+    if class_name == "ZeroPadding2D":
+        return convert_zero_padding(cfg)
+    if class_name == "LSTM":
+        return convert_lstm(cfg)
+    if class_name == "Embedding":
+        return convert_embedding(cfg)
+    if class_name == "Flatten":
+        return KerasLayerConversion(is_flatten=True)
+    if class_name == "InputLayer":
+        return KerasLayerConversion(is_input=True)
+    raise ValueError(f"Unsupported Keras layer type: {class_name!r} "
+                     f"(ref KerasLayer registry)")

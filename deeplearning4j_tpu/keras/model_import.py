@@ -1,0 +1,263 @@
+"""KerasModelImport: Keras HDF5 -> framework networks with weights.
+
+Parity: ref modelimport/keras/KerasModelImport.java:48-284 (entry points),
+KerasModel.java:418-523 (config construction) and :661-677 (weight copy),
+KerasSequentialModel.java:143-227. Supports Keras 1.x and 2.x JSON stored in the h5
+`model_config` attribute; Sequential models produce a MultiLayerNetwork and functional
+models a ComputationGraph. Data format: channels_last (TensorFlow) conv kernels are
+transposed to this framework's OIHW layout and a channels-last Flatten maps to
+TensorFlowCnnToFeedForwardPreProcessor so following Dense weights line up.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.common.enums import Activation, LossFunction
+from deeplearning4j_tpu.keras.hdf5 import Hdf5Archive
+from deeplearning4j_tpu.keras.layers import (
+    KerasLayerConversion, convert_layer, keras_loss)
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.feedforward import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.preprocessors import (
+    TensorFlowCnnToFeedForwardPreProcessor)
+
+
+def _input_type_from_shape(shape, channels_last=True) -> InputType:
+    """batch_input_shape [None, ...] -> InputType."""
+    dims = [d for d in shape[1:]]
+    if len(dims) == 1:
+        return InputType.feed_forward(int(dims[0]))
+    if len(dims) == 3:
+        if channels_last:
+            h, w, c = dims
+        else:
+            c, h, w = dims
+        return InputType.convolutional(int(h), int(w), int(c))
+    if len(dims) == 2:
+        # (time, features) keras RNN layout -> recurrent
+        t, f = dims
+        return InputType.recurrent(int(f), int(t) if t else 0)
+    raise ValueError(f"Unsupported Keras input shape: {shape}")
+
+
+def _training_loss(archive: Hdf5Archive) -> Optional[LossFunction]:
+    tc = archive.read_attribute_as_json("training_config")
+    if not tc:
+        return None
+    loss = tc.get("loss")
+    if isinstance(loss, dict):
+        loss = next(iter(loss.values()))
+    if isinstance(loss, str):
+        try:
+            return keras_loss(loss)
+        except ValueError:
+            return None
+    return None
+
+
+def _default_loss(activation: Activation) -> LossFunction:
+    if activation == Activation.SOFTMAX:
+        return LossFunction.MCXENT
+    if activation == Activation.SIGMOID:
+        return LossFunction.XENT
+    return LossFunction.MSE
+
+
+class KerasModelImport:
+    """(ref KerasModelImport.java entry points; camelCase aliases kept for parity)"""
+
+    # ------------------------------------------------------------- sequential
+    @staticmethod
+    def import_keras_sequential_model_and_weights(path: str,
+                                                  enforce_training_config: bool = False):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+        with Hdf5Archive(path) as archive:
+            model_config = archive.read_attribute_as_json("model_config")
+            if model_config is None:
+                raise ValueError(f"No model_config attribute in {path}")
+            if model_config.get("class_name") != "Sequential":
+                raise ValueError("Not a Sequential model; use "
+                                 "import_keras_model_and_weights")
+            cfg = model_config["config"]
+            layer_dicts = cfg["layers"] if isinstance(cfg, dict) else cfg
+            loss = _training_loss(archive)
+
+            builder = NeuralNetConfiguration.Builder().list()
+            conversions: List[Tuple[str, KerasLayerConversion]] = []
+            input_type = None
+            flatten_pending = False
+            is_rnn_stream = False  # activations currently (batch, size, time)?
+            idx = 0
+            n_real = sum(1 for ld in layer_dicts
+                         if ld["class_name"] not in ("InputLayer", "Flatten"))
+            seen_real = 0
+            for ld in layer_dicts:
+                class_name = ld["class_name"]
+                lcfg = ld.get("config", {})
+                name = lcfg.get("name", f"layer_{idx}")
+                if input_type is None:
+                    shape = lcfg.get("batch_input_shape")
+                    if shape:
+                        input_type = _input_type_from_shape(shape)
+                        is_rnn_stream = input_type.kind == "rnn"
+                if class_name == "InputLayer":
+                    continue
+                if class_name == "Flatten":
+                    flatten_pending = True
+                    is_rnn_stream = False
+                    continue
+                if class_name == "LSTM" and not lcfg.get("return_sequences", False):
+                    raise ValueError(
+                        "Sequential import of LSTM(return_sequences=False) is not "
+                        "supported; use the functional import (LastTimeStepVertex) "
+                        "or return_sequences=True")
+                seen_real += 1
+                as_output = None
+                if seen_real == n_real and class_name == "Dense":
+                    # final layer becomes the scoring output layer; on a sequence
+                    # stream Keras Dense is per-timestep -> RnnOutputLayer
+                    act = lcfg.get("activation")
+                    from deeplearning4j_tpu.keras.layers import keras_activation
+                    as_output = loss or _default_loss(keras_activation(act))
+                conv = convert_layer(class_name, lcfg, as_output=as_output,
+                                     rnn_stream=is_rnn_stream)
+                if class_name in ("LSTM",):
+                    is_rnn_stream = True
+                elif class_name in ("Dense", "GlobalMaxPooling1D",
+                                    "GlobalAveragePooling1D") and not is_rnn_stream:
+                    is_rnn_stream = False
+                if conv.is_input or conv.layer is None:
+                    continue
+                if flatten_pending:
+                    builder.input_pre_processor(
+                        idx, TensorFlowCnnToFeedForwardPreProcessor())
+                    flatten_pending = False
+                builder.layer(conv.layer)
+                conversions.append((name, conv))
+                idx += 1
+
+            if input_type is None:
+                raise ValueError("Could not infer input shape (no batch_input_shape)")
+            conf = builder.set_input_type(input_type).build()
+            net = MultiLayerNetwork(conf).init()
+            KerasModelImport._copy_weights(archive, net.params_tree, net.state_tree,
+                                           conversions)
+            return net
+    importKerasSequentialModelAndWeights = import_keras_sequential_model_and_weights
+
+    # ------------------------------------------------------------- functional
+    @staticmethod
+    def import_keras_model_and_weights(path: str,
+                                       enforce_training_config: bool = False):
+        from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+        from deeplearning4j_tpu.nn.graph.vertices import ElementWiseVertex, MergeVertex
+
+        with Hdf5Archive(path) as archive:
+            model_config = archive.read_attribute_as_json("model_config")
+            if model_config is None:
+                raise ValueError(f"No model_config attribute in {path}")
+            if model_config.get("class_name") == "Sequential":
+                return KerasModelImport.import_keras_sequential_model_and_weights(
+                    path, enforce_training_config)
+            cfg = model_config["config"]
+            layer_dicts = cfg["layers"]
+            loss = _training_loss(archive)
+            out_names = [o[0] for o in cfg.get("output_layers", [])]
+
+            g = NeuralNetConfiguration.Builder().graph_builder()
+            conversions: List[Tuple[str, KerasLayerConversion]] = []
+            input_types: List[InputType] = []
+            inputs: List[str] = []
+            # name of the graph node that provides each keras layer's output
+            flatten_from: Dict[str, str] = {}
+
+            for ld in layer_dicts:
+                class_name = ld["class_name"]
+                lcfg = ld.get("config", {})
+                name = lcfg.get("name", ld.get("name"))
+                inbound = [n[0] for node in ld.get("inbound_nodes", [])
+                           for n in node]
+                inbound = [flatten_from.get(n, n) for n in inbound]
+                if class_name == "InputLayer":
+                    inputs.append(name)
+                    g.add_inputs(name)
+                    input_types.append(_input_type_from_shape(
+                        lcfg["batch_input_shape"]))
+                    continue
+                if class_name == "Flatten":
+                    # structural: downstream consumers read from the producer with a
+                    # preprocessor attached at their own node
+                    flatten_from[name] = "__flatten__:" + inbound[0]
+                    continue
+                if class_name in ("Add", "Merge", "add"):
+                    g.add_vertex(name, ElementWiseVertex(op="Add"), *inbound)
+                    continue
+                if class_name in ("Concatenate", "concatenate"):
+                    g.add_vertex(name, MergeVertex(), *inbound)
+                    continue
+                as_output = None
+                if name in out_names and class_name == "Dense":
+                    from deeplearning4j_tpu.keras.layers import keras_activation
+                    as_output = loss or _default_loss(
+                        keras_activation(lcfg.get("activation")))
+                conv = convert_layer(class_name, lcfg, as_output=as_output)
+                pre = None
+                real_inputs = []
+                for n in inbound:
+                    if n.startswith("__flatten__:"):
+                        pre = TensorFlowCnnToFeedForwardPreProcessor()
+                        real_inputs.append(n.split(":", 1)[1])
+                    else:
+                        real_inputs.append(n)
+                g.add_layer(name, conv.layer, *real_inputs, preprocessor=pre)
+                conversions.append((name, conv))
+
+            g.set_outputs(*out_names)
+            g.set_input_types(*input_types)
+            graph = ComputationGraph(g.build()).init()
+            # params are ordered by topo order of layer nodes, not file order
+            order = {n: i for i, n in enumerate(graph.layer_names)}
+            conversions.sort(key=lambda nc: order[nc[0]])
+            KerasModelImport._copy_weights(archive, graph.params_tree,
+                                           graph.state_tree, conversions,
+                                           names=graph.layer_names)
+            return graph
+    importKerasModelAndWeights = import_keras_model_and_weights
+
+    # ------------------------------------------------------------- weights
+    @staticmethod
+    def _copy_weights(archive, params_tree, state_tree, conversions, names=None):
+        """(ref KerasModel.copyWeightsToModel :661-677)"""
+        import jax.numpy as jnp
+        conv_by_name = dict(conversions)
+        layer_names = names or [n for n, _ in conversions]
+        param_idx = 0
+        for lname in layer_names:
+            conv = conv_by_name.get(lname)
+            if conv is None:
+                continue
+            i = param_idx
+            param_idx += 1
+            if conv.weight_mapper is None:
+                continue
+            ws = archive.layer_weights(lname)
+            if not ws:
+                continue
+            params, state = conv.weight_mapper(ws)
+            for k, v in params.items():
+                if k not in params_tree[i]:
+                    raise ValueError(
+                        f"Layer {lname}: imported param {k!r} not in framework "
+                        f"params {sorted(params_tree[i])}")
+                expect = params_tree[i][k].shape
+                if tuple(v.shape) != tuple(expect):
+                    raise ValueError(
+                        f"Layer {lname} param {k}: shape {v.shape} != {expect}")
+                params_tree[i][k] = jnp.asarray(v, params_tree[i][k].dtype)
+            for k, v in state.items():
+                if k in state_tree[i]:
+                    state_tree[i][k] = jnp.asarray(v, state_tree[i][k].dtype)

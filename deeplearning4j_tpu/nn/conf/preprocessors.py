@@ -87,6 +87,17 @@ class FeedForwardToCnnPreProcessor(InputPreProcessor):
 
 @register_preprocessor
 @dataclass
+class TensorFlowCnnToFeedForwardPreProcessor(CnnToFeedForwardPreProcessor):
+    """Flatten NCHW activations in channels-LAST (h, w, c) element order — the order
+    a TensorFlow-backend Keras `Flatten` produced, so imported Dense weights line up
+    (ref modelimport/keras/preprocessors/TensorFlowCnnToFeedForwardPreProcessor.java)."""
+
+    def preprocess(self, x):
+        return jnp.transpose(x, (0, 2, 3, 1)).reshape(x.shape[0], -1)
+
+
+@register_preprocessor
+@dataclass
 class RnnToFeedForwardPreProcessor(InputPreProcessor):
     """(batch, size, time) → (batch*time, size) — stacks timesteps
     (ref RnnToFeedForwardPreProcessor.java)."""

@@ -1,0 +1,292 @@
+"""Keras HDF5 import suite (ref modelimport KerasModelImport tests + the
+theano_mnist .h5 resource pattern — here fixtures are generated in-test with h5py in
+the exact format tf.keras 2.x writes, and imported nets are validated against an
+independent numpy implementation of KERAS semantics (channels_last conv, channels_last
+flatten), not against this framework's own ops."""
+import json
+
+import numpy as np
+import pytest
+
+h5py = pytest.importorskip("h5py")
+
+from deeplearning4j_tpu.keras import KerasModelImport
+
+RNG = np.random.RandomState(0)
+
+
+# --------------------------------------------------------------------- h5 writer
+def write_keras_h5(path, model_config, weights, training_config=None):
+    """weights: {layer_name: [(weight_name, array), ...]} in keras get_weights order."""
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(model_config).encode()
+        if training_config is not None:
+            f.attrs["training_config"] = json.dumps(training_config).encode()
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = np.array(
+            [n.encode() for n in weights], dtype="S64")
+        for lname, ws in weights.items():
+            g = mw.create_group(lname)
+            g.attrs["weight_names"] = np.array(
+                [wn.encode() for wn, _ in ws], dtype="S64")
+            for wn, arr in ws:
+                g.create_dataset(wn, data=arr)
+
+
+def seq_config(layers, name="sequential"):
+    return {"class_name": "Sequential",
+            "config": {"name": name, "layers": layers}}
+
+
+# ------------------------------------------------------ numpy keras reference
+def np_conv2d_channels_last(x, k, b, stride=1):
+    """x (b,h,w,c), k (kh,kw,cin,cout) VALID conv — straight loop reference."""
+    bs, h, w, cin = x.shape
+    kh, kw, _, cout = k.shape
+    oh, ow = (h - kh) // stride + 1, (w - kw) // stride + 1
+    out = np.zeros((bs, oh, ow, cout))
+    for i in range(oh):
+        for j in range(ow):
+            patch = x[:, i * stride:i * stride + kh, j * stride:j * stride + kw, :]
+            out[:, i, j, :] = np.tensordot(patch, k, axes=([1, 2, 3], [0, 1, 2]))
+    return out + b
+
+
+def np_softmax(z):
+    e = np.exp(z - z.max(axis=-1, keepdims=True))
+    return e / e.sum(axis=-1, keepdims=True)
+
+
+# --------------------------------------------------------------------- tests
+def test_sequential_dense_import_matches_numpy(tmp_path):
+    w1 = RNG.randn(5, 8).astype(np.float32)
+    b1 = RNG.randn(8).astype(np.float32)
+    w2 = RNG.randn(8, 3).astype(np.float32)
+    b2 = RNG.randn(3).astype(np.float32)
+    cfg = seq_config([
+        {"class_name": "InputLayer",
+         "config": {"name": "input_1", "batch_input_shape": [None, 5]}},
+        {"class_name": "Dense",
+         "config": {"name": "dense_1", "units": 8, "activation": "tanh",
+                    "use_bias": True}},
+        {"class_name": "Dense",
+         "config": {"name": "dense_2", "units": 3, "activation": "softmax",
+                    "use_bias": True}},
+    ])
+    path = str(tmp_path / "mlp.h5")
+    write_keras_h5(path, cfg, {
+        "dense_1": [("dense_1/kernel:0", w1), ("dense_1/bias:0", b1)],
+        "dense_2": [("dense_2/kernel:0", w2), ("dense_2/bias:0", b2)],
+    }, training_config={"loss": "categorical_crossentropy"})
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = RNG.randn(4, 5).astype(np.float32)
+    expected = np_softmax(np.tanh(x @ w1 + b1) @ w2 + b2)
+    np.testing.assert_allclose(np.asarray(net.output(x)), expected,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_sequential_cnn_import_matches_numpy(tmp_path):
+    # channels_last keras CNN: conv(relu) -> maxpool -> flatten -> dense softmax
+    k = RNG.randn(3, 3, 2, 4).astype(np.float32) * 0.3
+    kb = RNG.randn(4).astype(np.float32) * 0.1
+    wd = RNG.randn(2 * 2 * 4, 3).astype(np.float32) * 0.3
+    bd = RNG.randn(3).astype(np.float32) * 0.1
+    cfg = seq_config([
+        {"class_name": "Conv2D",
+         "config": {"name": "conv", "filters": 4, "kernel_size": [3, 3],
+                    "strides": [1, 1], "padding": "valid", "activation": "relu",
+                    "use_bias": True, "data_format": "channels_last",
+                    "batch_input_shape": [None, 6, 6, 2]}},
+        {"class_name": "MaxPooling2D",
+         "config": {"name": "pool", "pool_size": [2, 2], "strides": [2, 2],
+                    "padding": "valid"}},
+        {"class_name": "Flatten", "config": {"name": "flatten"}},
+        {"class_name": "Dense",
+         "config": {"name": "out", "units": 3, "activation": "softmax"}},
+    ])
+    path = str(tmp_path / "cnn.h5")
+    write_keras_h5(path, cfg, {
+        "conv": [("conv/kernel:0", k), ("conv/bias:0", kb)],
+        "out": [("out/kernel:0", wd), ("out/bias:0", bd)],
+    })
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+
+    xk = RNG.randn(3, 6, 6, 2).astype(np.float32)  # keras layout (b,h,w,c)
+    conv = np.maximum(0.0, np_conv2d_channels_last(xk, k, kb))     # (b,4,4,4)
+    pooled = conv.reshape(3, 2, 2, 2, 2, 4).max(axis=(2, 4))       # (b,2,2,4)
+    expected = np_softmax(pooled.reshape(3, -1) @ wd + bd)
+
+    x = xk.transpose(0, 3, 1, 2)  # framework layout NCHW
+    np.testing.assert_allclose(np.asarray(net.output(x)), expected,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sequential_batchnorm_and_dropout_import(tmp_path):
+    gamma = np.abs(RNG.randn(4)).astype(np.float32) + 0.5
+    beta = RNG.randn(4).astype(np.float32)
+    mean = RNG.randn(4).astype(np.float32)
+    var = np.abs(RNG.randn(4)).astype(np.float32) + 0.5
+    wd = RNG.randn(4, 2).astype(np.float32)
+    bd = np.zeros(2, np.float32)
+    cfg = seq_config([
+        {"class_name": "BatchNormalization",
+         "config": {"name": "bn", "epsilon": 1e-3, "momentum": 0.99,
+                    "batch_input_shape": [None, 4]}},
+        {"class_name": "Dropout", "config": {"name": "drop", "rate": 0.5}},
+        {"class_name": "Dense",
+         "config": {"name": "out", "units": 2, "activation": "softmax"}},
+    ])
+    path = str(tmp_path / "bn.h5")
+    write_keras_h5(path, cfg, {
+        "bn": [("bn/gamma:0", gamma), ("bn/beta:0", beta),
+               ("bn/moving_mean:0", mean), ("bn/moving_variance:0", var)],
+        "out": [("out/kernel:0", wd), ("out/bias:0", bd)],
+    })
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = RNG.randn(5, 4).astype(np.float32)
+    normed = gamma * (x - mean) / np.sqrt(var + 1e-3) + beta
+    expected = np_softmax(normed @ wd + bd)
+    np.testing.assert_allclose(np.asarray(net.output(x)), expected,
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_functional_residual_add_import(tmp_path):
+    w1 = RNG.randn(4, 4).astype(np.float32) * 0.4
+    b1 = np.zeros(4, np.float32)
+    wo = RNG.randn(4, 2).astype(np.float32)
+    bo = np.zeros(2, np.float32)
+    cfg = {"class_name": "Functional", "config": {
+        "name": "model",
+        "layers": [
+            {"class_name": "InputLayer", "name": "in",
+             "config": {"name": "in", "batch_input_shape": [None, 4]},
+             "inbound_nodes": []},
+            {"class_name": "Dense", "name": "d1",
+             "config": {"name": "d1", "units": 4, "activation": "relu"},
+             "inbound_nodes": [[["in", 0, 0, {}]]]},
+            {"class_name": "Add", "name": "add",
+             "config": {"name": "add"},
+             "inbound_nodes": [[["d1", 0, 0, {}], ["in", 0, 0, {}]]]},
+            {"class_name": "Dense", "name": "out",
+             "config": {"name": "out", "units": 2, "activation": "softmax"},
+             "inbound_nodes": [[["add", 0, 0, {}]]]},
+        ],
+        "input_layers": [["in", 0, 0]],
+        "output_layers": [["out", 0, 0]],
+    }}
+    path = str(tmp_path / "func.h5")
+    write_keras_h5(path, cfg, {
+        "d1": [("d1/kernel:0", w1), ("d1/bias:0", b1)],
+        "out": [("out/kernel:0", wo), ("out/bias:0", bo)],
+    })
+    graph = KerasModelImport.import_keras_model_and_weights(path)
+    x = RNG.randn(6, 4).astype(np.float32)
+    hidden = np.maximum(0.0, x @ w1 + b1) + x
+    expected = np_softmax(hidden @ wo + bo)
+    np.testing.assert_allclose(np.asarray(graph.output(x)), expected,
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lstm_import_shapes_and_transfer(tmp_path):
+    """LSTM (return_sequences) import runs; imported net feeds TransferLearning."""
+    u, f = 3, 2
+    kernel = RNG.randn(f, 4 * u).astype(np.float32) * 0.3
+    rec = RNG.randn(u, 4 * u).astype(np.float32) * 0.3
+    bias = RNG.randn(4 * u).astype(np.float32) * 0.1
+    wd = RNG.randn(u, 2).astype(np.float32)
+    bd = np.zeros(2, np.float32)
+    cfg = seq_config([
+        {"class_name": "LSTM",
+         "config": {"name": "lstm", "units": u, "activation": "tanh",
+                    "recurrent_activation": "sigmoid", "return_sequences": True,
+                    "batch_input_shape": [None, 5, f]}},
+        {"class_name": "Dense",
+         "config": {"name": "out", "units": 2, "activation": "softmax"}},
+    ])
+    path = str(tmp_path / "lstm.h5")
+    write_keras_h5(path, cfg, {
+        "lstm": [("lstm/kernel:0", kernel), ("lstm/recurrent_kernel:0", rec),
+                 ("lstm/bias:0", bias)],
+        "out": [("out/kernel:0", wd), ("out/bias:0", bd)],
+    })
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = RNG.randn(2, f, 5)  # framework RNN layout (batch, features, time)
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 2, 5)
+    assert np.isfinite(out).all()
+    # gate permutation sanity: imported W holds keras blocks (i,f,o,c)
+    W = np.asarray(net.params_tree[0]["W"])
+    np.testing.assert_allclose(W[:, :u], kernel[:, :u])            # i block
+    np.testing.assert_allclose(W[:, u:2 * u], kernel[:, u:2 * u])  # f block
+    np.testing.assert_allclose(W[:, 2 * u:3 * u], kernel[:, 3 * u:])  # o <- keras o
+    np.testing.assert_allclose(W[:, 3 * u:], kernel[:, 2 * u:3 * u])  # g <- keras c
+
+    # BASELINE config 3 shape: imported model feeds the TransferLearning builder
+    from deeplearning4j_tpu.nn.transferlearning import (
+        FineTuneConfiguration, TransferLearning)
+    from deeplearning4j_tpu.nn.updater.updaters import Sgd
+    tuned = (TransferLearning.Builder(net)
+             .fine_tune_configuration(
+                 FineTuneConfiguration(updater=Sgd(learning_rate=0.01)))
+             .set_feature_extractor(0)
+             .build())
+    assert tuned.layers[0].frozen
+
+
+def test_vgg16_style_import_and_transfer(tmp_path):
+    """A VGG16-shaped (truncated: 2 blocks) channels_last model imports, and the
+    TransferLearning nOut-replace path works on it (BASELINE tracked config 3)."""
+    layers = [
+        {"class_name": "Conv2D",
+         "config": {"name": "block1_conv1", "filters": 8, "kernel_size": [3, 3],
+                    "padding": "same", "activation": "relu",
+                    "batch_input_shape": [None, 16, 16, 3]}},
+        {"class_name": "Conv2D",
+         "config": {"name": "block1_conv2", "filters": 8, "kernel_size": [3, 3],
+                    "padding": "same", "activation": "relu"}},
+        {"class_name": "MaxPooling2D",
+         "config": {"name": "block1_pool", "pool_size": [2, 2], "strides": [2, 2]}},
+        {"class_name": "Conv2D",
+         "config": {"name": "block2_conv1", "filters": 16, "kernel_size": [3, 3],
+                    "padding": "same", "activation": "relu"}},
+        {"class_name": "MaxPooling2D",
+         "config": {"name": "block2_pool", "pool_size": [2, 2], "strides": [2, 2]}},
+        {"class_name": "Flatten", "config": {"name": "flatten"}},
+        {"class_name": "Dense",
+         "config": {"name": "fc1", "units": 32, "activation": "relu"}},
+        {"class_name": "Dense",
+         "config": {"name": "predictions", "units": 10, "activation": "softmax"}},
+    ]
+    weights = {}
+    shapes = {"block1_conv1": (3, 3, 3, 8), "block1_conv2": (3, 3, 8, 8),
+              "block2_conv1": (3, 3, 8, 16)}
+    for n, s in shapes.items():
+        weights[n] = [(f"{n}/kernel:0", RNG.randn(*s).astype(np.float32) * 0.1),
+                      (f"{n}/bias:0", np.zeros(s[-1], np.float32))]
+    weights["fc1"] = [("fc1/kernel:0",
+                       RNG.randn(4 * 4 * 16, 32).astype(np.float32) * 0.1),
+                      ("fc1/bias:0", np.zeros(32, np.float32))]
+    weights["predictions"] = [("predictions/kernel:0",
+                               RNG.randn(32, 10).astype(np.float32) * 0.1),
+                              ("predictions/bias:0", np.zeros(10, np.float32))]
+    path = str(tmp_path / "vgg_small.h5")
+    write_keras_h5(path, seq_config(layers), weights)
+    net = KerasModelImport.import_keras_sequential_model_and_weights(path)
+    x = RNG.randn(2, 3, 16, 16).astype(np.float32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 10)
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-5)
+
+    # transfer: freeze features, replace the head for 4 classes, train a step
+    from deeplearning4j_tpu.nn.transferlearning import (
+        FineTuneConfiguration, TransferLearning)
+    from deeplearning4j_tpu.nn.updater.updaters import Adam
+    tuned = (TransferLearning.Builder(net)
+             .fine_tune_configuration(
+                 FineTuneConfiguration(updater=Adam(learning_rate=1e-3)))
+             .set_feature_extractor(4)
+             .nout_replace(6, 4)
+             .build())
+    y = np.eye(4)[RNG.randint(0, 4, 2)]
+    tuned.fit(x, y)
+    assert np.isfinite(tuned.score())
