@@ -20,6 +20,11 @@ from deeplearning4j_tpu.nn.conf.layers.normalization import (
     BatchNormalization, LocalResponseNormalization)
 from deeplearning4j_tpu.nn.conf.layers.recurrent import (
     GravesBidirectionalLSTM, GravesLSTM, LSTM, RnnOutputLayer)
+from deeplearning4j_tpu.nn.conf.layers.variational import (
+    BernoulliReconstructionDistribution, CenterLossOutputLayer,
+    CompositeReconstructionDistribution, ExponentialReconstructionDistribution,
+    GaussianReconstructionDistribution, LossFunctionWrapper, RBM,
+    ReconstructionDistribution, VariationalAutoencoder)
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.conf.graph_configuration import (
     ComputationGraphConfiguration, GraphBuilder)

@@ -133,6 +133,7 @@ class MultiLayerNetwork:
         self._train_step_fn = None
         self._output_jit = None
         self._rnn_step_jit = None
+        self._pretrain_step_jit = None
         return self
 
     # ----------------------------------------------------------- flat views
@@ -585,6 +586,108 @@ class MultiLayerNetwork:
         (loss, _), grads = jax.value_and_grad(self._loss_fn, has_aux=True)(
             self.params_tree, self.state_tree, x, y, fmask, lmask, None, True, None)
         return flatten_params(grads), float(loss)
+
+    # ------------------------------------------------------- pretraining
+    def _features_to(self, params_tree, state_tree, x, layer_idx: int):
+        """Input activations for layer `layer_idx`: inference forward through the
+        layers below, then that layer's own preprocessor (ref
+        MultiLayerNetwork.pretrainLayer feeding activationFromPrevLayer). Applies the
+        same compute_dtype mixed-precision policy as _forward/_loss_fn."""
+        from deeplearning4j_tpu.nn.conf.layers.feedforward import EmbeddingLayer
+        from deeplearning4j_tpu.util.dtypes import cast_floats
+        cd = self.compute_dtype
+        mixed = cd != self.dtype
+        if mixed:
+            params_tree = cast_floats(params_tree, cd)
+        cur = x
+        mask = None
+        orig_batch = x.shape[0]
+        for i, layer in enumerate(self.layers[:layer_idx]):
+            if mixed and not isinstance(layer, EmbeddingLayer):
+                cur = cur.astype(cd)
+            if i in self.conf.preprocessors:
+                pp = self.conf.preprocessors[i]
+                cur = (pp.preprocess(cur, minibatch=orig_batch)
+                       if isinstance(pp, FeedForwardToRnnPreProcessor)
+                       else pp.preprocess(cur))
+            cur, _, mask = layer.forward(params_tree[i], state_tree[i], cur,
+                                         train=False, rng=None, mask=mask)
+        if layer_idx in self.conf.preprocessors:
+            pp = self.conf.preprocessors[layer_idx]
+            cur = (pp.preprocess(cur, minibatch=orig_batch)
+                   if isinstance(pp, FeedForwardToRnnPreProcessor)
+                   else pp.preprocess(cur))
+        return cur.astype(self.dtype) if mixed else cur
+
+    def pretrain_layer(self, layer_idx: int, data, epochs: int = 1) -> float:
+        """Unsupervised pretraining of one layer (ref MultiLayerNetwork.pretrainLayer
+        :379-441). AutoEncoder/VariationalAutoencoder optimize their `pretrain_score`
+        via autodiff; RBM supplies direct CD-k statistics via `pretrain_grads`. The
+        whole step (lower-layer forward + objective + updater) is one jitted XLA
+        computation. Returns the last pretrain score."""
+        self._check_init()
+        layer = self.layers[layer_idx]
+        has_score = hasattr(layer, "pretrain_score")
+        has_grads = hasattr(layer, "pretrain_grads")
+        if not (has_score or has_grads):
+            return float("nan")
+        updater = self._updaters[layer_idx]
+        _normalize = _normalize_gradients
+
+        if getattr(self, "_pretrain_step_jit", None) is None:
+            self._pretrain_step_jit = {}
+        if layer_idx not in self._pretrain_step_jit:
+            def step(layer_params, opt_i, below_params, below_states, x, step_no, rng):
+                # below_* cover layers [0, layer_idx) only, so the donated layer
+                # buffers (args 0/1) are never aliased by another argument
+                feat = self._features_to(below_params, below_states, x, layer_idx)
+                feat = jax.lax.stop_gradient(feat)
+                if has_grads:  # RBM: CD-k statistics are the gradient estimate
+                    grads, score = layer.pretrain_grads(layer_params, feat, rng)
+                    reg_g = jax.grad(layer.regularization_score)(layer_params)
+                    grads = jax.tree_util.tree_map(lambda g, r: g + r, grads, reg_g)
+                else:
+                    score, grads = jax.value_and_grad(
+                        lambda p: layer.pretrain_score(p, feat, rng)
+                        + layer.regularization_score(p))(layer_params)
+                g = _normalize(layer, grads)
+                upd, new_opt = updater.update(g, opt_i, layer_params, step_no)
+                new_params = jax.tree_util.tree_map(lambda p, d: p - d,
+                                                    layer_params, upd)
+                return new_params, new_opt, score
+
+            self._pretrain_step_jit[layer_idx] = jax.jit(step, donate_argnums=(0, 1))
+        step_jit = self._pretrain_step_jit[layer_idx]
+        score = jnp.nan  # device scalar; host sync deferred to the single return
+
+        def one_batch(x):
+            nonlocal score
+            self._rng, sub = jax.random.split(self._rng)
+            new_p, new_opt, score = step_jit(
+                self.params_tree[layer_idx], self._opt_state[layer_idx],
+                self.params_tree[:layer_idx], self.state_tree[:layer_idx],
+                jnp.asarray(x, self.dtype), jnp.asarray(self._step, jnp.int32), sub)
+            self.params_tree[layer_idx] = new_p
+            self._opt_state[layer_idx] = new_opt
+            self._step += 1
+
+        for _ in range(epochs):
+            if hasattr(data, "reset") and hasattr(data, "__iter__"):
+                data.reset()
+                for ds in data:
+                    one_batch(ds.features)
+            else:
+                one_batch(data.features if hasattr(data, "features") else data)
+        self._train_step_fn = None  # param buffers were donated; retrace safely
+        self._output_jit = None
+        return float(score)
+
+    def pretrain(self, data, epochs: int = 1) -> None:
+        """Layerwise greedy pretraining over every pretrainable layer, bottom-up
+        (ref MultiLayerNetwork.pretrain(DataSetIterator) :358-377)."""
+        for i, layer in enumerate(self.layers):
+            if hasattr(layer, "pretrain_score") or hasattr(layer, "pretrain_grads"):
+                self.pretrain_layer(i, data, epochs=epochs)
 
     # ------------------------------------------------------------- rnn API
     def rnn_time_step(self, x) -> jnp.ndarray:

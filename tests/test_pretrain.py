@@ -1,0 +1,245 @@
+"""VAE / RBM / CenterLoss + layerwise pretraining tests.
+
+Parity: ref deeplearning4j-core gradientcheck/VaeGradientCheckTests.java (pretrain +
+supervised VAE gradients across reconstruction distributions), CenterLossOutputLayerTest,
+and the MultiLayerNetwork.pretrain layerwise path (MultiLayerNetwork.java:358-441)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    Activation, BernoulliReconstructionDistribution, CenterLossOutputLayer,
+    CompositeReconstructionDistribution, DenseLayer,
+    ExponentialReconstructionDistribution, GaussianReconstructionDistribution,
+    InputType, LossFunction, LossFunctionWrapper, MultiLayerNetwork,
+    NeuralNetConfiguration, OutputLayer, RBM, Sgd, VariationalAutoencoder, WeightInit)
+from deeplearning4j_tpu.gradientcheck import check_gradients
+from deeplearning4j_tpu.util.flat_params import flatten_params, unflatten_params
+
+RNG = np.random.RandomState(12345)
+
+
+def build(layers, input_type, lr=0.1):
+    b = (NeuralNetConfiguration.Builder()
+         .seed(12345).weight_init(WeightInit.XAVIER).activation(Activation.TANH)
+         .updater(Sgd(learning_rate=lr)).dtype("float64").list())
+    for l in layers:
+        b.layer(l)
+    conf = b.set_input_type(input_type).build()
+    return MultiLayerNetwork(conf).init()
+
+
+def onehot(classes, n):
+    return np.eye(n)[classes]
+
+
+def _check_pretrain_gradients(layer, x, *, eps=1e-6, tol=1e-5):
+    """Central-difference check of layer.pretrain_score over its own flat params."""
+    key = jax.random.PRNGKey(0)
+    params = layer.init_params(jax.random.PRNGKey(1), None, jnp.float64)
+
+    def score_flat(flat):
+        return layer.pretrain_score(unflatten_params([params], flat)[0], x, key)
+
+    score = jax.jit(score_flat)
+    flat0 = np.array(flatten_params([params]), np.float64)
+    analytic = np.asarray(jax.jit(jax.grad(score_flat))(jnp.asarray(flat0)))
+    for i in range(0, flat0.shape[0], max(1, flat0.shape[0] // 80)):
+        up, dn = flat0.copy(), flat0.copy()
+        up[i] += eps
+        dn[i] -= eps
+        fd = (float(score(jnp.asarray(up))) - float(score(jnp.asarray(dn)))) / (2 * eps)
+        denom = max(abs(fd), abs(analytic[i]))
+        if denom > 1e-8:
+            assert abs(fd - analytic[i]) / denom < tol, \
+                f"param {i}: fd={fd} analytic={analytic[i]}"
+
+
+@pytest.mark.parametrize("dist", [
+    GaussianReconstructionDistribution(Activation.IDENTITY),
+    GaussianReconstructionDistribution(Activation.TANH),
+    BernoulliReconstructionDistribution(),
+    ExponentialReconstructionDistribution(),
+    LossFunctionWrapper(Activation.IDENTITY, LossFunction.MSE),
+])
+def test_vae_pretrain_gradients(dist):
+    vae = VariationalAutoencoder(
+        n_in=6, n_out=3, encoder_layer_sizes=(5,), decoder_layer_sizes=(4,),
+        activation=Activation.TANH, reconstruction_distribution=dist,
+        weight_init=WeightInit.XAVIER, num_samples=1)
+    x = RNG.rand(4, 6)
+    if isinstance(dist, BernoulliReconstructionDistribution):
+        x = (x > 0.5).astype(np.float64)
+    _check_pretrain_gradients(vae, jnp.asarray(x, jnp.float64))
+
+
+def test_vae_composite_pretrain_gradients():
+    dist = CompositeReconstructionDistribution([
+        (3, GaussianReconstructionDistribution(Activation.IDENTITY)),
+        (3, BernoulliReconstructionDistribution()),
+    ])
+    vae = VariationalAutoencoder(
+        n_in=6, n_out=2, encoder_layer_sizes=(5,), decoder_layer_sizes=(5,),
+        activation=Activation.TANH, reconstruction_distribution=dist)
+    x = np.concatenate([RNG.rand(4, 3), (RNG.rand(4, 3) > 0.5).astype(float)], axis=1)
+    _check_pretrain_gradients(vae, jnp.asarray(x, jnp.float64))
+
+
+def test_vae_supervised_gradients():
+    """VAE as a hidden layer: supervised forward = q(z|x) mean (ref
+    VaeGradientCheckTests.testVaeAsMLP)."""
+    net = build([VariationalAutoencoder(n_out=3, encoder_layer_sizes=(5,),
+                                        decoder_layer_sizes=(5,)),
+                 OutputLayer(n_out=2)], InputType.feed_forward(4))
+    x = RNG.rand(5, 4)
+    y = onehot(RNG.randint(0, 2, 5), 2)
+    assert check_gradients(net, x, y)
+
+
+def test_vae_pretrain_improves_elbo():
+    vae = VariationalAutoencoder(
+        n_in=8, n_out=2, encoder_layer_sizes=(8,), decoder_layer_sizes=(8,),
+        activation=Activation.TANH,
+        reconstruction_distribution=GaussianReconstructionDistribution(
+            Activation.IDENTITY))
+    net = build([vae, OutputLayer(n_out=2)], InputType.feed_forward(8), lr=0.05)
+    x = RNG.rand(32, 8)
+    layer = net.layers[0]
+    key = jax.random.PRNGKey(7)
+    before = float(layer.pretrain_score(net.params_tree[0], jnp.asarray(x), key))
+    for _ in range(60):
+        net.pretrain_layer(0, x)
+    after = float(layer.pretrain_score(net.params_tree[0], jnp.asarray(x), key))
+    assert after < before
+
+
+def test_vae_reconstruction_api():
+    vae = VariationalAutoencoder(
+        n_in=6, n_out=2, encoder_layer_sizes=(5,), decoder_layer_sizes=(5,),
+        reconstruction_distribution=BernoulliReconstructionDistribution())
+    params = vae.init_params(jax.random.PRNGKey(0), None, jnp.float64)
+    x = jnp.asarray((RNG.rand(3, 6) > 0.5).astype(np.float64))
+    lp = vae.reconstruction_log_probability(params, x, num_samples=4)
+    assert lp.shape == (3,)
+    assert np.all(np.asarray(lp) <= 0.0 + 1e-9)
+    z = jnp.asarray(RNG.randn(3, 2))
+    mean = vae.generate_at_mean_given_z(params, z)
+    assert mean.shape == (3, 6)
+    rnd = vae.generate_random_given_z(params, z, jax.random.PRNGKey(1))
+    assert set(np.unique(np.asarray(rnd))) <= {0.0, 1.0}
+
+
+def test_lossfunctionwrapper_has_no_log_prob():
+    vae = VariationalAutoencoder(
+        n_in=4, n_out=2,
+        reconstruction_distribution=LossFunctionWrapper(
+            Activation.IDENTITY, LossFunction.MSE))
+    params = vae.init_params(jax.random.PRNGKey(0), None, jnp.float64)
+    with pytest.raises(ValueError):
+        vae.reconstruction_log_probability(params, jnp.zeros((2, 4)))
+    err = vae.reconstruction_error(params, jnp.asarray(RNG.rand(2, 4)))
+    assert err.shape == (2,)
+
+
+def test_rbm_cd_pretrain_reduces_reconstruction_error():
+    rbm = RBM(n_in=12, n_out=6, activation=Activation.SIGMOID, k=1)
+    net = build([rbm, OutputLayer(n_out=2)], InputType.feed_forward(12), lr=0.2)
+    # two binary prototypes + noise: CD should learn the modes
+    protos = np.array([[1, 1, 1, 1, 1, 1, 0, 0, 0, 0, 0, 0],
+                       [0, 0, 0, 0, 0, 0, 1, 1, 1, 1, 1, 1]], float)
+    x = protos[RNG.randint(0, 2, 64)]
+    flip = RNG.rand(64, 12) < 0.05
+    x = np.where(flip, 1 - x, x)
+
+    layer = net.layers[0]
+    params0 = {k: jnp.array(v, copy=True) for k, v in net.params_tree[0].items()}
+    _, before = layer.pretrain_grads(params0, jnp.asarray(x), jax.random.PRNGKey(3))
+    for _ in range(40):
+        net.pretrain_layer(0, x)
+    _, after = layer.pretrain_grads(net.params_tree[0], jnp.asarray(x),
+                                    jax.random.PRNGKey(3))
+    assert float(after) < float(before)
+
+
+def test_rbm_supervised_gradients():
+    net = build([RBM(n_out=5, activation=Activation.SIGMOID), OutputLayer(n_out=3)],
+                InputType.feed_forward(4))
+    x = RNG.rand(5, 4)
+    y = onehot(RNG.randint(0, 3, 5), 3)
+    assert check_gradients(net, x, y)
+
+
+def test_center_loss_gradients():
+    net = build([DenseLayer(n_out=5),
+                 CenterLossOutputLayer(n_out=3, lambda_=0.1, gradient_check=True)],
+                InputType.feed_forward(4))
+    # move centers off zero so their gradient is non-trivial
+    net.params_tree[-1]["cL"] = jnp.asarray(RNG.randn(3, 5) * 0.1)
+    x = RNG.rand(6, 4)
+    y = onehot(RNG.randint(0, 3, 6), 3)
+    assert check_gradients(net, x, y)
+
+
+def test_center_loss_pulls_features_to_centers():
+    net = build([DenseLayer(n_out=4),
+                 CenterLossOutputLayer(n_out=2, lambda_=1.0, alpha=0.1,
+                                       gradient_check=False)],
+                InputType.feed_forward(4), lr=0.1)
+    x = RNG.rand(16, 4)
+    y = onehot(RNG.randint(0, 2, 16), 2)
+    assert np.allclose(np.asarray(net.params_tree[-1]["cL"]), 0.0)
+    for _ in range(20):
+        net.fit_batch(x, y)
+    # centers moved toward class feature means (alpha EMA-style gradient)
+    assert float(jnp.abs(net.params_tree[-1]["cL"]).sum()) > 0.0
+
+
+def test_layerwise_pretrain_then_finetune():
+    """pretrain() sweeps AutoEncoder/VAE/RBM layers bottom-up, then supervised fit
+    still works on the same network (ref pretrain-then-backprop workflow)."""
+    net = build([RBM(n_out=8, activation=Activation.SIGMOID),
+                 VariationalAutoencoder(n_out=4, encoder_layer_sizes=(6,),
+                                        decoder_layer_sizes=(6,)),
+                 OutputLayer(n_out=2)], InputType.feed_forward(10), lr=0.05)
+    x = (RNG.rand(32, 10) > 0.5).astype(np.float64)
+    y = onehot(RNG.randint(0, 2, 32), 2)
+    net.pretrain(x, epochs=3)
+    s0 = None
+    for _ in range(30):
+        net.fit_batch(x, y)
+        if s0 is None:
+            s0 = net.score()
+    assert net.score() < s0
+
+
+def test_vae_conf_serde_round_trip():
+    dist = CompositeReconstructionDistribution([
+        (2, GaussianReconstructionDistribution(Activation.TANH)),
+        (2, BernoulliReconstructionDistribution()),
+    ])
+    conf = (NeuralNetConfiguration.Builder().seed(1).dtype("float64")
+            .updater(Sgd(learning_rate=0.1)).list()
+            .layer(VariationalAutoencoder(n_in=4, n_out=2, encoder_layer_sizes=(3, 3),
+                                          decoder_layer_sizes=(3,),
+                                          reconstruction_distribution=dist,
+                                          num_samples=2))
+            .layer(CenterLossOutputLayer(n_in=2, n_out=2, alpha=0.2, lambda_=0.3))
+            .set_input_type(InputType.feed_forward(4)).build())
+    from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+    conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+    vae2 = conf2.layers[0]
+    assert isinstance(vae2, VariationalAutoencoder)
+    assert vae2.encoder_layer_sizes == (3, 3)
+    assert vae2.num_samples == 2
+    d2 = vae2.reconstruction_distribution
+    assert isinstance(d2, CompositeReconstructionDistribution)
+    assert isinstance(d2.components[0][1], GaussianReconstructionDistribution)
+    assert d2.components[0][1].activation == Activation.TANH
+    cl2 = conf2.layers[1]
+    assert isinstance(cl2, CenterLossOutputLayer)
+    assert cl2.alpha == 0.2 and cl2.lambda_ == 0.3
+    # params init identically from the round-tripped conf
+    n1 = MultiLayerNetwork(conf).init()
+    n2 = MultiLayerNetwork(conf2).init()
+    assert np.allclose(np.asarray(n1.params()), np.asarray(n2.params()))
