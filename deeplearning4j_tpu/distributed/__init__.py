@@ -1,0 +1,18 @@
+"""Cluster scale-out (L6): multi-host training masters over jax.distributed.
+
+TPU-native redesign of the reference's Spark scale-out stack
+(deeplearning4j-scaleout/spark): the Spark driver/executor split and the Aeron-based
+parameter server are replaced by JAX's multi-process SPMD runtime — every host runs the
+same program, `jax.distributed.initialize` forms the global device mesh, and the
+DP-3/DP-4 synchronization semantics ride XLA collectives (ICI in-slice, DCN across
+hosts) instead of NCCL/Aeron unicast.
+"""
+from deeplearning4j_tpu.distributed.conf import VoidConfiguration, initialize_cluster
+from deeplearning4j_tpu.distributed.training_master import (
+    DistributedComputationGraph, DistributedMultiLayer,
+    ParameterAveragingTrainingMaster, SharedTrainingMaster)
+
+__all__ = [
+    "VoidConfiguration", "initialize_cluster", "ParameterAveragingTrainingMaster",
+    "SharedTrainingMaster", "DistributedMultiLayer", "DistributedComputationGraph",
+]

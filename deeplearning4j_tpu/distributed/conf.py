@@ -1,0 +1,95 @@
+"""Cluster configuration + process bootstrap.
+
+Parity: ref nd4j VoidConfiguration (the parameter-server transport config consumed by
+SharedTrainingMaster, ref deeplearning4j-scaleout/spark/dl4j-spark-parameterserver/
+.../training/SharedTrainingMaster.java:46-53) — here it describes the JAX coordinator
+instead of the Aeron unicast/multicast fabric. `network_mask`/`transport_type` are
+accepted for API parity and ignored: device-to-device transport is XLA's job.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class VoidConfiguration:
+    """Coordinator description for a multi-process cluster.
+
+    controller_address — host:port of process 0's coordinator service (maps to the
+    reference's controllerAddress on the param-server master).
+    num_processes / process_id — the jax.distributed world; None means single-process
+    (the `local[N]` test analog runs everything in one process on a virtual mesh).
+    """
+    controller_address: Optional[str] = None
+    num_processes: Optional[int] = None
+    process_id: Optional[int] = None
+    port: int = 40123  # parity field; folded into controller_address when absent
+    network_mask: Optional[str] = None     # parity no-op
+    transport_type: Optional[str] = None   # parity no-op (XLA picks ICI/DCN)
+    streams_per_device: int = 1            # parity no-op
+
+    # camelCase parity shims
+    @classmethod
+    def builder(cls):
+        return _VoidBuilder()
+
+    def unicast_port(self, p: int):
+        self.port = int(p)
+        return self
+
+
+class _VoidBuilder:
+    def __init__(self):
+        self._kw = {}
+
+    def controllerAddress(self, a):
+        self._kw["controller_address"] = a
+        return self
+    controller_address = controllerAddress
+
+    def unicastPort(self, p):
+        self._kw["port"] = int(p)
+        return self
+
+    def networkMask(self, m):
+        self._kw["network_mask"] = m
+        return self
+
+    def numProcesses(self, n):
+        self._kw["num_processes"] = int(n)
+        return self
+
+    def processId(self, i):
+        self._kw["process_id"] = int(i)
+        return self
+
+    def build(self) -> VoidConfiguration:
+        return VoidConfiguration(**self._kw)
+
+
+_initialized = False
+
+
+def initialize_cluster(config: VoidConfiguration) -> None:
+    """Join the multi-process world (ref: Spark context + VoidParameterServer.init).
+
+    Must run before the first device query in this process. No-op for
+    single-process configs and on repeat calls."""
+    global _initialized
+    if _initialized or config.num_processes is None or config.num_processes <= 1:
+        return
+    import jax
+    try:  # already joined (e.g. the worker bootstrapped before importing models)
+        from jax._src import distributed as _dist
+        if getattr(_dist.global_state, "client", None) is not None:
+            _initialized = True
+            return
+    except Exception:
+        pass
+    addr = config.controller_address
+    if addr and ":" not in addr:
+        addr = f"{addr}:{config.port}"
+    jax.distributed.initialize(addr, num_processes=config.num_processes,
+                               process_id=config.process_id)
+    _initialized = True

@@ -1,0 +1,88 @@
+"""Worker process for multi-host training-master tests (the reference's Spark
+`local[N]` cluster tests, SURVEY §4.5, rendered as real multi-process SPMD).
+
+Usage: python _dist_worker.py <mode> <process_id> <num_processes> <port> <out_path>
+
+Every process builds the SAME config (config-as-JSON shipping), loads ITS slice of a
+deterministic synthetic dataset, and runs the training master. Process 0 writes the
+final flat params + last score to <out_path> (.npz) for parity comparison against a
+single-process 8-virtual-device run of the same global batches.
+"""
+import os
+import sys
+
+if __name__ == "__main__":
+    mode, pid, nproc, port, out_path = (sys.argv[1], int(sys.argv[2]),
+                                        int(sys.argv[3]), int(sys.argv[4]),
+                                        sys.argv[5])
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=4")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    # must join the world before ANY backend-initializing call (importing the
+    # package builds jnp arrays in layer defaults)
+    jax.distributed.initialize(f"localhost:{port}", num_processes=nproc,
+                               process_id=pid)
+
+import numpy as np  # noqa: E402
+
+GLOBAL_BATCH = 32
+STEPS = 6
+
+
+def build_conf_json():
+    from deeplearning4j_tpu import (
+        Activation, DenseLayer, InputType, NeuralNetConfiguration, OutputLayer, Sgd,
+        WeightInit)
+    b = (NeuralNetConfiguration.Builder().seed(7).weight_init(WeightInit.XAVIER)
+         .activation(Activation.TANH).updater(Sgd(learning_rate=0.1)).dtype("float64")
+         .list())
+    b.layer(DenseLayer(n_out=8))
+    b.layer(OutputLayer(n_out=3))
+    return b.set_input_type(InputType.feed_forward(5)).build().to_json()
+
+
+def global_batches():
+    rng = np.random.RandomState(99)
+    for _ in range(STEPS):
+        x = rng.rand(GLOBAL_BATCH, 5)
+        y = np.eye(3)[rng.randint(0, 3, GLOBAL_BATCH)]
+        yield x, y
+
+
+def main():
+    from deeplearning4j_tpu.distributed import (
+        DistributedMultiLayer, ParameterAveragingTrainingMaster,
+        SharedTrainingMaster, VoidConfiguration)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    vc = VoidConfiguration(controller_address=f"localhost:{port}",
+                           num_processes=nproc, process_id=pid)
+    if mode == "averaging":
+        tm = (ParameterAveragingTrainingMaster.Builder(16)
+              .averagingFrequency(2).collectTrainingStats(True)
+              .voidConfiguration(vc).build())
+    else:
+        tm = (SharedTrainingMaster.Builder(vc)
+              .batchSizePerWorker(16).updatesThreshold(1e-3).build())
+    net = DistributedMultiLayer(build_conf_json(), tm)
+
+    # this process's rows: the global batch is laid out process-major over devices
+    per_proc = GLOBAL_BATCH // nproc
+    lo, hi = pid * per_proc, (pid + 1) * per_proc
+    score = None
+    for x, y in global_batches():
+        net.fit(DataSet(x[lo:hi], y[lo:hi]))
+        score = net.score()
+
+    if pid == 0:
+        w = net._wrapper
+        w._write_back()
+        np.savez(out_path, params=np.asarray(net.network.params()), score=score)
+    print(f"worker {pid} done score={score}")
+
+
+if __name__ == "__main__":
+    main()
