@@ -304,7 +304,7 @@ class ComputationGraph:
                                static_argnames=("n",))
             def run(params, opt, states, step, rng, x, y, fmask, lmask, n):
                 def body(carry, _):
-                    params_c, opt_c, states_c, step_c, rng_c = carry
+                    params_c, opt_c, states_c, step_c, rng_c, div_c = carry
                     rng_c, sub = jax.random.split(rng_c)
 
                     def loss_fn(p):
@@ -316,20 +316,38 @@ class ComputationGraph:
                         params_c)
                     newp, newo = _apply_updates(layer_confs, updaters, grads, opt_c,
                                                 params_c, step_c)
-                    return (newp, newo, ns, step_c + 1, rng_c), loss
+                    # divergence sentinel — see MultiLayerNetwork.fit_on_device
+                    bad = jnp.logical_or(~jnp.isfinite(loss), div_c >= 0)
+                    keep = lambda new, old: jax.tree_util.tree_map(
+                        lambda a, b: jnp.where(bad, b, a), new, old)
+                    newp = keep(newp, params_c)
+                    newo = keep(newo, opt_c)
+                    ns = keep(ns, states_c)
+                    div_c = jnp.where(jnp.logical_and(div_c < 0,
+                                                      ~jnp.isfinite(loss)),
+                                      step_c, div_c)
+                    return (newp, newo, ns, step_c + 1, rng_c, div_c), loss
 
-                carry, losses = jax.lax.scan(body, (params, opt, states, step, rng),
-                                             None, length=n)
+                div0 = jnp.asarray(-1, jnp.int32)
+                carry, losses = jax.lax.scan(
+                    body, (params, opt, states, step, rng, div0), None, length=n)
                 return carry, losses
             self._device_loop_cache[cache_key] = run
 
         self._rng, sub = jax.random.split(self._rng)
-        (self.params_tree, self._opt_state, self.state_tree, _, _), losses = run(
+        (self.params_tree, self._opt_state, self.state_tree, _, _, div), losses = run(
             self.params_tree, self._opt_state, self.state_tree,
             jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask, n=int(steps))
         self._step += int(steps)
         losses = np.asarray(losses)
         self._score = float(losses[-1])
+        div = int(div)
+        self._diverged_at = div if div >= 0 else None
+        if self._diverged_at is not None:
+            import warnings
+            warnings.warn(
+                f"Training diverged: non-finite loss at step {self._diverged_at}; "
+                f"parameters frozen at the last finite step")
         return losses
 
     def fit(self, data, labels=None, epochs: int = 1):

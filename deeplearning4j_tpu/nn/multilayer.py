@@ -407,7 +407,7 @@ class MultiLayerNetwork:
                                static_argnames=("n",))
             def run(params, opt, states, step, rng, x, y, fmask, lmask, n):
                 def body(carry, xs):
-                    params_c, opt_c, states_c, step_c, rng_c = carry
+                    params_c, opt_c, states_c, step_c, rng_c, div_c = carry
                     if per_step_data:
                         bx, by = xs[0], xs[1]
                         bfm = xs[2] if has_fm else None
@@ -425,25 +425,47 @@ class MultiLayerNetwork:
                         params_c)
                     newp, newo = _apply_updates(layers, updaters, grads, opt_c,
                                                 params_c, step_c)
-                    return (newp, newo, ns, step_c + 1, rng_c), loss
+                    # divergence sentinel (SURVEY §5 failure detection): once a
+                    # non-finite loss appears, freeze params/opt/state for the rest
+                    # of the scan and record the first bad step — a cheap select per
+                    # buffer, no host sync inside the loop
+                    bad = jnp.logical_or(~jnp.isfinite(loss), div_c >= 0)
+                    keep = lambda new, old: jax.tree_util.tree_map(
+                        lambda a, b: jnp.where(bad, b, a), new, old)
+                    newp = keep(newp, params_c)
+                    newo = keep(newo, opt_c)
+                    ns = keep(ns, states_c)
+                    div_c = jnp.where(jnp.logical_and(div_c < 0,
+                                                      ~jnp.isfinite(loss)),
+                                      step_c, div_c)
+                    return (newp, newo, ns, step_c + 1, rng_c, div_c), loss
 
                 if per_step_data:
                     xs = (x, y) + ((fmask,) if has_fm else ()) \
                         + ((lmask,) if has_lm else ())
                 else:
                     xs = None
-                carry, losses = jax.lax.scan(body, (params, opt, states, step, rng),
-                                             xs, length=n)
+                div0 = jnp.asarray(-1, jnp.int32)
+                carry, losses = jax.lax.scan(
+                    body, (params, opt, states, step, rng, div0), xs, length=n)
                 return carry, losses
             self._device_loop_cache[cache_key] = run
 
         self._rng, sub = jax.random.split(self._rng)
-        (self.params_tree, self._opt_state, self.state_tree, _, _), losses = run(
+        (self.params_tree, self._opt_state, self.state_tree, _, _, div), losses = run(
             self.params_tree, self._opt_state, self.state_tree,
             jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask, n=int(steps))
         self._step += int(steps)
         losses = np.asarray(losses)
         self._score = float(losses[-1])
+        div = int(div)
+        self._diverged_at = div if div >= 0 else None
+        if self._diverged_at is not None:
+            import warnings
+            warnings.warn(
+                f"Training diverged: non-finite loss at step {self._diverged_at}; "
+                f"parameters frozen at the last finite step "
+                f"(ref InvalidScoreIterationTerminationCondition semantics)")
         return losses
 
     def fit(self, data, labels=None, epochs: int = 1):

@@ -1,0 +1,19 @@
+"""Observability (L9): stats capture, storage, and dashboard.
+
+Parity: ref deeplearning4j-ui-parent — ui-model (BaseStatsListener + StatsStorage API),
+ui (play-based dashboard server). TPU-first: stats summaries (mean/stdev/magnitude/
+histograms) are computed on device in one fused jitted computation per report, then
+shipped host-side as plain dicts; the dashboard is a stdlib HTTP server over the same
+storage API instead of a Play/Netty stack.
+"""
+from deeplearning4j_tpu.ui.storage import (
+    FileStatsStorage, InMemoryStatsStorage, StatsStorage, StatsStorageEvent,
+    StatsStorageRouter)
+from deeplearning4j_tpu.ui.stats import ProfilerListener, StatsListener
+from deeplearning4j_tpu.ui.server import RemoteUIStatsStorageRouter, UIServer
+
+__all__ = [
+    "StatsStorage", "StatsStorageRouter", "StatsStorageEvent", "InMemoryStatsStorage",
+    "FileStatsStorage", "StatsListener", "ProfilerListener", "UIServer",
+    "RemoteUIStatsStorageRouter",
+]

@@ -1,0 +1,190 @@
+"""Training dashboard server + remote stats routing.
+
+Parity: ref deeplearning4j-ui/.../play/PlayUIServer.java (UIServer.getInstance().
+attach(statsStorage) + web dashboard) and ui-model's RemoteUIStatsStorageRouter
+(HTTP POST of stats records to a remote UI). TPU-first rendering: a stdlib
+ThreadingHTTPServer over the JSON StatsStorage records and a self-contained HTML page
+that polls and draws the score chart + layer summaries with inline SVG — no Play
+framework, no websockets, zero dependencies.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+from deeplearning4j_tpu.ui.storage import StatsStorage, StatsStorageRouter
+
+_PAGE = """<!DOCTYPE html>
+<html><head><title>deeplearning4j_tpu training UI</title>
+<style>
+body{font-family:sans-serif;margin:20px;background:#fafafa}
+h2{margin:8px 0}.row{display:flex;gap:24px;flex-wrap:wrap}
+.card{background:#fff;border:1px solid #ddd;border-radius:6px;padding:12px}
+svg{background:#fff}table{border-collapse:collapse}
+td,th{border:1px solid #ccc;padding:3px 8px;font-size:13px}
+</style></head><body>
+<h2>Training sessions</h2><div id="sessions"></div>
+<div class="row">
+ <div class="card"><h3>Score vs iteration</h3><svg id="chart" width="640" height="320"></svg></div>
+ <div class="card"><h3>Model</h3><pre id="info" style="font-size:12px"></pre>
+ <h3>Last update</h3><table id="layers"></table></div>
+</div>
+<script>
+let sid=null;
+async function j(u){const r=await fetch(u);return r.json()}
+async function refresh(){
+ const sessions=await j('/train/sessions');
+ document.getElementById('sessions').textContent=sessions.join(', ');
+ if(!sid&&sessions.length)sid=sessions[0];
+ if(!sid)return;
+ const info=await j('/train/sessions/'+sid+'/info');
+ if(info&&info.model)document.getElementById('info').textContent=
+   'params: '+info.model.num_params+'\\nlayers: '+info.model.num_layers+
+   '\\ndevice: '+(info.hardware?info.hardware.device_kind:'?');
+ const ups=await j('/train/sessions/'+sid+'/updates');
+ if(!ups.length)return;
+ drawChart(ups.map(u=>[u.iteration,u.score]));
+ const last=ups[ups.length-1];
+ let html='<tr><th>layer</th><th>param mean</th><th>stdev</th><th>|mean|</th></tr>';
+ const ps=(last.stats&&last.stats.params)||{};
+ for(const k of Object.keys(ps)){const s=ps[k];
+  html+='<tr><td>'+k+'</td><td>'+s.mean.toExponential(3)+'</td><td>'+
+    s.stdev.toExponential(3)+'</td><td>'+s.mean_magnitude.toExponential(3)+'</td></tr>'}
+ document.getElementById('layers').innerHTML=html;
+}
+function drawChart(pts){
+ const svg=document.getElementById('chart'),W=640,H=320,P=40;
+ const xs=pts.map(p=>p[0]),ys=pts.map(p=>p[1]).filter(isFinite);
+ const x0=Math.min(...xs),x1=Math.max(...xs),y0=Math.min(...ys),y1=Math.max(...ys);
+ const sx=v=>P+(W-2*P)*(v-x0)/Math.max(1e-12,x1-x0);
+ const sy=v=>H-P-(H-2*P)*(v-y0)/Math.max(1e-12,y1-y0);
+ let d='';pts.forEach((p,i)=>{if(isFinite(p[1]))d+=(d?'L':'M')+sx(p[0])+' '+sy(p[1])});
+ svg.innerHTML='<path d="'+d+'" stroke="#36c" fill="none" stroke-width="1.5"/>'+
+  '<text x="'+(W/2)+'" y="'+(H-8)+'" font-size="12">iteration</text>'+
+  '<text x="6" y="'+(P-10)+'" font-size="12">'+y1.toPrecision(4)+'</text>'+
+  '<text x="6" y="'+(H-P)+'" font-size="12">'+y0.toPrecision(4)+'</text>';
+}
+setInterval(refresh,2000);refresh();
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dl4jtpu-ui/1.0"
+    storage: Optional[StatsStorage] = None
+
+    def log_message(self, *a):  # quiet
+        pass
+
+    def _json(self, obj, code=200):
+        body = json.dumps(obj, default=str).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):
+        st = self.server.stats_storage  # type: ignore[attr-defined]
+        url = urlparse(self.path)
+        parts = [p for p in url.path.split("/") if p]
+        if not parts:
+            body = _PAGE.encode()
+            self.send_response(200)
+            self.send_header("Content-Type", "text/html")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if parts[0] != "train" or st is None:
+            self._json({"error": "not found"}, 404)
+            return
+        if len(parts) == 2 and parts[1] == "sessions":
+            self._json(st.list_session_ids())
+        elif len(parts) == 4 and parts[1] == "sessions" and parts[3] == "info":
+            self._json(st.get_static_info(parts[2]))
+        elif len(parts) == 4 and parts[1] == "sessions" and parts[3] == "updates":
+            q = parse_qs(url.query)
+            after = float(q.get("after", ["0"])[0])
+            ups = st.get_all_updates(parts[2])
+            self._json([u for u in ups if u.get("timestamp", 0) > after])
+        else:
+            self._json({"error": "not found"}, 404)
+
+    def do_POST(self):
+        """Remote stats sink (ref RemoteUIStatsStorageRouter receiving endpoint)."""
+        st = self.server.stats_storage  # type: ignore[attr-defined]
+        if self.path != "/remote/receive" or st is None:
+            self._json({"error": "not found"}, 404)
+            return
+        n = int(self.headers.get("Content-Length", "0"))
+        entry = json.loads(self.rfile.read(n).decode())
+        if entry.get("kind") == "static":
+            st.put_static_info(entry["record"])
+        else:
+            st.put_update(entry["record"])
+        self._json({"ok": True})
+
+
+class UIServer:
+    """(ref api/UIServer.java getInstance/attach/detach) — serves the dashboard and
+    the JSON stats API on localhost."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 9000):
+        self._httpd = ThreadingHTTPServer(("localhost", port), _Handler)
+        self._httpd.stats_storage = None  # type: ignore[attr-defined]
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @classmethod
+    def get_instance(cls, port: int = 9000) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = cls(port)
+        return cls._instance
+    getInstance = get_instance
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def attach(self, storage: StatsStorage) -> None:
+        self._httpd.stats_storage = storage  # type: ignore[attr-defined]
+
+    def detach(self, storage: StatsStorage = None) -> None:
+        self._httpd.stats_storage = None  # type: ignore[attr-defined]
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if UIServer._instance is self:
+            UIServer._instance = None
+
+
+class RemoteUIStatsStorageRouter(StatsStorageRouter):
+    """Client-side router POSTing records to a UIServer's /remote/receive
+    (ref impl/RemoteUIStatsStorageRouter.java)."""
+
+    def __init__(self, address: str):
+        # address like "http://localhost:9000"
+        self.address = address.rstrip("/")
+
+    def _post(self, kind: str, record: dict):
+        import urllib.request
+        data = json.dumps({"kind": kind, "record": record},
+                          default=str).encode()
+        req = urllib.request.Request(
+            self.address + "/remote/receive", data=data,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as resp:
+            return json.loads(resp.read().decode())
+
+    def put_static_info(self, record: dict) -> None:
+        self._post("static", record)
+
+    def put_update(self, record: dict) -> None:
+        self._post("update", record)

@@ -1,0 +1,218 @@
+"""StatsListener: per-iteration training statistics into a StatsStorage.
+
+Parity: ref deeplearning4j-ui-model/.../stats/BaseStatsListener.java:44 —
+initialization records (hardware/software/model info) + per-iteration updates (score,
+per-layer parameter/update summary stats: mean, stdev, mean magnitude, histograms;
+learning rates; memory; timing). TPU-first delta: all numeric summaries are computed
+ON DEVICE in one fused jitted computation per report (one host transfer), and
+"updates" are exact applied parameter deltas (previous snapshot minus current) rather
+than re-captured gradients — identical information post-updater, no training-path
+instrumentation needed.
+"""
+from __future__ import annotations
+
+import time
+import uuid
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.ui.storage import StatsStorageRouter
+
+_HIST_BINS = 20
+
+
+def _summary_tree(tree, hist: bool):
+    """Per-leaf-group summaries; returns a dict name -> stats arrays (device)."""
+    out = {}
+    for i, layer_params in enumerate(tree):
+        if not layer_params:
+            continue
+        flat = jnp.concatenate([jnp.ravel(v).astype(jnp.float32)
+                                for v in layer_params.values()])
+        s = {
+            "mean": jnp.mean(flat),
+            "stdev": jnp.std(flat),
+            "mean_magnitude": jnp.mean(jnp.abs(flat)),
+            "min": jnp.min(flat),
+            "max": jnp.max(flat),
+        }
+        if hist:
+            counts, edges = jnp.histogram(flat, bins=_HIST_BINS)
+            s["histogram_counts"] = counts
+            s["histogram_edges"] = edges
+        out[str(i)] = s
+    return out
+
+
+class StatsListener(TrainingListener):
+    """(ref BaseStatsListener.java:44 / StatsListener.java)
+
+    update_config flags mirror the reference's StatsUpdateConfiguration: histograms,
+    update stats, and memory reporting can each be disabled."""
+
+    def __init__(self, storage: StatsStorageRouter, frequency: int = 1,
+                 session_id: Optional[str] = None, worker_id: str = "0",
+                 collect_histograms: bool = True, collect_updates: bool = True,
+                 collect_memory: bool = True):
+        self.storage = storage
+        self.frequency = max(1, int(frequency))
+        self.session_id = session_id or f"session-{uuid.uuid4().hex[:12]}"
+        self.worker_id = worker_id
+        self.collect_histograms = collect_histograms
+        self.collect_updates = collect_updates
+        self.collect_memory = collect_memory
+        self._static_posted = False
+        self._prev_params = None
+        self._summary_jit = None
+        self._last_report_time = None
+
+    # ------------- static info (ref listener initialization records) -------------
+    def _post_static(self, model):
+        devs = jax.devices()
+        try:
+            conf_json = model.conf.to_json()
+        except Exception:
+            conf_json = None
+        layer_names = []
+        for i, layer in enumerate(getattr(model, "layers", [])):
+            layer_names.append(getattr(layer, "name", None) or
+                               f"{i}_{type(layer).__name__}")
+        record = {
+            "session_id": self.session_id, "type_id": "StatsListener",
+            "worker_id": self.worker_id, "timestamp": time.time(),
+            "hardware": {
+                "device_kind": devs[0].device_kind if devs else "unknown",
+                "device_count": len(devs),
+                "process_count": jax.process_count(),
+                "platform": devs[0].platform if devs else "unknown",
+            },
+            "software": {"jax_version": jax.__version__,
+                         "backend": jax.default_backend()},
+            "model": {
+                "config_json": conf_json,
+                "num_params": int(model.num_params()),
+                "num_layers": len(layer_names),
+                "layer_names": layer_names,
+            },
+        }
+        self.storage.put_static_info(record)
+        self._static_posted = True
+
+    # ------------- per-iteration -------------
+    def _build_summary(self, model):
+        hist = self.collect_histograms
+        upd = self.collect_updates
+
+        def f(params, prev):
+            res = {"params": _summary_tree(params, hist)}
+            if upd and prev is not None:
+                deltas = jax.tree_util.tree_map(lambda a, b: a - b, prev, params)
+                res["updates"] = _summary_tree(deltas, hist)
+            return res
+
+        return jax.jit(f)
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency != 0:
+            return
+        if not self._static_posted:
+            self._post_static(model)
+        if self._summary_jit is None:
+            self._summary_jit = self._build_summary(model)
+        params = model.params_tree
+        prev = self._prev_params if self.collect_updates else None
+        if self.collect_updates and prev is None:
+            # first report: no delta yet — jit signature needs a consistent prev
+            stats = jax.jit(lambda p: {"params": _summary_tree(
+                p, self.collect_histograms)})(params)
+        else:
+            stats = self._summary_jit(params, prev)
+        stats = jax.device_get(stats)  # ONE host transfer for the whole report
+        if self.collect_updates:
+            # deep copy: the train step donates param buffers, so holding the
+            # originals would leave deleted arrays in the snapshot
+            self._prev_params = jax.tree_util.tree_map(
+                lambda a: jnp.array(a, copy=True), params)
+
+        now = time.time()
+        record: Dict[str, Any] = {
+            "session_id": self.session_id, "type_id": "StatsListener",
+            "worker_id": self.worker_id, "timestamp": now,
+            "iteration": int(iteration),
+            "score": float(model.score()),
+            "stats": _to_python(stats),
+            "learning_rates": self._learning_rates(model),
+        }
+        if self._last_report_time is not None:
+            record["iteration_ms"] = (now - self._last_report_time) * 1e3 \
+                / self.frequency
+        self._last_report_time = now
+        if self.collect_memory:
+            record["memory"] = _memory_stats()
+        self.storage.put_update(record)
+
+    def _learning_rates(self, model) -> Dict[str, float]:
+        out = {}
+        for i, u in enumerate(getattr(model, "_updaters", [])):
+            try:
+                out[str(i)] = float(u.lr(model._step))
+            except Exception:
+                pass
+        return out
+
+
+def _to_python(obj):
+    if isinstance(obj, dict):
+        return {k: _to_python(v) for k, v in obj.items()}
+    a = np.asarray(obj)
+    if a.ndim == 0:
+        return float(a)
+    return a.tolist()
+
+
+def _memory_stats() -> Dict[str, Any]:
+    """Device HBM stats (the reference's JVM/off-heap memory block, TPU rendering)."""
+    out = {}
+    for d in jax.local_devices():
+        try:
+            ms = d.memory_stats()
+        except Exception:
+            ms = None
+        if ms:
+            out[str(d.id)] = {
+                "bytes_in_use": ms.get("bytes_in_use"),
+                "peak_bytes_in_use": ms.get("peak_bytes_in_use"),
+                "bytes_limit": ms.get("bytes_limit"),
+            }
+    return out
+
+
+class ProfilerListener(TrainingListener):
+    """XLA profiler session hook (SURVEY §5 tracing): captures a trace of iterations
+    [start_iteration, end_iteration) into `log_dir`, viewable with TensorBoard/XProf.
+    The reference's analog is its Spark per-phase timing + JVM profiler hooks."""
+
+    def __init__(self, log_dir: str, start_iteration: int = 10,
+                 end_iteration: int = 15):
+        self.log_dir = log_dir
+        self.start_iteration = int(start_iteration)
+        self.end_iteration = int(end_iteration)
+        self._active = False
+
+    def iteration_done(self, model, iteration: int):
+        if not self._active and iteration >= self.start_iteration \
+                and iteration < self.end_iteration:
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+        elif self._active and iteration >= self.end_iteration:
+            jax.profiler.stop_trace()
+            self._active = False
+
+    def on_epoch_end(self, model):
+        if self._active:
+            jax.profiler.stop_trace()
+            self._active = False

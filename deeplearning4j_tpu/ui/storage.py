@@ -1,0 +1,179 @@
+"""Stats storage API + in-memory and file-backed implementations.
+
+Parity: ref deeplearning4j-ui-parent/deeplearning4j-ui-model/.../api/storage/
+StatsStorage.java (session/type/worker-keyed static info + time-series updates,
+storage event listeners) with InMemoryStatsStorage / FileStatsStorage /
+StatsStorageRouter equivalents. Records are plain JSON-able dicts rather than
+Persistable blobs — the whole UI pipeline stays language-neutral.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+
+@dataclass
+class StatsStorageEvent:
+    """(ref api/storage/StatsStorageEvent.java)"""
+    event_type: str  # NewSessionID | NewTypeID | NewWorkerID | PostStaticInfo | PostUpdate
+    session_id: str
+    type_id: str
+    worker_id: str
+    timestamp: float = field(default_factory=time.time)
+
+
+class StatsStorageRouter:
+    """Write-side interface (ref api/storage/StatsStorageRouter.java) — training
+    processes route records here; a storage is also a router."""
+
+    def put_static_info(self, record: dict) -> None:
+        raise NotImplementedError
+
+    def put_update(self, record: dict) -> None:
+        raise NotImplementedError
+
+    # camelCase parity
+    putStaticInfo = put_static_info
+    putUpdate = put_update
+
+
+def _key_of(record: dict) -> Tuple[str, str, str]:
+    return (record.get("session_id", "default"),
+            record.get("type_id", "StatsListener"),
+            record.get("worker_id", "0"))
+
+
+class StatsStorage(StatsStorageRouter):
+    """Read side (ref api/storage/StatsStorage.java)."""
+
+    def __init__(self):
+        self._static: Dict[Tuple[str, str, str], dict] = {}
+        self._updates: Dict[Tuple[str, str, str], List[dict]] = {}
+        self._listeners: List[Callable[[StatsStorageEvent], None]] = []
+        self._lock = threading.RLock()
+
+    # ------------- write -------------
+    def put_static_info(self, record: dict) -> None:
+        key = _key_of(record)
+        with self._lock:
+            new_session = key[0] not in {k[0] for k in
+                                         list(self._static) + list(self._updates)}
+            self._static[key] = dict(record)
+            self._persist("static", record)
+        if new_session:
+            self._emit("NewSessionID", key)
+        self._emit("PostStaticInfo", key)
+
+    def put_update(self, record: dict) -> None:
+        key = _key_of(record)
+        record.setdefault("timestamp", time.time())
+        with self._lock:
+            self._updates.setdefault(key, []).append(dict(record))
+            self._persist("update", record)
+        self._emit("PostUpdate", key)
+
+    # ------------- read -------------
+    def list_session_ids(self) -> List[str]:
+        with self._lock:
+            return sorted({k[0] for k in list(self._static) + list(self._updates)})
+
+    def list_type_ids(self, session_id: str) -> List[str]:
+        with self._lock:
+            return sorted({k[1] for k in list(self._static) + list(self._updates)
+                           if k[0] == session_id})
+
+    def list_worker_ids(self, session_id: str, type_id: Optional[str] = None
+                        ) -> List[str]:
+        with self._lock:
+            return sorted({k[2] for k in list(self._static) + list(self._updates)
+                           if k[0] == session_id
+                           and (type_id is None or k[1] == type_id)})
+
+    def get_static_info(self, session_id: str, type_id: str = "StatsListener",
+                        worker_id: str = "0") -> Optional[dict]:
+        with self._lock:
+            return self._static.get((session_id, type_id, worker_id))
+
+    def get_all_updates(self, session_id: str, type_id: str = "StatsListener",
+                        worker_id: str = "0") -> List[dict]:
+        with self._lock:
+            return list(self._updates.get((session_id, type_id, worker_id), []))
+
+    def get_latest_update(self, session_id: str, type_id: str = "StatsListener",
+                          worker_id: str = "0") -> Optional[dict]:
+        ups = self.get_all_updates(session_id, type_id, worker_id)
+        return ups[-1] if ups else None
+
+    def get_updates_after(self, session_id: str, timestamp: float,
+                          type_id: str = "StatsListener", worker_id: str = "0"
+                          ) -> List[dict]:
+        return [u for u in self.get_all_updates(session_id, type_id, worker_id)
+                if u.get("timestamp", 0) > timestamp]
+
+    # ------------- events -------------
+    def register_stats_storage_listener(
+            self, fn: Callable[[StatsStorageEvent], None]) -> None:
+        self._listeners.append(fn)
+    registerStatsStorageListener = register_stats_storage_listener
+
+    def _emit(self, event_type: str, key):
+        ev = StatsStorageEvent(event_type, *key)
+        for fn in self._listeners:
+            fn(ev)
+
+    # ------------- persistence hook -------------
+    def _persist(self, kind: str, record: dict) -> None:
+        pass
+
+    def close(self) -> None:
+        pass
+
+
+class InMemoryStatsStorage(StatsStorage):
+    """(ref impl/InMemoryStatsStorage.java) — pure dict-backed."""
+
+
+class FileStatsStorage(StatsStorage):
+    """JSON-lines file persistence (ref impl/FileStatsStorage.java / the J7 MapDB
+    variant). Reopening the same path reloads all prior sessions."""
+
+    def __init__(self, path: str):
+        super().__init__()
+        self._path = path
+        self._file = None
+        if os.path.exists(path):
+            with open(path, "r") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    entry = json.loads(line)
+                    key = _key_of(entry["record"])
+                    if entry["kind"] == "static":
+                        self._static[key] = entry["record"]
+                    else:
+                        self._updates.setdefault(key, []).append(entry["record"])
+        self._file = open(path, "a")
+
+    def _persist(self, kind: str, record: dict) -> None:
+        if self._file is None:
+            return
+
+        def default(o):
+            try:
+                return float(o)
+            except Exception:
+                return str(o)
+
+        self._file.write(json.dumps({"kind": kind, "record": record},
+                                    default=default) + "\n")
+        self._file.flush()
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()
+            self._file = None

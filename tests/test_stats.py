@@ -1,0 +1,156 @@
+"""StatsListener/StatsStorage + divergence sentinel tests.
+
+Parity: ref deeplearning4j-ui-model TestStatsListener / TestStatsStorage, and the
+SURVEY §5 failure-detection slot (NaN sentinel in the train loop)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    Activation, DenseLayer, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+    OutputLayer, Sgd, WeightInit)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage, InMemoryStatsStorage, StatsListener)
+
+RNG = np.random.RandomState(7)
+
+
+def small_net(lr=0.1):
+    b = (NeuralNetConfiguration.Builder().seed(1).weight_init(WeightInit.XAVIER)
+         .activation(Activation.TANH).updater(Sgd(learning_rate=lr)).dtype("float64")
+         .list())
+    b.layer(DenseLayer(n_out=6))
+    b.layer(OutputLayer(n_out=3))
+    return MultiLayerNetwork(
+        b.set_input_type(InputType.feed_forward(4)).build()).init()
+
+
+def data(n=16):
+    x = RNG.rand(n, 4)
+    y = np.eye(3)[RNG.randint(0, 3, n)]
+    return x, y
+
+
+def test_stats_listener_collects_static_and_updates():
+    storage = InMemoryStatsStorage()
+    events = []
+    storage.register_stats_storage_listener(events.append)
+    net = small_net()
+    net.set_listeners(StatsListener(storage, session_id="s1"))
+    x, y = data()
+    for _ in range(5):
+        net.fit(DataSet(x, y))
+
+    assert storage.list_session_ids() == ["s1"]
+    static = storage.get_static_info("s1")
+    assert static["model"]["num_params"] == net.num_params()
+    assert static["hardware"]["device_count"] >= 1
+    assert static["software"]["backend"] == "cpu"
+
+    ups = storage.get_all_updates("s1")
+    assert len(ups) == 5
+    u = ups[-1]
+    assert np.isfinite(u["score"])
+    p0 = u["stats"]["params"]["0"]
+    assert set(p0) >= {"mean", "stdev", "mean_magnitude", "min", "max",
+                       "histogram_counts", "histogram_edges"}
+    assert len(p0["histogram_counts"]) == 20
+    # update (applied-delta) stats appear from the second report on
+    assert "updates" in u["stats"]
+    assert abs(u["stats"]["updates"]["0"]["mean_magnitude"]) > 0
+    assert u["learning_rates"]["0"] == pytest.approx(0.1)
+    kinds = {e.event_type for e in events}
+    assert {"NewSessionID", "PostStaticInfo", "PostUpdate"} <= kinds
+
+
+def test_file_stats_storage_round_trip(tmp_path):
+    path = os.path.join(tmp_path, "stats.jsonl")
+    storage = FileStatsStorage(path)
+    net = small_net()
+    net.set_listeners(StatsListener(storage, session_id="fs",
+                                    collect_histograms=False))
+    x, y = data()
+    for _ in range(3):
+        net.fit(DataSet(x, y))
+    storage.close()
+
+    re = FileStatsStorage(path)
+    assert re.list_session_ids() == ["fs"]
+    assert len(re.get_all_updates("fs")) == 3
+    assert re.get_static_info("fs")["model"]["num_params"] == net.num_params()
+    assert re.get_latest_update("fs")["iteration"] == 3
+
+
+def test_divergence_sentinel_freezes_params():
+    # identity MLP + MSE at an absurd LR: params -> ~1e200 after one step, the next
+    # loss is (1e200)^2 = inf — guaranteed overflow, nothing saturates
+    from deeplearning4j_tpu import LossFunction
+    b = (NeuralNetConfiguration.Builder().seed(1).weight_init(WeightInit.XAVIER)
+         .activation(Activation.IDENTITY).updater(Sgd(learning_rate=1e200))
+         .dtype("float64").list())
+    b.layer(DenseLayer(n_out=6))
+    b.layer(OutputLayer(n_out=3, loss_fn=LossFunction.MSE,
+                        activation=Activation.IDENTITY))
+    net = MultiLayerNetwork(
+        b.set_input_type(InputType.feed_forward(4)).build()).init()
+    x, y = data()
+    params_before = np.asarray(net.params())
+    with pytest.warns(UserWarning, match="diverged"):
+        losses = net.fit_on_device(x, y, steps=8)
+    assert net._diverged_at is not None
+    # params frozen at last finite step -> still finite
+    assert np.all(np.isfinite(np.asarray(net.params())))
+    # and training genuinely went non-finite at some point
+    assert not np.all(np.isfinite(losses))
+    # sentinel did not corrupt pre-divergence behavior: params did move or stayed
+    assert np.asarray(net.params()).shape == params_before.shape
+
+
+def test_no_divergence_no_warning():
+    net = small_net()
+    x, y = data()
+    losses = net.fit_on_device(x, y, steps=5)
+    assert net._diverged_at is None
+    assert np.all(np.isfinite(losses))
+
+
+def test_ui_server_and_remote_router():
+    """Dashboard endpoints + remote POST routing (ref UIServer.attach +
+    RemoteUIStatsStorageRouter)."""
+    import json
+    import urllib.request
+
+    from deeplearning4j_tpu.ui import (
+        RemoteUIStatsStorageRouter, StatsListener, UIServer)
+
+    server = UIServer(port=0)  # ephemeral port
+    try:
+        storage = InMemoryStatsStorage()
+        server.attach(storage)
+        base = f"http://localhost:{server.port}"
+
+        # train with a listener that routes REMOTELY over HTTP into the server
+        remote = RemoteUIStatsStorageRouter(base)
+        net = small_net()
+        net.set_listeners(StatsListener(remote, session_id="web",
+                                        collect_histograms=False))
+        x, y = data()
+        for _ in range(3):
+            net.fit(DataSet(x, y))
+
+        def get(path):
+            with urllib.request.urlopen(base + path, timeout=10) as r:
+                return json.loads(r.read().decode())
+
+        assert get("/train/sessions") == ["web"]
+        info = get("/train/sessions/web/info")
+        assert info["model"]["num_params"] == net.num_params()
+        ups = get("/train/sessions/web/updates")
+        assert len(ups) == 3 and ups[-1]["iteration"] == 3
+        # dashboard HTML served at root
+        with urllib.request.urlopen(base + "/", timeout=10) as r:
+            assert b"Score vs iteration" in r.read()
+    finally:
+        server.stop()
