@@ -1,0 +1,30 @@
+"""NLP domain library (L7): text pipeline + sequence-embedding models.
+
+Parity: ref deeplearning4j-nlp-parent — tokenization factories, sentence iterators,
+bag-of-words/TF-IDF vectorizers, the SequenceVectors framework (Word2Vec,
+ParagraphVectors, GloVe) and the word-vector serializer. TPU-first: the per-pair
+axpy hot loops (ref SkipGram.java:271-283) become closed-form batched gather/
+scatter-add updates inside single jitted XLA steps.
+"""
+from deeplearning4j_tpu.nlp.tokenization import (
+    CommonPreprocessor, DefaultTokenizerFactory, EndingPreProcessor,
+    NGramTokenizerFactory)
+from deeplearning4j_tpu.nlp.sentence_iterator import (
+    BasicLineIterator, CollectionSentenceIterator, FileSentenceIterator,
+    SentenceIterator)
+from deeplearning4j_tpu.nlp.vectorizers import CountVectorizer, TfidfVectorizer
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor, VocabWord
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+
+__all__ = [
+    "DefaultTokenizerFactory", "NGramTokenizerFactory", "CommonPreprocessor",
+    "EndingPreProcessor", "SentenceIterator", "BasicLineIterator",
+    "CollectionSentenceIterator", "FileSentenceIterator", "CountVectorizer",
+    "TfidfVectorizer", "VocabWord", "VocabCache", "VocabConstructor",
+    "SequenceVectors", "Word2Vec", "ParagraphVectors", "Glove",
+    "WordVectorSerializer",
+]

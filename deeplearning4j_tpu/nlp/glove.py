@@ -1,0 +1,169 @@
+"""GloVe: global vectors via weighted co-occurrence factorization.
+
+Parity: ref models/glove/Glove.java + embeddings/learning/impl/elements/
+GloVe.java (AdaGrad on f(X_ij)(w_i·w̃_j + b_i + b̃_j − log X_ij)²). TPU-first: the
+co-occurrence pass is host-side counting; training shuffles all (i, j, X) triples
+and runs fixed-size batched jitted AdaGrad steps with scatter-add — no per-pair
+Java loop, one XLA computation per batch.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Dict, Iterable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor
+from deeplearning4j_tpu.nlp.word_vectors import InMemoryLookupTable, WordVectors
+
+
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5, 6, 7))
+def _glove_step(w, wc, b, bc, gw, gwc, gb, gbc, ii, jj, xx, lr, xmax, alpha):
+    """Batched AdaGrad step on co-occurrence triples."""
+    wi = w[ii]
+    wj = wc[jj]
+    diff = jnp.sum(wi * wj, axis=-1) + b[ii] + bc[jj] - jnp.log(xx)
+    fx = jnp.minimum((xx / xmax) ** alpha, 1.0)
+    loss = 0.5 * jnp.mean(fx * diff * diff)
+    g = fx * diff                                   # (B,)
+    gwi = g[:, None] * wj
+    gwj = g[:, None] * wi
+
+    def ada(table, grad_table, idx, grads):
+        grad_table = grad_table.at[idx].add(grads * grads)
+        adj = grads / jnp.sqrt(grad_table[idx] + 1e-8)
+        return table.at[idx].add(-lr * adj), grad_table
+
+    w, gw = ada(w, gw, ii, gwi)
+    wc, gwc = ada(wc, gwc, jj, gwj)
+    b, gb = ada(b, gb, ii, g)
+    bc, gbc = ada(bc, gbc, jj, g)
+    return w, wc, b, bc, gw, gwc, gb, gbc, loss
+
+
+class Glove(WordVectors):
+    def __init__(self, layer_size: int = 100, window: int = 15,
+                 learning_rate: float = 0.05, epochs: int = 5,
+                 batch_size: int = 4096, min_word_frequency: int = 1,
+                 x_max: float = 100.0, alpha: float = 0.75,
+                 symmetric: bool = True, seed: int = 12345):
+        self.layer_size = int(layer_size)
+        self.window = int(window)
+        self.learning_rate = float(learning_rate)
+        self.epochs = int(epochs)
+        self.batch_size = int(batch_size)
+        self.min_word_frequency = int(min_word_frequency)
+        self.x_max = float(x_max)
+        self.alpha = float(alpha)
+        self.symmetric = bool(symmetric)
+        self.seed = int(seed)
+        self.vocab: Optional[VocabCache] = None
+        self.lookup_table: Optional[InMemoryLookupTable] = None
+        self._norm_cache = None
+
+    def _cooccurrences(self, sequences) -> Dict[Tuple[int, int], float]:
+        """1/distance-weighted counts in a symmetric window (ref glove/
+        AbstractCoOccurrences.java)."""
+        counts: Dict[Tuple[int, int], float] = {}
+        for seq in sequences:
+            idx = [self.vocab.index_of(t) for t in seq]
+            idx = [i for i in idx if i >= 0]
+            n = len(idx)
+            for i in range(n):
+                for j in range(max(0, i - self.window), i):
+                    a, b = idx[i], idx[j]
+                    if a == b:
+                        continue
+                    wgt = 1.0 / (i - j)
+                    counts[(a, b)] = counts.get((a, b), 0.0) + wgt
+                    if self.symmetric:
+                        counts[(b, a)] = counts.get((b, a), 0.0) + wgt
+        return counts
+
+    def fit(self, sequences_factory):
+        if self.vocab is None:
+            self.vocab = VocabConstructor(
+                self.min_word_frequency, build_huffman=False).build(
+                sequences_factory())
+        co = self._cooccurrences(sequences_factory())
+        if not co:
+            raise ValueError("empty co-occurrence matrix")
+        keys = np.asarray(list(co.keys()), np.int32)
+        xx = np.asarray(list(co.values()), np.float32)
+        V, D = self.vocab.num_words(), self.layer_size
+        rng = np.random.RandomState(self.seed)
+        w = jnp.asarray((rng.rand(V, D) - 0.5) / D, jnp.float32)
+        wc = jnp.asarray((rng.rand(V, D) - 0.5) / D, jnp.float32)
+        b = jnp.zeros((V,), jnp.float32)
+        bc = jnp.zeros((V,), jnp.float32)
+        gw = jnp.zeros((V, D), jnp.float32)
+        gwc = jnp.zeros((V, D), jnp.float32)
+        gb = jnp.zeros((V,), jnp.float32)
+        gbc = jnp.zeros((V,), jnp.float32)
+
+        shuffle_rng = np.random.RandomState(self.seed + 3)
+        for _ in range(self.epochs):
+            order = shuffle_rng.permutation(keys.shape[0])
+            for s in range(0, keys.shape[0], self.batch_size):
+                sel = order[s:s + self.batch_size]
+                w, wc, b, bc, gw, gwc, gb, gbc, _ = _glove_step(
+                    w, wc, b, bc, gw, gwc, gb, gbc,
+                    jnp.asarray(keys[sel, 0]), jnp.asarray(keys[sel, 1]),
+                    jnp.asarray(xx[sel]), jnp.float32(self.learning_rate),
+                    jnp.float32(self.x_max), jnp.float32(self.alpha))
+
+        self.lookup_table = InMemoryLookupTable(self.vocab, D, self.seed,
+                                                use_hs=False, use_neg=False)
+        # final embedding = w + w~ (the GloVe paper / reference convention)
+        self.lookup_table.syn0 = w + wc
+        self._invalidate()
+        return self
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def layerSize(self, n):
+            self._kw["layer_size"] = int(n)
+            return self
+
+        def windowSize(self, n):
+            self._kw["window"] = int(n)
+            return self
+
+        def learningRate(self, r):
+            self._kw["learning_rate"] = float(r)
+            return self
+
+        def epochs(self, n):
+            self._kw["epochs"] = int(n)
+            return self
+
+        def batchSize(self, n):
+            self._kw["batch_size"] = int(n)
+            return self
+
+        def minWordFrequency(self, n):
+            self._kw["min_word_frequency"] = int(n)
+            return self
+
+        def xMax(self, x):
+            self._kw["x_max"] = float(x)
+            return self
+
+        def alpha(self, a):
+            self._kw["alpha"] = float(a)
+            return self
+
+        def symmetric(self, b):
+            self._kw["symmetric"] = bool(b)
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = int(s)
+            return self
+
+        def build(self) -> "Glove":
+            return Glove(**self._kw)

@@ -1,0 +1,141 @@
+"""ParagraphVectors (doc2vec): PV-DBOW + inferVector.
+
+Parity: ref models/paragraphvectors/ParagraphVectors.java +
+embeddings/learning/impl/sequence/DBOW.java (the default sequence-learning
+algorithm). Doc/label vectors live in their own table; word-side output weights
+(syn1neg) are shared with/trained like Word2Vec's. inferVector trains a fresh doc
+vector against FROZEN weights (ref inferVector :160-220).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.learning import dbow_step, infer_vector_step
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory, TokenizerFactory)
+from deeplearning4j_tpu.nlp.vocab import VocabConstructor
+
+
+class ParagraphVectors(SequenceVectors):
+    def __init__(self, tokenizer_factory: Optional[TokenizerFactory] = None,
+                 train_words: bool = False, **kw):
+        kw.setdefault("min_word_frequency", 1)
+        super().__init__(**kw)
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.train_words = bool(train_words)
+        self.label_index: Dict[str, int] = {}
+        self.doc_vecs = None  # (num_docs, D)
+
+    # ------------------------------------------------------------------ fit
+    def fit_documents(self, documents: Sequence[Tuple[str, str]]):
+        """documents: list of (label, text). (ref fit() over LabelledDocument)."""
+        tf = self.tokenizer_factory
+        tokenized = [(lab, tf.tokenize(text)) for lab, text in documents]
+        corpus = lambda: (toks for _, toks in tokenized)
+        if self.train_words:
+            super().fit(corpus)  # word vectors via SkipGram first
+        else:
+            if self.vocab is None:
+                self.vocab = VocabConstructor(
+                    self.min_word_frequency, build_huffman=False).build(corpus())
+            if self.lookup_table is None:
+                from deeplearning4j_tpu.nlp.word_vectors import InMemoryLookupTable
+                self.lookup_table = InMemoryLookupTable(
+                    self.vocab, self.layer_size, self.seed, use_hs=False,
+                    use_neg=True)
+
+        self.label_index = {}
+        for lab, _ in tokenized:
+            if lab not in self.label_index:
+                self.label_index[lab] = len(self.label_index)
+        rng = np.random.RandomState(self.seed + 1)
+        D = self.layer_size
+        self.doc_vecs = jnp.asarray(
+            (rng.rand(len(self.label_index), D) - 0.5) / D, jnp.float32)
+
+        probs = self.vocab.unigram_probs()
+        total = max(1, sum(len(t) for _, t in tokenized) * self.epochs)
+        seen = 0
+        for _ in range(self.epochs):
+            docs_buf, words_buf = [], []
+            for lab, toks in tokenized:
+                widx = self._encode(toks)
+                if widx.size == 0:
+                    continue
+                docs_buf.append(np.full(widx.size, self.label_index[lab], np.int32))
+                words_buf.append(widx.astype(np.int32))
+                seen += widx.size
+            docs = np.concatenate(docs_buf)
+            words = np.concatenate(words_buf)
+            order = self._rng.permutation(docs.size)
+            docs, words = docs[order], words[order]
+            alpha = max(self.min_learning_rate,
+                        self.learning_rate * (1.0 - seen / total))
+            for s in range(0, docs.size, self.batch_size):
+                d, w = docs[s:s + self.batch_size], words[s:s + self.batch_size]
+                neg = self._negatives((w.shape[0], self.negative), probs)
+                self.doc_vecs, self.lookup_table.syn1neg, _ = dbow_step(
+                    self.doc_vecs, self.lookup_table.syn1neg, jnp.asarray(d),
+                    jnp.asarray(w), jnp.asarray(neg), jnp.float32(alpha))
+        self._invalidate()
+        return self
+
+    # ------------------------------------------------------------- queries
+    def get_label_vector(self, label: str) -> Optional[np.ndarray]:
+        i = self.label_index.get(label)
+        return None if i is None else np.asarray(self.doc_vecs[i])
+    lookupLabelVector = get_label_vector
+
+    def infer_vector(self, text: str, steps: int = 20,
+                     learning_rate: Optional[float] = None) -> np.ndarray:
+        """(ref ParagraphVectors.inferVector)"""
+        lr = self.learning_rate if learning_rate is None else learning_rate
+        widx = self._encode(self.tokenizer_factory.tokenize(text)).astype(np.int32)
+        rng = np.random.RandomState(self.seed + 7)
+        D = self.layer_size
+        vec = jnp.asarray((rng.rand(D) - 0.5) / D, jnp.float32)
+        if widx.size == 0:
+            return np.asarray(vec)
+        probs = self.vocab.unigram_probs()
+        for s in range(steps):
+            neg = self._negatives((widx.shape[0], self.negative), probs)
+            vec, _ = infer_vector_step(vec, self.lookup_table.syn1neg,
+                                       jnp.asarray(widx), jnp.asarray(neg),
+                                       jnp.float32(lr * (1 - s / steps) + 1e-4))
+        return np.asarray(vec)
+    inferVector = infer_vector
+
+    def similarity_to_label(self, text: str, label: str) -> float:
+        v = self.infer_vector(text)
+        d = self.get_label_vector(label)
+        return float(v @ d / max(np.linalg.norm(v) * np.linalg.norm(d), 1e-12))
+
+    def nearest_labels(self, text: str, top_n: int = 5) -> List[str]:
+        v = self.infer_vector(text)
+        dv = np.asarray(self.doc_vecs)
+        dn = dv / np.clip(np.linalg.norm(dv, axis=1, keepdims=True), 1e-12, None)
+        sims = dn @ (v / max(np.linalg.norm(v), 1e-12))
+        inv = {i: lab for lab, i in self.label_index.items()}
+        return [inv[i] for i in np.argsort(-sims)[:top_n]]
+
+    class Builder(SequenceVectors.Builder):
+        def __init__(self):
+            super().__init__()
+            self._tf = None
+            self._train_words = False
+
+        def tokenizerFactory(self, tf):
+            self._tf = tf
+            return self
+
+        def trainWordVectors(self, b):
+            self._train_words = bool(b)
+            return self
+
+        def build(self) -> "ParagraphVectors":
+            return ParagraphVectors(tokenizer_factory=self._tf,
+                                    train_words=self._train_words, **self._kw)

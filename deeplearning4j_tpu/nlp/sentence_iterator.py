@@ -1,0 +1,126 @@
+"""Sentence iterators — the corpus-ingest side of the NLP pipeline.
+
+Parity: ref deeplearning4j-nlp/.../text/sentenceiterator/{SentenceIterator,
+BasicLineIterator,CollectionSentenceIterator,FileSentenceIterator}.java +
+SentencePreProcessor.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Iterable, List, Optional
+
+
+class SentenceIterator:
+    def __init__(self):
+        self._pre: Optional[Callable[[str], str]] = None
+
+    def next_sentence(self) -> str:
+        raise NotImplementedError
+    nextSentence = next_sentence
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+    hasNext = has_next
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def set_pre_processor(self, fn: Callable[[str], str]):
+        self._pre = fn
+        return self
+    setPreProcessor = set_pre_processor
+
+    def _process(self, s: str) -> str:
+        return self._pre(s) if self._pre else s
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next_sentence()
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    def __init__(self, sentences: Iterable[str]):
+        super().__init__()
+        self._sentences: List[str] = list(sentences)
+        self._i = 0
+
+    def next_sentence(self) -> str:
+        s = self._sentences[self._i]
+        self._i += 1
+        return self._process(s)
+
+    def has_next(self) -> bool:
+        return self._i < len(self._sentences)
+
+    def reset(self) -> None:
+        self._i = 0
+
+
+class BasicLineIterator(SentenceIterator):
+    """One sentence per line from a file path or file-like (ref BasicLineIterator)."""
+
+    def __init__(self, path):
+        super().__init__()
+        self._path = path
+        self._fh = None
+        self._next = None
+        self.reset()
+
+    def reset(self) -> None:
+        if self._fh is not None:
+            self._fh.close()
+        self._fh = open(self._path, "r", encoding="utf-8")
+        self._advance()
+
+    def _advance(self):
+        line = self._fh.readline()
+        self._next = None if line == "" else line.rstrip("\n")
+
+    def has_next(self) -> bool:
+        return self._next is not None
+
+    def next_sentence(self) -> str:
+        s = self._next
+        self._advance()
+        return self._process(s)
+
+
+class FileSentenceIterator(SentenceIterator):
+    """Every line of every file under a directory (ref FileSentenceIterator)."""
+
+    def __init__(self, root: str):
+        super().__init__()
+        self._root = root
+        self.reset()
+
+    def reset(self) -> None:
+        self._files = []
+        if os.path.isdir(self._root):
+            for dirpath, _, names in os.walk(self._root):
+                for n in sorted(names):
+                    self._files.append(os.path.join(dirpath, n))
+        else:
+            self._files = [self._root]
+        self._lines: List[str] = []
+        self._fi = 0
+        self._li = 0
+        self._load_next_file()
+
+    def _load_next_file(self):
+        self._lines = []
+        self._li = 0
+        while self._fi < len(self._files) and not self._lines:
+            with open(self._files[self._fi], "r", encoding="utf-8") as f:
+                self._lines = [l.rstrip("\n") for l in f if l.strip()]
+            self._fi += 1
+
+    def has_next(self) -> bool:
+        return self._li < len(self._lines)
+
+    def next_sentence(self) -> str:
+        s = self._lines[self._li]
+        self._li += 1
+        if self._li >= len(self._lines):
+            self._load_next_file()
+        return self._process(s)

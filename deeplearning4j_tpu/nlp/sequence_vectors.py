@@ -1,0 +1,269 @@
+"""SequenceVectors: the distributed-representation training framework.
+
+Parity: ref deeplearning4j-nlp/.../models/sequencevectors/SequenceVectors.java
+(1,220 LoC): vocab construction, lookup-table init, epoch/iteration loop with linear
+learning-rate decay, elements-learning algorithm dispatch (SkipGram/CBOW), dynamic
+window reduction, frequency-based subsampling, negative-sampling table.
+
+TPU-first: pair generation is host-side numpy ETL; batches of (center, context,
+negatives) feed the fused jitted steps in nlp/learning.py. The per-pair nextRandom
+LCG threading of the reference becomes a seeded numpy RandomState — same statistics,
+vectorized.
+"""
+from __future__ import annotations
+
+from typing import Callable, Iterable, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.learning import (
+    cbow_ns_step, skipgram_hs_step, skipgram_ns_step)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor
+from deeplearning4j_tpu.nlp.word_vectors import InMemoryLookupTable, WordVectors
+
+
+class SequenceVectors(WordVectors):
+    """Train element embeddings over abstract sequences (lists of tokens)."""
+
+    def __init__(self, layer_size: int = 100, window: int = 5, negative: int = 5,
+                 use_hierarchic_softmax: bool = False, learning_rate: float = 0.025,
+                 min_learning_rate: float = 1e-4, epochs: int = 1,
+                 iterations: int = 1, batch_size: int = 2048,
+                 min_word_frequency: int = 1, sampling: float = 0.0,
+                 elements_algorithm: str = "skipgram", seed: int = 12345,
+                 vocab: Optional[VocabCache] = None):
+        self.layer_size = int(layer_size)
+        self.window = int(window)
+        self.negative = int(negative)
+        self.use_hs = bool(use_hierarchic_softmax)
+        self.learning_rate = float(learning_rate)
+        self.min_learning_rate = float(min_learning_rate)
+        self.epochs = int(epochs)
+        self.iterations = int(iterations)
+        self.batch_size = int(batch_size)
+        self.min_word_frequency = int(min_word_frequency)
+        self.sampling = float(sampling)
+        self.elements_algorithm = elements_algorithm.lower()
+        self.seed = int(seed)
+        self.vocab = vocab
+        self.lookup_table: Optional[InMemoryLookupTable] = None
+        self._norm_cache = None
+        self._rng = np.random.RandomState(seed)
+        self._max_code_len = 0
+
+    # ------------------------------------------------------------------ fit
+    def fit(self, sequences_factory: Callable[[], Iterable[List[str]]]):
+        """sequences_factory: zero-arg callable returning a fresh iterable of token
+        lists per epoch (the re-iterable corpus — ref SequenceIterator.reset)."""
+        if self.vocab is None:
+            self.vocab = VocabConstructor(
+                self.min_word_frequency,
+                build_huffman=self.use_hs).build(sequences_factory())
+        if self.lookup_table is None:
+            self.lookup_table = InMemoryLookupTable(
+                self.vocab, self.layer_size, self.seed,
+                use_hs=self.use_hs, use_neg=self.negative > 0)
+        if self.use_hs:
+            self._max_code_len = max(
+                (len(w.codes) for w in self.vocab.vocab_words()), default=1)
+        probs = self.vocab.unigram_probs() if self.negative > 0 else None
+        total_words = max(1, self.vocab.total_word_occurrences * self.epochs
+                          * self.iterations)
+        state = {"words_seen": 0}
+
+        def alpha():
+            return max(self.min_learning_rate,
+                       self.learning_rate
+                       * (1.0 - state["words_seen"] / total_words))
+
+        # Pairs are buffered across sequences and flushed in FIXED batch_size
+        # chunks, so the jitted steps compile for at most two shapes per run
+        # (full batch + the final tail) instead of one shape per sentence.
+        for _ in range(self.epochs):
+            buf: List[tuple] = []
+            buffered = 0
+            for seq in sequences_factory():
+                idx = self._encode(seq)
+                if idx.size < 2:
+                    continue
+                for _ in range(self.iterations):
+                    rows = self._sequence_rows(idx)
+                    if rows is not None:
+                        buf.append(rows)
+                        buffered += rows[0].shape[0]
+                    state["words_seen"] += idx.size
+                    while buffered >= self.batch_size:
+                        buf, buffered = self._flush(buf, buffered, alpha(), probs,
+                                                    exact=True)
+            while buffered > 0:
+                buf, buffered = self._flush(buf, buffered, alpha(), probs,
+                                            exact=False)
+        self._invalidate()
+        return self
+
+    def _sequence_rows(self, idx: np.ndarray):
+        if self.elements_algorithm == "cbow":
+            return self._context_windows(idx)
+        centers, contexts = self._pairs(idx)
+        if centers.size == 0:
+            return None
+        return (centers, contexts)
+
+    def _flush(self, buf, buffered, alpha, probs, exact: bool):
+        cols = [np.concatenate(parts) for parts in zip(*buf)]
+        take = self.batch_size if exact else min(self.batch_size, buffered)
+        batch = [c[:take] for c in cols]
+        rest = [c[take:] for c in cols]
+        self._train_batch(batch, alpha, probs)
+        remaining = buffered - take
+        return ([tuple(rest)] if remaining else []), remaining
+
+    # ------------------------------------------------------------- internals
+    def _encode(self, seq: Sequence[str]) -> np.ndarray:
+        """tokens -> indices, OOV dropped, frequency subsampling applied
+        (ref SkipGram.applySubsampling :120-140)."""
+        idx = np.asarray([self.vocab.index_of(t) for t in seq], np.int64)
+        idx = idx[idx >= 0]
+        if self.sampling > 0 and idx.size:
+            counts = self.vocab.counts_array()[idx]
+            n = self.vocab.total_word_occurrences
+            t = self.sampling
+            keep_prob = (np.sqrt(counts / (t * n)) + 1) * (t * n) / counts
+            idx = idx[self._rng.rand(idx.size) < keep_prob]
+        return idx
+
+    def _pairs(self, idx: np.ndarray):
+        """Dynamic-window (center, context) pairs (ref window reduction via
+        nextRandom % window)."""
+        n = idx.size
+        b = self._rng.randint(1, self.window + 1, size=n)  # realized window sizes
+        centers, contexts = [], []
+        for i in range(n):
+            lo, hi = max(0, i - b[i]), min(n, i + b[i] + 1)
+            for j in range(lo, hi):
+                if j != i:
+                    centers.append(idx[i])
+                    contexts.append(idx[j])
+        return (np.asarray(centers, np.int32), np.asarray(contexts, np.int32))
+
+    def _context_windows(self, idx: np.ndarray):
+        """(contexts (N,2W), mask, centers) for CBOW."""
+        n = idx.size
+        W = self.window
+        b = self._rng.randint(1, W + 1, size=n)
+        ctx = np.zeros((n, 2 * W), np.int32)
+        mask = np.zeros((n, 2 * W), np.float32)
+        for i in range(n):
+            k = 0
+            for j in range(max(0, i - b[i]), min(n, i + b[i] + 1)):
+                if j != i:
+                    ctx[i, k] = idx[j]
+                    mask[i, k] = 1.0
+                    k += 1
+        return ctx, mask, idx.astype(np.int32)
+
+    def _negatives(self, shape, probs) -> np.ndarray:
+        return self._rng.choice(len(probs), size=shape, p=probs).astype(np.int32)
+
+    def _train_batch(self, batch, alpha: float, probs):
+        tbl = self.lookup_table
+        if self.elements_algorithm == "cbow":
+            ctx, mask, centers = batch
+            neg = self._negatives((centers.shape[0], self.negative), probs)
+            tbl.syn0, tbl.syn1neg, _ = cbow_ns_step(
+                tbl.syn0, tbl.syn1neg, jnp.asarray(ctx), jnp.asarray(mask),
+                jnp.asarray(centers), jnp.asarray(neg), jnp.float32(alpha))
+            return
+        c, t = batch
+        if self.use_hs:
+            pts, codes, mask = self._huffman_batch(t)
+            tbl.syn0, tbl.syn1, _ = skipgram_hs_step(
+                tbl.syn0, tbl.syn1, jnp.asarray(c), jnp.asarray(pts),
+                jnp.asarray(codes), jnp.asarray(mask), jnp.float32(alpha))
+        if self.negative > 0:
+            neg = self._negatives((c.shape[0], self.negative), probs)
+            tbl.syn0, tbl.syn1neg, _ = skipgram_ns_step(
+                tbl.syn0, tbl.syn1neg, jnp.asarray(c), jnp.asarray(t),
+                jnp.asarray(neg), jnp.float32(alpha))
+
+    def _huffman_batch(self, words: np.ndarray):
+        L = self._max_code_len
+        B = words.shape[0]
+        pts = np.zeros((B, L), np.int32)
+        codes = np.zeros((B, L), np.float32)
+        mask = np.zeros((B, L), np.float32)
+        for r, wi in enumerate(words):
+            vw = self.vocab.element_at_index(int(wi))
+            k = len(vw.codes)
+            pts[r, :k] = vw.points
+            codes[r, :k] = vw.codes
+            mask[r, :k] = 1.0
+        return pts, codes, mask
+
+    # ------------------------------------------------------------- builder
+    class Builder:
+        _cls = None  # subclasses bind
+
+        def __init__(self):
+            self._kw = {}
+
+        def layerSize(self, n):
+            self._kw["layer_size"] = int(n)
+            return self
+        layer_size = layerSize
+
+        def windowSize(self, n):
+            self._kw["window"] = int(n)
+            return self
+        window_size = windowSize
+
+        def negativeSample(self, n):
+            self._kw["negative"] = int(n)
+            return self
+
+        def useHierarchicSoftmax(self, b):
+            self._kw["use_hierarchic_softmax"] = bool(b)
+            return self
+
+        def learningRate(self, r):
+            self._kw["learning_rate"] = float(r)
+            return self
+
+        def minLearningRate(self, r):
+            self._kw["min_learning_rate"] = float(r)
+            return self
+
+        def epochs(self, n):
+            self._kw["epochs"] = int(n)
+            return self
+
+        def iterations(self, n):
+            self._kw["iterations"] = int(n)
+            return self
+
+        def batchSize(self, n):
+            self._kw["batch_size"] = int(n)
+            return self
+
+        def minWordFrequency(self, n):
+            self._kw["min_word_frequency"] = int(n)
+            return self
+
+        def sampling(self, s):
+            self._kw["sampling"] = float(s)
+            return self
+
+        def elementsLearningAlgorithm(self, name):
+            self._kw["elements_algorithm"] = str(name)
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = int(s)
+            return self
+
+        def build(self):
+            cls = type(self)._cls or SequenceVectors
+            return cls(**self._kw)
+
+    Builder._cls = None

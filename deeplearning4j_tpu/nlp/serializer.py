@@ -1,0 +1,102 @@
+"""Word-vector serialization in the Google word2vec text/binary formats.
+
+Parity: ref embeddings/loader/WordVectorSerializer.java (writeWordVectors,
+readWord2VecModel text + binary C-format paths). Round-trips between this
+framework, original word2vec.c output, and gensim.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+from deeplearning4j_tpu.nlp.word_vectors import InMemoryLookupTable, WordVectors
+
+
+class WordVectorSerializer:
+    # ------------------------------------------------------------- write
+    @staticmethod
+    def write_word_vectors(model: WordVectors, path: str, binary: bool = False):
+        """(ref writeWordVectors / writeWord2VecModel)"""
+        vocab = model.vocab
+        syn0 = np.asarray(model.lookup_table.syn0, np.float32)
+        V, D = syn0.shape
+        if binary:
+            with open(path, "wb") as f:
+                f.write(f"{V} {D}\n".encode("utf-8"))
+                for i in range(V):
+                    f.write(vocab.word_at_index(i).encode("utf-8") + b" ")
+                    f.write(syn0[i].astype("<f4").tobytes())
+                    f.write(b"\n")
+        else:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(f"{V} {D}\n")
+                for i in range(V):
+                    vec = " ".join(f"{x:.6f}" for x in syn0[i])
+                    f.write(f"{vocab.word_at_index(i)} {vec}\n")
+    writeWordVectors = write_word_vectors
+
+    # ------------------------------------------------------------- read
+    @staticmethod
+    def read_word_vectors(path: str, binary: Optional[bool] = None) -> WordVectors:
+        """(ref readWord2VecModel — auto-detects binary vs text)"""
+        if binary is None:
+            with open(path, "rb") as f:
+                header = f.readline()
+                probe = f.read(256)
+            try:
+                probe.decode("utf-8")
+                binary = False
+            except UnicodeDecodeError:
+                binary = True
+        if binary:
+            return WordVectorSerializer._read_binary(path)
+        return WordVectorSerializer._read_text(path)
+    readWord2VecModel = read_word_vectors
+    loadTxtVectors = read_word_vectors
+
+    @staticmethod
+    def _read_text(path: str) -> WordVectors:
+        with open(path, "r", encoding="utf-8") as f:
+            V, D = (int(t) for t in f.readline().split())
+            vocab = VocabCache()
+            syn0 = np.zeros((V, D), np.float32)
+            for i in range(V):
+                parts = f.readline().rstrip("\n").split(" ")
+                word, vals = parts[0], parts[1:1 + D]
+                vw = VocabWord(word, V - i)  # rank-preserving pseudo counts
+                vocab.add_token(vw)
+                syn0[i] = np.asarray([float(v) for v in vals], np.float32)
+        return WordVectorSerializer._assemble(vocab, syn0)
+
+    @staticmethod
+    def _read_binary(path: str) -> WordVectors:
+        with open(path, "rb") as f:
+            V, D = (int(t) for t in f.readline().split())
+            vocab = VocabCache()
+            syn0 = np.zeros((V, D), np.float32)
+            for i in range(V):
+                word = bytearray()
+                while True:
+                    ch = f.read(1)
+                    if ch == b" ":
+                        break
+                    if ch != b"\n":
+                        word.extend(ch)
+                vocab.add_token(VocabWord(word.decode("utf-8"), V - i))
+                syn0[i] = np.frombuffer(f.read(4 * D), dtype="<f4")
+                nl = f.read(1)
+                if nl not in (b"\n", b""):  # some writers omit the newline
+                    f.seek(-1, 1)
+        return WordVectorSerializer._assemble(vocab, syn0)
+
+    @staticmethod
+    def _assemble(vocab: VocabCache, syn0: np.ndarray) -> WordVectors:
+        vocab.finish(min_word_frequency=0)
+        table = InMemoryLookupTable(vocab, syn0.shape[1], use_hs=False,
+                                    use_neg=False)
+        table.syn0 = jnp.asarray(syn0)
+        return WordVectors(vocab, table)

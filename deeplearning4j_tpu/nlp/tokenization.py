@@ -1,0 +1,111 @@
+"""Tokenizers + token preprocessors.
+
+Parity: ref deeplearning4j-nlp/.../text/tokenization/tokenizerfactory/
+{DefaultTokenizerFactory,NGramTokenizerFactory}.java and tokenizer/preprocessor/
+{CommonPreprocessor,EndingPreProcessor}.java. Tokenizers here are plain Python
+iterables — tokenization is host-side ETL, never traced.
+"""
+from __future__ import annotations
+
+import re
+from typing import Callable, List, Optional
+
+
+class TokenPreProcess:
+    def pre_process(self, token: str) -> str:
+        raise NotImplementedError
+
+    preProcess = pre_process
+
+
+class CommonPreprocessor(TokenPreProcess):
+    """Lowercase + strip punctuation/digits (ref CommonPreprocessor.java)."""
+
+    _PUNCT = re.compile(r"[\d\.:,\"'\(\)\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token.lower())
+
+
+class EndingPreProcessor(TokenPreProcess):
+    """Crude stemmer for English endings (ref EndingPreProcessor.java)."""
+
+    def pre_process(self, token: str) -> str:
+        if token.endswith("s") and not token.endswith("ss"):
+            token = token[:-1]
+        if token.endswith("."):
+            token = token[:-1]
+        if token.endswith("ly"):
+            token = token[:-2]
+        if token.endswith("ed"):
+            token = token[:-2]
+        return token
+
+
+class Tokenizer:
+    def __init__(self, tokens: List[str]):
+        self._tokens = tokens
+        self._i = 0
+
+    def has_more_tokens(self) -> bool:
+        return self._i < len(self._tokens)
+
+    def next_token(self) -> str:
+        t = self._tokens[self._i]
+        self._i += 1
+        return t
+
+    def count_tokens(self) -> int:
+        return len(self._tokens)
+
+    def get_tokens(self) -> List[str]:
+        return list(self._tokens)
+    getTokens = get_tokens
+
+
+class TokenizerFactory:
+    def __init__(self):
+        self._pre: Optional[TokenPreProcess] = None
+
+    def set_token_pre_processor(self, pre: TokenPreProcess):
+        self._pre = pre
+        return self
+    setTokenPreProcessor = set_token_pre_processor
+
+    def _apply_pre(self, tokens: List[str]) -> List[str]:
+        if self._pre is None:
+            return tokens
+        out = [self._pre.pre_process(t) for t in tokens]
+        return [t for t in out if t]
+
+    def create(self, text: str) -> Tokenizer:
+        raise NotImplementedError
+
+    def tokenize(self, text: str) -> List[str]:
+        return self.create(text).get_tokens()
+
+
+class DefaultTokenizerFactory(TokenizerFactory):
+    """Whitespace/word-boundary tokenizer (ref DefaultTokenizerFactory.java, which
+    wraps java.util.StringTokenizer)."""
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer(self._apply_pre(text.split()))
+
+
+class NGramTokenizerFactory(TokenizerFactory):
+    """N-gram shingles over an underlying tokenizer (ref NGramTokenizerFactory.java)."""
+
+    def __init__(self, base: TokenizerFactory, min_n: int, max_n: int):
+        super().__init__()
+        self.base = base
+        self.min_n = int(min_n)
+        self.max_n = int(max_n)
+
+    def create(self, text: str) -> Tokenizer:
+        toks = self._apply_pre(self.base.tokenize(text))
+        out = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(toks) - n + 1):
+                out.append(" ".join(toks[i:i + n]))
+        return Tokenizer(out)

@@ -1,0 +1,76 @@
+"""Bag-of-words + TF-IDF text vectorizers.
+
+Parity: ref deeplearning4j-nlp/.../bagofwords/vectorizer/{BagOfWordsVectorizer,
+TfidfVectorizer}.java — fit over a sentence iterator + tokenizer, transform text to
+fixed-width vocab-indexed vectors suitable for DataSet construction.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory, TokenizerFactory)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor
+
+
+class CountVectorizer:
+    """(ref BagOfWordsVectorizer.java)"""
+
+    def __init__(self, tokenizer_factory: Optional[TokenizerFactory] = None,
+                 min_word_frequency: int = 1):
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.min_word_frequency = int(min_word_frequency)
+        self.vocab: Optional[VocabCache] = None
+
+    def fit(self, texts: Iterable[str]):
+        tf = self.tokenizer_factory
+        self.vocab = VocabConstructor(
+            self.min_word_frequency, build_huffman=False).build(
+            tf.tokenize(t) for t in texts)
+        return self
+
+    def transform(self, texts: Iterable[str]) -> np.ndarray:
+        V = self.vocab.num_words()
+        rows = []
+        for t in texts:
+            v = np.zeros(V, np.float32)
+            for tok in self.tokenizer_factory.tokenize(t):
+                i = self.vocab.index_of(tok)
+                if i >= 0:
+                    v[i] += 1.0
+            rows.append(v)
+        return np.stack(rows) if rows else np.zeros((0, V), np.float32)
+
+    def fit_transform(self, texts: List[str]) -> np.ndarray:
+        return self.fit(texts).transform(texts)
+
+
+class TfidfVectorizer(CountVectorizer):
+    """(ref TfidfVectorizer.java — tf * log(numDocs/docFreq))"""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self._idf: Optional[np.ndarray] = None
+
+    def fit(self, texts: Iterable[str]):
+        texts = list(texts)
+        super().fit(texts)
+        V = self.vocab.num_words()
+        df = np.zeros(V, np.float64)
+        for t in texts:
+            seen = {self.vocab.index_of(tok)
+                    for tok in self.tokenizer_factory.tokenize(t)}
+            for i in seen:
+                if i >= 0:
+                    df[i] += 1
+        n_docs = max(1, len(texts))
+        self._idf = np.log(n_docs / np.maximum(df, 1.0)).astype(np.float32)
+        return self
+
+    def transform(self, texts: Iterable[str]) -> np.ndarray:
+        counts = super().transform(texts)
+        tf = counts / np.maximum(counts.sum(axis=1, keepdims=True), 1.0)
+        return tf * self._idf

@@ -1,0 +1,169 @@
+"""Vocabulary construction + Huffman coding.
+
+Parity: ref deeplearning4j-nlp/.../models/word2vec/{VocabWord,Huffman}.java,
+models/word2vec/wordstore/inmemory/AbstractCache.java (the VocabCache), and
+wordstore/VocabConstructor.java. Indices are assigned frequency-descending so the
+negative-sampling unigram table and Huffman tree match the reference layout.
+"""
+from __future__ import annotations
+
+import heapq
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+
+@dataclass
+class VocabWord:
+    """(ref models/word2vec/VocabWord.java)"""
+    word: str
+    count: int = 0
+    index: int = -1
+    codes: List[int] = field(default_factory=list)    # Huffman code bits
+    points: List[int] = field(default_factory=list)   # inner-node indices
+    is_label: bool = False  # ParagraphVectors doc labels live in the same vocab
+
+    def increment(self, by: int = 1):
+        self.count += by
+
+
+class VocabCache:
+    """(ref wordstore/inmemory/AbstractCache.java)"""
+
+    def __init__(self):
+        self._words: Dict[str, VocabWord] = {}
+        self._index: List[VocabWord] = []
+        self.total_word_occurrences = 0
+
+    # ------------- build -------------
+    def add_token(self, vw: VocabWord):
+        if vw.word in self._words:
+            self._words[vw.word].increment(vw.count)
+        else:
+            self._words[vw.word] = vw
+
+    def finish(self, min_word_frequency: int = 1):
+        """Prune + assign indices frequency-descending (ref VocabConstructor
+        buildJointVocabulary truncation + AbstractCache.updateWordsOccurencies)."""
+        kept = [w for w in self._words.values()
+                if w.count >= min_word_frequency or w.is_label]
+        kept.sort(key=lambda w: (-w.count, w.word))
+        self._words = {w.word: w for w in kept}
+        self._index = kept
+        for i, w in enumerate(kept):
+            w.index = i
+        self.total_word_occurrences = sum(w.count for w in kept)
+
+    # ------------- queries (ref VocabCache interface) -------------
+    def has_token(self, word: str) -> bool:
+        return word in self._words
+    containsWord = has_token
+
+    def word_for(self, word: str) -> Optional[VocabWord]:
+        return self._words.get(word)
+    wordFor = word_for
+
+    def index_of(self, word: str) -> int:
+        vw = self._words.get(word)
+        return -1 if vw is None else vw.index
+    indexOf = index_of
+
+    def word_at_index(self, idx: int) -> str:
+        return self._index[idx].word
+    wordAtIndex = word_at_index
+
+    def element_at_index(self, idx: int) -> VocabWord:
+        return self._index[idx]
+
+    def word_frequency(self, word: str) -> int:
+        vw = self._words.get(word)
+        return 0 if vw is None else vw.count
+    wordFrequency = word_frequency
+
+    def num_words(self) -> int:
+        return len(self._index)
+    numWords = num_words
+
+    def words(self) -> List[str]:
+        return [w.word for w in self._index]
+
+    def vocab_words(self) -> List[VocabWord]:
+        return list(self._index)
+    vocabWords = vocab_words
+
+    # ------------- derived tables -------------
+    def counts_array(self) -> np.ndarray:
+        return np.asarray([w.count for w in self._index], np.float64)
+
+    def unigram_probs(self, power: float = 0.75) -> np.ndarray:
+        """Negative-sampling distribution (ref AbstractCache/Word2Vec table build
+        with the 3/4 power)."""
+        c = self.counts_array() ** power
+        return c / c.sum()
+
+
+class Huffman:
+    """Huffman tree over word frequencies (ref models/word2vec/Huffman.java):
+    fills codes (bit per tree level) and points (inner-node ids) on every word —
+    consumed by the hierarchical-softmax path."""
+
+    def __init__(self, vocab: VocabCache, max_code_length: int = 40):
+        self.vocab = vocab
+        self.max_code_length = max_code_length
+
+    def build(self):
+        words = self.vocab.vocab_words()
+        n = len(words)
+        if n == 0:
+            return
+        heap = [(w.count, i, i) for i, w in enumerate(words)]  # (count, tiebreak, node)
+        heapq.heapify(heap)
+        parent = {}
+        bit = {}
+        next_inner = 0
+        serial = n
+        while len(heap) > 1:
+            c1, _, i1 = heapq.heappop(heap)
+            c2, _, i2 = heapq.heappop(heap)
+            inner = ("inner", next_inner)
+            next_inner += 1
+            parent[i1] = inner
+            parent[i2] = inner
+            bit[i1] = 0
+            bit[i2] = 1
+            heapq.heappush(heap, (c1 + c2, serial, inner))
+            serial += 1
+        for i, w in enumerate(words):
+            codes, points = [], []
+            node = i
+            while node in parent:
+                codes.append(bit[node])
+                node = parent[node]
+                points.append(node[1])
+            # root-first order, as the reference stores them
+            w.codes = codes[::-1][:self.max_code_length]
+            w.points = points[::-1][:self.max_code_length]
+
+
+class VocabConstructor:
+    """(ref wordstore/VocabConstructor.java) — single-pass count + prune + index,
+    optional Huffman build."""
+
+    def __init__(self, min_word_frequency: int = 1, build_huffman: bool = True):
+        self.min_word_frequency = int(min_word_frequency)
+        self.build_huffman = build_huffman
+
+    def build(self, sequences: Iterable[List[str]],
+              labels: Optional[Iterable[str]] = None) -> VocabCache:
+        vocab = VocabCache()
+        for seq in sequences:
+            for tok in seq:
+                vocab.add_token(VocabWord(tok, 1))
+        if labels is not None:
+            for lab in labels:
+                vocab.add_token(VocabWord(lab, 1, is_label=True))
+        vocab.finish(self.min_word_frequency)
+        if self.build_huffman:
+            Huffman(vocab).build()
+        return vocab

@@ -1,0 +1,56 @@
+"""Word2Vec: SequenceVectors over a sentence iterator + tokenizer.
+
+Parity: ref models/word2vec/Word2Vec.java (Builder with iterate/tokenizerFactory on
+top of SequenceVectors.Builder).
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+from deeplearning4j_tpu.nlp.sentence_iterator import SentenceIterator
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory, TokenizerFactory)
+
+
+class Word2Vec(SequenceVectors):
+    def __init__(self, sentence_iterator: Optional[SentenceIterator] = None,
+                 tokenizer_factory: Optional[TokenizerFactory] = None, **kw):
+        kw.setdefault("min_word_frequency", 5)
+        super().__init__(**kw)
+        self.sentence_iterator = sentence_iterator
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+
+    def _corpus(self) -> Iterable[List[str]]:
+        self.sentence_iterator.reset()
+        tf = self.tokenizer_factory
+        while self.sentence_iterator.has_next():
+            toks = tf.tokenize(self.sentence_iterator.next_sentence())
+            if toks:
+                yield toks
+
+    def fit(self, sequences_factory=None):
+        if sequences_factory is None:
+            if self.sentence_iterator is None:
+                raise ValueError("Word2Vec needs a sentence iterator (Builder.iterate)")
+            sequences_factory = self._corpus
+        return super().fit(sequences_factory)
+
+    class Builder(SequenceVectors.Builder):
+        def __init__(self):
+            super().__init__()
+            self._iter = None
+            self._tf = None
+
+        def iterate(self, it: SentenceIterator):
+            self._iter = it
+            return self
+
+        def tokenizerFactory(self, tf: TokenizerFactory):
+            self._tf = tf
+            return self
+        tokenizer_factory = tokenizerFactory
+
+        def build(self) -> "Word2Vec":
+            return Word2Vec(sentence_iterator=self._iter,
+                            tokenizer_factory=self._tf, **self._kw)

@@ -1,0 +1,120 @@
+"""WordVectors query API + in-memory lookup table.
+
+Parity: ref embeddings/wordvectors/WordVectorsImpl.java (getWordVector, similarity,
+wordsNearest incl. the positive/negative analogy form) and embeddings/inmemory/
+InMemoryLookupTable.java. wordsNearest is one normalized matmul over the whole
+vocab — the brute-force top-k the reference does via Nd4j, MXU-shaped here.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+class InMemoryLookupTable:
+    """syn0/syn1/syn1neg parameter matrices (ref InMemoryLookupTable.java)."""
+
+    def __init__(self, vocab: VocabCache, layer_size: int, seed: int = 12345,
+                 use_hs: bool = False, use_neg: bool = True,
+                 dtype=jnp.float32):
+        self.vocab = vocab
+        self.layer_size = int(layer_size)
+        V, D = vocab.num_words(), self.layer_size
+        rng = np.random.RandomState(seed)
+        # reference init: uniform in [-0.5/D, 0.5/D]
+        self.syn0 = jnp.asarray((rng.rand(V, D) - 0.5) / D, dtype)
+        self.syn1 = jnp.zeros((V, D), dtype) if use_hs else None
+        self.syn1neg = jnp.zeros((V, D), dtype) if use_neg else None
+
+    def reset_weights(self, seed: int = 12345):
+        self.__init__(self.vocab, self.layer_size, seed,
+                      self.syn1 is not None, self.syn1neg is not None,
+                      self.syn0.dtype)
+
+
+class WordVectors:
+    """Query surface shared by Word2Vec/ParagraphVectors/Glove
+    (ref WordVectorsImpl)."""
+
+    def __init__(self, vocab: VocabCache, table: InMemoryLookupTable):
+        self.vocab = vocab
+        self.lookup_table = table
+        self._norm_cache = None
+
+    # ------------- vectors -------------
+    def get_word_vector(self, word: str) -> Optional[np.ndarray]:
+        i = self.vocab.index_of(word)
+        return None if i < 0 else np.asarray(self.lookup_table.syn0[i])
+    getWordVector = get_word_vector
+    word_vector = get_word_vector
+
+    def get_word_vector_matrix(self, word: str):
+        return self.get_word_vector(word)
+
+    def has_word(self, word: str) -> bool:
+        return self.vocab.has_token(word)
+    hasWord = has_word
+
+    def _normed(self):
+        if self._norm_cache is None:
+            syn0 = self.lookup_table.syn0
+            self._norm_cache = syn0 / jnp.clip(
+                jnp.linalg.norm(syn0, axis=-1, keepdims=True), 1e-9)
+        return self._norm_cache
+
+    def _invalidate(self):
+        self._norm_cache = None
+
+    # ------------- similarity -------------
+    def similarity(self, w1: str, w2: str) -> float:
+        a, b = self.get_word_vector(w1), self.get_word_vector(w2)
+        if a is None or b is None:
+            return float("nan")
+        na, nb = np.linalg.norm(a), np.linalg.norm(b)
+        return float(a @ b / max(na * nb, 1e-12))
+
+    def words_nearest(self, positive, negative: Sequence[str] = (),
+                      top_n: int = 10) -> List[str]:
+        """wordsNearest(word|vec|positive-list, negative-list, n) — cosine top-k,
+        excluding the query words (ref WordVectorsImpl.wordsNearest)."""
+        exclude = set()
+        if isinstance(positive, str):
+            positive = [positive]
+        if isinstance(positive, (list, tuple)) and positive \
+                and isinstance(positive[0], str):
+            vec = np.zeros(self.lookup_table.layer_size, np.float32)
+            for w in positive:
+                v = self.get_word_vector(w)
+                if v is None:
+                    return []
+                vec += v
+                exclude.add(w)
+            for w in negative:
+                v = self.get_word_vector(w)
+                if v is None:
+                    return []
+                vec -= v
+                exclude.add(w)
+        else:
+            vec = np.asarray(positive, np.float32)
+        vec = vec / max(np.linalg.norm(vec), 1e-12)
+        sims = np.asarray(self._normed() @ jnp.asarray(vec))
+        order = np.argsort(-sims)
+        out = []
+        for i in order:
+            w = self.vocab.word_at_index(int(i))
+            if w in exclude:
+                continue
+            out.append(w)
+            if len(out) >= top_n:
+                break
+        return out
+    wordsNearest = words_nearest
+
+    def words_nearest_sum(self, word: str, top_n: int = 10) -> List[str]:
+        return self.words_nearest(word, top_n=top_n)

@@ -1,0 +1,214 @@
+"""NLP stack tests: tokenization, vocab/Huffman, Word2Vec (SkipGram/CBOW/HS),
+ParagraphVectors, GloVe, TF-IDF, serializer round-trip.
+
+Parity: ref deeplearning4j-nlp tests — Word2VecTests.java (similarity/wordsNearest
+on a toy corpus), ParagraphVectorsTest, GloveTest, TfidfVectorizerTest,
+WordVectorSerializerTest."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BasicLineIterator, CollectionSentenceIterator, CommonPreprocessor,
+    CountVectorizer, DefaultTokenizerFactory, Glove, NGramTokenizerFactory,
+    ParagraphVectors, TfidfVectorizer, VocabConstructor, Word2Vec,
+    WordVectorSerializer)
+
+RNG = np.random.RandomState(42)
+
+# two-topic toy corpus: fruit words co-occur, vehicle words co-occur
+FRUIT = ["apple", "banana", "cherry", "mango", "grape"]
+VEHICLE = ["car", "truck", "bus", "train", "plane"]
+
+
+def corpus(n=400):
+    rng = np.random.RandomState(7)
+    sents = []
+    for _ in range(n):
+        topic = FRUIT if rng.rand() < 0.5 else VEHICLE
+        words = [topic[i] for i in rng.randint(0, len(topic), 6)]
+        sents.append(" ".join(words))
+    return sents
+
+
+def _topic_coherence(vec_model):
+    """Mean in-topic minus cross-topic similarity."""
+    within, across = [], []
+    for a in FRUIT:
+        for b in FRUIT:
+            if a != b:
+                within.append(vec_model.similarity(a, b))
+        for b in VEHICLE:
+            across.append(vec_model.similarity(a, b))
+    return np.mean(within) - np.mean(across)
+
+
+# --------------------------------------------------------------- pipeline
+
+
+def test_tokenizer_and_preprocessor():
+    tf = DefaultTokenizerFactory()
+    tf.set_token_pre_processor(CommonPreprocessor())
+    toks = tf.tokenize("Hello, World! 42 times")
+    assert toks == ["hello", "world", "times"]
+    ng = NGramTokenizerFactory(DefaultTokenizerFactory(), 1, 2)
+    out = ng.tokenize("a b c")
+    assert "a b" in out and "b c" in out and "a" in out
+
+
+def test_sentence_iterators(tmp_path):
+    path = os.path.join(tmp_path, "corpus.txt")
+    with open(path, "w") as f:
+        f.write("first line\nsecond line\nthird line\n")
+    it = BasicLineIterator(path)
+    assert list(it) == ["first line", "second line", "third line"]
+    it.reset()
+    assert it.next_sentence() == "first line"
+    cit = CollectionSentenceIterator(["a", "b"])
+    cit.set_pre_processor(str.upper)
+    assert list(cit) == ["A", "B"]
+
+
+def test_vocab_and_huffman():
+    seqs = [s.split() for s in corpus(100)]
+    vocab = VocabConstructor(min_word_frequency=1).build(seqs)
+    assert vocab.num_words() == 10
+    # frequency-descending indexing
+    counts = [vocab.element_at_index(i).count for i in range(vocab.num_words())]
+    assert counts == sorted(counts, reverse=True)
+    # Huffman codes: prefix-free, rarer words get longer-or-equal codes
+    words = vocab.vocab_words()
+    codes = {w.word: "".join(map(str, w.codes)) for w in words}
+    clist = list(codes.values())
+    assert all(c for c in clist)
+    for a in clist:
+        for b in clist:
+            if a != b:
+                assert not b.startswith(a) or len(a) >= len(b)
+    assert len(words[0].codes) <= len(words[-1].codes)
+    assert all(len(w.points) == len(w.codes) for w in words)
+
+
+# --------------------------------------------------------------- word2vec
+
+
+def test_word2vec_skipgram_learns_topics():
+    w2v = (Word2Vec.Builder().layerSize(24).windowSize(3).negativeSample(5)
+           .minWordFrequency(1).epochs(20).learningRate(0.2).minLearningRate(0.01)
+           .batchSize(256).seed(1)
+           .iterate(CollectionSentenceIterator(corpus()))
+           .tokenizerFactory(DefaultTokenizerFactory()).build())
+    w2v.fit()
+    assert _topic_coherence(w2v) > 0.2
+    near = w2v.words_nearest("apple", top_n=4)
+    assert set(near) <= set(FRUIT) - {"apple"}
+    # analogy-style query executes (semantics weak on a toy corpus)
+    res = w2v.words_nearest(["apple", "car"], ["banana"], top_n=3)
+    assert len(res) == 3
+
+
+def test_word2vec_cbow_learns_topics():
+    w2v = (Word2Vec.Builder().layerSize(24).windowSize(3).negativeSample(5)
+           .minWordFrequency(1).epochs(20).learningRate(0.25).minLearningRate(0.01)
+           .batchSize(256).seed(2)
+           .elementsLearningAlgorithm("cbow")
+           .iterate(CollectionSentenceIterator(corpus()))
+           .tokenizerFactory(DefaultTokenizerFactory()).build())
+    w2v.fit()
+    assert _topic_coherence(w2v) > 0.15
+
+
+def test_word2vec_hierarchic_softmax():
+    w2v = (Word2Vec.Builder().layerSize(24).windowSize(3).negativeSample(0)
+           .useHierarchicSoftmax(True).minWordFrequency(1).epochs(20)
+           .batchSize(256).learningRate(0.3).minLearningRate(0.02).seed(3)
+           .iterate(CollectionSentenceIterator(corpus()))
+           .tokenizerFactory(DefaultTokenizerFactory()).build())
+    w2v.fit()
+    assert _topic_coherence(w2v) > 0.15
+
+
+def test_word2vec_deterministic_with_seed():
+    def run():
+        w2v = (Word2Vec.Builder().layerSize(8).windowSize(2).negativeSample(3)
+               .minWordFrequency(1).epochs(1).seed(11)
+               .iterate(CollectionSentenceIterator(corpus(50)))
+               .tokenizerFactory(DefaultTokenizerFactory()).build())
+        w2v.fit()
+        return w2v.get_word_vector("apple")
+
+    assert np.allclose(run(), run())
+
+
+# ----------------------------------------------------------- paragraph vectors
+
+
+def test_paragraph_vectors_dbow():
+    docs = []
+    rng = np.random.RandomState(3)
+    for k in range(30):
+        topic, lab = (FRUIT, "fruit") if k % 2 == 0 else (VEHICLE, "vehicle")
+        words = [topic[i] for i in rng.randint(0, len(topic), 8)]
+        docs.append((f"{lab}_{k}", " ".join(words)))
+    pv = (ParagraphVectors.Builder().layerSize(16).negativeSample(5)
+          .minWordFrequency(1).epochs(60).learningRate(0.2).batchSize(64)
+          .seed(5).build())
+    pv.fit_documents(docs)
+    assert pv.doc_vecs.shape == (30, 16)
+    # inferred vector for a new fruit doc lands nearer fruit labels
+    near = pv.nearest_labels("apple banana mango cherry grape apple", top_n=6)
+    fruit_hits = sum(1 for lab in near if lab.startswith("fruit"))
+    assert fruit_hits >= 4
+
+
+# --------------------------------------------------------------------- glove
+
+
+def test_glove_learns_topics():
+    seqs = [s.split() for s in corpus(300)]
+    glove = (Glove.Builder().layerSize(16).windowSize(4).learningRate(0.1)
+             .epochs(25).minWordFrequency(1).xMax(20.0).seed(9).build())
+    glove.fit(lambda: seqs)
+    assert _topic_coherence(glove) > 0.2
+    assert set(glove.words_nearest("truck", top_n=3)) <= set(VEHICLE) - {"truck"}
+
+
+# --------------------------------------------------------------- vectorizers
+
+
+def test_tfidf_vectorizer():
+    texts = ["apple banana apple", "car truck car car", "apple car"]
+    cv = CountVectorizer()
+    m = cv.fit_transform(texts)
+    assert m.shape == (3, cv.vocab.num_words())
+    ai = cv.vocab.index_of("apple")
+    assert m[0, ai] == 2.0
+    tv = TfidfVectorizer()
+    t = tv.fit_transform(texts)
+    # 'banana' appears in 1 doc, 'apple' in 2 -> higher idf weight for banana
+    bi = tv.vocab.index_of("banana")
+    assert t[0, bi] > t[0, ai] > 0
+
+
+# ---------------------------------------------------------------- serializer
+
+
+@pytest.mark.parametrize("binary", [False, True])
+def test_serializer_round_trip(tmp_path, binary):
+    w2v = (Word2Vec.Builder().layerSize(12).windowSize(2).negativeSample(3)
+           .minWordFrequency(1).epochs(1).seed(4)
+           .iterate(CollectionSentenceIterator(corpus(60)))
+           .tokenizerFactory(DefaultTokenizerFactory()).build())
+    w2v.fit()
+    path = os.path.join(tmp_path, "vecs.bin" if binary else "vecs.txt")
+    WordVectorSerializer.write_word_vectors(w2v, path, binary=binary)
+    loaded = WordVectorSerializer.read_word_vectors(path)
+    assert loaded.vocab.num_words() == w2v.vocab.num_words()
+    for w in ["apple", "car", "train"]:
+        a, b = w2v.get_word_vector(w), loaded.get_word_vector(w)
+        tol = 1e-6 if binary else 1e-5  # text format rounds to 6 decimals
+        assert np.allclose(a, b, atol=tol)
+    # queries work on the loaded model
+    assert loaded.similarity("apple", "apple") == pytest.approx(1.0, abs=1e-5)
+    assert len(loaded.words_nearest("bus", top_n=3)) == 3
