@@ -1,0 +1,17 @@
+"""Graph embeddings (L7): graph API, random walks, DeepWalk.
+
+Parity: ref deeplearning4j-graph — api/{Graph,Vertex,Edge}, graph/Graph impl,
+iterator/{RandomWalkIterator,WeightedRandomWalkIterator}, models/deepwalk/DeepWalk,
+data/GraphLoader. TPU-first: DeepWalk reuses the SequenceVectors SkipGram XLA steps
+over vertex-token walks — the reference's GraphHuffman/own-gradient code collapses
+into the shared embedding trainer.
+"""
+from deeplearning4j_tpu.graphs.api import Edge, Graph, Vertex
+from deeplearning4j_tpu.graphs.loader import GraphLoader
+from deeplearning4j_tpu.graphs.random_walk import (
+    NoEdgeHandling, RandomWalkIterator, WeightedRandomWalkIterator)
+from deeplearning4j_tpu.graphs.deepwalk import DeepWalk
+
+__all__ = ["Graph", "Vertex", "Edge", "RandomWalkIterator",
+           "WeightedRandomWalkIterator", "NoEdgeHandling", "DeepWalk",
+           "GraphLoader"]

@@ -1,0 +1,98 @@
+"""Graph structure.
+
+Parity: ref deeplearning4j-graph/.../api/{IGraph,Vertex,Edge}.java and
+graph/Graph.java (adjacency-list impl with optional vertex values and weighted,
+directed/undirected edges).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, Generic, List, Optional, Sequence, TypeVar
+
+import numpy as np
+
+V = TypeVar("V")
+
+
+@dataclass
+class Vertex:
+    idx: int
+    value: Any = None
+
+    def vertex_id(self) -> int:
+        return self.idx
+    vertexID = vertex_id
+
+
+@dataclass
+class Edge:
+    frm: int
+    to: int
+    weight: float = 1.0
+    directed: bool = False
+
+    def get_from(self) -> int:
+        return self.frm
+
+    def get_to(self) -> int:
+        return self.to
+
+
+class Graph:
+    """(ref graph/Graph.java)"""
+
+    def __init__(self, num_vertices: int, allow_multiple_edges: bool = True,
+                 vertex_values: Optional[Sequence[Any]] = None):
+        self._n = int(num_vertices)
+        self.allow_multiple_edges = allow_multiple_edges
+        self._vertices = [
+            Vertex(i, vertex_values[i] if vertex_values is not None else None)
+            for i in range(self._n)]
+        self._adj: List[List[Edge]] = [[] for _ in range(self._n)]
+
+    # ------------- construction -------------
+    def add_edge(self, frm: int, to: int, weight: float = 1.0,
+                 directed: bool = False):
+        if not (0 <= frm < self._n and 0 <= to < self._n):
+            raise ValueError(f"Edge ({frm},{to}) out of range for "
+                             f"{self._n} vertices (ref Graph.java bounds check)")
+        if not self.allow_multiple_edges:
+            if any(ex.to == to for ex in self._adj[frm]):
+                return self
+        self._adj[frm].append(Edge(frm, to, weight, directed))
+        if not directed:
+            # the reverse half obeys allow_multiple_edges too
+            if self.allow_multiple_edges or \
+                    not any(ex.to == frm for ex in self._adj[to]):
+                self._adj[to].append(Edge(to, frm, weight, directed))
+        return self
+    addEdge = add_edge
+
+    # ------------- queries (ref IGraph) -------------
+    def num_vertices(self) -> int:
+        return self._n
+    numVertices = num_vertices
+
+    def get_vertex(self, idx: int) -> Vertex:
+        return self._vertices[idx]
+    getVertex = get_vertex
+
+    def get_edges_out(self, idx: int) -> List[Edge]:
+        return list(self._adj[idx])
+    getEdgesOut = get_edges_out
+
+    def get_vertex_degree(self, idx: int) -> int:
+        return len(self._adj[idx])
+    getVertexDegree = get_vertex_degree
+
+    def get_connected_vertex_indices(self, idx: int) -> List[int]:
+        return [e.to for e in self._adj[idx]]
+    getConnectedVertexIndices = get_connected_vertex_indices
+
+    def neighbor_arrays(self):
+        """(neighbors, weights) ragged arrays for vectorized walk sampling."""
+        nbrs = [np.asarray([e.to for e in self._adj[i]], np.int64)
+                for i in range(self._n)]
+        wgts = [np.asarray([e.weight for e in self._adj[i]], np.float64)
+                for i in range(self._n)]
+        return nbrs, wgts

@@ -1,0 +1,115 @@
+"""DeepWalk vertex embeddings.
+
+Parity: ref deeplearning4j-graph/.../models/deepwalk/DeepWalk.java (Builder with
+vectorSize/windowSize/learningRate, initialize(graph), fit(walkIterator),
+getVertexVector/similarity/verticesNearest) and GraphHuffman.java. TPU-first: walks
+become token sequences and training reuses the SequenceVectors SkipGram XLA steps
+(hierarchical softmax by default, like the reference; negative sampling available).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graphs.api import Graph
+from deeplearning4j_tpu.graphs.random_walk import RandomWalkIterator
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+
+
+class DeepWalk:
+    def __init__(self, vector_size: int = 100, window_size: int = 5,
+                 learning_rate: float = 0.025, negative: int = 0,
+                 use_hierarchic_softmax: bool = True, epochs: int = 1,
+                 batch_size: int = 2048, seed: int = 12345):
+        self.vector_size = int(vector_size)
+        self.window_size = int(window_size)
+        self.learning_rate = float(learning_rate)
+        self.negative = int(negative)
+        self.use_hs = bool(use_hierarchic_softmax)
+        self.epochs = int(epochs)
+        self.batch_size = int(batch_size)
+        self.seed = int(seed)
+        self.graph: Optional[Graph] = None
+        self._sv: Optional[SequenceVectors] = None
+
+    # ------------- lifecycle (ref initialize + fit) -------------
+    def initialize(self, graph: Graph):
+        self.graph = graph
+        return self
+
+    def fit(self, walk_iterator: Optional[RandomWalkIterator] = None,
+            walk_length: int = 40):
+        if self.graph is None and walk_iterator is None:
+            raise ValueError("call initialize(graph) or pass a walk iterator")
+        if walk_iterator is None:
+            walk_iterator = RandomWalkIterator(self.graph, walk_length,
+                                               seed=self.seed)
+
+        def corpus():
+            walk_iterator.reset()
+            while walk_iterator.has_next():
+                yield [str(v) for v in walk_iterator.next_walk()]
+
+        self._sv = SequenceVectors(
+            layer_size=self.vector_size, window=self.window_size,
+            negative=self.negative, use_hierarchic_softmax=self.use_hs,
+            learning_rate=self.learning_rate, epochs=self.epochs,
+            batch_size=self.batch_size, min_word_frequency=1, seed=self.seed)
+        self._sv.fit(corpus)
+        return self
+
+    # ------------- queries (ref DeepWalk public API) -------------
+    @property
+    def lookup_table(self):
+        return self._sv.lookup_table
+
+    def get_vertex_vector(self, idx: int) -> np.ndarray:
+        return self._sv.get_word_vector(str(idx))
+    getVertexVector = get_vertex_vector
+
+    def similarity(self, a: int, b: int) -> float:
+        return self._sv.similarity(str(a), str(b))
+
+    def vertices_nearest(self, idx: int, top_n: int = 10) -> List[int]:
+        return [int(w) for w in self._sv.words_nearest(str(idx), top_n=top_n)]
+    verticesNearest = vertices_nearest
+
+    def num_vertices(self) -> int:
+        return self._sv.vocab.num_words()
+
+    class Builder:
+        def __init__(self):
+            self._kw = {}
+
+        def vectorSize(self, n):
+            self._kw["vector_size"] = int(n)
+            return self
+
+        def windowSize(self, n):
+            self._kw["window_size"] = int(n)
+            return self
+
+        def learningRate(self, r):
+            self._kw["learning_rate"] = float(r)
+            return self
+
+        def negativeSample(self, n):
+            self._kw["negative"] = int(n)
+            self._kw["use_hierarchic_softmax"] = int(n) == 0
+            return self
+
+        def epochs(self, n):
+            self._kw["epochs"] = int(n)
+            return self
+
+        def batchSize(self, n):
+            self._kw["batch_size"] = int(n)
+            return self
+
+        def seed(self, s):
+            self._kw["seed"] = int(s)
+            return self
+
+        def build(self) -> "DeepWalk":
+            return DeepWalk(**self._kw)

@@ -1,0 +1,41 @@
+"""Graph loading from edge-list files.
+
+Parity: ref deeplearning4j-graph/.../data/GraphLoader.java
+(loadUndirectedGraphEdgeListFile / loadWeightedEdgeListFile).
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.graphs.api import Graph
+
+
+class GraphLoader:
+    @staticmethod
+    def load_undirected_graph_edge_list_file(path: str, num_vertices: int,
+                                             delimiter: str = ",") -> Graph:
+        g = Graph(num_vertices)
+        with open(path, "r") as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split(delimiter)
+                g.add_edge(int(parts[0]), int(parts[1]))
+        return g
+    loadUndirectedGraphEdgeListFile = load_undirected_graph_edge_list_file
+
+    @staticmethod
+    def load_weighted_edge_list_file(path: str, num_vertices: int,
+                                     delimiter: str = ",",
+                                     directed: bool = False) -> Graph:
+        g = Graph(num_vertices)
+        with open(path, "r") as f:
+            for line in f:
+                line = line.strip()
+                if not line or line.startswith("#"):
+                    continue
+                parts = line.split(delimiter)
+                w = float(parts[2]) if len(parts) > 2 else 1.0
+                g.add_edge(int(parts[0]), int(parts[1]), weight=w,
+                           directed=directed)
+        return g
+    loadWeightedEdgeListFile = load_weighted_edge_list_file

@@ -1,0 +1,87 @@
+"""Random-walk iterators over a graph.
+
+Parity: ref deeplearning4j-graph/.../iterator/{RandomWalkIterator,
+WeightedRandomWalkIterator}.java + GraphWalkIterator API and the NoEdgeHandling
+enum (SELF_LOOP_ON_DISCONNECTED / EXCEPTION_ON_DISCONNECTED).
+"""
+from __future__ import annotations
+
+from typing import Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graphs.api import Graph
+
+
+class NoEdgeHandling:
+    SELF_LOOP_ON_DISCONNECTED = "self_loop"
+    EXCEPTION_ON_DISCONNECTED = "exception"
+
+
+class RandomWalkIterator:
+    """Uniform random walks, one starting at each vertex per epoch
+    (ref RandomWalkIterator.java)."""
+
+    def __init__(self, graph: Graph, walk_length: int, seed: int = 12345,
+                 no_edge_handling: str = NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED):
+        self.graph = graph
+        self.walk_length = int(walk_length)
+        self.seed = int(seed)
+        self.no_edge_handling = no_edge_handling
+        self._nbrs, self._wgts = graph.neighbor_arrays()
+        self.reset()
+
+    def reset(self):
+        self._rng = np.random.RandomState(self.seed)
+        self._order = self._rng.permutation(self.graph.num_vertices())
+        self._pos = 0
+
+    def has_next(self) -> bool:
+        return self._pos < self._order.size
+    hasNext = has_next
+
+    def _choose(self, cur: int) -> int:
+        nbrs = self._nbrs[cur]
+        return int(nbrs[self._rng.randint(nbrs.size)])
+
+    def next_walk(self) -> List[int]:
+        start = int(self._order[self._pos])
+        self._pos += 1
+        walk = [start]
+        cur = start
+        for _ in range(self.walk_length):
+            if self._nbrs[cur].size == 0:
+                if self.no_edge_handling == NoEdgeHandling.EXCEPTION_ON_DISCONNECTED:
+                    raise ValueError(f"Vertex {cur} has no outgoing edges")
+                walk.append(cur)  # self loop
+                continue
+            cur = self._choose(cur)
+            walk.append(cur)
+        return walk
+    next = next_walk
+
+    def walk_length_(self) -> int:
+        return self.walk_length
+
+    def __iter__(self) -> Iterator[List[int]]:
+        self.reset()
+        while self.has_next():
+            yield self.next_walk()
+
+
+class WeightedRandomWalkIterator(RandomWalkIterator):
+    """Transition probability proportional to edge weight
+    (ref WeightedRandomWalkIterator.java). Probabilities are normalized ONCE at
+    construction; a vertex whose weights sum to zero falls back to uniform."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self._probs = []
+        for w in self._wgts:
+            s = w.sum()
+            self._probs.append(w / s if s > 0 else
+                               (np.full(w.size, 1.0 / w.size) if w.size else w))
+
+    def _choose(self, cur: int) -> int:
+        nbrs = self._nbrs[cur]
+        return int(nbrs[self._rng.choice(nbrs.size, p=self._probs[cur])])

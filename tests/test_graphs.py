@@ -1,0 +1,126 @@
+"""Graph API / random walks / DeepWalk tests.
+
+Parity: ref deeplearning4j-graph tests — TestGraph, TestRandomWalkIterator,
+DeepWalkGradientCheck/TestDeepWalk (two-cluster embedding separation)."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graphs import (
+    DeepWalk, Graph, GraphLoader, NoEdgeHandling, RandomWalkIterator,
+    WeightedRandomWalkIterator)
+
+
+def two_cluster_graph(k=6):
+    """Two dense k-cliques joined by a single bridge edge."""
+    g = Graph(2 * k)
+    for a in range(k):
+        for b in range(a + 1, k):
+            g.add_edge(a, b)
+            g.add_edge(k + a, k + b)
+    g.add_edge(0, k)  # bridge
+    return g
+
+
+def test_graph_api():
+    g = Graph(4)
+    g.add_edge(0, 1).add_edge(1, 2, weight=2.0).add_edge(2, 3, directed=True)
+    assert g.num_vertices() == 4
+    assert g.get_vertex_degree(1) == 2        # undirected edges count both ways
+    assert g.get_connected_vertex_indices(2) == [1, 3]
+    assert g.get_connected_vertex_indices(3) == []  # directed 2->3
+    assert g.get_vertex(2).vertex_id() == 2
+
+
+def test_random_walks():
+    g = two_cluster_graph()
+    it = RandomWalkIterator(g, walk_length=10, seed=3)
+    walks = list(it)
+    assert len(walks) == g.num_vertices()      # one walk per start vertex
+    assert all(len(w) == 11 for w in walks)
+    for w in walks:                            # every hop is a real edge
+        for a, b in zip(w, w[1:]):
+            assert b in g.get_connected_vertex_indices(a)
+    # deterministic under reset
+    it.reset()
+    assert list(it)[0] == walks[0]
+
+
+def test_walks_isolated_vertex_self_loop_and_exception():
+    g = Graph(3)
+    g.add_edge(0, 1)
+    walks = {w[0]: w for w in RandomWalkIterator(g, 4, seed=1)}
+    assert set(walks[2]) == {2}  # isolated vertex self-loops
+    with pytest.raises(ValueError):
+        it = RandomWalkIterator(
+            g, 4, seed=1,
+            no_edge_handling=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED)
+        list(it)
+
+
+def test_weighted_walks_bias():
+    g = Graph(3)
+    g.add_edge(0, 1, weight=100.0)
+    g.add_edge(0, 2, weight=1.0)
+    # long walks through one iterator: every return to 0 is a fresh biased draw
+    it = WeightedRandomWalkIterator(g, walk_length=400, seed=5)
+    hits = {1: 0, 2: 0}
+    for w in it:
+        for a, b in zip(w, w[1:]):
+            if a == 0:
+                hits[b] += 1
+    assert hits[1] + hits[2] > 100
+    assert hits[1] > hits[2] * 5
+
+
+def test_weighted_walks_zero_weight_fallback():
+    g = Graph(2)
+    g.add_edge(0, 1, weight=0.0)
+    it = WeightedRandomWalkIterator(g, walk_length=3, seed=1)
+    walks = list(it)  # must not raise on the 0/0 normalization
+    assert all(len(w) == 4 for w in walks)
+
+
+def test_no_multiple_edges_flag_covers_reverse_half():
+    g = Graph(2, allow_multiple_edges=False)
+    g.add_edge(0, 1, directed=True)
+    g.add_edge(1, 0)  # undirected; reverse half would duplicate 0->1
+    assert len(g.get_edges_out(0)) == 1
+    assert len(g.get_edges_out(1)) == 1
+    with pytest.raises(ValueError):
+        g.add_edge(0, 5)  # bounds check
+
+
+def test_deepwalk_separates_clusters():
+    g = two_cluster_graph()
+    dw = (DeepWalk.Builder().vectorSize(16).windowSize(4).learningRate(0.3)
+          .epochs(15).batchSize(256).seed(7).build())
+    dw.initialize(g)
+    dw.fit(walk_length=20)
+    assert dw.num_vertices() == g.num_vertices()
+    k = 6
+    within, across = [], []
+    for a in range(1, k):       # skip bridge vertices 0 and k
+        for b in range(1, k):
+            if a != b:
+                within.append(dw.similarity(a, b))
+        for b in range(k + 1, 2 * k):
+            across.append(dw.similarity(a, b))
+    assert np.mean(within) - np.mean(across) > 0.3
+    near = dw.vertices_nearest(2, top_n=4)
+    assert all(v < k for v in near)  # same-cluster neighbors
+    assert dw.get_vertex_vector(3).shape == (16,)
+
+
+def test_graph_loader(tmp_path):
+    path = os.path.join(tmp_path, "edges.csv")
+    with open(path, "w") as f:
+        f.write("# comment\n0,1\n1,2\n2,0\n")
+    g = GraphLoader.load_undirected_graph_edge_list_file(path, 3)
+    assert g.get_vertex_degree(0) == 2
+    wpath = os.path.join(tmp_path, "wedges.csv")
+    with open(wpath, "w") as f:
+        f.write("0,1,5.0\n1,2,0.5\n")
+    gw = GraphLoader.load_weighted_edge_list_file(wpath, 3)
+    assert gw.get_edges_out(0)[0].weight == 5.0
