@@ -1,0 +1,17 @@
+"""Nearest neighbors + clustering + t-SNE (L7).
+
+Parity: ref deeplearning4j-nearestneighbors-parent/nearestneighbor-core
+(clustering/kmeans, clustering/vptree/VPTree.java:54) and deeplearning4j-core
+plot/BarnesHutTsne.java:65. TPU-first: the default KNN path is brute force on the
+MXU (one |x|^2+|y|^2-2xy matmul + top_k beats tree pointer-chasing for any N that
+fits in HBM); VPTree is kept as the host-side exact structure for API parity and
+huge-N regimes; t-SNE runs the EXACT O(N^2) gradient as batched XLA matmuls —
+the Barnes-Hut quadtree is a scalar-workload design that would waste the MXU.
+"""
+from deeplearning4j_tpu.clustering.knn import NearestNeighbors, VPTree
+from deeplearning4j_tpu.clustering.kmeans import (
+    Cluster, ClusterSet, KMeansClustering, Point)
+from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne, Tsne
+
+__all__ = ["NearestNeighbors", "VPTree", "KMeansClustering", "ClusterSet",
+           "Cluster", "Point", "BarnesHutTsne", "Tsne"]

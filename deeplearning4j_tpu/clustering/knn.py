@@ -1,0 +1,139 @@
+"""K-nearest-neighbor search: XLA brute force + host-side VPTree.
+
+Parity: ref nearestneighbor-core/.../vptree/VPTree.java:54 (vantage-point tree with
+search(point, k)) and the brute-force path the reference's parameter-server KNN
+falls back to. TPU-first: `NearestNeighbors` computes the full distance block as
+|x|^2 + |y|^2 - 2 x·y on the MXU and top_k's it — one fused jitted computation,
+batched over queries.
+"""
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@functools.partial(jax.jit, static_argnames=("k", "cosine"))
+def _knn_block(data, queries, k: int, cosine: bool = False):
+    if cosine:
+        dn = data / jnp.clip(jnp.linalg.norm(data, axis=1, keepdims=True), 1e-12)
+        qn = queries / jnp.clip(jnp.linalg.norm(queries, axis=1, keepdims=True),
+                                1e-12)
+        sims = qn @ dn.T
+        neg_d, idx = jax.lax.top_k(sims, k)
+        return 1.0 - neg_d, idx
+    d2 = (jnp.sum(queries * queries, axis=1)[:, None]
+          + jnp.sum(data * data, axis=1)[None, :]
+          - 2.0 * queries @ data.T)                      # MXU matmul
+    neg, idx = jax.lax.top_k(-d2, k)
+    return jnp.sqrt(jnp.maximum(-neg, 0.0)), idx
+
+
+class NearestNeighbors:
+    """Brute-force exact KNN on device."""
+
+    def __init__(self, data, distance: str = "euclidean"):
+        self.data = jnp.asarray(data, jnp.float32)
+        if distance not in ("euclidean", "cosine"):
+            raise ValueError(f"unsupported distance {distance!r}")
+        self.cosine = distance == "cosine"
+
+    def search(self, queries, k: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Returns (distances (Q,k), indices (Q,k)), nearest first."""
+        q = jnp.atleast_2d(jnp.asarray(queries, jnp.float32))
+        d, i = _knn_block(self.data, q, k=int(k), cosine=self.cosine)
+        return np.asarray(d), np.asarray(i)
+
+
+class VPTree:
+    """Exact vantage-point tree (ref VPTree.java:54): O(log N) expected search via
+    triangle-inequality pruning. Host-side recursive structure; distances are
+    numpy — use NearestNeighbors for the TPU path."""
+
+    class _Node:
+        __slots__ = ("index", "threshold", "inside", "outside")
+
+        def __init__(self, index, threshold=0.0, inside=None, outside=None):
+            self.index = index
+            self.threshold = threshold
+            self.inside = inside
+            self.outside = outside
+
+    def __init__(self, items, distance: str = "euclidean", seed: int = 12345):
+        self.items = np.asarray(items, np.float64)
+        if distance not in ("euclidean", "cosine"):
+            raise ValueError(f"unsupported distance {distance!r}")
+        self.distance = distance
+        self._rng = np.random.RandomState(seed)
+        if self.distance == "cosine":
+            norms = np.linalg.norm(self.items, axis=1, keepdims=True)
+            self._normed = self.items / np.clip(norms, 1e-12, None)
+        self.root = self._build(list(range(self.items.shape[0])))
+
+    def _dist(self, i: int, pts: np.ndarray) -> np.ndarray:
+        if self.distance == "cosine":
+            return 1.0 - self._normed[pts] @ self._normed[i]
+        diff = self.items[pts] - self.items[i]
+        return np.sqrt(np.sum(diff * diff, axis=1))
+
+    def _build(self, idxs: List[int]):
+        if not idxs:
+            return None
+        if len(idxs) == 1:
+            return VPTree._Node(idxs[0])
+        vp = idxs[self._rng.randint(len(idxs))]
+        rest = np.asarray([i for i in idxs if i != vp])
+        d = self._dist(vp, rest)
+        median = float(np.median(d))
+        inside = rest[d <= median].tolist()
+        outside = rest[d > median].tolist()
+        if not inside or not outside:  # degenerate split: fall back to halves
+            order = rest[np.argsort(d)]
+            half = len(order) // 2
+            inside, outside = order[:half + 1].tolist(), order[half + 1:].tolist()
+        return VPTree._Node(vp, median, self._build(inside),
+                            self._build(outside))
+
+    def search(self, point, k: int) -> Tuple[List[int], List[float]]:
+        """(ref VPTree.search(point, k, results, distances))"""
+        point = np.asarray(point, np.float64)
+        if self.distance == "cosine":
+            pn = point / max(np.linalg.norm(point), 1e-12)
+
+            def dist_to(i):
+                return float(1.0 - self._normed[i] @ pn)
+        else:
+            def dist_to(i):
+                return float(np.linalg.norm(self.items[i] - point))
+
+        import heapq
+        heap: List[Tuple[float, int]] = []  # max-heap via negated distance
+        tau = [np.inf]
+
+        def visit(node):
+            if node is None:
+                return
+            d = dist_to(node.index)
+            if d < tau[0] or len(heap) < k:
+                heapq.heappush(heap, (-d, node.index))
+                if len(heap) > k:
+                    heapq.heappop(heap)
+                if len(heap) == k:
+                    tau[0] = -heap[0][0]
+            if node.inside is None and node.outside is None:
+                return
+            if d <= node.threshold:
+                visit(node.inside)
+                if d + tau[0] > node.threshold:   # ball crosses the boundary
+                    visit(node.outside)
+            else:
+                visit(node.outside)
+                if d - tau[0] <= node.threshold:
+                    visit(node.inside)
+
+        visit(self.root)
+        out = sorted(((-nd, i) for nd, i in heap))
+        return [i for _, i in out], [d for d, _ in out]

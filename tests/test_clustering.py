@@ -1,0 +1,114 @@
+"""KNN / VPTree / k-means / t-SNE tests.
+
+Parity: ref nearestneighbor-core tests (VPTreeTest, KMeansTest) and
+deeplearning4j-core Test (BarnesHutTsne smoke + convergence)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    BarnesHutTsne, KMeansClustering, NearestNeighbors, Point, Tsne, VPTree)
+
+RNG = np.random.RandomState(0)
+
+
+def blobs(k=3, n_per=40, d=5, spread=0.3, rng=None):
+    rng = rng or np.random.RandomState(1)
+    centers = np.eye(k, d) * 10.0  # orthogonal, guaranteed well-separated
+    xs, ys = [], []
+    for c in range(k):
+        xs.append(centers[c] + spread * rng.randn(n_per, d))
+        ys.append(np.full(n_per, c))
+    return np.concatenate(xs), np.concatenate(ys)
+
+
+def _brute_knn(data, q, k):
+    d = np.linalg.norm(data - q, axis=1)
+    idx = np.argsort(d)[:k]
+    return idx, d[idx]
+
+
+def test_knn_matches_numpy_brute_force():
+    data = RNG.randn(200, 8).astype(np.float32)
+    nn = NearestNeighbors(data)
+    queries = RNG.randn(5, 8).astype(np.float32)
+    dist, idx = nn.search(queries, k=7)
+    for qi in range(5):
+        ref_idx, ref_d = _brute_knn(data, queries[qi], 7)
+        assert list(idx[qi]) == list(ref_idx)
+        assert np.allclose(dist[qi], ref_d, atol=1e-4)
+
+
+def test_knn_cosine():
+    data = RNG.randn(100, 6).astype(np.float32)
+    nn = NearestNeighbors(data, distance="cosine")
+    # nearest to a data point under cosine is itself (distance 0)
+    d, i = nn.search(data[17], k=1)
+    assert i[0, 0] == 17
+    assert d[0, 0] == pytest.approx(0.0, abs=1e-5)
+
+
+def test_vptree_matches_brute_force():
+    data = RNG.randn(300, 4)
+    tree = VPTree(data)
+    for _ in range(10):
+        q = RNG.randn(4)
+        idx, dist = tree.search(q, k=5)
+        ref_idx, ref_d = _brute_knn(data, q, 5)
+        assert list(idx) == list(ref_idx)
+        assert np.allclose(dist, ref_d, atol=1e-9)
+        assert dist == sorted(dist)
+
+
+def test_vptree_cosine():
+    data = RNG.randn(100, 5)
+    tree = VPTree(data, distance="cosine")
+    idx, dist = tree.search(data[3], k=1)
+    assert idx[0] == 3 and dist[0] == pytest.approx(0.0, abs=1e-9)
+
+
+def test_kmeans_recovers_blobs():
+    x, y = blobs(k=3, n_per=50)
+    km = KMeansClustering.setup(3, max_iterations=50, distance="euclidean")
+    cs = km.apply_to(x)
+    assert cs.get_cluster_count() == 3
+    a = cs.assignments
+    # purity: every true blob maps dominantly to one cluster
+    purity = 0
+    for c in range(3):
+        counts = np.bincount(a[y == c], minlength=3)
+        purity += counts.max()
+    assert purity / x.shape[0] > 0.95
+    assert np.all(cs.distances >= 0)
+    # Point-object API
+    pts = [Point(i, x[i]) for i in range(20)]
+    cs2 = KMeansClustering.setup(2, 10).apply_to(pts)
+    assert sum(len(c.point_ids) for c in cs2.get_clusters()) == 20
+
+
+def test_tsne_separates_blobs():
+    x, y = blobs(k=2, n_per=40, d=10, spread=0.2)
+    tsne = (BarnesHutTsne.Builder().setMaxIter(300).perplexity(15.0)
+            .learningRate(100.0).theta(0.5).seed(2).build())
+    out = tsne.fit(x)
+    assert out.shape == (80, 2)
+    assert np.all(np.isfinite(out))
+    # KL decreased over optimization (after the early-exaggeration phase)
+    assert tsne.kl_history[-1] < tsne.kl_history[110]
+    # 2D embedding separates the blobs: distance between class means far
+    # exceeds the within-class spread
+    m0, m1 = out[y == 0].mean(0), out[y == 1].mean(0)
+    s0 = np.linalg.norm(out[y == 0] - m0, axis=1).mean()
+    s1 = np.linalg.norm(out[y == 1] - m1, axis=1).mean()
+    assert np.linalg.norm(m0 - m1) > 2.0 * (s0 + s1)
+
+
+def test_tsne_save_as_file(tmp_path):
+    import os
+    x, y = blobs(k=2, n_per=10, d=4)
+    tsne = Tsne(max_iter=50, perplexity=5.0, seed=3)
+    tsne.fit(x)
+    path = os.path.join(tmp_path, "tsne.tsv")
+    tsne.save_as_file(path, labels=y.astype(int))
+    lines = open(path).read().strip().split("\n")
+    assert len(lines) == 20
+    assert len(lines[0].split("\t")) == 3
