@@ -1,0 +1,13 @@
+"""Dataset fetchers/iterators (ref deeplearning4j-core datasets/iterator/impl/)."""
+from deeplearning4j_tpu.datasets.impl.mnist import MnistDataSetIterator
+from deeplearning4j_tpu.datasets.impl.iris import IrisDataSetIterator, load_iris
+from deeplearning4j_tpu.datasets.impl.emnist import (
+    EmnistDataSetIterator, EmnistSet, load_emnist)
+from deeplearning4j_tpu.datasets.impl.cifar import (
+    CifarDataSetIterator, load_cifar)
+from deeplearning4j_tpu.datasets.impl.lfw import LFWDataSetIterator, load_lfw
+
+__all__ = ["MnistDataSetIterator", "IrisDataSetIterator", "load_iris",
+           "EmnistDataSetIterator", "EmnistSet", "load_emnist",
+           "CifarDataSetIterator", "load_cifar", "LFWDataSetIterator",
+           "load_lfw"]

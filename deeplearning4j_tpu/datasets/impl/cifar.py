@@ -1,0 +1,98 @@
+"""CIFAR-10 dataset iterator.
+
+Parity: ref deeplearning4j-core/.../datasets/iterator/impl/CifarDataSetIterator.java
++ base/CifarLoader.java (binary-batch format: 1 label byte + 3072 pixel bytes per
+record). Resolution: real data_batch_*.bin / test_batch.bin under $CIFAR_DIR or
+~/.deeplearning4j/cifar10 (the cifar-10-batches-bin layout), else a deterministic
+synthetic set (class-dependent color gradients + texture) with identical shapes.
+"""
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+NUM_LABELS = 10
+RECORD_BYTES = 1 + 3072
+
+
+def _read_bin(path: Path) -> Tuple[np.ndarray, np.ndarray]:
+    raw = np.frombuffer(path.read_bytes(), np.uint8)
+    recs = raw.reshape(-1, RECORD_BYTES)
+    labels = recs[:, 0].astype(np.int64)
+    imgs = recs[:, 1:].reshape(-1, 3, 32, 32).astype(np.float32) / 255.0
+    return imgs, labels
+
+
+def _synthetic_cifar(n: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Class = distinctive mean color + oriented sinusoidal texture."""
+    rng = np.random.RandomState(seed)
+    proto_rng = np.random.RandomState(999)
+    yy, xx = np.mgrid[0:32, 0:32] / 32.0
+    protos = []
+    for c in range(NUM_LABELS):
+        color = proto_rng.rand(3, 1, 1)
+        freq, angle = proto_rng.uniform(2, 8), proto_rng.uniform(0, np.pi)
+        tex = 0.25 * np.sin(2 * np.pi * freq *
+                            (np.cos(angle) * xx + np.sin(angle) * yy))
+        protos.append(np.clip(color + tex[None], 0, 1).astype(np.float32))
+    labels = rng.randint(0, NUM_LABELS, n)
+    imgs = np.zeros((n, 3, 32, 32), np.float32)
+    for i, c in enumerate(labels):
+        imgs[i] = np.clip(protos[c] + rng.normal(0, 0.08, (3, 32, 32)), 0, 1)
+    return imgs, labels.astype(np.int64)
+
+
+def load_cifar(train: bool = True, num_examples: Optional[int] = None,
+               seed: int = 555) -> Tuple[np.ndarray, np.ndarray]:
+    """((n,3,32,32) float32 CHW in [0,1], labels (n,))."""
+    base = Path(os.environ.get("CIFAR_DIR",
+                               "~/.deeplearning4j/cifar10")).expanduser()
+    for sub in ("", "cifar-10-batches-bin"):
+        d = base / sub if sub else base
+        names = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+                 else ["test_batch.bin"])
+        paths = [d / nm for nm in names]
+        if all(p.exists() for p in paths):
+            parts = [_read_bin(p) for p in paths]
+            imgs = np.concatenate([p[0] for p in parts])
+            labels = np.concatenate([p[1] for p in parts])
+            break
+    else:
+        n = num_examples or (8192 if train else 2048)
+        imgs, labels = _synthetic_cifar(n, seed if train else seed + 1)
+    if num_examples is not None:
+        imgs, labels = imgs[:num_examples], labels[:num_examples]
+    return imgs, labels
+
+
+class CifarDataSetIterator(DataSetIterator):
+    """(ref CifarDataSetIterator(batch, numExamples, train)) — CHW features for
+    InputType.convolutional(32, 32, 3)."""
+
+    def __init__(self, batch: int = 128, num_examples: Optional[int] = None,
+                 train: bool = True, seed: int = 555):
+        self._batch = int(batch)
+        self.x, y = load_cifar(train, num_examples, seed)
+        self.y = np.eye(NUM_LABELS, dtype=np.float32)[y]
+
+    def __iter__(self):
+        for s in range(0, self.x.shape[0], self._batch):
+            yield DataSet(self.x[s:s + self._batch], self.y[s:s + self._batch])
+
+    def reset(self):
+        pass
+
+    def batch(self):
+        return self._batch
+
+    def total_outcomes(self):
+        return NUM_LABELS
+
+    def input_columns(self):
+        return 3 * 32 * 32

@@ -1,0 +1,89 @@
+"""EMNIST dataset iterator.
+
+Parity: ref deeplearning4j-core/.../datasets/iterator/impl/EmnistDataSetIterator.java
+(Set enum: COMPLETE/MERGE/BALANCED/LETTERS/DIGITS/MNIST with per-set class counts).
+Resolution: real EMNIST IDX files under $EMNIST_DIR or ~/.deeplearning4j/emnist
+(gzip or raw, reusing the MNIST IDX reader), else the deterministic synthetic
+pattern generator with the set's class count.
+"""
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.impl.mnist import (
+    _find_idx, _read_idx, _synthetic_digits)
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+
+class EmnistSet:
+    """(ref EmnistDataSetIterator.Set + numLabels mapping)"""
+    COMPLETE = "complete"      # 62 classes
+    MERGE = "merge"            # 47
+    BALANCED = "balanced"      # 47
+    LETTERS = "letters"        # 26
+    DIGITS = "digits"          # 10
+    MNIST = "mnist"            # 10
+
+    NUM_LABELS = {COMPLETE: 62, MERGE: 47, BALANCED: 47, LETTERS: 26,
+                  DIGITS: 10, MNIST: 10}
+
+
+def num_labels(dataset_set: str) -> int:
+    return EmnistSet.NUM_LABELS[dataset_set]
+
+
+def load_emnist(dataset_set: str = EmnistSet.BALANCED, train: bool = True,
+                num_examples: Optional[int] = None, seed: int = 321):
+    classes = num_labels(dataset_set)
+    base = Path(os.environ.get("EMNIST_DIR",
+                               "~/.deeplearning4j/emnist")).expanduser()
+    split = "train" if train else "test"
+    ip = _find_idx(base, [f"emnist-{dataset_set}-{split}-images-idx3-ubyte"])
+    lp = _find_idx(base, [f"emnist-{dataset_set}-{split}-labels-idx1-ubyte"])
+    if ip is not None and lp is not None:
+        imgs = _read_idx(ip).astype(np.float32) / 255.0
+        labels = _read_idx(lp).astype(np.int64)
+        # EMNIST labels can be 1-based (letters); shift to 0-based
+        if labels.min() == 1:
+            labels = labels - 1
+        imgs = imgs.reshape(imgs.shape[0], -1)
+    else:
+        n = num_examples or (8192 if train else 2048)
+        imgs, labels = _synthetic_digits(n, seed if train else seed + 1,
+                                         classes=classes)
+    if num_examples is not None:
+        imgs, labels = imgs[:num_examples], labels[:num_examples]
+    return imgs, labels, classes
+
+
+class EmnistDataSetIterator(DataSetIterator):
+    """(ref EmnistDataSetIterator(Set, batch, train))"""
+
+    def __init__(self, dataset_set: str = EmnistSet.BALANCED, batch: int = 128,
+                 train: bool = True, num_examples: Optional[int] = None,
+                 seed: int = 321):
+        self._batch = int(batch)
+        self.x, y, self.classes = load_emnist(dataset_set, train, num_examples,
+                                              seed)
+        self.y = np.eye(self.classes, dtype=np.float32)[y]
+
+    def __iter__(self):
+        for s in range(0, self.x.shape[0], self._batch):
+            yield DataSet(self.x[s:s + self._batch], self.y[s:s + self._batch])
+
+    def reset(self):
+        pass
+
+    def batch(self):
+        return self._batch
+
+    def total_outcomes(self):
+        return self.classes
+
+    def input_columns(self):
+        return self.x.shape[1]

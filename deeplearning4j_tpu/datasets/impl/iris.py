@@ -1,0 +1,67 @@
+"""Iris dataset iterator.
+
+Parity: ref deeplearning4j-core/.../datasets/iterator/impl/IrisDataSetIterator.java
++ base/IrisUtils.java (embedded 150-sample Fisher iris table). Resolution order:
+scikit-learn's bundled copy of the REAL dataset (local, zero egress), else a
+deterministic Gaussian stand-in built from the published per-class feature means.
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+# published per-class means/stds (setosa, versicolor, virginica) x 4 features
+_MEANS = np.asarray([[5.006, 3.428, 1.462, 0.246],
+                     [5.936, 2.770, 4.260, 1.326],
+                     [6.588, 2.974, 5.552, 2.026]])
+_STDS = np.asarray([[0.352, 0.379, 0.174, 0.105],
+                    [0.516, 0.314, 0.470, 0.198],
+                    [0.636, 0.322, 0.552, 0.275]])
+
+
+def load_iris() -> Tuple[np.ndarray, np.ndarray]:
+    """(features (150,4) float32, labels (150,) int) — 50 per class, class-major
+    order like the reference's embedded table."""
+    try:
+        from sklearn.datasets import load_iris as _sk
+        d = _sk()
+        return d.data.astype(np.float32), d.target.astype(np.int64)
+    except Exception:
+        rng = np.random.RandomState(123)
+        xs, ys = [], []
+        for c in range(3):
+            xs.append(_MEANS[c] + _STDS[c] * rng.randn(50, 4))
+            ys.append(np.full(50, c))
+        return (np.concatenate(xs).astype(np.float32),
+                np.concatenate(ys).astype(np.int64))
+
+
+class IrisDataSetIterator(DataSetIterator):
+    """(ref IrisDataSetIterator(batch, numExamples))"""
+
+    def __init__(self, batch: int = 150, num_examples: int = 150):
+        self._batch = int(batch)
+        x, y = load_iris()
+        n = min(int(num_examples), x.shape[0])
+        self.x = x[:n]
+        self.y = np.eye(3, dtype=np.float32)[y[:n]]
+
+    def __iter__(self):
+        for s in range(0, self.x.shape[0], self._batch):
+            yield DataSet(self.x[s:s + self._batch], self.y[s:s + self._batch])
+
+    def reset(self):
+        pass
+
+    def batch(self):
+        return self._batch
+
+    def total_outcomes(self):
+        return 3
+
+    def input_columns(self):
+        return 4

@@ -1,0 +1,93 @@
+"""LFW (Labeled Faces in the Wild) dataset iterator.
+
+Parity: ref deeplearning4j-core/.../datasets/iterator/impl/LFWDataSetIterator.java +
+base/LFWLoader.java (per-person directories of face jpgs; label = person).
+Resolution: a real lfw image tree under $LFW_DIR or ~/.deeplearning4j/lfw (decoded
+through the datavec ImageRecordReader), else deterministic synthetic "faces"
+(per-identity smooth eigenface-ish blobs) with the requested shape.
+"""
+from __future__ import annotations
+
+import os
+from pathlib import Path
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+
+def _synthetic_faces(n: int, num_people: int, h: int, w: int, channels: int,
+                     seed: int) -> Tuple[np.ndarray, np.ndarray]:
+    rng = np.random.RandomState(seed)
+    proto_rng = np.random.RandomState(777)
+    yy, xx = np.mgrid[0:h, 0:w]
+    protos = []
+    for p in range(num_people):
+        img = np.zeros((h, w), np.float32)
+        # oval head + two "eyes" + identity-specific blobs
+        cy, cx = h / 2 + proto_rng.uniform(-3, 3), w / 2 + proto_rng.uniform(-3, 3)
+        img += np.exp(-(((yy - cy) / (h * 0.32)) ** 2
+                        + ((xx - cx) / (w * 0.24)) ** 2) * 3)
+        for _ in range(4):
+            by, bx = proto_rng.uniform(0.2, 0.8, 2)
+            bs = proto_rng.uniform(0.04, 0.12)
+            img += 0.5 * np.exp(-(((yy / h - by) / bs) ** 2
+                                  + ((xx / w - bx) / bs) ** 2))
+        protos.append(np.clip(img / img.max(), 0, 1))
+    labels = rng.randint(0, num_people, n)
+    imgs = np.zeros((n, channels, h, w), np.float32)
+    for i, p in enumerate(labels):
+        base = protos[p] + rng.normal(0, 0.05, (h, w))
+        imgs[i] = np.clip(np.broadcast_to(base, (channels, h, w)), 0, 1)
+    return imgs, labels.astype(np.int64)
+
+
+def load_lfw(num_examples: Optional[int] = None, image_shape=(1, 28, 28),
+             num_people: int = 10, seed: int = 888):
+    channels, h, w = image_shape
+    base = Path(os.environ.get("LFW_DIR", "~/.deeplearning4j/lfw")).expanduser()
+    if base.is_dir() and any(base.iterdir()):
+        from deeplearning4j_tpu.datavec import FileSplit, ImageRecordReader
+        rr = ImageRecordReader(h, w, channels)
+        rr.initialize(FileSplit(str(base),
+                                allowed_extensions=(".jpg", ".jpeg", ".png")))
+        xs, ys = [], []
+        for rec in rr:
+            xs.append(rec[0] / 255.0)
+            ys.append(int(rec[1]))
+            if num_examples is not None and len(xs) >= num_examples:
+                break
+        return (np.stack(xs).astype(np.float32), np.asarray(ys, np.int64),
+                rr.num_labels())
+    n = num_examples or 2048
+    imgs, labels = _synthetic_faces(n, num_people, h, w, channels, seed)
+    return imgs, labels, num_people
+
+
+class LFWDataSetIterator(DataSetIterator):
+    """(ref LFWDataSetIterator(batch, numExamples, imgDim...))"""
+
+    def __init__(self, batch: int = 64, num_examples: Optional[int] = None,
+                 image_shape=(1, 28, 28), num_people: int = 10, seed: int = 888):
+        self._batch = int(batch)
+        self.x, y, self.num_people = load_lfw(num_examples, image_shape,
+                                              num_people, seed)
+        self.y = np.eye(self.num_people, dtype=np.float32)[y]
+
+    def __iter__(self):
+        for s in range(0, self.x.shape[0], self._batch):
+            yield DataSet(self.x[s:s + self._batch], self.y[s:s + self._batch])
+
+    def reset(self):
+        pass
+
+    def batch(self):
+        return self._batch
+
+    def total_outcomes(self):
+        return self.num_people
+
+    def input_columns(self):
+        return int(np.prod(self.x.shape[1:]))

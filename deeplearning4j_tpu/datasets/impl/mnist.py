@@ -62,21 +62,23 @@ def load_mnist(train: bool = True, num_examples: Optional[int] = None, seed: int
     return imgs, labels
 
 
-def _synthetic_digits(n: int, seed: int) -> Tuple[np.ndarray, np.ndarray]:
+def _synthetic_digits(n: int, seed: int, classes: int = 10
+                      ) -> Tuple[np.ndarray, np.ndarray]:
     """Deterministic learnable stand-in: each class = fixed smooth prototype pattern,
-    samples add pixel noise and ±2px translation."""
+    samples add pixel noise and ±2px translation. `classes` supports the EMNIST
+    splits (up to 62 classes)."""
     rng = np.random.RandomState(seed)
     proto_rng = np.random.RandomState(1234)  # prototypes fixed across train/test
     protos = []
     yy, xx = np.mgrid[0:28, 0:28]
-    for c in range(10):
+    for c in range(classes):
         img = np.zeros((28, 28), np.float32)
         for _ in range(3):  # a few gaussian strokes per class
             cy, cx = proto_rng.uniform(6, 22, 2)
             sy, sx = proto_rng.uniform(2, 6, 2)
             img += np.exp(-(((yy - cy) / sy) ** 2 + ((xx - cx) / sx) ** 2))
         protos.append(np.clip(img / img.max(), 0, 1))
-    labels = rng.randint(0, 10, n)
+    labels = rng.randint(0, classes, n)
     imgs = np.zeros((n, 28, 28), np.float32)
     for i, c in enumerate(labels):
         dy, dx = rng.randint(-2, 3, 2)

@@ -1,0 +1,19 @@
+"""DataVec bridge (L3): record readers + record-reader dataset iterators.
+
+Parity: ref DataVec's record-reader API surface consumed by deeplearning4j-core:
+CSVRecordReader / CSVSequenceRecordReader / ImageRecordReader / CollectionRecordReader
+and deeplearning4j-core/.../datasets/datavec/RecordReaderDataSetIterator.java (442
+LoC) + SequenceRecordReaderDataSetIterator. Record decoding is host-side ETL; the
+iterators emit ready-to-device DataSet batches.
+"""
+from deeplearning4j_tpu.datavec.readers import (
+    CollectionRecordReader, CSVRecordReader, CSVSequenceRecordReader,
+    FileSplit, ImageRecordReader, ListStringSplit, RecordReader)
+from deeplearning4j_tpu.datavec.iterator import (
+    RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator)
+
+__all__ = [
+    "RecordReader", "CSVRecordReader", "CSVSequenceRecordReader",
+    "ImageRecordReader", "CollectionRecordReader", "FileSplit", "ListStringSplit",
+    "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
+]

@@ -1,0 +1,157 @@
+"""Record-reader -> DataSet iterators.
+
+Parity: ref deeplearning4j-core/.../datasets/datavec/RecordReaderDataSetIterator.java
+(label_index/num_classes one-hot classification, regression mode, writable
+conversion, batching) and SequenceRecordReaderDataSetIterator (separate or combined
+feature/label sequence readers with padding+masks — ALIGN_END alignment).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """(ref RecordReaderDataSetIterator.java:66 constructor family)"""
+
+    def __init__(self, record_reader, batch_size: int,
+                 label_index: Optional[int] = None,
+                 num_possible_labels: Optional[int] = None,
+                 regression: bool = False,
+                 label_index_to: Optional[int] = None):
+        self.reader = record_reader
+        self.batch_size = int(batch_size)
+        self.label_index = label_index
+        self.num_possible_labels = num_possible_labels
+        self.regression = regression
+        self.label_index_to = label_index_to  # inclusive end for multi-col regression
+
+    def _split_record(self, rec: List[Any]):
+        if isinstance(rec[0], np.ndarray):  # image record: [array, label]
+            x = rec[0]
+            y = rec[1] if len(rec) > 1 else None
+            return x, y
+        if self.label_index is None:
+            return np.asarray(rec, np.float32), None
+        if self.regression and self.label_index_to is not None:
+            li, lt = self.label_index, self.label_index_to
+            y = np.asarray(rec[li:lt + 1], np.float32)
+            x = np.asarray(rec[:li] + rec[lt + 1:], np.float32)
+            return x, y
+        li = self.label_index
+        y = rec[li]
+        x = np.asarray(rec[:li] + rec[li + 1:], np.float32)
+        return x, y
+
+    def __iter__(self):
+        self.reader.reset()
+        xs, ys = [], []
+
+        def emit():
+            x = np.stack(xs).astype(np.float32)
+            if ys and ys[0] is not None:
+                if self.regression:
+                    y = np.stack([np.atleast_1d(np.asarray(v, np.float32))
+                                  for v in ys])
+                else:
+                    n = self.num_possible_labels
+                    y = np.eye(n, dtype=np.float32)[
+                        np.asarray([int(v) for v in ys])]
+            else:
+                y = None
+            return DataSet(x, y)
+
+        for rec in self.reader:
+            x, y = self._split_record(rec)
+            xs.append(x)
+            ys.append(y)
+            if len(xs) == self.batch_size:
+                yield emit()
+                xs, ys = [], []
+        if xs:
+            yield emit()
+
+    def reset(self):
+        self.reader.reset()
+
+    def batch(self):
+        return self.batch_size
+
+    def total_outcomes(self):
+        return self.num_possible_labels or 0
+
+
+class SequenceRecordReaderDataSetIterator(DataSetIterator):
+    """(ref SequenceRecordReaderDataSetIterator.java) — separate feature/label
+    sequence readers, or a single reader with label column. Variable-length
+    sequences are padded to the batch max with feature/label masks (ALIGN_END=False:
+    the reference's default ALIGN_START semantics — pad at the end)."""
+
+    def __init__(self, features_reader, labels_reader=None, batch_size: int = 8,
+                 num_possible_labels: Optional[int] = None,
+                 regression: bool = False,
+                 label_index: Optional[int] = None):
+        self.features_reader = features_reader
+        self.labels_reader = labels_reader
+        self.batch_size = int(batch_size)
+        self.num_possible_labels = num_possible_labels
+        self.regression = regression
+        self.label_index = label_index
+
+    def _collect(self):
+        self.features_reader.reset()
+        if self.labels_reader is not None:
+            self.labels_reader.reset()
+        seqs = []
+        while self.features_reader.has_next():
+            f_seq = self.features_reader.next()
+            if self.labels_reader is not None:
+                l_seq = self.labels_reader.next()
+                f = np.asarray(f_seq, np.float32)
+                l = np.asarray(l_seq, np.float32)
+            else:
+                li = self.label_index
+                arr = f_seq
+                f = np.asarray([r[:li] + r[li + 1:] for r in arr], np.float32)
+                l = np.asarray([[r[li]] for r in arr], np.float32)
+            seqs.append((f, l))
+        return seqs
+
+    def __iter__(self):
+        seqs = self._collect()
+        for s in range(0, len(seqs), self.batch_size):
+            chunk = seqs[s:s + self.batch_size]
+            T = max(f.shape[0] for f, _ in chunk)
+            B = len(chunk)
+            nf = chunk[0][0].shape[1]
+            x = np.zeros((B, nf, T), np.float32)
+            fmask = np.zeros((B, T), np.float32)
+            if self.regression:
+                nl = chunk[0][1].shape[1]
+            else:
+                nl = self.num_possible_labels
+            y = np.zeros((B, nl, T), np.float32)
+            lmask = np.zeros((B, T), np.float32)
+            for b, (f, l) in enumerate(chunk):
+                t = f.shape[0]
+                x[b, :, :t] = f.T
+                fmask[b, :t] = 1.0
+                if self.regression:
+                    y[b, :, :t] = l.T
+                else:
+                    oh = np.eye(nl, dtype=np.float32)[l[:, 0].astype(int)]
+                    y[b, :, :t] = oh.T
+                lmask[b, :t] = 1.0
+            yield DataSet(x, y, fmask, lmask)
+
+    def reset(self):
+        self.features_reader.reset()
+        if self.labels_reader is not None:
+            self.labels_reader.reset()
+
+    def batch(self):
+        return self.batch_size

@@ -1,0 +1,230 @@
+"""Record readers + input splits.
+
+Parity: ref datavec-api records/reader/impl/csv/CSVRecordReader.java,
+csv/CSVSequenceRecordReader.java, collection/CollectionRecordReader.java,
+datavec-data-image/.../ImageRecordReader.java, and api/split/FileSplit.java.
+A record is a list of python scalars/strings; image records are numpy arrays.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+
+class FileSplit:
+    """(ref api/split/FileSplit.java) — files under a root, optionally filtered
+    by allowed extensions, deterministic order (sorted) or seeded shuffle."""
+
+    def __init__(self, root: str, allowed_extensions: Optional[Sequence[str]] = None,
+                 seed: Optional[int] = None):
+        self.root = root
+        if os.path.isdir(root):
+            files = []
+            for dirpath, _, names in os.walk(root):
+                for n in names:
+                    files.append(os.path.join(dirpath, n))
+            files.sort()
+        else:
+            files = [root]
+        if allowed_extensions:
+            exts = tuple(e if e.startswith(".") else "." + e
+                         for e in allowed_extensions)
+            files = [f for f in files if f.endswith(exts)]
+        if seed is not None:
+            np.random.RandomState(seed).shuffle(files)
+        self.files = files
+
+
+class ListStringSplit:
+    """(ref api/split/ListStringSplit.java)"""
+
+    def __init__(self, data: List[List[str]]):
+        self.data = data
+
+
+class RecordReader:
+    """(ref api/records/reader/RecordReader.java)"""
+
+    def initialize(self, split) -> None:
+        raise NotImplementedError
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+    hasNext = has_next
+
+    def next(self) -> List[Any]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next()
+
+
+class CSVRecordReader(RecordReader):
+    """(ref CSVRecordReader.java — skipNumLines + delimiter; values parsed to
+    float when possible, left as strings otherwise)"""
+
+    def __init__(self, skip_num_lines: int = 0, delimiter: str = ","):
+        self.skip_num_lines = int(skip_num_lines)
+        self.delimiter = delimiter
+        self._rows: List[List[Any]] = []
+        self._i = 0
+
+    def initialize(self, split) -> None:
+        self._rows = []
+        if isinstance(split, ListStringSplit):
+            raw_rows = split.data
+            for row in raw_rows:
+                self._rows.append([self._parse(v) for v in row])
+        else:
+            for path in split.files:
+                with open(path, "r") as f:
+                    for ln, line in enumerate(f):
+                        if ln < self.skip_num_lines:
+                            continue
+                        line = line.strip()
+                        if not line:
+                            continue
+                        self._rows.append([self._parse(v)
+                                           for v in line.split(self.delimiter)])
+        self._i = 0
+
+    @staticmethod
+    def _parse(v: str):
+        try:
+            return float(v)
+        except (TypeError, ValueError):
+            return v
+
+    def has_next(self) -> bool:
+        return self._i < len(self._rows)
+
+    def next(self) -> List[Any]:
+        row = self._rows[self._i]
+        self._i += 1
+        return row
+
+    def reset(self) -> None:
+        self._i = 0
+
+
+class CollectionRecordReader(RecordReader):
+    """(ref collection/CollectionRecordReader.java) — records from an in-memory
+    collection."""
+
+    def __init__(self, records: Iterable[List[Any]]):
+        self._records = [list(r) for r in records]
+        self._i = 0
+
+    def initialize(self, split=None) -> None:
+        self._i = 0
+
+    def has_next(self) -> bool:
+        return self._i < len(self._records)
+
+    def next(self) -> List[Any]:
+        r = self._records[self._i]
+        self._i += 1
+        return r
+
+    def reset(self) -> None:
+        self._i = 0
+
+
+class CSVSequenceRecordReader(RecordReader):
+    """(ref csv/CSVSequenceRecordReader.java) — one file per sequence; each line
+    is a timestep."""
+
+    def __init__(self, skip_num_lines: int = 0, delimiter: str = ","):
+        self.skip_num_lines = int(skip_num_lines)
+        self.delimiter = delimiter
+        self._seqs: List[List[List[Any]]] = []
+        self._i = 0
+
+    def initialize(self, split) -> None:
+        self._seqs = []
+        for path in split.files:
+            seq = []
+            with open(path, "r") as f:
+                for ln, line in enumerate(f):
+                    if ln < self.skip_num_lines:
+                        continue
+                    line = line.strip()
+                    if not line:
+                        continue
+                    seq.append([CSVRecordReader._parse(v)
+                                for v in line.split(self.delimiter)])
+            if seq:
+                self._seqs.append(seq)
+        self._i = 0
+
+    def has_next(self) -> bool:
+        return self._i < len(self._seqs)
+
+    def next_sequence(self) -> List[List[Any]]:
+        s = self._seqs[self._i]
+        self._i += 1
+        return s
+    next = next_sequence
+
+    def reset(self) -> None:
+        self._i = 0
+
+
+class ImageRecordReader(RecordReader):
+    """(ref datavec-data-image ImageRecordReader.java) — decodes images to CHW
+    float arrays; the label is derived from the parent directory name
+    (ParentPathLabelGenerator semantics)."""
+
+    def __init__(self, height: int, width: int, channels: int = 3,
+                 label_generator: str = "parent"):
+        self.height = int(height)
+        self.width = int(width)
+        self.channels = int(channels)
+        self.label_generator = label_generator
+        self.labels: List[str] = []
+        self._files: List[str] = []
+        self._i = 0
+
+    def initialize(self, split: FileSplit) -> None:
+        self._files = list(split.files)
+        if self.label_generator == "parent":
+            self.labels = sorted({os.path.basename(os.path.dirname(f))
+                                  for f in self._files})
+        self._i = 0
+
+    def has_next(self) -> bool:
+        return self._i < len(self._files)
+
+    def _decode(self, path: str) -> np.ndarray:
+        from PIL import Image
+        img = Image.open(path)
+        img = img.convert("L" if self.channels == 1 else "RGB")
+        img = img.resize((self.width, self.height))
+        arr = np.asarray(img, np.float32)
+        if arr.ndim == 2:
+            arr = arr[None, :, :]
+        else:
+            arr = arr.transpose(2, 0, 1)  # HWC -> CHW
+        return arr
+
+    def next(self) -> List[Any]:
+        path = self._files[self._i]
+        self._i += 1
+        arr = self._decode(path)
+        if self.label_generator == "parent":
+            label = self.labels.index(os.path.basename(os.path.dirname(path)))
+            return [arr, float(label)]
+        return [arr]
+
+    def reset(self) -> None:
+        self._i = 0
+
+    def num_labels(self) -> int:
+        return len(self.labels)

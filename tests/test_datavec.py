@@ -1,0 +1,166 @@
+"""DataVec bridge + dataset fetcher tests.
+
+Parity: ref deeplearning4j-core RecordReaderDataSetIteratorTest (CSV classification/
+regression, sequence padding+masks), and the iterator/impl fetcher tests."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.datavec import (
+    CollectionRecordReader, CSVRecordReader, CSVSequenceRecordReader, FileSplit,
+    ImageRecordReader, ListStringSplit, RecordReaderDataSetIterator,
+    SequenceRecordReaderDataSetIterator)
+from deeplearning4j_tpu.datasets.impl import (
+    CifarDataSetIterator, EmnistDataSetIterator, EmnistSet, IrisDataSetIterator,
+    LFWDataSetIterator, load_iris)
+
+
+def test_csv_record_reader_classification(tmp_path):
+    path = os.path.join(tmp_path, "data.csv")
+    with open(path, "w") as f:
+        f.write("# header\n")
+        for i in range(10):
+            f.write(f"{i * 0.1},{i * 0.2},{i % 3}\n")
+    rr = CSVRecordReader(skip_num_lines=1)
+    rr.initialize(FileSplit(path))
+    it = RecordReaderDataSetIterator(rr, batch_size=4, label_index=2,
+                                     num_possible_labels=3)
+    batches = list(it)
+    assert len(batches) == 3  # 4+4+2
+    assert batches[0].features.shape == (4, 2)
+    assert batches[0].labels.shape == (4, 3)
+    assert batches[0].labels[1].argmax() == 1
+    assert batches[-1].features.shape == (2, 2)
+    # reset + re-iterate
+    assert len(list(it)) == 3
+
+
+def test_csv_record_reader_regression():
+    rows = [[str(i), str(i * 2.0), str(i * 3.0)] for i in range(6)]
+    rr = CSVRecordReader()
+    rr.initialize(ListStringSplit(rows))
+    it = RecordReaderDataSetIterator(rr, batch_size=6, label_index=1,
+                                     regression=True, label_index_to=2)
+    ds = next(iter(it))
+    assert ds.features.shape == (6, 1)
+    assert ds.labels.shape == (6, 2)
+    assert ds.labels[2, 0] == pytest.approx(4.0)
+
+
+def test_collection_record_reader():
+    rr = CollectionRecordReader([[0.1, 0.2, 0], [0.3, 0.4, 1]])
+    rr.initialize()
+    it = RecordReaderDataSetIterator(rr, 2, label_index=2,
+                                     num_possible_labels=2)
+    ds = next(iter(it))
+    assert ds.features.shape == (2, 2) and ds.labels.shape == (2, 2)
+
+
+def test_sequence_record_reader_with_masks(tmp_path):
+    # two sequences of different lengths -> padding + masks
+    for si, steps in enumerate([4, 2]):
+        fpath = os.path.join(tmp_path, f"f{si}.csv")
+        lpath = os.path.join(tmp_path, f"l{si}.csv")
+        with open(fpath, "w") as f, open(lpath, "w") as l:
+            for t in range(steps):
+                f.write(f"{t * 1.0},{t * 2.0}\n")
+                l.write(f"{t % 2}\n")
+    fr = CSVSequenceRecordReader()
+    fr.initialize(FileSplit(str(tmp_path), allowed_extensions=[".csv"]))
+    # separate feature/label readers over disjoint file sets
+    fr_feat = CSVSequenceRecordReader()
+    fr_feat.initialize(FileSplit(str(tmp_path)))
+    fr_feat._seqs = [s for s in fr_feat._seqs if len(s[0]) == 2]  # feature files
+    fr_lab = CSVSequenceRecordReader()
+    fr_lab.initialize(FileSplit(str(tmp_path)))
+    fr_lab._seqs = [s for s in fr_lab._seqs if len(s[0]) == 1]    # label files
+    it = SequenceRecordReaderDataSetIterator(fr_feat, fr_lab, batch_size=2,
+                                             num_possible_labels=2)
+    ds = next(iter(it))
+    assert ds.features.shape == (2, 2, 4)   # (batch, nIn, maxT)
+    assert ds.labels.shape == (2, 2, 4)
+    assert ds.features_mask.tolist() == [[1, 1, 1, 1], [1, 1, 0, 0]]
+    assert ds.labels_mask.tolist() == [[1, 1, 1, 1], [1, 1, 0, 0]]
+
+
+def test_image_record_reader(tmp_path):
+    from PIL import Image
+    for cls in ("cats", "dogs"):
+        d = os.path.join(tmp_path, cls)
+        os.makedirs(d)
+        for i in range(3):
+            arr = np.full((10, 12, 3), 40 if cls == "cats" else 200, np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"{i}.png"))
+    rr = ImageRecordReader(height=8, width=8, channels=3)
+    rr.initialize(FileSplit(str(tmp_path), allowed_extensions=[".png"]))
+    assert rr.labels == ["cats", "dogs"]
+    it = RecordReaderDataSetIterator(rr, batch_size=6, label_index=1,
+                                     num_possible_labels=2)
+    ds = next(iter(it))
+    assert ds.features.shape == (6, 3, 8, 8)
+    assert ds.labels.shape == (6, 2)
+    cats = ds.features[np.asarray(ds.labels)[:, 0] == 1]
+    dogs = ds.features[np.asarray(ds.labels)[:, 1] == 1]
+    assert cats.mean() < dogs.mean()
+
+
+# ------------------------------------------------------------------ fetchers
+
+
+def test_iris_iterator():
+    x, y = load_iris()
+    assert x.shape == (150, 4) and set(y) == {0, 1, 2}
+    it = IrisDataSetIterator(batch=50)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].features.shape == (50, 4)
+    assert it.total_outcomes() == 3 and it.input_columns() == 4
+
+
+def test_iris_trains():
+    """A tiny MLP reaches high accuracy on iris — the reference's canonical
+    smoke test (many dl4j-core tests train on iris)."""
+    from deeplearning4j_tpu import (
+        Activation, DenseLayer, InputType, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer, Sgd, WeightInit)
+    x, y = load_iris()
+    x = (x - x.mean(0)) / x.std(0)
+    yoh = np.eye(3, dtype=np.float32)[y]
+    b = (NeuralNetConfiguration.Builder().seed(3).weight_init(WeightInit.XAVIER)
+         .activation(Activation.TANH).updater(Sgd(learning_rate=0.2))
+         .dtype("float64").list())
+    b.layer(DenseLayer(n_out=10))
+    b.layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX))
+    net = MultiLayerNetwork(b.set_input_type(InputType.feed_forward(4)).build())
+    net.init()
+    net.fit_on_device(x, yoh, steps=200)
+    acc = float((np.asarray(net.output(x)).argmax(1) == y).mean())
+    assert acc > 0.95
+
+
+def test_emnist_iterator():
+    for s, n in [(EmnistSet.LETTERS, 26), (EmnistSet.BALANCED, 47),
+                 (EmnistSet.DIGITS, 10)]:
+        it = EmnistDataSetIterator(s, batch=32, num_examples=64)
+        ds = next(iter(it))
+        assert ds.features.shape == (32, 784)
+        assert ds.labels.shape == (32, n)
+        assert it.total_outcomes() == n
+
+
+def test_cifar_iterator():
+    it = CifarDataSetIterator(batch=16, num_examples=48)
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].features.shape == (16, 3, 32, 32)
+    assert batches[0].labels.shape == (16, 10)
+    assert 0.0 <= batches[0].features.min() <= batches[0].features.max() <= 1.0
+
+
+def test_lfw_iterator():
+    it = LFWDataSetIterator(batch=8, num_examples=24, image_shape=(1, 28, 28),
+                            num_people=5)
+    ds = next(iter(it))
+    assert ds.features.shape == (8, 1, 28, 28)
+    assert ds.labels.shape == (8, 5)
