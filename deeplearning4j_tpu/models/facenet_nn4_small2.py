@@ -1,0 +1,227 @@
+"""FaceNet NN4-small2 (ref deeplearning4j-zoo/.../zoo/model/FaceNetNN4Small2.java:30
++ helper/FaceNetHelper.java).
+
+Mirrors the reference: 96x96x3 input, conv7x7/2 stem with LRN, inception modules
+2/3a/3b/3c/4a/4e/5a/5b with the exact branch channels, kernel sizes, and pooling
+types (MAX and PNORM p=2) of FaceNetNN4Small2.java:83-330, avg pool 3x3/3, 128-d
+identity bottleneck, L2-normalized embeddings, CenterLossOutputLayer(SQUARED_LOSS,
+softmax, lambda=1e-4, alpha=0.9, RenormalizeL2PerLayer); Adam(0.1) updater, RELU
+weight init, l2=5e-5, convolution mode Same globally.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.enums import (
+    Activation, ConvolutionMode, GradientNormalization, LossFunction, PoolingType,
+    WeightInit)
+from deeplearning4j_tpu.models.zoo_model import ZooModel
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+    ConvolutionLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.layers.feedforward import (
+    ActivationLayer, DenseLayer)
+from deeplearning4j_tpu.nn.conf.layers.normalization import (
+    BatchNormalization, LocalResponseNormalization)
+from deeplearning4j_tpu.nn.conf.layers.variational import CenterLossOutputLayer
+from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.graph.vertices import L2NormalizeVertex, MergeVertex
+from deeplearning4j_tpu.nn.updater.updaters import Adam
+
+RELU = ActivationLayer(activation=Activation.RELU)
+
+
+def _conv(n_out, k=(1, 1), stride=(1, 1), pad=None, bias=0.0):
+    c = ConvolutionLayer(n_out=n_out, kernel_size=k, stride=stride,
+                         bias_init=bias)
+    if pad is not None:
+        c.padding = pad
+    return c
+
+
+def _bn():
+    return BatchNormalization()
+
+
+def _pool(ptype, size=3, stride=1, pad=(1, 1), pnorm=2):
+    p = SubsamplingLayer(pooling_type=ptype, kernel_size=(size, size),
+                         stride=(stride, stride), padding=pad)
+    if ptype == PoolingType.PNORM:
+        p.pnorm = pnorm
+    return p
+
+
+class FaceNetNN4Small2(ZooModel):
+    def __init__(self, num_labels: int = 1000, seed: int = 123,
+                 input_shape=(3, 96, 96), updater=None, dtype: str = "float32",
+                 compute_dtype=None, embedding_size: int = 128):
+        super().__init__(num_labels, seed)
+        self.input_shape = tuple(input_shape)
+        self.updater = updater or Adam(learning_rate=0.1, beta1=0.9, beta2=0.999,
+                                       epsilon=0.01)
+        self.dtype = dtype
+        self.compute_dtype = compute_dtype
+        self.embedding_size = int(embedding_size)
+
+    def _inception_module(self, g, name, kernel_sizes, kernel_strides,
+                          output_sizes, reduce_sizes, pooling, inp,
+                          pnorm=0, pool_size=3, pool_stride=1):
+        """(ref FaceNetHelper.appendGraph :122-244) — 1x1-reduce->NxN branches,
+        optional pool->1x1 branch, optional straight 1x1 reduce branch; merged."""
+        mod = f"inception-{name}"
+        merge_in = []
+        for i, (ks, st) in enumerate(zip(kernel_sizes, kernel_strides)):
+            (g.add_layer(f"{mod}-cnn1-{i}", _conv(reduce_sizes[i], bias=0.2), inp)
+              .add_layer(f"{mod}-batch1-{i}", _bn(), f"{mod}-cnn1-{i}")
+              .add_layer(f"{mod}-transfer1-{i}", RELU, f"{mod}-batch1-{i}")
+              .add_layer(f"{mod}-reduce1-{i}",
+                         _conv(output_sizes[i], (ks, ks), (st, st),
+                               pad=(ks // 2, ks // 2), bias=0.2),
+                         f"{mod}-transfer1-{i}")
+              .add_layer(f"{mod}-batch2-{i}", _bn(), f"{mod}-reduce1-{i}")
+              .add_layer(f"{mod}-transfer2-{i}", RELU, f"{mod}-batch2-{i}"))
+            merge_in.append(f"{mod}-transfer2-{i}")
+        i = len(kernel_sizes)
+        if len(reduce_sizes) > i:  # pool branch
+            (g.add_layer(f"{mod}-pool1",
+                         _pool(pooling, pool_size, pool_stride, pnorm=pnorm), inp)
+              .add_layer(f"{mod}-cnn2", _conv(reduce_sizes[i]), f"{mod}-pool1")
+              .add_layer(f"{mod}-batch3", _bn(), f"{mod}-cnn2")
+              .add_layer(f"{mod}-transfer3", RELU, f"{mod}-batch3"))
+            merge_in.append(f"{mod}-transfer3")
+        i += 1
+        if len(reduce_sizes) > i:  # straight 1x1 reduce branch
+            (g.add_layer(f"{mod}-reduce2", _conv(reduce_sizes[i]), inp)
+              .add_layer(f"{mod}-batch4", _bn(), f"{mod}-reduce2")
+              .add_layer(f"{mod}-transfer4", RELU, f"{mod}-batch4"))
+            merge_in.append(f"{mod}-transfer4")
+        g.add_vertex(mod, MergeVertex(), *merge_in)
+        return mod
+
+    def _downsample_module(self, g, name, cfg, inp):
+        """The hand-rolled strided modules 3c/4e (ref :142-262): two
+        1x1-reduce -> 3x3/2 branches + max pool 3x3/2, merged."""
+        (r1, o1), (r2, o2) = cfg
+        (g.add_layer(f"{name}-1x1", _conv(r1), inp)
+          .add_layer(f"{name}-1x1-norm", _bn(), f"{name}-1x1")
+          .add_layer(f"{name}-transfer1", RELU, f"{name}-1x1-norm")
+          .add_layer(f"{name}-3x3", _conv(o1, (3, 3), (2, 2)), f"{name}-transfer1")
+          .add_layer(f"{name}-3x3-norm", _bn(), f"{name}-3x3")
+          .add_layer(f"{name}-transfer2", RELU, f"{name}-3x3-norm")
+          .add_layer(f"{name}-2-1x1", _conv(r2), inp)
+          .add_layer(f"{name}-2-1x1-norm", _bn(), f"{name}-2-1x1")
+          .add_layer(f"{name}-2-transfer3", RELU, f"{name}-2-1x1-norm")
+          .add_layer(f"{name}-2-5x5", _conv(o2, (3, 3), (2, 2)),
+                     f"{name}-2-transfer3")
+          .add_layer(f"{name}-2-5x5-norm", _bn(), f"{name}-2-5x5")
+          .add_layer(f"{name}-2-transfer4", RELU, f"{name}-2-5x5-norm")
+          .add_layer(f"{name}-pool", _pool(PoolingType.MAX, 3, 2), inp)
+          .add_vertex(f"inception-{name}", MergeVertex(), f"{name}-transfer2",
+                      f"{name}-2-transfer4", f"{name}-pool"))
+        return f"inception-{name}"
+
+    def graph_builder(self):
+        c, h, w = self.input_shape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .activation(Activation.IDENTITY)
+             .updater(self.updater)
+             .weight_init(WeightInit.RELU)
+             .l2(5e-5)
+             .convolution_mode(ConvolutionMode.Same)
+             .dtype(self.dtype)
+             .compute_dtype(self.compute_dtype)
+             .graph_builder())
+        # stem + inception-2 (ref :83-131)
+        (g.add_inputs("input")
+          .add_layer("stem-cnn1", _conv(64, (7, 7), (2, 2), pad=(3, 3)), "input")
+          .add_layer("stem-batch1", _bn(), "stem-cnn1")
+          .add_layer("stem-activation1", RELU, "stem-batch1")
+          .add_layer("stem-pool1", _pool(PoolingType.MAX, 3, 2),
+                     "stem-activation1")
+          .add_layer("stem-lrn1", LocalResponseNormalization(
+              k=1, n=5, alpha=1e-4, beta=0.75), "stem-pool1")
+          .add_layer("inception-2-cnn1", _conv(64), "stem-lrn1")
+          .add_layer("inception-2-batch1", _bn(), "inception-2-cnn1")
+          .add_layer("inception-2-activation1", RELU, "inception-2-batch1")
+          .add_layer("inception-2-cnn2", _conv(192, (3, 3), pad=(1, 1)),
+                     "inception-2-activation1")
+          .add_layer("inception-2-batch2", _bn(), "inception-2-cnn2")
+          .add_layer("inception-2-activation2", RELU, "inception-2-batch2")
+          .add_layer("inception-2-lrn1", LocalResponseNormalization(
+              k=1, n=5, alpha=1e-4, beta=0.75), "inception-2-activation2")
+          .add_layer("inception-2-pool1", _pool(PoolingType.MAX, 3, 2),
+                     "inception-2-lrn1"))
+
+        # inception modules (ref :132-141 and FaceNetHelper channel tables)
+        x = self._inception_module(g, "3a", [3, 5], [1, 1], [128, 32],
+                                   [96, 16, 32, 64], PoolingType.MAX,
+                                   "inception-2-pool1")
+        x = self._inception_module(g, "3b", [3, 5], [1, 1], [128, 64],
+                                   [96, 32, 64, 64], PoolingType.PNORM, x,
+                                   pnorm=2)
+        x = self._downsample_module(g, "3c", [(128, 256), (32, 64)], x)
+        x = self._inception_module(g, "4a", [3, 5], [1, 1], [192, 64],
+                                   [96, 32, 128, 256], PoolingType.PNORM, x,
+                                   pnorm=2)
+        x = self._downsample_module(g, "4e", [(160, 256), (64, 128)], x)
+
+        # 5a (ref :258-283): 1x1 branch, 1x1->3x3 branch, pnorm-pool->1x1 branch
+        (g.add_layer("5a-1x1", _conv(256), x)
+          .add_layer("5a-1x1-norm", _bn(), "5a-1x1")
+          .add_layer("5a-transfer1", RELU, "5a-1x1-norm")
+          .add_layer("5a-2-1x1", _conv(96), x)
+          .add_layer("5a-2-1x1-norm", _bn(), "5a-2-1x1")
+          .add_layer("5a-2-transfer2", RELU, "5a-2-1x1-norm")
+          .add_layer("5a-2-3x3", _conv(384, (3, 3), pad=(1, 1)),
+                     "5a-2-transfer2")
+          .add_layer("5a-2-3x3-norm", _bn(), "5a-2-3x3")
+          .add_layer("5a-transfer3", RELU, "5a-2-3x3-norm")
+          .add_layer("5a-3-pool", _pool(PoolingType.PNORM, 3, 1, pnorm=2), x)
+          .add_layer("5a-3-1x1reduce", _conv(96), "5a-3-pool")
+          .add_layer("5a-3-1x1reduce-norm", _bn(), "5a-3-1x1reduce")
+          .add_layer("5a-3-transfer4", RELU, "5a-3-1x1reduce-norm")
+          .add_vertex("inception-5a", MergeVertex(), "5a-transfer1",
+                      "5a-transfer3", "5a-3-transfer4"))
+        x = "inception-5a"
+
+        # 5b (ref :286-320): 1x1, 1x1->3x3, maxpool->1x1
+        (g.add_layer("5b-1x1", _conv(256), x)
+          .add_layer("5b-1x1-norm", _bn(), "5b-1x1")
+          .add_layer("5b-transfer1", RELU, "5b-1x1-norm")
+          .add_layer("5b-2-1x1", _conv(96), x)
+          .add_layer("5b-2-1x1-norm", _bn(), "5b-2-1x1")
+          .add_layer("5b-2-transfer2", RELU, "5b-2-1x1-norm")
+          .add_layer("5b-2-3x3", _conv(384, (3, 3), pad=(1, 1)),
+                     "5b-2-transfer2")
+          .add_layer("5b-2-3x3-norm", _bn(), "5b-2-3x3")
+          .add_layer("5b-2-transfer3", RELU, "5b-2-3x3-norm")
+          .add_layer("5b-3-pool", _pool(PoolingType.MAX, 3, 1), x)
+          .add_layer("5b-3-1x1reduce", _conv(96), "5b-3-pool")
+          .add_layer("5b-3-1x1reduce-norm", _bn(), "5b-3-1x1reduce")
+          .add_layer("5b-3-transfer4", RELU, "5b-3-1x1reduce-norm")
+          .add_vertex("inception-5b", MergeVertex(), "5b-transfer1",
+                      "5b-2-transfer3", "5b-3-transfer4"))
+
+        (g.add_layer("avgpool", SubsamplingLayer(
+            pooling_type=PoolingType.AVG, kernel_size=(3, 3), stride=(3, 3)),
+            "inception-5b")
+          .add_layer("bottleneck", DenseLayer(n_out=self.embedding_size,
+                                              activation=Activation.IDENTITY),
+                     "avgpool")
+          .add_vertex("embeddings", L2NormalizeVertex(eps=1e-6), "bottleneck")
+          .add_layer("lossLayer", CenterLossOutputLayer(
+              n_out=self.num_labels, loss_fn=LossFunction.MSE,
+              activation=Activation.SOFTMAX, lambda_=1e-4, alpha=0.9,
+              gradient_normalization=GradientNormalization.RenormalizeL2PerLayer),
+              "embeddings")
+          .set_outputs("lossLayer")
+          .set_input_types(InputType.convolutional(h, w, c)))
+        return g
+
+    def conf(self):
+        return self.graph_builder().build()
+
+    def init(self) -> ComputationGraph:
+        net = ComputationGraph(self.conf())
+        net.init()
+        return net

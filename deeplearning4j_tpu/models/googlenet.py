@@ -1,0 +1,133 @@
+"""GoogLeNet / Inception-v1 (ref deeplearning4j-zoo/.../zoo/model/GoogLeNet.java:37).
+
+Mirrors the reference config: conv7x7/2 stem with LRN sandwich, nine inception
+modules (3a..5b) with the exact branch channel table (GoogLeNet.java:155-169), avg
+pool 7x7, dropout FC head, NLL softmax output; Nesterovs(1e-2, 0.9) updater, Xavier
+init, l2=2e-4.
+
+Documented deviation: the reference wires inception 4a from "3b-depthconcat1",
+leaving its own "max3" pooling layer dangling (GoogLeNet.java:157-160) — an
+upstream bug that breaks the spatial dimensioning of stages 4-5. Here 4a consumes
+max3, giving the actual GoogLeNet topology of the paper the reference cites.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.enums import (
+    Activation, ConvolutionMode, LossFunction, PoolingType, WeightInit)
+from deeplearning4j_tpu.models.zoo_model import PretrainedType, ZooModel
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+    ConvolutionLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.layers.feedforward import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.layers.normalization import (
+    LocalResponseNormalization)
+from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.graph.vertices import MergeVertex
+from deeplearning4j_tpu.nn.updater.updaters import Nesterovs
+
+# inception branch channel table (ref GoogLeNet.java:155-169):
+# name -> [[1x1], [3x3reduce, 3x3], [5x5reduce, 5x5], [poolproj]]
+_INCEPTION = [
+    ("3a", [[64], [96, 128], [16, 32], [32]]),
+    ("3b", [[128], [128, 192], [32, 96], [64]]),
+    ("4a", [[192], [96, 208], [16, 48], [64]]),
+    ("4b", [[160], [112, 224], [24, 64], [64]]),
+    ("4c", [[128], [128, 256], [24, 64], [64]]),
+    ("4d", [[112], [144, 288], [32, 64], [64]]),
+    ("4e", [[256], [160, 320], [32, 128], [128]]),
+    ("5a", [[256], [160, 320], [32, 128], [128]]),
+    ("5b", [[384], [192, 384], [48, 128], [128]]),
+]
+
+
+def _conv(n_out, k, stride=(1, 1), pad=(0, 0)):
+    return ConvolutionLayer(n_out=n_out, kernel_size=k, stride=stride,
+                            padding=pad, bias_init=0.2)
+
+
+class GoogLeNet(ZooModel):
+    def __init__(self, num_labels: int = 1000, seed: int = 123,
+                 input_shape=(3, 224, 224), updater=None, dtype: str = "float32",
+                 compute_dtype=None):
+        super().__init__(num_labels, seed)
+        self.input_shape = tuple(input_shape)
+        self.updater = updater or Nesterovs(learning_rate=1e-2, momentum=0.9)
+        self.dtype = dtype
+        self.compute_dtype = compute_dtype
+
+    def _inception(self, g, name, cfg, inp):
+        """(ref GoogLeNet.java inception() :124-136)"""
+        (g.add_layer(f"{name}-cnn1", _conv(cfg[0][0], (1, 1)), inp)
+          .add_layer(f"{name}-cnn2", _conv(cfg[1][0], (1, 1)), inp)
+          .add_layer(f"{name}-cnn3", _conv(cfg[2][0], (1, 1)), inp)
+          .add_layer(f"{name}-max1",
+                     SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                      kernel_size=(3, 3), stride=(1, 1),
+                                      padding=(1, 1)), inp)
+          .add_layer(f"{name}-cnn4", _conv(cfg[1][1], (3, 3), pad=(1, 1)),
+                     f"{name}-cnn2")
+          .add_layer(f"{name}-cnn5", _conv(cfg[2][1], (5, 5), pad=(2, 2)),
+                     f"{name}-cnn3")
+          .add_layer(f"{name}-cnn6", _conv(cfg[3][0], (1, 1)), f"{name}-max1")
+          .add_vertex(f"{name}-depthconcat1", MergeVertex(), f"{name}-cnn1",
+                      f"{name}-cnn4", f"{name}-cnn5", f"{name}-cnn6"))
+        return f"{name}-depthconcat1"
+
+    def graph_builder(self):
+        c, h, w = self.input_shape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .activation(Activation.RELU)
+             .updater(self.updater)
+             .weight_init(WeightInit.XAVIER)
+             .l2(2e-4)
+             .convolution_mode(ConvolutionMode.Truncate)
+             .dtype(self.dtype)
+             .compute_dtype(self.compute_dtype)
+             .graph_builder())
+        (g.add_inputs("input")
+          .add_layer("cnn1", _conv(64, (7, 7), stride=(2, 2), pad=(3, 3)), "input")
+          .add_layer("max1", SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                              kernel_size=(3, 3), stride=(2, 2),
+                                              padding=(1, 1)), "cnn1")
+          .add_layer("lrn1", LocalResponseNormalization(n=5, alpha=1e-4,
+                                                        beta=0.75), "max1")
+          .add_layer("cnn2", _conv(64, (1, 1)), "lrn1")
+          .add_layer("cnn3", _conv(192, (3, 3), pad=(1, 1)), "cnn2")
+          .add_layer("lrn2", LocalResponseNormalization(n=5, alpha=1e-4,
+                                                        beta=0.75), "cnn3")
+          .add_layer("max2", SubsamplingLayer(pooling_type=PoolingType.MAX,
+                                              kernel_size=(3, 3), stride=(2, 2),
+                                              padding=(1, 1)), "lrn2"))
+        x = "max2"
+        for name, cfg in _INCEPTION:
+            if name == "4a":
+                g.add_layer("max3", SubsamplingLayer(
+                    pooling_type=PoolingType.MAX, kernel_size=(3, 3),
+                    stride=(2, 2), padding=(1, 1)), x)
+                x = "max3"
+            elif name == "5a":
+                g.add_layer("max4", SubsamplingLayer(
+                    pooling_type=PoolingType.MAX, kernel_size=(3, 3),
+                    stride=(2, 2), padding=(1, 1)), x)
+                x = "max4"
+            x = self._inception(g, name, cfg, x)
+        (g.add_layer("avg3", SubsamplingLayer(pooling_type=PoolingType.AVG,
+                                              kernel_size=(7, 7), stride=(1, 1)), x)
+          .add_layer("fc1", DenseLayer(n_out=1024, dropout=0.4), "avg3")
+          .add_layer("output", OutputLayer(
+              n_out=self.num_labels,
+              loss_fn=LossFunction.NEGATIVELOGLIKELIHOOD,
+              activation=Activation.SOFTMAX), "fc1")
+          .set_outputs("output")
+          .set_input_types(InputType.convolutional(h, w, c)))
+        return g
+
+    def conf(self):
+        return self.graph_builder().build()
+
+    def init(self) -> ComputationGraph:
+        net = ComputationGraph(self.conf())
+        net.init()
+        return net

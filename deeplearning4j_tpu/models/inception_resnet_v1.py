@@ -1,0 +1,242 @@
+"""Inception-ResNet-v1 for face recognition (ref deeplearning4j-zoo/.../zoo/model/
+InceptionResNetV1.java:32 + helper/InceptionResNetHelper.java).
+
+Mirrors the reference: 7-conv stem (:113-162), 5x inception-resnet-A (scale 0.17),
+reduction-A (:173-216), 10x inception-resnet-B (scale 0.10), reduction-B, 5x
+inception-resnet-C (scale 0.20, :302), 1x1 avg pool, 128-d bottleneck,
+L2-normalized embeddings, CenterLossOutputLayer head (:75-98); RmsProp(0.1, 0.96)
+updater, N(0, 0.5) init, l2=5e-5, Truncate conv mode, TANH block activations and
+BN(decay=0.995, eps=0.001) exactly as the reference helper builds them.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.common.enums import (
+    Activation, ConvolutionMode, LossFunction, PoolingType, WeightInit)
+from deeplearning4j_tpu.models.zoo_model import ZooModel
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+    ConvolutionLayer, SubsamplingLayer)
+from deeplearning4j_tpu.nn.conf.layers.feedforward import (
+    ActivationLayer, DenseLayer)
+from deeplearning4j_tpu.nn.conf.layers.normalization import BatchNormalization
+from deeplearning4j_tpu.nn.conf.layers.variational import CenterLossOutputLayer
+from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.graph.vertices import (
+    ElementWiseVertex, L2NormalizeVertex, MergeVertex, ScaleVertex)
+from deeplearning4j_tpu.nn.updater.updaters import RmsProp
+
+SAME = ConvolutionMode.Same
+
+
+def _bn(eps=0.001, decay=0.995, act=None):
+    # activation passed as a constructor kwarg so explicit-set tracking protects
+    # it from the global default
+    if act is not None:
+        return BatchNormalization(decay=decay, eps=eps, activation=act)
+    return BatchNormalization(decay=decay, eps=eps)
+
+
+def _conv(n_out, k=(1, 1), stride=(1, 1), mode=None):
+    if mode is not None:
+        return ConvolutionLayer(n_out=n_out, kernel_size=k, stride=stride,
+                                convolution_mode=mode)
+    return ConvolutionLayer(n_out=n_out, kernel_size=k, stride=stride)
+
+
+class InceptionResNetV1(ZooModel):
+    def __init__(self, num_labels: int = 1000, seed: int = 123,
+                 input_shape=(3, 160, 160), updater=None, dtype: str = "float32",
+                 compute_dtype=None, embedding_size: int = 128):
+        super().__init__(num_labels, seed)
+        self.input_shape = tuple(input_shape)
+        self.updater = updater or RmsProp(learning_rate=0.1, rms_decay=0.96)
+        self.dtype = dtype
+        self.compute_dtype = compute_dtype
+        self.embedding_size = int(embedding_size)
+
+    # ---- blocks (ref InceptionResNetHelper.inceptionV1ResA/B/C) ----
+    def _res_a(self, g, name, count, scale, inp):
+        prev = inp
+        for i in range(1, count + 1):
+            n = lambda l: f"{name}-{l}-{i}"
+            (g.add_layer(n("cnn1"), _conv(32, mode=SAME), prev)
+              .add_layer(n("batch1"), _bn(), n("cnn1"))
+              .add_layer(n("cnn2"), _conv(32, mode=SAME), prev)
+              .add_layer(n("batch2"), _bn(), n("cnn2"))
+              .add_layer(n("cnn3"), _conv(32, (3, 3), mode=SAME), n("batch2"))
+              .add_layer(n("batch3"), _bn(), n("cnn3"))
+              .add_layer(n("cnn4"), _conv(32, mode=SAME), prev)
+              .add_layer(n("batch4"), _bn(), n("cnn4"))
+              .add_layer(n("cnn5"), _conv(32, (3, 3), mode=SAME), n("batch4"))
+              .add_layer(n("batch5"), _bn(), n("cnn5"))
+              .add_layer(n("cnn6"), _conv(32, (3, 3), mode=SAME), n("batch5"))
+              .add_layer(n("batch6"), _bn(), n("cnn6"))
+              .add_vertex(n("merge1"), MergeVertex(), n("batch1"), n("batch3"),
+                          n("batch6"))
+              .add_layer(n("cnn7"), _conv(192, (3, 3), mode=SAME), n("merge1"))
+              .add_layer(n("batch7"), _bn(), n("cnn7"))
+              .add_vertex(n("scaling"), ScaleVertex(scale_factor=scale),
+                          n("batch7"))
+              .add_layer(n("shortcut-identity"),
+                         ActivationLayer(activation=Activation.IDENTITY), prev)
+              .add_vertex(n("shortcut"), ElementWiseVertex(op="Add"),
+                          n("scaling"), n("shortcut-identity")))
+            out = name if i == count else n("activation")
+            g.add_layer(out, ActivationLayer(activation=Activation.TANH),
+                        n("shortcut"))
+            prev = out
+        return prev
+
+    def _res_b(self, g, name, count, scale, inp):
+        g.add_layer(f"{name}-activation1-0",
+                    ActivationLayer(activation=Activation.TANH), inp)
+        prev = f"{name}-activation1-0"
+        for i in range(1, count + 1):
+            n = lambda l: f"{name}-{l}-{i}"
+            (g.add_layer(n("cnn1"), _conv(128, mode=SAME), prev)
+              .add_layer(n("batch1"), _bn(), n("cnn1"))
+              .add_layer(n("cnn2"), _conv(128, mode=SAME), prev)
+              .add_layer(n("batch2"), _bn(), n("cnn2"))
+              .add_layer(n("cnn3"), _conv(128, (1, 3), mode=SAME), n("batch2"))
+              .add_layer(n("batch3"), _bn(), n("cnn3"))
+              .add_layer(n("cnn4"), _conv(128, (3, 1), mode=SAME), n("batch3"))
+              .add_layer(n("batch4"), _bn(), n("cnn4"))
+              .add_vertex(n("merge1"), MergeVertex(), n("batch1"), n("batch4"))
+              .add_layer(n("cnn5"), _conv(576, mode=SAME), n("merge1"))
+              .add_layer(n("batch5"), _bn(), n("cnn5"))
+              .add_vertex(n("scaling"), ScaleVertex(scale_factor=scale),
+                          n("batch5"))
+              .add_layer(n("shortcut-identity"),
+                         ActivationLayer(activation=Activation.IDENTITY), prev)
+              .add_vertex(n("shortcut"), ElementWiseVertex(op="Add"),
+                          n("scaling"), n("shortcut-identity")))
+            out = name if i == count else n("activation")
+            g.add_layer(out, ActivationLayer(activation=Activation.TANH),
+                        n("shortcut"))
+            prev = out
+        return prev
+
+    def _res_c(self, g, name, count, scale, inp):
+        prev = inp
+        for i in range(1, count + 1):
+            n = lambda l: f"{name}-{l}-{i}"
+            (g.add_layer(n("cnn1"), _conv(192, mode=SAME), prev)
+              .add_layer(n("batch1"), _bn(), n("cnn1"))
+              .add_layer(n("cnn2"), _conv(192, mode=SAME), prev)
+              .add_layer(n("batch2"), _bn(), n("cnn2"))
+              .add_layer(n("cnn3"), _conv(192, (1, 3), mode=SAME), n("batch2"))
+              .add_layer(n("batch3"), _bn(), n("cnn3"))
+              .add_layer(n("cnn4"), _conv(192, (3, 1), mode=SAME), n("batch3"))
+              .add_layer(n("batch4"), _bn(act=Activation.TANH), n("cnn4"))
+              .add_vertex(n("merge1"), MergeVertex(), n("batch1"), n("batch4"))
+              .add_layer(n("cnn5"), _conv(1344, mode=SAME), n("merge1"))
+              .add_layer(n("batch5"), _bn(act=Activation.TANH), n("cnn5"))
+              .add_vertex(n("scaling"), ScaleVertex(scale_factor=scale),
+                          n("batch5"))
+              .add_layer(n("shortcut-identity"),
+                         ActivationLayer(activation=Activation.IDENTITY), prev)
+              .add_vertex(n("shortcut"), ElementWiseVertex(op="Add"),
+                          n("scaling"), n("shortcut-identity")))
+            out = name if i == count else n("activation")
+            g.add_layer(out, ActivationLayer(activation=Activation.TANH),
+                        n("shortcut"))
+            prev = out
+        return prev
+
+    # ---- full graph ----
+    def graph_builder(self):
+        c, h, w = self.input_shape
+        g = (NeuralNetConfiguration.Builder()
+             .seed(self.seed)
+             .activation(Activation.RELU)
+             .updater(self.updater)
+             .weight_init(WeightInit.DISTRIBUTION)
+             .dist({"type": "normal", "mean": 0.0, "std": 0.5})
+             .l2(5e-5)
+             .convolution_mode(ConvolutionMode.Truncate)
+             .dtype(self.dtype)
+             .compute_dtype(self.compute_dtype)
+             .graph_builder())
+        # stem (ref :113-162)
+        (g.add_inputs("input")
+          .add_layer("stem-cnn1", _conv(32, (3, 3), (2, 2)), "input")
+          .add_layer("stem-batch1", _bn(), "stem-cnn1")
+          .add_layer("stem-cnn2", _conv(32, (3, 3)), "stem-batch1")
+          .add_layer("stem-batch2", _bn(), "stem-cnn2")
+          .add_layer("stem-cnn3", _conv(64, (3, 3), mode=SAME), "stem-batch2")
+          .add_layer("stem-batch3", _bn(), "stem-cnn3")
+          .add_layer("stem-pool4", SubsamplingLayer(
+              pooling_type=PoolingType.MAX, kernel_size=(3, 3), stride=(2, 2)),
+              "stem-batch3")
+          .add_layer("stem-cnn5", _conv(80, (1, 1)), "stem-pool4")
+          .add_layer("stem-batch5", _bn(), "stem-cnn5")
+          .add_layer("stem-cnn6", _conv(128, (3, 3)), "stem-batch5")
+          .add_layer("stem-batch6", _bn(), "stem-cnn6")
+          .add_layer("stem-cnn7", _conv(192, (3, 3), (2, 2)), "stem-batch6")
+          .add_layer("stem-batch7", _bn(), "stem-cnn7"))
+
+        x = self._res_a(g, "resnetA", 5, 0.17, "stem-batch7")
+
+        # reduction-A (ref :173-216)
+        (g.add_layer("reduceA-cnn1", _conv(192, (3, 3), (2, 2)), x)
+          .add_layer("reduceA-batch1", _bn(), "reduceA-cnn1")
+          .add_layer("reduceA-cnn2", _conv(128, mode=SAME), x)
+          .add_layer("reduceA-batch2", _bn(), "reduceA-cnn2")
+          .add_layer("reduceA-cnn3", _conv(128, (3, 3), mode=SAME),
+                     "reduceA-batch2")
+          .add_layer("reduceA-batch3", _bn(), "reduceA-cnn3")
+          .add_layer("reduceA-cnn4", _conv(192, (3, 3), (2, 2)), "reduceA-batch3")
+          .add_layer("reduceA-batch4", _bn(), "reduceA-cnn4")
+          .add_layer("reduceA-pool5", SubsamplingLayer(
+              pooling_type=PoolingType.MAX, kernel_size=(3, 3), stride=(2, 2)), x)
+          .add_vertex("reduceA", MergeVertex(), "reduceA-batch1",
+                      "reduceA-batch4", "reduceA-pool5"))
+
+        x = self._res_b(g, "resnetB", 10, 0.10, "reduceA")
+
+        # reduction-B (ref :226-298)
+        (g.add_layer("reduceB-pool1", SubsamplingLayer(
+            pooling_type=PoolingType.MAX, kernel_size=(3, 3), stride=(2, 2)), x)
+          .add_layer("reduceB-cnn2", _conv(256, mode=SAME), x)
+          .add_layer("reduceB-batch1", _bn(), "reduceB-cnn2")
+          .add_layer("reduceB-cnn3", _conv(256, (3, 3), (2, 2)), "reduceB-batch1")
+          .add_layer("reduceB-batch2", _bn(), "reduceB-cnn3")
+          .add_layer("reduceB-cnn4", _conv(256, mode=SAME), x)
+          .add_layer("reduceB-batch3", _bn(), "reduceB-cnn4")
+          .add_layer("reduceB-cnn5", _conv(256, (3, 3), (2, 2)), "reduceB-batch3")
+          .add_layer("reduceB-batch4", _bn(), "reduceB-cnn5")
+          .add_layer("reduceB-cnn6", _conv(256, mode=SAME), x)
+          .add_layer("reduceB-batch5", _bn(), "reduceB-cnn6")
+          .add_layer("reduceB-cnn7", _conv(256, (3, 3), mode=SAME),
+                     "reduceB-batch5")
+          .add_layer("reduceB-batch6", _bn(), "reduceB-cnn7")
+          .add_layer("reduceB-cnn8", _conv(256, (3, 3), (2, 2)), "reduceB-batch6")
+          .add_layer("reduceB-batch7", _bn(), "reduceB-cnn8")
+          .add_vertex("reduceB", MergeVertex(), "reduceB-pool1",
+                      "reduceB-batch2", "reduceB-batch4", "reduceB-batch7"))
+
+        x = self._res_c(g, "resnetC", 5, 0.20, "reduceB")
+
+        (g.add_layer("avgpool", SubsamplingLayer(
+            pooling_type=PoolingType.AVG, kernel_size=(1, 1), stride=(1, 1)), x)
+          .add_layer("bottleneck", DenseLayer(n_out=self.embedding_size,
+                                              activation=Activation.IDENTITY),
+                     "avgpool")
+          .add_vertex("embeddings", L2NormalizeVertex(eps=1e-10), "bottleneck")
+          .add_layer("outputLayer", CenterLossOutputLayer(
+              n_out=self.num_labels,
+              loss_fn=LossFunction.NEGATIVELOGLIKELIHOOD,
+              activation=Activation.SOFTMAX, alpha=0.9, lambda_=1e-4),
+              "embeddings")
+          .set_outputs("outputLayer")
+          .set_input_types(InputType.convolutional(h, w, c)))
+        return g
+
+    def conf(self):
+        return self.graph_builder().build()
+
+    def init(self) -> ComputationGraph:
+        net = ComputationGraph(self.conf())
+        net.init()
+        return net

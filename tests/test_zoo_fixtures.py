@@ -1,0 +1,53 @@
+"""Zoo numeric-validation fixtures + checkpoint-format regression.
+
+Parity: ref SURVEY §4.3 regression-test strategy (deeplearning4j-core regression
+tests load committed old-version model files and compare outputs). Each fixture
+pins: exact forward values on a committed input, and the parameter count — any
+change to layer math, init order, or graph wiring fails loudly.
+"""
+import os
+
+import numpy as np
+import pytest
+
+FIXDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "fixtures")
+
+_SPECS = {
+    "lenet": ("LeNet", {}),
+    "alexnet": ("AlexNet", {}),
+    "vgg16": ("VGG16", {}),
+    "resnet50": ("ResNet50", {}),
+    "simplecnn": ("SimpleCNN", {}),
+    "googlenet": ("GoogLeNet", {}),
+    "inception_resnet_v1": ("InceptionResNetV1", {}),
+    "facenet_nn4_small2": ("FaceNetNN4Small2", {}),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_SPECS))
+def test_zoo_forward_values_match_fixture(name):
+    import deeplearning4j_tpu.models as models
+    cls_name, kw = _SPECS[name]
+    fix = np.load(os.path.join(FIXDIR, f"zoo_forward_{name}.npz"))
+    net = getattr(models, cls_name)(num_labels=10, seed=42, **kw).init()
+    assert net.num_params() == int(fix["num_params"]), \
+        f"{name} param count changed: {net.num_params()} != {int(fix['num_params'])}"
+    train_mode = bool(fix["train_mode"]) if "train_mode" in fix else False
+    out = np.asarray(net.output(fix["x"], train=train_mode))
+    assert np.allclose(out, fix["out"], atol=1e-4), \
+        f"{name} forward values drifted: max|d|={np.abs(out - fix['out']).max()}"
+
+
+def test_checkpoint_format_regression():
+    """A zip written by an OLD build must keep loading and producing identical
+    outputs (ref §4.3: format_version stability)."""
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+    exp = np.load(os.path.join(FIXDIR, "checkpoint_v1_expected.npz"))
+    net = ModelSerializer.restore(os.path.join(FIXDIR, "checkpoint_v1_mln.zip"))
+    assert np.allclose(np.asarray(net.params()), exp["params"], atol=1e-12)
+    assert net._step == int(exp["step"])
+    out = np.asarray(net.output(exp["x"]))
+    assert np.allclose(out, exp["out"], atol=1e-10)
+    # training continues from the restored updater state without error
+    net.fit_batch(exp["x"], exp["y"])
+    assert np.isfinite(net.score())
