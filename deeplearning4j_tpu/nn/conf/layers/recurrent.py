@@ -65,6 +65,14 @@ class LSTM(FeedForwardLayerConf):
     def _step(self, params, xw_t, h, c):
         n = self.n_out
         gates = xw_t + h @ params["RW"]
+        if not self.peephole and self.gate_activation == Activation.SIGMOID \
+                and self.activation == Activation.TANH:
+            # helper seam (ref LSTMHelper.java fast path): fused Pallas gate
+            # kernel when enabled, identical math either way
+            from deeplearning4j_tpu.ops.helpers import helper_for
+            from deeplearning4j_tpu.ops.pallas_kernels import lstm_gates_xla
+            c_new, h_new = helper_for("lstm_gates", lstm_gates_xla)(gates, c)
+            return h_new, c_new
         zi, zf, zo, zg = (gates[:, :n], gates[:, n:2 * n],
                           gates[:, 2 * n:3 * n], gates[:, 3 * n:])
         gact = lambda v: apply_activation(self.gate_activation, v)

@@ -1,0 +1,14 @@
+"""Accelerated-kernel plug-ins (L2): the helper seam + Pallas TPU kernels.
+
+Parity: ref nn/layers/LayerHelper + the cudnn helper interfaces
+(ConvolutionHelper, LSTMHelper, BatchNormalizationHelper) — here a registry of
+Pallas kernels that call sites reach through `helper_for`, disabled by default
+(XLA fusion is the baseline; enable with enable_helpers()/DL4J_TPU_HELPERS=1).
+"""
+from deeplearning4j_tpu.ops.helpers import (
+    enable_helpers, helper_for, helpers_enabled, register_helper,
+    registered_helpers)
+from deeplearning4j_tpu.ops import pallas_kernels  # registers kernels on import
+
+__all__ = ["enable_helpers", "helpers_enabled", "helper_for", "register_helper",
+           "registered_helpers", "pallas_kernels"]

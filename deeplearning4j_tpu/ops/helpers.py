@@ -1,0 +1,50 @@
+"""Acceleration-helper seam (L2).
+
+Parity: ref nn/layers/LayerHelper + ConvolutionHelper/LSTMHelper/
+BatchNormalizationHelper — the reference's pluggable cudnn fast-path interfaces
+(e.g. nn/layers/recurrent/LSTMHelper.java). TPU rendering: ops register named
+accelerated implementations (Pallas kernels) keyed by op name; call sites dispatch
+through `helper_for`, which returns the registered kernel when the seam is enabled
+and the platform supports it, else the XLA-fallback the caller supplies. XLA's
+default codegen is already excellent — kernels go through this seam only where
+hand-tiling beats the compiler, and everything keeps working with the seam off.
+"""
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+_REGISTRY: Dict[str, Callable] = {}
+_ENABLED: Optional[bool] = None
+
+
+def register_helper(op_name: str):
+    """Decorator: register an accelerated implementation for `op_name`."""
+    def deco(fn):
+        _REGISTRY[op_name] = fn
+        return fn
+    return deco
+
+
+def enable_helpers(flag: bool = True) -> None:
+    """Programmatic switch (env DL4J_TPU_HELPERS=1 also enables)."""
+    global _ENABLED
+    _ENABLED = bool(flag)
+
+
+def helpers_enabled() -> bool:
+    if _ENABLED is not None:
+        return _ENABLED
+    return os.environ.get("DL4J_TPU_HELPERS", "0") == "1"
+
+
+def helper_for(op_name: str, fallback: Callable) -> Callable:
+    """The seam: accelerated impl if registered+enabled, else the fallback
+    (ref LayerHelper selection in BaseLayer.initializeHelper)."""
+    if helpers_enabled() and op_name in _REGISTRY:
+        return _REGISTRY[op_name]
+    return fallback
+
+
+def registered_helpers():
+    return dict(_REGISTRY)

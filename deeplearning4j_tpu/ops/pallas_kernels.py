@@ -1,0 +1,160 @@
+"""Pallas TPU kernels behind the helper seam.
+
+Two hot ops where hand-tiling pays (everything else is left to XLA fusion):
+
+- `lstm_gates`: the per-timestep gate nonlinearity + cell update of the LSTM scan
+  body (ref nn/layers/recurrent/LSTMHelpers.java:200 — the reference's cudnn
+  fast path). One VMEM-resident kernel computes sigmoid/tanh gates and the new
+  (c, h) for a batch tile, replacing four separate slice+activation HLOs between
+  the two MXU matmuls.
+- `threshold_encode`: the gradient-compression quantizer of the SHARED_GRADIENTS
+  path (ref EncodingHandler / threshold encoding) — elementwise ternarize with
+  residual carry, the "quantization kernels" pattern from the Pallas guide.
+
+Both run with `interpret=True` off-TPU so the CPU test mesh exercises the same
+code path, and both have pure-jnp fallbacks wired through the seam.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.helpers import register_helper
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+# ------------------------------------------------------------------ lstm gates
+
+
+def _lstm_gates_kernel(gates_ref, c_ref, c_out_ref, h_out_ref):
+    import jax.nn as jnn
+    g = gates_ref[:]                       # (TB, 4H)
+    c = c_ref[:]                           # (TB, H)
+    H = c.shape[-1]
+    zi = jnn.sigmoid(g[:, :H])
+    zf = jnn.sigmoid(g[:, H:2 * H])
+    zo = jnn.sigmoid(g[:, 2 * H:3 * H])
+    zg = jnp.tanh(g[:, 3 * H:])
+    c_new = zf * c + zi * zg
+    c_out_ref[:] = c_new
+    h_out_ref[:] = zo * jnp.tanh(c_new)
+
+
+def _lstm_gates_bwd_kernel(gates_ref, c_ref, dc_ref, dh_ref,
+                           dgates_ref, dcprev_ref):
+    """Backward: recompute activations from the saved inputs (remat-style — no
+    forward activations are kept in HBM), then the closed-form gate gradients."""
+    import jax.nn as jnn
+    g = gates_ref[:]
+    c = c_ref[:]
+    dc_new = dc_ref[:]
+    dh = dh_ref[:]
+    H = c.shape[-1]
+    i = jnn.sigmoid(g[:, :H])
+    f = jnn.sigmoid(g[:, H:2 * H])
+    o = jnn.sigmoid(g[:, 2 * H:3 * H])
+    gg = jnp.tanh(g[:, 3 * H:])
+    c_new = f * c + i * gg
+    t = jnp.tanh(c_new)
+    do = dh * t
+    dct = dc_new + dh * o * (1.0 - t * t)
+    dzi = dct * gg * i * (1.0 - i)
+    dzf = dct * c * f * (1.0 - f)
+    dzo = do * o * (1.0 - o)
+    dzg = dct * i * (1.0 - gg * gg)
+    dgates_ref[:] = jnp.concatenate([dzi, dzf, dzo, dzg], axis=-1)
+    dcprev_ref[:] = dct * f
+
+
+@jax.custom_vjp
+def lstm_gates_pallas(gates: jnp.ndarray, c: jnp.ndarray):
+    """gates (B, 4H) pre-activations [i|f|o|g], c (B, H) -> (c_new, h_new).
+
+    Gate order matches nn/conf/layers/recurrent.py:67-70 (zi, zf, zo, zg).
+    Differentiable via a custom VJP whose backward is itself a Pallas kernel
+    (the guide's Custom VJP pattern)."""
+    from jax.experimental import pallas as pl
+    B, H = c.shape
+    c_new, h_new = pl.pallas_call(
+        _lstm_gates_kernel,
+        out_shape=(jax.ShapeDtypeStruct((B, H), c.dtype),
+                   jax.ShapeDtypeStruct((B, H), c.dtype)),
+        interpret=_interpret(),
+    )(gates, c)
+    return c_new, h_new
+
+
+def _lstm_gates_fwd(gates, c):
+    return lstm_gates_pallas(gates, c), (gates, c)
+
+
+def _lstm_gates_bwd(saved, cotangents):
+    from jax.experimental import pallas as pl
+    gates, c = saved
+    dc_new, dh = cotangents
+    B, H = c.shape
+    dgates, dc_prev = pl.pallas_call(
+        _lstm_gates_bwd_kernel,
+        out_shape=(jax.ShapeDtypeStruct((B, 4 * H), gates.dtype),
+                   jax.ShapeDtypeStruct((B, H), c.dtype)),
+        interpret=_interpret(),
+    )(gates, c, dc_new, dh)
+    return dgates, dc_prev
+
+
+lstm_gates_pallas.defvjp(_lstm_gates_fwd, _lstm_gates_bwd)
+register_helper("lstm_gates")(lstm_gates_pallas)
+
+
+def lstm_gates_xla(gates: jnp.ndarray, c: jnp.ndarray):
+    """Fallback: plain jnp (what the layer inlines today)."""
+    H = c.shape[-1]
+    zi = jax.nn.sigmoid(gates[:, :H])
+    zf = jax.nn.sigmoid(gates[:, H:2 * H])
+    zo = jax.nn.sigmoid(gates[:, 2 * H:3 * H])
+    zg = jnp.tanh(gates[:, 3 * H:])
+    c_new = zf * c + zi * zg
+    return c_new, zo * jnp.tanh(c_new)
+
+
+# ------------------------------------------------------------ threshold encode
+
+
+def _make_threshold_kernel(thr: float):
+    def kernel(acc_ref, msg_ref, res_ref):
+        acc = acc_ref[:]
+        mask = jnp.abs(acc) >= thr
+        msg = jnp.where(mask, jnp.sign(acc) * thr, 0.0).astype(acc.dtype)
+        msg_ref[:] = msg
+        res_ref[:] = acc - msg
+    return kernel
+
+
+@register_helper("threshold_encode")
+@functools.partial(jax.jit, static_argnames=("threshold",))
+def threshold_encode_pallas(update: jnp.ndarray, residual: jnp.ndarray,
+                            threshold: float):
+    """Ternarize update+residual to {-t, 0, +t} with residual carry — same
+    contract as parallel/accumulation.threshold_encode. The threshold is a
+    compile-time constant (one compiled kernel per threshold value, exactly like
+    the reference's fixed EncodingHandler threshold)."""
+    from jax.experimental import pallas as pl
+    n = update.shape[0]
+    lanes = 128
+    rows = max(8, (n + lanes - 1) // lanes)
+    acc = update + residual
+    acc2d = jnp.zeros((rows * lanes,), update.dtype).at[:n].set(acc) \
+        .reshape(rows, lanes)
+    msg2d, res2d = pl.pallas_call(
+        _make_threshold_kernel(float(threshold)),
+        out_shape=(jax.ShapeDtypeStruct((rows, lanes), update.dtype),
+                   jax.ShapeDtypeStruct((rows, lanes), update.dtype)),
+        interpret=_interpret(),
+    )(acc2d)
+    return msg2d.reshape(-1)[:n], res2d.reshape(-1)[:n]
