@@ -1,0 +1,56 @@
+"""EvaluationTools: self-contained HTML export of ROC / calibration charts.
+
+Parity: ref deeplearning4j-core/.../evaluation/EvaluationTools.java
+(exportRocChartsToHtmlFile) — rendered as dependency-free inline-SVG HTML instead
+of the reference's component/Play stack.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+
+def _svg_line_chart(points, width=560, height=360, pad=45, title="",
+                    xlabel="", ylabel="", diagonal=False):
+    xs = [p[0] for p in points]
+    ys = [p[1] for p in points]
+    x0, x1 = min(xs), max(xs)
+    y0, y1 = min(0.0, min(ys)), max(1.0, max(ys))
+    sx = lambda v: pad + (width - 2 * pad) * (v - x0) / max(x1 - x0, 1e-12)
+    sy = lambda v: height - pad - (height - 2 * pad) * (v - y0) / max(y1 - y0, 1e-12)
+    d = " ".join(f"{'M' if i == 0 else 'L'}{sx(x):.1f} {sy(y):.1f}"
+                 for i, (x, y) in enumerate(points))
+    diag = (f'<line x1="{sx(0)}" y1="{sy(0)}" x2="{sx(1)}" y2="{sy(1)}" '
+            f'stroke="#bbb" stroke-dasharray="4"/>') if diagonal else ""
+    return f"""<svg width="{width}" height="{height}">
+<rect width="{width}" height="{height}" fill="#fff" stroke="#ccc"/>
+<text x="{width / 2}" y="18" text-anchor="middle" font-size="14">{title}</text>
+<text x="{width / 2}" y="{height - 8}" text-anchor="middle" font-size="11">{xlabel}</text>
+<text x="12" y="{height / 2}" font-size="11" transform="rotate(-90 12 {height / 2})">{ylabel}</text>
+{diag}
+<path d="{d}" stroke="#36c" fill="none" stroke-width="1.6"/>
+</svg>"""
+
+
+class EvaluationTools:
+    @staticmethod
+    def roc_chart_html(roc, title: str = "ROC") -> str:
+        curve = roc.get_roc_curve()
+        roc_pts = sorted(zip(curve.fpr, curve.tpr))
+        pr = roc.get_precision_recall_curve()
+        pr_pts = sorted(zip(pr.recall, pr.precision))
+        return ("<html><body><h2>{t}</h2><p>AUC: {auc:.6f} | AUPRC: {pr:.6f}</p>"
+                "{c1}{c2}</body></html>").format(
+            t=title, auc=roc.calculate_auc(), pr=roc.calculate_auprc(),
+            c1=_svg_line_chart(roc_pts, title="ROC curve",
+                               xlabel="False positive rate",
+                               ylabel="True positive rate", diagonal=True),
+            c2=_svg_line_chart(pr_pts, title="Precision-Recall",
+                               xlabel="Recall", ylabel="Precision"))
+
+    @staticmethod
+    def export_roc_charts_to_html_file(roc, path: str,
+                                       title: str = "ROC") -> None:
+        """(ref EvaluationTools.exportRocChartsToHtmlFile)"""
+        with open(path, "w") as f:
+            f.write(EvaluationTools.roc_chart_html(roc, title))
+    exportRocChartsToHtmlFile = export_roc_charts_to_html_file

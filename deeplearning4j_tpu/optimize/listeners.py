@@ -113,6 +113,57 @@ class EvaluativeListener(TrainingListener):
             print(self.last_evaluation.stats())
 
 
+class ParamAndGradientIterationListener(IterationListener):
+    """Per-iteration parameter/update magnitude stats to console and/or a
+    delimited file (ref optimize/listeners/ParamAndGradientIterationListener.java).
+    'Gradients' are the applied parameter deltas (post-updater), captured as the
+    difference between successive parameter snapshots — exact, no training-path
+    instrumentation."""
+
+    def __init__(self, iterations: int = 1, print_mean: bool = True,
+                 print_min_max: bool = True, print_mean_abs_value: bool = True,
+                 output_to_console: bool = False, output_to_file: bool = False,
+                 file_path: Optional[str] = None, delimiter: str = "\t"):
+        self.iterations = max(1, int(iterations))
+        self.print_mean = print_mean
+        self.print_min_max = print_min_max
+        self.print_mean_abs_value = print_mean_abs_value
+        self.output_to_console = output_to_console
+        self.output_to_file = output_to_file
+        self.file_path = file_path
+        self.delimiter = delimiter
+        self.history: List[dict] = []
+        self._prev = None
+        self._wrote_header = False
+
+    def iteration_done(self, model, iteration: int):
+        import numpy as np
+        params = np.asarray(model.params())
+        if iteration % self.iterations != 0:
+            self._prev = params
+            return
+        rec = {"iteration": iteration, "score": float(model.score())}
+        sources = {"param": params}
+        if self._prev is not None:
+            sources["update"] = params - self._prev
+        for kind, arr in sources.items():
+            if self.print_mean:
+                rec[f"{kind}_mean"] = float(arr.mean())
+            if self.print_min_max:
+                rec[f"{kind}_min"] = float(arr.min())
+                rec[f"{kind}_max"] = float(arr.max())
+            if self.print_mean_abs_value:
+                rec[f"{kind}_mean_abs"] = float(np.abs(arr).mean())
+        self._prev = params
+        self.history.append(rec)
+        line = self.delimiter.join(f"{k}={v}" for k, v in rec.items())
+        if self.output_to_console:
+            print(line)
+        if self.output_to_file and self.file_path:
+            with open(self.file_path, "a") as f:
+                f.write(line + "\n")
+
+
 class SleepyTrainingListener(TrainingListener):
     """Throttling listener (ref SleepyTrainingListener) — mainly for tests."""
 

@@ -1,0 +1,146 @@
+"""Memory estimation: per-layer and whole-network byte reports.
+
+Parity: ref nn/conf/memory/{MemoryReport,LayerMemoryReport,NetworkMemoryReport}.java
+(getMemoryReport(InputType) on every layer conf; fixed vs per-example memory,
+params + updater state + activations). TPU rendering: parameter/state shapes come
+from `jax.eval_shape` over the real init functions — zero allocation, always in
+sync with the actual layers — and the report distinguishes HBM-resident fixed
+bytes (params, updater state) from per-example activation bytes.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _updater_state_multiplier(updater) -> int:
+    """How many param-sized buffers the updater keeps (ref updater state sizes)."""
+    name = type(updater).__name__
+    return {"Sgd": 0, "NoOp": 0, "Nesterovs": 1, "AdaGrad": 1, "RmsProp": 1,
+            "AdaDelta": 2, "Adam": 2, "AdaMax": 2, "Nadam": 2}.get(name, 1)
+
+
+@dataclass
+class LayerMemoryReport:
+    """(ref LayerMemoryReport.java)"""
+    layer_name: str
+    layer_type: str
+    param_count: int
+    updater_state_count: int
+    activation_elements_per_example: int
+
+    def total_fixed_bytes(self, bytes_per_element: int) -> int:
+        return (self.param_count + self.updater_state_count) * bytes_per_element
+
+    def activation_bytes(self, batch: int, bytes_per_element: int) -> int:
+        return self.activation_elements_per_example * batch * bytes_per_element
+
+
+@dataclass
+class NetworkMemoryReport:
+    """(ref NetworkMemoryReport.java)"""
+    layers: List[LayerMemoryReport]
+    network_class: str
+    dtype: str
+
+    @property
+    def bytes_per_element(self) -> int:
+        return jnp.dtype(self.dtype).itemsize
+
+    def total_param_count(self) -> int:
+        return sum(l.param_count for l in self.layers)
+
+    def total_fixed_bytes(self) -> int:
+        return sum(l.total_fixed_bytes(self.bytes_per_element)
+                   for l in self.layers)
+
+    def total_activation_bytes(self, batch: int) -> int:
+        return sum(l.activation_bytes(batch, self.bytes_per_element)
+                   for l in self.layers)
+
+    def total_bytes(self, batch: int, training: bool = True) -> int:
+        """Training ~ activations kept for backward (x2 for cotangents)."""
+        act = self.total_activation_bytes(batch)
+        return self.total_fixed_bytes() + (2 * act if training else act)
+
+    def to_string(self, batch: int = 32) -> str:
+        def fmt(b):
+            for unit in ("B", "KB", "MB", "GB"):
+                if b < 1024:
+                    return f"{b:.1f} {unit}"
+                b /= 1024
+            return f"{b:.1f} TB"
+
+        lines = [f"NetworkMemoryReport ({self.network_class}, dtype={self.dtype})",
+                 f"{'layer':<28}{'type':<26}{'params':>12}{'updater':>12}"
+                 f"{'act/ex':>10}"]
+        for l in self.layers:
+            lines.append(f"{l.layer_name:<28}{l.layer_type:<26}"
+                         f"{l.param_count:>12}{l.updater_state_count:>12}"
+                         f"{l.activation_elements_per_example:>10}")
+        lines.append(f"total params: {self.total_param_count()} "
+                     f"({fmt(self.total_fixed_bytes())} fixed HBM); "
+                     f"activations@batch={batch}: "
+                     f"{fmt(self.total_activation_bytes(batch))}; "
+                     f"training total: {fmt(self.total_bytes(batch))}")
+        return "\n".join(lines)
+
+
+class MemoryReport:
+    """(ref MemoryReport.java entry points)"""
+
+    @staticmethod
+    def for_network(conf) -> NetworkMemoryReport:
+        """Accepts a MultiLayerConfiguration or ComputationGraphConfiguration."""
+        from deeplearning4j_tpu.nn.conf.configuration import (
+            MultiLayerConfiguration)
+        dtype = jnp.dtype(conf.global_conf.dtype)
+        key = jax.random.PRNGKey(0)
+        reports = []
+        if isinstance(conf, MultiLayerConfiguration):
+            input_types = conf.input_types_per_layer()
+            global_updater = conf.get_updater()
+            for i, layer in enumerate(conf.layers):
+                it = input_types[i]
+                shapes = jax.eval_shape(
+                    lambda: layer.init_params(key, it, dtype)) \
+                    if layer.has_params() else {}
+                pcount = sum(int(np.prod(s.shape)) for s in shapes.values())
+                from deeplearning4j_tpu.nn.updater.updaters import BaseUpdater
+                upd = (BaseUpdater.from_dict(layer.updater)
+                       if layer.updater is not None else global_updater)
+                mult = 0 if layer.frozen else _updater_state_multiplier(upd)
+                out_t = layer.get_output_type(it)
+                reports.append(LayerMemoryReport(
+                    layer_name=layer.name or f"layer{i}",
+                    layer_type=type(layer).__name__,
+                    param_count=pcount,
+                    updater_state_count=pcount * mult,
+                    activation_elements_per_example=out_t.flat_size()
+                    if hasattr(out_t, "flat_size") else out_t.size))
+            return NetworkMemoryReport(reports, "MultiLayerNetwork", str(dtype))
+        # ComputationGraphConfiguration: instantiate shapes via the graph nodes
+        from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+        net = ComputationGraph(conf)
+        net.init()  # graphs resolve nIn at init; reuse then drop
+        global_updater = conf.global_conf.get_updater() \
+            if hasattr(conf.global_conf, "get_updater") else conf.get_updater()
+        for name, params in zip(net.layer_names, net.params_tree):
+            layer = net.conf.nodes[name].conf
+            pcount = sum(int(np.prod(p.shape)) for p in params.items()
+                         for p in [p[1]])
+            from deeplearning4j_tpu.nn.updater.updaters import BaseUpdater
+            upd = (BaseUpdater.from_dict(layer.updater)
+                   if getattr(layer, "updater", None) is not None
+                   else global_updater)
+            mult = 0 if getattr(layer, "frozen", False) \
+                else _updater_state_multiplier(upd)
+            reports.append(LayerMemoryReport(
+                layer_name=name, layer_type=type(layer).__name__,
+                param_count=pcount, updater_state_count=pcount * mult,
+                activation_elements_per_example=0))
+        return NetworkMemoryReport(reports, "ComputationGraph", str(dtype))

@@ -1,0 +1,36 @@
+"""ModelGuesser: load a saved model file without knowing its type.
+
+Parity: ref deeplearning4j-core/.../util/ModelGuesser.java (loadModelGuess —
+tries MultiLayerNetwork, ComputationGraph, raw configuration JSON in turn).
+"""
+from __future__ import annotations
+
+import json
+import zipfile
+
+
+class ModelGuesser:
+    @staticmethod
+    def load_model_guess(path: str):
+        """Model zip -> the right network class; bare .json -> a configuration."""
+        if path.endswith(".json"):
+            return ModelGuesser.load_config_guess(path)
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+        return ModelSerializer.restore(path)
+    loadModelGuess = load_model_guess
+
+    @staticmethod
+    def load_config_guess(path: str):
+        """(ref loadConfigGuess) — MultiLayerConfiguration or
+        ComputationGraphConfiguration from a JSON file."""
+        from deeplearning4j_tpu.nn.conf.configuration import (
+            MultiLayerConfiguration)
+        from deeplearning4j_tpu.nn.conf.graph_configuration import (
+            ComputationGraphConfiguration)
+        with open(path, "r") as f:
+            text = f.read()
+        d = json.loads(text)
+        if "nodes" in d or "vertices" in d:
+            return ComputationGraphConfiguration.from_json(text)
+        return MultiLayerConfiguration.from_json(text)
+    loadConfigGuess = load_config_guess

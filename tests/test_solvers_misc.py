@@ -1,0 +1,163 @@
+"""Solvers (LBFGS/CG/line search), memory reports, ModelGuesser, EvaluationTools,
+ParamAndGradientIterationListener.
+
+Parity: ref optimize/solvers tests (TestOptimizers.java runs each
+OptimizationAlgorithm to convergence), nn/conf/memory tests, ModelGuesserTest,
+EvaluationToolsTests."""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    Activation, Adam, DenseLayer, InputType, LossFunction, MultiLayerNetwork,
+    NeuralNetConfiguration, OutputLayer, Sgd, WeightInit)
+from deeplearning4j_tpu.common.enums import OptimizationAlgorithm
+from deeplearning4j_tpu.datasets.impl import load_iris
+from deeplearning4j_tpu.optimize.solvers import (
+    ConjugateGradient, LBFGS, LineGradientDescent, Solver)
+
+RNG = np.random.RandomState(3)
+
+
+def iris_net(updater=None):
+    b = (NeuralNetConfiguration.Builder().seed(3).weight_init(WeightInit.XAVIER)
+         .activation(Activation.TANH).updater(updater or Sgd(learning_rate=0.1))
+         .dtype("float64").list())
+    b.layer(DenseLayer(n_out=8))
+    b.layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX))
+    return MultiLayerNetwork(
+        b.set_input_type(InputType.feed_forward(4)).build()).init()
+
+
+def iris_xy():
+    x, y = load_iris()
+    x = (x - x.mean(0)) / x.std(0)
+    return x, np.eye(3, dtype=np.float64)[y]
+
+
+@pytest.mark.parametrize("solver_cls", [LBFGS, ConjugateGradient,
+                                        LineGradientDescent])
+def test_solver_converges_on_iris(solver_cls):
+    """(ref TestOptimizers: every algorithm must reach a good optimum)"""
+    net = iris_net()
+    x, y = iris_xy()
+    f0 = net.score(type("DS", (), {"features": x, "labels": y,
+                                   "features_mask": None, "labels_mask": None})())
+    solver = solver_cls(max_iterations=150)
+    f = solver.optimize(net, x, y)
+    assert f < 0.35  # near the full-batch optimum; init CE ~1.1
+    assert f < f0 / 2
+    assert len(solver.score_history) > 3
+    # monotone-ish: final is the best seen
+    assert f <= min(solver.score_history) + 1e-9
+    acc = (np.asarray(net.output(x)).argmax(1) == y.argmax(1)).mean()
+    assert acc > 0.9
+
+
+def test_lbfgs_beats_short_sgd():
+    """Second-order full-batch should crush the same number of SGD steps."""
+    x, y = iris_xy()
+    net1 = iris_net()
+    LBFGS(max_iterations=40).optimize(net1, x, y)
+    net2 = iris_net()
+    for _ in range(40):
+        net2.fit_batch(x, y)
+    assert float(net1._score) < float(net2.score())
+
+
+def test_solver_facade_dispatch():
+    net = iris_net()
+    x, y = iris_xy()
+    s = Solver.Builder().model(net).configure(max_iterations=30).build()
+    f = s.optimize(x, y, algorithm=OptimizationAlgorithm.LBFGS)
+    assert f < 0.6
+    # SGD dispatch goes through the network's own step
+    f2 = s.optimize(x, y,
+                    algorithm=OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT)
+    assert np.isfinite(f2)
+
+
+# ----------------------------------------------------------------- memory
+
+
+def test_memory_report_mln():
+    from deeplearning4j_tpu.util.memory import MemoryReport
+    net = iris_net(updater=Adam(learning_rate=0.01))
+    rep = MemoryReport.for_network(net.conf)
+    assert rep.total_param_count() == net.num_params()
+    # Adam keeps 2 param-sized buffers
+    assert rep.total_fixed_bytes() == net.num_params() * 3 * 8  # float64
+    act = rep.total_activation_bytes(batch=10)
+    assert act == (8 + 3) * 10 * 8
+    s = rep.to_string(batch=10)
+    assert "DenseLayer" in s and "total params" in s
+    assert rep.total_bytes(10) > rep.total_fixed_bytes()
+
+
+def test_memory_report_zoo_model():
+    from deeplearning4j_tpu.models import LeNet
+    from deeplearning4j_tpu.util.memory import MemoryReport
+    net = LeNet(num_labels=10).init()
+    rep = MemoryReport.for_network(net.conf)
+    assert rep.total_param_count() == net.num_params()
+
+
+# ----------------------------------------------------------------- guesser
+
+
+def test_model_guesser(tmp_path):
+    from deeplearning4j_tpu.util.model_guesser import ModelGuesser
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+    net = iris_net()
+    x, y = iris_xy()
+    net.fit_batch(x, y)
+    path = os.path.join(tmp_path, "m.zip")
+    ModelSerializer.write_model(net, path)
+    loaded = ModelGuesser.load_model_guess(path)
+    assert type(loaded).__name__ == "MultiLayerNetwork"
+    assert np.allclose(np.asarray(loaded.params()), np.asarray(net.params()))
+    cpath = os.path.join(tmp_path, "conf.json")
+    with open(cpath, "w") as f:
+        f.write(net.conf.to_json())
+    conf = ModelGuesser.load_config_guess(cpath)
+    assert len(conf.layers) == 2
+
+
+# ------------------------------------------------------------- eval tools
+
+
+def test_evaluation_tools_roc_html(tmp_path):
+    from deeplearning4j_tpu.eval.roc import ROC
+    from deeplearning4j_tpu.eval.evaluation_tools import EvaluationTools
+    roc = ROC()
+    scores = RNG.rand(200)
+    labels = (scores + RNG.randn(200) * 0.3 > 0.5).astype(float)
+    roc.eval(labels, scores)
+    path = os.path.join(tmp_path, "roc.html")
+    EvaluationTools.export_roc_charts_to_html_file(roc, path)
+    html = open(path).read()
+    assert "ROC curve" in html and "Precision-Recall" in html
+    assert f"{roc.calculate_auc():.6f}" in html
+
+
+# ------------------------------------------------------------- listener
+
+
+def test_param_and_gradient_listener(tmp_path):
+    from deeplearning4j_tpu.optimize.listeners import (
+        ParamAndGradientIterationListener)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    path = os.path.join(tmp_path, "stats.tsv")
+    lst = ParamAndGradientIterationListener(output_to_file=True, file_path=path)
+    net = iris_net()
+    net.set_listeners(lst)
+    x, y = iris_xy()
+    for _ in range(4):
+        net.fit(DataSet(x, y))
+    assert len(lst.history) == 4
+    rec = lst.history[-1]
+    assert {"param_mean", "param_min", "param_max", "param_mean_abs",
+            "update_mean", "update_mean_abs"} <= set(rec)
+    assert abs(rec["update_mean_abs"]) > 0
+    assert len(open(path).read().strip().split("\n")) == 4
