@@ -14,12 +14,15 @@ from deeplearning4j_tpu.nn.conf.layers.feedforward import (
     ActivationLayer, AutoEncoder, DenseLayer, DropoutLayer, EmbeddingLayer, LossLayer,
     OutputLayer)
 from deeplearning4j_tpu.nn.conf.layers.convolutional import (
-    Convolution1DLayer, ConvolutionLayer, GlobalPoolingLayer, Subsampling1DLayer,
-    SubsamplingLayer, ZeroPaddingLayer)
+    Convolution1DLayer, ConvolutionLayer, Cropping2D, Deconvolution2D,
+    DepthwiseConvolutionLayer, GlobalPoolingLayer, SeparableConvolution2D,
+    SpaceToDepthLayer, Subsampling1DLayer, SubsamplingLayer, Upsampling2D,
+    ZeroPaddingLayer)
 from deeplearning4j_tpu.nn.conf.layers.normalization import (
     BatchNormalization, LocalResponseNormalization)
 from deeplearning4j_tpu.nn.conf.layers.recurrent import (
-    GravesBidirectionalLSTM, GravesLSTM, LSTM, RnnOutputLayer)
+    Bidirectional, GravesBidirectionalLSTM, GravesLSTM, LastTimeStep, LSTM,
+    RnnOutputLayer, SimpleRnn)
 from deeplearning4j_tpu.nn.conf.layers.variational import (
     BernoulliReconstructionDistribution, CenterLossOutputLayer,
     CompositeReconstructionDistribution, ExponentialReconstructionDistribution,

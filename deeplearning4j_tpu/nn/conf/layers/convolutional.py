@@ -295,3 +295,196 @@ class GlobalPoolingLayer(BaseLayerConf):
             else:
                 out = (jnp.sum(jnp.abs(x) ** self.pnorm, axis=axes)) ** (1.0 / self.pnorm)
         return out, state, None
+
+
+@register_layer
+@dataclass
+class Upsampling2D(BaseLayerConf):
+    """Nearest-neighbor spatial upsampling (ref nn/conf/layers/Upsampling2D.java).
+    On TPU this is a pair of jnp.repeat ops — pure data movement, fused by XLA."""
+    size: Tuple[int, int] = (2, 2)
+
+    def __post_init__(self):
+        if isinstance(self.size, int):
+            self.size = (self.size, self.size)
+        self.size = tuple(self.size)
+
+    def has_params(self):
+        return False
+
+    def get_output_type(self, input_type):
+        return InputType.convolutional(input_type.height * self.size[0],
+                                       input_type.width * self.size[1],
+                                       input_type.channels)
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        z = jnp.repeat(jnp.repeat(x, self.size[0], axis=2), self.size[1], axis=3)
+        return z, state, mask  # pure data movement — no activation
+
+
+@register_layer
+@dataclass
+class SpaceToDepthLayer(BaseLayerConf):
+    """Rearrange spatial blocks into channels (ref nn/conf/layers/
+    SpaceToDepthLayer.java; blocks=NCHW DCR order)."""
+    block_size: int = 2
+
+    def has_params(self):
+        return False
+
+    def get_output_type(self, input_type):
+        b = self.block_size
+        return InputType.convolutional(input_type.height // b,
+                                       input_type.width // b,
+                                       input_type.channels * b * b)
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        n, c, h, w = x.shape
+        b = self.block_size
+        z = x.reshape(n, c, h // b, b, w // b, b)
+        z = z.transpose(0, 3, 5, 1, 2, 4).reshape(n, c * b * b, h // b, w // b)
+        return z, state, mask  # pure data movement — no activation
+
+
+@register_layer
+@dataclass
+class Cropping2D(BaseLayerConf):
+    """Crop spatial borders (ref nn/conf/layers/convolutional/Cropping2D.java);
+    crop = (top, bottom, left, right)."""
+    crop: Tuple[int, int, int, int] = (0, 0, 0, 0)
+
+    def __post_init__(self):
+        if isinstance(self.crop, int):
+            self.crop = (self.crop,) * 4
+        elif len(self.crop) == 2:
+            self.crop = (self.crop[0], self.crop[0], self.crop[1], self.crop[1])
+        self.crop = tuple(self.crop)
+
+    def has_params(self):
+        return False
+
+    def get_output_type(self, input_type):
+        t, b, l, r = self.crop
+        return InputType.convolutional(input_type.height - t - b,
+                                       input_type.width - l - r,
+                                       input_type.channels)
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        t, b, l, r = self.crop
+        h, w = x.shape[2], x.shape[3]
+        return x[:, :, t:h - b or None, l:w - r or None], state, mask
+
+
+@register_layer
+@dataclass
+class Deconvolution2D(ConvolutionLayer):
+    """Transposed convolution (ref nn/conf/layers/Deconvolution2D.java) via
+    lax.conv_transpose."""
+
+    def get_output_type(self, input_type):
+        kh, kw = self.kernel_size
+        sh, sw = self.stride
+        if self.convolution_mode == ConvolutionMode.Same:
+            oh, ow = input_type.height * sh, input_type.width * sw
+        else:
+            oh = sh * (input_type.height - 1) + kh - 2 * self.padding[0]
+            ow = sw * (input_type.width - 1) + kw - 2 * self.padding[1]
+        return InputType.convolutional(oh, ow, self.n_out)
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        fan_in = self.n_in * kh * kw
+        fan_out = self.n_out * kh * kw
+        p = {"W": self._winit(key, (self.n_in, self.n_out, kh, kw), fan_in,
+                              fan_out, dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        if self.convolution_mode == ConvolutionMode.Same:
+            pad = "SAME"
+        else:
+            kh, kw = self.kernel_size
+            ph, pw = self.padding
+            pad = ((kh - 1 - ph, kh - 1 - ph), (kw - 1 - pw, kw - 1 - pw))
+        z = lax.conv_transpose(
+            x, params["W"], strides=self.stride, padding=pad,
+            dimension_numbers=("NCHW", "IOHW", "NCHW"))
+        if self.has_bias:
+            z = z + params["b"][None, :, None, None]
+        return self._act(z), state, mask
+
+
+@register_layer
+@dataclass
+class DepthwiseConvolutionLayer(ConvolutionLayer):
+    """Depthwise conv (ref nn/conf/layers/DepthwiseConvolution2D.java):
+    feature_group_count=n_in on the MXU conv op, depth_multiplier channels out
+    per input channel."""
+    depth_multiplier: int = 1
+
+    def get_output_type(self, input_type):
+        base = super().get_output_type(input_type)
+        return InputType.convolutional(base.height, base.width,
+                                       self.n_in * self.depth_multiplier)
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        dm = self.depth_multiplier
+        fan_in = kh * kw
+        fan_out = dm * kh * kw
+        p = {"W": self._winit(key, (self.n_in * dm, 1, kh, kw), fan_in, fan_out,
+                              dtype)}
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_in * dm,), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        ph, pw = _pad_config(x.shape[2], x.shape[3], self.kernel_size,
+                             self.stride, self.padding, self.convolution_mode,
+                             self.dilation)
+        z = lax.conv_general_dilated(
+            x, params["W"], window_strides=self.stride, padding=(ph, pw),
+            rhs_dilation=self.dilation, feature_group_count=self.n_in,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if self.has_bias:
+            z = z + params["b"][None, :, None, None]
+        return self._act(z), state, mask
+
+
+@register_layer
+@dataclass
+class SeparableConvolution2D(ConvolutionLayer):
+    """Depthwise-separable conv (ref nn/conf/layers/SeparableConvolution2D.java):
+    depthwise spatial conv + 1x1 pointwise mix."""
+    depth_multiplier: int = 1
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kh, kw = self.kernel_size
+        dm = self.depth_multiplier
+        kd, kp = jax.random.split(key)
+        p = {
+            "W": self._winit(kd, (self.n_in * dm, 1, kh, kw), kh * kw,
+                             dm * kh * kw, dtype),  # depthwise
+            "w_point": self._winit(kp, (self.n_out, self.n_in * dm, 1, 1),
+                                   self.n_in * dm, self.n_out, dtype),
+        }
+        if self.has_bias:
+            p["b"] = jnp.full((self.n_out,), self.bias_init, dtype)
+        return p
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        ph, pw = _pad_config(x.shape[2], x.shape[3], self.kernel_size,
+                             self.stride, self.padding, self.convolution_mode,
+                             self.dilation)
+        z = lax.conv_general_dilated(
+            x, params["W"], window_strides=self.stride, padding=(ph, pw),
+            rhs_dilation=self.dilation, feature_group_count=self.n_in,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        z = lax.conv_general_dilated(
+            z, params["w_point"], window_strides=(1, 1), padding=((0, 0), (0, 0)),
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        if self.has_bias:
+            z = z + params["b"][None, :, None, None]
+        return self._act(z), state, mask

@@ -25,7 +25,8 @@ from jax import lax
 from deeplearning4j_tpu.common.enums import Activation, LossFunction
 from deeplearning4j_tpu.nn.activations import apply_activation
 from deeplearning4j_tpu.nn.conf.input_type import InputType
-from deeplearning4j_tpu.nn.conf.layers.base import FeedForwardLayerConf, register_layer
+from deeplearning4j_tpu.nn.conf.layers.base import (
+    BaseLayerConf, FeedForwardLayerConf, register_layer)
 from deeplearning4j_tpu.nn.losses import compute_loss
 
 
@@ -206,3 +207,152 @@ class RnnOutputLayer(FeedForwardLayerConf):
         l2 = jnp.moveaxis(labels, 1, 2).reshape(-1, self.n_out)
         m2 = None if mask is None else mask.reshape(-1)
         return compute_loss(self.loss_fn, l2, z2, self.activation, m2)
+
+
+@register_layer
+@dataclass
+class SimpleRnn(FeedForwardLayerConf):
+    """Vanilla RNN: h_t = act(x_t W + h_{t-1} RW + b)
+    (ref nn/conf/layers/recurrent/SimpleRnn.java). Input projection batched over
+    all timesteps up front, recurrence as one lax.scan — same TPU shape as LSTM."""
+    activation: Activation = Activation.TANH
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(self.n_out,
+                                   getattr(input_type, "timeseries_length", -1))
+
+    def set_n_in(self, input_type, override=False):
+        if self.n_in == 0 or override:
+            self.n_in = input_type.size
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kw, kr = jax.random.split(key)
+        return {
+            "W": self._winit(kw, (self.n_in, self.n_out), self.n_in, self.n_out,
+                             dtype),
+            "RW": self._winit(kr, (self.n_out, self.n_out), self.n_out,
+                              self.n_out, dtype),
+            "b": jnp.full((self.n_out,), self.bias_init, dtype),
+        }
+
+    def _scan(self, params, x, mask, h0=None, reverse=False):
+        b = x.shape[0]
+        dtype = x.dtype
+        h = jnp.zeros((b, self.n_out), dtype) if h0 is None else h0
+        xt = jnp.moveaxis(x, 2, 0)
+        xw = xt @ params["W"] + params["b"]
+        mt = None if mask is None else \
+            jnp.moveaxis(mask, 1, 0)[..., None].astype(dtype)
+
+        def body(h, inp):
+            if mask is None:
+                h_new = apply_activation(self.activation,
+                                         inp + h @ params["RW"])
+                return h_new, h_new
+            xw_t, m = inp
+            h_new = apply_activation(self.activation, xw_t + h @ params["RW"])
+            h_keep = m * h_new + (1 - m) * h
+            return h_keep, m * h_new
+
+        xs = xw if mask is None else (xw, mt)
+        h, ys = lax.scan(body, h, xs, reverse=reverse)
+        return jnp.moveaxis(ys, 0, 2), h
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        out, _ = self._scan(params, x, mask)
+        return out, state, mask
+
+
+@register_layer
+@dataclass
+class Bidirectional(BaseLayerConf):
+    """Bidirectional wrapper around any recurrent layer
+    (ref nn/conf/layers/recurrent/Bidirectional.java). Modes: CONCAT (default),
+    ADD, MUL, AVERAGE — applied to the forward and time-reversed passes."""
+    fwd: Optional[FeedForwardLayerConf] = None  # the wrapped RNN layer conf
+    mode: str = "concat"
+
+    def __post_init__(self):
+        from deeplearning4j_tpu.nn.conf.layers.base import BaseLayerConf as _B
+        if isinstance(self.fwd, dict):
+            self.fwd = _B.from_dict(self.fwd)
+
+    @property
+    def n_out(self):
+        base = self.fwd.n_out
+        return 2 * base if self.mode == "concat" else base
+
+    def set_n_in(self, input_type, override=False):
+        self.fwd.set_n_in(input_type, override)
+
+    def get_output_type(self, input_type):
+        base = self.fwd.get_output_type(input_type)
+        if self.mode == "concat":
+            return InputType.recurrent(
+                base.size * 2, getattr(base, "timeseries_length", -1))
+        return base
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kf, kb = jax.random.split(key)
+        f = self.fwd.init_params(kf, input_type, dtype)
+        b = self.fwd.init_params(kb, input_type, dtype)
+        p = {f"{k}_f": v for k, v in f.items()}
+        p.update({f"{k}_b": v for k, v in b.items()})
+        return p
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        pf = {k[:-2]: v for k, v in params.items() if k.endswith("_f")}
+        pb = {k[:-2]: v for k, v in params.items() if k.endswith("_b")}
+        out_f, _ = self.fwd._scan(pf, x, mask)
+        out_b, _ = self.fwd._scan(pb, x, mask, reverse=True)
+        if self.mode == "concat":
+            out = jnp.concatenate([out_f, out_b], axis=1)
+        elif self.mode == "add":
+            out = out_f + out_b
+        elif self.mode == "mul":
+            out = out_f * out_b
+        elif self.mode == "average":
+            out = 0.5 * (out_f + out_b)
+        else:
+            raise ValueError(f"unknown Bidirectional mode {self.mode!r}")
+        return out, state, mask
+
+
+@register_layer
+@dataclass
+class LastTimeStep(BaseLayerConf):
+    """Wrapper returning only the last (unmasked) timestep of the wrapped RNN
+    layer's output as feed-forward activations
+    (ref nn/conf/layers/recurrent/LastTimeStep.java)."""
+    underlying: Optional[FeedForwardLayerConf] = None
+
+    def __post_init__(self):
+        from deeplearning4j_tpu.nn.conf.layers.base import BaseLayerConf as _B
+        if isinstance(self.underlying, dict):
+            self.underlying = _B.from_dict(self.underlying)
+
+    @property
+    def n_out(self):
+        return self.underlying.n_out
+
+    def set_n_in(self, input_type, override=False):
+        self.underlying.set_n_in(input_type, override)
+
+    def get_output_type(self, input_type):
+        base = self.underlying.get_output_type(input_type)
+        return InputType.feed_forward(base.size)
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        return self.underlying.init_params(key, input_type, dtype)
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        out, ns, out_mask = self.underlying.forward(
+            params, state, x, train=train, rng=rng, mask=mask)
+        if out_mask is None:
+            last = out[:, :, -1]
+        else:
+            idx = jnp.maximum(
+                jnp.sum(out_mask.astype(jnp.int32), axis=1) - 1, 0)  # (batch,)
+            last = jnp.take_along_axis(
+                out, idx[:, None, None], axis=2)[:, :, 0]
+        return last, ns, None  # pure selection — underlying already activated

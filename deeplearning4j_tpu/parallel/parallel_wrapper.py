@@ -335,12 +335,16 @@ class ParallelWrapper:
 
     def _write_back(self):
         """Copy replica-0 state back into the wrapped model (replicas are identical
-        after sync in both modes when averaging_frequency divides the step count)."""
+        after sync in both modes when averaging_frequency divides the step count).
+        ONE jitted extraction for all trees — per-leaf indexing would pay a tunnel
+        round-trip per parameter on remote-TPU setups."""
         net = self.model
         params_repl, opt_repl, states_repl, _, step = self._carry
-        net.params_tree = jax.tree_util.tree_map(lambda a: a[0], params_repl)
-        net._opt_state = jax.tree_util.tree_map(lambda a: a[0], opt_repl)
-        net.state_tree = jax.tree_util.tree_map(lambda a: a[0], states_repl)
+        if getattr(self, "_writeback_jit", None) is None:
+            self._writeback_jit = jax.jit(
+                lambda trees: jax.tree_util.tree_map(lambda a: a[0], trees))
+        net.params_tree, net._opt_state, net.state_tree = self._writeback_jit(
+            (params_repl, opt_repl, states_repl))
         net._step = self._host_step
 
     def score(self):

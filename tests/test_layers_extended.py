@@ -1,0 +1,183 @@
+"""Extended layer family gradient checks: upsampling/space-to-depth/cropping/
+deconv/depthwise/separable CNN layers + SimpleRnn/Bidirectional/LastTimeStep.
+
+Parity: ref CNNGradientCheckTest (Upsampling/Deconvolution/Depthwise/Separable/
+Cropping cases) and GradientCheckTestsRnn (SimpleRnn/Bidirectional variants)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    Activation, Bidirectional, ConvolutionLayer, Cropping2D, Deconvolution2D,
+    DenseLayer, DepthwiseConvolutionLayer, InputType, LastTimeStep, LSTM,
+    MultiLayerNetwork, NeuralNetConfiguration, OutputLayer, RnnOutputLayer,
+    SeparableConvolution2D, Sgd, SimpleRnn, SpaceToDepthLayer, Upsampling2D,
+    WeightInit)
+from deeplearning4j_tpu.gradientcheck import check_gradients
+
+RNG = np.random.RandomState(99)
+
+
+def build(layers, input_type):
+    b = (NeuralNetConfiguration.Builder().seed(99).weight_init(WeightInit.XAVIER)
+         .activation(Activation.TANH).updater(Sgd(learning_rate=0.1))
+         .dtype("float64").list())
+    for l in layers:
+        b.layer(l)
+    return MultiLayerNetwork(b.set_input_type(input_type).build()).init()
+
+
+def onehot(classes, n):
+    return np.eye(n)[classes]
+
+
+def cnn_data(n=3, c=2, h=8, w=8, classes=3):
+    return (RNG.rand(n, c, h, w),
+            onehot(RNG.randint(0, classes, n), classes))
+
+
+def test_upsampling_shapes_and_gradients():
+    net = build([ConvolutionLayer(n_out=3, kernel_size=(3, 3)),
+                 Upsampling2D(size=(2, 2)),
+                 OutputLayer(n_out=3, activation=Activation.SOFTMAX)],
+                InputType.convolutional(8, 8, 2))
+    x, y = cnn_data()
+    acts = net.feed_forward(x)
+    assert acts[2].shape == (3, 3, 12, 12)  # 6x6 conv out upsampled 2x
+    assert np.array_equal(np.asarray(acts[2])[:, :, ::2, ::2],
+                          np.asarray(acts[1]))
+    assert check_gradients(net, x, y, subset=150)
+
+
+def test_space_to_depth_gradients():
+    net = build([ConvolutionLayer(n_out=4, kernel_size=(3, 3)),
+                 SpaceToDepthLayer(block_size=2),
+                 OutputLayer(n_out=3, activation=Activation.SOFTMAX)],
+                InputType.convolutional(8, 8, 2))
+    x, y = cnn_data()
+    acts = net.feed_forward(x)
+    assert acts[2].shape == (3, 16, 3, 3)
+    assert check_gradients(net, x, y, subset=150)
+
+
+def test_cropping_gradients():
+    net = build([Cropping2D(crop=(1, 1, 2, 1)),
+                 ConvolutionLayer(n_out=3, kernel_size=(3, 3)),
+                 OutputLayer(n_out=3, activation=Activation.SOFTMAX)],
+                InputType.convolutional(8, 8, 2))
+    x, y = cnn_data()
+    acts = net.feed_forward(x)
+    assert acts[1].shape == (3, 2, 6, 5)
+    assert np.array_equal(np.asarray(acts[1]), x[:, :, 1:7, 2:7])
+    assert check_gradients(net, x, y, subset=150)
+
+
+def test_deconvolution_gradients():
+    net = build([Deconvolution2D(n_out=3, kernel_size=(2, 2), stride=(2, 2)),
+                 OutputLayer(n_out=3, activation=Activation.SOFTMAX)],
+                InputType.convolutional(4, 4, 2))
+    x = RNG.rand(3, 2, 4, 4)
+    y = onehot(RNG.randint(0, 3, 3), 3)
+    acts = net.feed_forward(x)
+    assert acts[1].shape == (3, 3, 8, 8)  # stride-2 transpose doubles space
+    assert check_gradients(net, x, y, subset=150)
+
+
+def test_depthwise_conv_gradients():
+    net = build([DepthwiseConvolutionLayer(kernel_size=(3, 3),
+                                           depth_multiplier=2),
+                 OutputLayer(n_out=3, activation=Activation.SOFTMAX)],
+                InputType.convolutional(6, 6, 2))
+    x = RNG.rand(3, 2, 6, 6)
+    y = onehot(RNG.randint(0, 3, 3), 3)
+    acts = net.feed_forward(x)
+    assert acts[1].shape == (3, 4, 4, 4)  # 2 channels x multiplier 2
+    assert check_gradients(net, x, y, subset=150)
+
+
+def test_separable_conv_gradients():
+    net = build([SeparableConvolution2D(n_out=5, kernel_size=(3, 3)),
+                 OutputLayer(n_out=3, activation=Activation.SOFTMAX)],
+                InputType.convolutional(6, 6, 2))
+    x = RNG.rand(3, 2, 6, 6)
+    y = onehot(RNG.randint(0, 3, 3), 3)
+    acts = net.feed_forward(x)
+    assert acts[1].shape == (3, 5, 4, 4)
+    assert check_gradients(net, x, y, subset=150)
+
+
+def test_simple_rnn_gradients():
+    net = build([SimpleRnn(n_out=5),
+                 RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX)],
+                InputType.recurrent(3))
+    x = RNG.rand(4, 3, 6)
+    y = np.eye(2)[RNG.randint(0, 2, (4, 6))].transpose(0, 2, 1)
+    assert check_gradients(net, x, y)
+
+
+def test_simple_rnn_masked_gradients():
+    net = build([SimpleRnn(n_out=4),
+                 RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX)],
+                InputType.recurrent(3))
+    x = RNG.rand(3, 3, 5)
+    y = np.eye(2)[RNG.randint(0, 2, (3, 5))].transpose(0, 2, 1)
+    fmask = np.asarray([[1, 1, 1, 1, 1], [1, 1, 1, 0, 0], [1, 1, 0, 0, 0]],
+                       np.float64)
+    assert check_gradients(net, x, y, fmask=fmask, lmask=fmask)
+
+
+@pytest.mark.parametrize("mode", ["concat", "add", "average", "mul"])
+def test_bidirectional_modes(mode):
+    net = build([Bidirectional(fwd=LSTM(n_out=4), mode=mode),
+                 RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX)],
+                InputType.recurrent(3))
+    x = RNG.rand(3, 3, 5)
+    y = np.eye(2)[RNG.randint(0, 2, (3, 5))].transpose(0, 2, 1)
+    acts = net.feed_forward(x)
+    assert acts[1].shape == (3, 8 if mode == "concat" else 4, 5)
+    assert check_gradients(net, x, y, subset=200)
+
+
+def test_bidirectional_simple_rnn():
+    net = build([Bidirectional(fwd=SimpleRnn(n_out=4), mode="concat"),
+                 RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX)],
+                InputType.recurrent(3))
+    x = RNG.rand(3, 3, 5)
+    y = np.eye(2)[RNG.randint(0, 2, (3, 5))].transpose(0, 2, 1)
+    assert check_gradients(net, x, y)
+
+
+def test_last_time_step_gradients_and_masking():
+    net = build([LastTimeStep(underlying=LSTM(n_out=5)),
+                 OutputLayer(n_out=2, activation=Activation.SOFTMAX)],
+                InputType.recurrent(3))
+    x = RNG.rand(3, 3, 6)
+    y = onehot(RNG.randint(0, 2, 3), 2)
+    acts = net.feed_forward(x)
+    assert acts[1].shape == (3, 5)  # FF output
+    assert check_gradients(net, x, y)
+    # with a mask, the LAST UNMASKED step is selected
+    fmask = np.asarray([[1, 1, 1, 1, 1, 1], [1, 1, 1, 1, 0, 0],
+                        [1, 1, 0, 0, 0, 0]], np.float64)
+    lstm = net.layers[0].underlying
+    full, _ = lstm._scan(net.params_tree[0], np.asarray(x),
+                         np.asarray(fmask))
+    out, _, _ = net.layers[0].forward(net.params_tree[0], {}, np.asarray(x),
+                                      train=False, mask=np.asarray(fmask))
+    assert np.allclose(np.asarray(out[1]), np.asarray(full[1, :, 3]))
+    assert np.allclose(np.asarray(out[2]), np.asarray(full[2, :, 1]))
+
+
+def test_serde_round_trip_wrappers():
+    from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+    b = (NeuralNetConfiguration.Builder().seed(1).dtype("float64")
+         .updater(Sgd(learning_rate=0.1)).list())
+    b.layer(Bidirectional(fwd=SimpleRnn(n_in=3, n_out=4), mode="add"))
+    b.layer(RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX))
+    conf = b.set_input_type(InputType.recurrent(3)).build()
+    conf2 = MultiLayerConfiguration.from_json(conf.to_json())
+    bi = conf2.layers[0]
+    assert isinstance(bi, Bidirectional) and isinstance(bi.fwd, SimpleRnn)
+    assert bi.mode == "add" and bi.fwd.n_out == 4
+    n1 = MultiLayerNetwork(conf).init()
+    n2 = MultiLayerNetwork(conf2).init()
+    assert np.allclose(np.asarray(n1.params()), np.asarray(n2.params()))
