@@ -51,9 +51,21 @@ def load_mnist(train: bool = True, num_examples: Optional[int] = None, seed: int
                  else ["t10k-labels-idx1-ubyte", "t10k-labels.idx1-ubyte"])
     ip, lp = _find_idx(base, img_names), _find_idx(base, lbl_names)
     if ip is not None and lp is not None:
-        imgs = _read_idx(ip).astype(np.float32) / 255.0
-        labels = _read_idx(lp).astype(np.int64)
-        imgs = imgs.reshape(imgs.shape[0], -1)
+        imgs = labels = None
+        if ip.suffix != ".gz" and lp.suffix != ".gz":
+            try:  # native C++ codec fast path (native/dl4jtpu_io.cpp)
+                from deeplearning4j_tpu.native import (native_available,
+                                                       read_idx_native)
+                if native_available():
+                    imgs = read_idx_native(str(ip), normalize=True)
+                    labels = read_idx_native(
+                        str(lp), normalize=False).reshape(-1).astype(np.int64)
+            except Exception:
+                imgs = labels = None
+        if imgs is None:
+            imgs = _read_idx(ip).astype(np.float32) / 255.0
+            imgs = imgs.reshape(imgs.shape[0], -1)
+            labels = _read_idx(lp).astype(np.int64)
     else:
         n = num_examples or (8192 if train else 2048)
         imgs, labels = _synthetic_digits(n, seed if train else seed + 1)

@@ -1,0 +1,13 @@
+"""Native runtime bindings (ctypes over native/libdl4jtpu_io.so).
+
+Parity: the reference's native data-path (DataVec JavaCPP loaders, libnd4j
+codecs) — see native/dl4jtpu_io.cpp. Auto-builds with `make -C native` on first
+use when a compiler is present; everything gracefully falls back to the pure
+Python readers when the library is unavailable.
+"""
+from deeplearning4j_tpu.native.io import (
+    NativeBatchPrefetcher, native_available, read_cifar_native,
+    read_idx_native)
+
+__all__ = ["native_available", "read_idx_native", "read_cifar_native",
+           "NativeBatchPrefetcher"]

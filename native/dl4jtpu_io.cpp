@@ -1,0 +1,208 @@
+// Native IO/ETL runtime for deeplearning4j_tpu.
+//
+// Parity: the reference keeps its data-loading hot paths native (DataVec's
+// JavaCPP/OpenCV image pipeline, libnd4j buffer codecs); this library is the
+// TPU-framework equivalent: IDX (MNIST/EMNIST) and CIFAR-10 binary decoding
+// into ready-to-device float32 buffers, plus a multi-threaded prefetching
+// batch pipeline (the AsyncDataSetIterator's decode stage, off the GIL).
+//
+// C ABI only — consumed from Python via ctypes (no pybind11 in this image).
+#include <algorithm>
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <queue>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------- IDX codec
+// Returns 0 on success. Caller owns out buffers (sized via dl4j_idx_info).
+// IDX format: [0,0,dtype,ndim][dims:4B big-endian each][payload]
+int dl4j_idx_info(const char* path, int64_t* n_items, int64_t* item_size) {
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    unsigned char hdr[4];
+    if (fread(hdr, 1, 4, f) != 4 || hdr[0] != 0 || hdr[1] != 0) {
+        fclose(f);
+        return -2;
+    }
+    int ndim = hdr[3];
+    if (ndim < 1 || ndim > 8) { fclose(f); return -6; }
+    int64_t dims[8] = {0};
+    for (int i = 0; i < ndim; i++) {
+        unsigned char b[4];
+        if (fread(b, 1, 4, f) != 4) { fclose(f); return -3; }
+        dims[i] = ((int64_t)b[0] << 24) | (b[1] << 16) | (b[2] << 8) | b[3];
+    }
+    fclose(f);
+    *n_items = dims[0];
+    int64_t sz = 1;
+    for (int i = 1; i < ndim; i++) sz *= dims[i];
+    *item_size = sz;
+    return 0;
+}
+
+// Decode u8 payload to float32 in [0,1] (scale=1/255) or raw labels (scale=0
+// means "copy as float without scaling").
+int dl4j_idx_read_f32(const char* path, float* out, int64_t capacity,
+                      int normalize) {
+    int64_t n, isz;
+    int rc = dl4j_idx_info(path, &n, &isz);
+    if (rc != 0) return rc;
+    int64_t total = n * isz;
+    if (total > capacity) return -4;
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    unsigned char hdr[4];
+    if (fread(hdr, 1, 4, f) != 4) { fclose(f); return -2; }
+    fseek(f, 4 + 4 * hdr[3], SEEK_SET);
+    std::vector<unsigned char> buf(1 << 20);
+    int64_t done = 0;
+    const float scale = normalize ? (1.0f / 255.0f) : 1.0f;
+    while (done < total) {
+        size_t want = (size_t)std::min<int64_t>(buf.size(), total - done);
+        size_t got = fread(buf.data(), 1, want, f);
+        if (got == 0) { fclose(f); return -5; }
+        for (size_t i = 0; i < got; i++) out[done + i] = buf[i] * scale;
+        done += (int64_t)got;
+    }
+    fclose(f);
+    return 0;
+}
+
+// -------------------------------------------------------------- CIFAR codec
+// CIFAR-10 binary batches: records of [label u8][3072 u8 pixels].
+// Fills x (n*3072 float32, /255) and y (n int32). Returns record count or <0.
+int64_t dl4j_cifar_read(const char* path, float* x, int32_t* y,
+                        int64_t max_records) {
+    const int64_t REC = 1 + 3072;
+    FILE* f = fopen(path, "rb");
+    if (!f) return -1;
+    std::vector<unsigned char> rec(REC);
+    int64_t n = 0;
+    while (n < max_records && fread(rec.data(), 1, REC, f) == (size_t)REC) {
+        y[n] = rec[0];
+        float* dst = x + n * 3072;
+        for (int i = 0; i < 3072; i++) dst[i] = rec[1 + i] * (1.0f / 255.0f);
+        n++;
+    }
+    fclose(f);
+    return n;
+}
+
+// -------------------------------------------------- threaded batch prefetcher
+// Decodes+assembles shuffled minibatches from a (features, labels) pool on
+// worker threads; Python pops ready batches without holding the GIL during
+// assembly. Mirrors AsyncDataSetIterator's queue semantics (bounded, ordered).
+struct Prefetcher {
+    const float* x;            // (n, feat) borrowed from Python
+    const float* y;            // (n, lab)
+    int64_t n, feat, lab, batch;
+    std::vector<int64_t> order;
+    std::atomic<int64_t> next_batch{0};
+    int64_t n_batches;
+    std::queue<std::pair<int64_t, std::vector<float>>> ready;  // (batch_idx, data)
+    std::mutex mu;
+    std::condition_variable cv_ready, cv_space;
+    size_t max_queue;
+    std::vector<std::thread> workers;
+    std::atomic<bool> stop{false};
+    int64_t pop_cursor = 0;
+
+    void worker() {
+        for (;;) {
+            int64_t b = next_batch.fetch_add(1);
+            if (b >= n_batches || stop.load()) return;
+            int64_t lo = b * batch;
+            int64_t hi = std::min(n, lo + batch);
+            std::vector<float> out((hi - lo) * (feat + lab));
+            for (int64_t r = lo; r < hi; r++) {
+                int64_t src = order[r];
+                std::memcpy(&out[(r - lo) * feat], x + src * feat,
+                            feat * sizeof(float));
+                std::memcpy(&out[(hi - lo) * feat + (r - lo) * lab],
+                            y + src * lab, lab * sizeof(float));
+            }
+            std::unique_lock<std::mutex> lk(mu);
+            cv_space.wait(lk, [&] {
+                return ready.size() < max_queue || stop.load();
+            });
+            if (stop.load()) return;
+            ready.emplace(b, std::move(out));
+            cv_ready.notify_all();
+        }
+    }
+};
+
+void* dl4j_prefetcher_create(const float* x, const float* y, int64_t n,
+                             int64_t feat, int64_t lab, int64_t batch,
+                             int64_t seed, int threads, int shuffle) {
+    auto* p = new Prefetcher();
+    p->x = x; p->y = y; p->n = n; p->feat = feat; p->lab = lab;
+    p->batch = batch;
+    p->n_batches = (n + batch - 1) / batch;
+    p->max_queue = 4;
+    p->order.resize(n);
+    for (int64_t i = 0; i < n; i++) p->order[i] = i;
+    if (shuffle) {  // xorshift64 Fisher-Yates, deterministic under seed
+        uint64_t s = (uint64_t)seed | 1;
+        for (int64_t i = n - 1; i > 0; i--) {
+            s ^= s << 13; s ^= s >> 7; s ^= s << 17;
+            int64_t j = (int64_t)(s % (uint64_t)(i + 1));
+            std::swap(p->order[i], p->order[j]);
+        }
+    }
+    for (int t = 0; t < threads; t++)
+        p->workers.emplace_back(&Prefetcher::worker, p);
+    return p;
+}
+
+// Pops the NEXT batch in order; blocks until ready. Returns rows in batch,
+// 0 when exhausted. out must hold batch*(feat+lab) floats: features first.
+int64_t dl4j_prefetcher_next(void* handle, float* out) {
+    auto* p = (Prefetcher*)handle;
+    if (p->pop_cursor >= p->n_batches) return 0;
+    std::vector<float> data;
+    int64_t want = p->pop_cursor;
+    {
+        std::unique_lock<std::mutex> lk(p->mu);
+        for (;;) {
+            if (!p->ready.empty() && p->ready.front().first == want) {
+                data = std::move(p->ready.front().second);
+                p->ready.pop();
+                p->cv_space.notify_all();
+                break;
+            }
+            // out-of-order batch at the head: rotate it to the back
+            if (!p->ready.empty() && p->ready.front().first != want) {
+                auto item = std::move(p->ready.front());
+                p->ready.pop();
+                p->ready.push(std::move(item));
+                continue;
+            }
+            p->cv_ready.wait_for(lk, std::chrono::milliseconds(50));
+        }
+    }
+    p->pop_cursor++;
+    std::memcpy(out, data.data(), data.size() * sizeof(float));
+    int64_t lo = want * p->batch;
+    return std::min(p->n, lo + p->batch) - lo;
+}
+
+void dl4j_prefetcher_destroy(void* handle) {
+    auto* p = (Prefetcher*)handle;
+    p->stop.store(true);
+    p->cv_space.notify_all();
+    p->cv_ready.notify_all();
+    for (auto& t : p->workers) t.join();
+    delete p;
+}
+
+}  // extern "C"
