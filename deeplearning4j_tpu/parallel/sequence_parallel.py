@@ -1,0 +1,150 @@
+"""Sequence/context parallelism: ring attention over the device mesh.
+
+The reference (0.9.1-era) has no attention ops — its long-context story is tBPTT
+segmentation (implemented in nn/multilayer.py). This module is the framework's
+forward-looking long-context primitive, required for parity-of-scale: attention
+over sequences longer than one chip's HBM, sharded over a 'seq' mesh axis.
+
+Design (the scaling-book / Ring Attention recipe, arXiv:2310.01889):
+- q, k, v are sharded over the sequence axis: each device holds its q block
+  permanently, and k/v blocks ROTATE around the ring via `lax.ppermute` (ICI
+  neighbor exchange, bandwidth-optimal, overlapping compute with transfer).
+- Each step computes blockwise attention against the resident k/v block and
+  folds it into an online-softmax accumulator (running max + normalizer), so
+  the full S x S score matrix never materializes — flash-attention's recurrence
+  across devices.
+- Causal masking is handled per block pair from the ring offset: fully-visible
+  blocks skip the elementwise mask entirely.
+
+`ring_attention` is the shard_map collective form; `attention_reference` is the
+single-device oracle used by tests and small models.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+NEG_INF = -1e30
+
+
+def attention_reference(q, k, v, causal: bool = False, scale: Optional[float] = None):
+    """Plain softmax attention oracle. q/k/v: (batch, heads, seq, dim)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / np.sqrt(d)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        s = q.shape[2]
+        mask = jnp.tril(jnp.ones((s, s), bool))
+        scores = jnp.where(mask, scores, NEG_INF)
+    return jnp.einsum("bhqk,bhkv->bhqv", jax.nn.softmax(scores, axis=-1), v)
+
+
+def _block_attn(q, k, v, scale, mask=None):
+    """One q-block x k-block contribution: returns (unnormalized out, row max,
+    row normalizer) for online-softmax accumulation."""
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask, scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)                      # (b,h,q)
+    p = jnp.exp(scores - m[..., None])
+    if mask is not None:  # rows with no visible keys: exp(NEG_INF - NEG_INF)=1 junk
+        p = jnp.where(mask, p, 0.0)
+    l = jnp.sum(p, axis=-1)                           # (b,h,q)
+    o = jnp.einsum("bhqk,bhkv->bhqv", p, v)
+    return o, m, l
+
+
+def _merge(acc, o, m, l):
+    """Fold a block contribution into the online-softmax accumulator."""
+    acc_o, acc_m, acc_l = acc
+    new_m = jnp.maximum(acc_m, m)
+    a = jnp.exp(acc_m - new_m)[..., None]
+    b = jnp.exp(m - new_m)[..., None]
+    return (acc_o * a + o * b,
+            new_m,
+            acc_l * a[..., 0] + l * b[..., 0])
+
+
+def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
+                   causal: bool = False, scale: Optional[float] = None):
+    """Attention with q/k/v sequence-sharded over `axis`; k/v ride the ring.
+
+    q/k/v: (batch, heads, seq, dim) GLOBAL arrays (sharded or to-be-sharded on
+    the seq axis). Returns output with the same sharding. Communication is N-1
+    `ppermute` neighbor hops over ICI, compute overlaps transfers under XLA's
+    async collectives.
+    """
+    d = q.shape[-1]
+    scale_ = scale if scale is not None else 1.0 / np.sqrt(d)
+    n_dev = mesh.shape[axis]
+    seq = q.shape[2]
+    assert seq % n_dev == 0, f"seq {seq} not divisible by mesh axis {n_dev}"
+    blk = seq // n_dev
+
+    def local(q_blk, k_blk, v_blk):
+        # q_blk etc: (b, h, blk, d) — this device's shard
+        my = lax.axis_index(axis)
+
+        def causal_mask(kv_owner):
+            # global row ids of my q block vs col ids of the visiting k block
+            qi = my * blk + jnp.arange(blk)
+            ki = kv_owner * blk + jnp.arange(blk)
+            return (qi[:, None] >= ki[None, :])[None, None]  # (1,1,blk,blk)
+
+        def step(carry, r):
+            acc, kb, vb = carry
+            owner = (my - r) % n_dev  # whose k/v block is resident this round
+            if causal:
+                # blocks fully in the future are masked out entirely; fully
+                # visible blocks skip the mask. Done with where-on-scores since
+                # owner is traced: build the mask every step (blk x blk only).
+                mask = causal_mask(owner)
+                o, m_, l_ = _block_attn(q_blk, kb, vb, scale_, mask)
+            else:
+                o, m_, l_ = _block_attn(q_blk, kb, vb, scale_)
+            acc = _merge(acc, o, m_, l_)
+            # rotate k/v to the next device on the ring (neighbor exchange)
+            perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+            kb = lax.ppermute(kb, axis, perm)
+            vb = lax.ppermute(vb, axis, perm)
+            return (acc, kb, vb), None
+
+        b, h = q_blk.shape[0], q_blk.shape[1]
+        acc0 = (jnp.zeros_like(q_blk),
+                jnp.full((b, h, blk), NEG_INF, q_blk.dtype),
+                jnp.zeros((b, h, blk), q_blk.dtype))
+        (acc, _, _), _ = lax.scan(step, (acc0, k_blk, v_blk),
+                                  jnp.arange(n_dev))
+        out, m_, l_ = acc
+        return out / jnp.maximum(l_, 1e-30)[..., None]
+
+    spec = P(None, None, axis, None)
+    shmapped = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
+                             out_specs=spec, check_vma=False)
+    return shmapped(q, k, v)
+
+
+class SequenceParallelAttention:
+    """User-facing wrapper: places inputs on the seq-sharded mesh and runs
+    ring attention — the framework's long-context building block."""
+
+    def __init__(self, mesh: Optional[Mesh] = None, axis: str = "seq",
+                 causal: bool = False):
+        if mesh is None:
+            mesh = Mesh(np.asarray(jax.devices()), (axis,))
+        self.mesh = mesh
+        self.axis = axis
+        self.causal = causal
+        self._jit = jax.jit(functools.partial(
+            ring_attention, mesh=self.mesh, axis=self.axis, causal=self.causal))
+
+    def __call__(self, q, k, v):
+        sh = NamedSharding(self.mesh, P(None, None, self.axis, None))
+        q, k, v = (jax.device_put(jnp.asarray(a), sh) for a in (q, k, v))
+        return self._jit(q, k, v)

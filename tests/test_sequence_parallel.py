@@ -1,0 +1,65 @@
+"""Ring attention / sequence parallelism tests: exact parity with the
+single-device attention oracle on the 8-virtual-device CPU mesh, causal and
+non-causal, plus gradient flow through the collective."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.sequence_parallel import (
+    SequenceParallelAttention, attention_reference, ring_attention)
+
+RNG = np.random.RandomState(13)
+
+
+def qkv(b=2, h=3, s=32, d=8):
+    return (jnp.asarray(RNG.randn(b, h, s, d), jnp.float64),
+            jnp.asarray(RNG.randn(b, h, s, d), jnp.float64),
+            jnp.asarray(RNG.randn(b, h, s, d), jnp.float64))
+
+
+def mesh8():
+    return Mesh(np.asarray(jax.devices()[:8]), ("seq",))
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_matches_reference(causal):
+    q, k, v = qkv()
+    ref = attention_reference(q, k, v, causal=causal)
+    out = ring_attention(q, k, v, mesh8(), causal=causal)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-10)
+
+
+def test_ring_attention_long_sequence_many_blocks():
+    q, k, v = qkv(b=1, h=2, s=128, d=4)  # 16 steps around the ring
+    ref = attention_reference(q, k, v, causal=True)
+    out = ring_attention(q, k, v, mesh8(), causal=True)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-10)
+
+
+def test_ring_attention_gradients_match():
+    q, k, v = qkv(b=1, h=1, s=16, d=4)
+    mesh = mesh8()
+
+    def loss_ring(q, k, v):
+        return jnp.sum(ring_attention(q, k, v, mesh, causal=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(attention_reference(q, k, v, causal=True) ** 2)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(g_ring, g_ref):
+        assert np.allclose(np.asarray(a), np.asarray(b), atol=1e-9)
+
+
+def test_sequence_parallel_attention_wrapper():
+    spa = SequenceParallelAttention(mesh8(), causal=False)
+    q, k, v = qkv(s=64)
+    out = spa(q, k, v)
+    ref = attention_reference(q, k, v)
+    assert np.allclose(np.asarray(out), np.asarray(ref), atol=1e-10)
+    # output is sequence-sharded over the mesh
+    assert out.sharding.spec == P(None, None, "seq", None)
