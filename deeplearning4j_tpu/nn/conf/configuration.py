@@ -154,6 +154,18 @@ class MultiLayerConfiguration:
     def from_json(s: str) -> "MultiLayerConfiguration":
         return MultiLayerConfiguration.from_dict(json.loads(s))
 
+    def to_yaml(self) -> str:
+        """(ref MultiLayerConfiguration.toYaml)"""
+        import yaml
+        return yaml.safe_dump(json.loads(self.to_json()), sort_keys=False)
+
+    @staticmethod
+    def from_yaml(s: str) -> "MultiLayerConfiguration":
+        import yaml
+        return MultiLayerConfiguration.from_dict(yaml.safe_load(s))
+    toYaml = to_yaml
+    fromYaml = from_yaml
+
     def get_updater(self) -> BaseUpdater:
         if self.global_conf.updater is None:
             return Sgd()

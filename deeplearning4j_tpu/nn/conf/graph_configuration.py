@@ -140,6 +140,18 @@ class ComputationGraphConfiguration:
     def from_json(s: str) -> "ComputationGraphConfiguration":
         return ComputationGraphConfiguration.from_dict(json.loads(s))
 
+    def to_yaml(self) -> str:
+        """(ref ComputationGraphConfiguration.toYaml)"""
+        import yaml
+        return yaml.safe_dump(json.loads(self.to_json()), sort_keys=False)
+
+    @staticmethod
+    def from_yaml(s: str) -> "ComputationGraphConfiguration":
+        import yaml
+        return ComputationGraphConfiguration.from_dict(yaml.safe_load(s))
+    toYaml = to_yaml
+    fromYaml = from_yaml
+
     def get_updater(self):
         from deeplearning4j_tpu.nn.updater.updaters import BaseUpdater, Sgd
         if self.global_conf.updater is None:

@@ -1,0 +1,13 @@
+"""Streaming ingest (L10): push-based DataSet streams feeding training.
+
+Parity: ref deeplearning4j-streaming (Camel/Kafka routes turning records into
+INDArray batches consumed by training). TPU rendering: the broker-specific
+plumbing is out of scope in a zero-egress environment, but the SHAPE of the
+subsystem — a producer pushing batches into a bounded queue that training
+consumes as a DataSetIterator, with backpressure and end-of-stream — is here,
+transport-agnostic: any thread/socket/file-tail producer can publish.
+"""
+from deeplearning4j_tpu.streaming.stream import (
+    DataSetStreamPublisher, StreamingDataSetIterator)
+
+__all__ = ["StreamingDataSetIterator", "DataSetStreamPublisher"]

@@ -1,0 +1,85 @@
+"""Bounded publish/consume DataSet stream.
+
+Parity: ref deeplearning4j-streaming's Camel route -> DataSet conversion
+(e.g. Dl4jProcessor/KafkaConnectionInformation plumbing) reduced to its
+essential contract: producers publish (features, labels) batches with
+backpressure; training consumes them in order as a normal DataSetIterator;
+`end()` terminates the epoch cleanly.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import DataSetIterator
+
+_EOS = object()
+
+
+class DataSetStreamPublisher:
+    """Producer handle (the 'Kafka topic' analog): publish() blocks when the
+    consumer is behind by `capacity` batches (backpressure)."""
+
+    def __init__(self, capacity: int = 8):
+        self._q: "queue.Queue" = queue.Queue(maxsize=int(capacity))
+        self._closed = False
+
+    def publish(self, features, labels, features_mask=None, labels_mask=None,
+                timeout: Optional[float] = None) -> None:
+        if self._closed:
+            raise RuntimeError("stream already ended")
+        ds = DataSet(np.asarray(features), np.asarray(labels),
+                     features_mask, labels_mask)
+        self._q.put(ds, timeout=timeout)
+
+    def publish_dataset(self, ds: DataSet, timeout: Optional[float] = None):
+        self._q.put(ds, timeout=timeout)
+
+    def end(self) -> None:
+        """Signal end-of-stream; the consuming iterator finishes its epoch."""
+        self._closed = True
+        self._q.put(_EOS)
+
+
+class StreamingDataSetIterator(DataSetIterator):
+    """Consumer side — a DataSetIterator over a live stream.
+
+    `max_batches` bounds one epoch for finite training runs on infinite
+    streams (the EarlyTermination composition done inline, since a stream has
+    no reset)."""
+
+    def __init__(self, publisher: DataSetStreamPublisher,
+                 max_batches: Optional[int] = None,
+                 poll_timeout: Optional[float] = 30.0):
+        self._pub = publisher
+        self.max_batches = max_batches
+        self.poll_timeout = poll_timeout
+        self._done = False
+
+    # streams cannot rewind
+    async_supported = False
+
+    def reset(self):
+        pass
+
+    def __iter__(self) -> Iterator[DataSet]:
+        n = 0
+        while not self._done and (self.max_batches is None
+                                  or n < self.max_batches):
+            try:
+                item = self._pub._q.get(timeout=self.poll_timeout)
+            except queue.Empty:
+                raise TimeoutError(
+                    f"no batch arrived within {self.poll_timeout}s")
+            if item is _EOS:
+                self._done = True
+                break
+            n += 1
+            yield item
+
+    def batch(self):
+        return 0  # stream-determined

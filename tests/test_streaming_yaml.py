@@ -1,0 +1,107 @@
+"""Streaming ingest + YAML config serde tests."""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    Activation, DenseLayer, InputType, MultiLayerNetwork,
+    NeuralNetConfiguration, OutputLayer, Sgd, WeightInit)
+from deeplearning4j_tpu.streaming import (
+    DataSetStreamPublisher, StreamingDataSetIterator)
+
+RNG = np.random.RandomState(21)
+
+
+def small_net():
+    b = (NeuralNetConfiguration.Builder().seed(1).weight_init(WeightInit.XAVIER)
+         .activation(Activation.TANH).updater(Sgd(learning_rate=0.1))
+         .dtype("float64").list())
+    b.layer(DenseLayer(n_out=6))
+    b.layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX))
+    return MultiLayerNetwork(
+        b.set_input_type(InputType.feed_forward(4)).build()).init()
+
+
+def test_stream_trains_network():
+    pub = DataSetStreamPublisher(capacity=4)
+    x = RNG.rand(16, 4)
+    y = np.eye(3)[RNG.randint(0, 3, 16)]
+
+    def producer():
+        for _ in range(10):
+            pub.publish(x, y)
+        pub.end()
+
+    t = threading.Thread(target=producer)
+    t.start()
+    net = small_net()
+    it = StreamingDataSetIterator(pub)
+    first = None
+    net.fit(it)
+    t.join()
+    assert np.isfinite(net.score())
+    assert net._step == 10  # consumed exactly the published batches
+
+
+def test_stream_backpressure_and_max_batches():
+    pub = DataSetStreamPublisher(capacity=2)
+    published = []
+
+    def producer():
+        for i in range(50):
+            pub.publish(np.full((2, 4), i, float), np.eye(3)[[0, 1]])
+            published.append(i)
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    # producer is blocked by backpressure well short of 50
+    assert len(published) <= 4
+    it = StreamingDataSetIterator(pub, max_batches=5)
+    batches = list(it)
+    assert len(batches) == 5
+    assert float(batches[0].features[0, 0]) == 0.0
+
+
+def test_stream_timeout():
+    pub = DataSetStreamPublisher()
+    it = StreamingDataSetIterator(pub, poll_timeout=0.1)
+    with pytest.raises(TimeoutError):
+        list(it)
+
+
+def test_yaml_round_trip():
+    from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+    net = small_net()
+    y = net.conf.to_yaml()
+    assert "DenseLayer" in y
+    conf2 = MultiLayerConfiguration.from_yaml(y)
+    n2 = MultiLayerNetwork(conf2).init()
+    assert np.allclose(np.asarray(net.params()), np.asarray(n2.params()))
+
+
+def test_yaml_round_trip_graph():
+    from deeplearning4j_tpu.models import LeNet
+    from deeplearning4j_tpu.nn.conf.graph_configuration import (
+        ComputationGraphConfiguration)
+    from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+    conf = LeNet(num_labels=10).graph_conf() if hasattr(LeNet, "graph_conf") \
+        else None
+    if conf is None:
+        # LeNet is an MLN model; use a tiny graph instead
+        from deeplearning4j_tpu import GraphBuilder
+        g = (NeuralNetConfiguration.Builder().seed(1).dtype("float64")
+             .updater(Sgd(learning_rate=0.1)).graph_builder())
+        (g.add_inputs("in")
+          .add_layer("d", DenseLayer(n_out=5), "in")
+          .add_layer("out", OutputLayer(n_out=2, activation=Activation.SOFTMAX),
+                     "d")
+          .set_outputs("out")
+          .set_input_types(InputType.feed_forward(3)))
+        conf = g.build()
+    conf2 = ComputationGraphConfiguration.from_yaml(conf.to_yaml())
+    n1 = ComputationGraph(conf).init()
+    n2 = ComputationGraph(conf2).init()
+    assert np.allclose(np.asarray(n1.params()), np.asarray(n2.params()))
