@@ -11,8 +11,11 @@ from deeplearning4j_tpu.distributed.conf import VoidConfiguration, initialize_cl
 from deeplearning4j_tpu.distributed.training_master import (
     DistributedComputationGraph, DistributedMultiLayer,
     ParameterAveragingTrainingMaster, SharedTrainingMaster)
+from deeplearning4j_tpu.distributed.param_server import (
+    ParameterServer, ParameterServerClient, ParameterServerTrainer)
 
 __all__ = [
     "VoidConfiguration", "initialize_cluster", "ParameterAveragingTrainingMaster",
     "SharedTrainingMaster", "DistributedMultiLayer", "DistributedComputationGraph",
+    "ParameterServer", "ParameterServerClient", "ParameterServerTrainer",
 ]

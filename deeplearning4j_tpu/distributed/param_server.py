@@ -1,0 +1,155 @@
+"""DP-5: external parameter server (async gradient sharing).
+
+Parity: ref nd4j-parameter-server / VoidParameterServer consumed by the Spark
+SharedTrainingMaster's async mode — a standalone server process owns the flat
+parameter vector; workers PUSH (threshold-encoded) updates and PULL fresh
+parameters asynchronously, tolerating staleness. TPU rendering: the server is a
+stdlib ThreadingHTTPServer moving raw float32 buffers (the control plane the
+reference runs over Aeron unicast); workers overlap their jitted compute with
+push/pull I/O. Synchronous in-graph collectives (DP-1..DP-4) remain the
+recommended path on TPU pods — this exists for parity with the reference's
+deployment shape and for elastic/heterogeneous workers off the mesh.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+
+class ParameterServer:
+    """The server process (ref VoidParameterServer in MSGD 'shards' role)."""
+
+    def __init__(self, initial_params: np.ndarray, port: int = 0,
+                 learning_rate: float = 1.0):
+        self._params = np.array(initial_params, np.float32, copy=True)
+        self._lock = threading.Lock()
+        self._updates_applied = 0
+        self.learning_rate = float(learning_rate)
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                if self.path == "/params":
+                    with server._lock:
+                        body = server._params.tobytes()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/octet-stream")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif self.path == "/stats":
+                    body = json.dumps({
+                        "num_params": int(server._params.size),
+                        "updates_applied": server._updates_applied}).encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                else:
+                    self.send_response(404)
+                    self.end_headers()
+
+            def do_POST(self):
+                if self.path != "/update":
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                n = int(self.headers["Content-Length"])
+                update = np.frombuffer(self.rfile.read(n), np.float32)
+                with server._lock:
+                    # workers send post-updater deltas; server applies them
+                    # scaled by its own rate (1.0 = apply as-is)
+                    server._params -= server.learning_rate * update
+                    server._updates_applied += 1
+                self.send_response(200)
+                self.send_header("Content-Length", "2")
+                self.end_headers()
+                self.wfile.write(b"ok")
+
+        self._httpd = ThreadingHTTPServer(("localhost", port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        return f"http://localhost:{self.port}"
+
+    def current_params(self) -> np.ndarray:
+        with self._lock:
+            return self._params.copy()
+
+    def updates_applied(self) -> int:
+        return self._updates_applied
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class ParameterServerClient:
+    """Worker-side connector (ref ParameterServerTrainer push/pull)."""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        self.address = address.rstrip("/")
+        self.timeout = timeout
+
+    def pull(self) -> np.ndarray:
+        with urllib.request.urlopen(self.address + "/params",
+                                    timeout=self.timeout) as r:
+            return np.frombuffer(r.read(), np.float32).copy()
+
+    def push(self, update: np.ndarray) -> None:
+        req = urllib.request.Request(
+            self.address + "/update",
+            data=np.ascontiguousarray(update, np.float32).tobytes(),
+            headers={"Content-Type": "application/octet-stream"})
+        with urllib.request.urlopen(req, timeout=self.timeout):
+            pass
+
+    def stats(self) -> dict:
+        with urllib.request.urlopen(self.address + "/stats",
+                                    timeout=self.timeout) as r:
+            return json.loads(r.read().decode())
+
+
+class ParameterServerTrainer:
+    """Async-SGD worker loop: pull params every `pull_frequency` steps, compute
+    the local (post-updater) update on device, push it — the reference's
+    SharedTrainingMaster async semantics with explicit staleness.
+
+    `net` supplies the jitted objective; updates are computed with the net's own
+    updaters so Adam/Nesterov state stays worker-local (ref: one updater per
+    trainer thread)."""
+
+    def __init__(self, net, client: ParameterServerClient,
+                 pull_frequency: int = 1):
+        self.net = net
+        self.client = client
+        self.pull_frequency = max(1, int(pull_frequency))
+        self._since_pull = 0
+
+    def fit_batch(self, x, y) -> float:
+        import jax.numpy as jnp
+        if self._since_pull % self.pull_frequency == 0:
+            self.net.set_params(jnp.asarray(self.client.pull()))
+        self._since_pull += 1
+        before = np.asarray(self.net.params(), np.float32)
+        self.net.fit_batch(x, y)
+        after = np.asarray(self.net.params(), np.float32)
+        # post-updater delta (what the reference's EncodingHandler encodes)
+        self.client.push(before - after)
+        return float(self.net.score())

@@ -101,3 +101,66 @@ def test_single_process_master_api():
     stats = tm.get_training_stats()
     assert len(stats) == 8 and stats[0]["event"] == "fit"
     assert net.getNetwork() is net.network
+
+
+def test_parameter_server_async_training():
+    """DP-5: two async workers train one model through an external parameter
+    server (ref VoidParameterServer async gradient sharing)."""
+    import threading
+
+    import numpy as np
+
+    from deeplearning4j_tpu import (
+        Activation, DenseLayer, InputType, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer, Sgd, WeightInit)
+    from deeplearning4j_tpu.distributed import (
+        ParameterServer, ParameterServerClient, ParameterServerTrainer)
+
+    def make_net():
+        b = (NeuralNetConfiguration.Builder().seed(7)
+             .weight_init(WeightInit.XAVIER).activation(Activation.TANH)
+             .updater(Sgd(learning_rate=0.1)).dtype("float64").list())
+        b.layer(DenseLayer(n_out=8))
+        b.layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX))
+        return MultiLayerNetwork(
+            b.set_input_type(InputType.feed_forward(5)).build()).init()
+
+    master = make_net()
+    server = ParameterServer(np.asarray(master.params(), np.float32))
+    try:
+        rng = np.random.RandomState(4)
+        x = rng.rand(32, 5)
+        y = np.eye(3)[(x @ rng.randn(5, 3)).argmax(1)]  # learnable labels
+
+        def initial_loss():
+            from deeplearning4j_tpu.datasets.dataset import DataSet
+            return master.score(DataSet(x, y))
+
+        loss0 = initial_loss()
+
+        def worker(seed):
+            net = make_net()
+            trainer = ParameterServerTrainer(
+                net, ParameterServerClient(server.address), pull_frequency=2)
+            w_rng = np.random.RandomState(seed)
+            for _ in range(15):
+                sel = w_rng.choice(32, 16, replace=False)
+                trainer.fit_batch(x[sel], y[sel])
+
+        threads = [threading.Thread(target=worker, args=(s,)) for s in (1, 2)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+
+        assert server.updates_applied() == 30
+        # pull final params into a fresh net: loss improved vs init
+        final = make_net()
+        final.set_params(server.current_params().astype(np.float64))
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        assert final.score(DataSet(x, y)) < loss0 * 0.8
+        stats = ParameterServerClient(server.address).stats()
+        assert stats["updates_applied"] == 30
+        assert stats["num_params"] == final.num_params()
+    finally:
+        server.stop()
