@@ -82,6 +82,10 @@ class GlobalConf:
     # bf16 mixed precision: layer compute in this dtype, params/updater state and
     # output-layer score stay in `dtype`. None = pure `dtype` (reference behavior).
     compute_dtype: Optional[str] = None
+    # gradient checkpointing: rematerialize per-layer activations in backward
+    # (jax.checkpoint around each hidden layer) — HBM for FLOPs, the workspace
+    # knob's TPU analog (ref WorkspaceMode controls activation memory reuse)
+    remat: bool = False
 
     def to_dict(self):
         d = dataclasses.asdict(self)
@@ -223,6 +227,11 @@ class NeuralNetConfiguration:
 
         def dtype(self, dt: str):
             self._global.dtype = dt
+            return self
+
+        def remat(self, b: bool = True):
+            """Enable per-layer gradient checkpointing (rematerialization)."""
+            self._global.remat = bool(b)
             return self
 
         def compute_dtype(self, dt: Optional[str]):

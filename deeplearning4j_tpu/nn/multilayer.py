@@ -281,8 +281,16 @@ class MultiLayerNetwork:
             else:
                 if isinstance(layer, LSTM):
                     final_rnn.append(None)
-                cur, ns, mask = layer.forward(params_tree[i], state_tree[i], cur,
-                                              train=train, rng=lrng, mask=mask)
+
+                def fwd(p, s, c, r, m, _layer=layer):
+                    return _layer.forward(p, s, c, train=train, rng=r, mask=m)
+
+                if self.conf.global_conf.remat:
+                    # gradient checkpointing: drop this layer's activations and
+                    # recompute them in the backward pass (HBM for FLOPs)
+                    fwd = jax.checkpoint(fwd)
+                cur, ns, mask = fwd(params_tree[i], state_tree[i], cur, lrng,
+                                    mask)
                 new_states.append(ns)
         li = len(self.layers) - 1
         if li in self.conf.preprocessors:

@@ -113,6 +113,57 @@ class EvaluativeListener(TrainingListener):
             print(self.last_evaluation.stats())
 
 
+class CheckpointListener(TrainingListener):
+    """Periodic model checkpointing with keep-last-N retention
+    (ref optimize/listeners/CheckpointListener.java: saveEveryNIterations /
+    keepLast). Together with `restore_latest` this is the crash-restart loop of
+    SURVEY §5 failure recovery."""
+
+    def __init__(self, directory: str, save_every_n_iterations: int = 100,
+                 keep_last: int = 3, save_updater: bool = True):
+        import os
+        self.directory = directory
+        self.frequency = max(1, int(save_every_n_iterations))
+        self.keep_last = max(1, int(keep_last))
+        self.save_updater = save_updater
+        os.makedirs(directory, exist_ok=True)
+        self.saved: List[str] = []
+
+    def iteration_done(self, model, iteration: int):
+        import os
+        if iteration % self.frequency != 0:
+            return
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+        path = os.path.join(self.directory, f"checkpoint_iter_{iteration}.zip")
+        ModelSerializer.write_model(model, path, save_updater=self.save_updater)
+        self.saved.append(path)
+        while len(self.saved) > self.keep_last:
+            old = self.saved.pop(0)
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+
+    @staticmethod
+    def restore_latest(directory: str):
+        """Resume point: the newest checkpoint in `directory`, or None."""
+        import os
+        import re
+        if not os.path.isdir(directory):
+            return None
+        best, best_iter = None, -1
+        for name in os.listdir(directory):
+            m = re.match(r"checkpoint_iter_(\d+)\.zip$", name)
+            if m and int(m.group(1)) > best_iter:
+                best_iter = int(m.group(1))
+                best = os.path.join(directory, name)
+        if best is None:
+            return None
+        from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+        return ModelSerializer.restore(best)
+    restoreLatest = restore_latest
+
+
 class ParamAndGradientIterationListener(IterationListener):
     """Per-iteration parameter/update magnitude stats to console and/or a
     delimited file (ref optimize/listeners/ParamAndGradientIterationListener.java).
