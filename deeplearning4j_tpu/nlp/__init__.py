@@ -16,6 +16,7 @@ from deeplearning4j_tpu.nlp.vectorizers import CountVectorizer, TfidfVectorizer
 from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabConstructor, VocabWord
 from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.distributed import DistributedWord2Vec
 from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
 from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
@@ -25,6 +26,6 @@ __all__ = [
     "EndingPreProcessor", "SentenceIterator", "BasicLineIterator",
     "CollectionSentenceIterator", "FileSentenceIterator", "CountVectorizer",
     "TfidfVectorizer", "VocabWord", "VocabCache", "VocabConstructor",
-    "SequenceVectors", "Word2Vec", "ParagraphVectors", "Glove",
+    "SequenceVectors", "Word2Vec", "DistributedWord2Vec", "ParagraphVectors", "Glove",
     "WordVectorSerializer",
 ]

@@ -1,0 +1,96 @@
+"""Distributed (data-parallel) Word2Vec over the device mesh.
+
+Parity: ref deeplearning4j-nlp-parent/deeplearning4j-nlp-spark (SparkWord2Vec /
+Word2VecVariables — the driver broadcasts the vocab, executors train on RDD
+partitions, and parameter updates flow through the param server). TPU-first
+redesign: vocab construction stays host-side (one pass), and each training step
+shards the PAIR BATCH over Mesh('data') with shard_map — every device computes
+count-normalized scatter deltas from its pair shard, deltas are pmean'd across
+the mesh, and the (replicated) tables advance identically everywhere. That is
+the synchronous rendering of the Spark executors + param-server exchange, riding
+ICI instead of the driver network.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Iterable, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.nlp.learning import _scatter_mean_update
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+
+class DistributedWord2Vec(Word2Vec):
+    """Word2Vec whose SkipGram step runs data-parallel over a mesh.
+
+    Semantics delta vs single-device: each device computes its shard's
+    count-normalized update and the mesh AVERAGES them (pmean) — equivalent to
+    one batch with per-device normalization, deterministic, staleness-free."""
+
+    def __init__(self, mesh: Optional[Mesh] = None, **kw):
+        super().__init__(**kw)
+        self.mesh = mesh or Mesh(np.asarray(jax.devices()), ("data",))
+        self._n_dev = int(np.prod(list(self.mesh.shape.values())))
+        self._sharded_step = None
+
+    def _build_sharded_step(self):
+        mesh = self.mesh
+
+        def per_shard(syn0, syn1neg, centers, contexts, negatives, lr):
+            # replicated tables in, pair shard in; compute local new tables,
+            # then pmean the DELTAS so every replica applies the mesh average
+            v = syn0[centers]
+            upos = syn1neg[contexts]
+            uneg = syn1neg[negatives]
+            pos_logit = jnp.sum(v * upos, axis=-1)
+            neg_logit = jnp.einsum("bd,bkd->bk", v, uneg)
+            loss = jnp.mean(jax.nn.softplus(-pos_logit)
+                            + jnp.sum(jax.nn.softplus(neg_logit), axis=-1))
+            g_pos = jax.nn.sigmoid(pos_logit) - 1.0
+            g_neg = jax.nn.sigmoid(neg_logit)
+            g_v = g_pos[:, None] * upos + jnp.einsum("bk,bkd->bd", g_neg, uneg)
+            g_upos = g_pos[:, None] * v
+            g_uneg = g_neg[..., None] * v[:, None, :]
+            new0 = _scatter_mean_update(syn0, centers, g_v, lr)
+            idx = jnp.concatenate([contexts[:, None], negatives], axis=1)
+            g_u = jnp.concatenate([g_upos[:, None, :], g_uneg], axis=1)
+            new1 = _scatter_mean_update(syn1neg, idx, g_u, lr)
+            d0 = lax.pmean(new0 - syn0, "data")
+            d1 = lax.pmean(new1 - syn1neg, "data")
+            return syn0 + d0, syn1neg + d1, lax.pmean(loss, "data")
+
+        rep = P()
+        shard = P("data")
+        fn = jax.shard_map(per_shard, mesh=mesh,
+                           in_specs=(rep, rep, shard, shard, shard, rep),
+                           out_specs=(rep, rep, rep), check_vma=False)
+        self._sharded_step = jax.jit(fn, donate_argnums=(0, 1))
+
+    def _train_batch(self, batch, alpha: float, probs):
+        if self.elements_algorithm != "skipgram" or self.use_hs:
+            return super()._train_batch(batch, alpha, probs)
+        c, t = batch
+        # pad the pair shard to a multiple of the device count
+        n = c.shape[0]
+        pad = (-n) % self._n_dev
+        if pad:
+            # padded pairs reuse pair 0 — their gradient contribution is real
+            # but pair 0 is arbitrary; acceptable at <n_dev extra pairs per
+            # flush. (The single-device path has no such constraint.)
+            c = np.concatenate([c, np.repeat(c[:1], pad)])
+            t = np.concatenate([t, np.repeat(t[:1], pad)])
+        neg = self._negatives((c.shape[0], self.negative), probs)
+        if self._sharded_step is None:
+            self._build_sharded_step()
+        tbl = self.lookup_table
+        sh = NamedSharding(self.mesh, P("data"))
+        cj = jax.device_put(jnp.asarray(c, jnp.int32), sh)
+        tj = jax.device_put(jnp.asarray(t, jnp.int32), sh)
+        nj = jax.device_put(jnp.asarray(neg, jnp.int32), sh)
+        tbl.syn0, tbl.syn1neg, _ = self._sharded_step(
+            tbl.syn0, tbl.syn1neg, cj, tj, nj, jnp.float32(alpha))

@@ -212,3 +212,23 @@ def test_serializer_round_trip(tmp_path, binary):
     # queries work on the loaded model
     assert loaded.similarity("apple", "apple") == pytest.approx(1.0, abs=1e-5)
     assert len(loaded.words_nearest("bus", top_n=3)) == 3
+
+
+def test_distributed_word2vec_learns_topics():
+    """Data-parallel SkipGram over the 8-virtual-device mesh (the 'NLP on
+    Spark' analog): same topic coherence as single-device training."""
+    import jax
+    import numpy as np
+    from jax.sharding import Mesh
+    from deeplearning4j_tpu.nlp import DistributedWord2Vec
+
+    mesh = Mesh(np.asarray(jax.devices()[:8]), ("data",))
+    w2v = DistributedWord2Vec(
+        mesh=mesh, layer_size=24, window=3, negative=5, min_word_frequency=1,
+        epochs=20, learning_rate=0.2, min_learning_rate=0.01, batch_size=256,
+        seed=1, sentence_iterator=CollectionSentenceIterator(corpus()),
+        tokenizer_factory=DefaultTokenizerFactory())
+    w2v.fit()
+    assert _topic_coherence(w2v) > 0.2
+    near = w2v.words_nearest("banana", top_n=4)
+    assert set(near) <= set(FRUIT) - {"banana"}
