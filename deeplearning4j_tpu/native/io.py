@@ -124,6 +124,8 @@ class NativeBatchPrefetcher:
 
     def __iter__(self):
         while True:
+            if self._handle is None:
+                raise RuntimeError("prefetcher is closed")
             rows = self._lib.dl4j_prefetcher_next(self._handle,
                                                   _fptr(self._buf))
             if rows == 0:

@@ -122,12 +122,21 @@ class CheckpointListener(TrainingListener):
     def __init__(self, directory: str, save_every_n_iterations: int = 100,
                  keep_last: int = 3, save_updater: bool = True):
         import os
+        import re
         self.directory = directory
         self.frequency = max(1, int(save_every_n_iterations))
         self.keep_last = max(1, int(keep_last))
         self.save_updater = save_updater
         os.makedirs(directory, exist_ok=True)
-        self.saved: List[str] = []
+        # seed retention state from checkpoints already on disk, so keep_last
+        # holds across crash-restarts instead of orphaning prior files
+        existing = []
+        for name in os.listdir(directory):
+            m = re.match(r"checkpoint_iter_(\d+)\.zip$", name)
+            if m:
+                existing.append((int(m.group(1)),
+                                 os.path.join(directory, name)))
+        self.saved: List[str] = [p for _, p in sorted(existing)]
 
     def iteration_done(self, model, iteration: int):
         import os

@@ -37,6 +37,8 @@ class DataSetStreamPublisher:
         self._q.put(ds, timeout=timeout)
 
     def publish_dataset(self, ds: DataSet, timeout: Optional[float] = None):
+        if self._closed:
+            raise RuntimeError("stream already ended")
         self._q.put(ds, timeout=timeout)
 
     def end(self) -> None:

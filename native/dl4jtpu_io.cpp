@@ -15,6 +15,7 @@
 #include <cstdio>
 #include <cstring>
 #include <mutex>
+#include <map>
 #include <queue>
 #include <string>
 #include <thread>
@@ -108,13 +109,18 @@ struct Prefetcher {
     std::vector<int64_t> order;
     std::atomic<int64_t> next_batch{0};
     int64_t n_batches;
-    std::queue<std::pair<int64_t, std::vector<float>>> ready;  // (batch_idx, data)
+    // consumer-ordered reorder buffer: batch_idx -> assembled data. Producers
+    // gate on (b - pop_cursor) < window so the buffer stays bounded but the
+    // batch the consumer is waiting for can ALWAYS be inserted (no circular
+    // wait: workers ahead of the window sleep, the one holding `pop_cursor`'s
+    // batch is inside the window by construction).
+    std::map<int64_t, std::vector<float>> ready;
     std::mutex mu;
     std::condition_variable cv_ready, cv_space;
-    size_t max_queue;
+    int64_t window;
     std::vector<std::thread> workers;
     std::atomic<bool> stop{false};
-    int64_t pop_cursor = 0;
+    int64_t pop_cursor = 0;  // guarded by mu
 
     void worker() {
         for (;;) {
@@ -132,7 +138,7 @@ struct Prefetcher {
             }
             std::unique_lock<std::mutex> lk(mu);
             cv_space.wait(lk, [&] {
-                return ready.size() < max_queue || stop.load();
+                return stop.load() || b < pop_cursor + window;
             });
             if (stop.load()) return;
             ready.emplace(b, std::move(out));
@@ -148,7 +154,7 @@ void* dl4j_prefetcher_create(const float* x, const float* y, int64_t n,
     p->x = x; p->y = y; p->n = n; p->feat = feat; p->lab = lab;
     p->batch = batch;
     p->n_batches = (n + batch - 1) / batch;
-    p->max_queue = 4;
+    p->window = 4 + threads;  // buffered batches bound
     p->order.resize(n);
     for (int64_t i = 0; i < n; i++) p->order[i] = i;
     if (shuffle) {  // xorshift64 Fisher-Yates, deterministic under seed
@@ -170,27 +176,22 @@ int64_t dl4j_prefetcher_next(void* handle, float* out) {
     auto* p = (Prefetcher*)handle;
     if (p->pop_cursor >= p->n_batches) return 0;
     std::vector<float> data;
-    int64_t want = p->pop_cursor;
+    int64_t want;
     {
         std::unique_lock<std::mutex> lk(p->mu);
+        want = p->pop_cursor;
         for (;;) {
-            if (!p->ready.empty() && p->ready.front().first == want) {
-                data = std::move(p->ready.front().second);
-                p->ready.pop();
-                p->cv_space.notify_all();
+            auto it = p->ready.find(want);
+            if (it != p->ready.end()) {
+                data = std::move(it->second);
+                p->ready.erase(it);
                 break;
-            }
-            // out-of-order batch at the head: rotate it to the back
-            if (!p->ready.empty() && p->ready.front().first != want) {
-                auto item = std::move(p->ready.front());
-                p->ready.pop();
-                p->ready.push(std::move(item));
-                continue;
             }
             p->cv_ready.wait_for(lk, std::chrono::milliseconds(50));
         }
+        p->pop_cursor++;           // advances the producer window
+        p->cv_space.notify_all();
     }
-    p->pop_cursor++;
     std::memcpy(out, data.data(), data.size() * sizeof(float));
     int64_t lo = want * p->batch;
     return std::min(p->n, lo + p->batch) - lo;

@@ -99,3 +99,22 @@ def test_prefetcher_unshuffled_order():
     got = np.concatenate([xb for xb, _ in pf])
     pf.close()
     assert np.array_equal(got, x)
+
+
+def test_prefetcher_no_deadlock_under_contention():
+    """Regression: out-of-order production with more threads than window slack
+    must never deadlock (reorder buffer + cursor-gated producers)."""
+    x = RNG.rand(64, 5).astype(np.float32)
+    y = RNG.rand(64, 2).astype(np.float32)
+    for trial in range(20):
+        pf = NativeBatchPrefetcher(x, y, batch=4, threads=4, seed=trial)
+        assert sum(xb.shape[0] for xb, _ in pf) == 64
+        pf.close()
+
+
+def test_prefetcher_closed_raises():
+    pf = NativeBatchPrefetcher(np.zeros((8, 2), np.float32),
+                               np.zeros((8, 1), np.float32), batch=4)
+    pf.close()
+    with pytest.raises(RuntimeError):
+        list(pf)
