@@ -262,5 +262,88 @@ def convert_layer(class_name: str, cfg: dict, as_output=None,
         return KerasLayerConversion(is_flatten=True)
     if class_name == "InputLayer":
         return KerasLayerConversion(is_input=True)
+    if class_name == "UpSampling2D":
+        from deeplearning4j_tpu.nn.conf.layers.convolutional import Upsampling2D
+        return KerasLayerConversion(Upsampling2D(size=_pair(cfg.get("size",
+                                                                    (2, 2)))))
+    if class_name == "Cropping2D":
+        from deeplearning4j_tpu.nn.conf.layers.convolutional import Cropping2D
+        c = cfg.get("cropping", ((0, 0), (0, 0)))
+        if isinstance(c, int):
+            crop = (c, c, c, c)
+        elif isinstance(c[0], (list, tuple)):
+            crop = (c[0][0], c[0][1], c[1][0], c[1][1])
+        else:
+            crop = (c[0], c[0], c[1], c[1])
+        return KerasLayerConversion(Cropping2D(crop=tuple(int(v) for v in crop)))
+    if class_name == "SeparableConv2D":
+        return convert_separable_conv2d(cfg)
+    if class_name == "DepthwiseConv2D":
+        return convert_depthwise_conv2d(cfg)
+    if class_name == "SimpleRNN":
+        return convert_simple_rnn(cfg)
     raise ValueError(f"Unsupported Keras layer type: {class_name!r} "
                      f"(ref KerasLayer registry)")
+
+
+def convert_separable_conv2d(cfg):
+    from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+        SeparableConvolution2D)
+    filters = int(cfg.get("filters"))
+    kernel = _pair(cfg["kernel_size"])
+    layer = SeparableConvolution2D(
+        n_out=filters, kernel_size=kernel,
+        stride=_pair(cfg.get("strides", (1, 1))),
+        depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+        convolution_mode=_border_mode(cfg),
+        activation=keras_activation(cfg.get("activation")),
+        has_bias=cfg.get("use_bias", True))
+
+    def mapper(ws):
+        # keras: depthwise (kh, kw, in, dm), pointwise (1, 1, in*dm, out)
+        dw = np.asarray(ws[0])
+        kh, kw, cin, dm = dw.shape
+        p = {"W": dw.transpose(2, 3, 0, 1).reshape(cin * dm, 1, kh, kw),
+             "w_point": np.asarray(ws[1]).transpose(3, 2, 0, 1)}
+        if len(ws) > 2:
+            p["b"] = np.asarray(ws[2]).reshape(-1)
+        return p, {}
+
+    return KerasLayerConversion(layer, mapper)
+
+
+def convert_depthwise_conv2d(cfg):
+    from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+        DepthwiseConvolutionLayer)
+    kernel = _pair(cfg["kernel_size"])
+    layer = DepthwiseConvolutionLayer(
+        kernel_size=kernel, stride=_pair(cfg.get("strides", (1, 1))),
+        depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+        convolution_mode=_border_mode(cfg),
+        activation=keras_activation(cfg.get("activation")),
+        has_bias=cfg.get("use_bias", True))
+
+    def mapper(ws):
+        dw = np.asarray(ws[0])                       # (kh, kw, in, dm)
+        kh, kw, cin, dm = dw.shape
+        p = {"W": dw.transpose(2, 3, 0, 1).reshape(cin * dm, 1, kh, kw)}
+        if len(ws) > 1:
+            p["b"] = np.asarray(ws[1]).reshape(-1)
+        return p, {}
+
+    return KerasLayerConversion(layer, mapper)
+
+
+def convert_simple_rnn(cfg):
+    from deeplearning4j_tpu.nn.conf.layers.recurrent import SimpleRnn
+    units = int(cfg.get("units", cfg.get("output_dim")))
+    layer = SimpleRnn(n_out=units,
+                      activation=keras_activation(cfg.get("activation", "tanh")))
+
+    def mapper(ws):
+        p = {"W": np.asarray(ws[0]), "RW": np.asarray(ws[1])}
+        p["b"] = (np.asarray(ws[2]).reshape(-1) if len(ws) > 2
+                  else np.zeros(units, np.float32))
+        return p, {}
+
+    return KerasLayerConversion(layer, mapper)

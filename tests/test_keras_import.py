@@ -290,3 +290,46 @@ def test_vgg16_style_import_and_transfer(tmp_path):
     y = np.eye(4)[RNG.randint(0, 4, 2)]
     tuned.fit(x, y)
     assert np.isfinite(tuned.score())
+
+
+def test_extended_layer_converters():
+    """Converters for the extended layer families (ref KerasLayer registry:
+    upsampling/cropping/separable/depthwise/simple-rnn)."""
+    import numpy as np
+    from deeplearning4j_tpu.keras.layers import convert_layer
+    from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+        Cropping2D, DepthwiseConvolutionLayer, SeparableConvolution2D,
+        Upsampling2D)
+    from deeplearning4j_tpu.nn.conf.layers.recurrent import SimpleRnn
+
+    up = convert_layer("UpSampling2D", {"size": [2, 2]})
+    assert isinstance(up.layer, Upsampling2D) and up.layer.size == (2, 2)
+
+    cr = convert_layer("Cropping2D", {"cropping": [[1, 2], [3, 4]]})
+    assert isinstance(cr.layer, Cropping2D) and cr.layer.crop == (1, 2, 3, 4)
+
+    sep = convert_layer("SeparableConv2D", {
+        "filters": 8, "kernel_size": [3, 3], "padding": "same",
+        "depth_multiplier": 2, "use_bias": True})
+    assert isinstance(sep.layer, SeparableConvolution2D)
+    dw_k = np.random.rand(3, 3, 4, 2).astype(np.float32)   # kh,kw,in,dm
+    pw_k = np.random.rand(1, 1, 8, 8).astype(np.float32)
+    bias = np.random.rand(8).astype(np.float32)
+    p, _ = sep.weight_mapper([dw_k, pw_k, bias])
+    assert p["W"].shape == (8, 1, 3, 3)        # in*dm depthwise OIHW
+    assert p["w_point"].shape == (8, 8, 1, 1)
+    # depthwise weights preserved per (channel, multiplier) slice
+    assert np.allclose(p["W"][2 * 2 + 1, 0], dw_k[:, :, 2, 1])
+
+    dwc = convert_layer("DepthwiseConv2D", {
+        "kernel_size": [3, 3], "depth_multiplier": 1, "padding": "valid"})
+    assert isinstance(dwc.layer, DepthwiseConvolutionLayer)
+    p, _ = dwc.weight_mapper([np.random.rand(3, 3, 5, 1).astype(np.float32)])
+    assert p["W"].shape == (5, 1, 3, 3)
+
+    rnn = convert_layer("SimpleRNN", {"units": 7, "activation": "tanh"})
+    assert isinstance(rnn.layer, SimpleRnn) and rnn.layer.n_out == 7
+    p, _ = rnn.weight_mapper([np.random.rand(4, 7), np.random.rand(7, 7),
+                              np.random.rand(7)])
+    assert p["W"].shape == (4, 7) and p["RW"].shape == (7, 7)
+    assert p["b"].shape == (7,)
