@@ -265,3 +265,176 @@ class TransferLearningHelper:
         for i, p in enumerate(sub.params_tree):
             self.net.params_tree[self.split + i] = p
         return self.net
+
+
+class TransferLearningGraph:
+    """Transfer learning on ComputationGraphs
+    (ref nn/transferlearning/TransferLearning.GraphBuilder :318-560)."""
+
+    class GraphBuilder:
+        def __init__(self, net):
+            from deeplearning4j_tpu.nn.conf.graph_configuration import (
+                ComputationGraphConfiguration)
+            self._net = net
+            self._conf = ComputationGraphConfiguration.from_json(
+                net.conf.to_json())
+            self._params = {name: dict(p) for name, p in
+                            zip(net.layer_names, net.params_tree)}
+            self._fine_tune = None
+            self._freeze_at: List[str] = []
+            self._nout_changes: List = []   # (name, n_out, weight_init)
+            self._reinit: set = set()
+
+        def fine_tune_configuration(self, ftc: FineTuneConfiguration):
+            self._fine_tune = ftc
+            return self
+        fineTuneConfiguration = fine_tune_configuration
+
+        def set_feature_extractor(self, *names: str):
+            """Freeze the named vertices and everything upstream of them
+            (ref setFeatureExtractor(frozenOutputAt))."""
+            self._freeze_at.extend(names)
+            return self
+        setFeatureExtractor = set_feature_extractor
+
+        def remove_vertex_keep_connections(self, name: str):
+            """Remove a vertex; the caller re-adds one with the same name so
+            downstream input references resolve (ref removeVertexKeepConnections)."""
+            del self._conf.nodes[name]
+            self._params.pop(name, None)
+            return self
+        removeVertexKeepConnections = remove_vertex_keep_connections
+
+        def remove_vertex_and_connections(self, name: str):
+            """Remove a vertex and everything downstream of it
+            (ref removeVertexAndConnections)."""
+            doomed = {name}
+            changed = True
+            while changed:
+                changed = False
+                for n, node in self._conf.nodes.items():
+                    if n not in doomed and any(i in doomed for i in node.inputs):
+                        doomed.add(n)
+                        changed = True
+            for n in doomed:
+                self._conf.nodes.pop(n, None)
+                self._params.pop(n, None)
+            self._conf.outputs = [o for o in self._conf.outputs
+                                  if o not in doomed]
+            return self
+        removeVertexAndConnections = remove_vertex_and_connections
+
+        def add_layer(self, name: str, layer, *inputs: str):
+            from deeplearning4j_tpu.nn.conf.graph_configuration import GraphNode
+            self._conf.nodes[name] = GraphNode(name, "layer", layer,
+                                               list(inputs))
+            self._reinit.add(name)
+            return self
+        addLayer = add_layer
+
+        def add_vertex(self, name: str, vertex, *inputs: str):
+            from deeplearning4j_tpu.nn.conf.graph_configuration import GraphNode
+            self._conf.nodes[name] = GraphNode(name, "vertex", vertex,
+                                               list(inputs))
+            return self
+        addVertex = add_vertex
+
+        def set_outputs(self, *names: str):
+            self._conf.outputs = list(names)
+            return self
+        setOutputs = set_outputs
+
+        def nout_replace(self, name: str, n_out: int,
+                         weight_init=WeightInit.XAVIER):
+            self._nout_changes.append((name, int(n_out), weight_init))
+            return self
+        nOutReplace = nout_replace
+
+        def _ancestors(self, names):
+            out = set()
+            stack = list(names)
+            while stack:
+                n = stack.pop()
+                if n in out or n in self._conf.inputs:
+                    continue
+                out.add(n)
+                node = self._conf.nodes.get(n)
+                if node is not None:
+                    stack.extend(node.inputs)
+            return out
+
+        def build(self):
+            from deeplearning4j_tpu.nn.graph.computation_graph import (
+                ComputationGraph)
+            conf = self._conf
+            # nOut replacement: re-init changed layer + direct consumers
+            for name, n_out, w in self._nout_changes:
+                node = conf.nodes[name]
+                node.conf.n_out = n_out
+                node.conf.weight_init = w
+                self._reinit.add(name)
+                for n2, other in conf.nodes.items():
+                    if name in other.inputs and other.kind == "layer" \
+                            and hasattr(other.conf, "n_in"):
+                        other.conf.n_in = 0
+                        self._reinit.add(n2)
+
+            # freeze the feature extractor (named vertices + ancestors)
+            for n in self._ancestors(self._freeze_at):
+                node = conf.nodes.get(n)
+                if node is not None and node.kind == "layer":
+                    node.conf.frozen = True
+
+            if self._fine_tune is not None:
+                ft = self._fine_tune
+                for node in conf.nodes.values():
+                    if node.kind == "layer":
+                        ft.apply_to(node.conf)
+                if ft.updater is not None:
+                    conf.global_conf.updater = ft.updater.to_dict()
+                if ft.seed is not None:
+                    conf.global_conf.seed = ft.seed
+
+            # re-resolve topology, auto preprocessors, and nIn over the edited
+            # graph — the same two passes GraphBuilder.build runs
+            conf.topo_order = conf._topological_sort()
+            if conf.input_types is not None:
+                from deeplearning4j_tpu.nn.conf.configuration import (
+                    _EXPECTED_KIND, make_preprocessor)
+                known = dict(zip(conf.inputs, conf.input_types))
+                for name in conf.topo_order:
+                    node = conf.nodes[name]
+                    in_types = [known[i] for i in node.inputs]
+                    if node.kind == "layer":
+                        cur = in_types[0]
+                        if node.preprocessor is None:
+                            expected = _EXPECTED_KIND.get(
+                                type(node.conf).__name__)
+                            if expected is not None:
+                                node.preprocessor = make_preprocessor(cur,
+                                                                      expected)
+                        if node.preprocessor is not None:
+                            cur = node.preprocessor.get_output_type(cur)
+                        if name in self._reinit \
+                                and hasattr(node.conf, "n_in"):
+                            node.conf.n_in = 0
+                        node.conf.set_n_in(cur, override=False)
+                        known[name] = node.conf.get_output_type(cur)
+                    else:
+                        known[name] = node.conf.get_output_type(in_types)
+
+            new_net = ComputationGraph(conf)
+            new_net.init()
+            import jax.numpy as jnp
+            for i, name in enumerate(new_net.layer_names):
+                if name in self._params and name not in self._reinit:
+                    new_net.params_tree[i] = {
+                        k: jnp.array(v, copy=True)
+                        for k, v in self._params[name].items()}
+            new_net._opt_state = [u.init(p) for u, p in
+                                  zip(new_net._updaters, new_net.params_tree)]
+            return new_net
+
+
+# ref API shape: TransferLearning.GraphBuilder(computationGraph)
+TransferLearning.GraphBuilder = TransferLearningGraph.GraphBuilder

@@ -1,0 +1,103 @@
+"""TransferLearning.GraphBuilder tests (ref TransferLearningCompGraphTest):
+freeze feature extractor, replace the output head, verify frozen params stay
+fixed while the new head trains."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    Activation, ConvolutionLayer, DenseLayer, GraphBuilder, InputType,
+    LossFunction, NeuralNetConfiguration, OutputLayer, Sgd, SubsamplingLayer,
+    WeightInit)
+from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+from deeplearning4j_tpu.nn.transferlearning import (
+    FineTuneConfiguration, TransferLearning)
+
+RNG = np.random.RandomState(55)
+
+
+def base_graph():
+    g = (NeuralNetConfiguration.Builder().seed(5).weight_init(WeightInit.XAVIER)
+         .activation(Activation.RELU).updater(Sgd(learning_rate=0.1))
+         .dtype("float64").graph_builder())
+    (g.add_inputs("in")
+      .add_layer("conv", ConvolutionLayer(n_out=4, kernel_size=(3, 3)), "in")
+      .add_layer("pool", SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)),
+                 "conv")
+      .add_layer("fc", DenseLayer(n_out=12), "pool")
+      .add_layer("out", OutputLayer(n_out=5, activation=Activation.SOFTMAX),
+                 "fc")
+      .set_outputs("out")
+      .set_input_types(InputType.convolutional(8, 8, 1)))
+    return ComputationGraph(g.build()).init()
+
+
+def data(classes):
+    x = RNG.rand(8, 1, 8, 8)
+    y = np.eye(classes)[RNG.randint(0, classes, 8)]
+    return x, y
+
+
+def test_graph_transfer_replace_head_and_freeze():
+    net = base_graph()
+    x, y = data(5)
+    net.fit_batch(x, y)
+    conv_before = {k: np.asarray(v) for k, v in
+                   net.params_tree[net.layer_names.index("conv")].items()}
+
+    new_net = (TransferLearning.GraphBuilder(net)
+               .fine_tune_configuration(
+                   FineTuneConfiguration.Builder()
+                   .updater(Sgd(learning_rate=0.05)).build())
+               .set_feature_extractor("fc")
+               .remove_vertex_keep_connections("out")
+               .add_layer("out", OutputLayer(n_out=3,
+                                             activation=Activation.SOFTMAX),
+                          "fc")
+               .build())
+
+    # 3-class head, conv/fc params carried over and frozen
+    x3, y3 = data(3)
+    out = np.asarray(new_net.output(x3))
+    assert out.shape == (8, 3)
+    ci = new_net.layer_names.index("conv")
+    for k in conv_before:
+        assert np.allclose(np.asarray(new_net.params_tree[ci][k]),
+                           conv_before[k])
+    for _ in range(5):
+        new_net.fit_batch(x3, y3)
+    for k in conv_before:  # frozen: unchanged by training
+        assert np.allclose(np.asarray(new_net.params_tree[ci][k]),
+                           conv_before[k])
+    oi = new_net.layer_names.index("out")
+    assert not np.allclose(
+        np.asarray(new_net.params_tree[oi]["W"]).std(), 0.0)
+    assert np.isfinite(new_net.score())
+
+
+def test_graph_transfer_nout_replace():
+    net = base_graph()
+    new_net = (TransferLearning.GraphBuilder(net)
+               .nout_replace("fc", 20)
+               .build())
+    fi = new_net.layer_names.index("fc")
+    assert new_net.params_tree[fi]["W"].shape[1] == 20
+    oi = new_net.layer_names.index("out")
+    assert new_net.params_tree[oi]["W"].shape == (20, 5)
+    x, y = data(5)
+    new_net.fit_batch(x, y)
+    assert np.isfinite(new_net.score())
+
+
+def test_graph_transfer_remove_and_connections():
+    net = base_graph()
+    new_net = (TransferLearning.GraphBuilder(net)
+               .remove_vertex_and_connections("fc")  # drops fc AND out
+               .add_layer("newout",
+                          OutputLayer(n_out=2, activation=Activation.SOFTMAX),
+                          "pool")
+               .set_outputs("newout")
+               .build())
+    assert "fc" not in new_net.layer_names
+    x, y = data(2)
+    out = np.asarray(new_net.output(x))
+    assert out.shape == (8, 2)
