@@ -12,6 +12,8 @@ from deeplearning4j_tpu.clustering.knn import NearestNeighbors, VPTree
 from deeplearning4j_tpu.clustering.kmeans import (
     Cluster, ClusterSet, KMeansClustering, Point)
 from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne, Tsne
+from deeplearning4j_tpu.clustering.server import (
+    NearestNeighborsClient, NearestNeighborsServer)
 
 __all__ = ["NearestNeighbors", "VPTree", "KMeansClustering", "ClusterSet",
-           "Cluster", "Point", "BarnesHutTsne", "Tsne"]
+           "Cluster", "Point", "BarnesHutTsne", "Tsne", "NearestNeighborsServer", "NearestNeighborsClient"]

@@ -1,0 +1,106 @@
+"""Nearest-neighbors REST server + client.
+
+Parity: ref deeplearning4j-nearestneighbors-parent/nearestneighbor-server
+(NearestNeighborsServer exposing /knn over HTTP with a vectorized index) and
+nearestneighbors-client. Same stdlib-HTTP rendering as the UI server; the index
+is the XLA brute-force NearestNeighbors (MXU distance block), so each request is
+one jitted call.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.knn import NearestNeighbors
+
+
+class NearestNeighborsServer:
+    """(ref server/NearestNeighborsServer.java)"""
+
+    def __init__(self, data, port: int = 0, distance: str = "euclidean"):
+        index = NearestNeighbors(data, distance=distance)
+        n_points = np.asarray(data).shape[0]
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/status":
+                    self._json({"points": int(n_points), "ok": True})
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                if self.path != "/knn":
+                    self._json({"error": "not found"}, 404)
+                    return
+                n = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(n).decode())
+                k = int(req.get("k", 5))
+                if "index" in req:   # query by stored point id (ref knn by index)
+                    q = np.asarray(index.data[int(req["index"])])
+                else:
+                    q = np.asarray(req["vector"], np.float32)
+                dist, idx = index.search(q, k=k)
+                self._json({"indices": idx[0].tolist(),
+                            "distances": dist[0].tolist()})
+
+        self._httpd = ThreadingHTTPServer(("localhost", port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        return f"http://localhost:{self.port}"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class NearestNeighborsClient:
+    """(ref client/NearestNeighborsClient.java)"""
+
+    def __init__(self, address: str, timeout: float = 30.0):
+        self.address = address.rstrip("/")
+        self.timeout = timeout
+
+    def _post(self, path, payload):
+        import urllib.request
+        req = urllib.request.Request(
+            self.address + path, data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=self.timeout) as r:
+            return json.loads(r.read().decode())
+
+    def knn(self, vector, k: int = 5) -> dict:
+        return self._post("/knn", {"vector": np.asarray(vector).tolist(),
+                                   "k": int(k)})
+    knnVector = knn
+
+    def knn_by_index(self, index: int, k: int = 5) -> dict:
+        return self._post("/knn", {"index": int(index), "k": int(k)})
+
+    def status(self) -> dict:
+        import urllib.request
+        with urllib.request.urlopen(self.address + "/status",
+                                    timeout=self.timeout) as r:
+            return json.loads(r.read().decode())

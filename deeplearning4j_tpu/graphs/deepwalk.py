@@ -78,6 +78,25 @@ class DeepWalk:
     def num_vertices(self) -> int:
         return self._sv.vocab.num_words()
 
+    # ------------- serde (ref GraphVectorSerializer / GraphVectors) -------------
+    def save(self, path: str, binary: bool = False) -> None:
+        """Persist vertex vectors in the word2vec text/binary format with vertex
+        ids as tokens (ref models/embeddings/loader GraphVectorSerializer)."""
+        from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+        WordVectorSerializer.write_word_vectors(self._sv, path, binary=binary)
+
+    @staticmethod
+    def load(path: str) -> "DeepWalk":
+        from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+        wv = WordVectorSerializer.read_word_vectors(path)
+        dw = DeepWalk(vector_size=wv.lookup_table.layer_size)
+        from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+        sv = SequenceVectors(layer_size=wv.lookup_table.layer_size)
+        sv.vocab = wv.vocab
+        sv.lookup_table = wv.lookup_table
+        dw._sv = sv
+        return dw
+
     class Builder:
         def __init__(self):
             self._kw = {}

@@ -8,7 +8,7 @@ scatter-add updates inside single jitted XLA steps.
 """
 from deeplearning4j_tpu.nlp.tokenization import (
     CommonPreprocessor, DefaultTokenizerFactory, EndingPreProcessor,
-    NGramTokenizerFactory)
+    NGramTokenizerFactory, UnicodeScriptTokenizerFactory)
 from deeplearning4j_tpu.nlp.sentence_iterator import (
     BasicLineIterator, CollectionSentenceIterator, FileSentenceIterator,
     SentenceIterator)
@@ -23,6 +23,7 @@ from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
 
 __all__ = [
     "DefaultTokenizerFactory", "NGramTokenizerFactory", "CommonPreprocessor",
+    "UnicodeScriptTokenizerFactory",
     "EndingPreProcessor", "SentenceIterator", "BasicLineIterator",
     "CollectionSentenceIterator", "FileSentenceIterator", "CountVectorizer",
     "TfidfVectorizer", "VocabWord", "VocabCache", "VocabConstructor",

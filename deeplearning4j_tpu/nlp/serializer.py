@@ -61,16 +61,31 @@ class WordVectorSerializer:
     @staticmethod
     def _read_text(path: str) -> WordVectors:
         with open(path, "r", encoding="utf-8") as f:
-            V, D = (int(t) for t in f.readline().split())
-            vocab = VocabCache()
-            syn0 = np.zeros((V, D), np.float32)
-            for i in range(V):
-                parts = f.readline().rstrip("\n").split(" ")
-                word, vals = parts[0], parts[1:1 + D]
-                vw = VocabWord(word, V - i)  # rank-preserving pseudo counts
-                vocab.add_token(vw)
-                syn0[i] = np.asarray([float(v) for v in vals], np.float32)
+            first = f.readline().rstrip("\n")
+            head = first.split()
+            rows: list = []
+            words: list = []
+            if len(head) == 2 and all(t.isdigit() for t in head):
+                pass  # word2vec header: "V D"
+            else:  # headerless GloVe text format (ref loadTxt glove handling)
+                parts = first.split(" ")
+                words.append(parts[0])
+                rows.append([float(v) for v in parts[1:]])
+            for line in f:
+                line = line.rstrip("\n")
+                if not line:
+                    continue
+                parts = line.split(" ")
+                words.append(parts[0])
+                rows.append([float(v) for v in parts[1:]])
+        vocab = VocabCache()
+        V = len(words)
+        for i, w in enumerate(words):
+            vocab.add_token(VocabWord(w, V - i))  # rank-preserving pseudo counts
+        syn0 = np.asarray(rows, np.float32)
         return WordVectorSerializer._assemble(vocab, syn0)
+
+    read_glove = read_word_vectors  # GloVe text auto-detected (headerless)
 
     @staticmethod
     def _read_binary(path: str) -> WordVectors:

@@ -109,3 +109,42 @@ class NGramTokenizerFactory(TokenizerFactory):
             for i in range(len(toks) - n + 1):
                 out.append(" ".join(toks[i:i + n]))
         return Tokenizer(out)
+
+
+class UnicodeScriptTokenizerFactory(TokenizerFactory):
+    """Language-pack slot (ref deeplearning4j-nlp-{chinese,japanese,korean}
+    tokenizer factories, which bundle dictionary analyzers): a dictionary-free
+    approximation that splits on whitespace AND emits CJK codepoints as
+    individual tokens (character unigrams are the standard no-dictionary
+    baseline for Chinese/Japanese segmentation)."""
+
+    _CJK = (
+        (0x4E00, 0x9FFF), (0x3400, 0x4DBF),   # CJK unified (+ext A)
+        (0x3040, 0x309F), (0x30A0, 0x30FF),   # hiragana, katakana
+        (0xAC00, 0xD7AF),                      # hangul syllables
+    )
+
+    @classmethod
+    def _is_cjk(cls, ch: str) -> bool:
+        cp = ord(ch)
+        return any(lo <= cp <= hi for lo, hi in cls._CJK)
+
+    def create(self, text: str) -> Tokenizer:
+        out: List[str] = []
+        buf: List[str] = []
+
+        def flush():
+            if buf:
+                out.append("".join(buf))
+                buf.clear()
+
+        for ch in text:
+            if ch.isspace():
+                flush()
+            elif self._is_cjk(ch):
+                flush()
+                out.append(ch)
+            else:
+                buf.append(ch)
+        flush()
+        return Tokenizer(self._apply_pre(out))

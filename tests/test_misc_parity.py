@@ -1,0 +1,71 @@
+"""KNN REST server/client, GraphVectors serde, GloVe text format, CJK tokenizer."""
+import os
+
+import numpy as np
+import pytest
+
+RNG = np.random.RandomState(61)
+
+
+def test_knn_rest_server_and_client():
+    from deeplearning4j_tpu.clustering import (
+        NearestNeighborsClient, NearestNeighborsServer)
+    data = RNG.randn(100, 6).astype(np.float32)
+    server = NearestNeighborsServer(data, port=0)
+    try:
+        client = NearestNeighborsClient(server.address)
+        assert client.status() == {"points": 100, "ok": True}
+        res = client.knn(data[7], k=3)
+        assert res["indices"][0] == 7
+        assert res["distances"][0] == pytest.approx(0.0, abs=1e-5)
+        # matches in-process brute force
+        d = np.linalg.norm(data - data[7], axis=1)
+        assert res["indices"] == np.argsort(d)[:3].tolist()
+        res2 = client.knn_by_index(12, k=2)
+        assert res2["indices"][0] == 12
+    finally:
+        server.stop()
+
+
+def test_deepwalk_serde_round_trip(tmp_path):
+    from deeplearning4j_tpu.graphs import DeepWalk, Graph
+    g = Graph(6)
+    for a in range(3):
+        for b in range(a + 1, 3):
+            g.add_edge(a, b)
+            g.add_edge(3 + a, 3 + b)
+    g.add_edge(0, 3)
+    dw = (DeepWalk.Builder().vectorSize(8).windowSize(2).epochs(5)
+          .batchSize(128).learningRate(0.2).seed(3).build())
+    dw.initialize(g)
+    dw.fit(walk_length=10)
+    path = os.path.join(tmp_path, "gv.txt")
+    dw.save(path)
+    loaded = DeepWalk.load(path)
+    assert loaded.num_vertices() == 6
+    for v in range(6):
+        assert np.allclose(loaded.get_vertex_vector(v),
+                           dw.get_vertex_vector(v), atol=1e-5)
+    assert loaded.similarity(0, 1) == pytest.approx(dw.similarity(0, 1),
+                                                    abs=1e-5)
+
+
+def test_glove_headerless_text_format(tmp_path):
+    from deeplearning4j_tpu.nlp import WordVectorSerializer
+    path = os.path.join(tmp_path, "glove.txt")
+    with open(path, "w") as f:
+        f.write("king 0.1 0.2 0.3\nqueen 0.2 0.3 0.4\napple -1.0 0.0 1.0\n")
+    wv = WordVectorSerializer.read_word_vectors(path)
+    assert wv.vocab.num_words() == 3
+    assert np.allclose(wv.get_word_vector("queen"), [0.2, 0.3, 0.4])
+    assert wv.similarity("king", "queen") > wv.similarity("king", "apple")
+
+
+def test_unicode_script_tokenizer():
+    from deeplearning4j_tpu.nlp import UnicodeScriptTokenizerFactory
+    tf = UnicodeScriptTokenizerFactory()
+    assert tf.tokenize("hello world") == ["hello", "world"]
+    # CJK runs split per codepoint, latin runs stay whole
+    toks = tf.tokenize("我爱NLP 日本語です")
+    assert toks == ["我", "爱", "NLP", "日", "本", "語", "で", "す"]
+    assert tf.tokenize("한국어 test") == ["한", "국", "어", "test"]
