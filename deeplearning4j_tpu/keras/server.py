@@ -1,0 +1,122 @@
+"""Keras-backend bridge: drive training in this framework from an external
+process.
+
+Parity: ref deeplearning4j-keras — Server.java launches a py4j GatewayServer
+around DeepLearning4jEntryPoint.fit(EntryPointFitParameters): the Python/Keras
+side hands over a saved Keras model file + feature/label data files and DL4J
+trains it. TPU rendering: the same entry-point contract over stdlib HTTP (py4j
+is a JVM artifact): POST /fit with the file-path parameters; the server imports
+the model (Keras .h5 via keras/model_import, or a framework zip), loads .npy
+feature/label files, fits, and returns the score + optional save path.
+"""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as np
+
+
+class EntryPointFitParameters:
+    """(ref EntryPointFitParameters.java) — plain parameter holder."""
+
+    def __init__(self, model_file_path: str, train_features_path: str,
+                 train_labels_path: str, batch_size: int = 32,
+                 nb_epoch: int = 1, save_path: Optional[str] = None):
+        self.model_file_path = model_file_path
+        self.train_features_path = train_features_path
+        self.train_labels_path = train_labels_path
+        self.batch_size = int(batch_size)
+        self.nb_epoch = int(nb_epoch)
+        self.save_path = save_path
+
+    @staticmethod
+    def from_dict(d: dict) -> "EntryPointFitParameters":
+        return EntryPointFitParameters(
+            d["model_file_path"], d["train_features_path"],
+            d["train_labels_path"], d.get("batch_size", 32),
+            d.get("nb_epoch", 1), d.get("save_path"))
+
+
+class DeepLearning4jEntryPoint:
+    """(ref DeepLearning4jEntryPoint.java:12) — the fit() entry point, usable
+    in-process or behind the HTTP server."""
+
+    def fit(self, params: EntryPointFitParameters) -> dict:
+        from deeplearning4j_tpu.datasets.iterators import INDArrayDataSetIterator
+        net = self._load_model(params.model_file_path)
+        x = np.load(params.train_features_path)
+        y = np.load(params.train_labels_path)
+        it = INDArrayDataSetIterator(x, y, params.batch_size)
+        net.fit(it, epochs=params.nb_epoch)
+        result = {"score": float(net.score()), "steps": int(net._step)}
+        if params.save_path:
+            from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+            ModelSerializer.write_model(net, params.save_path)
+            result["saved_to"] = params.save_path
+        return result
+
+    @staticmethod
+    def _load_model(path: str):
+        if path.endswith((".h5", ".hdf5")):
+            from deeplearning4j_tpu.keras.model_import import KerasModelImport
+            return KerasModelImport.import_keras_sequential_model_and_weights(
+                path)
+        from deeplearning4j_tpu.util.model_guesser import ModelGuesser
+        return ModelGuesser.load_model_guess(path)
+
+
+class KerasBridgeServer:
+    """(ref Server.java) — HTTP rendering of the py4j gateway."""
+
+    def __init__(self, port: int = 0):
+        entry = DeepLearning4jEntryPoint()
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                if self.path == "/status":
+                    self._json({"ok": True})
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                if self.path != "/fit":
+                    self._json({"error": "not found"}, 404)
+                    return
+                n = int(self.headers.get("Content-Length", "0"))
+                try:
+                    params = EntryPointFitParameters.from_dict(
+                        json.loads(self.rfile.read(n).decode()))
+                    self._json(entry.fit(params))
+                except Exception as e:  # surfaced to the remote caller
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+
+        self._httpd = ThreadingHTTPServer(("localhost", port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        return f"http://localhost:{self.port}"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()

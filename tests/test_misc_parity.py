@@ -69,3 +69,55 @@ def test_unicode_script_tokenizer():
     toks = tf.tokenize("我爱NLP 日本語です")
     assert toks == ["我", "爱", "NLP", "日", "本", "語", "で", "す"]
     assert tf.tokenize("한국어 test") == ["한", "국", "어", "test"]
+
+
+def test_keras_bridge_server_fit(tmp_path):
+    """(ref deeplearning4j-keras Server/DeepLearning4jEntryPoint): external
+    process drives training over the bridge from saved model + data files."""
+    import json
+    import urllib.request
+
+    from deeplearning4j_tpu import (
+        Activation, DenseLayer, InputType, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer, Sgd, WeightInit)
+    from deeplearning4j_tpu.keras.server import (
+        DeepLearning4jEntryPoint, EntryPointFitParameters, KerasBridgeServer)
+    from deeplearning4j_tpu.util.model_serializer import ModelSerializer
+
+    b = (NeuralNetConfiguration.Builder().seed(1).weight_init(WeightInit.XAVIER)
+         .activation(Activation.TANH).updater(Sgd(learning_rate=0.1))
+         .dtype("float64").list())
+    b.layer(DenseLayer(n_out=6))
+    b.layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX))
+    net = MultiLayerNetwork(
+        b.set_input_type(InputType.feed_forward(4)).build()).init()
+    model_path = os.path.join(tmp_path, "model.zip")
+    ModelSerializer.write_model(net, model_path)
+    x = RNG.rand(32, 4)
+    y = np.eye(3)[RNG.randint(0, 3, 32)]
+    xp, yp = os.path.join(tmp_path, "x.npy"), os.path.join(tmp_path, "y.npy")
+    np.save(xp, x)
+    np.save(yp, y)
+
+    # in-process entry point (the py4j-gateway analog surface)
+    out_path = os.path.join(tmp_path, "trained.zip")
+    res = DeepLearning4jEntryPoint().fit(EntryPointFitParameters(
+        model_path, xp, yp, batch_size=8, nb_epoch=2, save_path=out_path))
+    assert np.isfinite(res["score"]) and res["steps"] == 8
+    assert os.path.exists(out_path)
+
+    # over HTTP
+    server = KerasBridgeServer()
+    try:
+        req = urllib.request.Request(
+            server.address + "/fit",
+            data=json.dumps({"model_file_path": model_path,
+                             "train_features_path": xp,
+                             "train_labels_path": yp,
+                             "batch_size": 8, "nb_epoch": 1}).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            res = json.loads(r.read().decode())
+        assert np.isfinite(res["score"]) and res["steps"] == 4
+    finally:
+        server.stop()
