@@ -1,0 +1,18 @@
+"""LeNet on MNIST — the canonical first example (ref dl4j-examples LenetMnistExample)."""
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu import Adam
+from deeplearning4j_tpu.datasets.impl import MnistDataSetIterator
+from deeplearning4j_tpu.models import LeNet
+from deeplearning4j_tpu.optimize.listeners import ScoreIterationListener
+
+net = LeNet(num_labels=10, updater=Adam(learning_rate=1e-3)).init()
+net.set_listeners(ScoreIterationListener(10))
+net.fit(MnistDataSetIterator(batch=64, num_examples=2048), epochs=3)
+print(net.evaluate(MnistDataSetIterator(batch=64, train=False)).stats())
+
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

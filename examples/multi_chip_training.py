@@ -1,0 +1,21 @@
+"""Data-parallel training over every available chip via ParallelWrapper
+(ref dl4j-examples ParallelWrapper usage). On one chip this still runs —
+the same code scales to a full mesh."""
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from deeplearning4j_tpu import Adam
+from deeplearning4j_tpu.datasets.impl import MnistDataSetIterator
+from deeplearning4j_tpu.models import LeNet
+from deeplearning4j_tpu.parallel import ParallelWrapper, TrainingMode
+
+net = LeNet(num_labels=10, updater=Adam(learning_rate=1e-3)).init()
+pw = (ParallelWrapper.Builder(net)
+      .training_mode(TrainingMode.SHARED_GRADIENTS)
+      .gradients_threshold(1e-3)
+      .build())
+pw.fit(MnistDataSetIterator(batch=64, num_examples=1024), epochs=2)
+print("final score:", pw.score())

@@ -1,0 +1,32 @@
+"""Freeze a trained feature extractor and retrain a new head
+(ref dl4j-examples TransferLearning examples)."""
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from deeplearning4j_tpu import (Activation, Adam, DenseLayer, InputType,
+                                MultiLayerNetwork, NeuralNetConfiguration,
+                                OutputLayer, WeightInit)
+from deeplearning4j_tpu.nn.transferlearning import (FineTuneConfiguration,
+                                                    TransferLearning)
+
+b = (NeuralNetConfiguration.Builder().seed(7).weight_init(WeightInit.XAVIER)
+     .activation(Activation.RELU).updater(Adam(learning_rate=1e-2)).list())
+b.layer(DenseLayer(n_out=32))
+b.layer(DenseLayer(n_out=16))
+b.layer(OutputLayer(n_out=5, activation=Activation.SOFTMAX))
+net = MultiLayerNetwork(b.set_input_type(InputType.feed_forward(10)).build()).init()
+rng = np.random.RandomState(0)
+net.fit(rng.rand(256, 10), np.eye(5)[rng.randint(0, 5, 256)], epochs=5)
+
+new_net = (TransferLearning.Builder(net)
+           .fine_tune_configuration(FineTuneConfiguration.Builder()
+                                    .updater(Adam(learning_rate=1e-3)).build())
+           .set_feature_extractor(1)       # freeze the two dense layers
+           .remove_output_layer()
+           .add_layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX))
+           .build())
+new_net.fit(rng.rand(128, 10), np.eye(3)[rng.randint(0, 3, 128)], epochs=5)
+print("transfer score:", new_net.score())

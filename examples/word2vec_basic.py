@@ -1,0 +1,23 @@
+"""Word2Vec over a text file (ref dl4j-examples Word2VecRawTextExample)."""
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import sys
+
+from deeplearning4j_tpu.nlp import (BasicLineIterator, CollectionSentenceIterator,
+                                    DefaultTokenizerFactory, Word2Vec)
+
+corpus = (BasicLineIterator(sys.argv[1]) if len(sys.argv) > 1 else
+          CollectionSentenceIterator(
+              ["the quick brown fox jumps over the lazy dog",
+               "the lazy dog sleeps while the quick fox runs"] * 200))
+w2v = (Word2Vec.Builder().layerSize(64).windowSize(5).negativeSample(5)
+       .minWordFrequency(2).epochs(5).learningRate(0.1).batchSize(512)
+       .iterate(corpus).tokenizerFactory(DefaultTokenizerFactory()).build())
+w2v.fit()
+print("nearest to 'dog':", w2v.words_nearest("dog", top_n=5))
+
+import os
+import sys
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
