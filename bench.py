@@ -70,7 +70,7 @@ def bench_lenet(batch=128, steps=200):
             "batch": batch}
 
 
-def bench_graves_lstm(batch=64, seq_len=50, steps=50, compute_dtype="bfloat16"):
+def bench_graves_lstm(batch=512, seq_len=100, steps=20, compute_dtype="bfloat16"):
     """BASELINE config 4: GravesLSTM char-RNN tokens/sec (zoo TextGenerationLSTM:
     GravesLSTM(256)x2 -> RnnOutputLayer over 47 chars, the LSTMHelpers.java:200/496
     hot loop rendered as one scanned XLA computation)."""
