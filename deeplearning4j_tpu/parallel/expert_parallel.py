@@ -1,0 +1,172 @@
+"""Expert parallelism: Switch-style mixture-of-experts over Mesh('expert').
+
+No reference counterpart (pre-MoE era); this is the ep dimension of the
+parallelism suite. Design (Switch/GShard, einsum-dispatch formulation):
+
+- top-1 gating over E experts, computed identically on every device from the
+  replicated token batch;
+- capacity-bounded dispatch: each expert processes at most C tokens; the
+  dispatch is a one-hot (tokens x capacity) matrix so scatter/gather become
+  TWO MXU matmuls per device (the classic MoE trick — no dynamic shapes);
+- each device owns ONE expert's FFN weights (sharded over 'expert'); tokens
+  are combined with their gate probability through one psum (each token has
+  exactly one nonzero expert contribution);
+- Switch auxiliary load-balancing loss (E * sum f_e P_e) included.
+
+Overflow tokens (beyond capacity) pass through the residual path with zero
+expert contribution, exactly as in Switch Transformers.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class ExpertParallelMoE:
+    """One MoE FFN block: router + E expert MLPs (d -> hidden -> d), experts
+    sharded over Mesh('expert'). Trains with SGD on a jitted sharded step."""
+
+    def __init__(self, d_model: int, hidden: int, mesh: Optional[Mesh] = None,
+                 axis: str = "expert", capacity_factor: float = 1.5,
+                 aux_loss_weight: float = 0.01, learning_rate: float = 0.1,
+                 seed: int = 0, dtype=jnp.float64):
+        self.axis = axis
+        self.mesh = mesh or Mesh(np.asarray(jax.devices()), (axis,))
+        self.E = self.mesh.shape[axis]
+        self.d = int(d_model)
+        self.hidden = int(hidden)
+        self.capacity_factor = float(capacity_factor)
+        self.aux_w = float(aux_loss_weight)
+        self.lr = float(learning_rate)
+        rng = np.random.RandomState(seed)
+        E, d, h = self.E, self.d, self.hidden
+        ex = NamedSharding(self.mesh, P(axis))
+        rep = NamedSharding(self.mesh, P())
+        self.params = {
+            "Wg": jax.device_put(jnp.asarray(
+                (rng.randn(d, E) / np.sqrt(d)).astype(dtype)), rep),
+            "W1": jax.device_put(jnp.asarray(
+                (rng.randn(E, d, h) / np.sqrt(d)).astype(dtype)), ex),
+            "b1": jax.device_put(jnp.zeros((E, h), dtype), ex),
+            "W2": jax.device_put(jnp.asarray(
+                (rng.randn(E, h, d) / np.sqrt(h)).astype(dtype)), ex),
+            "b2": jax.device_put(jnp.zeros((E, d), dtype), ex),
+        }
+        self._step = None
+        self._fwd = None
+
+    def _capacity(self, T: int) -> int:
+        return max(1, int(np.ceil(T / self.E * self.capacity_factor)))
+
+    # --------------- routing (identical on every device) ---------------
+    def _route(self, Wg, x):
+        T = x.shape[0]
+        C = self._capacity(T)
+        logits = x @ Wg                            # (T, E)
+        probs = jax.nn.softmax(logits, axis=-1)
+        top = jnp.argmax(probs, axis=-1)           # (T,)
+        onehot = jax.nn.one_hot(top, self.E, dtype=x.dtype)  # (T, E)
+        # position of each token within its expert queue
+        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0      # (T, E), -1 if not routed
+        keep = jnp.logical_and(pos >= 0, pos < C)
+        # Switch aux loss: E * sum_e (fraction routed to e) * (mean prob of e)
+        f = jnp.mean(onehot, axis=0)
+        Pm = jnp.mean(probs, axis=-2)
+        aux = self.E * jnp.sum(f * Pm)
+        gate = jnp.sum(probs * onehot, axis=-1)    # (T,) top-1 prob
+        return pos, keep, gate, aux, C
+
+    # --------------- mesh-local compute ---------------
+    def _local_forward(self, p, x, *, return_aux=False):
+        axis = self.axis
+        my = lax.axis_index(axis)
+        pos, keep, gate, aux, C = self._route(p["Wg"], x)
+        # my expert's dispatch: (T, C) one-hot (token t -> slot pos[t, my])
+        mypos = pos[:, my]
+        mykeep = keep[:, my]
+        disp = jax.nn.one_hot(jnp.where(mykeep, mypos, -1), C, dtype=x.dtype)
+        expert_in = disp.T @ x                      # (C, d) gather as matmul
+        h = jax.nn.relu(expert_in @ p["W1"][0] + p["b1"][0])
+        out_e = h @ p["W2"][0] + p["b2"][0]         # (C, d)
+        y_my = (disp @ out_e) * gate[:, None]       # (T, d) scatter as matmul
+        y = lax.psum(y_my, axis)                    # combine (one expert/token)
+        if return_aux:
+            return y, aux
+        return y
+
+    def _local_loss(self, p, x, y_true):
+        out, aux = self._local_forward(p, x, return_aux=True)
+        mse = jnp.mean(jnp.sum((out - y_true) ** 2, axis=-1))
+        return mse + self.aux_w * aux
+
+    def _specs(self):
+        a = self.axis
+        return {"Wg": P(), "W1": P(a), "b1": P(a), "W2": P(a), "b2": P(a)}
+
+    def _build(self):
+        pspec = self._specs()
+        E = self.E
+
+        axis = self.axis
+
+        def local_step(p, x, y):
+            loss, g = jax.value_and_grad(self._local_loss)(p, x, y)
+            # Two manual-AD corrections (see tensor_parallel.py):
+            # 1. each device's Wg grad covers only ITS expert's token subset —
+            #    the replicated router needs an explicit psum over the mesh;
+            # 2. every path upstream of the combine-psum carries an E factor
+            #    from the psum transpose (and the router psum adds the same E
+            #    to both its gate and aux paths) — one global /E restores
+            #    exact SGD.
+            g = dict(g)
+            g["Wg"] = lax.psum(g["Wg"], axis)
+            g = jax.tree_util.tree_map(lambda v: v / E, g)
+            return (jax.tree_util.tree_map(lambda w, d: w - self.lr * d, p, g),
+                    loss)
+
+        self._step = jax.jit(jax.shard_map(
+            local_step, mesh=self.mesh, in_specs=(pspec, P(), P()),
+            out_specs=(pspec, P()), check_vma=False), donate_argnums=(0,))
+        self._fwd = jax.jit(jax.shard_map(
+            lambda p, x: self._local_forward(p, x), mesh=self.mesh,
+            in_specs=(pspec, P()), out_specs=P(), check_vma=False))
+
+    # --------------- public API ---------------
+    def forward(self, x):
+        if self._fwd is None:
+            self._build()
+        return self._fwd(self.params, jnp.asarray(x))
+
+    def fit_batch(self, x, y) -> float:
+        if self._step is None:
+            self._build()
+        self.params, loss = self._step(self.params, jnp.asarray(x),
+                                       jnp.asarray(y))
+        return float(loss)
+
+    def gathered_params(self):
+        return {k: np.asarray(v) for k, v in self.params.items()}
+
+    # single-device oracle (same routing/capacity semantics) for tests
+    def reference_forward(self, params, x):
+        x = np.asarray(x)
+        T = x.shape[0]
+        C = self._capacity(T)
+        logits = x @ params["Wg"]
+        probs = np.exp(logits - logits.max(1, keepdims=True))
+        probs /= probs.sum(1, keepdims=True)
+        top = probs.argmax(1)
+        out = np.zeros_like(x)
+        counts = np.zeros(self.E, int)
+        for t in range(T):
+            e = top[t]
+            if counts[e] < C:
+                h = np.maximum(x[t] @ params["W1"][e] + params["b1"][e], 0)
+                out[t] = (h @ params["W2"][e] + params["b2"][e]) * probs[t, e]
+            counts[e] += 1
+        return out

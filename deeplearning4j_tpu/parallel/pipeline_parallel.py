@@ -1,0 +1,172 @@
+"""Pipeline (stage) parallelism: layers sharded over Mesh('pipe') with a
+GPipe-style microbatch schedule.
+
+No reference counterpart (the reference's models fit one device); this is the
+scale dimension a TPU framework needs when the LAYER STACK outgrows one chip.
+Design (the scaling-book pipelining recipe):
+
+- each device owns one contiguous stage of the network (here: one dense block
+  per stage, weights sharded over 'pipe');
+- the global batch splits into M microbatches; on every tick each stage
+  computes on the microbatch it holds and `ppermute`s the result to its
+  neighbor — after S-1 warmup ticks all stages work concurrently (the bubble
+  is the standard (S-1)/(M+S-1) fraction);
+- the whole schedule is ONE `lax.scan` inside `shard_map`, and `jax.grad`
+  differentiates straight through it (ppermute transposes to the reverse
+  permutation), so the backward pipeline needs no hand scheduling.
+
+`PipelineParallelMLP` packages S dense stages + loss/SGD for the dryrun/tests.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class PipelineParallelMLP:
+    """S equal dense stages (tanh between, identity at the end) pipelined over
+    Mesh('pipe'); stage s holds W[s] (n, n) + b[s]. Output head + loss live on
+    the LAST stage; every device returns the (replicated via psum) loss."""
+
+    def __init__(self, width: int, num_stages: Optional[int] = None,
+                 n_out: Optional[int] = None, mesh: Optional[Mesh] = None,
+                 axis: str = "pipe", microbatches: int = 4,
+                 learning_rate: float = 0.1, seed: int = 0,
+                 dtype=jnp.float64):
+        self.axis = axis
+        self.mesh = mesh or Mesh(np.asarray(jax.devices()), (axis,))
+        self.S = num_stages or self.mesh.shape[axis]
+        assert self.S == self.mesh.shape[axis]
+        self.width = int(width)
+        self.n_out = int(n_out or width)
+        self.M = int(microbatches)
+        self.lr = float(learning_rate)
+        rng = np.random.RandomState(seed)
+        # stage weights stacked on a leading 'stage' axis, sharded over pipe
+        W = (rng.randn(self.S, width, width) / np.sqrt(width)).astype(dtype)
+        b = np.zeros((self.S, width), dtype)
+        Wout = (rng.randn(width, self.n_out) / np.sqrt(width)).astype(dtype)
+        bout = np.zeros((self.n_out,), dtype)
+        st = NamedSharding(self.mesh, P(axis))
+        rep = NamedSharding(self.mesh, P())
+        self.params = {
+            "W": jax.device_put(jnp.asarray(W), st),
+            "b": jax.device_put(jnp.asarray(b), st),
+            "Wout": jax.device_put(jnp.asarray(Wout), rep),
+            "bout": jax.device_put(jnp.asarray(bout), rep),
+        }
+        self._step = None
+        self._fwd = None
+
+    # ---------------- mesh-local pipelined forward ----------------
+    def _local_forward(self, p, x):
+        """Inside shard_map: p["W"] is (1, n, n) — this stage's block; x is the
+        full (B, n) batch (replicated). Returns (B, n) final-stage activations
+        REPLICATED via psum broadcast from the last stage."""
+        axis = self.axis
+        S, M = self.S, self.M
+        my = lax.axis_index(axis)
+        W = p["W"][0]
+        b = p["b"][0]
+        B = x.shape[0]
+        assert B % M == 0, f"batch {B} % microbatches {M} != 0"
+        mb = B // M
+        xs = x.reshape(M, mb, -1)
+        n_ticks = M + S - 1
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def stage_fn(h, is_last):
+            z = h @ W + b
+            # hidden stages tanh; the last stage stays linear (head applied after)
+            return jnp.where(is_last, z, jnp.tanh(z))
+
+        is_last = (my == S - 1)
+
+        def tick(carry, t):
+            buf, outs = carry           # buf: (mb, n) activation held HERE
+            # stage 0 ingests microbatch t (when valid); others use the buffer
+            feed = jnp.where(t < M, t, 0)
+            inject = xs[feed]
+            h_in = jnp.where(my == 0, inject, buf)
+            h_out = stage_fn(h_in, is_last)
+            # last stage records its finished microbatch (index t - (S-1))
+            out_idx = t - (S - 1)
+            valid = jnp.logical_and(out_idx >= 0, is_last)
+            outs = lax.cond(
+                jnp.logical_and(out_idx >= 0, True),
+                lambda o: o.at[jnp.maximum(out_idx, 0)].add(
+                    jnp.where(valid, h_out, 0.0)),
+                lambda o: o, outs)
+            # rotate activations to the next stage
+            buf = lax.ppermute(h_out, axis, perm)
+            return (buf, outs), None
+
+        buf0 = jnp.zeros((mb, self.width), x.dtype)
+        outs0 = jnp.zeros((M, mb, self.width), x.dtype)
+        (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(n_ticks))
+        # only the last stage accumulated outputs; broadcast to all stages
+        outs = lax.psum(outs, axis)  # other stages contributed zeros
+        h = outs.reshape(B, self.width)
+        return h @ p["Wout"] + p["bout"]
+
+    def _local_loss(self, p, x, y):
+        logits = self._local_forward(p, x)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        return -jnp.mean(jnp.sum(y * logp, axis=-1))
+
+    def _specs(self):
+        return {"W": P(self.axis), "b": P(self.axis), "Wout": P(), "bout": P()}
+
+    def _build(self):
+        pspec = self._specs()
+        S = self.S
+
+        def local_step(p, x, y):
+            loss, g = jax.value_and_grad(self._local_loss)(p, x, y)
+            # stage-sharded W/b grads are shard-local and exact; the replicated
+            # head (Wout/bout) gets its cotangent from the psum broadcast —
+            # every device computes the full head grad, and the pre-psum path
+            # scales by S exactly as in tensor_parallel.py. Wout/bout grads are
+            # computed identically on all devices (full outs) -> exact; W/b sit
+            # upstream of the psum -> divide by S.
+            g = {"W": g["W"] / S, "b": g["b"] / S,
+                 "Wout": g["Wout"], "bout": g["bout"]}
+            return (jax.tree_util.tree_map(lambda w, d: w - self.lr * d, p, g),
+                    loss)
+
+        self._step = jax.jit(jax.shard_map(
+            local_step, mesh=self.mesh, in_specs=(pspec, P(), P()),
+            out_specs=(pspec, P()), check_vma=False), donate_argnums=(0,))
+        self._fwd = jax.jit(jax.shard_map(
+            self._local_forward, mesh=self.mesh, in_specs=(pspec, P()),
+            out_specs=P(), check_vma=False))
+
+    # ---------------- public API ----------------
+    def fit_batch(self, x, y) -> float:
+        if self._step is None:
+            self._build()
+        self.params, loss = self._step(self.params, jnp.asarray(x),
+                                       jnp.asarray(y))
+        return float(loss)
+
+    def forward(self, x):
+        if self._fwd is None:
+            self._build()
+        return self._fwd(self.params, jnp.asarray(x))
+
+    def gathered_params(self):
+        return {k: np.asarray(v) for k, v in self.params.items()}
+
+    # single-device oracle for tests
+    def reference_forward(self, params, x):
+        h = np.asarray(x)
+        for s in range(self.S):
+            z = h @ params["W"][s] + params["b"][s]
+            h = z if s == self.S - 1 else np.tanh(z)
+        return h @ params["Wout"] + params["bout"]
