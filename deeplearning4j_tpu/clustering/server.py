@@ -2,78 +2,47 @@
 
 Parity: ref deeplearning4j-nearestneighbors-parent/nearestneighbor-server
 (NearestNeighborsServer exposing /knn over HTTP with a vectorized index) and
-nearestneighbors-client. Same stdlib-HTTP rendering as the UI server; the index
-is the XLA brute-force NearestNeighbors (MXU distance block), so each request is
-one jitted call.
+nearestneighbors-client. Built on the shared JSON-HTTP helper; the index is the
+XLA brute-force NearestNeighbors (MXU distance block), so each request is one
+jitted call. Malformed requests return JSON errors (400), not dropped
+connections.
 """
 from __future__ import annotations
 
 import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
 
 from deeplearning4j_tpu.clustering.knn import NearestNeighbors
+from deeplearning4j_tpu.util.http import JsonHttpServer
 
 
-class NearestNeighborsServer:
+class NearestNeighborsServer(JsonHttpServer):
     """(ref server/NearestNeighborsServer.java)"""
 
     def __init__(self, data, port: int = 0, distance: str = "euclidean"):
         index = NearestNeighbors(data, distance=distance)
-        n_points = np.asarray(data).shape[0]
+        n_points = int(np.asarray(data).shape[0])
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
+        def knn(req: dict):
+            k = int(req.get("k", 5))
+            if not 1 <= k <= n_points:
+                raise ValueError(f"k={k} out of range [1, {n_points}]")
+            if "index" in req:   # query by stored point id (ref knn by index)
+                i = int(req["index"])
+                if not 0 <= i < n_points:
+                    raise ValueError(f"index {i} out of range")
+                q = np.asarray(index.data[i])
+            else:
+                q = np.asarray(req["vector"], np.float32)
+            dist, idx = index.search(q, k=k)
+            return {"indices": idx[0].tolist(), "distances": dist[0].tolist()}
 
-            def _json(self, obj, code=200):
-                body = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_GET(self):
-                if self.path == "/status":
-                    self._json({"points": int(n_points), "ok": True})
-                else:
-                    self._json({"error": "not found"}, 404)
-
-            def do_POST(self):
-                if self.path != "/knn":
-                    self._json({"error": "not found"}, 404)
-                    return
-                n = int(self.headers.get("Content-Length", "0"))
-                req = json.loads(self.rfile.read(n).decode())
-                k = int(req.get("k", 5))
-                if "index" in req:   # query by stored point id (ref knn by index)
-                    q = np.asarray(index.data[int(req["index"])])
-                else:
-                    q = np.asarray(req["vector"], np.float32)
-                dist, idx = index.search(q, k=k)
-                self._json({"indices": idx[0].tolist(),
-                            "distances": dist[0].tolist()})
-
-        self._httpd = ThreadingHTTPServer(("localhost", port), Handler)
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
-
-    @property
-    def port(self) -> int:
-        return self._httpd.server_address[1]
-
-    @property
-    def address(self) -> str:
-        return f"http://localhost:{self.port}"
-
-    def stop(self):
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        super().__init__({
+            "GET /status": lambda q: {"points": n_points, "ok": True},
+            "POST /knn": knn,
+        }, port=port)
 
 
 class NearestNeighborsClient:

@@ -300,8 +300,3 @@ class EarlyStoppingTrainer:
 
 # alias matching reference naming (EarlyStoppingGraphTrainer)
 EarlyStoppingGraphTrainer = EarlyStoppingTrainer
-
-
-# API-parity alias (ref trainer/EarlyStoppingGraphTrainer.java — here the one
-# trainer serves MultiLayerNetwork and ComputationGraph alike)
-EarlyStoppingGraphTrainer = EarlyStoppingTrainer

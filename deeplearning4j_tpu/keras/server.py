@@ -4,19 +4,19 @@ process.
 Parity: ref deeplearning4j-keras — Server.java launches a py4j GatewayServer
 around DeepLearning4jEntryPoint.fit(EntryPointFitParameters): the Python/Keras
 side hands over a saved Keras model file + feature/label data files and DL4J
-trains it. TPU rendering: the same entry-point contract over stdlib HTTP (py4j
-is a JVM artifact): POST /fit with the file-path parameters; the server imports
-the model (Keras .h5 via keras/model_import, or a framework zip), loads .npy
-feature/label files, fits, and returns the score + optional save path.
+trains it. TPU rendering: the same entry-point contract over the shared
+JSON-HTTP helper (py4j is a JVM artifact): POST /fit with the file-path
+parameters; the server imports the model (Keras .h5 via keras/model_import, or
+a framework zip), loads .npy feature/label files, fits, and returns the score +
+optional save path. Failures come back as JSON errors.
 """
 from __future__ import annotations
 
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 import numpy as np
+
+from deeplearning4j_tpu.util.http import JsonHttpServer
 
 
 class EntryPointFitParameters:
@@ -68,55 +68,13 @@ class DeepLearning4jEntryPoint:
         return ModelGuesser.load_model_guess(path)
 
 
-class KerasBridgeServer:
+class KerasBridgeServer(JsonHttpServer):
     """(ref Server.java) — HTTP rendering of the py4j gateway."""
 
     def __init__(self, port: int = 0):
         entry = DeepLearning4jEntryPoint()
-
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def _json(self, obj, code=200):
-                body = json.dumps(obj).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_GET(self):
-                if self.path == "/status":
-                    self._json({"ok": True})
-                else:
-                    self._json({"error": "not found"}, 404)
-
-            def do_POST(self):
-                if self.path != "/fit":
-                    self._json({"error": "not found"}, 404)
-                    return
-                n = int(self.headers.get("Content-Length", "0"))
-                try:
-                    params = EntryPointFitParameters.from_dict(
-                        json.loads(self.rfile.read(n).decode()))
-                    self._json(entry.fit(params))
-                except Exception as e:  # surfaced to the remote caller
-                    self._json({"error": f"{type(e).__name__}: {e}"}, 500)
-
-        self._httpd = ThreadingHTTPServer(("localhost", port), Handler)
-        self._thread = threading.Thread(target=self._httpd.serve_forever,
-                                        daemon=True)
-        self._thread.start()
-
-    @property
-    def port(self) -> int:
-        return self._httpd.server_address[1]
-
-    @property
-    def address(self) -> str:
-        return f"http://localhost:{self.port}"
-
-    def stop(self):
-        self._httpd.shutdown()
-        self._httpd.server_close()
+        super().__init__({
+            "GET /status": lambda q: {"ok": True},
+            "POST /fit": lambda body: entry.fit(
+                EntryPointFitParameters.from_dict(body)),
+        }, port=port)

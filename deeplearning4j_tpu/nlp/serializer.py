@@ -68,14 +68,13 @@ class WordVectorSerializer:
             if len(head) == 2 and all(t.isdigit() for t in head):
                 pass  # word2vec header: "V D"
             else:  # headerless GloVe text format (ref loadTxt glove handling)
-                parts = first.split(" ")
+                parts = first.split()
                 words.append(parts[0])
                 rows.append([float(v) for v in parts[1:]])
             for line in f:
-                line = line.rstrip("\n")
-                if not line:
+                parts = line.split()  # tolerates trailing whitespace
+                if not parts:
                     continue
-                parts = line.split(" ")
                 words.append(parts[0])
                 rows.append([float(v) for v in parts[1:]])
         vocab = VocabCache()

@@ -376,7 +376,9 @@ class TransferLearningGraph:
                 for n2, other in conf.nodes.items():
                     if name in other.inputs and other.kind == "layer" \
                             and hasattr(other.conf, "n_in"):
-                        other.conf.n_in = 0
+                        # set directly: correct even for graphs built without
+                        # input types (where no re-inference pass runs)
+                        other.conf.n_in = n_out
                         self._reinit.add(n2)
 
             # freeze the feature extractor (named vertices + ancestors)

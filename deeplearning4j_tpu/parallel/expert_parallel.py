@@ -71,8 +71,10 @@ class ExpertParallelMoE:
         probs = jax.nn.softmax(logits, axis=-1)
         top = jnp.argmax(probs, axis=-1)           # (T,)
         onehot = jax.nn.one_hot(top, self.E, dtype=x.dtype)  # (T, E)
-        # position of each token within its expert queue
-        pos = jnp.cumsum(onehot, axis=0) * onehot - 1.0      # (T, E), -1 if not routed
+        # position of each token within its expert queue (kept integer so the
+        # dispatch one_hot gets integer indices)
+        ioh = onehot.astype(jnp.int32)
+        pos = jnp.cumsum(ioh, axis=0) * ioh - 1              # (T, E), -1 if not routed
         keep = jnp.logical_and(pos >= 0, pos < C)
         # Switch aux loss: E * sum_e (fraction routed to e) * (mean prob of e)
         f = jnp.mean(onehot, axis=0)

@@ -19,7 +19,6 @@ Design (the scaling-book pipelining recipe):
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -98,11 +97,9 @@ class PipelineParallelMLP:
             # last stage records its finished microbatch (index t - (S-1))
             out_idx = t - (S - 1)
             valid = jnp.logical_and(out_idx >= 0, is_last)
-            outs = lax.cond(
-                jnp.logical_and(out_idx >= 0, True),
-                lambda o: o.at[jnp.maximum(out_idx, 0)].add(
-                    jnp.where(valid, h_out, 0.0)),
-                lambda o: o, outs)
+            # masked add: invalid/pre-warmup ticks add zeros at clamped slot 0
+            outs = outs.at[jnp.maximum(out_idx, 0)].add(
+                jnp.where(valid, h_out, 0.0))
             # rotate activations to the next stage
             buf = lax.ppermute(h_out, axis, perm)
             return (buf, outs), None

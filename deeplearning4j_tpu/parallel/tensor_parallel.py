@@ -15,7 +15,6 @@ with a jitted training step for the dryrun/test path.
 """
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax
@@ -25,7 +24,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
-def column_parallel_dense(x, W, b=None, *, axis: str = "model"):
+def column_parallel_dense(x, W, b=None):
     """Inside shard_map: x replicated, W/b sharded on the output dim.
     Returns feature-sharded activations (no collective)."""
     z = x @ W

@@ -1,0 +1,77 @@
+"""Shared JSON-over-HTTP micro-server used by the UI, KNN, and Keras-bridge
+services (one place for the handler boilerplate, bind/port plumbing, error
+rendering, and shutdown ordering)."""
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+
+class JsonHttpServer:
+    """Routes: dict "METHOD /path" -> fn. GET fns take (query: dict) and POST
+    fns take (body: dict); both return a JSON-able object. Exceptions render as
+    {"error": ...} with status 500 (ValueError/KeyError: 400); unknown paths
+    404. Start is immediate (daemon thread); `port`/`address`/`stop` as in the
+    reference servers."""
+
+    def __init__(self, routes: Dict[str, Callable], port: int = 0,
+                 host: str = "localhost"):
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj, default=str).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _dispatch(self, method):
+                from urllib.parse import parse_qs, urlparse
+                url = urlparse(self.path)
+                fn = routes.get(f"{method} {url.path}")
+                if fn is None:
+                    self._json({"error": "not found"}, 404)
+                    return
+                try:
+                    if method == "POST":
+                        n = int(self.headers.get("Content-Length", "0"))
+                        payload = json.loads(self.rfile.read(n).decode()) \
+                            if n else {}
+                    else:
+                        payload = {k: v[0] for k, v in
+                                   parse_qs(url.query).items()}
+                    self._json(fn(payload))
+                except (ValueError, KeyError, IndexError) as e:
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 400)
+                except Exception as e:
+                    self._json({"error": f"{type(e).__name__}: {e}"}, 500)
+
+            def do_GET(self):
+                self._dispatch("GET")
+
+            def do_POST(self):
+                self._dispatch("POST")
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    @property
+    def address(self) -> str:
+        return f"http://localhost:{self.port}"
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -12,7 +12,3 @@ net = LeNet(num_labels=10, updater=Adam(learning_rate=1e-3)).init()
 net.set_listeners(ScoreIterationListener(10))
 net.fit(MnistDataSetIterator(batch=64, num_examples=2048), epochs=3)
 print(net.evaluate(MnistDataSetIterator(batch=64, train=False)).stats())
-
-import os
-import sys
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -17,7 +17,3 @@ w2v = (Word2Vec.Builder().layerSize(64).windowSize(5).negativeSample(5)
        .iterate(corpus).tokenizerFactory(DefaultTokenizerFactory()).build())
 w2v.fit()
 print("nearest to 'dog':", w2v.words_nearest("dog", top_n=5))
-
-import os
-import sys
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
