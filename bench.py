@@ -47,7 +47,7 @@ def _synth(rng, batch, classes, *feature_shape):
     return x, y
 
 
-def bench_resnet50(batch=512, steps=20, compute_dtype="bfloat16"):
+def bench_resnet50(batch=1024, steps=15, compute_dtype="bfloat16"):
     from deeplearning4j_tpu.models import ResNet50
 
     net = ResNet50(num_labels=1000, seed=42, compute_dtype=compute_dtype).init()
