@@ -1,0 +1,112 @@
+"""Coverage for graph networks in contexts previously tested only with
+MultiLayerNetwork: ParallelWrapper training, the distributed facade, early
+stopping, and new-zoo-model convergence."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (
+    Activation, Adam, DenseLayer, GraphBuilder, InputType,
+    NeuralNetConfiguration, OutputLayer, Sgd, WeightInit)
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+
+RNG = np.random.RandomState(41)
+
+
+def small_graph(lr=0.1):
+    g = (NeuralNetConfiguration.Builder().seed(2).weight_init(WeightInit.XAVIER)
+         .activation(Activation.TANH).updater(Sgd(learning_rate=lr))
+         .dtype("float64").graph_builder())
+    (g.add_inputs("in")
+      .add_layer("d1", DenseLayer(n_out=8), "in")
+      .add_layer("out", OutputLayer(n_out=3, activation=Activation.SOFTMAX),
+                 "d1")
+      .set_outputs("out")
+      .set_input_types(InputType.feed_forward(5)))
+    return ComputationGraph(g.build()).init()
+
+
+def data(n=32):
+    x = RNG.rand(n, 5)
+    y = np.eye(3)[(x @ RNG.randn(5, 3)).argmax(1)]
+    return x, y
+
+
+def test_parallel_wrapper_trains_computation_graph():
+    """ParallelWrapper over a graph net on the 8-device mesh (the bench's
+    ResNet50 path, locked on CPU)."""
+    from deeplearning4j_tpu.parallel import ParallelWrapper, TrainingMode
+
+    net = small_graph()
+    pw = (ParallelWrapper.Builder(net).workers(8)
+          .training_mode(TrainingMode.SHARED_GRADIENTS)
+          .gradients_threshold(1e-3).build())
+    x, y = data(32)
+    first = None
+    for _ in range(20):
+        pw.fit(x, y)
+        if first is None:
+            first = pw.score()
+    assert pw.score() < first
+    # wrapped graph received the trained params and serves predictions
+    out = np.asarray(net.output(x))
+    assert out.shape == (32, 3)
+    acc = (out.argmax(1) == y.argmax(1)).mean()
+    assert acc > 0.6
+
+
+def test_distributed_computation_graph_facade():
+    from deeplearning4j_tpu.distributed import (
+        DistributedComputationGraph, ParameterAveragingTrainingMaster)
+
+    net = small_graph()
+    tm = ParameterAveragingTrainingMaster.Builder(16).averagingFrequency(1) \
+        .build()
+    sg = DistributedComputationGraph(net, tm)
+    x, y = data(32)
+    first = None
+    for _ in range(10):
+        sg.fit(DataSet(x, y))
+        if first is None:
+            first = sg.score()
+    assert sg.score() < first
+
+
+def test_early_stopping_graph_trainer():
+    from deeplearning4j_tpu.earlystopping.early_stopping import (
+        DataSetLossCalculator, EarlyStoppingConfiguration,
+        EarlyStoppingGraphTrainer, InMemoryModelSaver,
+        MaxEpochsTerminationCondition)
+
+    net = small_graph(lr=0.2)
+    x, y = data(48)
+    train_it = ListDataSetIterator([DataSet(x[:32], y[:32])])
+    val_it = ListDataSetIterator([DataSet(x[32:], y[32:])])
+    cfg = EarlyStoppingConfiguration(
+        epoch_termination_conditions=[MaxEpochsTerminationCondition(8)],
+        iteration_termination_conditions=[],
+        score_calculator=DataSetLossCalculator(val_it),
+        model_saver=InMemoryModelSaver(), evaluate_every_n_epochs=1)
+    result = EarlyStoppingGraphTrainer(cfg, net, train_it).fit()
+    assert result.best_model is not None
+    assert result.total_epochs >= 1
+    assert np.isfinite(result.best_model_score)
+
+
+@pytest.mark.parametrize("model_name", ["GoogLeNet", "FaceNetNN4Small2"])
+def test_new_zoo_models_train(model_name):
+    """The round's new zoo models actually LEARN on a tiny synthetic set (not
+    just produce fixture-matching forwards)."""
+    import deeplearning4j_tpu.models as models
+
+    cls = getattr(models, model_name)
+    shape = {"GoogLeNet": (3, 224, 224),
+             "FaceNetNN4Small2": (3, 96, 96)}[model_name]
+    net = cls(num_labels=3, seed=1, updater=Adam(learning_rate=1e-3)).init()
+    rng = np.random.RandomState(0)
+    x = rng.rand(6, *shape).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 6)]
+    losses = net.fit_on_device(x, y, steps=15)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
