@@ -186,6 +186,8 @@ class ComputationGraph:
         (single array if one output) (ref ComputationGraph.output). Jitted: the whole
         DAG is one cached XLA computation per input shape."""
         self._check_init()
+        if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+            inputs = tuple(inputs[0])  # output([a, b]) == output(a, b)
         ins = tuple(jnp.asarray(x, self.dtype) for x in inputs)
         if train:
             values, _, _ = self._forward_all(self.params_tree, self.state_tree,

@@ -291,12 +291,12 @@ class ParallelWrapper:
     # ---------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):
         """fit(x, y) | fit(DataSetIterator[, epochs]) (ref ParallelWrapper.fit :178)."""
-        from deeplearning4j_tpu.datasets.dataset import DataSet
+        from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
         self._ensure_setup()
         net = self.model
         if labels is not None:
             self._fit_one(DataSet(data, labels))
-        elif isinstance(data, DataSet):
+        elif isinstance(data, (DataSet, MultiDataSet)):
             self._fit_one(data)
         else:
             from deeplearning4j_tpu.datasets.iterators import AsyncDataSetIterator
@@ -313,18 +313,32 @@ class ParallelWrapper:
 
     def _fit_one(self, ds):
         net = self.model
-        x = jnp.asarray(ds.features, net.dtype)
-        y = jnp.asarray(ds.labels, net.dtype)
-        if x.shape[0] % self.workers != 0:
-            raise ValueError(
-                f"Batch size {x.shape[0]} not divisible by workers {self.workers}")
-        fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
-        lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
-        net._rng, sub = jax.random.split(net._rng)
-        # shard batch over the mesh
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
         bsh = NamedSharding(self.mesh, P("data"))
-        x = jax.device_put(x, bsh)
-        y = jax.device_put(y, bsh)
+
+        def place(a):
+            return jax.device_put(jnp.asarray(a, net.dtype), bsh)
+
+        if isinstance(ds, MultiDataSet):
+            # multi-input/-output graphs: every stream shards over the mesh
+            # (ref ParallelWrapper.fit(MultiDataSetIterator))
+            x = [place(f) for f in ds.features]
+            y = [place(l) for l in ds.labels]
+            n = x[0].shape[0]
+            fm = None if ds.features_masks is None else [
+                jnp.asarray(m) for m in ds.features_masks]
+            lm = None if ds.labels_masks is None else [
+                jnp.asarray(m) for m in ds.labels_masks]
+        else:
+            x = place(ds.features)
+            y = place(ds.labels)
+            n = x.shape[0]
+            fm = None if ds.features_mask is None else jnp.asarray(ds.features_mask)
+            lm = None if ds.labels_mask is None else jnp.asarray(ds.labels_mask)
+        if n % self.workers != 0:
+            raise ValueError(
+                f"Batch size {n} not divisible by workers {self.workers}")
+        net._rng, sub = jax.random.split(net._rng)
         self._carry, loss = self._step_fn(self._carry, sub, x, y, fm, lm)
         self._score = loss
         # host mirror of the device step counter: listeners must not force a

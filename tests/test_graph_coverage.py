@@ -110,3 +110,38 @@ def test_new_zoo_models_train(model_name):
     losses = net.fit_on_device(x, y, steps=15)
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
+
+
+def test_parallel_wrapper_multi_input_graph():
+    """MultiDataSet through ParallelWrapper: a two-input merge graph trains
+    data-parallel over the mesh (ref ParallelWrapper MultiDataSetIterator fit)."""
+    from deeplearning4j_tpu import MergeVertex
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.parallel import ParallelWrapper, TrainingMode
+
+    g = (NeuralNetConfiguration.Builder().seed(4).weight_init(WeightInit.XAVIER)
+         .activation(Activation.TANH).updater(Sgd(learning_rate=0.1))
+         .dtype("float64").graph_builder())
+    (g.add_inputs("a", "b")
+      .add_layer("da", DenseLayer(n_out=6), "a")
+      .add_layer("db", DenseLayer(n_out=6), "b")
+      .add_vertex("merge", MergeVertex(), "da", "db")
+      .add_layer("out", OutputLayer(n_out=2, activation=Activation.SOFTMAX),
+                 "merge")
+      .set_outputs("out")
+      .set_input_types(InputType.feed_forward(3), InputType.feed_forward(4)))
+    net = ComputationGraph(g.build()).init()
+
+    pw = (ParallelWrapper.Builder(net).workers(8)
+          .training_mode(TrainingMode.AVERAGING).averaging_frequency(1).build())
+    xa = RNG.rand(32, 3)
+    xb = RNG.rand(32, 4)
+    y = np.eye(2)[RNG.randint(0, 2, 32)]
+    first = None
+    for _ in range(15):
+        pw.fit(MultiDataSet([xa, xb], [y]))
+        if first is None:
+            first = pw.score()
+    assert pw.score() < first
+    out = np.asarray(net.output([xa, xb]))
+    assert out.shape == (32, 2)
