@@ -21,6 +21,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 class InferenceMode:
     SEQUENTIAL = "sequential"
     BATCHED = "batched"
+    # INPLACE (ref ParallelInference.java INPLACE): the caller's thread invokes
+    # the shared jitted executable directly — no queue, no observable machinery,
+    # no batch padding. Lowest latency; best when callers already batch.
+    INPLACE = "inplace"
 
 
 class _Observable:
@@ -67,6 +71,10 @@ class ParallelInference:
     # ---------------- public API (ref ParallelInference.output) ----------------
     def output(self, x) -> np.ndarray:
         """Synchronous single-request inference."""
+        if self.inference_mode == InferenceMode.INPLACE:
+            out = self.model.output(np.asarray(x))
+            out = out[0] if isinstance(out, list) else out
+            return np.asarray(out)
         if self.inference_mode == InferenceMode.SEQUENTIAL:
             return np.asarray(self._run(np.asarray(x)))
         obs = self.output_async(x)
@@ -74,7 +82,8 @@ class ParallelInference:
 
     def output_async(self, x) -> _Observable:
         obs = _Observable()
-        if self.inference_mode == InferenceMode.SEQUENTIAL:
+        if self.inference_mode in (InferenceMode.SEQUENTIAL,
+                                   InferenceMode.INPLACE):
             try:
                 obs._set(np.asarray(self._run(np.asarray(x))))
             except BaseException as e:

@@ -145,3 +145,19 @@ def test_parallel_wrapper_multi_input_graph():
     assert pw.score() < first
     out = np.asarray(net.output([xa, xb]))
     assert out.shape == (32, 2)
+
+
+def test_parallel_inference_inplace_mode():
+    """INPLACE inference mode: direct shared-executable calls
+    (ref ParallelInference.java INPLACE)."""
+    from deeplearning4j_tpu.parallel.parallel_inference import (
+        InferenceMode, ParallelInference)
+
+    net = small_graph()
+    pi = ParallelInference(net, inference_mode=InferenceMode.INPLACE)
+    x, _ = data(8)
+    out = pi.output(x)
+    assert out.shape == (8, 3)
+    assert np.allclose(out, np.asarray(net.output(x)), atol=1e-12)
+    obs = pi.output_async(x)
+    assert np.allclose(obs.get(timeout=10), out)
