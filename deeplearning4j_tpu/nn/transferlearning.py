@@ -440,3 +440,115 @@ class TransferLearningGraph:
 
 # ref API shape: TransferLearning.GraphBuilder(computationGraph)
 TransferLearning.GraphBuilder = TransferLearningGraph.GraphBuilder
+
+
+class TransferLearningGraphHelper:
+    """Featurize-and-train on a ComputationGraph's unfrozen subgraph
+    (ref TransferLearningHelper.java — the same helper serves ComputationGraph
+    in the reference; here the graph version is its own class).
+
+    The frozen set = the named frontier vertices and all their ancestors. The
+    unfrozen subgraph gets one new input per frozen->unfrozen boundary edge;
+    featurize() computes those boundary activations once (inference mode) so
+    the tail can be trained repeatedly on cached features."""
+
+    def __init__(self, net, frozen_outputs: Optional[List[str]] = None):
+        from deeplearning4j_tpu.nn.conf.graph_configuration import (
+            ComputationGraphConfiguration, GraphNode)
+        from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+        if frozen_outputs:
+            net = (TransferLearning.GraphBuilder(net)
+                   .set_feature_extractor(*frozen_outputs).build())
+        self.net = net
+        conf = net.conf
+        frozen = {n for n, node in conf.nodes.items()
+                  if node.kind == "layer" and getattr(node.conf, "frozen", False)}
+        # vertices whose every layer-ancestor is frozen count as frozen too
+        changed = True
+        while changed:
+            changed = False
+            for n, node in conf.nodes.items():
+                if n in frozen or node.kind == "layer":
+                    continue
+                deps = [i for i in node.inputs if i not in conf.inputs]
+                if deps and all(d in frozen for d in deps):
+                    frozen.add(n)
+                    changed = True
+        self.frozen = frozen
+        # boundary: frozen vertices feeding at least one unfrozen consumer
+        boundary = []
+        for n, node in conf.nodes.items():
+            if n in frozen:
+                continue
+            for i in node.inputs:
+                if i in frozen and i not in boundary:
+                    boundary.append(i)
+        self.boundary = boundary
+
+        # build the unfrozen subgraph: boundary vertices become inputs
+        known = dict(zip(conf.inputs, conf.input_types or []))
+        for name in conf.topo_order:
+            node = conf.nodes[name]
+            ins = [known[i] for i in node.inputs]
+            if node.kind == "layer":
+                t = ins[0]
+                if node.preprocessor is not None:
+                    t = node.preprocessor.get_output_type(t)
+                known[name] = node.conf.get_output_type(t)
+            else:
+                known[name] = node.conf.get_output_type(ins)
+        sub_nodes = {}
+        for n, node in conf.nodes.items():
+            if n in frozen:
+                continue
+            sub_nodes[n] = GraphNode(n, node.kind, node.conf, list(node.inputs),
+                                     node.preprocessor)
+        kept_inputs = [i for i in conf.inputs
+                       if any(i in nd.inputs for nd in sub_nodes.values())]
+        sub_inputs = list(boundary) + kept_inputs
+        sub_conf = ComputationGraphConfiguration(
+            inputs=sub_inputs,
+            outputs=list(conf.outputs),
+            nodes=sub_nodes,
+            global_conf=conf.global_conf,
+            input_types=[known[n] for n in sub_inputs])
+        self.sub = ComputationGraph(sub_conf)
+        # share trained values: init then overwrite by name
+        self.sub.init()
+        name_to_params = dict(zip(net.layer_names, net.params_tree))
+        for i, n in enumerate(self.sub.layer_names):
+            if n in name_to_params:
+                self.sub.params_tree[i] = {
+                    k: jnp.array(v, copy=True)
+                    for k, v in name_to_params[n].items()}
+        self.sub._opt_state = [u.init(p) for u, p in
+                               zip(self.sub._updaters, self.sub.params_tree)]
+
+    def featurize(self, ds):
+        """(features..., labels) -> boundary activations as the subgraph's
+        inputs (ref featurize)."""
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+        feats = ds.features if isinstance(ds, MultiDataSet) else [ds.features]
+        labels = ds.labels if isinstance(ds, MultiDataSet) else [ds.labels]
+        values = self.net.feed_forward(*feats, train=False)
+        new_inputs = [values[b] for b in self.boundary]
+        # pass through any original inputs the subgraph still consumes
+        for i, name in enumerate(self.net.conf.inputs):
+            if name in self.sub.conf.inputs:
+                new_inputs.append(feats[i])
+        return MultiDataSet(new_inputs, labels)
+
+    def fit_featurized(self, featurized):
+        """Train the unfrozen subgraph on cached boundary features, then write
+        its params back into the full graph."""
+        self.sub.fit_batch(featurized.features, featurized.labels)
+        name_to_idx = {n: i for i, n in enumerate(self.net.layer_names)}
+        for i, n in enumerate(self.sub.layer_names):
+            if n in name_to_idx:
+                self.net.params_tree[name_to_idx[n]] = {
+                    k: jnp.array(v, copy=True)
+                    for k, v in self.sub.params_tree[i].items()}
+        return self.net
+
+    def unfrozen_graph(self):
+        return self.sub

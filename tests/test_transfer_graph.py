@@ -101,3 +101,36 @@ def test_graph_transfer_remove_and_connections():
     x, y = data(2)
     out = np.asarray(new_net.output(x))
     assert out.shape == (8, 2)
+
+
+def test_graph_transfer_helper_featurize():
+    """Featurize-and-train on the unfrozen subgraph
+    (ref TransferLearningHelper for ComputationGraph)."""
+    from deeplearning4j_tpu.nn.transferlearning import TransferLearningGraphHelper
+
+    net = base_graph()
+    helper = TransferLearningGraphHelper(net, frozen_outputs=["pool"])
+    # frozen set covers conv+pool; the subgraph starts at the boundary
+    assert "conv" in helper.net.layer_names
+    assert "fc" in helper.sub.layer_names and "out" in helper.sub.layer_names
+    assert "conv" not in helper.sub.layer_names
+    assert helper.boundary == ["pool"]
+
+    x, y = data(5)
+    feat = helper.featurize(type("DS", (), {"features": x, "labels": y})())
+    assert len(feat.features) == 1  # boundary activations only
+
+    # training the featurized tail matches full-net scoring afterwards
+    full_before = np.asarray(helper.net.output(x))
+    for _ in range(5):
+        helper.fit_featurized(feat)
+    full_after = np.asarray(helper.net.output(x))
+    assert not np.allclose(full_before, full_after)
+    # frozen conv params untouched
+    ci = helper.net.layer_names.index("conv")
+    cg = base_graph()
+    assert np.allclose(np.asarray(helper.net.params_tree[ci]["W"]),
+                       np.asarray(cg.params_tree[cg.layer_names.index("conv")]["W"]))
+    # subgraph forward on featurized inputs equals full-net forward
+    sub_out = np.asarray(helper.sub.output(feat.features))
+    assert np.allclose(sub_out, full_after, atol=1e-10)
