@@ -31,13 +31,19 @@ class ConfusionMatrix:
 
 class Evaluation:
     def __init__(self, num_classes: Optional[int] = None,
-                 labels: Optional[List[str]] = None, top_n: int = 1):
+                 labels: Optional[List[str]] = None, top_n: int = 1,
+                 record_meta: bool = False):
         self.num_classes = num_classes
         self.label_names = labels
         self.top_n = int(top_n)
         self.confusion: Optional[ConfusionMatrix] = None
         self._top_n_correct = 0
         self._count = 0
+        # eval/meta parity (ref eval/meta/Prediction.java + RecordMetaData):
+        # when enabled, every misclassified example is recorded as
+        # (global_index, actual, predicted) for error inspection
+        self.record_meta = bool(record_meta)
+        self._errors: List[tuple] = []
 
     def _ensure(self, n: int):
         if self.confusion is None:
@@ -60,6 +66,12 @@ class Evaluation:
         predicted = np.argmax(predictions, axis=-1)
         # vectorized confusion accumulation — O(batch) numpy, no Python loop
         np.add.at(self.confusion.matrix, (actual, predicted), 1)
+        if self.record_meta:
+            wrong = np.nonzero(actual != predicted)[0]
+            base = self._count
+            self._errors.extend(
+                (int(base + i), int(actual[i]), int(predicted[i]))
+                for i in wrong)
         self._count += actual.shape[0]
         if self.top_n > 1:
             # true class within the top-N predicted scores
@@ -124,6 +136,15 @@ class Evaluation:
         if self.label_names and c < len(self.label_names):
             return self.label_names[c]
         return str(c)
+
+    def get_prediction_errors(self) -> List[tuple]:
+        """(global_index, actual_class, predicted_class) per misclassified
+        example, in evaluation order (ref eval/meta getPredictionErrors)."""
+        return list(self._errors)
+    getPredictionErrors = get_prediction_errors
+
+    def get_predictions_by_actual_class(self, cls: int) -> List[tuple]:
+        return [e for e in self._errors if e[1] == int(cls)]
 
     def stats(self, print_confusion: bool = False) -> str:
         m = self.confusion.matrix

@@ -45,3 +45,16 @@ def test_regression_evaluation():
     assert abs(re.mean_absolute_error(1) - 0.5) < 1e-9
     assert re.correlation_r2(0) > 0.99
     assert "RMSE" in re.stats()
+
+
+def test_evaluation_records_prediction_errors():
+    """eval/meta parity: misclassified examples recorded as
+    (index, actual, predicted) across batches (ref eval/meta/Prediction)."""
+    import numpy as np
+    from deeplearning4j_tpu.eval.evaluation import Evaluation
+    ev = Evaluation(record_meta=True)
+    ev.eval(np.eye(3)[[0, 1, 2]], np.eye(3)[[0, 2, 2]])
+    ev.eval(np.eye(3)[[2, 0]], np.eye(3)[[2, 1]])
+    assert ev.get_prediction_errors() == [(1, 1, 2), (4, 0, 1)]
+    assert ev.get_predictions_by_actual_class(0) == [(4, 0, 1)]
+    assert ev.accuracy() == pytest.approx(3 / 5)
