@@ -114,10 +114,13 @@ class ComputationGraph:
     # ------------------------------------------------------------- forward
     def _forward_all(self, params_tree, state_tree, inputs: List[jnp.ndarray], *,
                      train: bool, rng=None, fmasks: Optional[List] = None,
-                     stop_at_scores: bool = False, labels=None, lmasks=None):
+                     stop_at_scores: bool = False, labels=None, lmasks=None,
+                     rnn_init_states: Optional[List] = None):
         """Trace the whole DAG in topo order. If stop_at_scores, output-layer nodes
         contribute their loss instead of activations. Returns
-        (activations dict, new_states list, total_loss or None)."""
+        (activations dict, new_states list, total_loss or None); with
+        `rnn_init_states` (tBPTT: per-LSTM (h0, c0) in layer-name order, None
+        entries allowed) a 4th element — the final RNN states — is appended."""
         from deeplearning4j_tpu.nn.conf.layers.feedforward import EmbeddingLayer
         from deeplearning4j_tpu.util.dtypes import cast_floats
         cd = self.compute_dtype
@@ -137,6 +140,12 @@ class ComputationGraph:
             label_map = dict(zip(self.conf.outputs, labels))
             lmask_map = dict(zip(self.conf.outputs, lmasks or [None] * len(labels)))
         total_loss = jnp.asarray(0.0, self.dtype) if stop_at_scores else None
+        from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM as _LSTM
+        final_rnn: List = []
+        if rnn_init_states is not None:
+            from deeplearning4j_tpu.util.dtypes import cast_floats as _cf
+            if mixed:
+                rnn_init_states = _cf(rnn_init_states, cd)
 
         for name in self.conf.topo_order:
             node = nodes[name]
@@ -173,12 +182,27 @@ class ComputationGraph:
                                            train=train, rng=lrng, mask=mask)
                 values[name], masks[name] = out, m
             else:
-                out, ns, m = layer.forward(params_tree[i], state_tree[i], cur,
-                                           train=train, rng=lrng, mask=mask)
+                if isinstance(layer, _LSTM) and rnn_init_states is not None:
+                    # tBPTT segment: scan from the carried state, export final
+                    init = rnn_init_states[len(final_rnn)]
+                    out, (h, c) = layer._scan(
+                        params_tree[i], cur, mask,
+                        h0=None if init is None else init[0],
+                        c0=None if init is None else init[1])
+                    final_rnn.append((h, c))
+                    ns, m = state_tree[i], mask
+                else:
+                    if isinstance(layer, _LSTM):
+                        final_rnn.append(None)
+                    out, ns, m = layer.forward(params_tree[i], state_tree[i],
+                                               cur, train=train, rng=lrng,
+                                               mask=mask)
                 new_states[i] = ns
                 values[name], masks[name] = out, m
         if mixed:
             new_states = cast_floats(new_states, self.dtype)
+        if rnn_init_states is not None:
+            return values, new_states, total_loss, final_rnn
         return values, new_states, total_loss
 
     def output(self, *inputs, train: bool = False) -> Union[jnp.ndarray, List[jnp.ndarray]]:
@@ -219,30 +243,39 @@ class ComputationGraph:
         labels = _as_list(y)
         fmasks = _as_list(fmask) if fmask is not None else None
         lmasks = _as_list(lmask) if lmask is not None else None
-        _, new_states, loss = self._forward_all(
-            params_tree, state_tree, inputs, train=train, rng=rng, fmasks=fmasks,
-            stop_at_scores=True, labels=labels, lmasks=lmasks)
+        if rnn_init_states is not None:
+            _, new_states, loss, final_rnn = self._forward_all(
+                params_tree, state_tree, inputs, train=train, rng=rng,
+                fmasks=fmasks, stop_at_scores=True, labels=labels,
+                lmasks=lmasks, rnn_init_states=rnn_init_states)
+        else:
+            _, new_states, loss = self._forward_all(
+                params_tree, state_tree, inputs, train=train, rng=rng,
+                fmasks=fmasks, stop_at_scores=True, labels=labels,
+                lmasks=lmasks)
+            final_rnn = None
         reg = sum((self.conf.nodes[n].conf.regularization_score(p)
                    for n, p in zip(self.layer_names, params_tree)), jnp.asarray(0.0))
-        return loss + reg, (new_states, None)
+        return loss + reg, (new_states, final_rnn)
 
     # ------------------------------------------------------------- training
     def _build_train_step(self):
         updaters = self._updaters
         layer_confs = self.layers
 
-        def train_step(params_tree, opt_state, state_tree, step, rng, x, y, fmask, lmask):
-            (loss, (new_states, _)), grads = jax.value_and_grad(
+        def train_step(params_tree, opt_state, state_tree, step, rng, x, y,
+                       fmask, lmask, rnn_init_states):
+            (loss, (new_states, final_rnn)), grads = jax.value_and_grad(
                 self._loss_fn, has_aux=True)(params_tree, state_tree, x, y, fmask,
-                                             lmask, rng, True, None)
+                                             lmask, rng, True, rnn_init_states)
             new_params, new_opt = _apply_updates(layer_confs, updaters, grads,
                                                  opt_state, params_tree, step)
-            return new_params, new_opt, new_states, loss
+            return new_params, new_opt, new_states, loss, final_rnn
 
         self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2))
         return self._train_step_fn
 
-    def fit_batch(self, x, y, fmask=None, lmask=None):
+    def fit_batch(self, x, y, fmask=None, lmask=None, rnn_init_states=None):
         self._check_init()
         x = tuple(jnp.asarray(v, self.dtype) for v in _as_list(x))
         y = tuple(jnp.asarray(v, self.dtype) for v in _as_list(y))
@@ -255,9 +288,10 @@ class ComputationGraph:
         if self._accumulator is not None:
             return self._fit_batch_accumulated(x, y, fmask, lmask, sub)
 
-        new_params, new_opt, new_states, loss = self._train_step_fn(
+        new_params, new_opt, new_states, loss, final_rnn = self._train_step_fn(
             self.params_tree, self._opt_state, self.state_tree,
-            jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask)
+            jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask,
+            rnn_init_states)
         self.params_tree = new_params
         self._opt_state = new_opt
         self.state_tree = new_states
@@ -265,6 +299,7 @@ class ComputationGraph:
         self._score = loss
         for lst in self._listeners:
             lst.iteration_done(self, self._step)
+        return final_rnn
 
     def _fit_batch_accumulated(self, x, y, fmask, lmask, sub):
         (loss, (new_states, _)), grads = jax.value_and_grad(
@@ -386,12 +421,52 @@ class ComputationGraph:
                     lst.on_epoch_end(self)
         return self
 
+    def fit_tbptt(self, x, y, fmask=None, lmask=None):
+        """Truncated BPTT for graph nets (ref ComputationGraph.doTruncatedBPTT):
+        split the time axis into fwd-length segments, carry LSTM states across
+        segments (stop-gradient), backprop within each."""
+        from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM as _LSTM
+        xs = _as_list(x)
+        ys = _as_list(y)
+        T = xs[0].shape[2]
+        L = self.conf.tbptt_fwd_length
+        n_rnn = sum(1 for l in self.layers if isinstance(l, _LSTM))
+        carry = [None] * n_rnn
+
+        def seg(a, s, e):
+            return a[:, :, s:e] if a is not None and np.ndim(a) == 3 else a
+
+        def seg_mask(m, s, e):
+            return None if m is None else m[:, s:e]
+
+        for start in range(0, T, L):
+            end = min(start + L, T)
+            sx = [seg(v, start, end) for v in xs]
+            sy = [seg(v, start, end) for v in ys]
+            fm = None if fmask is None else [seg_mask(m, start, end)
+                                             for m in _as_list(fmask)]
+            lm = None if lmask is None else [seg_mask(m, start, end)
+                                             for m in _as_list(lmask)]
+            final = self.fit_batch(sx, sy, fm, lm, rnn_init_states=carry)
+            if final is not None:
+                carry = [None if s is None else
+                         (jax.lax.stop_gradient(s[0]),
+                          jax.lax.stop_gradient(s[1]))
+                         for s in final]
+
     def _fit_one(self, ds):
         from deeplearning4j_tpu.datasets.dataset import MultiDataSet
         if isinstance(ds, MultiDataSet):
-            self.fit_batch(ds.features, ds.labels, ds.features_masks, ds.labels_masks)
+            feats, labs = ds.features, ds.labels
+            fm, lm = ds.features_masks, ds.labels_masks
         else:
-            self.fit_batch(ds.features, ds.labels, ds.features_mask, ds.labels_mask)
+            feats, labs = ds.features, ds.labels
+            fm, lm = ds.features_mask, ds.labels_mask
+        if self.conf.backprop_type == BackpropType.TruncatedBPTT \
+                and np.ndim(_as_list(feats)[0]) == 3:
+            self.fit_tbptt(feats, labs, fm, lm)
+        else:
+            self.fit_batch(feats, labs, fm, lm)
 
     # ------------------------------------------------------------- scoring
     def score(self, ds=None, training: bool = False) -> float:

@@ -161,3 +161,48 @@ def test_parallel_inference_inplace_mode():
     assert np.allclose(out, np.asarray(net.output(x)), atol=1e-12)
     obs = pi.output_async(x)
     assert np.allclose(obs.get(timeout=10), out)
+
+
+def test_graph_tbptt_matches_full_bptt_segment_structure():
+    """Graph tBPTT (ref ComputationGraph.doTruncatedBPTT): state carried across
+    segments, training converges, and one-full-length segment == plain BPTT."""
+    from deeplearning4j_tpu import BackpropType, LSTM, RnnOutputLayer
+
+    def rnn_graph(tbptt_len=None):
+        g = (NeuralNetConfiguration.Builder().seed(6)
+             .weight_init(WeightInit.XAVIER).updater(Sgd(learning_rate=0.1))
+             .dtype("float64").graph_builder())
+        (g.add_inputs("in")
+          .add_layer("lstm", LSTM(n_out=5, activation=Activation.TANH), "in")
+          .add_layer("out", RnnOutputLayer(n_out=2,
+                                           activation=Activation.SOFTMAX),
+                     "lstm")
+          .set_outputs("out")
+          .set_input_types(InputType.recurrent(3)))
+        if tbptt_len is not None:
+            g.backprop_type(BackpropType.TruncatedBPTT)
+            g.t_bptt_forward_length(tbptt_len)
+        return ComputationGraph(g.build()).init()
+
+    x = RNG.rand(4, 3, 12)
+    y = np.eye(2)[RNG.randint(0, 2, (4, 12))].transpose(0, 2, 1)
+
+    # tBPTT with segment length == T is numerically plain BPTT
+    plain = rnn_graph()
+    plain.fit_batch(x, y)
+    whole = rnn_graph(tbptt_len=12)
+    whole.fit_tbptt(x, y)
+    assert np.allclose(np.asarray(plain.params()), np.asarray(whole.params()),
+                       atol=1e-12)
+
+    # short segments: converges, and fit() dispatches automatically
+    net = rnn_graph(tbptt_len=4)
+    first = None
+    for _ in range(15):
+        net.fit_tbptt(x, y)
+        if first is None:
+            first = float(net.score())
+    assert float(net.score()) < first
+    net2 = rnn_graph(tbptt_len=4)
+    net2.fit(DataSet(x, y))  # _fit_one dispatch
+    assert np.isfinite(net2.score())
