@@ -468,6 +468,39 @@ class ComputationGraph:
         else:
             self.fit_batch(feats, labs, fm, lm)
 
+    # ------------------------------------------------------------- rnn API
+    def rnn_time_step(self, *inputs):
+        """Streaming inference with persistent LSTM state
+        (ref ComputationGraph.rnnTimeStep)."""
+        from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM as _LSTM
+        self._check_init()
+        if len(inputs) == 1 and isinstance(inputs[0], (list, tuple)):
+            inputs = tuple(inputs[0])
+        ins = [jnp.asarray(v, self.dtype) for v in inputs]
+        squeeze = ins[0].ndim == 2
+        if squeeze:
+            ins = [v[:, :, None] for v in ins]
+        n_rnn = sum(1 for l in self.layers if isinstance(l, _LSTM))
+        if getattr(self, "_rnn_state", None) is None:
+            self._rnn_state = [None] * n_rnn
+        if getattr(self, "_rnn_step_jit", None) is None:
+            def f(params, states, ins, rnn_states):
+                values, _, _, final = self._forward_all(
+                    params, states, list(ins), train=False,
+                    rnn_init_states=rnn_states)
+                return tuple(values[o] for o in self.conf.outputs), final
+            self._rnn_step_jit = jax.jit(f)
+        outs, final = self._rnn_step_jit(self.params_tree, self.state_tree,
+                                         tuple(ins), self._rnn_state)
+        self._rnn_state = final
+        outs = [o[:, :, 0] if squeeze and o.ndim == 3 else o for o in outs]
+        return outs[0] if len(outs) == 1 else list(outs)
+    rnnTimeStep = rnn_time_step
+
+    def rnn_clear_previous_state(self):
+        self._rnn_state = None
+    rnnClearPreviousState = rnn_clear_previous_state
+
     # ------------------------------------------------------------- scoring
     def score(self, ds=None, training: bool = False) -> float:
         self._check_init()

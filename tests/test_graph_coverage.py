@@ -206,3 +206,27 @@ def test_graph_tbptt_matches_full_bptt_segment_structure():
     net2 = rnn_graph(tbptt_len=4)
     net2.fit(DataSet(x, y))  # _fit_one dispatch
     assert np.isfinite(net2.score())
+
+
+def test_graph_rnn_time_step_streaming():
+    """Graph rnnTimeStep: feeding a sequence step-by-step equals the full-
+    sequence forward (ref ComputationGraph.rnnTimeStep)."""
+    from deeplearning4j_tpu import LSTM, RnnOutputLayer
+
+    g = (NeuralNetConfiguration.Builder().seed(8).weight_init(WeightInit.XAVIER)
+         .updater(Sgd(learning_rate=0.1)).dtype("float64").graph_builder())
+    (g.add_inputs("in")
+      .add_layer("lstm", LSTM(n_out=4, activation=Activation.TANH), "in")
+      .add_layer("out", RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX),
+                 "lstm")
+      .set_outputs("out")
+      .set_input_types(InputType.recurrent(3)))
+    net = ComputationGraph(g.build()).init()
+    x = RNG.rand(2, 3, 6)
+    full = np.asarray(net.output(x))
+    stepped = np.stack([np.asarray(net.rnn_time_step(x[:, :, t]))
+                        for t in range(6)], axis=2)
+    assert np.allclose(stepped, full, atol=1e-10)
+    net.rnn_clear_previous_state()
+    again = np.asarray(net.rnn_time_step(x[:, :, 0]))
+    assert np.allclose(again, full[:, :, 0], atol=1e-10)
