@@ -86,6 +86,12 @@ class BaseLayerConf:
     frozen: bool = False  # FrozenLayer semantics (ref nn/layers/FrozenLayer.java)
     gradient_normalization: GradientNormalization = GradientNormalization.NoNormalization
     gradient_normalization_threshold: float = 1.0
+    # Per-param partition specs for model-parallel training: param name -> a
+    # per-dimension list of mesh-axis names (or None), e.g. {"W": [None, "model"]}
+    # for a Megatron column-parallel Dense kernel. None = use the trainer's auto
+    # policy (parallel/sharded.py). JSON round-trips as plain dict-of-lists, so
+    # sharded configs ship across processes like every other conf field.
+    weight_sharding: Optional[Dict[str, Any]] = None
 
     # ---------------- shape / params ----------------
     def get_output_type(self, input_type: InputType) -> InputType:

@@ -325,10 +325,31 @@ class ComputationGraph:
         if steps is None:
             raise ValueError("steps is required (single-batch device loop)")
 
+        run = self._get_device_loop()
+
+        self._rng, sub = jax.random.split(self._rng)
+        (self.params_tree, self._opt_state, self.state_tree, _, _, div), losses = run(
+            self.params_tree, self._opt_state, self.state_tree,
+            jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask, n=int(steps))
+        self._step += int(steps)
+        losses = np.asarray(losses)
+        self._score = float(losses[-1])
+        div = int(div)
+        self._diverged_at = div if div >= 0 else None
+        if self._diverged_at is not None:
+            import warnings
+            warnings.warn(
+                f"Training diverged: non-finite loss at step {self._diverged_at}; "
+                f"parameters frozen at the last finite step")
+        return losses
+
+    def _get_device_loop(self):
+        """Build (or fetch from cache) the jitted scan loop used by fit_on_device /
+        train_step_flops. Data (x/y/masks) is passed as jit arguments — never
+        captured as traced constants — so a warm cache cannot replay the first
+        call's batch."""
         import functools
 
-        # Data (x/y/masks) is passed as jit arguments — never captured as traced
-        # constants — so a warm cache cannot replay the first call's batch.
         cache_key = ("cg",)
         if not hasattr(self, "_device_loop_cache"):
             self._device_loop_cache = {}
@@ -370,22 +391,20 @@ class ComputationGraph:
                     body, (params, opt, states, step, rng, div0), None, length=n)
                 return carry, losses
             self._device_loop_cache[cache_key] = run
+        return run
 
-        self._rng, sub = jax.random.split(self._rng)
-        (self.params_tree, self._opt_state, self.state_tree, _, _, div), losses = run(
-            self.params_tree, self._opt_state, self.state_tree,
-            jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask, n=int(steps))
-        self._step += int(steps)
-        losses = np.asarray(losses)
-        self._score = float(losses[-1])
-        div = int(div)
-        self._diverged_at = div if div >= 0 else None
-        if self._diverged_at is not None:
-            import warnings
-            warnings.warn(
-                f"Training diverged: non-finite loss at step {self._diverged_at}; "
-                f"parameters frozen at the last finite step")
-        return losses
+    def train_step_flops(self, x, y) -> Optional[float]:
+        """XLA cost-analysis FLOPs of ONE fit_on_device training step (see
+        MultiLayerNetwork.train_step_flops)."""
+        self._check_init()
+        x = tuple(jnp.asarray(v, self.dtype) for v in _as_list(x))
+        y = tuple(jnp.asarray(v, self.dtype) for v in _as_list(y))
+        from deeplearning4j_tpu.util.costs import lowered_flops
+        run = self._get_device_loop()
+        return lowered_flops(
+            run, self.params_tree, self._opt_state, self.state_tree,
+            jnp.asarray(self._step, jnp.int32), self._rng, x, y, None, None,
+            n=1)
 
     def fit(self, data, labels=None, epochs: int = 1):
         """fit(x(s), y(s)) | fit(DataSet/MultiDataSet) | fit(iterator[, epochs])

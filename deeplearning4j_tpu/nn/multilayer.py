@@ -403,6 +403,28 @@ class MultiLayerNetwork:
         # (a warm cache must not replay the first call's data). jax.jit's own aval
         # cache handles shape/dtype/None changes. In per-step mode masks (when given)
         # carry a leading step axis and are scanned alongside x/y.
+        run = self._get_device_loop(per_step_data, has_fm, has_lm)
+
+        self._rng, sub = jax.random.split(self._rng)
+        (self.params_tree, self._opt_state, self.state_tree, _, _, div), losses = run(
+            self.params_tree, self._opt_state, self.state_tree,
+            jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask, n=int(steps))
+        self._step += int(steps)
+        losses = np.asarray(losses)
+        self._score = float(losses[-1])
+        div = int(div)
+        self._diverged_at = div if div >= 0 else None
+        if self._diverged_at is not None:
+            import warnings
+            warnings.warn(
+                f"Training diverged: non-finite loss at step {self._diverged_at}; "
+                f"parameters frozen at the last finite step "
+                f"(ref InvalidScoreIterationTerminationCondition semantics)")
+        return losses
+
+    def _get_device_loop(self, per_step_data: bool, has_fm: bool, has_lm: bool):
+        """Build (or fetch from cache) the jitted scan training loop used by
+        fit_on_device / train_step_flops."""
         cache_key = ("mln", per_step_data, has_fm, has_lm)
         if not hasattr(self, "_device_loop_cache"):
             self._device_loop_cache = {}
@@ -458,23 +480,22 @@ class MultiLayerNetwork:
                     body, (params, opt, states, step, rng, div0), xs, length=n)
                 return carry, losses
             self._device_loop_cache[cache_key] = run
+        return run
 
-        self._rng, sub = jax.random.split(self._rng)
-        (self.params_tree, self._opt_state, self.state_tree, _, _, div), losses = run(
-            self.params_tree, self._opt_state, self.state_tree,
-            jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask, n=int(steps))
-        self._step += int(steps)
-        losses = np.asarray(losses)
-        self._score = float(losses[-1])
-        div = int(div)
-        self._diverged_at = div if div >= 0 else None
-        if self._diverged_at is not None:
-            import warnings
-            warnings.warn(
-                f"Training diverged: non-finite loss at step {self._diverged_at}; "
-                f"parameters frozen at the last finite step "
-                f"(ref InvalidScoreIterationTerminationCondition semantics)")
-        return losses
+    def train_step_flops(self, x, y) -> Optional[float]:
+        """XLA cost-analysis FLOPs of ONE fit_on_device training step
+        (forward + backward + updater), or None when the backend exposes no cost
+        model. Used by bench.py to report MFU and sanity-check throughput against
+        hardware peak."""
+        self._check_init()
+        x = jnp.asarray(x, self.dtype)
+        y = jnp.asarray(y, self.dtype)
+        from deeplearning4j_tpu.util.costs import lowered_flops
+        run = self._get_device_loop(False, False, False)
+        return lowered_flops(
+            run, self.params_tree, self._opt_state, self.state_tree,
+            jnp.asarray(self._step, jnp.int32), self._rng, x, y, None, None,
+            n=1)
 
     def fit(self, data, labels=None, epochs: int = 1):
         """fit(x, y) | fit(DataSet) | fit(DataSetIterator[, epochs])

@@ -8,3 +8,5 @@ from deeplearning4j_tpu.parallel.mesh import (
 from deeplearning4j_tpu.parallel.parallel_inference import (
     InferenceMode, ParallelInference)
 from deeplearning4j_tpu.parallel.parallel_wrapper import ParallelWrapper, TrainingMode
+from deeplearning4j_tpu.parallel.pipelined import PipelinedTrainer
+from deeplearning4j_tpu.parallel.sharded import ShardedTrainer, auto_shard_specs

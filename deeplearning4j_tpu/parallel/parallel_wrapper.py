@@ -79,6 +79,8 @@ class ParallelWrapper:
         self.accumulator = accumulator
         self._carry = None  # (params_repl, opt_repl, states_repl, residual, step)
         self._step_fn = None
+        self._step_fn_raw = None  # unjitted step (scanned by fit_on_device)
+        self._scan_fn = None
         self._score = float("nan")
         self._listeners: List[Any] = []
 
@@ -213,6 +215,7 @@ class ParallelWrapper:
         # different arg shardings, and the whole step silently recompiles EVERY fit.
         carry_sh = jax.tree_util.tree_map(lambda a: a.sharding, self._carry)
         loss_sh = NamedSharding(mesh, P())
+        self._step_fn_raw = step_fn
         self._step_fn = jax.jit(step_fn, donate_argnums=(0,),
                                 out_shardings=(carry_sh, loss_sh))
 
@@ -347,6 +350,55 @@ class ParallelWrapper:
         for lst in self._listeners:
             lst.iteration_done(self, self._host_step)
 
+    def fit_on_device(self, x, y, steps: int):
+        """Run `steps` data-parallel training steps as ONE jitted lax.scan on device
+        (same batch each step — benchmark/epoch-runner mode, see
+        MultiLayerNetwork.fit_on_device). This is the TPU-idiomatic measurement path:
+        per-step host dispatch over a tunneled link costs ms of RTT per call and
+        would measure the link, not the mesh. Not available for CUSTOM mode (its
+        accumulator is host-side by contract). Returns per-step mean losses."""
+        if self.training_mode == TrainingMode.CUSTOM:
+            raise ValueError(
+                "fit_on_device is unsupported in CUSTOM mode: the caller-provided "
+                "GradientsAccumulator is applied host-side between steps")
+        self._ensure_setup()
+        net = self.model
+        if np.shape(x)[0] % self.workers != 0:
+            raise ValueError(
+                f"Batch size {np.shape(x)[0]} not divisible by workers "
+                f"{self.workers}")
+        bsh = NamedSharding(self.mesh, P("data"))
+        x = jax.device_put(jnp.asarray(x, net.dtype), bsh)
+        y = jax.device_put(jnp.asarray(y, net.dtype), bsh)
+        if self._scan_fn is None:
+            raw = self._step_fn_raw
+            carry_sh = jax.tree_util.tree_map(lambda a: a.sharding, self._carry)
+            loss_sh = NamedSharding(self.mesh, P())
+
+            @functools.partial(jax.jit, donate_argnums=(0,),
+                               static_argnames=("n",),
+                               out_shardings=(carry_sh, loss_sh))
+            def scan_run(carry, rng, bx, by, n):
+                def body(c, _):
+                    carry_c, rng_c = c
+                    rng_c, sub = jax.random.split(rng_c)
+                    new_carry, loss = raw(carry_c, sub, bx, by, None, None)
+                    return (new_carry, rng_c), loss
+
+                (carry, _), losses = lax.scan(body, (carry, rng), None, length=n)
+                return carry, losses
+
+            self._scan_fn = scan_run
+        net._rng, sub = jax.random.split(net._rng)
+        self._carry, losses = self._scan_fn(self._carry, sub, x, y, n=int(steps))
+        self._host_step += int(steps)
+        # host transfer doubles as the synchronization point: callers (and the
+        # bench timing loop) must observe completed work, not queued dispatches
+        losses = np.asarray(losses)
+        self._score = float(losses[-1])
+        self._write_back()
+        return losses
+
     def _write_back(self):
         """Copy replica-0 state back into the wrapped model (replicas are identical
         after sync in both modes when averaging_frequency divides the step count).
@@ -370,6 +422,8 @@ class ParallelWrapper:
     def shutdown(self):
         self._carry = None
         self._step_fn = None
+        self._step_fn_raw = None
+        self._scan_fn = None
 
     # ---------------------------------------------------------------- builder
     class Builder:

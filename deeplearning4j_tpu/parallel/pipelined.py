@@ -1,0 +1,440 @@
+"""Pipeline (stage) parallelism for REAL MultiLayerNetworks over Mesh('pipe').
+
+Round-3 integration of what used to be the PipelineParallelMLP demo
+(pipeline_parallel.py): any MultiLayerNetwork whose repeated middle segment
+partitions into S structurally identical stages trains GPipe-style over a
+'pipe' mesh axis, composing with the framework's configs, updaters, listeners
+and serialization. The homogeneous-stage requirement is the same constraint
+production JAX pipelining uses (stacked stage weights + one SPMD program);
+heterogeneous prologue/epilogue layers are handled as replicated head/tail.
+
+Schedule (scaling-book recipe, one lax.scan inside shard_map):
+- head layers (before the pipelined segment) run replicated on every device;
+- the batch splits into M microbatches; each tick, every stage applies its
+  chunk of layers to the microbatch it holds and `ppermute`s the result to the
+  next stage — after S-1 warmup ticks all stages work concurrently;
+- the last stage's accumulated outputs are psum-broadcast, and the tail layers
+  (+ loss) run replicated.
+
+Gradient exactness (why this is standard SGD, not an approximation): the
+per-device autodiff differentiates the replicated loss copy, i.e. the
+effective objective is S x loss. Stage-sharded params therefore get their local
+gradient divided by S; head params (used asymmetrically — only stage 0 injects)
+get psum/S, which is exact because ppermute transposes to the reverse
+permutation and routes the full cotangent back to stage 0; tail params sit
+after the psum broadcast and come out exact and replicated as-is. The same
+accounting as tensor_parallel.py, verified by fp64 parity tests.
+
+No reference counterpart (SURVEY §2.3: the reference is DP-only); this is the
+scale dimension the BASELINE north star (pod-scale training) requires when the
+layer stack outgrows one chip.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.common.enums import GradientNormalization
+
+_ELEMENTWISE_GN = (GradientNormalization.NoNormalization,
+                   GradientNormalization.ClipElementWiseAbsoluteValue)
+
+
+def _layer_signature(layer, params):
+    return (type(layer).__name__,
+            tuple(sorted((k, tuple(v.shape)) for k, v in params.items())))
+
+
+class PipelinedTrainer:
+    """GPipe microbatch pipeline for a MultiLayerNetwork (see module docstring).
+
+    Builder ergonomics mirror ParallelWrapper.Builder:
+
+        pt = (PipelinedTrainer.Builder(net).mesh(make_mesh(4, axes=("pipe",)))
+              .stage_range(1, 5)        # layers [1, 5) form S identical stages
+              .microbatches(4).build())
+        pt.fit(x, y); pt.write_back()
+    """
+
+    def __init__(self, model, mesh: Mesh, pipe_axis: str = "pipe",
+                 stage_start: int = 0, stage_end: Optional[int] = None,
+                 microbatches: int = 4):
+        from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+        if not isinstance(model, MultiLayerNetwork):
+            raise TypeError("PipelinedTrainer pipelines MultiLayerNetwork stacks; "
+                            "use ShardedTrainer for ComputationGraph")
+        if pipe_axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no {pipe_axis!r} axis: {mesh}")
+        if len(mesh.axis_names) != 1:
+            raise ValueError("PipelinedTrainer uses a 1-D ('pipe',) mesh; "
+                             "compose dp via ShardedTrainer or ParallelWrapper")
+        model._check_init()
+        self.net = model
+        self.mesh = mesh
+        self.axis = pipe_axis
+        self.S = int(mesh.shape[pipe_axis])
+        self.M = int(microbatches)
+        n_layers = len(model.layers)
+        stage_end = n_layers - 1 if stage_end is None else int(stage_end)
+        self.i0, self.i1 = int(stage_start), stage_end
+        seg = self.i1 - self.i0
+        if seg <= 0 or seg % self.S != 0:
+            raise ValueError(
+                f"segment [{self.i0},{self.i1}) of {seg} layers does not split "
+                f"into {self.S} equal stages")
+        self.k = seg // self.S
+        self._validate()
+        self._carry = None
+        self._step_fn = None
+        self._scan_fn = None
+        self._score = float("nan")
+        self._listeners: List[Any] = []
+
+    def _validate(self):
+        net = self.net
+        sig0 = [_layer_signature(net.layers[self.i0 + j],
+                                 net.params_tree[self.i0 + j])
+                for j in range(self.k)]
+        for s in range(1, self.S):
+            sig = [_layer_signature(net.layers[self.i0 + s * self.k + j],
+                                    net.params_tree[self.i0 + s * self.k + j])
+                   for j in range(self.k)]
+            if sig != sig0:
+                raise ValueError(
+                    f"stage {s} (layers {self.i0 + s * self.k}.."
+                    f"{self.i0 + (s + 1) * self.k - 1}) is not structurally "
+                    f"identical to stage 0 — pipeline stages must repeat the "
+                    f"same block (stacked-weight SPMD schedule)")
+        for i, layer in enumerate(net.layers):
+            # the pipelined forward rebuilds the net's loss path layer by
+            # layer; features it does not reproduce are rejected up front
+            # rather than silently dropped
+            if net.state_tree[i]:
+                raise ValueError(
+                    f"layer {i} ({type(layer).__name__}) carries state (e.g. "
+                    f"BN running stats) — not supported by PipelinedTrainer")
+            if layer.dropout:
+                raise ValueError(
+                    f"layer {i} has dropout — not supported by "
+                    f"PipelinedTrainer (the microbatch schedule would need "
+                    f"per-tick rng plumbing)")
+            if self.i0 <= i < self.i1 and \
+                    layer.gradient_normalization not in _ELEMENTWISE_GN:
+                raise ValueError(
+                    "per-layer-norm gradient normalization inside the pipeline "
+                    "segment would mix stages; use elementwise clipping")
+        if net.compute_dtype != net.dtype:
+            raise ValueError(
+                "mixed-precision compute_dtype is not supported by "
+                "PipelinedTrainer (train in the storage dtype)")
+        for i in net.conf.preprocessors:
+            if self.i0 < i < self.i1:
+                raise ValueError(
+                    f"input preprocessor at layer {i} sits inside the pipeline "
+                    f"segment — stages must map the activation shape onto "
+                    f"itself with no shape adapters")
+        in_type = net.conf.input_types_per_layer()
+        if str(in_type[self.i0]) != str(in_type[self.i0 + self.k]):
+            raise ValueError(
+                "stage input/output types differ — each stage must map the "
+                "activation shape onto itself")
+
+    # ------------------------------------------------------------------ setup
+    def _split_params(self, tree_per_layer):
+        """net layout (one pytree per layer) -> (head list, stage list with a
+        leading stacked stage dim on every leaf, tail list)."""
+        head = [tree_per_layer[i] for i in range(self.i0)]
+        tail = [tree_per_layer[i] for i in range(self.i1, len(self.net.layers))]
+        stacked = []
+        for j in range(self.k):
+            per_stage = [tree_per_layer[self.i0 + s * self.k + j]
+                         for s in range(self.S)]
+            stacked.append(jax.tree_util.tree_map(
+                lambda *xs: jnp.stack(xs), *per_stage))
+        return head, stacked, tail
+
+    def _merge_params(self, head, stacked, tail, like):
+        out = list(like)
+        for i in range(self.i0):
+            out[i] = head[i]
+        for j in range(self.k):
+            for s in range(self.S):
+                out[self.i0 + s * self.k + j] = jax.tree_util.tree_map(
+                    lambda v: v[s], stacked[j])
+        for idx, i in enumerate(range(self.i1, len(out))):
+            out[i] = tail[idx]
+        return out
+
+    def _ensure_setup(self):
+        if self._carry is not None:
+            return
+        net = self.net
+        st = NamedSharding(self.mesh, P(self.axis))
+        rep = NamedSharding(self.mesh, P())
+        head, stacked, tail = self._split_params(net.params_tree)
+        oh, ost, otl = self._split_params(self._stage_opt_template())
+        put_rep = functools.partial(jax.device_put, device=rep)
+        put_st = functools.partial(jax.device_put, device=st)
+        params = (jax.tree_util.tree_map(put_rep, head),
+                  [jax.tree_util.tree_map(put_st, d) for d in stacked],
+                  jax.tree_util.tree_map(put_rep, tail))
+        opt = (jax.tree_util.tree_map(put_rep, oh),
+               [jax.tree_util.tree_map(put_st, d) for d in ost],
+               jax.tree_util.tree_map(put_rep, otl))
+        self._carry = (params, opt,
+                       jax.device_put(jnp.asarray(net._step, jnp.int32), rep))
+        self._host_step = net._step
+        self._build_step()
+
+    def _stage_opt_template(self):
+        """Opt state in net layout (list per layer) — already built by init()."""
+        return self.net._opt_state
+
+    # ------------------------------------------------------- pipelined forward
+    def _chunk_forward(self, chunk_params, h, train):
+        """Apply one stage's k layers. chunk_params: list of per-layer dicts."""
+        net = self.net
+        for j in range(self.k):
+            layer = net.layers[self.i0 + j]  # confs identical across stages
+            h, _, _ = layer.forward(chunk_params[j], {}, h, train=train,
+                                    rng=None, mask=None)
+        return h
+
+    def _local_loss(self, p, x, y, train):
+        """Inside shard_map. p = (head, stacked-local, tail); x/y replicated."""
+        net = self.net
+        head, stacked, tail = p
+        axis, S, M = self.axis, self.S, self.M
+        my = lax.axis_index(axis)
+        # local stage chunk: leading stacked dim is 1 after shard_map
+        chunk = [jax.tree_util.tree_map(lambda v: v[0], d) for d in stacked]
+
+        def pre(i, h):
+            pp = net.conf.preprocessors.get(i)
+            return pp.preprocess(h) if pp is not None else h
+
+        h = x
+        for i in range(self.i0):
+            h = pre(i, h)
+            h, _, _ = net.layers[i].forward(head[i], {}, h, train=train,
+                                            rng=None, mask=None)
+        h = pre(self.i0, h)
+        B = h.shape[0]
+        if B % M != 0:
+            raise ValueError(f"batch {B} % microbatches {M} != 0")
+        mb = B // M
+        xs = h.reshape((M, mb) + h.shape[1:])
+        n_ticks = M + S - 1
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            buf, outs = carry
+            feed = jnp.where(t < M, t, 0)
+            inject = xs[feed]
+            h_in = jnp.where(my == 0, inject, buf)
+            h_out = self._chunk_forward(chunk, h_in, train)
+            out_idx = t - (S - 1)
+            valid = jnp.logical_and(out_idx >= 0, my == S - 1)
+            outs = outs.at[jnp.maximum(out_idx, 0)].add(
+                jnp.where(valid, h_out, jnp.zeros_like(h_out)))
+            buf = lax.ppermute(h_out, axis, perm)
+            return (buf, outs), None
+
+        buf0 = jnp.zeros(xs.shape[1:], h.dtype)
+        outs0 = jnp.zeros((M,) + xs.shape[1:], h.dtype)
+        (_, outs), _ = lax.scan(tick, (buf0, outs0), jnp.arange(n_ticks))
+        outs = lax.psum(outs, axis)  # non-last stages contributed zeros
+        h = outs.reshape((B,) + outs.shape[2:])
+
+        loss = None
+        for idx, i in enumerate(range(self.i1, len(net.layers))):
+            layer = net.layers[i]
+            h = pre(i, h)
+            if layer.is_output_layer():
+                loss = layer.compute_score(tail[idx], h, y)
+                break
+            h, _, _ = layer.forward(tail[idx], {}, h, train=train,
+                                    rng=None, mask=None)
+        if loss is None:
+            raise ValueError("no output layer after the pipeline segment")
+
+        # regularization: stage terms are per-device (this stage only) — psum
+        # restores the replicated total; head/tail terms are already replicated
+        reg = jnp.asarray(0.0, h.dtype)
+        for j in range(self.k):
+            reg = reg + net.layers[self.i0 + j].regularization_score(chunk[j])
+        reg = lax.psum(reg, axis)
+        for i in range(self.i0):
+            reg = reg + net.layers[i].regularization_score(head[i])
+        for idx, i in enumerate(range(self.i1, len(net.layers))):
+            reg = reg + net.layers[i].regularization_score(tail[idx])
+        return loss + reg
+
+    def _build_step(self):
+        net = self.net
+        from deeplearning4j_tpu.nn.multilayer import _normalize_gradients
+        axis, S = self.axis, self.S
+        st_spec = P(axis)
+        rep = P()
+        head_spec = jax.tree_util.tree_map(lambda _: rep, self._carry[0][0])
+        stage_spec = [jax.tree_util.tree_map(lambda _: st_spec, d)
+                      for d in self._carry[0][1]]
+        tail_spec = jax.tree_util.tree_map(lambda _: rep, self._carry[0][2])
+        pspec = (head_spec, stage_spec, tail_spec)
+
+        def local_grads(p, x, y):
+            loss, g = jax.value_and_grad(
+                lambda q: self._local_loss(q, x, y, True))(p)
+            gh, gs, gt = g
+            # gradient exactness accounting (module docstring): stage /S,
+            # head psum/S, tail exact
+            gs = jax.tree_util.tree_map(lambda a: a / S, gs)
+            gh = jax.tree_util.tree_map(lambda a: lax.psum(a, axis) / S, gh)
+            return (gh, gs, gt), loss
+
+        shmapped = jax.shard_map(
+            local_grads, mesh=self.mesh,
+            in_specs=(pspec, rep, rep), out_specs=(pspec, rep),
+            check_vma=False)
+
+        updaters = net._updaters
+        layers = net.layers
+        i0, i1, k = self.i0, self.i1, self.k
+
+        def step_fn(carry, x, y):
+            (params, opt, step) = carry
+            grads, loss = shmapped(params, x, y)
+            gh, gs, gt = grads
+            ph, ps, pt = params
+            oh, ost, otl = opt
+            new_h, new_oh = [], []
+            for i in range(i0):
+                g = _normalize_gradients(layers[i], gh[i])
+                upd, so = updaters[i].update(g, oh[i], ph[i], step)
+                new_h.append(jax.tree_util.tree_map(lambda p, d: p - d, ph[i], upd))
+                new_oh.append(so)
+            new_s, new_ost = [], []
+            for j in range(k):
+                # all stages of position j share the layer conf + updater;
+                # elementwise updater math applies straight to stacked leaves
+                g = _normalize_gradients(layers[i0 + j], gs[j])
+                upd, so = updaters[i0 + j].update(g, ost[j], ps[j], step)
+                new_s.append(jax.tree_util.tree_map(lambda p, d: p - d, ps[j], upd))
+                new_ost.append(so)
+            new_t, new_otl = [], []
+            for idx, i in enumerate(range(i1, len(layers))):
+                g = _normalize_gradients(layers[i], gt[idx])
+                upd, so = updaters[i].update(g, otl[idx], pt[idx], step)
+                new_t.append(jax.tree_util.tree_map(lambda p, d: p - d,
+                                                    pt[idx], upd))
+                new_otl.append(so)
+            return (((new_h, new_s, new_t), (new_oh, new_ost, new_otl),
+                     step + 1), loss)
+
+        carry_sh = jax.tree_util.tree_map(lambda a: a.sharding, self._carry)
+        rep_sh = NamedSharding(self.mesh, P())
+        self._step_fn = jax.jit(step_fn, donate_argnums=(0,),
+                                out_shardings=(carry_sh, rep_sh))
+
+        @functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("n",),
+                           out_shardings=(carry_sh, rep_sh))
+        def scan_run(carry, x, y, n):
+            def body(c, _):
+                new_c, loss = step_fn(c, x, y)
+                return new_c, loss
+
+            return jax.lax.scan(body, carry, None, length=n)
+
+        self._scan_fn = scan_run
+
+    # -------------------------------------------------------------------- fit
+    def fit(self, data, labels=None, epochs: int = 1):
+        from deeplearning4j_tpu.datasets.dataset import DataSet
+        self._ensure_setup()
+        if labels is not None:
+            self._fit_one(data, labels)
+        elif isinstance(data, DataSet):
+            self._fit_one(data.features, data.labels)
+        else:
+            for _ in range(epochs):
+                if hasattr(data, "reset"):
+                    data.reset()
+                for ds in data:
+                    self._fit_one(ds.features, ds.labels)
+        self.write_back()
+        return self
+
+    def _fit_one(self, x, y):
+        net = self.net
+        x = jnp.asarray(x, net.dtype)
+        y = jnp.asarray(y, net.dtype)
+        self._carry, loss = self._step_fn(self._carry, x, y)
+        self._score = loss
+        self._host_step += 1
+        for lst in self._listeners:
+            lst.iteration_done(self, self._host_step)
+
+    def fit_on_device(self, x, y, steps: int):
+        self._ensure_setup()
+        net = self.net
+        x = jnp.asarray(x, net.dtype)
+        y = jnp.asarray(y, net.dtype)
+        self._carry, losses = self._scan_fn(self._carry, x, y, n=int(steps))
+        self._host_step += int(steps)
+        losses = np.asarray(losses)  # host transfer = sync point
+        self._score = float(losses[-1])
+        self.write_back()
+        return losses
+
+    # ---------------------------------------------------------------- results
+    def write_back(self):
+        """Unstack stage params back into the net's per-layer layout."""
+        net = self.net
+        (head, stacked, tail), (oh, ost, otl), step = self._carry
+        net.params_tree = self._merge_params(head, stacked, tail,
+                                             net.params_tree)
+        net._opt_state = self._merge_params(oh, ost, otl, net._opt_state)
+        net._step = self._host_step
+        return net
+
+    def score(self):
+        return float(self._score)
+
+    def set_listeners(self, *listeners):
+        self._listeners = list(listeners)
+
+    # ---------------------------------------------------------------- builder
+    class Builder:
+        def __init__(self, model):
+            self._model = model
+            self._kw: Dict[str, Any] = {}
+
+        def mesh(self, m: Mesh):
+            self._kw["mesh"] = m
+            return self
+
+        def pipe_axis(self, name: str):
+            self._kw["pipe_axis"] = name
+            return self
+
+        def stage_range(self, start: int, end: int):
+            """Layers [start, end) form the pipelined segment (must split into
+            mesh['pipe'] structurally identical stages)."""
+            self._kw["stage_start"] = int(start)
+            self._kw["stage_end"] = int(end)
+            return self
+
+        def microbatches(self, m: int):
+            self._kw["microbatches"] = int(m)
+            return self
+
+        def build(self) -> "PipelinedTrainer":
+            if "mesh" not in self._kw:
+                raise ValueError("PipelinedTrainer requires .mesh(Mesh)")
+            return PipelinedTrainer(self._model, **self._kw)

@@ -1,0 +1,20 @@
+"""XLA cost-analysis helpers (MFU accounting for bench.py)."""
+from __future__ import annotations
+
+from typing import Optional
+
+
+def lowered_flops(jitted, *args, **kwargs) -> Optional[float]:
+    """FLOPs of `jitted(*args, **kwargs)` per XLA's cost model, or None when the
+    backend exposes none. AOT lower/compile — nothing executes and no buffer is
+    donated. Note this pays one extra (cache-independent) compile; callers use
+    it once per bench config, outside timed regions."""
+    try:
+        compiled = jitted.lower(*args, **kwargs).compile()
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0))
+        return flops if flops > 0 else None
+    except Exception:
+        return None
