@@ -4,16 +4,22 @@ Parity: ref deeplearning4j-nearestneighbors-parent/nearestneighbor-core
 (clustering/kmeans, clustering/vptree/VPTree.java:54) and deeplearning4j-core
 plot/BarnesHutTsne.java:65. TPU-first: the default KNN path is brute force on the
 MXU (one |x|^2+|y|^2-2xy matmul + top_k beats tree pointer-chasing for any N that
-fits in HBM); VPTree is kept as the host-side exact structure for API parity and
-huge-N regimes; t-SNE runs the EXACT O(N^2) gradient as batched XLA matmuls —
-the Barnes-Hut quadtree is a scalar-workload design that would waste the MXU.
+fits in HBM); VPTree/KDTree are kept as host-side exact structures for API
+parity; t-SNE runs the EXACT O(N^2) gradient as batched XLA matmuls up to
+~4k points and a grid-summarized far field beyond (the TPU-native analog of
+the reference's Barnes-Hut sp/quad-tree — see tsne.py); RandomProjectionLSH
+provides approximate candidates for huge-N regimes.
 """
 from deeplearning4j_tpu.clustering.knn import NearestNeighbors, VPTree
+from deeplearning4j_tpu.clustering.kdtree import KDTree
 from deeplearning4j_tpu.clustering.kmeans import (
     Cluster, ClusterSet, KMeansClustering, Point)
+from deeplearning4j_tpu.clustering.lsh import RandomProjectionLSH
 from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne, Tsne
 from deeplearning4j_tpu.clustering.server import (
     NearestNeighborsClient, NearestNeighborsServer)
 
-__all__ = ["NearestNeighbors", "VPTree", "KMeansClustering", "ClusterSet",
-           "Cluster", "Point", "BarnesHutTsne", "Tsne", "NearestNeighborsServer", "NearestNeighborsClient"]
+__all__ = ["NearestNeighbors", "VPTree", "KDTree", "RandomProjectionLSH",
+           "KMeansClustering", "ClusterSet", "Cluster", "Point",
+           "BarnesHutTsne", "Tsne", "NearestNeighborsServer",
+           "NearestNeighborsClient"]

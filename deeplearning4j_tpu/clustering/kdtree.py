@@ -1,0 +1,131 @@
+"""KD-tree nearest neighbors (ref clustering/kdtree/KDTree.java:37).
+
+API parity: KDTree(dims), insert(point), delete(point), nn(point) ->
+(distance, point), knn(point, radius) -> [(distance, point) within radius],
+size(). Host-side index structure (like the reference — it backs small/mid-N
+exact queries; the TPU brute-force path in clustering/knn.py owns the large-N
+regime, and tsne.py's grid summarizer owns the Barnes-Hut role)."""
+from __future__ import annotations
+
+import heapq
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+
+class _Node:
+    __slots__ = ("point", "left", "right")
+
+    def __init__(self, point):
+        self.point = point
+        self.left: Optional["_Node"] = None
+        self.right: Optional["_Node"] = None
+
+
+class KDTree:
+    GREATER = 1
+    LESS = 0
+
+    def __init__(self, dims: int):
+        self.dims = int(dims)
+        self._root: Optional[_Node] = None
+        self._size = 0
+
+    # ------------------------------------------------------------- build
+    def insert(self, point) -> None:
+        point = np.asarray(point, np.float64).reshape(-1)
+        if point.shape[0] != self.dims:
+            raise ValueError(f"point has {point.shape[0]} dims, tree {self.dims}")
+        self._size += 1
+        if self._root is None:
+            self._root = _Node(point)
+            return
+        node, depth = self._root, 0
+        while True:
+            axis = depth % self.dims
+            if point[axis] < node.point[axis]:
+                if node.left is None:
+                    node.left = _Node(point)
+                    return
+                node = node.left
+            else:
+                if node.right is None:
+                    node.right = _Node(point)
+                    return
+                node = node.right
+            depth += 1
+
+    def delete(self, point) -> bool:
+        """Remove one node matching `point` exactly (ref delete :98 — rebuilds
+        the affected subtree)."""
+        point = np.asarray(point, np.float64).reshape(-1)
+        remaining: List[np.ndarray] = []
+        found = [False]
+
+        def collect(node):
+            if node is None:
+                return
+            if not found[0] and np.array_equal(node.point, point):
+                found[0] = True
+            else:
+                remaining.append(node.point)
+            collect(node.left)
+            collect(node.right)
+
+        collect(self._root)
+        if not found[0]:
+            return False
+        self._root = None
+        self._size = 0
+        for p in remaining:
+            self.insert(p)
+        return True
+
+    def size(self) -> int:
+        return self._size
+
+    # ------------------------------------------------------------ queries
+    def nn(self, point) -> Optional[Tuple[float, np.ndarray]]:
+        """(ref nn :165) — (euclidean distance, nearest point)."""
+        point = np.asarray(point, np.float64).reshape(-1)
+        best = [np.inf, None]
+
+        def search(node, depth):
+            if node is None:
+                return
+            d = float(np.linalg.norm(node.point - point))
+            if d < best[0]:
+                best[0], best[1] = d, node.point
+            axis = depth % self.dims
+            delta = point[axis] - node.point[axis]
+            near, far = (node.left, node.right) if delta < 0 else \
+                (node.right, node.left)
+            search(near, depth + 1)
+            if abs(delta) < best[0]:  # hypersphere crosses the splitting plane
+                search(far, depth + 1)
+
+        search(self._root, 0)
+        return None if best[1] is None else (best[0], best[1])
+
+    def knn(self, point, distance: float) -> List[Tuple[float, np.ndarray]]:
+        """All points within `distance`, closest first (ref knn :129)."""
+        point = np.asarray(point, np.float64).reshape(-1)
+        out: List[Tuple[float, np.ndarray]] = []
+
+        def search(node, depth):
+            if node is None:
+                return
+            d = float(np.linalg.norm(node.point - point))
+            if d <= distance:
+                out.append((d, node.point))
+            axis = depth % self.dims
+            delta = point[axis] - node.point[axis]
+            near, far = (node.left, node.right) if delta < 0 else \
+                (node.right, node.left)
+            search(near, depth + 1)
+            if abs(delta) <= distance:
+                search(far, depth + 1)
+
+        search(self._root, 0)
+        out.sort(key=lambda t: t[0])
+        return out

@@ -1,0 +1,66 @@
+"""Random-projection LSH for approximate nearest neighbors.
+
+The 0.9.1 reference has no LSH module (its approximate-neighbor structures are
+the VP/KD/sp trees); later DL4J versions grew RandomProjectionLSH — provided
+here as the approximate-neighbor provider that composes with the brute-force
+KNN (clustering/knn.py) and the t-SNE k-NN stage: signed random projections
+(SimHash) bucket vectors across L tables; queries union candidate buckets and
+re-rank exactly — one (B, D) x (D, bits) matmul to hash, one small exact top-k
+to answer, both MXU-shaped.
+"""
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+
+class RandomProjectionLSH:
+    def __init__(self, dims: int, hash_bits: int = 8, num_tables: int = 16,
+                 seed: int = 12345):
+        self.dims = int(dims)
+        self.bits = int(hash_bits)
+        self.L = int(num_tables)
+        rng = np.random.RandomState(seed)
+        # (L, D, bits) signed projection planes
+        self._planes = rng.randn(self.L, self.dims, self.bits)
+        self._tables: List[Dict[int, List[int]]] = [
+            defaultdict(list) for _ in range(self.L)]
+        self._data: np.ndarray = np.zeros((0, self.dims), np.float32)
+
+    def _keys(self, x: np.ndarray) -> np.ndarray:
+        """(n, L) integer bucket keys via sign bits."""
+        bits = (np.einsum("nd,ldb->nlb", x, self._planes) > 0)
+        weights = 1 << np.arange(self.bits)
+        return (bits * weights).sum(axis=-1)
+
+    def index(self, data) -> "RandomProjectionLSH":
+        data = np.asarray(data, np.float32)
+        base = self._data.shape[0]
+        self._data = np.vstack([self._data, data]) if base else data
+        keys = self._keys(data)
+        for i in range(data.shape[0]):
+            for t in range(self.L):
+                self._tables[t][int(keys[i, t])].append(base + i)
+        return self
+
+    def candidates(self, query) -> np.ndarray:
+        q = np.asarray(query, np.float32).reshape(1, -1)
+        keys = self._keys(q)[0]
+        cand = set()
+        for t in range(self.L):
+            cand.update(self._tables[t].get(int(keys[t]), ()))
+        return np.fromiter(cand, np.int64, len(cand))
+
+    def search(self, query, k: int = 10) -> List[Tuple[int, float]]:
+        """Approximate k-NN: exact re-rank of the union of candidate buckets.
+        Returns [(index, distance)] closest first; falls back to brute force
+        when the buckets miss (rare, small data)."""
+        q = np.asarray(query, np.float32).reshape(-1)
+        cand = self.candidates(q)
+        if cand.size < k:
+            cand = np.arange(self._data.shape[0])
+        d = np.linalg.norm(self._data[cand] - q[None, :], axis=1)
+        order = np.argsort(d)[:k]
+        return [(int(cand[i]), float(d[i])) for i in order]

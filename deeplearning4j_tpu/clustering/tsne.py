@@ -1,15 +1,27 @@
-"""t-SNE dimensionality reduction.
+"""t-SNE dimensionality reduction: exact and grid-accelerated.
 
 Parity: ref deeplearning4j-core/.../plot/BarnesHutTsne.java:65 (Builder with
 perplexity/theta/maxIter/learningRate/momentum, fit(X), getData) and plot/Tsne.
 
-TPU-first redesign: the reference approximates the repulsive forces with a
-Barnes-Hut quadtree (theta) because CPU O(N^2) is slow — but the quadtree is a
-pointer-chasing scalar workload. On the MXU the EXACT O(N^2) gradient is two batched
-matmuls per iteration and wins for any N that fits in HBM, so `theta` is accepted
-and ignored (documented delta). The optimization loop (gains + momentum + early
-exaggeration, matching van der Maaten's reference schedule the Java code follows)
-runs as ONE lax.scan on device.
+TPU-first redesign, two regimes:
+
+- exact (small/medium N): the O(N^2) gradient is two batched matmuls per
+  iteration — on the MXU this beats any tree for N that fits in HBM. The whole
+  optimization (gains + momentum + early exaggeration, van der Maaten's
+  schedule which the Java code follows) is ONE lax.scan on device.
+
+- grid (large N; the reference's Barnes-Hut regime, BarnesHutTsne.java:65 +
+  sptree/SpTree.java): the quadtree/sp-tree is a pointer-chasing scalar
+  workload that cannot map to the MXU, so the far-field summarization is
+  redesigned as a UNIFORM GRID: embedding points scatter-add into G x G cells
+  (centroid + count, both one segment-sum), and every point computes its
+  repulsion against the M = G^2 cell summaries — a dense (N, M) Student-t
+  kernel, statically shaped, MXU-batched: O(N*M) instead of O(N^2). Attractive
+  forces use the standard sparse k-NN conditional P (k = 3*perplexity, exactly
+  BarnesHutTsne's computeGaussianPerplexity(..., K) sparsification), with the
+  k-NN search itself chunked so memory stays O(chunk * N). This grid
+  summarizer is the TPU-native analog of the reference's
+  clustering/sptree/SpTree.java + quadtree/QuadTree.java.
 """
 from __future__ import annotations
 
@@ -96,14 +108,133 @@ def _tsne_loop(P, y0, learning_rate, momentum_start, momentum_final,
     return y, kls
 
 
+@functools.partial(jax.jit, static_argnames=("k", "chunk"))
+def _knn_chunked(x, k: int, chunk: int):
+    """(idx, d2) of the k nearest neighbors per row, scanning row chunks so no
+    N x N buffer ever materializes (self excluded)."""
+    n, d = x.shape
+    sq = jnp.sum(x * x, axis=1)
+    pad = (-n) % chunk
+    xp = jnp.pad(x, ((0, pad), (0, 0)))
+    sqp = jnp.pad(sq, (0, pad))
+    rows0 = jnp.arange(xp.shape[0]).reshape(-1, chunk)
+
+    def one_chunk(_, rows):
+        xc = xp[rows]                                    # (chunk, d)
+        d2 = sqp[rows][:, None] + sq[None, :] - 2.0 * xc @ x.T  # (chunk, N)
+        d2 = d2.at[jnp.arange(rows.shape[0]), jnp.clip(rows, 0, n - 1)].set(
+            jnp.inf)                                     # drop self
+        neg, idx = jax.lax.top_k(-d2, k)
+        return None, (idx, -neg)
+
+    _, (idx, d2) = jax.lax.scan(one_chunk, None, rows0)
+    idx = idx.reshape(-1, k)[:n]
+    d2 = jnp.maximum(d2.reshape(-1, k)[:n], 0.0)
+    return idx.astype(jnp.int32), d2
+
+
+@functools.partial(jax.jit, static_argnames=("tol_iters",))
+def _cond_probs_knn(d2, log_perplexity, tol_iters: int = 50):
+    """Per-row beta search over the k-NN distances only (ref BarnesHutTsne
+    computeGaussianPerplexity(D, N, K) sparse branch)."""
+
+    def row_search(d2_row):
+        def body(carry, _):
+            beta, lo, hi = carry
+            p = jnp.exp(-d2_row * beta)
+            sum_p = jnp.maximum(jnp.sum(p), 1e-12)
+            h = jnp.log(sum_p) + beta * jnp.sum(d2_row * p) / sum_p
+            too_high = h > log_perplexity
+            lo = jnp.where(too_high, beta, lo)
+            hi = jnp.where(too_high, hi, beta)
+            beta = jnp.where(too_high,
+                             jnp.where(jnp.isinf(hi), beta * 2, (beta + hi) / 2),
+                             (lo + beta) / 2)
+            return (beta, lo, hi), None
+
+        (beta, _, _), _ = jax.lax.scan(
+            body, (jnp.asarray(1.0, d2.dtype), jnp.asarray(0.0, d2.dtype),
+                   jnp.asarray(jnp.inf, d2.dtype)), None, length=tol_iters)
+        p = jnp.exp(-d2_row * beta)
+        return p / jnp.maximum(jnp.sum(p), 1e-12)
+
+    return jax.vmap(row_search)(d2)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("iters", "exaggeration_iters", "grid"))
+def _tsne_loop_grid(rows, cols, pvals, y0, learning_rate, momentum_start,
+                    momentum_final, iters: int, exaggeration_iters: int,
+                    grid: int):
+    """Sparse-attract + grid-repulse optimization loop (see module docstring).
+    rows/cols/pvals: symmetrized COO of P (nnz = 2*N*k)."""
+    n = y0.shape[0]
+    M = grid * grid
+
+    def grad_kl(y, exag):
+        # ---- far field: summarize the embedding into grid cells
+        lo = jnp.min(y, axis=0)
+        hi = jnp.max(y, axis=0)
+        span = jnp.maximum(hi - lo, 1e-6)
+        cell = jnp.clip(((y - lo) / span * grid).astype(jnp.int32), 0, grid - 1)
+        cid = cell[:, 0] * grid + cell[:, 1]
+        cnt = jnp.zeros((M,), y.dtype).at[cid].add(1.0)
+        cent = jnp.zeros((M, 2), y.dtype).at[cid].add(y) \
+            / jnp.maximum(cnt, 1.0)[:, None]
+        diff = y[:, None, :] - cent[None, :, :]          # (N, M, 2)
+        num = cnt[None, :] / (1.0 + jnp.sum(diff * diff, axis=-1))  # (N, M)
+        Z = jnp.maximum(jnp.sum(num) - n, 1e-12)  # minus self pairs (q_ii=0)
+        f_rep = jnp.sum((num / cnt.clip(1.0)[None, :] * num)[..., None] * diff,
+                        axis=1)                          # sum_m n_m q_im^2 dir
+
+        # ---- near field: exact attraction on the sparse P edges
+        dy = y[rows] - y[cols]                           # (nnz, 2)
+        enum = 1.0 / (1.0 + jnp.sum(dy * dy, axis=-1))   # (nnz,)
+        pe = pvals * exag
+        f_attr = jnp.zeros_like(y).at[rows].add((pe * enum)[:, None] * dy)
+        g = 4.0 * (f_attr - f_rep / Z)
+        kl = jnp.sum(pe * jnp.log(jnp.maximum(pe, 1e-12)
+                                  / jnp.maximum(enum / Z, 1e-12)))
+        return g, kl
+
+    def body(carry, it):
+        y, vel, gains = carry
+        exag = jnp.where(it < exaggeration_iters, 4.0, 1.0)
+        mom = jnp.where(it < exaggeration_iters, momentum_start, momentum_final)
+        g, kl = grad_kl(y, exag)
+        same_sign = jnp.sign(g) == jnp.sign(vel)
+        # unlike exact/BH forces, cell-quantization noise makes gradient signs
+        # jitter near convergence; unclamped delta-bar-delta gains then grow
+        # without bound and the step explodes — clamp gains and trust-region
+        # the per-point displacement to a fraction of the embedding span
+        gains = jnp.clip(
+            jnp.where(same_sign, gains * 0.8, gains + 0.2), 0.01, 10.0)
+        vel = mom * vel - learning_rate * gains * g
+        span = jnp.maximum(jnp.max(jnp.abs(y)), 1.0)
+        step_norm = jnp.linalg.norm(vel, axis=1, keepdims=True)
+        max_step = 0.05 * span + 0.5
+        vel = vel * jnp.minimum(1.0, max_step / jnp.maximum(step_norm, 1e-12))
+        y = y + vel
+        y = y - jnp.mean(y, axis=0)
+        return (y, vel, gains), kl
+
+    (y, _, _), kls = jax.lax.scan(
+        body, (y0, jnp.zeros_like(y0), jnp.ones_like(y0)), jnp.arange(iters))
+    return y, kls
+
+
 class Tsne:
     """Exact t-SNE (ref plot/Tsne.java)."""
+
+    # exact-method cutover for method="auto" (exact needs the N x N buffer)
+    AUTO_EXACT_MAX_N = 4096
 
     def __init__(self, max_iter: int = 500, perplexity: float = 30.0,
                  learning_rate: float = 200.0, num_dimension: int = 2,
                  momentum: float = 0.5, final_momentum: float = 0.8,
                  stop_lying_iteration: int = 100, theta: float = 0.5,
-                 seed: int = 12345):
+                 seed: int = 12345, method: str = "exact",
+                 grid_size: int = 64, knn_chunk: int = 1024):
         self.max_iter = int(max_iter)
         self.perplexity = float(perplexity)
         self.learning_rate = float(learning_rate)
@@ -111,14 +242,26 @@ class Tsne:
         self.momentum = float(momentum)
         self.final_momentum = float(final_momentum)
         self.stop_lying_iteration = int(stop_lying_iteration)
-        self.theta = float(theta)  # accepted for parity; exact gradient used
+        self.theta = float(theta)
         self.seed = int(seed)
+        if method not in ("exact", "grid", "auto"):
+            raise ValueError(f"method must be exact|grid|auto, got {method!r}")
+        self.method = method
+        self.grid_size = int(grid_size)
+        self.knn_chunk = int(knn_chunk)
         self.y: Optional[np.ndarray] = None
         self.kl_history: Optional[np.ndarray] = None
+
+    def _resolved_method(self, n: int) -> str:
+        if self.method != "auto":
+            return self.method
+        return "exact" if n <= self.AUTO_EXACT_MAX_N else "grid"
 
     def fit(self, x) -> np.ndarray:
         x = jnp.asarray(x, jnp.float32)
         n = x.shape[0]
+        if self._resolved_method(n) == "grid":
+            return self._fit_grid(x)
         d2 = (jnp.sum(x * x, axis=1)[:, None] + jnp.sum(x * x, axis=1)[None, :]
               - 2.0 * x @ x.T)
         cond = _cond_probs(d2, jnp.log(jnp.asarray(self.perplexity, jnp.float32)))
@@ -131,6 +274,37 @@ class Tsne:
                             jnp.float32(self.final_momentum),
                             iters=self.max_iter,
                             exaggeration_iters=self.stop_lying_iteration)
+        self.y = np.asarray(y)
+        self.kl_history = np.asarray(kls)
+        return self.y
+
+    def _fit_grid(self, x) -> np.ndarray:
+        """Sparse k-NN attraction + G x G grid repulsion (module docstring);
+        only 2-D embeddings (the reference's Barnes-Hut is 2-D-only as well)."""
+        if self.num_dimension != 2:
+            raise ValueError("grid method supports num_dimension=2 "
+                             "(like the reference's Barnes-Hut quadtree)")
+        n = x.shape[0]
+        k = min(n - 1, max(4, int(3 * self.perplexity)))
+        chunk = min(self.knn_chunk, n)
+        idx, d2 = _knn_chunked(x, k, chunk)
+        cond = _cond_probs_knn(
+            d2, jnp.log(jnp.asarray(self.perplexity, jnp.float32)))
+        # symmetrize the sparse conditional: COO with both orientations
+        r = jnp.repeat(jnp.arange(n, dtype=jnp.int32), k)
+        c = idx.reshape(-1)
+        v = cond.reshape(-1) / (2.0 * n)
+        rows = jnp.concatenate([r, c])
+        cols = jnp.concatenate([c, r])
+        pvals = jnp.concatenate([v, v])
+        rng = np.random.RandomState(self.seed)
+        y0 = jnp.asarray(rng.randn(n, 2) * 1e-4, jnp.float32)
+        y, kls = _tsne_loop_grid(
+            rows, cols, pvals, y0, jnp.float32(self.learning_rate),
+            jnp.float32(self.momentum), jnp.float32(self.final_momentum),
+            iters=self.max_iter,
+            exaggeration_iters=self.stop_lying_iteration,
+            grid=self.grid_size)
         self.y = np.asarray(y)
         self.kl_history = np.asarray(kls)
         return self.y
@@ -151,12 +325,26 @@ class Tsne:
 
 
 class BarnesHutTsne(Tsne):
-    """API-parity alias (ref plot/BarnesHutTsne.java:65). The theta knob is
-    accepted but the exact MXU gradient is used — see module docstring."""
+    """(ref plot/BarnesHutTsne.java:65). method='auto': the exact MXU gradient
+    up to AUTO_EXACT_MAX_N points (where it beats any tree), the grid-summarized
+    far field beyond — the TPU rendition of the reference's theta-controlled
+    quadtree approximation (see module docstring)."""
+
+    def __init__(self, **kw):
+        kw.setdefault("method", "auto")
+        super().__init__(**kw)
 
     class Builder:
         def __init__(self):
             self._kw = {}
+
+        def method(self, m):
+            self._kw["method"] = str(m)
+            return self
+
+        def grid_size(self, g):
+            self._kw["grid_size"] = int(g)
+            return self
 
         def setMaxIter(self, n):
             self._kw["max_iter"] = int(n)

@@ -7,13 +7,18 @@ LoC) + SequenceRecordReaderDataSetIterator. Record decoding is host-side ETL; th
 iterators emit ready-to-device DataSet batches.
 """
 from deeplearning4j_tpu.datavec.readers import (
-    CollectionRecordReader, CSVRecordReader, CSVSequenceRecordReader,
-    FileSplit, ImageRecordReader, ListStringSplit, RecordReader)
+    CollectionRecordReader, CollectionSequenceRecordReader, CSVRecordReader,
+    CSVSequenceRecordReader, FileSplit, ImageRecordReader, ListStringSplit,
+    RecordReader)
 from deeplearning4j_tpu.datavec.iterator import (
     RecordReaderDataSetIterator, SequenceRecordReaderDataSetIterator)
+from deeplearning4j_tpu.datavec.multi_iterator import (
+    AlignmentMode, RecordReaderMultiDataSetIterator)
 
 __all__ = [
     "RecordReader", "CSVRecordReader", "CSVSequenceRecordReader",
-    "ImageRecordReader", "CollectionRecordReader", "FileSplit", "ListStringSplit",
+    "ImageRecordReader", "CollectionRecordReader",
+    "CollectionSequenceRecordReader", "FileSplit", "ListStringSplit",
     "RecordReaderDataSetIterator", "SequenceRecordReaderDataSetIterator",
+    "AlignmentMode", "RecordReaderMultiDataSetIterator",
 ]

@@ -177,6 +177,31 @@ class CSVSequenceRecordReader(RecordReader):
         self._i = 0
 
 
+class CollectionSequenceRecordReader(RecordReader):
+    """(ref collection/CollectionSequenceRecordReader.java) — in-memory
+    sequences: each element is a list of timesteps, each timestep a list of
+    writable values."""
+
+    def __init__(self, sequences):
+        self._seqs = [list(map(list, s)) for s in sequences]
+        self._i = 0
+
+    def initialize(self, split=None) -> None:
+        self._i = 0
+
+    def has_next(self) -> bool:
+        return self._i < len(self._seqs)
+
+    def next_sequence(self):
+        s = self._seqs[self._i]
+        self._i += 1
+        return s
+    next = next_sequence
+
+    def reset(self) -> None:
+        self._i = 0
+
+
 class ImageRecordReader(RecordReader):
     """(ref datavec-data-image ImageRecordReader.java) — decodes images to CHW
     float arrays; the label is derived from the parent directory name

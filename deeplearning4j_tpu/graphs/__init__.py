@@ -9,9 +9,11 @@ into the shared embedding trainer.
 from deeplearning4j_tpu.graphs.api import Edge, Graph, Vertex
 from deeplearning4j_tpu.graphs.loader import GraphLoader
 from deeplearning4j_tpu.graphs.random_walk import (
-    NoEdgeHandling, RandomWalkIterator, WeightedRandomWalkIterator)
+    Node2VecWalkIterator, NoEdgeHandling, RandomWalkIterator,
+    WeightedRandomWalkIterator)
 from deeplearning4j_tpu.graphs.deepwalk import DeepWalk
+from deeplearning4j_tpu.graphs.node2vec import Node2Vec
 
 __all__ = ["Graph", "Vertex", "Edge", "RandomWalkIterator",
-           "WeightedRandomWalkIterator", "NoEdgeHandling", "DeepWalk",
-           "GraphLoader"]
+           "WeightedRandomWalkIterator", "Node2VecWalkIterator",
+           "NoEdgeHandling", "DeepWalk", "Node2Vec", "GraphLoader"]

@@ -1,0 +1,51 @@
+"""Node2Vec vertex embeddings: p/q-biased walks + SkipGram over the walks.
+
+Parity note: the reference ships models/node2vec/Node2Vec.java but marks it
+@Deprecated with "PLEASE NOTE: This class is under construction and isn't
+suited for any use" (its inferVector returns null). This module provides the
+WORKING equivalent the reference intended: a SequenceVectors specialization
+over Node2VecWalkIterator (Grover & Leskovec 2016) — the same
+walk-corpus-into-SkipGram structure as DeepWalk, with second-order bias.
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.graphs.api import Graph
+from deeplearning4j_tpu.graphs.deepwalk import DeepWalk
+from deeplearning4j_tpu.graphs.random_walk import Node2VecWalkIterator
+
+
+class Node2Vec(DeepWalk):
+    """DeepWalk with p/q-biased walks; all DeepWalk queries/serde carry over.
+    Hierarchical softmax by default (like DeepWalk): vertex vocabularies are
+    small, where negative sampling degenerates (half the 'vocabulary' gets
+    pushed away every step)."""
+
+    def __init__(self, p: float = 1.0, q: float = 1.0, **kw):
+        super().__init__(**kw)
+        self.p = float(p)
+        self.q = float(q)
+
+    def fit(self, walk_iterator: Optional[Node2VecWalkIterator] = None,
+            walk_length: int = 40):
+        if walk_iterator is None:
+            if self.graph is None:
+                raise ValueError("call initialize(graph) or pass a walk iterator")
+            walk_iterator = Node2VecWalkIterator(
+                self.graph, walk_length, p=self.p, q=self.q, seed=self.seed)
+        return super().fit(walk_iterator=walk_iterator)
+
+    class Builder(DeepWalk.Builder):
+        def p(self, v: float):
+            self._kw["p"] = float(v)
+            return self
+
+        def q(self, v: float):
+            self._kw["q"] = float(v)
+            return self
+
+        def build(self) -> "Node2Vec":
+            return Node2Vec(**self._kw)

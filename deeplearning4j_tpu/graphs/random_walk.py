@@ -69,6 +69,57 @@ class RandomWalkIterator:
             yield self.next_walk()
 
 
+class Node2VecWalkIterator(RandomWalkIterator):
+    """Second-order p/q-biased walks (Grover & Leskovec 2016). The reference's
+    models/node2vec/Node2Vec.java is @Deprecated and non-functional ("isn't
+    suited for any use"); this is the working TPU-framework rendition: return
+    parameter p discounts revisiting the previous vertex, in-out parameter q
+    discounts moving beyond the previous vertex's neighborhood."""
+
+    def __init__(self, graph: Graph, walk_length: int, p: float = 1.0,
+                 q: float = 1.0, seed: int = 12345,
+                 no_edge_handling: str = NoEdgeHandling.SELF_LOOP_ON_DISCONNECTED):
+        self.p = float(p)
+        self.q = float(q)
+        super().__init__(graph, walk_length, seed, no_edge_handling)
+        # adjacency sets for O(1) "is x a neighbor of prev" tests
+        self._nbr_sets = [set(int(v) for v in nbrs) for nbrs in self._nbrs]
+
+    def next_walk(self) -> List[int]:
+        start = int(self._order[self._pos])
+        self._pos += 1
+        walk = [start]
+        prev: Optional[int] = None
+        cur = start
+        for _ in range(self.walk_length):
+            nbrs = self._nbrs[cur]
+            if nbrs.size == 0:
+                if self.no_edge_handling == NoEdgeHandling.EXCEPTION_ON_DISCONNECTED:
+                    raise ValueError(f"Vertex {cur} has no outgoing edges")
+                walk.append(cur)
+                prev = cur
+                continue
+            if prev is None:
+                nxt = int(nbrs[self._rng.randint(nbrs.size)])
+            else:
+                prev_nbrs = self._nbr_sets[prev]
+                w = np.empty(nbrs.size, np.float64)
+                for i, x in enumerate(nbrs):
+                    xi = int(x)
+                    if xi == prev:
+                        w[i] = 1.0 / self.p
+                    elif xi in prev_nbrs:
+                        w[i] = 1.0
+                    else:
+                        w[i] = 1.0 / self.q
+                w /= w.sum()
+                nxt = int(nbrs[self._rng.choice(nbrs.size, p=w)])
+            walk.append(nxt)
+            prev, cur = cur, nxt
+        return walk
+    next = next_walk
+
+
 class WeightedRandomWalkIterator(RandomWalkIterator):
     """Transition probability proportional to edge weight
     (ref WeightedRandomWalkIterator.java). Probabilities are normalized ONCE at

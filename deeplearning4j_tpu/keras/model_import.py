@@ -17,7 +17,7 @@ import numpy as np
 from deeplearning4j_tpu.common.enums import Activation, LossFunction
 from deeplearning4j_tpu.keras.hdf5 import Hdf5Archive
 from deeplearning4j_tpu.keras.layers import (
-    KerasLayerConversion, convert_layer, keras_loss)
+    KerasLayerConversion, check_training_config, convert_layer, keras_loss)
 from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.input_type import InputType
 from deeplearning4j_tpu.nn.conf.layers.feedforward import DenseLayer, OutputLayer
@@ -43,7 +43,8 @@ def _input_type_from_shape(shape, channels_last=True) -> InputType:
     raise ValueError(f"Unsupported Keras input shape: {shape}")
 
 
-def _training_loss(archive: Hdf5Archive) -> Optional[LossFunction]:
+def _training_loss(archive: Hdf5Archive,
+                   enforce: bool = False) -> Optional[LossFunction]:
     tc = archive.read_attribute_as_json("training_config")
     if not tc:
         return None
@@ -54,6 +55,15 @@ def _training_loss(archive: Hdf5Archive) -> Optional[LossFunction]:
         try:
             return keras_loss(loss)
         except ValueError:
+            if enforce:
+                from deeplearning4j_tpu.keras.layers import (
+                    UnsupportedKerasConfigurationException)
+                raise UnsupportedKerasConfigurationException(
+                    f"Unsupported Keras training loss {loss!r} "
+                    f"(enforce_training_config=True)")
+            import warnings
+            warnings.warn(f"Unsupported Keras training loss {loss!r} — "
+                          f"falling back to activation default")
             return None
     return None
 
@@ -83,8 +93,13 @@ class KerasModelImport:
                 raise ValueError("Not a Sequential model; use "
                                  "import_keras_model_and_weights")
             cfg = model_config["config"]
+            # Keras 1.x stores the layer list directly; 2.x wraps it
             layer_dicts = cfg["layers"] if isinstance(cfg, dict) else cfg
-            loss = _training_loss(archive)
+            loss = _training_loss(archive, enforce_training_config)
+            # theano dim ordering (Keras 1.x "th"): conv kernels flip 180 and
+            # Flatten is channels-FIRST C-order (ref KerasLayer.DimOrder.THEANO)
+            theano = any(ld.get("config", {}).get("dim_ordering") == "th"
+                         for ld in layer_dicts)
 
             builder = NeuralNetConfiguration.Builder().list()
             conversions: List[Tuple[str, KerasLayerConversion]] = []
@@ -99,10 +114,12 @@ class KerasModelImport:
                 class_name = ld["class_name"]
                 lcfg = ld.get("config", {})
                 name = lcfg.get("name", f"layer_{idx}")
+                check_training_config(class_name, lcfg, enforce_training_config)
                 if input_type is None:
                     shape = lcfg.get("batch_input_shape")
                     if shape:
-                        input_type = _input_type_from_shape(shape)
+                        input_type = _input_type_from_shape(
+                            shape, channels_last=not theano)
                         is_rnn_stream = input_type.kind == "rnn"
                 if class_name == "InputLayer":
                     continue
@@ -117,14 +134,24 @@ class KerasModelImport:
                         "or return_sequences=True")
                 seen_real += 1
                 as_output = None
+                from deeplearning4j_tpu.keras.layers import keras_activation
                 if seen_real == n_real and class_name == "Dense":
                     # final layer becomes the scoring output layer; on a sequence
                     # stream Keras Dense is per-timestep -> RnnOutputLayer
                     act = lcfg.get("activation")
-                    from deeplearning4j_tpu.keras.layers import keras_activation
                     as_output = loss or _default_loss(keras_activation(act))
-                conv = convert_layer(class_name, lcfg, as_output=as_output,
-                                     rnn_stream=is_rnn_stream)
+                if seen_real == n_real and class_name == "Activation":
+                    # Keras-1 idiom: Dense(linear) then Activation(softmax);
+                    # the reference appends a KerasLoss LossLayer
+                    # (KerasModel.java:227-251) — our LossLayer fuses both
+                    from deeplearning4j_tpu.nn.conf.layers.feedforward import (
+                        LossLayer)
+                    act = keras_activation(lcfg.get("activation"))
+                    conv = KerasLayerConversion(LossLayer(
+                        loss_fn=loss or _default_loss(act), activation=act))
+                else:
+                    conv = convert_layer(class_name, lcfg, as_output=as_output,
+                                         rnn_stream=is_rnn_stream)
                 if class_name in ("LSTM",):
                     is_rnn_stream = True
                 elif class_name in ("Dense", "GlobalMaxPooling1D",
@@ -133,8 +160,14 @@ class KerasModelImport:
                 if conv.is_input or conv.layer is None:
                     continue
                 if flatten_pending:
-                    builder.input_pre_processor(
-                        idx, TensorFlowCnnToFeedForwardPreProcessor())
+                    if theano:
+                        from deeplearning4j_tpu.nn.conf.preprocessors import (
+                            CnnToFeedForwardPreProcessor)
+                        builder.input_pre_processor(
+                            idx, CnnToFeedForwardPreProcessor())
+                    else:
+                        builder.input_pre_processor(
+                            idx, TensorFlowCnnToFeedForwardPreProcessor())
                     flatten_pending = False
                 builder.layer(conv.layer)
                 conversions.append((name, conv))
@@ -193,8 +226,37 @@ class KerasModelImport:
                     # preprocessor attached at their own node
                     flatten_from[name] = "__flatten__:" + inbound[0]
                     continue
-                if class_name in ("Add", "Merge", "add"):
+                check_training_config(class_name, lcfg, enforce_training_config)
+                if class_name == "Merge":
+                    # Keras 1.x Merge layer with a mode string
+                    # (ref KerasMerge mergeModeMapping)
+                    mode = lcfg.get("mode", "sum")
+                    if mode in ("concat", "concatenate"):
+                        g.add_vertex(name, MergeVertex(), *inbound)
+                    else:
+                        op = {"sum": "Add", "add": "Add", "mul": "Product",
+                              "multiply": "Product", "ave": "Average",
+                              "avg": "Average", "average": "Average",
+                              "max": "Max"}.get(mode)
+                        if op is None:
+                            raise ValueError(
+                                f"Unsupported Keras Merge mode: {mode!r}")
+                        g.add_vertex(name, ElementWiseVertex(op=op), *inbound)
+                    continue
+                if class_name in ("Add", "add"):
                     g.add_vertex(name, ElementWiseVertex(op="Add"), *inbound)
+                    continue
+                if class_name in ("Multiply", "multiply"):
+                    g.add_vertex(name, ElementWiseVertex(op="Product"), *inbound)
+                    continue
+                if class_name in ("Average", "average"):
+                    g.add_vertex(name, ElementWiseVertex(op="Average"), *inbound)
+                    continue
+                if class_name in ("Maximum", "maximum"):
+                    g.add_vertex(name, ElementWiseVertex(op="Max"), *inbound)
+                    continue
+                if class_name in ("Subtract", "subtract"):
+                    g.add_vertex(name, ElementWiseVertex(op="Subtract"), *inbound)
                     continue
                 if class_name in ("Concatenate", "concatenate"):
                     g.add_vertex(name, MergeVertex(), *inbound)

@@ -1,21 +1,27 @@
 """AlexNet (ref deeplearning4j-zoo/.../zoo/model/AlexNet.java:41).
 
-Mirrors the reference's single-stream variant (AlexNet.java:85-129): conv11x11/4 → LRN →
-maxpool3/2 → conv5x5(s2,p2,192) → maxpool → conv3x3(384) → conv3x3(256) → conv3x3(256) →
-maxpool3/7 → dense4096(drop0.5) ×2 → softmax; Nesterovs lr 1e-2, N(0,0.01) weights,
-l2 5e-4, bias 1 on the deep layers.
+Mirrors the reference's single-stream variant layer-for-layer (AlexNet.java:85-131):
+conv11x11/4(p2,Truncate,64) → maxpool3/2(p1,Truncate) → conv5x5/2(p2,Truncate,192) →
+maxpool3/2(Same) → conv3x3(384) → conv3x3(256) → conv3x3(256) → maxpool3/7(Same) →
+dense4096(N(0,0.005), bias 1, drop0.5) ×2 → softmax NLL; global ConvolutionMode.Same,
+global dropout 0.5, RenormalizeL2PerLayer gradient normalization, Nesterovs lr 1e-2,
+N(0,0.01) weights, l2 5e-4. Note the reference has NO LocalResponseNormalization
+layers (its own deviation from Krizhevsky et al.) and its strides (cnn2 s2,
+maxpool3 s7) carry in-source TODOs — mirrored verbatim for parity, giving
+ffn1 nIn=256 (AlexNet.java:122). The reference's biasLearningRate(2e-2) has no
+per-param-LR analog here (updaters apply one LR per layer) — documented delta.
 """
 from __future__ import annotations
 
 from deeplearning4j_tpu.common.enums import (
-    Activation, LossFunction, PoolingType, WeightInit)
+    Activation, ConvolutionMode, GradientNormalization, LossFunction,
+    PoolingType, WeightInit)
 from deeplearning4j_tpu.models.zoo_model import ZooModel
 from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.input_type import InputType
 from deeplearning4j_tpu.nn.conf.layers.convolutional import (
     ConvolutionLayer, SubsamplingLayer)
 from deeplearning4j_tpu.nn.conf.layers.feedforward import DenseLayer, OutputLayer
-from deeplearning4j_tpu.nn.conf.layers.normalization import LocalResponseNormalization
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.updater.updaters import Nesterovs
 
@@ -41,18 +47,24 @@ class AlexNet(ZooModel):
                 .dist({"type": "normal", "mean": 0.0, "std": 0.01})
                 .activation(Activation.RELU)
                 .updater(self.updater)
+                .convolution_mode(ConvolutionMode.Same)
+                .gradient_normalization(GradientNormalization.RenormalizeL2PerLayer)
+                .dropOut(drop)
                 .l2(5e-4)
                 .dtype(self.dtype)
                 .compute_dtype(self.compute_dtype)
                 .list()
                 .layer(ConvolutionLayer(name="cnn1", n_in=c, n_out=64,
                                         kernel_size=(11, 11), stride=(4, 4),
-                                        padding=(3, 3)))
-                .layer(LocalResponseNormalization(name="lrn1"))
+                                        padding=(2, 2),
+                                        convolution_mode=ConvolutionMode.Truncate))
                 .layer(SubsamplingLayer(name="maxpool1", pooling_type=PoolingType.MAX,
-                                        kernel_size=(3, 3), stride=(2, 2)))
+                                        kernel_size=(3, 3), stride=(2, 2),
+                                        padding=(1, 1),
+                                        convolution_mode=ConvolutionMode.Truncate))
                 .layer(ConvolutionLayer(name="cnn2", n_out=192, kernel_size=(5, 5),
                                         stride=(2, 2), padding=(2, 2),
+                                        convolution_mode=ConvolutionMode.Truncate,
                                         bias_init=non_zero_bias))
                 .layer(SubsamplingLayer(name="maxpool2", pooling_type=PoolingType.MAX,
                                         kernel_size=(3, 3), stride=(2, 2)))
@@ -75,7 +87,7 @@ class AlexNet(ZooModel):
                 .layer(OutputLayer(name="output", n_out=self.num_labels,
                                    loss_fn=LossFunction.NEGATIVELOGLIKELIHOOD,
                                    activation=Activation.SOFTMAX))
-                .set_input_type(InputType.convolutional(h, w, c))
+                .set_input_type(InputType.convolutional_flat(h, w, c))
                 .build())
 
     def init(self) -> MultiLayerNetwork:

@@ -5,10 +5,14 @@ modules (3a..5b) with the exact branch channel table (GoogLeNet.java:155-169), a
 pool 7x7, dropout FC head, NLL softmax output; Nesterovs(1e-2, 0.9) updater, Xavier
 init, l2=2e-4.
 
-Documented deviation: the reference wires inception 4a from "3b-depthconcat1",
-leaving its own "max3" pooling layer dangling (GoogLeNet.java:157-160) — an
-upstream bug that breaks the spatial dimensioning of stages 4-5. Here 4a consumes
-max3, giving the actual GoogLeNet topology of the paper the reference cites.
+Documented deviations (both required for the model to be well-formed): (1) the
+reference wires inception 4a from "3b-depthconcat1", leaving its own "max3"
+pooling layer dangling (GoogLeNet.java:157-160); here 4a consumes max3. (2) the
+reference's stem/stage pools use padding {0,0} (GoogLeNet.java:146-151,:158,:166),
+which at the declared 3x224x224 input yields a 7x7x1024 map into the fc1 layer
+whose nIn is hard-coded 1024 (GoogLeNet.java:171) — the reference model cannot
+even initialize as written. Here those pools pad (1,1), giving the paper's
+topology where avg-pool-7x7 sees exactly 7x7 and fc1's 1024 is correct.
 """
 from __future__ import annotations
 
@@ -52,7 +56,11 @@ class GoogLeNet(ZooModel):
                  compute_dtype=None):
         super().__init__(num_labels, seed)
         self.input_shape = tuple(input_shape)
-        self.updater = updater or Nesterovs(learning_rate=1e-2, momentum=0.9)
+        # ref GoogLeNet.java:141-143: Nesterovs(1e-2, 0.9) with Step lr decay
+        # 0.96 every 320k iterations
+        self.updater = updater or Nesterovs(
+            learning_rate=1e-2, momentum=0.9,
+            schedule={"type": "step", "decay_rate": 0.96, "steps": 320000})
         self.dtype = dtype
         self.compute_dtype = compute_dtype
 

@@ -120,8 +120,11 @@ class ResNet50(ZooModel):
         x = self._identity_block(g, (3, 3), (512, 512, 2048), "5", "b", x)
         x = self._identity_block(g, (3, 3), (512, 512, 2048), "5", "c", x)
 
+        # ref ResNet50.java:218: Builder(MAX, {3,3}) leaves stride at the DL4J
+        # default {2,2} (SubsamplingLayer.java:295) -> final map 1x1x2048, so the
+        # head sees 2048 features (canonical ~25.6M total params)
         (g.add_layer("avgpool", SubsamplingLayer(pooling_type=PoolingType.MAX,
-                                                 kernel_size=(3, 3), stride=(1, 1)), x)
+                                                 kernel_size=(3, 3)), x)
           .add_layer("output", OutputLayer(n_out=self.num_labels,
                                            loss_fn=LossFunction.NEGATIVELOGLIKELIHOOD,
                                            activation=Activation.SOFTMAX), "avgpool")

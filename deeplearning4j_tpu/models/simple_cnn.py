@@ -82,8 +82,9 @@ class SimpleCNN(ZooModel):
 
 
 class TextGenerationLSTM(ZooModel):
-    """(ref zoo/model/TextGenerationLSTM.java:81-87) — char-RNN: GravesLSTM(256) ×2 →
-    RnnOutputLayer(MCXENT softmax), truncated BPTT 50/50, gradient norm clipping."""
+    """(ref zoo/model/TextGenerationLSTM.java:75-87) — char-RNN: GravesLSTM(256) ×2 →
+    RnnOutputLayer(MCXENT softmax), truncated BPTT 50/50, RmsProp lr 0.01, l2 1e-3,
+    XAVIER init (the reference applies NO gradient clipping)."""
 
     def __init__(self, total_unique_characters: int = 47, seed: int = 123,
                  max_length: int = 40, updater=None, dtype: str = "float32",
@@ -95,7 +96,7 @@ class TextGenerationLSTM(ZooModel):
         self.compute_dtype = compute_dtype
 
     def conf(self):
-        from deeplearning4j_tpu.common.enums import BackpropType, GradientNormalization
+        from deeplearning4j_tpu.common.enums import BackpropType
         from deeplearning4j_tpu.nn.conf.layers.recurrent import (
             GravesLSTM, RnnOutputLayer)
         from deeplearning4j_tpu.nn.updater.updaters import RmsProp
@@ -103,10 +104,8 @@ class TextGenerationLSTM(ZooModel):
                 .seed(self.seed)
                 .l2(0.001)
                 .weight_init(WeightInit.XAVIER)
-                .updater(self.updater or RmsProp(learning_rate=0.1))
-                .gradient_normalization(
-                    GradientNormalization.ClipElementWiseAbsoluteValue)
-                .gradient_normalization_threshold(1.0)
+                # ref TextGenerationLSTM.java:78: .learningRate(0.01) + RmsProp()
+                .updater(self.updater or RmsProp(learning_rate=0.01))
                 .dtype(self.dtype)
                 .compute_dtype(self.compute_dtype)
                 .list()

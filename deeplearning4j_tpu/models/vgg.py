@@ -14,20 +14,23 @@ from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.conf.input_type import InputType
 from deeplearning4j_tpu.nn.conf.layers.convolutional import (
     ConvolutionLayer, SubsamplingLayer)
-from deeplearning4j_tpu.nn.conf.layers.feedforward import OutputLayer
+from deeplearning4j_tpu.nn.conf.layers.feedforward import DenseLayer, OutputLayer
 from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.nn.updater.updaters import Nesterovs
 
 
 class VGG16(ZooModel):
     BLOCKS = (2, 2, 3, 3, 3)
+    FC = ()  # ref VGG16.java:147-151 comments out the classic FC-4096 pair
 
     def __init__(self, num_labels: int = 1000, seed: int = 123,
                  input_shape=(3, 224, 224), updater=None, dtype: str = "float32",
                  compute_dtype=None):
         super().__init__(num_labels, seed)
         self.input_shape = tuple(input_shape)
-        self.updater = updater or Nesterovs(learning_rate=1e-2, momentum=0.9)
+        # ref VGG16.java:95-97 sets only Updater.NESTEROVS: the builder defaults
+        # apply — lr 1e-1, XAVIER init (NeuralNetConfiguration.java:532,535)
+        self.updater = updater or Nesterovs(learning_rate=1e-1, momentum=0.9)
         self.dtype = dtype
         self.compute_dtype = compute_dtype
 
@@ -37,7 +40,7 @@ class VGG16(ZooModel):
         b = (NeuralNetConfiguration.Builder()
              .seed(self.seed)
              .activation(Activation.RELU)
-             .weight_init(WeightInit.RELU)
+             .weight_init(WeightInit.XAVIER)
              .updater(self.updater)
              .dtype(self.dtype)
                 .compute_dtype(self.compute_dtype)
@@ -49,10 +52,12 @@ class VGG16(ZooModel):
             b.layer(SubsamplingLayer(name=f"pool{block}",
                                      pooling_type=PoolingType.MAX,
                                      kernel_size=(2, 2), stride=(2, 2)))
+        for i, width in enumerate(self.FC, start=1):
+            b.layer(DenseLayer(name=f"fc{i}", n_out=width))
         b.layer(OutputLayer(name="output", n_out=self.num_labels,
                             loss_fn=LossFunction.NEGATIVELOGLIKELIHOOD,
                             activation=Activation.SOFTMAX))
-        return b.set_input_type(InputType.convolutional(h, w, c)).build()
+        return b.set_input_type(InputType.convolutional_flat(h, w, c)).build()
 
     def pretrained_url(self, pretrained_type):
         if pretrained_type == PretrainedType.IMAGENET:
@@ -66,8 +71,10 @@ class VGG16(ZooModel):
 
 
 class VGG19(VGG16):
-    """(ref zoo/model/VGG19.java) — same family, 2-2-4-4-4 conv stacks."""
+    """(ref zoo/model/VGG19.java) — 2-2-4-4-4 conv stacks; unlike VGG16 the
+    reference keeps ONE Dense(4096) head layer (VGG19.java:143)."""
     BLOCKS = (2, 2, 4, 4, 4)
+    FC = (4096,)
 
     def pretrained_url(self, pretrained_type):
         return None

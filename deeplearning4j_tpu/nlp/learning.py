@@ -141,6 +141,63 @@ def dbow_step(doc_vecs, syn1neg, docs, words, negatives, lr):
     return doc_vecs, syn1neg, loss
 
 
+@functools.partial(jax.jit, donate_argnums=(0, 1, 2))
+def dm_step(syn0, doc_vecs, syn1neg, contexts, cmask, docs, centers, negatives,
+            lr):
+    """PV-DM (ref embeddings/learning/impl/sequence/DM.java:105-144): the mean
+    of the window's context-word vectors AND the document vector predicts the
+    center word through the CBOW negative-sampling objective (DM delegates to
+    CBOW.iterateSample with the label index appended to the window); the
+    gradient is distributed back over the context words and the doc vector.
+
+    contexts: (B,W) padded ids; cmask: (B,W); docs/centers: (B,); negatives (B,K)."""
+    cvecs = syn0[contexts]                              # (B,W,D)
+    dvec = doc_vecs[docs]                               # (B,D)
+    n = jnp.sum(cmask, axis=-1, keepdims=True) + 1.0    # context words + doc
+    h = (jnp.sum(cvecs * cmask[..., None], axis=1) + dvec) / n
+    upos = syn1neg[centers]
+    uneg = syn1neg[negatives]
+    pos_logit = jnp.sum(h * upos, axis=-1)
+    neg_logit = jnp.einsum("bd,bkd->bk", h, uneg)
+    loss = jnp.mean(jax.nn.softplus(-pos_logit)
+                    + jnp.sum(jax.nn.softplus(neg_logit), axis=-1))
+    g_pos = jax.nn.sigmoid(pos_logit) - 1.0
+    g_neg = jax.nn.sigmoid(neg_logit)
+    g_h = g_pos[:, None] * upos + jnp.einsum("bk,bkd->bd", g_neg, uneg)
+    g_upos = g_pos[:, None] * h
+    g_uneg = g_neg[..., None] * h[:, None, :]
+    g_ctx = (g_h / n)[:, None, :] * cmask[..., None]
+    g_doc = g_h / n
+    syn0 = _scatter_mean_update(syn0, contexts, g_ctx, lr, weights=cmask)
+    doc_vecs = _scatter_mean_update(doc_vecs, docs, g_doc, lr)
+    idx = jnp.concatenate([centers[:, None], negatives], axis=1)
+    g_u = jnp.concatenate([g_upos[:, None, :], g_uneg], axis=1)
+    syn1neg = _scatter_mean_update(syn1neg, idx, g_u, lr)
+    return syn0, doc_vecs, syn1neg, loss
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def dm_infer_step(doc_vec, syn0, syn1neg, contexts, cmask, centers, negatives,
+                  lr):
+    """PV-DM inference: train ONE fresh doc vector against frozen word tables
+    (ref DM.inferSequence — isInference=true routes the update solely into the
+    inference vector)."""
+    cvecs = syn0[contexts]
+    n = jnp.sum(cmask, axis=-1, keepdims=True) + 1.0
+    h = (jnp.sum(cvecs * cmask[..., None], axis=1) + doc_vec[None, :]) / n
+    upos = syn1neg[centers]
+    uneg = syn1neg[negatives]
+    pos_logit = jnp.sum(h * upos, axis=-1)
+    neg_logit = jnp.einsum("bd,bkd->bk", h, uneg)
+    loss = jnp.mean(jax.nn.softplus(-pos_logit)
+                    + jnp.sum(jax.nn.softplus(neg_logit), axis=-1))
+    g_pos = jax.nn.sigmoid(pos_logit) - 1.0
+    g_neg = jax.nn.sigmoid(neg_logit)
+    g_h = g_pos[:, None] * upos + jnp.einsum("bk,bkd->bd", g_neg, uneg)
+    g_doc = jnp.mean(g_h / n, axis=0)
+    return doc_vec - lr * g_doc, loss
+
+
 @functools.partial(jax.jit, donate_argnums=(0,))
 def infer_vector_step(doc_vec, syn1neg, words, negatives, lr):
     """Inference-time doc vector training with FROZEN word-side weights

@@ -22,11 +22,22 @@ from deeplearning4j_tpu.nlp.vocab import VocabConstructor
 
 class ParagraphVectors(SequenceVectors):
     def __init__(self, tokenizer_factory: Optional[TokenizerFactory] = None,
-                 train_words: bool = False, **kw):
+                 train_words: bool = False,
+                 sequence_learning_algorithm: str = "PV-DBOW", **kw):
         kw.setdefault("min_word_frequency", 1)
         super().__init__(**kw)
         self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
         self.train_words = bool(train_words)
+        algo = sequence_learning_algorithm.upper().replace("_", "-")
+        if algo in ("PV-DM", "DM"):
+            self.sequence_learning_algorithm = "PV-DM"
+        elif algo in ("PV-DBOW", "DBOW"):
+            self.sequence_learning_algorithm = "PV-DBOW"
+        else:
+            raise ValueError(
+                f"Unknown sequence learning algorithm: "
+                f"{sequence_learning_algorithm!r} (PV-DM | PV-DBOW, "
+                f"ref SequenceVectors.Builder.sequenceLearningAlgorithm)")
         self.label_index: Dict[str, int] = {}
         self.doc_vecs = None  # (num_docs, D)
 
@@ -60,7 +71,13 @@ class ParagraphVectors(SequenceVectors):
         probs = self.vocab.unigram_probs()
         total = max(1, sum(len(t) for _, t in tokenized) * self.epochs)
         seen = 0
+        dm = self.sequence_learning_algorithm == "PV-DM"
+        dm_built = self._dm_windows(tokenized) if dm else None
         for _ in range(self.epochs):
+            if dm:
+                if dm_built is not None:
+                    seen = self._fit_epoch_dm(dm_built, probs, total, seen)
+                continue
             docs_buf, words_buf = [], []
             for lab, toks in tokenized:
                 widx = self._encode(toks)
@@ -84,6 +101,61 @@ class ParagraphVectors(SequenceVectors):
         self._invalidate()
         return self
 
+    def _token_windows(self, widx):
+        """(centers, padded-contexts, masks) for ONE token array — the single
+        source of window semantics, shared by training and inference (ref
+        DM.java:105-130: window positions around each center; the label vector
+        joins the average inside dm_step)."""
+        W = self.window
+        n = widx.size
+        centers = widx.astype(np.int32)
+        ctxs = np.zeros((n, 2 * W), np.int32)
+        masks = np.zeros((n, 2 * W), np.float32)
+        for i in range(n):
+            lo, hi = max(0, i - W), min(n, i + W + 1)
+            ctx = np.concatenate([widx[lo:i], widx[i + 1:hi]])
+            ctxs[i, :ctx.size] = ctx
+            masks[i, :ctx.size] = 1.0
+        return centers, ctxs, masks
+
+    def _dm_windows(self, tokenized):
+        """Window arrays over the whole corpus (built once per fit)."""
+        docs, centers, ctxs, masks = [], [], [], []
+        for lab, toks in tokenized:
+            widx = self._encode(toks)
+            if widx.size == 0:
+                continue
+            c, x, m = self._token_windows(widx)
+            docs.append(np.full(widx.size, self.label_index[lab], np.int32))
+            centers.append(c)
+            ctxs.append(x)
+            masks.append(m)
+        if not docs:
+            return None
+        return (np.concatenate(docs), np.concatenate(centers),
+                np.vstack(ctxs), np.vstack(masks))
+
+    def _fit_epoch_dm(self, built, probs, total, seen):
+        from deeplearning4j_tpu.nlp.learning import dm_step
+        docs, centers, ctxs, masks = built
+        seen += centers.size
+        order = self._rng.permutation(docs.size)
+        docs, centers = docs[order], centers[order]
+        ctxs, masks = ctxs[order], masks[order]
+        alpha = max(self.min_learning_rate,
+                    self.learning_rate * (1.0 - seen / total))
+        syn0 = self.lookup_table.syn0
+        for s in range(0, docs.size, self.batch_size):
+            sl = slice(s, s + self.batch_size)
+            neg = self._negatives((centers[sl].shape[0], self.negative), probs)
+            syn0, self.doc_vecs, self.lookup_table.syn1neg, _ = dm_step(
+                syn0, self.doc_vecs, self.lookup_table.syn1neg,
+                jnp.asarray(ctxs[sl]), jnp.asarray(masks[sl]),
+                jnp.asarray(docs[sl]), jnp.asarray(centers[sl]),
+                jnp.asarray(neg), jnp.float32(alpha))
+        self.lookup_table.syn0 = syn0
+        return seen
+
     # ------------------------------------------------------------- queries
     def get_label_vector(self, label: str) -> Optional[np.ndarray]:
         i = self.label_index.get(label)
@@ -101,6 +173,16 @@ class ParagraphVectors(SequenceVectors):
         if widx.size == 0:
             return np.asarray(vec)
         probs = self.vocab.unigram_probs()
+        if self.sequence_learning_algorithm == "PV-DM":
+            from deeplearning4j_tpu.nlp.learning import dm_infer_step
+            centers, rows, masks = self._token_windows(widx)
+            for s in range(steps):
+                neg = self._negatives((centers.shape[0], self.negative), probs)
+                vec, _ = dm_infer_step(
+                    vec, self.lookup_table.syn0, self.lookup_table.syn1neg,
+                    jnp.asarray(rows), jnp.asarray(masks), jnp.asarray(centers),
+                    jnp.asarray(neg), jnp.float32(lr * (1 - s / steps) + 1e-4))
+            return np.asarray(vec)
         for s in range(steps):
             neg = self._negatives((widx.shape[0], self.negative), probs)
             vec, _ = infer_vector_step(vec, self.lookup_table.syn1neg,
@@ -127,6 +209,7 @@ class ParagraphVectors(SequenceVectors):
             super().__init__()
             self._tf = None
             self._train_words = False
+            self._algo = "PV-DBOW"
 
         def tokenizerFactory(self, tf):
             self._tf = tf
@@ -136,6 +219,15 @@ class ParagraphVectors(SequenceVectors):
             self._train_words = bool(b)
             return self
 
+        def sequence_learning_algorithm(self, name: str):
+            """"PV-DM" | "PV-DBOW" (ref SequenceVectors.Builder
+            .sequenceLearningAlgorithm; DM.java / DBOW.java)."""
+            self._algo = name
+            return self
+        sequenceLearningAlgorithm = sequence_learning_algorithm
+
         def build(self) -> "ParagraphVectors":
             return ParagraphVectors(tokenizer_factory=self._tf,
-                                    train_words=self._train_words, **self._kw)
+                                    train_words=self._train_words,
+                                    sequence_learning_algorithm=self._algo,
+                                    **self._kw)

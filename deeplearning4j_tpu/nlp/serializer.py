@@ -1,12 +1,21 @@
-"""Word-vector serialization in the Google word2vec text/binary formats.
+"""Word-vector serialization: word2vec text/binary formats + full-model zips.
 
-Parity: ref embeddings/loader/WordVectorSerializer.java (writeWordVectors,
-readWord2VecModel text + binary C-format paths). Round-trips between this
-framework, original word2vec.c output, and gensim.
+Parity: ref embeddings/loader/WordVectorSerializer.java (2,830 LoC surface) —
+writeWordVectors / readWord2VecModel (text + binary C formats, gzipped text),
+writeWord2VecModel / readWord2Vec (full-model zip: config + vocab counts +
+syn0/syn1/syn1neg, enabling training continuation, ref :497/:868), and
+writeParagraphVectors / readParagraphVectors (full PV zip incl. label vectors,
+ref :473/:814). Text/binary round-trip with original word2vec.c output and
+gensim; zips are this framework's container (DL4J's zip entries are
+ND4J-serialized and not portable anyway).
 """
 from __future__ import annotations
 
+import gzip
+import io
+import json
 import struct
+import zipfile
 from typing import Optional
 
 import jax.numpy as jnp
@@ -42,7 +51,12 @@ class WordVectorSerializer:
     # ------------------------------------------------------------- read
     @staticmethod
     def read_word_vectors(path: str, binary: Optional[bool] = None) -> WordVectors:
-        """(ref readWord2VecModel — auto-detects binary vs text)"""
+        """(ref readWord2VecModel — auto-detects binary vs text vs gzipped text,
+        the reference's GzipUtils.isCompressed path)"""
+        with open(path, "rb") as f:
+            magic = f.read(2)
+        if magic == b"\x1f\x8b":
+            return WordVectorSerializer._read_text(path, gzipped=True)
         if binary is None:
             with open(path, "rb") as f:
                 header = f.readline()
@@ -59,8 +73,10 @@ class WordVectorSerializer:
     loadTxtVectors = read_word_vectors
 
     @staticmethod
-    def _read_text(path: str) -> WordVectors:
-        with open(path, "r", encoding="utf-8") as f:
+    def _read_text(path: str, gzipped: bool = False) -> WordVectors:
+        opener = (lambda: gzip.open(path, "rt", encoding="utf-8")) if gzipped \
+            else (lambda: open(path, "r", encoding="utf-8"))
+        with opener() as f:
             first = f.readline().rstrip("\n")
             head = first.split()
             rows: list = []
@@ -114,3 +130,135 @@ class WordVectorSerializer:
                                     use_neg=False)
         table.syn0 = jnp.asarray(syn0)
         return WordVectors(vocab, table)
+
+    # ---------------------------------------------------- full-model zips
+    @staticmethod
+    def _table_npz(table: InMemoryLookupTable) -> bytes:
+        arrays = {"syn0": np.asarray(table.syn0, np.float32)}
+        if table.syn1 is not None:
+            arrays["syn1"] = np.asarray(table.syn1, np.float32)
+        if table.syn1neg is not None:
+            arrays["syn1neg"] = np.asarray(table.syn1neg, np.float32)
+        buf = io.BytesIO()
+        np.savez(buf, **arrays)
+        return buf.getvalue()
+
+    @staticmethod
+    def _vocab_json(vocab: VocabCache) -> str:
+        return json.dumps([[w.word, int(w.count)] for w in vocab.vocab_words()])
+
+    @staticmethod
+    def _restore_vocab(payload: str) -> VocabCache:
+        vocab = VocabCache()
+        for word, count in json.loads(payload):
+            vocab.add_token(VocabWord(word, count))
+        vocab.finish(min_word_frequency=0)
+        return vocab
+
+    @staticmethod
+    def write_word2vec_model(model, path: str):
+        """Full-model save: vocab WITH counts + all weight tables + training
+        config, so training can continue after restore
+        (ref writeWord2VecModel :497-560)."""
+        table = model.lookup_table
+        config = {
+            "layer_size": table.layer_size,
+            "window": getattr(model, "window", 5),
+            "negative": getattr(model, "negative", 5),
+            "use_hierarchic_softmax": table.syn1 is not None,
+            "learning_rate": getattr(model, "learning_rate", 0.025),
+            "min_word_frequency": getattr(model, "min_word_frequency", 1),
+            "seed": getattr(model, "seed", 12345),
+        }
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+            z.writestr("config.json", json.dumps(config))
+            z.writestr("vocab.json", WordVectorSerializer._vocab_json(model.vocab))
+            z.writestr("tables.npz", WordVectorSerializer._table_npz(table))
+    writeWord2VecModel = write_word2vec_model
+
+    @staticmethod
+    def _restore_table(z: zipfile.ZipFile, vocab: VocabCache,
+                       layer_size: int) -> InMemoryLookupTable:
+        data = np.load(io.BytesIO(z.read("tables.npz")))
+        table = InMemoryLookupTable(vocab, layer_size,
+                                    use_hs="syn1" in data,
+                                    use_neg="syn1neg" in data)
+        table.syn0 = jnp.asarray(data["syn0"])
+        if "syn1" in data:
+            table.syn1 = jnp.asarray(data["syn1"])
+        if "syn1neg" in data:
+            table.syn1neg = jnp.asarray(data["syn1neg"])
+        return table
+
+    @staticmethod
+    def read_word2vec(path: str):
+        """(ref readWord2Vec :868) — returns a trainable Word2Vec."""
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        with zipfile.ZipFile(path, "r") as z:
+            config = json.loads(z.read("config.json"))
+            vocab = WordVectorSerializer._restore_vocab(
+                z.read("vocab.json").decode("utf-8"))
+            table = WordVectorSerializer._restore_table(
+                z, vocab, config["layer_size"])
+        w2v = Word2Vec(
+            layer_size=config["layer_size"], window=config["window"],
+            negative=config["negative"],
+            use_hierarchic_softmax=config["use_hierarchic_softmax"],
+            learning_rate=config["learning_rate"],
+            min_word_frequency=config["min_word_frequency"],
+            seed=config["seed"])
+        w2v.vocab = vocab
+        w2v.lookup_table = table
+        return w2v
+    readWord2Vec = read_word2vec
+
+    @staticmethod
+    def write_paragraph_vectors(vectors, path: str):
+        """(ref writeParagraphVectors :473) — word tables + label vectors +
+        label index."""
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as z:
+            config = {
+                "layer_size": vectors.layer_size,
+                "window": vectors.window,
+                "negative": vectors.negative,
+                "learning_rate": vectors.learning_rate,
+                "seed": vectors.seed,
+                "sequence_learning_algorithm":
+                    vectors.sequence_learning_algorithm,
+                "train_words": vectors.train_words,
+            }
+            z.writestr("config.json", json.dumps(config))
+            z.writestr("vocab.json",
+                       WordVectorSerializer._vocab_json(vectors.vocab))
+            z.writestr("tables.npz",
+                       WordVectorSerializer._table_npz(vectors.lookup_table))
+            z.writestr("labels.json", json.dumps(vectors.label_index))
+            buf = io.BytesIO()
+            np.savez(buf, doc_vecs=np.asarray(vectors.doc_vecs, np.float32))
+            z.writestr("docvecs.npz", buf.getvalue())
+    writeParagraphVectors = write_paragraph_vectors
+
+    @staticmethod
+    def read_paragraph_vectors(path: str):
+        """(ref readParagraphVectors :814)"""
+        from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+        with zipfile.ZipFile(path, "r") as z:
+            config = json.loads(z.read("config.json"))
+            vocab = WordVectorSerializer._restore_vocab(
+                z.read("vocab.json").decode("utf-8"))
+            table = WordVectorSerializer._restore_table(
+                z, vocab, config["layer_size"])
+            labels = json.loads(z.read("labels.json"))
+            doc_vecs = np.load(io.BytesIO(z.read("docvecs.npz")))["doc_vecs"]
+        pv = ParagraphVectors(
+            layer_size=config["layer_size"], window=config["window"],
+            negative=config["negative"],
+            learning_rate=config["learning_rate"], seed=config["seed"],
+            train_words=config["train_words"],
+            sequence_learning_algorithm=config["sequence_learning_algorithm"])
+        pv.vocab = vocab
+        pv.lookup_table = table
+        pv.label_index = dict(labels)
+        pv.doc_vecs = jnp.asarray(doc_vecs)
+        return pv
+    readParagraphVectors = read_paragraph_vectors

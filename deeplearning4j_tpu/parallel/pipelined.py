@@ -47,8 +47,13 @@ _ELEMENTWISE_GN = (GradientNormalization.NoNormalization,
 
 
 def _layer_signature(layer, params):
+    """Type + param shapes + full conf (minus the name): stages must repeat the
+    same block EXACTLY — two Dense(16) layers with different activations would
+    otherwise silently train with stage 0's conf for every stage."""
+    conf = {k: v for k, v in layer.to_dict().items() if k != "name"}
     return (type(layer).__name__,
-            tuple(sorted((k, tuple(v.shape)) for k, v in params.items())))
+            tuple(sorted((k, tuple(v.shape)) for k, v in params.items())),
+            tuple(sorted((k, repr(v)) for k, v in conf.items())))
 
 
 class PipelinedTrainer:

@@ -16,5 +16,11 @@ def lowered_flops(jitted, *args, **kwargs) -> Optional[float]:
             ca = ca[0] if ca else {}
         flops = float(ca.get("flops", 0.0))
         return flops if flops > 0 else None
-    except Exception:
+    except Exception as e:
+        # None disables the caller's peak-FLOPS sanity gate — never let that
+        # happen silently (the gate exists to catch measurement artifacts)
+        import warnings
+        warnings.warn(f"XLA cost analysis unavailable ({type(e).__name__}: "
+                      f"{e}); MFU reporting and peak-sanity gating disabled "
+                      f"for this entry")
         return None

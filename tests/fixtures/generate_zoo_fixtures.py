@@ -1,0 +1,54 @@
+"""Regenerate the zoo forward-value fixtures (tests/test_zoo_fixtures.py).
+
+Run after any intentional change to a zoo architecture, on the CPU backend the
+test suite uses (forward values are pinned there):
+
+    python tests/fixtures/generate_zoo_fixtures.py [model ...]
+
+Each fixture pins the committed input, the exact forward values, and the
+parameter count, so unintentional drift in layer math / init order / graph
+wiring fails loudly (ref SURVEY §4.3 regression-test strategy).
+"""
+import os
+import sys
+
+os.environ["XLA_FLAGS"] = os.environ.get("XLA_FLAGS", "") + \
+    " --xla_force_host_platform_device_count=8"
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+FIXDIR = os.path.dirname(os.path.abspath(__file__))
+
+# name -> (class, ctor kwargs, input shape, train-mode forward?)
+SPECS = {
+    "lenet": ("LeNet", {}, (1, 1, 28, 28), False),
+    "alexnet": ("AlexNet", {}, (1, 3, 224, 224), False),
+    "vgg16": ("VGG16", {}, (1, 3, 224, 224), False),
+    "vgg19": ("VGG19", {}, (1, 3, 224, 224), False),
+    "resnet50": ("ResNet50", {}, (1, 3, 224, 224), True),
+    "simplecnn": ("SimpleCNN", {}, (1, 3, 48, 48), False),
+    "googlenet": ("GoogLeNet", {}, (1, 3, 224, 224), False),
+    "inception_resnet_v1": ("InceptionResNetV1", {}, (1, 3, 160, 160), False),
+    "facenet_nn4_small2": ("FaceNetNN4Small2", {}, (1, 3, 96, 96), False),
+}
+
+
+def main(names):
+    import deeplearning4j_tpu.models as models
+    for name in names:
+        cls_name, kw, shape, train_mode = SPECS[name]
+        rng = np.random.RandomState(7)
+        x = rng.rand(*shape).astype(np.float32)
+        net = getattr(models, cls_name)(num_labels=10, seed=42, **kw).init()
+        out = np.asarray(net.output(x, train=train_mode))
+        path = os.path.join(FIXDIR, f"zoo_forward_{name}.npz")
+        np.savez(path, x=x, out=out, num_params=net.num_params(),
+                 train_mode=train_mode)
+        print(f"{name}: params={net.num_params()} out_shape={out.shape} -> {path}")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:] or sorted(SPECS))

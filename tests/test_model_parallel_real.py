@@ -209,6 +209,21 @@ class TestPipelinedTrainer:
         got = [float(pt.fit_on_device(x, y, steps=1)[0]) for _ in range(4)]
         np.testing.assert_allclose(got, ref, rtol=1e-10)
 
+    def test_pp_rejects_stages_differing_only_in_conf(self):
+        # same shapes, different activation — must be rejected, not silently
+        # trained with stage 0's conf
+        conf = (NeuralNetConfiguration.Builder().seed(3).dtype("float64")
+                .updater(Adam(learning_rate=1e-2)).list()
+                .layer(DenseLayer(n_in=6, n_out=16, activation=Activation.TANH))
+                .layer(DenseLayer(n_out=16, activation=Activation.TANH))
+                .layer(DenseLayer(n_out=16, activation=Activation.RELU))
+                .layer(OutputLayer(n_out=3, loss_fn=LossFunction.MCXENT))
+                .set_input_type(InputType.feed_forward(6)).build())
+        net = MultiLayerNetwork(conf).init()
+        with pytest.raises(ValueError, match="identical"):
+            (PipelinedTrainer.Builder(net).mesh(make_mesh(2, axes=("pipe",)))
+             .stage_range(1, 3).microbatches(2).build())
+
     def test_pp_rejects_heterogeneous_stages(self):
         net = dense_net()  # 32-wide layers but layer0 n_in=12 differs
         with pytest.raises(ValueError):

@@ -1,0 +1,164 @@
+"""Round-3 NLP additions: PV-DM, node2vec, full-model serde, gzip vectors
+(VERDICT r2 next#6 / missing#4-5)."""
+import gzip
+import os
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+
+
+DOCS = [
+    ("doc_fruit_1", "apple banana cherry apple banana fruit sweet"),
+    ("doc_fruit_2", "banana apple mango fruit juice sweet tasty"),
+    ("doc_metal_1", "iron steel copper metal forge weld hard"),
+    ("doc_metal_2", "steel iron alloy metal rust weld strong"),
+] * 3
+
+
+def fit_pv(algo):
+    # syn1neg bootstraps from zero (word2vec.c convention), and PV-DM's input
+    # is an average — tiny corpora need a hot lr + many epochs to separate
+    pv = ParagraphVectors(layer_size=24, negative=4, epochs=150, seed=7,
+                          learning_rate=0.25, window=3,
+                          sequence_learning_algorithm=algo)
+    pv.fit_documents(DOCS)
+    return pv
+
+
+class TestPVDM:
+    def test_dm_trains_and_groups_topics(self):
+        pv = fit_pv("PV-DM")
+        f1 = pv.get_label_vector("doc_fruit_1")
+        f2 = pv.get_label_vector("doc_fruit_2")
+        m1 = pv.get_label_vector("doc_metal_1")
+
+        def cos(a, b):
+            return float(a @ b / (np.linalg.norm(a) * np.linalg.norm(b)))
+
+        assert cos(f1, f2) > cos(f1, m1)
+
+    def test_dm_infer_vector_prefers_matching_topic(self):
+        pv = fit_pv("PV-DM")
+        labs = pv.nearest_labels("apple banana sweet fruit", top_n=2)
+        assert all(l.startswith("doc_fruit") for l in labs)
+
+    def test_dm_updates_word_vectors(self):
+        pv = fit_pv("PV-DM")
+        # DM trains syn0 context vectors (DM.java trainElementsVectors path)
+        w = pv.get_word_vector("apple") if hasattr(pv, "get_word_vector") else \
+            np.asarray(pv.lookup_table.syn0[pv.vocab.index_of("apple")])
+        assert np.abs(w).sum() > 0
+
+    def test_unknown_algorithm_rejected(self):
+        try:
+            ParagraphVectors(sequence_learning_algorithm="PV-NOPE")
+            assert False, "expected ValueError"
+        except ValueError:
+            pass
+
+    def test_builder_selects_dm(self):
+        pv = (ParagraphVectors.Builder().sequenceLearningAlgorithm("PV-DM")
+              .build())
+        assert pv.sequence_learning_algorithm == "PV-DM"
+
+
+class TestNode2Vec:
+    def barbell_graph(self):
+        from deeplearning4j_tpu.graphs import Graph
+        # two 5-cliques joined by one bridge edge
+        g = Graph(10)
+        for base in (0, 5):
+            for i in range(base, base + 5):
+                for j in range(i + 1, base + 5):
+                    g.add_edge(i, j, directed=False)
+        g.add_edge(4, 5, directed=False)
+        return g
+
+    def test_walks_biased_by_p_q(self):
+        from deeplearning4j_tpu.graphs import Node2VecWalkIterator
+        g = self.barbell_graph()
+        it = Node2VecWalkIterator(g, walk_length=10, p=0.25, q=4.0, seed=3)
+        walks = []
+        while it.has_next():
+            walks.append(it.next_walk())
+        assert len(walks) == 10 and all(len(w) == 11 for w in walks)
+
+    def test_node2vec_embeds_cliques_together(self):
+        from deeplearning4j_tpu.graphs import Node2Vec
+        g = self.barbell_graph()
+        nv = Node2Vec(p=1.0, q=0.5, vector_size=16, window_size=4, epochs=15,
+                      learning_rate=0.3, batch_size=256, seed=7).initialize(g)
+        nv.fit(walk_length=20)
+        within, across = [], []
+        for a in (0, 1, 2, 3):          # skip the bridge vertices 4 and 5
+            for b in (0, 1, 2, 3):
+                if a != b:
+                    within.append(nv.similarity(a, b))
+            for b in (6, 7, 8, 9):
+                across.append(nv.similarity(a, b))
+        assert np.mean(within) - np.mean(across) > 0.3
+
+    def test_builder(self):
+        from deeplearning4j_tpu.graphs import Node2Vec
+        nv = (Node2Vec.Builder().p(0.5).q(2.0).vectorSize(8).build())
+        assert nv.p == 0.5 and nv.q == 2.0 and nv.vector_size == 8
+
+
+class TestFullModelSerde:
+    def test_word2vec_model_roundtrip_continues_training(self):
+        from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+        corpus = [d[1].split() for d in DOCS]
+        sv = SequenceVectors(layer_size=16, negative=3, epochs=3, seed=5,
+                             min_word_frequency=1)
+        sv.fit(lambda: iter(corpus))
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "w2v.zip")
+            WordVectorSerializer.write_word2vec_model(sv, path)
+            w2v = WordVectorSerializer.read_word2vec(path)
+        assert w2v.vocab.num_words() == sv.vocab.num_words()
+        np.testing.assert_allclose(np.asarray(w2v.lookup_table.syn0),
+                                   np.asarray(sv.lookup_table.syn0), atol=1e-7)
+        # counts survive (full-model contract) and training continues
+        assert w2v.vocab.word_for("apple").count == \
+            sv.vocab.word_for("apple").count
+        w2v.fit(lambda: iter(corpus))
+
+    def test_paragraph_vectors_roundtrip(self):
+        pv = fit_pv("PV-DM")
+        with tempfile.TemporaryDirectory() as td:
+            path = os.path.join(td, "pv.zip")
+            WordVectorSerializer.write_paragraph_vectors(pv, path)
+            pv2 = WordVectorSerializer.read_paragraph_vectors(path)
+        assert pv2.sequence_learning_algorithm == "PV-DM"
+        np.testing.assert_allclose(pv2.get_label_vector("doc_fruit_1"),
+                                   pv.get_label_vector("doc_fruit_1"),
+                                   atol=1e-7)
+        # pin the negative-sampling stream: the live model's rng advanced
+        # during training, the restored one is fresh
+        pv._rng = np.random.RandomState(0)
+        pv2._rng = np.random.RandomState(0)
+        v1 = pv.infer_vector("apple banana")
+        v2 = pv2.infer_vector("apple banana")
+        np.testing.assert_allclose(v1, v2, atol=1e-6)
+
+
+def test_gzipped_text_vectors_read():
+    from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+    corpus = [d[1].split() for d in DOCS]
+    sv = SequenceVectors(layer_size=8, negative=3, epochs=2, seed=5,
+                         min_word_frequency=1)
+    sv.fit(lambda: iter(corpus))
+    from deeplearning4j_tpu.nlp.word_vectors import WordVectors
+    wv = WordVectors(sv.vocab, sv.lookup_table)
+    with tempfile.TemporaryDirectory() as td:
+        txt = os.path.join(td, "vecs.txt")
+        WordVectorSerializer.write_word_vectors(wv, txt, binary=False)
+        gz = os.path.join(td, "vecs.txt.gz")
+        with open(txt, "rb") as fin, gzip.open(gz, "wb") as fout:
+            fout.write(fin.read())
+        loaded = WordVectorSerializer.read_word_vectors(gz)
+    np.testing.assert_allclose(loaded.get_word_vector("apple"),
+                               wv.get_word_vector("apple"), atol=1e-5)

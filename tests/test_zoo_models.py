@@ -29,7 +29,8 @@ def test_vgg16_vgg19_conf():
     v16 = VGG16(num_labels=1000).init()
     v19 = VGG19(num_labels=1000).init()
     assert v19.num_params() > v16.num_params() > 30e6
-    assert len(v19.layers) == len(v16.layers) + 3
+    # +3 convs (2-2-4-4-4 vs 2-2-3-3-3) +1 Dense(4096) head (VGG19.java:143)
+    assert len(v19.layers) == len(v16.layers) + 4
 
 
 def test_alexnet_dense_nin_matches_reference():

@@ -1,0 +1,191 @@
+"""Zoo architecture parity vs the REFERENCE builders (VERDICT r2 next#3).
+
+Unlike test_zoo_fixtures.py (self-generated regression values), every expected
+number here is derived independently from the reference Java sources under
+/root/reference/deeplearning4j-zoo/src/main/java/org/deeplearning4j/zoo/model/
+(file:line cited per case) or from the canonical published architecture. The
+full audit narrative lives in ZOO_PARITY.md.
+
+Note on param counts: DL4J stores BatchNormalization's running mean/var inside
+the params vector (BatchNormalizationParamInitializer GLOBAL_MEAN/GLOBAL_VAR),
+while this framework keeps them in state_tree — so our num_params() equals
+DL4J's numParams() minus 2x(BN channels). Expectations below count TRAINABLE
+params and separately assert the BN-stat delta where relevant.
+"""
+import numpy as np
+import pytest
+
+import deeplearning4j_tpu.models as M
+
+
+def shapes(net):
+    return [{k: tuple(v.shape) for k, v in p.items()} for p in net.params_tree]
+
+
+class TestLeNet:
+    # ref LeNet.java:86-100: conv5x5/1(20) -> max2x2/2 -> conv5x5/1(50)
+    # -> max2x2/2 -> dense(500) -> softmax(numLabels); ConvolutionMode.Same
+    def test_per_layer_shapes(self):
+        net = M.LeNet(num_labels=10, seed=1).init()
+        exp = [
+            {"W": (20, 1, 5, 5), "b": (20,)},
+            {},                                  # maxpool1
+            {"W": (50, 20, 5, 5), "b": (50,)},
+            {},                                  # maxpool2
+            {"W": (2450, 500), "b": (500,)},     # 50*7*7 (Same: 28->14->7)
+            {"W": (500, 10), "b": (10,)},
+        ]
+        assert shapes(net) == exp
+        assert net.num_params() == 20 * 25 + 20 + 50 * 20 * 25 + 50 + \
+            2450 * 500 + 500 + 500 * 10 + 10
+
+
+class TestAlexNet:
+    # ref AlexNet.java:96-131 (NO LRN layers; ffn1 nIn hard-coded 256 :122)
+    def test_per_layer_shapes(self):
+        net = M.AlexNet(num_labels=10, seed=1).init()
+        s = shapes(net)
+        assert s[0] == {"W": (64, 3, 11, 11), "b": (64,)}        # cnn1
+        assert s[2] == {"W": (192, 64, 5, 5), "b": (192,)}       # cnn2
+        assert s[4] == {"W": (384, 192, 3, 3), "b": (384,)}      # cnn3
+        assert s[5] == {"W": (256, 384, 3, 3), "b": (256,)}      # cnn4
+        assert s[6] == {"W": (256, 256, 3, 3), "b": (256,)}      # cnn5
+        assert s[8] == {"W": (256, 4096), "b": (4096,)}          # ffn1 nIn=256
+        assert s[9] == {"W": (4096, 4096), "b": (4096,)}
+        assert s[10] == {"W": (4096, 10), "b": (10,)}
+        assert len(net.layers) == 11  # exactly the reference's 11 layers
+
+    def test_total_params(self):
+        net = M.AlexNet(num_labels=10, seed=1).init()
+        conv = (64 * 3 * 121 + 64) + (192 * 64 * 25 + 192) + \
+               (384 * 192 * 9 + 384) + (256 * 384 * 9 + 256) + (256 * 256 * 9 + 256)
+        dense = (256 * 4096 + 4096) + (4096 * 4096 + 4096) + (4096 * 10 + 10)
+        assert net.num_params() == conv + dense
+
+
+class TestVGG:
+    # ref VGG16.java:99-155: 3x3/1 p1 conv stacks 2-2-3-3-3, 2x2/2 max pools,
+    # FC-4096 pair commented out (:147-151) -> output straight from pool5
+    def test_vgg16_structure(self):
+        net = M.VGG16(num_labels=10, seed=1).init()
+        convs = [p for p in net.params_tree if p and len(p["W"].shape) == 4]
+        assert [c["W"].shape[0] for c in convs] == \
+            [64, 64, 128, 128, 256, 256, 256, 512, 512, 512, 512, 512, 512]
+        # output dense from 7x7x512 map
+        assert net.params_tree[-1]["W"].shape == (25088, 10)
+
+    # ref VGG19.java:99-147: stacks 2-2-4-4-4 and ONE Dense(4096) head (:143)
+    def test_vgg19_structure(self):
+        net = M.VGG19(num_labels=10, seed=1).init()
+        convs = [p for p in net.params_tree if p and len(p["W"].shape) == 4]
+        assert len(convs) == 16
+        assert net.params_tree[-2]["W"].shape == (25088, 4096)
+        assert net.params_tree[-1]["W"].shape == (4096, 10)
+
+
+class TestResNet50:
+    # ref ResNet50.java:175-224. The head pool Builder(MAX, {3,3}) keeps the
+    # DL4J default stride {2,2} (SubsamplingLayer.java:295): 4x4 map -> 1x1,
+    # so the output layer sees 2048 features. Trainable-param total must then
+    # equal canonical Keras ResNet50 minus BN running stats:
+    # 25,636,712 - 53,120 = 25,583,592 at 1000 classes (fchollet keras 1.1.2,
+    # the stated origin of the reference's weights, ResNet50.java:28).
+    def test_total_params_canonical(self):
+        net = M.ResNet50(num_labels=1000, seed=1).init()
+        assert net.num_params() == 25_583_592
+
+    def test_bn_stats_delta_vs_dl4j_count(self):
+        net = M.ResNet50(num_labels=1000, seed=1).init()
+        bn_channels = sum(
+            st["mean"].shape[0] for st in net.state_tree if "mean" in st)
+        assert bn_channels == 26_560  # 53 BN layers, canonical channel table
+        assert net.num_params() + 2 * bn_channels == 25_636_712
+
+    def test_conv_block_strides(self):
+        # stage-2 conv block uses stride {2,2} (ResNet50.java:196 explicit) —
+        # a reference deviation from canonical ResNet50 (stride 1 after the
+        # stem maxpool), mirrored here
+        net = M.ResNet50(num_labels=10, seed=1).init()
+        names = net.conf.layer_names if hasattr(net.conf, "layer_names") else None
+        layer = {l.name: l for l in net.layers}.get("res2a_branch2a")
+        if layer is None:  # names stored on confs
+            layer = [l for l in net.layers
+                     if getattr(l, "name", "") == "res2a_branch2a"]
+            layer = layer[0] if layer else None
+        assert layer is not None and tuple(layer.stride) == (2, 2)
+
+
+class TestSimpleCNN:
+    # ref SimpleCNN.java:79-130: conv widths 16,16,32,32,64,64,128,128,256,numLabels
+    def test_conv_widths(self):
+        net = M.SimpleCNN(num_labels=10, seed=1).init()
+        convs = [p for p in net.params_tree if p and "W" in p
+                 and len(p["W"].shape) == 4]
+        assert [c["W"].shape[0] for c in convs] == \
+            [16, 16, 32, 32, 64, 64, 128, 128, 256, 10]
+
+
+class TestTextGenerationLSTM:
+    # ref TextGenerationLSTM.java:75-87: GravesLSTM(in,256)+GravesLSTM(256,256)
+    # + RnnOutputLayer(256,vocab); RmsProp + builder learningRate(0.01); NO
+    # gradient clipping in the reference conf
+    def test_shapes_and_conf(self):
+        net = M.TextGenerationLSTM(total_unique_characters=47, seed=1).init()
+        s = shapes(net)
+        assert s[0]["W"] == (47, 1024) and s[0]["RW"] == (256, 1024)
+        assert s[1]["W"] == (256, 1024) and s[1]["RW"] == (256, 1024)
+        assert s[2]["W"] == (256, 47)
+        from deeplearning4j_tpu.common.enums import GradientNormalization
+        assert all(l.gradient_normalization ==
+                   GradientNormalization.NoNormalization for l in net.layers)
+        upd = net.conf.get_updater()
+        assert abs(upd.learning_rate - 0.01) < 1e-12
+
+
+class TestGoogLeNet:
+    # ref GoogLeNet.java:155-169 inception channel table; deviations from the
+    # (broken-as-written) reference documented in models/googlenet.py
+    def test_inception_channel_table(self):
+        net = M.GoogLeNet(num_labels=10, seed=1).init()
+        by_name = {l.name: p for l, p in zip(net.layers, net.params_tree)
+                   if getattr(l, "name", None)}
+        assert by_name["3a-cnn1"]["W"].shape == (64, 192, 1, 1)
+        assert by_name["3a-cnn4"]["W"].shape == (128, 96, 3, 3)
+        assert by_name["3a-cnn5"]["W"].shape == (32, 16, 5, 5)
+        assert by_name["5b-cnn4"]["W"].shape == (384, 192, 3, 3)
+        assert by_name["fc1"]["W"].shape == (1024, 1024)
+
+    def test_inception_module_count(self):
+        net = M.GoogLeNet(num_labels=10, seed=1).init()
+        concats = [n for n in getattr(net, "vertex_names", [])
+                   if "depthconcat" in n] or \
+                  [l.name for l in net.layers
+                   if getattr(l, "name", "") and "cnn1" in l.name and
+                   l.name[0] in "345"]
+        assert len([l for l in net.layers
+                    if getattr(l, "name", "").endswith("-cnn1")]) == 9
+
+
+class TestFaceNetFamily:
+    # ref InceptionResNetV1.java:167/:220/:302 — 5xA(0.17), 10xB(0.10), 5xC(0.20),
+    # 128-d L2-normalized embedding (:76-84) into CenterLossOutputLayer
+    def test_inception_resnet_v1_structure(self):
+        net = M.InceptionResNetV1(num_labels=10, seed=1).init()
+        import re
+        names = [getattr(l, "name", "") or "" for l in net.layers]
+
+        def blocks(prefix):
+            return {m.group(1) for n in names
+                    for m in [re.match(prefix + r"-cnn1-(\d+)$", n)] if m}
+
+        assert (len(blocks("resnetA")), len(blocks("resnetB")),
+                len(blocks("resnetC"))) == (5, 10, 5)
+        bottleneck = [p for l, p in zip(net.layers, net.params_tree)
+                      if getattr(l, "name", "") == "bottleneck"][0]
+        assert bottleneck["W"].shape[1] == 128
+
+    def test_facenet_nn4_small2_embedding(self):
+        net = M.FaceNetNN4Small2(num_labels=10, seed=1).init()
+        bottleneck = [p for l, p in zip(net.layers, net.params_tree)
+                      if getattr(l, "name", "") == "bottleneck"]
+        assert bottleneck and bottleneck[0]["W"].shape[1] == 128
