@@ -57,23 +57,23 @@ class KDTree:
 
     def delete(self, point) -> bool:
         """Remove one node matching `point` exactly (ref delete :98 — rebuilds
-        the affected subtree)."""
+        the affected subtree). Iterative traversal: the tree is insertion-
+        ordered (unbalanced), so recursion would overflow on sorted inserts."""
         point = np.asarray(point, np.float64).reshape(-1)
         remaining: List[np.ndarray] = []
-        found = [False]
-
-        def collect(node):
-            if node is None:
-                return
-            if not found[0] and np.array_equal(node.point, point):
-                found[0] = True
+        found = False
+        stack = [self._root] if self._root is not None else []
+        while stack:
+            node = stack.pop()
+            if not found and np.array_equal(node.point, point):
+                found = True
             else:
                 remaining.append(node.point)
-            collect(node.left)
-            collect(node.right)
-
-        collect(self._root)
-        if not found[0]:
+            if node.left is not None:
+                stack.append(node.left)
+            if node.right is not None:
+                stack.append(node.right)
+        if not found:
             return False
         self._root = None
         self._size = 0
@@ -86,35 +86,35 @@ class KDTree:
 
     # ------------------------------------------------------------ queries
     def nn(self, point) -> Optional[Tuple[float, np.ndarray]]:
-        """(ref nn :165) — (euclidean distance, nearest point)."""
+        """(ref nn :165) — (euclidean distance, nearest point). Explicit-stack
+        traversal (insertion-ordered trees can be deep)."""
         point = np.asarray(point, np.float64).reshape(-1)
-        best = [np.inf, None]
-
-        def search(node, depth):
-            if node is None:
-                return
+        best_d, best_p = np.inf, None
+        stack = [(self._root, 0)] if self._root is not None else []
+        while stack:
+            node, depth = stack.pop()
             d = float(np.linalg.norm(node.point - point))
-            if d < best[0]:
-                best[0], best[1] = d, node.point
+            if d < best_d:
+                best_d, best_p = d, node.point
             axis = depth % self.dims
             delta = point[axis] - node.point[axis]
             near, far = (node.left, node.right) if delta < 0 else \
                 (node.right, node.left)
-            search(near, depth + 1)
-            if abs(delta) < best[0]:  # hypersphere crosses the splitting plane
-                search(far, depth + 1)
-
-        search(self._root, 0)
-        return None if best[1] is None else (best[0], best[1])
+            # push far first so the near side is explored first (tightening
+            # best_d before the plane-crossing test below re-runs on pop)
+            if far is not None and abs(delta) < best_d:
+                stack.append((far, depth + 1))
+            if near is not None:
+                stack.append((near, depth + 1))
+        return None if best_p is None else (best_d, best_p)
 
     def knn(self, point, distance: float) -> List[Tuple[float, np.ndarray]]:
         """All points within `distance`, closest first (ref knn :129)."""
         point = np.asarray(point, np.float64).reshape(-1)
         out: List[Tuple[float, np.ndarray]] = []
-
-        def search(node, depth):
-            if node is None:
-                return
+        stack = [(self._root, 0)] if self._root is not None else []
+        while stack:
+            node, depth = stack.pop()
             d = float(np.linalg.norm(node.point - point))
             if d <= distance:
                 out.append((d, node.point))
@@ -122,10 +122,9 @@ class KDTree:
             delta = point[axis] - node.point[axis]
             near, far = (node.left, node.right) if delta < 0 else \
                 (node.right, node.left)
-            search(near, depth + 1)
-            if abs(delta) <= distance:
-                search(far, depth + 1)
-
-        search(self._root, 0)
+            if far is not None and abs(delta) <= distance:
+                stack.append((far, depth + 1))
+            if near is not None:
+                stack.append((near, depth + 1))
         out.sort(key=lambda t: t[0])
         return out

@@ -113,13 +113,23 @@ class RecordReaderMultiDataSetIterator:
                     "data was encountered. Use ALIGN_START or ALIGN_END "
                     "(ref RecordReaderMultiDataSetIterator.java:496)")
 
-        # per-example placement offsets (shared by all readers so arrays align)
+        # per-example placement offsets. The random offset is drawn ONCE per
+        # example from the example's longest sequence across readers, and
+        # shared by every reader — independent draws would misalign a
+        # feature reader's timesteps against a label reader's.
+        shared_off = None
+        if self.ts_random_offset and seqs:
+            shared_off = []
+            for e in range(count):
+                t_max = max((lengths[n][e] for n in seqs), default=0)
+                shared_off.append(
+                    int(self._offset_rng.randint(0, max_t - t_max + 1)))
         offsets = {}
         for n, ls in lengths.items():
             offs = []
-            for t in ls:
-                if self.ts_random_offset:
-                    offs.append(int(self._offset_rng.randint(0, max_t - t + 1)))
+            for e, t in enumerate(ls):
+                if shared_off is not None:
+                    offs.append(shared_off[e])
                 elif self.alignment_mode == AlignmentMode.ALIGN_END:
                     offs.append(max_t - t)
                 else:

@@ -73,20 +73,36 @@ class _DistributedWrapper(ParallelWrapper):
         return jax.make_array_from_process_local_data(sharding, np.asarray(local_x))
 
     def _fit_one(self, ds):
+        from deeplearning4j_tpu.datasets.dataset import MultiDataSet
         net = self.model
-        x = np.asarray(ds.features, net.dtype)
-        y = np.asarray(ds.labels, net.dtype)
         n_local = len(self.mesh.local_devices)
-        if x.shape[0] % n_local != 0:
-            raise ValueError(f"Local batch {x.shape[0]} not divisible by "
-                             f"local device count {n_local}")
         bsh = NamedSharding(self.mesh, P("data"))
-        gx = self._global_batch(x, bsh)
-        gy = self._global_batch(y, bsh)
-        fm = None if ds.features_mask is None else self._global_batch(
-            np.asarray(ds.features_mask), bsh)
-        lm = None if ds.labels_mask is None else self._global_batch(
-            np.asarray(ds.labels_mask), bsh)
+        multi = isinstance(ds, MultiDataSet)
+        if multi:
+            # multi-input/-output graphs (ref SparkComputationGraph
+            # fit(MultiDataSet)): every stream shards over the global mesh
+            xs = [np.asarray(f, net.dtype) for f in ds.features]
+            ys = [np.asarray(l, net.dtype) for l in ds.labels]
+            n = xs[0].shape[0]
+        else:
+            xs = [np.asarray(ds.features, net.dtype)]
+            ys = [np.asarray(ds.labels, net.dtype)]
+            n = xs[0].shape[0]
+        if n % n_local != 0:
+            raise ValueError(f"Local batch {n} not divisible by "
+                             f"local device count {n_local}")
+        gx = [self._global_batch(x, bsh) for x in xs]
+        gy = [self._global_batch(y, bsh) for y in ys]
+        fmask = ds.features_masks if multi else ds.features_mask
+        lmask = ds.labels_masks if multi else ds.labels_mask
+        fm = None if fmask is None else jax.tree_util.tree_map(
+            lambda m: self._global_batch(np.asarray(m), bsh), fmask)
+        lm = None if lmask is None else jax.tree_util.tree_map(
+            lambda m: self._global_batch(np.asarray(m), bsh), lmask)
+        if multi:
+            gx, gy = tuple(gx), tuple(gy)
+        else:
+            gx, gy = gx[0], gy[0]
         net._rng, sub = jax.random.split(net._rng)
         self._carry, loss = self._step_fn(self._carry, sub, gx, gy, fm, lm)
         self._score = loss

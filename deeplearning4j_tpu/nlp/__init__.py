@@ -20,6 +20,9 @@ from deeplearning4j_tpu.nlp.distributed import DistributedWord2Vec
 from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
 from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+from deeplearning4j_tpu.nlp.cnn_sentence_iterator import (
+    CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider,
+    LabeledSentenceProvider, UnknownWordHandling)
 
 __all__ = [
     "DefaultTokenizerFactory", "NGramTokenizerFactory", "CommonPreprocessor",
@@ -29,4 +32,6 @@ __all__ = [
     "TfidfVectorizer", "VocabWord", "VocabCache", "VocabConstructor",
     "SequenceVectors", "Word2Vec", "DistributedWord2Vec", "ParagraphVectors", "Glove",
     "WordVectorSerializer",
+    "CnnSentenceDataSetIterator", "CollectionLabeledSentenceProvider",
+    "LabeledSentenceProvider", "UnknownWordHandling",
 ]

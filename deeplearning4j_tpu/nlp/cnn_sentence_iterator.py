@@ -1,0 +1,232 @@
+"""CnnSentenceDataSetIterator: labeled sentences -> CNN-ready word-vector maps.
+
+Parity: ref deeplearning4j-nlp/.../iterator/CnnSentenceDataSetIterator.java:48
+(517 LoC) — the NLP -> CNN training bridge: each sentence becomes a
+(1, maxLength, vectorSize) "image" of stacked word vectors (or its transpose
+with sentences_along_height=False), padded/truncated to the batch max with a
+feature mask, labels one-hot from the provider's label set. UnknownWordHandling
+RemoveWord|UseUnknownVector mirrors the reference enum (:49).
+LabeledSentenceProvider + the collection implementation mirror
+iterator/LabeledSentenceProvider.java and provider/CollectionLabeledSentenceProvider.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.datasets.dataset import DataSet
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory, TokenizerFactory)
+
+
+class UnknownWordHandling:
+    """(ref CnnSentenceDataSetIterator.UnknownWordHandling :49)"""
+    RemoveWord = "remove_word"
+    UseUnknownVector = "use_unknown_vector"
+
+
+class LabeledSentenceProvider:
+    """(ref iterator/LabeledSentenceProvider.java)"""
+
+    def has_next(self) -> bool:
+        raise NotImplementedError
+
+    def next_sentence(self) -> Tuple[str, str]:
+        """-> (sentence, label)"""
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def all_labels(self) -> List[str]:
+        raise NotImplementedError
+
+    def total_num_sentences(self) -> int:
+        raise NotImplementedError
+
+
+class CollectionLabeledSentenceProvider(LabeledSentenceProvider):
+    """(ref provider/CollectionLabeledSentenceProvider.java)"""
+
+    def __init__(self, sentences: Sequence[str], labels: Sequence[str],
+                 seed: Optional[int] = None):
+        if len(sentences) != len(labels):
+            raise ValueError(f"{len(sentences)} sentences vs {len(labels)} labels")
+        self._sentences = list(sentences)
+        self._labels = list(labels)
+        self._label_set = sorted(set(self._labels))
+        self._order = np.arange(len(sentences))
+        self._rng = None if seed is None else np.random.RandomState(seed)
+        self._pos = 0
+        if self._rng is not None:
+            self._rng.shuffle(self._order)
+
+    def has_next(self):
+        return self._pos < len(self._sentences)
+
+    def next_sentence(self):
+        i = self._order[self._pos]
+        self._pos += 1
+        return self._sentences[i], self._labels[i]
+
+    def reset(self):
+        self._pos = 0
+        if self._rng is not None:
+            self._rng.shuffle(self._order)
+
+    def all_labels(self):
+        return list(self._label_set)
+
+    def total_num_sentences(self):
+        return len(self._sentences)
+
+
+class CnnSentenceDataSetIterator:
+    """Build via CnnSentenceDataSetIterator.Builder (ref :395)."""
+
+    def __init__(self, sentence_provider: LabeledSentenceProvider,
+                 word_vectors, batch_size: int = 32,
+                 max_sentence_length: int = 256,
+                 sentences_along_height: bool = True,
+                 unknown_word_handling: str = UnknownWordHandling.RemoveWord,
+                 use_normalized_word_vectors: bool = False,
+                 tokenizer_factory: Optional[TokenizerFactory] = None):
+        self.provider = sentence_provider
+        self.word_vectors = word_vectors
+        self.batch_size = int(batch_size)
+        self.max_length = int(max_sentence_length)
+        self.along_height = bool(sentences_along_height)
+        self.unknown_handling = unknown_word_handling
+        self.normalize = bool(use_normalized_word_vectors)
+        self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
+        self.labels = self.provider.all_labels()
+        self._label_idx = {l: i for i, l in enumerate(self.labels)}
+        self.vector_size = int(
+            np.asarray(word_vectors.lookup_table.syn0).shape[1])
+        self._unknown = np.zeros((self.vector_size,), np.float32)
+        self.async_supported = True
+
+    # ------------------------------------------------------------- vectors
+    def _vector(self, word: str) -> Optional[np.ndarray]:
+        v = self.word_vectors.get_word_vector(word)
+        if v is None:
+            if self.unknown_handling == UnknownWordHandling.UseUnknownVector:
+                return self._unknown
+            return None  # RemoveWord
+        v = np.asarray(v, np.float32)
+        if self.normalize:
+            v = v / max(float(np.linalg.norm(v)), 1e-12)
+        return v
+
+    def _sentence_matrix(self, sentence: str) -> np.ndarray:
+        toks = self.tokenizer_factory.tokenize(sentence)
+        vecs = [v for t in toks[:self.max_length]
+                for v in [self._vector(t)] if v is not None]
+        if not vecs:
+            vecs = [self._unknown]
+        return np.stack(vecs[:self.max_length])  # (len, D)
+
+    def load_single_sentence(self, sentence: str) -> np.ndarray:
+        """(ref loadSingleSentence :110) — (1, 1, len, D) feature map."""
+        m = self._sentence_matrix(sentence)
+        out = m[None, None, :, :]
+        return out if self.along_height else out.transpose(0, 1, 3, 2)
+    loadSingleSentence = load_single_sentence
+
+    # ------------------------------------------------------------ iteration
+    def reset(self):
+        self.provider.reset()
+
+    def has_next(self) -> bool:
+        return self.provider.has_next()
+
+    def __iter__(self):
+        self.reset()
+        while self.has_next():
+            yield self.next()
+
+    def next(self, num: Optional[int] = None) -> DataSet:
+        num = num or self.batch_size
+        mats, ys = [], []
+        while len(mats) < num and self.provider.has_next():
+            sentence, label = self.provider.next_sentence()
+            mats.append(self._sentence_matrix(sentence))
+            ys.append(self._label_idx[label])
+        if not mats:
+            raise StopIteration
+        b = len(mats)
+        T = max(m.shape[0] for m in mats)
+        x = np.zeros((b, 1, T, self.vector_size), np.float32)
+        # mask over the sentence-length axis (ref :300-320 feature mask)
+        fmask = np.zeros((b, T), np.float32)
+        for i, m in enumerate(mats):
+            x[i, 0, :m.shape[0]] = m
+            fmask[i, :m.shape[0]] = 1.0
+        if not self.along_height:
+            x = x.transpose(0, 1, 3, 2)
+        y = np.eye(len(self.labels), dtype=np.float32)[np.asarray(ys)]
+        return DataSet(x, y, features_mask=fmask)
+
+    def batch(self):
+        return self.batch_size
+
+    def total_outcomes(self):
+        return len(self.labels)
+
+    def get_labels(self):
+        return list(self.labels)
+    getLabels = get_labels
+
+    # ---------------------------------------------------------------- builder
+    class Builder:
+        """(ref CnnSentenceDataSetIterator.Builder :395-510)"""
+
+        def __init__(self):
+            self._kw = {}
+
+        def sentence_provider(self, p: LabeledSentenceProvider):
+            self._kw["sentence_provider"] = p
+            return self
+        sentenceProvider = sentence_provider
+
+        def word_vectors(self, wv):
+            self._kw["word_vectors"] = wv
+            return self
+        wordVectors = word_vectors
+
+        def minibatch_size(self, n: int):
+            self._kw["batch_size"] = int(n)
+            return self
+        minibatchSize = minibatch_size
+
+        def max_sentence_length(self, n: int):
+            self._kw["max_sentence_length"] = int(n)
+            return self
+        maxSentenceLength = max_sentence_length
+
+        def sentences_along_height(self, b: bool):
+            self._kw["sentences_along_height"] = bool(b)
+            return self
+        sentencesAlongHeight = sentences_along_height
+
+        def unknown_word_handling(self, h: str):
+            self._kw["unknown_word_handling"] = h
+            return self
+        unknownWordHandling = unknown_word_handling
+
+        def use_normalized_word_vectors(self, b: bool):
+            self._kw["use_normalized_word_vectors"] = bool(b)
+            return self
+        useNormalizedWordVectors = use_normalized_word_vectors
+
+        def tokenizer_factory(self, tf: TokenizerFactory):
+            self._kw["tokenizer_factory"] = tf
+            return self
+        tokenizerFactory = tokenizer_factory
+
+        def build(self) -> "CnnSentenceDataSetIterator":
+            if "sentence_provider" not in self._kw or \
+                    "word_vectors" not in self._kw:
+                raise ValueError("sentence_provider and word_vectors required")
+            return CnnSentenceDataSetIterator(**self._kw)

@@ -116,8 +116,11 @@ class EvaluativeListener(TrainingListener):
 class CheckpointListener(TrainingListener):
     """Periodic model checkpointing with keep-last-N retention
     (ref optimize/listeners/CheckpointListener.java: saveEveryNIterations /
-    keepLast). Together with `restore_latest` this is the crash-restart loop of
-    SURVEY §5 failure recovery."""
+    keepLast). `restore_latest` resumes params, updater state, and the step
+    counter. Scope note (matches the reference, SURVEY §5: DL4J checkpoints no
+    iterator state either): the RNG stream and the data-iterator position are
+    NOT part of the checkpoint, so a restarted run replays a different batch
+    order — this is restart-from-checkpoint, not exact mid-epoch resume."""
 
     def __init__(self, directory: str, save_every_n_iterations: int = 100,
                  keep_last: int = 3, save_updater: bool = True):

@@ -101,8 +101,10 @@ class ParallelWrapper:
         params_repl = self._replicate(net.params_tree)
         opt_repl = self._replicate(net._opt_state)
         states_repl = self._replicate(net.state_tree)
-        residual = self._replicate(
-            jnp.zeros((net.num_params(),), net.dtype)) \
+        # residuals carry per-leaf (not as one flat vector): the flat view
+        # would cost a full concatenate + re-slice of every parameter per step
+        residual = self._replicate(jax.tree_util.tree_map(
+            jnp.zeros_like, net.params_tree)) \
             if self.training_mode == TrainingMode.SHARED_GRADIENTS else None
         # step lives on device (replicated) so the carry round-trips through the
         # jitted step without host syncs; a host mirror (_host_step) serves listeners
@@ -120,7 +122,6 @@ class ParallelWrapper:
         af = self.averaging_frequency
         thr = self.gradients_threshold
         mesh = self.mesh
-        from deeplearning4j_tpu.util.flat_params import flatten_params, unflatten_params
 
         if mode == TrainingMode.CUSTOM:
             self._build_custom_step()
@@ -138,7 +139,7 @@ class ParallelWrapper:
             params, opt, states = jax.tree_util.tree_map(
                 lambda a: a[0], (params, opt, states))
             if residual is not None:
-                residual = residual[0]
+                residual = jax.tree_util.tree_map(lambda a: a[0], residual)
             # bx/by arrive already split along axis 0 by the P("data") spec
             rng = jax.random.fold_in(rng, lax.axis_index("data"))
 
@@ -155,14 +156,20 @@ class ParallelWrapper:
                 # updater to its raw gradients, the resulting *update* is threshold-
                 # encoded; every replica then subtracts the SUM of all replicas'
                 # sparse messages (EncodedGradientsAccumulator sums, not averages).
+                # Encoding runs per-leaf: flattening to the reference's single
+                # vector would add a full concatenate + re-slice of every
+                # parameter per step (~2 extra HBM passes on a 25M-param net).
                 upds, new_opt = _compute_updates(layers, updaters, grads, opt,
                                                  params, step)
-                flat_upd = flatten_params(upds)
-                msg, residual = threshold_encode(flat_upd, residual, thr)
-                agg = unflatten_params(upds, lax.psum(msg, "data"))
-                new_params = [jax.tree_util.tree_map(lambda p, d: p - d,
-                                                     params[i], agg[i])
-                              for i in range(len(layers))]
+                # one source of truth for the encoding math (XLA CSE merges
+                # the two tree_map passes inside the jitted step)
+                msg = jax.tree_util.tree_map(
+                    lambda u, r: threshold_encode(u, r, thr)[0], upds, residual)
+                residual = jax.tree_util.tree_map(
+                    lambda u, r: threshold_encode(u, r, thr)[1], upds, residual)
+                agg = lax.psum(msg, "data")
+                new_params = jax.tree_util.tree_map(lambda p, d: p - d,
+                                                    params, agg)
                 new_states = _pmean_floats(new_states)
             else:  # AVERAGING
                 new_params, new_opt = _apply_updates(layers, updaters, grads, opt,
@@ -188,7 +195,8 @@ class ParallelWrapper:
             mean_loss = lax.psum(loss, "data") / lax.psum(1, "data")
             out = (jax.tree_util.tree_map(lambda a: a[None], (new_params, new_opt,
                                                               new_states)),
-                   None if residual is None else residual[None], mean_loss)
+                   None if residual is None else jax.tree_util.tree_map(
+                       lambda a: a[None], residual), mean_loss)
             return out
 
         repl_spec = P("data")
@@ -399,12 +407,49 @@ class ParallelWrapper:
         self._write_back()
         return losses
 
+    def _average_partial_window(self):
+        """AVERAGING mode, fit() epilogue: when averaging_frequency does not
+        divide the step count, the replicas hold un-averaged tail steps — DL4J
+        averages that final partial window before writing back
+        (ParallelWrapper.java:306-365 runs once more after the fit loop);
+        without this, replica-0's un-averaged state would silently win."""
+        if self.training_mode != TrainingMode.AVERAGING:
+            return
+        if self.averaging_frequency <= 1 or \
+                self._host_step % self.averaging_frequency == 0:
+            return
+        if getattr(self, "_final_avg_jit", None) is None:
+            mesh = self.mesh
+
+            def avg(trees):
+                params_repl, opt_repl, states_repl = trees
+
+                def mean_repl(tree):
+                    return jax.tree_util.tree_map(
+                        lambda a: jnp.broadcast_to(
+                            jnp.mean(a, axis=0, keepdims=True), a.shape)
+                        if jnp.issubdtype(a.dtype, jnp.floating) else a, tree)
+
+                return (mean_repl(params_repl), mean_repl(opt_repl),
+                        mean_repl(states_repl))
+
+            carry_sh = jax.tree_util.tree_map(lambda a: a.sharding,
+                                              self._carry[:3])
+            self._final_avg_jit = jax.jit(avg, donate_argnums=(0,),
+                                          out_shardings=carry_sh)
+        params_repl, opt_repl, states_repl, residual, step = self._carry
+        params_repl, opt_repl, states_repl = self._final_avg_jit(
+            (params_repl, opt_repl, states_repl))
+        self._carry = (params_repl, opt_repl, states_repl, residual, step)
+
     def _write_back(self):
-        """Copy replica-0 state back into the wrapped model (replicas are identical
-        after sync in both modes when averaging_frequency divides the step count).
+        """Copy replica-0 state back into the wrapped model (replicas are
+        identical after sync: per-window during fit, with the final partial
+        window averaged by _average_partial_window).
         ONE jitted extraction for all trees — per-leaf indexing would pay a tunnel
         round-trip per parameter on remote-TPU setups."""
         net = self.model
+        self._average_partial_window()
         params_repl, opt_repl, states_repl, _, step = self._carry
         if getattr(self, "_writeback_jit", None) is None:
             self._writeback_jit = jax.jit(

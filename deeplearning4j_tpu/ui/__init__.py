@@ -11,9 +11,18 @@ from deeplearning4j_tpu.ui.storage import (
     StatsStorageRouter)
 from deeplearning4j_tpu.ui.stats import ProfilerListener, StatsListener
 from deeplearning4j_tpu.ui.server import RemoteUIStatsStorageRouter, UIServer
+from deeplearning4j_tpu.ui.components import (
+    Component, ComponentChartHistogram, ComponentChartLine, ComponentDiv,
+    ComponentHtmlRenderer, ComponentTable, ComponentText)
+from deeplearning4j_tpu.ui.legacy_listeners import (
+    ConvolutionalIterationListener, FlowIterationListener,
+    HistogramIterationListener)
 
 __all__ = [
     "StatsStorage", "StatsStorageRouter", "StatsStorageEvent", "InMemoryStatsStorage",
     "FileStatsStorage", "StatsListener", "ProfilerListener", "UIServer",
-    "RemoteUIStatsStorageRouter",
+    "RemoteUIStatsStorageRouter", "HistogramIterationListener",
+    "FlowIterationListener", "ConvolutionalIterationListener",
+    "Component", "ComponentText", "ComponentTable", "ComponentChartLine",
+    "ComponentChartHistogram", "ComponentDiv", "ComponentHtmlRenderer",
 ]

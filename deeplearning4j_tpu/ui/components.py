@@ -1,0 +1,203 @@
+"""Reusable report components: chart/table/text -> standalone HTML.
+
+Parity: ref deeplearning4j-ui-components/.../components/ (chart/, table/,
+text/, component/ + the TypeScript renderer dl4j-ui.js). TPU-first rendering:
+components serialize to plain dicts and render to dependency-free inline SVG /
+HTML (no TypeScript asset pipeline), which is how every report in this
+framework ships (EvaluationTools ROC pages, the training dashboard).
+
+    page = ComponentHtmlRenderer().render(
+        ComponentText("Report"),
+        ComponentChartLine("loss", [(xs, ys, "train")]),
+        ComponentTable(["metric", "value"], [["acc", "0.98"]]))
+"""
+from __future__ import annotations
+
+import html as _html
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+
+class Component:
+    """(ref components/component/Component.java) — serializable render node."""
+    component_type = "component"
+
+    def to_dict(self) -> Dict[str, Any]:
+        raise NotImplementedError
+
+    def render_html(self) -> str:
+        raise NotImplementedError
+
+
+class ComponentText(Component):
+    """(ref text/ComponentText.java)"""
+    component_type = "text"
+
+    def __init__(self, text: str, heading: bool = True):
+        self.text = text
+        self.heading = heading
+
+    def to_dict(self):
+        return {"type": self.component_type, "text": self.text,
+                "heading": self.heading}
+
+    def render_html(self):
+        tag = "h3" if self.heading else "p"
+        return f"<{tag}>{_html.escape(self.text)}</{tag}>"
+
+
+class ComponentTable(Component):
+    """(ref table/ComponentTable.java)"""
+    component_type = "table"
+
+    def __init__(self, header: Sequence[str], rows: Sequence[Sequence[Any]]):
+        self.header = list(header)
+        self.rows = [list(r) for r in rows]
+
+    def to_dict(self):
+        return {"type": self.component_type, "header": self.header,
+                "rows": self.rows}
+
+    def render_html(self):
+        out = ['<table style="border-collapse:collapse">',
+               "<tr>" + "".join(
+                   f'<th style="border:1px solid #ccc;padding:3px 8px">'
+                   f"{_html.escape(str(h))}</th>" for h in self.header) + "</tr>"]
+        for r in self.rows:
+            out.append("<tr>" + "".join(
+                f'<td style="border:1px solid #ccc;padding:3px 8px">'
+                f"{_html.escape(str(v))}</td>" for v in r) + "</tr>")
+        out.append("</table>")
+        return "".join(out)
+
+
+_COLORS = ("#36c", "#c63", "#383", "#936", "#693", "#369")
+
+
+class ComponentChartLine(Component):
+    """(ref chart/ChartLine.java) — multi-series line chart."""
+    component_type = "chart_line"
+
+    def __init__(self, title: str,
+                 series: Sequence[Tuple[Sequence[float], Sequence[float], str]],
+                 width: int = 560, height: int = 320,
+                 x_label: str = "", y_label: str = ""):
+        self.title = title
+        self.series = [(list(x), list(y), str(n)) for x, y, n in series]
+        self.width, self.height = int(width), int(height)
+        self.x_label, self.y_label = x_label, y_label
+
+    def to_dict(self):
+        return {"type": self.component_type, "title": self.title,
+                "series": [{"x": x, "y": y, "name": n}
+                           for x, y, n in self.series]}
+
+    def render_html(self):
+        W, H, P = self.width, self.height, 42
+        pts = [(x, y) for xs, ys, _ in self.series
+               for x, y in zip(xs, ys) if y == y]
+        if not pts:
+            return f"<h4>{_html.escape(self.title)}</h4><svg/>"
+        x0 = min(p[0] for p in pts)
+        x1 = max(p[0] for p in pts)
+        y0 = min(p[1] for p in pts)
+        y1 = max(p[1] for p in pts)
+
+        def sx(v):
+            return P + (W - 2 * P) * (v - x0) / max(1e-12, x1 - x0)
+
+        def sy(v):
+            return H - P - (H - 2 * P) * (v - y0) / max(1e-12, y1 - y0)
+
+        parts = [f'<rect x="{P}" y="{P}" width="{W - 2 * P}" '
+                 f'height="{H - 2 * P}" fill="none" stroke="#ddd"/>']
+        legend = []
+        for i, (xs, ys, name) in enumerate(self.series):
+            color = _COLORS[i % len(_COLORS)]
+            d = ""
+            for x, y in zip(xs, ys):
+                if y == y:
+                    d += f"{'L' if d else 'M'}{sx(x):.1f} {sy(y):.1f}"
+            parts.append(f'<path d="{d}" stroke="{color}" fill="none" '
+                         f'stroke-width="1.5"/>')
+            legend.append(f'<tspan fill="{color}">{_html.escape(name)}</tspan>')
+        parts.append(f'<text x="{P}" y="16" font-size="12">'
+                     + " ".join(legend) + "</text>")
+        parts.append(f'<text x="6" y="{P}" font-size="11">{y1:.4g}</text>')
+        parts.append(f'<text x="6" y="{H - P}" font-size="11">{y0:.4g}</text>')
+        if self.x_label:
+            parts.append(f'<text x="{W // 2}" y="{H - 6}" font-size="12">'
+                         f"{_html.escape(self.x_label)}</text>")
+        return (f"<h4>{_html.escape(self.title)}</h4>"
+                f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" '
+                f'height="{H}" style="background:#fff">'
+                + "".join(parts) + "</svg>")
+
+
+class ComponentChartHistogram(Component):
+    """(ref chart/ChartHistogram.java)"""
+    component_type = "chart_histogram"
+
+    def __init__(self, title: str, bin_edges: Sequence[float],
+                 counts: Sequence[float], width: int = 560, height: int = 320):
+        self.title = title
+        self.bin_edges = list(bin_edges)
+        self.counts = list(counts)
+        self.width, self.height = int(width), int(height)
+
+    def to_dict(self):
+        return {"type": self.component_type, "title": self.title,
+                "bin_edges": self.bin_edges, "counts": self.counts}
+
+    def render_html(self):
+        W, H, P = self.width, self.height, 30
+        if not self.counts:
+            return f"<h4>{_html.escape(self.title)}</h4><svg/>"
+        m = max(self.counts) or 1.0
+        bw = (W - 2 * P) / len(self.counts)
+        parts = []
+        for i, c in enumerate(self.counts):
+            h = (H - 2 * P) * c / m
+            parts.append(f'<rect x="{P + i * bw:.1f}" y="{H - P - h:.1f}" '
+                         f'width="{max(1.0, bw - 1):.1f}" height="{h:.1f}" '
+                         f'fill="#36c"/>')
+        parts.append(f'<text x="{P}" y="{H - 8}" font-size="11">'
+                     f"{self.bin_edges[0]:.3g}</text>")
+        parts.append(f'<text x="{W - P - 40}" y="{H - 8}" font-size="11">'
+                     f"{self.bin_edges[-1]:.3g}</text>")
+        return (f"<h4>{_html.escape(self.title)}</h4>"
+                f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" '
+                f'height="{H}" style="background:#fff">'
+                + "".join(parts) + "</svg>")
+
+
+class ComponentDiv(Component):
+    """(ref component/ComponentDiv.java) — container with child components."""
+    component_type = "div"
+
+    def __init__(self, *children: Component, style: str = ""):
+        self.children = list(children)
+        self.style = style
+
+    def to_dict(self):
+        return {"type": self.component_type, "style": self.style,
+                "children": [c.to_dict() for c in self.children]}
+
+    def render_html(self):
+        inner = "".join(c.render_html() for c in self.children)
+        style = f' style="{_html.escape(self.style)}"' if self.style else ""
+        return f"<div{style}>{inner}</div>"
+
+
+class ComponentHtmlRenderer:
+    """(ref the dl4j-ui.js renderer + StaticPageUtil) — standalone page."""
+
+    def render(self, *components: Component, title: str = "Report") -> str:
+        body = "".join(c.render_html() for c in components)
+        return (f"<!DOCTYPE html><html><head><title>{_html.escape(title)}"
+                f"</title></head><body style=\"font-family:sans-serif\">"
+                f"{body}</body></html>")
+
+    def render_to_file(self, path: str, *components: Component,
+                       title: str = "Report") -> None:
+        with open(path, "w") as f:
+            f.write(self.render(*components, title=title))

@@ -1,0 +1,112 @@
+"""Legacy UI listeners (ref deeplearning4j-ui/.../ui/weights/
+HistogramIterationListener.java + ConvolutionalIterationListener.java and the
+Flow listener family).
+
+TPU-first rendering: instead of the reference's Play-served pages, each
+listener emits either records into the StatsStorage chain (picked up by the
+round-3 dashboard's histogram/graph views) or a self-contained SVG/HTML file —
+zero servers required, nothing blocks the device loop (one host transfer per
+visualization tick).
+"""
+from __future__ import annotations
+
+import html
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.ui.stats import StatsListener
+
+
+class HistogramIterationListener(StatsListener):
+    """(ref HistogramIterationListener.java) — parameter/update histograms per
+    iteration. The modern StatsListener already collects exactly this; the
+    legacy class survives as a preset (histograms on, memory off) so existing
+    reference call sites port 1:1."""
+
+    def __init__(self, storage, frequency: int = 1, session_id=None):
+        super().__init__(storage, frequency=frequency, session_id=session_id,
+                         collect_histograms=True, collect_updates=True,
+                         collect_memory=False)
+
+
+class FlowIterationListener(StatsListener):
+    """(ref FlowIterationListener) — model-graph 'flow' view. The static-info
+    record carries the layer graph (config_json + layer_names); the dashboard's
+    Model-graph panel renders it. Preset: no histograms (the flow view is
+    topology + score)."""
+
+    def __init__(self, storage, frequency: int = 1, session_id=None):
+        super().__init__(storage, frequency=frequency, session_id=session_id,
+                         collect_histograms=False, collect_updates=False,
+                         collect_memory=False)
+
+
+class ConvolutionalIterationListener(TrainingListener):
+    """(ref ConvolutionalIterationListener.java:38) — every N iterations,
+    render the first convolution layer's activation maps for one input as an
+    SVG grid written to `output_dir` (conv_acts_iter_<i>.html)."""
+
+    def __init__(self, output_dir: str, visualization_frequency: int = 10,
+                 max_channels: int = 16, sample_input=None):
+        import os
+        self.output_dir = output_dir
+        self.frequency = max(1, int(visualization_frequency))
+        self.max_channels = int(max_channels)
+        self.sample_input = sample_input
+        os.makedirs(output_dir, exist_ok=True)
+        self.last_path: Optional[str] = None
+
+    def _first_conv_activations(self, model, x) -> Optional[np.ndarray]:
+        from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+            ConvolutionLayer)
+        acts = model.feed_forward(x, train=False)
+        for layer, act in zip(model.layers, acts[1:]):
+            if isinstance(layer, ConvolutionLayer):
+                return np.asarray(act)
+        return None
+
+    def iteration_done(self, model, iteration: int):
+        if iteration % self.frequency != 0:
+            return
+        x = self.sample_input
+        if x is None or not hasattr(model, "feed_forward"):
+            return
+        act = self._first_conv_activations(model, np.asarray(x)[:1])
+        if act is None:
+            return
+        self.last_path = self._render(act[0], iteration)
+
+    def _render(self, act: np.ndarray, iteration: int) -> str:
+        import os
+        C = min(act.shape[0], self.max_channels)
+        h, w = act.shape[1], act.shape[2]
+        cell = 4
+        cols = min(C, 8)
+        rows = (C + cols - 1) // cols
+        parts = []
+        for c in range(C):
+            a = act[c]
+            lo, hi = float(a.min()), float(a.max())
+            norm = (a - lo) / max(hi - lo, 1e-12)
+            ox = (c % cols) * (w * cell + 8)
+            oy = (c // cols) * (h * cell + 8)
+            # downsample to at most 32x32 rects per map to keep files small
+            step = max(1, h // 32, w // 32)
+            for i in range(0, h, step):
+                for j in range(0, w, step):
+                    v = int(255 * float(norm[i, j]))
+                    parts.append(
+                        f'<rect x="{ox + j * cell}" y="{oy + i * cell}" '
+                        f'width="{cell * step}" height="{cell * step}" '
+                        f'fill="rgb({v},{v},{v})"/>')
+        W = cols * (w * cell + 8)
+        H = rows * (h * cell + 8)
+        svg = (f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" '
+               f'height="{H}">' + "".join(parts) + "</svg>")
+        path = os.path.join(self.output_dir, f"conv_acts_iter_{iteration}.html")
+        with open(path, "w") as f:
+            f.write(f"<html><body><h3>{html.escape(str(iteration))}: first "
+                    f"conv layer activations</h3>{svg}</body></html>")
+        return path

@@ -21,51 +21,129 @@ _PAGE = """<!DOCTYPE html>
 <html><head><title>deeplearning4j_tpu training UI</title>
 <style>
 body{font-family:sans-serif;margin:20px;background:#fafafa}
-h2{margin:8px 0}.row{display:flex;gap:24px;flex-wrap:wrap}
+h2{margin:8px 0}h3{margin:4px 0}.row{display:flex;gap:24px;flex-wrap:wrap}
 .card{background:#fff;border:1px solid #ddd;border-radius:6px;padding:12px}
 svg{background:#fff}table{border-collapse:collapse}
 td,th{border:1px solid #ccc;padding:3px 8px;font-size:13px}
+select{margin:4px 0}
+.node{fill:#eef;stroke:#36c}.nodetxt{font-size:11px}
 </style></head><body>
 <h2>Training sessions</h2><div id="sessions"></div>
 <div class="row">
- <div class="card"><h3>Score vs iteration</h3><svg id="chart" width="640" height="320"></svg></div>
+ <div class="card"><h3>Score vs iteration</h3><svg id="chart" width="560" height="280"></svg></div>
  <div class="card"><h3>Model</h3><pre id="info" style="font-size:12px"></pre>
  <h3>Last update</h3><table id="layers"></table></div>
+ <div class="card"><h3>Model graph</h3><svg id="graph" width="260" height="420"></svg></div>
+</div>
+<div class="row">
+ <div class="card"><h3>Layer detail <select id="layersel"></select></h3>
+  <div class="row">
+   <div><h4>mean |param| / |update| vs iteration</h4>
+    <svg id="mag" width="420" height="240"></svg></div>
+   <div><h4>update : param ratio (log10)</h4>
+    <svg id="ratio" width="420" height="240"></svg></div>
+   <div><h4>param histogram (latest)</h4>
+    <svg id="hist" width="420" height="240"></svg></div>
+  </div>
+ </div>
 </div>
 <script>
-let sid=null;
+let sid=null,layerNames=[];
 async function j(u){const r=await fetch(u);return r.json()}
+function line(svg,series,opts){
+ const W=svg.width.baseVal.value,H=svg.height.baseVal.value,P=34;
+ let out='';const all=series.flatMap(s=>s.pts.filter(p=>isFinite(p[1])));
+ if(!all.length){svg.innerHTML='';return}
+ const xs=all.map(p=>p[0]),ys=all.map(p=>p[1]);
+ const x0=Math.min(...xs),x1=Math.max(...xs),y0=Math.min(...ys),y1=Math.max(...ys);
+ const sx=v=>P+(W-2*P)*(v-x0)/Math.max(1e-12,x1-x0);
+ const sy=v=>H-P-(H-2*P)*(v-y0)/Math.max(1e-12,y1-y0);
+ series.forEach(s=>{let d='';
+  s.pts.forEach(p=>{if(isFinite(p[1]))d+=(d?'L':'M')+sx(p[0])+' '+sy(p[1])});
+  out+='<path d="'+d+'" stroke="'+s.color+'" fill="none" stroke-width="1.5"/>'});
+ out+='<text x="6" y="'+(P-10)+'" font-size="11">'+y1.toPrecision(3)+'</text>'+
+  '<text x="6" y="'+(H-P)+'" font-size="11">'+y0.toPrecision(3)+'</text>';
+ let lx=P;series.forEach(s=>{out+='<rect x="'+lx+'" y="4" width="10" height="10" fill="'+s.color+'"/>'+
+  '<text x="'+(lx+14)+'" y="13" font-size="11">'+s.label+'</text>';lx+=s.label.length*7+30});
+ svg.innerHTML=out;
+}
+function bars(svg,counts,edges){
+ const W=svg.width.baseVal.value,H=svg.height.baseVal.value,P=24;
+ if(!counts||!counts.length){svg.innerHTML='';return}
+ const m=Math.max(...counts);let out='';
+ const bw=(W-2*P)/counts.length;
+ counts.forEach((c,i)=>{const h=(H-2*P)*c/Math.max(1,m);
+  out+='<rect x="'+(P+i*bw)+'" y="'+(H-P-h)+'" width="'+Math.max(1,bw-1)+
+   '" height="'+h+'" fill="#36c"/>'});
+ out+='<text x="'+P+'" y="'+(H-6)+'" font-size="11">'+edges[0].toPrecision(2)+'</text>'+
+  '<text x="'+(W-P-40)+'" y="'+(H-6)+'" font-size="11">'+
+   edges[edges.length-1].toPrecision(2)+'</text>';
+ svg.innerHTML=out;
+}
+function drawGraph(info){
+ const svg=document.getElementById('graph');
+ if(!info||!info.model||!info.model.layer_names){svg.innerHTML='';return}
+ let edges=[],names=info.model.layer_names;
+ try{const conf=JSON.parse(info.model.config_json);
+  if(conf&&conf.nodes){names=Object.keys(conf.nodes);
+   names.forEach(n=>{(conf.nodes[n].inputs||[]).forEach(i=>edges.push([i,n]))});}
+  else{for(let i=1;i<names.length;i++)edges.push([names[i-1],names[i]]);}
+ }catch(e){for(let i=1;i<names.length;i++)edges.push([names[i-1],names[i]]);}
+ const W=260,rowH=34,pos={};let out='';
+ const shown=names.slice(0,11);
+ shown.forEach((n,i)=>{pos[n]=[W/2,20+i*rowH];
+  out+='<rect class="node" x="'+(W/2-80)+'" y="'+(6+i*rowH)+'" width="160" height="22" rx="4"/>'+
+   '<text class="nodetxt" x="'+(W/2)+'" y="'+(21+i*rowH)+'" text-anchor="middle">'+
+    n.slice(0,26)+'</text>'});
+ edges.forEach(e=>{const a=pos[e[0]],b=pos[e[1]];
+  if(a&&b)out+='<line x1="'+a[0]+'" y1="'+(a[1]+8)+'" x2="'+b[0]+'" y2="'+(b[1]-14)+
+   '" stroke="#999" marker-end="none"/>'});
+ if(names.length>shown.length)out+='<text x="'+(W/2)+'" y="'+(16+shown.length*rowH)+
+  '" text-anchor="middle" font-size="11">… '+(names.length-shown.length)+' more</text>';
+ svg.innerHTML=out;
+ svg.setAttribute('height',Math.min(420,30+shown.length*rowH));
+}
 async function refresh(){
  const sessions=await j('/train/sessions');
  document.getElementById('sessions').textContent=sessions.join(', ');
  if(!sid&&sessions.length)sid=sessions[0];
  if(!sid)return;
  const info=await j('/train/sessions/'+sid+'/info');
- if(info&&info.model)document.getElementById('info').textContent=
+ if(info&&info.model){document.getElementById('info').textContent=
    'params: '+info.model.num_params+'\\nlayers: '+info.model.num_layers+
    '\\ndevice: '+(info.hardware?info.hardware.device_kind:'?');
+  drawGraph(info);
+  const sel=document.getElementById('layersel');
+  if(sel.options.length===0&&info.model.layer_names){
+   layerNames=info.model.layer_names;
+   layerNames.forEach((n,i)=>{const o=document.createElement('option');
+    o.value=i;o.textContent=i+': '+n;sel.appendChild(o)})}}
  const ups=await j('/train/sessions/'+sid+'/updates');
  if(!ups.length)return;
- drawChart(ups.map(u=>[u.iteration,u.score]));
+ line(document.getElementById('chart'),
+   [{pts:ups.map(u=>[u.iteration,u.score]),color:'#36c',label:'score'}]);
  const last=ups[ups.length-1];
- let html='<tr><th>layer</th><th>param mean</th><th>stdev</th><th>|mean|</th></tr>';
+ let html='<tr><th>layer</th><th>param |mean|</th><th>update |mean|</th><th>ratio</th></tr>';
  const ps=(last.stats&&last.stats.params)||{};
+ const us=(last.stats&&last.stats.updates)||{};
+ const rs=(last.stats&&last.stats.update_ratios)||{};
  for(const k of Object.keys(ps)){const s=ps[k];
-  html+='<tr><td>'+k+'</td><td>'+s.mean.toExponential(3)+'</td><td>'+
-    s.stdev.toExponential(3)+'</td><td>'+s.mean_magnitude.toExponential(3)+'</td></tr>'}
+  html+='<tr><td>'+(layerNames[k]||k)+'</td><td>'+s.mean_magnitude.toExponential(2)+
+   '</td><td>'+(us[k]?us[k].mean_magnitude.toExponential(2):'-')+'</td><td>'+
+   (rs[k]!=null?rs[k].toExponential(2):'-')+'</td></tr>'}
  document.getElementById('layers').innerHTML=html;
-}
-function drawChart(pts){
- const svg=document.getElementById('chart'),W=640,H=320,P=40;
- const xs=pts.map(p=>p[0]),ys=pts.map(p=>p[1]).filter(isFinite);
- const x0=Math.min(...xs),x1=Math.max(...xs),y0=Math.min(...ys),y1=Math.max(...ys);
- const sx=v=>P+(W-2*P)*(v-x0)/Math.max(1e-12,x1-x0);
- const sy=v=>H-P-(H-2*P)*(v-y0)/Math.max(1e-12,y1-y0);
- let d='';pts.forEach((p,i)=>{if(isFinite(p[1]))d+=(d?'L':'M')+sx(p[0])+' '+sy(p[1])});
- svg.innerHTML='<path d="'+d+'" stroke="#36c" fill="none" stroke-width="1.5"/>'+
-  '<text x="'+(W/2)+'" y="'+(H-8)+'" font-size="12">iteration</text>'+
-  '<text x="6" y="'+(P-10)+'" font-size="12">'+y1.toPrecision(4)+'</text>'+
-  '<text x="6" y="'+(H-P)+'" font-size="12">'+y0.toPrecision(4)+'</text>';
+ // per-layer drill-down (ref TrainModule model tab)
+ const li=document.getElementById('layersel').value||Object.keys(ps)[0];
+ line(document.getElementById('mag'),[
+  {pts:ups.map(u=>[u.iteration,(u.stats.params[li]||{}).mean_magnitude]),
+   color:'#36c',label:'|param|'},
+  {pts:ups.map(u=>[u.iteration,((u.stats.updates||{})[li]||{}).mean_magnitude]),
+   color:'#c63',label:'|update|'}]);
+ line(document.getElementById('ratio'),[
+  {pts:ups.map(u=>{const r=((u.stats.update_ratios||{})[li]);
+    return [u.iteration,r>0?Math.log10(r):NaN]}),color:'#383',label:'log10 ratio'}]);
+ const h=(last.stats.params[li]||{});
+ bars(document.getElementById('hist'),h.histogram_counts,h.histogram_edges||[0,1]);
 }
 setInterval(refresh,2000);refresh();
 </script></body></html>"""

@@ -139,12 +139,23 @@ class StatsListener(TrainingListener):
                 lambda a: jnp.array(a, copy=True), params)
 
         now = time.time()
+        stats_py = _to_python(stats)
+        # update:param mean-magnitude ratio per layer — the TrainModule ratio
+        # chart (ref module/train/TrainModule.java ratio tab); healthy training
+        # sits around 1e-3
+        if "updates" in stats_py:
+            ratios = {}
+            for k, u in stats_py["updates"].items():
+                p = stats_py["params"].get(k)
+                if p and p.get("mean_magnitude"):
+                    ratios[k] = u["mean_magnitude"] / p["mean_magnitude"]
+            stats_py["update_ratios"] = ratios
         record: Dict[str, Any] = {
             "session_id": self.session_id, "type_id": "StatsListener",
             "worker_id": self.worker_id, "timestamp": now,
             "iteration": int(iteration),
             "score": float(model.score()),
-            "stats": _to_python(stats),
+            "stats": stats_py,
             "learning_rates": self._learning_rates(model),
         }
         if self._last_report_time is not None:

@@ -168,3 +168,19 @@ def test_two_input_computation_graph_trains_from_two_readers():
         net.fit(it)
         s0 = s0 if s0 is not None else net.score()
     assert net.score() < s0
+
+
+def test_time_series_random_offset_shared_across_readers():
+    """Features and labels from different readers must land at the SAME time
+    positions (independent draws would train on misaligned pairs)."""
+    fa = [seq(2, 0.0, 0), seq(5, 10.0, 1)]
+    fb = [seq(2, 100.0, 1), seq(5, 200.0, 0)]
+    it = (RecordReaderMultiDataSetIterator.Builder(2)
+          .add_sequence_reader("fa", CollectionSequenceRecordReader(fa))
+          .add_sequence_reader("fb", CollectionSequenceRecordReader(fb))
+          .add_input("fa", 0, 0)
+          .add_output_one_hot("fb", 1, 2)
+          .time_series_random_offset(True, seed=99)
+          .build())
+    mds = next(iter(it))
+    np.testing.assert_allclose(mds.features_masks[0], mds.labels_masks[0])

@@ -162,3 +162,68 @@ def test_gzipped_text_vectors_read():
         loaded = WordVectorSerializer.read_word_vectors(gz)
     np.testing.assert_allclose(loaded.get_word_vector("apple"),
                                wv.get_word_vector("apple"), atol=1e-5)
+
+
+class TestCnnSentenceIterator:
+    """NLP -> CNN bridge (ref iterator/CnnSentenceDataSetIterator.java:48)."""
+
+    def build_wv(self):
+        from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+        from deeplearning4j_tpu.nlp.word_vectors import WordVectors
+        corpus = [d[1].split() for d in DOCS]
+        sv = SequenceVectors(layer_size=8, negative=3, epochs=2, seed=5,
+                             min_word_frequency=1)
+        sv.fit(lambda: iter(corpus))
+        return WordVectors(sv.vocab, sv.lookup_table)
+
+    def test_batches_shapes_masks_labels(self):
+        from deeplearning4j_tpu.nlp import (
+            CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider)
+        wv = self.build_wv()
+        sentences = ["apple banana fruit", "iron steel metal weld forge",
+                     "banana juice", "copper alloy metal"]
+        labels = ["fruit", "metal", "fruit", "metal"]
+        it = (CnnSentenceDataSetIterator.Builder()
+              .sentence_provider(CollectionLabeledSentenceProvider(sentences,
+                                                                   labels))
+              .word_vectors(wv).minibatch_size(4).max_sentence_length(6)
+              .build())
+        ds = next(iter(it))
+        assert ds.features.shape == (4, 1, 5, 8)  # padded to longest (5 toks)
+        assert ds.labels.shape == (4, 2)
+        np.testing.assert_allclose(ds.features_mask[0], [1, 1, 1, 0, 0])
+        assert it.get_labels() == ["fruit", "metal"]
+        # sentence 0 row 0 equals the word vector for "apple"
+        np.testing.assert_allclose(ds.features[0, 0, 0],
+                                   wv.get_word_vector("apple"), atol=1e-6)
+
+    def test_unknown_word_handling_and_height_toggle(self):
+        from deeplearning4j_tpu.nlp import (
+            CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider,
+            UnknownWordHandling)
+        wv = self.build_wv()
+        prov = CollectionLabeledSentenceProvider(
+            ["apple zzzunknown banana"], ["fruit"])
+        it = (CnnSentenceDataSetIterator.Builder()
+              .sentence_provider(prov).word_vectors(wv)
+              .unknown_word_handling(UnknownWordHandling.RemoveWord).build())
+        ds = it.next()
+        assert ds.features.shape[2] == 2  # unknown word removed
+        prov.reset()
+        it2 = (CnnSentenceDataSetIterator.Builder()
+               .sentence_provider(prov).word_vectors(wv)
+               .unknown_word_handling(UnknownWordHandling.UseUnknownVector)
+               .sentences_along_height(False).build())
+        ds2 = it2.next()
+        assert ds2.features.shape == (1, 1, 8, 3)  # transposed, unknown kept
+        np.testing.assert_allclose(ds2.features[0, 0, :, 1], 0.0)
+
+    def test_load_single_sentence(self):
+        from deeplearning4j_tpu.nlp import (
+            CnnSentenceDataSetIterator, CollectionLabeledSentenceProvider)
+        wv = self.build_wv()
+        it = (CnnSentenceDataSetIterator.Builder()
+              .sentence_provider(CollectionLabeledSentenceProvider(
+                  ["apple"], ["a"])).word_vectors(wv).build())
+        m = it.load_single_sentence("apple banana")
+        assert m.shape == (1, 1, 2, 8)

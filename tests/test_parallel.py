@@ -197,3 +197,25 @@ def test_custom_mode_matches_single_device_sgd():
     net_b.fit_batch(x, y)
     np.testing.assert_allclose(np.asarray(net_a.params()),
                                np.asarray(net_b.params()), rtol=1e-10, atol=1e-12)
+
+
+def test_averaging_partial_window_averaged_on_write_back():
+    """averaging_frequency NOT dividing the step count: the final partial
+    window must be averaged (DL4J runs one more average after the fit loop,
+    ParallelWrapper.java:306-365) instead of keeping replica-0's tail."""
+    x, y = xor(64)
+    net = make_net(seed=11)
+    pw = (ParallelWrapper.Builder(net).workers(8)
+          .training_mode(TrainingMode.AVERAGING).averaging_frequency(4).build())
+    from deeplearning4j_tpu.datasets.iterators import ListDataSetIterator
+    batches = [DataSet(x, y)] * 3  # 3 steps: 3 % 4 != 0
+    pw.fit(ListDataSetIterator(batches, batch=64))
+    params_repl = pw._carry[0]
+    for layer in params_repl:
+        for k, v in layer.items():
+            arr = np.asarray(v)
+            for r in range(1, arr.shape[0]):
+                np.testing.assert_allclose(
+                    arr[r], arr[0], atol=1e-7,
+                    err_msg=f"replica {r} of {k} differs after partial-window "
+                            f"write-back")

@@ -154,3 +154,105 @@ def test_ui_server_and_remote_router():
             assert b"Score vs iteration" in r.read()
     finally:
         server.stop()
+
+
+def test_update_ratio_and_histograms_in_records():
+    """TrainModule-parity depth: per-layer update:param ratio + histograms
+    (ref module/train/TrainModule.java ratio/histogram tabs)."""
+    from deeplearning4j_tpu.ui.stats import StatsListener
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+    storage = InMemoryStatsStorage()
+    net = small_net()
+    net.set_listeners(StatsListener(storage, session_id="deep",
+                                    collect_histograms=True))
+    x, y = data()
+    for _ in range(3):
+        net.fit(DataSet(x, y))
+    ups = storage.get_all_updates("deep")
+    last = ups[-1]["stats"]
+    assert "update_ratios" in last
+    for k, r in last["update_ratios"].items():
+        assert r > 0
+    some = next(iter(last["params"].values()))
+    assert len(some["histogram_counts"]) > 0
+    assert len(some["histogram_edges"]) == len(some["histogram_counts"]) + 1
+
+
+def test_dashboard_page_has_train_module_sections():
+    from deeplearning4j_tpu.ui.server import _PAGE
+    for marker in ("Model graph", "update : param ratio", "param histogram",
+                   "layersel"):
+        assert marker in _PAGE
+
+
+def test_legacy_listeners(tmp_path):
+    """ref deeplearning4j-ui legacy listeners (Histogram/Flow/Convolutional)."""
+    import os
+    from deeplearning4j_tpu.ui import (
+        ConvolutionalIterationListener, FlowIterationListener,
+        HistogramIterationListener)
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+    from deeplearning4j_tpu.common.enums import (
+        Activation, LossFunction, PoolingType)
+    from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+        ConvolutionLayer, SubsamplingLayer)
+    from deeplearning4j_tpu.nn.conf.layers.feedforward import OutputLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+
+    conf = (NeuralNetConfiguration.Builder().seed(4)
+            .list()
+            .layer(ConvolutionLayer(n_out=4, kernel_size=(3, 3),
+                                    activation=Activation.RELU))
+            .layer(SubsamplingLayer(kernel_size=(2, 2), stride=(2, 2)))
+            .layer(OutputLayer(n_out=3, loss_fn=LossFunction.MCXENT))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.rand(8, 1, 8, 8).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.randint(0, 3, 8)]
+
+    storage = InMemoryStatsStorage()
+    conv_dir = os.path.join(tmp_path, "convviz")
+    cl = ConvolutionalIterationListener(conv_dir, visualization_frequency=1,
+                                        sample_input=x)
+    net.set_listeners(HistogramIterationListener(storage, session_id="hist"),
+                      cl)
+    for _ in range(2):
+        net.fit(DataSet(x, y))
+    ups = storage.get_all_updates("hist")
+    assert "histogram_counts" in next(iter(ups[-1]["stats"]["params"].values()))
+    assert cl.last_path and os.path.exists(cl.last_path)
+    content = open(cl.last_path).read()
+    assert "<svg" in content
+
+    storage2 = InMemoryStatsStorage()
+    net.set_listeners(FlowIterationListener(storage2, session_id="flow"))
+    net.fit(DataSet(x, y))
+    info = storage2.get_static_info("flow")
+    assert info["model"]["layer_names"]
+
+
+def test_ui_components_render(tmp_path):
+    """ref deeplearning4j-ui-components chart/table/text component model."""
+    import os
+    from deeplearning4j_tpu.ui import (
+        ComponentChartHistogram, ComponentChartLine, ComponentDiv,
+        ComponentHtmlRenderer, ComponentTable, ComponentText)
+    page = ComponentHtmlRenderer().render(
+        ComponentText("Report title"),
+        ComponentDiv(
+            ComponentChartLine("loss", [([0, 1, 2], [1.0, 0.5, 0.3], "train"),
+                                        ([0, 1, 2], [1.1, 0.7, 0.5], "test")]),
+            ComponentChartHistogram("weights", [0, 0.5, 1.0], [3, 7]),
+            style="display:flex"),
+        ComponentTable(["metric", "value"], [["acc", 0.98], ["f1", 0.97]]))
+    assert "Report title" in page and "<svg" in page and "acc" in page
+    path = os.path.join(tmp_path, "report.html")
+    ComponentHtmlRenderer().render_to_file(
+        path, ComponentText("x", heading=False))
+    assert os.path.exists(path)
+    d = ComponentDiv(ComponentText("a")).to_dict()
+    assert d["children"][0]["type"] == "text"

@@ -161,3 +161,15 @@ class TestLSH:
         res = lsh.search(rng.randn(8), k=5)
         assert len(res) == 5
         assert all(0 <= i < 200 for i, _ in res)
+
+
+def test_kdtree_deep_unbalanced_tree_no_recursion_limit():
+    # monotone inserts give a height-N tree; traversals must not recurse
+    tree = KDTree(2)
+    n = 3000
+    for i in range(n):
+        tree.insert([float(i), float(i)])
+    d, p = tree.nn([1500.2, 1500.2])
+    assert abs(d - np.linalg.norm([0.2, 0.2])) < 1e-9
+    assert len(tree.knn([10.0, 10.0], 1.5)) == 3  # 9,10,11
+    assert tree.delete([0.0, 0.0]) and tree.size() == n - 1
