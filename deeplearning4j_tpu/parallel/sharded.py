@@ -143,8 +143,14 @@ class ShardedTrainer:
         st.fit_on_device(x, y, steps=K)   # K steps as one scanned computation
         st.write_back()       # net holds the (global-view) trained state
 
-    After write_back the wrapped net serializes/evaluates exactly like an
-    unsharded one — jax global arrays gather transparently on host reads."""
+    Single-process (incl. a full single-host slice): after write_back the
+    wrapped net serializes/evaluates exactly like an unsharded one — jax
+    global arrays gather transparently on host reads. Multi-HOST runs
+    (process_count > 1): model-sharded params span other processes' devices,
+    so host reads of the whole array raise 'not fully addressable'; gather
+    per-process via `arr.addressable_shards` (each process addresses a full
+    copy of every model shard for its data rows under the supported layout —
+    see tests/_sharded_worker.py) or use jax.experimental.multihost_utils."""
 
     def __init__(self, model, mesh: Mesh, data_axis: str = "data",
                  model_axis: str = "model", auto_shard: bool = True,
@@ -220,6 +226,19 @@ class ShardedTrainer:
                 zip(self.net._opt_state, self.net.params_tree, param_sh)]
 
     # ------------------------------------------------------------------ setup
+    def _put(self, value, sharding):
+        """Multi-process-safe placement. Single process: plain device_put.
+        Multi-host: every process holds the full value and contributes its
+        addressable shards (valid for the supported pod layout — the 'data'
+        axis spans processes, the 'model' axis stays inside each process's
+        ICI domain, so each process addresses every model shard of its data
+        rows)."""
+        if jax.process_count() == 1:
+            return jax.device_put(value, sharding)
+        value = np.asarray(value)
+        return jax.make_array_from_process_local_data(sharding, value,
+                                                      value.shape)
+
     def _ensure_setup(self):
         if self._carry is not None:
             return
@@ -227,7 +246,7 @@ class ShardedTrainer:
         param_sh = self._param_shardings()
         opt_sh = self._opt_shardings(param_sh)
         rep = NamedSharding(self.mesh, P())
-        put = jax.device_put
+        put = self._put
         params = [
             {k: put(v, param_sh[i][k]) for k, v in p.items()}
             for i, p in enumerate(net.params_tree)]
@@ -241,16 +260,20 @@ class ShardedTrainer:
         self._build_step()
 
     def _place_batch(self, x, y):
-        """Batch sharded over the data axis, replicated over model/pipe axes."""
+        """Batch sharded over the data axis, replicated over model/pipe axes.
+        Multi-host: each process passes its LOCAL rows; the global batch is
+        their concatenation along the data axis (jax.distributed layout)."""
         net = self.net
         from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
         multi = isinstance(net, ComputationGraph)
 
         def put(a):
-            a = jnp.asarray(a, net.dtype)
             sh = NamedSharding(self.mesh,
-                               P(self.data_axis, *([None] * (a.ndim - 1))))
-            return jax.device_put(a, sh)
+                               P(self.data_axis, *([None] * (np.ndim(a) - 1))))
+            if jax.process_count() == 1:
+                return jax.device_put(jnp.asarray(a, net.dtype), sh)
+            return jax.make_array_from_process_local_data(
+                sh, np.asarray(a, net.dtype))
 
         if multi:
             xs = tuple(put(v) for v in (x if isinstance(x, (list, tuple)) else [x]))
@@ -345,8 +368,10 @@ class ShardedTrainer:
     # ---------------------------------------------------------------- results
     def write_back(self):
         """Install the trained (still device-sharded, globally-viewed) state into
-        the wrapped net. jax global arrays read on host as the full value, so
-        serialization/eval round-trip without an explicit gather."""
+        the wrapped net. Single-process: jax global arrays read on host as the
+        full value, so serialization/eval round-trip without an explicit
+        gather. Multi-host: host reads of model-sharded params need the
+        per-process addressable-shards gather (class docstring)."""
         net = self.net
         if self._carry is None:
             return net  # nothing trained yet

@@ -1,0 +1,46 @@
+"""Shared launcher for REAL multi-process cluster tests (the reference's Spark
+`local[N]` strategy rendered as actual subprocesses + jax.distributed)."""
+import os
+import socket
+import subprocess
+import sys
+import tempfile
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def run_cluster(worker_script: str, extra_argv, num_processes: int = 2,
+                timeout: int = 600):
+    """Launch `worker_script` once per process id. Each worker receives
+    argv: [*extra_argv, pid, num_processes, port, out_path]. Returns
+    (out_path, logs). Kills survivors if any worker fails or hangs so a
+    process blocked in jax.distributed.initialize can't leak."""
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    port = free_port()
+    out = os.path.join(tempfile.mkdtemp(), "result.npz")
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    procs = []
+    for pid in range(num_processes):
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.join(repo, "tests", worker_script),
+             *[str(a) for a in extra_argv], str(pid), str(num_processes),
+             str(port), out],
+            env=env, cwd=repo, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT))
+    logs = []
+    try:
+        for p in procs:
+            stdout, _ = p.communicate(timeout=timeout)
+            logs.append(stdout.decode(errors="replace"))
+            assert p.returncode == 0, f"worker failed:\n{logs[-1][-3000:]}"
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return out, logs

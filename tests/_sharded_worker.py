@@ -1,0 +1,87 @@
+"""Worker process for multi-host ShardedTrainer tests: dp spans processes,
+tp (Megatron-sharded weights) stays within each process's local devices —
+the standard pod layout (dp over DCN, tp over ICI).
+
+Usage: python _sharded_worker.py <process_id> <num_processes> <port> <out_path>
+"""
+import os
+import sys
+
+if __name__ == "__main__":
+    pid, nproc, port, out_path = (int(sys.argv[1]), int(sys.argv[2]),
+                                  int(sys.argv[3]), sys.argv[4])
+    os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                               + " --xla_force_host_platform_device_count=4")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+    jax.distributed.initialize(f"localhost:{port}", num_processes=nproc,
+                               process_id=pid)
+
+import numpy as np  # noqa: E402
+
+GLOBAL_BATCH = 16
+STEPS = 5
+
+
+def build_net():
+    from deeplearning4j_tpu import (
+        Activation, DenseLayer, InputType, LossFunction, NeuralNetConfiguration,
+        OutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.updater.updaters import Adam
+    conf = (NeuralNetConfiguration.Builder().seed(7).dtype("float64")
+            .updater(Adam(learning_rate=1e-2)).list()
+            .layer(DenseLayer(n_in=12, n_out=32, activation=Activation.TANH))
+            .layer(DenseLayer(n_out=32, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=4, loss_fn=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(12))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def global_batches():
+    rng = np.random.RandomState(42)
+    for _ in range(STEPS):
+        x = rng.randn(GLOBAL_BATCH, 12)
+        y = np.eye(4)[rng.randint(0, 4, GLOBAL_BATCH)]
+        yield x, y
+
+
+def main():
+    import jax
+    from jax.sharding import Mesh
+    from deeplearning4j_tpu.parallel import ShardedTrainer
+
+    devs = np.array(jax.devices()).reshape(nproc, -1)  # (data, model)
+    mesh = Mesh(devs, ("data", "model"))
+    net = build_net()
+    st = ShardedTrainer.Builder(net).mesh(mesh).build()
+
+    per = GLOBAL_BATCH // nproc
+    lo, hi = pid * per, (pid + 1) * per
+    scores = []
+    for x, y in global_batches():
+        st.fit(x[lo:hi], y[lo:hi])
+        scores.append(st.score())
+
+    if pid == 0:
+        # gather this process's addressable view: params replicated over data
+        # and model-sharded within local devices -> process 0 addresses a full
+        # copy of every param
+        flat = []
+        for layer in st._carry[0]:
+            for k in sorted(layer):
+                a = layer[k]
+                full = np.zeros(a.shape, np.float64)
+                for s in a.addressable_shards:
+                    full[s.index] = np.asarray(s.data)
+                flat.append(full.ravel())
+        np.savez(out_path, params=np.concatenate(flat),
+                 scores=np.asarray(scores))
+    print(f"sharded worker {pid} done score={scores[-1]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -7,23 +7,12 @@ exact loss/param parity against a single-process 8-device run of the same global
 """
 import json
 import os
-import socket
-import subprocess
 import sys
-import tempfile
 
 import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-
-
-def _free_port():
-    s = socket.socket()
-    s.bind(("localhost", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
 
 
 def _single_process_reference(mode):
@@ -50,22 +39,9 @@ def _single_process_reference(mode):
 
 
 def _run_cluster(mode):
-    port = _free_port()
-    out = os.path.join(tempfile.mkdtemp(), "result.npz")
-    procs = []
-    env = dict(os.environ)
-    env.pop("XLA_FLAGS", None)
-    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
-    for pid in range(2):
-        procs.append(subprocess.Popen(
-            [sys.executable, os.path.join(REPO, "tests", "_dist_worker.py"),
-             mode, str(pid), "2", str(port), out],
-            env=env, cwd=REPO, stdout=subprocess.PIPE, stderr=subprocess.STDOUT))
-    logs = []
-    for p in procs:
-        stdout, _ = p.communicate(timeout=600)
-        logs.append(stdout.decode(errors="replace"))
-        assert p.returncode == 0, f"worker failed:\n{logs[-1][-3000:]}"
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    from _cluster_utils import run_cluster
+    out, _logs = run_cluster("_dist_worker.py", [mode])
     data = np.load(out)
     return data["params"], float(data["score"])
 

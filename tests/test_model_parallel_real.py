@@ -256,3 +256,35 @@ class TestAutoShardPolicy:
         st = ShardedTrainer.Builder(net).mesh(mesh).build()
         losses = st.fit_on_device(x, y, steps=3)
         assert np.isfinite(losses).all()
+
+
+class TestMultiHostSharded:
+    """2 REAL processes x 4 virtual devices: dp over processes, Megatron tp
+    within each process — parity vs the same steps on one process's 8-device
+    mesh (the reference's local[N]-vs-cluster strategy, SURVEY §4.5)."""
+
+    def test_two_process_dp_tp_parity(self):
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        sys.path.insert(0, os.path.join(repo, "tests"))
+        from _cluster_utils import run_cluster
+        out, _logs = run_cluster("_sharded_worker.py", [])
+        cluster = np.load(out)
+
+        # single-process oracle: same global batches on an 8-device mesh
+        sys.path.insert(0, os.path.join(repo, "tests"))
+        import _sharded_worker as w
+        net = w.build_net()
+        st = ShardedTrainer.Builder(net).mesh(mesh_2d()).build()
+        scores = []
+        for x, y in w.global_batches():
+            st.fit(x, y)
+            scores.append(st.score())
+        np.testing.assert_allclose(cluster["scores"], scores, rtol=1e-9)
+        flat = []
+        for layer in st._carry[0]:
+            for k in sorted(layer):
+                flat.append(np.asarray(layer[k], np.float64).ravel())
+        np.testing.assert_allclose(cluster["params"], np.concatenate(flat),
+                                   atol=1e-10)
