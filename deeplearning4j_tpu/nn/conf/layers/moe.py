@@ -1,0 +1,97 @@
+"""Mixture-of-Experts layer: Switch-style top-1 routing as a FRAMEWORK layer.
+
+No reference counterpart (the reference predates MoE); this is the round-3
+promotion of the standalone ExpertParallelMoE demo
+(parallel/expert_parallel.py) into a real layer that composes with configs,
+serialization, updaters, and ShardedTrainer — auto_shard_specs shards the
+expert dimension over the 'model' mesh axis, which IS expert parallelism
+(each device owns num_experts/|model| experts; the einsum dispatch/combine
+becomes the all-to-all under GSPMD).
+
+TPU-first dispatch (the Switch Transformer recipe): tokens route top-1 with a
+bounded per-expert capacity C = ceil(batch/E * capacity_factor); dispatch and
+combine are dense one-hot einsums (static shapes, MXU-batched), overflowing
+tokens pass through unchanged (residual drop). The load-balancing auxiliary
+loss (Switch eq. 4: E * sum_e fraction_e * mean_prob_e) reaches the training
+loss through the "__aux_loss__" state seam in MultiLayerNetwork._loss_fn /
+ComputationGraph._loss_fn.
+"""
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.common.enums import Activation
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import (
+    FeedForwardLayerConf, register_layer)
+
+
+@register_layer
+@dataclass
+class MixtureOfExperts(FeedForwardLayerConf):
+    """Top-1 routed expert FFN bank over 2-D activations (batch, features)."""
+    num_experts: int = 4
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 1e-2
+    router_noise: float = 1e-2  # train-time logit jitter (exploration)
+    activation: Activation = Activation.RELU
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        kg, kw = jax.random.split(key)
+        E, n_in, n_out = self.num_experts, self.n_in, self.n_out
+        p = {"W": self._winit(kg, (n_in, E), n_in, E, dtype)}  # router gate
+        p["w_experts"] = self._winit(kw, (E, n_in, n_out), n_in, n_out, dtype)
+        p["b"] = jnp.full((E, n_out), self.bias_init, dtype)
+        return p
+
+    def init_state(self, input_type, dtype=jnp.float32):
+        return {"__aux_loss__": jnp.zeros((), dtype)}
+
+    def get_output_type(self, input_type):
+        return InputType.feed_forward(self.n_out)
+
+    def _capacity(self, batch: int) -> int:
+        return max(1, int(math.ceil(batch / self.num_experts
+                                    * self.capacity_factor)))
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        if x.ndim != 2:
+            raise ValueError("MixtureOfExperts expects (batch, features) input")
+        E = self.num_experts
+        B = x.shape[0]
+        C = self._capacity(B)
+        logits = x @ params["W"]                                  # (B, E)
+        if train and rng is not None and self.router_noise > 0:
+            logits = logits + self.router_noise * \
+                jax.random.normal(rng, logits.shape, logits.dtype)
+        probs = jax.nn.softmax(logits, axis=-1)
+        expert = jnp.argmax(probs, axis=-1)                       # (B,)
+        gate = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
+        onehot_e = jax.nn.one_hot(expert, E, dtype=x.dtype)       # (B, E)
+        # position of each token in its expert's queue; overflow drops
+        # 0-based queue position within the assigned expert (zeros elsewhere,
+        # so the row-sum extracts exactly this token's slot)
+        pos = (jnp.cumsum(onehot_e, axis=0) - 1.0) * onehot_e     # (B, E)
+        slot = jnp.sum(pos, axis=-1).astype(jnp.int32)            # (B,)
+        keep = slot < C
+        dispatch = (onehot_e[:, :, None]
+                    * jax.nn.one_hot(jnp.clip(slot, 0, C - 1), C, dtype=x.dtype)
+                    [:, None, :]) * keep[:, None, None]           # (B, E, C)
+        xin = jnp.einsum("bec,bi->eci", dispatch, x)              # (E, C, n_in)
+        h = self._act(jnp.einsum("eci,eio->eco", xin, params["w_experts"])
+                      + params["b"][:, None, :])                  # (E, C, n_out)
+        out = jnp.einsum("bec,eco->bo", dispatch * gate[:, None, None], h)
+        # overflowed/undispatched tokens pass through when shapes allow
+        if self.n_in == self.n_out:
+            routed = jnp.sum(dispatch, axis=(1, 2))               # (B,)
+            out = out + (1.0 - routed)[:, None] * x
+        # Switch load-balance loss: E * sum_e (token fraction_e * mean prob_e)
+        frac = jnp.mean(onehot_e, axis=0)
+        mean_prob = jnp.mean(probs, axis=0)
+        aux = self.aux_loss_weight * E * jnp.sum(frac * mean_prob)
+        new_state = {"__aux_loss__": jnp.where(train, aux, 0.0).astype(x.dtype)}
+        return out, new_state, mask

@@ -256,7 +256,11 @@ class ComputationGraph:
             final_rnn = None
         reg = sum((self.conf.nodes[n].conf.regularization_score(p)
                    for n, p in zip(self.layer_names, params_tree)), jnp.asarray(0.0))
-        return loss + reg, (new_states, final_rnn)
+        # aux-loss seam (see MultiLayerNetwork._loss_fn): e.g. MoE load balancing
+        aux = sum((jnp.sum(ns["__aux_loss__"]) for ns in new_states
+                   if isinstance(ns, dict) and "__aux_loss__" in ns),
+                  jnp.asarray(0.0))
+        return loss + reg + aux, (new_states, final_rnn)
 
     # ------------------------------------------------------------- training
     def _build_train_step(self):

@@ -314,7 +314,13 @@ class MultiLayerNetwork:
         new_states.append(state_tree[-1])
         reg = sum((layer.regularization_score(p)
                    for layer, p in zip(self.layers, params_full)), jnp.asarray(0.0))
-        return loss + reg, (new_states, final_rnn)
+        # auxiliary-loss seam: layers that contribute a data-dependent loss
+        # term (MixtureOfExperts load balancing) publish it in their new state
+        # under "__aux_loss__"
+        aux = sum((jnp.sum(ns["__aux_loss__"]) for ns in new_states
+                   if isinstance(ns, dict) and "__aux_loss__" in ns),
+                  jnp.asarray(0.0))
+        return loss + reg + aux, (new_states, final_rnn)
 
     # ------------------------------------------------------------- training
     def _build_train_step(self):

@@ -98,6 +98,13 @@ def auto_shard_specs(layers, model_axis: str = "model",
             if fits(layer.n_out):
                 s["W"] = (None, model_axis)
                 s["RW"] = (None, model_axis)
+        elif type(layer).__name__ == "MixtureOfExperts":
+            # EXPERT parallelism: shard the expert bank over the model axis —
+            # each device owns E/|model| experts; GSPMD turns the dispatch/
+            # combine einsums into the all-to-all
+            if fits(layer.num_experts):
+                s["w_experts"] = (model_axis, None, None)
+                s["b"] = (model_axis, None)
         elif isinstance(layer, (DepthwiseConvolutionLayer,
                                 SeparableConvolution2D)):
             pass  # grouped kernels: leave replicated

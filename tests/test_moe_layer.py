@@ -1,0 +1,152 @@
+"""MixtureOfExperts as a framework layer (the round-3 promotion of the
+ExpertParallelMoE demo): configs/serialization/updaters compose, the Switch
+load-balance loss reaches training through the __aux_loss__ seam, and
+ShardedTrainer shards the expert bank over the 'model' axis (expert
+parallelism) with fp64 loss parity."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.common.enums import Activation, LossFunction
+from deeplearning4j_tpu.nn.conf.configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.feedforward import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.conf.layers.moe import MixtureOfExperts
+from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.nn.updater.updaters import Adam
+from deeplearning4j_tpu.parallel import ShardedTrainer, make_mesh
+
+
+def moe_net(seed=5, experts=4, aux=1e-2):
+    conf = (NeuralNetConfiguration.Builder().seed(seed).dtype("float64")
+            .updater(Adam(learning_rate=5e-3)).list()
+            .layer(DenseLayer(n_in=10, n_out=16, activation=Activation.TANH))
+            .layer(MixtureOfExperts(n_out=16, num_experts=experts,
+                                    aux_loss_weight=aux,
+                                    activation=Activation.RELU))
+            .layer(OutputLayer(n_out=3, loss_fn=LossFunction.MCXENT))
+            .set_input_type(InputType.feed_forward(10))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def data(n=64, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.randn(n, 10).astype(np.float64)
+    y = np.eye(3)[rng.randint(0, 3, n)].astype(np.float64)
+    return x, y
+
+
+def test_moe_trains_and_aux_loss_flows():
+    net = moe_net()
+    x, y = data()
+    losses = net.fit_on_device(x, y, steps=60)
+    assert losses[-1] < losses[0]
+    # the state seam carried a positive balance term during training
+    aux = float(net.state_tree[1]["__aux_loss__"])
+    assert aux > 0.0
+
+
+def test_moe_capacity_and_passthrough():
+    layer = MixtureOfExperts(n_in=8, n_out=8, num_experts=2,
+                             capacity_factor=0.5)
+    import jax
+    import jax.numpy as jnp
+    params = layer.init_params(jax.random.PRNGKey(0),
+                               InputType.feed_forward(8), jnp.float64)
+    x = jnp.asarray(np.random.RandomState(0).randn(16, 8))
+    out, ns, _ = layer.forward(params, layer.init_state(None), x,
+                               train=False, rng=None)
+    assert out.shape == (16, 8)
+    assert float(ns["__aux_loss__"]) == 0.0  # eval mode contributes nothing
+    # capacity 0.5 -> at most ceil(16/2*0.5)=4 tokens per expert are routed;
+    # overflowing tokens pass through (out == x where undispatched)
+    assert np.isfinite(np.asarray(out)).all()
+
+
+def test_moe_conf_json_roundtrip():
+    net = moe_net()
+    from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+    conf2 = MultiLayerConfiguration.from_json(net.conf.to_json())
+    layer = conf2.layers[1]
+    assert type(layer).__name__ == "MixtureOfExperts"
+    assert layer.num_experts == 4
+    net2 = MultiLayerNetwork(conf2).init()
+    assert net2.params_tree[1]["w_experts"].shape == (4, 16, 16)
+
+
+def test_moe_expert_parallel_sharding_and_parity():
+    x, y = data(16)
+    net0 = moe_net(seed=9)
+    ref = [float(net0.fit_on_device(x, y, steps=1)[0]) for _ in range(4)]
+    net1 = moe_net(seed=9)
+    mesh = make_mesh(8, axes=("data", "model"), shape=(2, 4))
+    st = ShardedTrainer.Builder(net1).mesh(mesh).build()
+    assert st.shard_specs()[1]["w_experts"] == ("model", None, None)
+    got = [float(st.fit_on_device(x, y, steps=1)[0]) for _ in range(4)]
+    np.testing.assert_allclose(got, ref, rtol=1e-9)
+
+
+def test_moe_routing_matches_per_token_oracle():
+    """Independent oracle: each within-capacity token must get exactly
+    gate * act(x @ W_e + b_e) for ITS argmax expert — and must not be
+    affected by other tokens (dispatch slots must not collide)."""
+    import jax
+    import jax.numpy as jnp
+    layer = MixtureOfExperts(n_in=6, n_out=5, num_experts=3,
+                             capacity_factor=4.0,  # ample: nobody drops
+                             activation=Activation.RELU)
+    params = layer.init_params(jax.random.PRNGKey(3),
+                               InputType.feed_forward(6), jnp.float64)
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(12, 6))
+    out, _, _ = layer.forward(params, layer.init_state(None), x,
+                              train=False, rng=None)
+    logits = np.asarray(x @ params["W"])
+    probs = np.exp(logits - logits.max(1, keepdims=True))
+    probs /= probs.sum(1, keepdims=True)
+    for b in range(12):
+        e = int(probs[b].argmax())
+        gate = probs[b, e]
+        expect = gate * np.maximum(
+            np.asarray(x)[b] @ np.asarray(params["w_experts"][e])
+            + np.asarray(params["b"][e]), 0.0)
+        np.testing.assert_allclose(np.asarray(out)[b], expect, atol=1e-9,
+                                   err_msg=f"token {b} expert {e}")
+
+
+def test_moe_capacity_bound_enforced():
+    """At most ceil(B/E * cf) tokens reach any expert; overflow passes
+    through unchanged (n_in == n_out)."""
+    import jax
+    import jax.numpy as jnp
+    layer = MixtureOfExperts(n_in=6, n_out=6, num_experts=2,
+                             capacity_factor=0.25,  # C = ceil(16/2*0.25) = 2
+                             activation=Activation.IDENTITY)
+    params = layer.init_params(jax.random.PRNGKey(0),
+                               InputType.feed_forward(6), jnp.float64)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 6))
+    out, _, _ = layer.forward(params, layer.init_state(None), x,
+                              train=False, rng=None)
+    logits = np.asarray(x @ params["W"])
+    expert = logits.argmax(1)
+    counts = {0: 0, 1: 0}
+    for b in range(16):
+        e = int(expert[b])
+        within = counts[e] < 2
+        counts[e] += 1
+        if not within:  # overflowed -> identity passthrough
+            np.testing.assert_allclose(np.asarray(out)[b], np.asarray(x)[b],
+                                       atol=1e-12,
+                                       err_msg=f"token {b} should pass through")
+
+
+def test_moe_rejects_sequence_input():
+    layer = MixtureOfExperts(n_in=4, n_out=4, num_experts=2)
+    import jax
+    import jax.numpy as jnp
+    params = layer.init_params(jax.random.PRNGKey(0),
+                               InputType.feed_forward(4), jnp.float64)
+    with pytest.raises(ValueError, match="batch, features"):
+        layer.forward(params, layer.init_state(None),
+                      jnp.zeros((2, 4, 6)), train=False)
