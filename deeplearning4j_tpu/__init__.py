@@ -18,6 +18,7 @@ from deeplearning4j_tpu.nn.conf.layers.convolutional import (
     DepthwiseConvolutionLayer, GlobalPoolingLayer, SeparableConvolution2D,
     SpaceToDepthLayer, Subsampling1DLayer, SubsamplingLayer, Upsampling2D,
     ZeroPaddingLayer)
+from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
 from deeplearning4j_tpu.nn.conf.layers.moe import MixtureOfExperts
 from deeplearning4j_tpu.nn.conf.layers.normalization import (
     BatchNormalization, LocalResponseNormalization)

@@ -1,0 +1,87 @@
+"""Multi-head self-attention over recurrent streams.
+
+Beyond-reference (the 2017 reference predates attention entirely — SURVEY §5
+long-context: "no attention layer at all"); this is the long-context primitive
+the TPU framework adds: a layer over the framework's recurrent activation
+layout (batch, size, time) that composes with configs, masking, serialization,
+and ShardedTrainer. Context parallelism comes in two forms:
+
+- GSPMD: ShardedTrainer.Builder().sequence_axis("seq") shards the TIME
+  dimension of recurrent inputs over a mesh axis; the attention einsums then
+  partition across chips with XLA inserting the collectives (correct for
+  causal + masked attention — softmax normalizers reduce over the sharded
+  axis).
+- hand-scheduled: parallel/sequence_parallel.py's ring_attention (k/v blocks
+  rotating via ppermute with online softmax) remains the explicitly-scheduled
+  alternative for very long sequences.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers.base import (
+    FeedForwardLayerConf, register_layer)
+from deeplearning4j_tpu.parallel.sequence_parallel import NEG_INF as _NEG_INF
+
+
+@register_layer
+@dataclass
+class SelfAttentionLayer(FeedForwardLayerConf):
+    """(batch, n_in, time) -> (batch, n_out, time); n_out % n_heads == 0.
+    Pre-softmax masking drops padded timesteps (the framework's (batch, time)
+    feature masks); `causal` gives autoregressive attention."""
+    n_heads: int = 4
+    causal: bool = False
+
+    def set_n_in(self, input_type, override=False):
+        if self.n_in == 0 or override:
+            self.n_in = input_type.size
+        if self.n_out == 0:
+            self.n_out = self.n_in
+
+    def get_output_type(self, input_type):
+        return InputType.recurrent(self.n_out,
+                                   getattr(input_type, "timeseries_length", -1))
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        if self.n_out % self.n_heads != 0:
+            raise ValueError(f"n_out {self.n_out} % n_heads {self.n_heads} != 0")
+        kq, kk, kv, ko = jax.random.split(key, 4)
+        shape = (self.n_in, self.n_out)
+        w = lambda k: self._winit(k, shape, self.n_in, self.n_out, dtype)
+        return {"w_q": w(kq), "w_k": w(kk), "w_v": w(kv),
+                "w_o": self._winit(ko, (self.n_out, self.n_out), self.n_out,
+                                   self.n_out, dtype),
+                "b": jnp.full((self.n_out,), self.bias_init, dtype)}
+
+    def forward(self, params, state, x, *, train, rng=None, mask=None):
+        if x.ndim != 3:
+            raise ValueError("SelfAttentionLayer expects (batch, size, time)")
+        B, _, T = x.shape
+        H = self.n_heads
+        Dh = self.n_out // H
+        xt = jnp.swapaxes(x, 1, 2)                       # (B, T, n_in)
+
+        def heads(w):
+            return jnp.reshape(xt @ w, (B, T, H, Dh)).transpose(0, 2, 1, 3)
+
+        q, k, v = heads(params["w_q"]), heads(params["w_k"]), heads(params["w_v"])
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(Dh)
+        if self.causal:
+            scores = jnp.where(jnp.tril(jnp.ones((T, T), bool)), scores,
+                               _NEG_INF)
+        if mask is not None:  # (B, T) padding mask: keys at padded steps drop
+            scores = jnp.where(mask[:, None, None, :] > 0, scores, _NEG_INF)
+        attn = jax.nn.softmax(scores, axis=-1)
+        out = jnp.einsum("bhqk,bhkv->bhqv", attn, v)     # (B, H, T, Dh)
+        out = out.transpose(0, 2, 1, 3).reshape(B, T, self.n_out)
+        out = out @ params["w_o"] + params["b"]
+        out = self._act(out)
+        if mask is not None:  # zero padded query positions like RnnOutputLayer
+            out = out * mask[:, :, None].astype(out.dtype)
+        return jnp.swapaxes(out, 1, 2), state, mask

@@ -161,13 +161,21 @@ class ShardedTrainer:
 
     def __init__(self, model, mesh: Mesh, data_axis: str = "data",
                  model_axis: str = "model", auto_shard: bool = True,
+                 sequence_axis: Optional[str] = None,
                  layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None):
         if data_axis not in mesh.axis_names:
             raise ValueError(f"mesh has no data axis {data_axis!r}: {mesh}")
+        if sequence_axis is not None and sequence_axis not in mesh.axis_names:
+            raise ValueError(f"mesh has no sequence axis {sequence_axis!r}")
         self.net = model
         self.mesh = mesh
         self.data_axis = data_axis
         self.model_axis = model_axis
+        # context parallelism: shard the TIME dim (axis 2 of the framework's
+        # recurrent (batch, size, time) layout); GSPMD partitions the
+        # attention/elementwise work and inserts the softmax-normalizer
+        # collectives (module docstring of nn/conf/layers/attention.py)
+        self.sequence_axis = sequence_axis
         has_model = model_axis in mesh.axis_names
         model._check_init()
         if auto_shard and has_model:
@@ -275,8 +283,10 @@ class ShardedTrainer:
         multi = isinstance(net, ComputationGraph)
 
         def put(a):
-            sh = NamedSharding(self.mesh,
-                               P(self.data_axis, *([None] * (np.ndim(a) - 1))))
+            dims = [None] * (np.ndim(a) - 1)
+            if self.sequence_axis is not None and np.ndim(a) == 3:
+                dims[1] = self.sequence_axis  # (batch, size, TIME)
+            sh = NamedSharding(self.mesh, P(self.data_axis, *dims))
             if jax.process_count() == 1:
                 return jax.device_put(jnp.asarray(a, net.dtype), sh)
             return jax.make_array_from_process_local_data(
@@ -419,6 +429,12 @@ class ShardedTrainer:
 
         def model_axis(self, name: str):
             self._kw["model_axis"] = name
+            return self
+
+        def sequence_axis(self, name: str):
+            """Shard the time dimension of recurrent inputs over this mesh
+            axis (context parallelism for attention nets)."""
+            self._kw["sequence_axis"] = name
             return self
 
         def auto_shard(self, b: bool):
