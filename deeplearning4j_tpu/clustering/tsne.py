@@ -86,7 +86,10 @@ def _tsne_loop(P, y0, learning_rate, momentum_start, momentum_final,
         Q = jnp.maximum(num / jnp.sum(num), 1e-12)
         PQ = (P_eff - Q) * num              # (N,N)
         g = 4.0 * ((jnp.diag(jnp.sum(PQ, axis=1)) - PQ) @ y)
-        kl = jnp.sum(P_eff * jnp.log(jnp.maximum(P_eff, 1e-12) / Q))
+        # report KL from the UN-exaggerated P (P_eff drives only the
+        # gradient) — keeps kl_history a real KL(P||Q), comparable across the
+        # exact and grid paths and across the exaggeration boundary
+        kl = jnp.sum(P * jnp.log(jnp.maximum(P, 1e-12) / Q))
         return g, kl
 
     def body(carry, it):
@@ -193,8 +196,11 @@ def _tsne_loop_grid(rows, cols, pvals, y0, learning_rate, momentum_start,
         pe = pvals * exag
         f_attr = jnp.zeros_like(y).at[rows].add((pe * enum)[:, None] * dy)
         g = 4.0 * (f_attr - f_rep / Z)
-        kl = jnp.sum(pe * jnp.log(jnp.maximum(pe, 1e-12)
-                                  / jnp.maximum(enum / Z, 1e-12)))
+        # report KL from the UN-exaggerated P (pe drives only the gradient):
+        # exaggerated-P "KL" is inflated by ~4*log(4) terms during early
+        # exaggeration and is not comparable to the exact path's history
+        kl = jnp.sum(pvals * jnp.log(jnp.maximum(pvals, 1e-12)
+                                     / jnp.maximum(enum / Z, 1e-12)))
         return g, kl
 
     def body(carry, it):

@@ -71,13 +71,18 @@ class MixtureOfExperts(FeedForwardLayerConf):
         probs = jax.nn.softmax(logits, axis=-1)
         expert = jnp.argmax(probs, axis=-1)                       # (B,)
         gate = jnp.take_along_axis(probs, expert[:, None], 1)[:, 0]
-        onehot_e = jax.nn.one_hot(expert, E, dtype=x.dtype)       # (B, E)
+        # routing bookkeeping stays exact int32 regardless of activation
+        # dtype: a bf16 cumsum is inexact past 256 tokens per expert and
+        # silently misroutes (ADVICE r3 medium#2); only the dispatch tensor
+        # that feeds the einsum is cast to x.dtype
+        onehot_i = jax.nn.one_hot(expert, E, dtype=jnp.int32)     # (B, E)
         # position of each token in its expert's queue; overflow drops
         # 0-based queue position within the assigned expert (zeros elsewhere,
         # so the row-sum extracts exactly this token's slot)
-        pos = (jnp.cumsum(onehot_e, axis=0) - 1.0) * onehot_e     # (B, E)
-        slot = jnp.sum(pos, axis=-1).astype(jnp.int32)            # (B,)
+        pos = (jnp.cumsum(onehot_i, axis=0) - 1) * onehot_i       # (B, E)
+        slot = jnp.sum(pos, axis=-1)                              # (B,) int32
         keep = slot < C
+        onehot_e = onehot_i.astype(x.dtype)
         dispatch = (onehot_e[:, :, None]
                     * jax.nn.one_hot(jnp.clip(slot, 0, C - 1), C, dtype=x.dtype)
                     [:, None, :]) * keep[:, None, None]           # (B, E, C)
@@ -90,8 +95,10 @@ class MixtureOfExperts(FeedForwardLayerConf):
             routed = jnp.sum(dispatch, axis=(1, 2))               # (B,)
             out = out + (1.0 - routed)[:, None] * x
         # Switch load-balance loss: E * sum_e (token fraction_e * mean prob_e)
-        frac = jnp.mean(onehot_e, axis=0)
-        mean_prob = jnp.mean(probs, axis=0)
+        # (accumulated fp32: a bf16 mean over large B loses the small
+        # per-expert fractions the loss exists to balance)
+        frac = jnp.mean(onehot_i.astype(jnp.float32), axis=0)
+        mean_prob = jnp.mean(probs.astype(jnp.float32), axis=0)
         aux = self.aux_loss_weight * E * jnp.sum(frac * mean_prob)
         new_state = {"__aux_loss__": jnp.where(train, aux, 0.0).astype(x.dtype)}
         return out, new_state, mask

@@ -361,16 +361,30 @@ class PipelinedTrainer:
     # -------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):
         from deeplearning4j_tpu.datasets.dataset import DataSet
+
+        def check_no_masks(ds):
+            # fail loudly instead of silently training on padding
+            # (ADVICE r3 medium#1): the pipelined step has no mask path
+            from deeplearning4j_tpu.parallel.sharded import _ds_masks
+            if any(m is not None for m in _ds_masks(ds)):
+                raise ValueError(
+                    "PipelinedTrainer does not support feature/label masks; "
+                    "use MultiLayerNetwork.fit or ShardedTrainer (which "
+                    "plumbs masks) for masked sequence batches")
+            return ds
+
         self._ensure_setup()
         if labels is not None:
             self._fit_one(data, labels)
         elif isinstance(data, DataSet):
+            check_no_masks(data)
             self._fit_one(data.features, data.labels)
         else:
             for _ in range(epochs):
                 if hasattr(data, "reset"):
                     data.reset()
                 for ds in data:
+                    check_no_masks(ds)
                     self._fit_one(ds.features, ds.labels)
         self.write_back()
         return self

@@ -49,6 +49,18 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 
+def _ds_masks(ds):
+    """(features_mask, labels_mask) from a DataSet or MultiDataSet (which uses
+    the plural names), None-safe."""
+    fm = getattr(ds, "features_mask", None)
+    if fm is None:
+        fm = getattr(ds, "features_masks", None)
+    lm = getattr(ds, "labels_mask", None)
+    if lm is None:
+        lm = getattr(ds, "labels_masks", None)
+    return fm, lm
+
+
 def _spec(entry) -> P:
     """('model', None) / ['model', None] / P(...) -> PartitionSpec."""
     if entry is None:
@@ -274,29 +286,50 @@ class ShardedTrainer:
         self._host_step = net._step
         self._build_step()
 
-    def _place_batch(self, x, y):
+    def _place_batch(self, x, y, fmask=None, lmask=None):
         """Batch sharded over the data axis, replicated over model/pipe axes.
-        Multi-host: each process passes its LOCAL rows; the global batch is
-        their concatenation along the data axis (jax.distributed layout)."""
+        Masks ((batch, time)) shard like their data: dim 0 on the data axis,
+        dim 1 on the sequence axis when context parallelism is on. Multi-host:
+        each process passes its LOCAL rows; the global batch is their
+        concatenation along the data axis (jax.distributed layout)."""
         net = self.net
         from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
         multi = isinstance(net, ComputationGraph)
 
-        def put(a):
+        def put(a, is_mask=False):
             dims = [None] * (np.ndim(a) - 1)
-            if self.sequence_axis is not None and np.ndim(a) == 3:
+            if self.sequence_axis is not None and not is_mask \
+                    and np.ndim(a) == 3:
                 dims[1] = self.sequence_axis  # (batch, size, TIME)
+            if self.sequence_axis is not None and is_mask and np.ndim(a) == 2 \
+                    and np.shape(a)[1] > 1 \
+                    and np.shape(a)[1] % self.mesh.shape[self.sequence_axis] == 0:
+                # only a MASK's dim 1 is time; 2-D features/labels keep their
+                # feature dim replicated (a (B, classes) y must not be
+                # context-sharded). Per-example (B, 1) masks and times not
+                # divisible by the seq axis stay replicated — sharding is a
+                # layout hint, GSPMD reshards as needed, so correctness is
+                # unaffected
+                dims[0] = self.sequence_axis  # mask (batch, TIME)
             sh = NamedSharding(self.mesh, P(self.data_axis, *dims))
             if jax.process_count() == 1:
                 return jax.device_put(jnp.asarray(a, net.dtype), sh)
             return jax.make_array_from_process_local_data(
                 sh, np.asarray(a, net.dtype))
 
+        def put_opt(a):
+            if a is None:
+                return None
+            if isinstance(a, (list, tuple)):
+                return tuple(None if v is None else put(v, is_mask=True)
+                             for v in a)
+            return put(a, is_mask=True)
+
         if multi:
             xs = tuple(put(v) for v in (x if isinstance(x, (list, tuple)) else [x]))
             ys = tuple(put(v) for v in (y if isinstance(y, (list, tuple)) else [y]))
-            return xs, ys
-        return put(x), put(y)
+            return xs, ys, put_opt(fmask), put_opt(lmask)
+        return put(x), put(y), put_opt(fmask), put_opt(lmask)
 
     def _build_step(self):
         net = self.net
@@ -304,12 +337,12 @@ class ShardedTrainer:
         updaters = net._updaters
         layers = net.layers
 
-        def step_fn(carry, rng, x, y):
+        def step_fn(carry, rng, x, y, fmask, lmask):
             params, opt, states, step = carry
 
             def loss_fn(p):
-                loss, (ns, _) = net._loss_fn(p, states, x, y, None, None, rng,
-                                             True, None)
+                loss, (ns, _) = net._loss_fn(p, states, x, y, fmask, lmask,
+                                             rng, True, None)
                 return loss, ns
 
             (loss, new_states), grads = jax.value_and_grad(
@@ -326,11 +359,11 @@ class ShardedTrainer:
 
         @functools.partial(jax.jit, donate_argnums=(0,), static_argnames=("n",),
                            out_shardings=(carry_sh, rep))
-        def scan_run(carry, rng, x, y, n):
+        def scan_run(carry, rng, x, y, fmask, lmask, n):
             def body(c, _):
                 carry_c, rng_c = c
                 rng_c, sub = jax.random.split(rng_c)
-                new_carry, loss = step_fn(carry_c, sub, x, y)
+                new_carry, loss = step_fn(carry_c, sub, x, y, fmask, lmask)
                 return (new_carry, rng_c), loss
 
             (carry, _), losses = jax.lax.scan(body, (carry, rng), None, length=n)
@@ -340,41 +373,45 @@ class ShardedTrainer:
 
     # -------------------------------------------------------------------- fit
     def fit(self, data, labels=None, epochs: int = 1):
-        """fit(x, y) | fit(DataSet/MultiDataSet) | fit(iterator[, epochs])."""
+        """fit(x, y) | fit(DataSet/MultiDataSet) | fit(iterator[, epochs]).
+        Feature/label masks on a DataSet/MultiDataSet are honored: they are
+        batch-sharded like the data and reach the loss, matching
+        MultiLayerNetwork.fit semantics (ADVICE r3 medium#1)."""
         from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
         self._ensure_setup()
         if labels is not None:
             self._fit_one(data, labels)
         elif isinstance(data, (DataSet, MultiDataSet)):
-            self._fit_one(data.features, data.labels)
+            self._fit_one(data.features, data.labels, *_ds_masks(data))
         else:
             for _ in range(epochs):
                 if hasattr(data, "reset"):
                     data.reset()
                 for ds in data:
-                    self._fit_one(ds.features, ds.labels)
+                    self._fit_one(ds.features, ds.labels, *_ds_masks(ds))
         self.write_back()
         return self
 
-    def _fit_one(self, x, y):
+    def _fit_one(self, x, y, fmask=None, lmask=None):
         self._ensure_setup()
         net = self.net
-        x, y = self._place_batch(x, y)
+        x, y, fmask, lmask = self._place_batch(x, y, fmask, lmask)
         net._rng, sub = jax.random.split(net._rng)
-        self._carry, loss = self._step_fn(self._carry, sub, x, y)
+        self._carry, loss = self._step_fn(self._carry, sub, x, y, fmask, lmask)
         self._score = loss
         self._host_step += 1
         for lst in self._listeners:
             lst.iteration_done(self, self._host_step)
 
-    def fit_on_device(self, x, y, steps: int):
+    def fit_on_device(self, x, y, steps: int, fmask=None, lmask=None):
         """`steps` sharded training steps as ONE jitted lax.scan (same batch each
         step — benchmark/epoch-runner mode; no per-step host dispatch)."""
         self._ensure_setup()
         net = self.net
-        x, y = self._place_batch(x, y)
+        x, y, fmask, lmask = self._place_batch(x, y, fmask, lmask)
         net._rng, sub = jax.random.split(net._rng)
-        self._carry, losses = self._scan_fn(self._carry, sub, x, y, n=int(steps))
+        self._carry, losses = self._scan_fn(self._carry, sub, x, y, fmask,
+                                            lmask, n=int(steps))
         self._host_step += int(steps)
         # host transfer = synchronization point (timed callers must see real work)
         losses = np.asarray(losses)

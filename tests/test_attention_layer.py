@@ -96,7 +96,7 @@ def test_context_parallel_time_sharding_parity():
     got = [float(st.fit_on_device(x, y, steps=1)[0]) for _ in range(3)]
     np.testing.assert_allclose(got, ref, rtol=1e-9)
     # the batch really is time-sharded on device
-    bx, _ = st._place_batch(x, y)
+    bx, _, _, _ = st._place_batch(x, y)
     from jax.sharding import PartitionSpec as P
     assert bx.sharding.spec == P("data", None, "seq")
 

@@ -150,3 +150,37 @@ def test_moe_rejects_sequence_input():
     with pytest.raises(ValueError, match="batch, features"):
         layer.forward(params, layer.init_state(None),
                       jnp.zeros((2, 4, 6)), train=False)
+
+
+def test_moe_routing_exact_in_bf16_past_256_tokens_per_expert():
+    """Routing bookkeeping must be int32-exact regardless of activation dtype
+    (ADVICE r3 medium#2): a bf16 cumsum plateaus at 256 (257 rounds back to
+    256), colliding queue slots once any expert holds >256 tokens. With
+    IDENTITY expert weights a slot collision sums two tokens into one
+    dispatch cell (xin[e,c] = x_i + x_j), shifting the colliding rows by O(4)
+    — so bf16 forward must match the fp32 forward, which routes exactly."""
+    import jax
+    import jax.numpy as jnp
+    E, B, n = 4, 2048, 8  # 512 tokens/expert >> 256
+    layer = MixtureOfExperts(n_in=n, n_out=n, num_experts=E,
+                             capacity_factor=1.25, router_noise=0.0)
+    # deterministic, well-separated routing: token i -> expert i % E
+    W = np.zeros((n, E), np.float32)
+    W[:E, :E] = np.eye(E) * 10.0
+    w_exp = np.stack([np.eye(n, dtype=np.float32)] * E)
+    rng = np.random.RandomState(0)
+    x = 0.05 * rng.randn(B, n).astype(np.float32)
+    x[np.arange(B), np.arange(B) % E] += 4.0
+    outs = {}
+    for dt in (jnp.bfloat16, jnp.float32):
+        params = {"W": jnp.asarray(W, dt),
+                  "w_experts": jnp.asarray(w_exp, dt),
+                  "b": jnp.zeros((E, n), dt)}
+        out, _, _ = layer.forward(params, layer.init_state(None),
+                                  jnp.asarray(x, dt), train=False, rng=None)
+        outs[dt] = np.asarray(out, np.float32)
+    # capacity C = ceil(2048/4 * 1.25) = 640 >= 512: every token routes; a
+    # collided bf16 slot would sum two +4.0 spikes into one cell (error ~4,
+    # far above bf16 rounding ~0.03)
+    np.testing.assert_allclose(outs[jnp.bfloat16], outs[jnp.float32],
+                               atol=0.15)
