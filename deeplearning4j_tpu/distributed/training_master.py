@@ -288,6 +288,168 @@ class DistributedMultiLayer:
     def score(self):
         return self._wrapper.score() if self._wrapper else float("nan")
 
+    # --------------------------------------------- distributed evaluate/score
+    # (ref SparkDl4jMultiLayer.evaluate + impl/multilayer/scoring/,
+    # SparkComputationGraph evaluate/calculateScore — executors evaluate their
+    # partitions, Evaluation objects merge on the driver. TPU rendering: ONE
+    # mesh-sharded forward per batch — GSPMD splits it over every device of
+    # every process — then a host-side metric merge across processes.)
+    def _batch_sharding(self):
+        mesh = Mesh(np.asarray(jax.devices()), ("data",))
+        return NamedSharding(mesh, P("data"))
+
+    def _shard_eval_batch(self, a, sharding):
+        a = np.asarray(a, self.network.dtype)
+        if jax.process_count() == 1:
+            return jax.device_put(a, sharding)
+        return jax.make_array_from_process_local_data(sharding, a)
+
+    @staticmethod
+    def _local_rows_of(global_arr):
+        """This process's rows of a data-sharded global array, in order."""
+        if jax.process_count() == 1:
+            return np.asarray(global_arr)
+        shards = sorted(global_arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        return np.concatenate([np.asarray(s.data) for s in shards])
+
+    def _ensure_global_params(self):
+        """Promote the net's params/states (committed to one local device
+        after _write_back) to fully-replicated arrays over the global mesh so
+        they can enter one jitted computation together with mesh-sharded eval
+        batches. Replicated globals stay host-readable everywhere."""
+        net = self.network
+        mesh = Mesh(np.asarray(jax.devices()), ("data",))
+        rep = NamedSharding(mesh, P())
+
+        def put(a):
+            if getattr(a, "sharding", None) == rep:
+                return a
+            if jax.process_count() == 1:
+                return jax.device_put(jnp.asarray(a), rep)
+            return jax.make_array_from_process_local_data(
+                rep, np.asarray(a))
+
+        net.params_tree = jax.tree_util.tree_map(put, net.params_tree)
+        net.state_tree = jax.tree_util.tree_map(put, net.state_tree)
+
+    def _eval_forward(self, ds):
+        """Mesh-data-parallel inference on one (Multi)DataSet; returns this
+        process's local output rows plus local labels/mask."""
+        net = self.network
+        sh = self._batch_sharding()
+        feats = ds.features if isinstance(ds.features, (list, tuple)) \
+            else [ds.features]
+        gx = [self._shard_eval_batch(f, sh) for f in feats]
+        out = net.output(*gx) if len(gx) > 1 else net.output(gx[0])
+        if isinstance(out, (list, tuple)):
+            out = out[0]  # single-metric eval uses the first configured output
+        labels = ds.labels[0] if isinstance(ds.labels, (list, tuple)) \
+            else ds.labels
+        from deeplearning4j_tpu.parallel.sharded import _ds_masks
+        _, lmask = _ds_masks(ds)
+        if isinstance(lmask, (list, tuple)):
+            lmask = lmask[0]
+        return self._local_rows_of(out), np.asarray(labels), lmask
+
+    def _merge_across_processes(self, ev):
+        if jax.process_count() == 1 or ev.confusion is None:
+            # (empty iterators are empty on every process: _shard_eval_batch
+            # is a collective, so batch counts must agree SPMD-wise)
+            return ev
+        from jax.experimental import multihost_utils
+        import copy
+        mats = np.asarray(multihost_utils.process_allgather(
+            np.asarray(ev.confusion.matrix, np.int64)))
+        counts = np.asarray(multihost_utils.process_allgather(
+            np.asarray([ev._count, ev._top_n_correct], np.int64)))
+        merged = copy.deepcopy(ev)
+        merged.confusion.matrix = mats.sum(axis=0)
+        merged._count = int(counts[:, 0].sum())
+        merged._top_n_correct = int(counts[:, 1].sum())
+        return merged
+
+    def evaluate(self, iterator, num_classes=None, top_n: int = 1):
+        """Data-parallel classification evaluation over the global mesh with
+        metric merge — parity with single-device MultiLayerNetwork.evaluate
+        (ref SparkDl4jMultiLayer.evaluate)."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        self._ensure_global_params()
+        ev = Evaluation(num_classes, top_n=top_n)
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out, labels, lmask = self._eval_forward(ds)
+            ev.eval(labels, out, mask=lmask)
+        return self._merge_across_processes(ev)
+
+    def evaluate_regression(self, iterator):
+        """(ref SparkDl4jMultiLayer.evaluateRegression)"""
+        from deeplearning4j_tpu.eval.evaluation import RegressionEvaluation
+        self._ensure_global_params()
+        ev = RegressionEvaluation()
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        for ds in iterator:
+            out, labels, lmask = self._eval_forward(ds)
+            ev.eval(labels, out, mask=lmask)
+        if jax.process_count() > 1 and ev._sum_sq_err is not None:
+            from jax.experimental import multihost_utils
+            sums = {f: np.asarray(multihost_utils.process_allgather(
+                getattr(ev, f))).sum(axis=0)
+                for f in ("_sum_sq_err", "_sum_abs_err", "_sum_label",
+                          "_sum_label_sq", "_sum_pred", "_sum_pred_sq",
+                          "_sum_label_pred")}
+            cnt = int(np.asarray(multihost_utils.process_allgather(
+                np.asarray([ev._count], np.int64))).sum())
+            for f, v in sums.items():
+                setattr(ev, f, v)
+            ev._count = cnt
+        return ev
+
+    def calculate_score(self, iterator, average: bool = True) -> float:
+        """Mean (or summed) loss over the iterator, computed data-parallel
+        over the global mesh (ref SparkDl4jMultiLayer.calculateScore /
+        impl/multilayer/scoring). Every process feeds its local shard; the
+        jitted loss is a global mean, so all processes return the same value."""
+        import functools
+        net = self.network
+        self._ensure_global_params()
+        if getattr(self, "_score_jit", None) is None:
+            @functools.partial(jax.jit, static_argnames=())
+            def score_fn(params, states, x, y, fmask, lmask):
+                loss, _ = net._loss_fn(params, states, x, y, fmask, lmask,
+                                       None, False, None)
+                return loss
+            self._score_jit = score_fn
+        sh = self._batch_sharding()
+        total, n = 0.0, 0
+        if hasattr(iterator, "reset"):
+            iterator.reset()
+        from deeplearning4j_tpu.parallel.sharded import _ds_masks
+        for ds in iterator:
+            feats = ds.features
+            multi = isinstance(feats, (list, tuple))
+            gx = tuple(self._shard_eval_batch(f, sh) for f in feats) if multi \
+                else self._shard_eval_batch(feats, sh)
+            ys = ds.labels
+            gy = tuple(self._shard_eval_batch(l, sh) for l in ys) if multi \
+                else self._shard_eval_batch(ys, sh)
+            fm, lm = _ds_masks(ds)
+            put_m = lambda m: None if m is None else (
+                tuple(None if v is None else self._shard_eval_batch(v, sh)
+                      for v in m) if isinstance(m, (list, tuple))
+                else self._shard_eval_batch(m, sh))
+            loss = self._score_jit(net.params_tree, net.state_tree, gx, gy,
+                                   put_m(fm), put_m(lm))
+            b = (gx[0] if multi else gx).shape[0]  # GLOBAL batch rows
+            total += float(loss) * b
+            n += b
+        if n == 0:
+            return float("nan")
+        return total / n if average else total
+    calculateScore = calculate_score
+
     def get_network(self):
         return self.network
     getNetwork = get_network

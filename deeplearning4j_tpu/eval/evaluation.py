@@ -83,6 +83,24 @@ class Evaluation:
             self._top_n_correct += int((predicted == actual).sum())
     evaluate = eval
 
+    def merge(self, other: "Evaluation") -> "Evaluation":
+        """Accumulate another Evaluation's counts into this one — the
+        reduction step of distributed evaluation (ref BaseEvaluation.merge,
+        used by dl4j-spark's evaluate tree-aggregate)."""
+        if other.confusion is None:
+            return self
+        if self.confusion is None:
+            self._ensure(other.num_classes)
+        if self.num_classes != other.num_classes:
+            raise ValueError(
+                f"cannot merge: {self.num_classes} vs {other.num_classes} classes")
+        self.confusion.matrix += other.confusion.matrix
+        self._top_n_correct += other._top_n_correct
+        self._count += other._count
+        if self.record_meta:
+            self._errors.extend(other._errors)
+        return self
+
     # ---- metrics (ref Evaluation accuracy/precision/recall/f1) ----
     def _tp(self, c):
         return self.confusion.matrix[c, c]
@@ -213,6 +231,26 @@ class RegressionEvaluation:
         self._sum_pred_sq += (predictions ** 2).sum(axis=0)
         self._sum_label_pred += (labels * predictions).sum(axis=0)
         self._count += labels.shape[0]
+
+    def merge(self, other: "RegressionEvaluation") -> "RegressionEvaluation":
+        """Sum another RegressionEvaluation's accumulators into this one (ref
+        RegressionEvaluation.merge) — all metrics are ratios of sums, so the
+        merged metrics equal single-pass metrics exactly."""
+        if other._sum_sq_err is None:
+            return self
+        if self._sum_sq_err is None:
+            self.n = other.n
+            for f in ("_sum_sq_err", "_sum_abs_err", "_sum_label",
+                      "_sum_label_sq", "_sum_pred", "_sum_pred_sq",
+                      "_sum_label_pred"):
+                setattr(self, f, np.zeros(self.n))
+        if self.n != other.n:
+            raise ValueError(f"cannot merge: {self.n} vs {other.n} columns")
+        for f in ("_sum_sq_err", "_sum_abs_err", "_sum_label", "_sum_label_sq",
+                  "_sum_pred", "_sum_pred_sq", "_sum_label_pred"):
+            setattr(self, f, getattr(self, f) + getattr(other, f))
+        self._count += other._count
+        return self
 
     def mean_squared_error(self, col: int = 0) -> float:
         return float(self._sum_sq_err[col] / self._count)

@@ -51,12 +51,20 @@ class WordVectorSerializer:
     # ------------------------------------------------------------- read
     @staticmethod
     def read_word_vectors(path: str, binary: Optional[bool] = None) -> WordVectors:
-        """(ref readWord2VecModel — auto-detects binary vs text vs gzipped text,
-        the reference's GzipUtils.isCompressed path)"""
+        """(ref readWord2VecModel — auto-detects fastText .bin vs word2vec
+        binary vs text vs gzipped text, the reference's GzipUtils.isCompressed
+        path + fastText surface)"""
         with open(path, "rb") as f:
-            magic = f.read(2)
+            magic4 = f.read(4)
+        magic = magic4[:2]
         if magic == b"\x1f\x8b":
             return WordVectorSerializer._read_text(path, gzipped=True)
+        if len(magic4) == 4:
+            from deeplearning4j_tpu.nlp.fasttext import FASTTEXT_MAGIC
+            if struct.unpack("<i", magic4)[0] == FASTTEXT_MAGIC:
+                # fastText model file: freeze composed (word + subword)
+                # vectors into the static query API (loadStaticModel analog)
+                return WordVectorSerializer.read_fasttext(path).to_word_vectors()
         if binary is None:
             with open(path, "rb") as f:
                 header = f.readline()
@@ -101,6 +109,30 @@ class WordVectorSerializer:
         return WordVectorSerializer._assemble(vocab, syn0)
 
     read_glove = read_word_vectors  # GloVe text auto-detected (headerless)
+
+    # ------------------------------------------------------------- fastText
+    @staticmethod
+    def read_fasttext(path: str):
+        """Read a fastText model: `.bin` (full model, subword-capable) or
+        `.vec` (text — plain composed vectors). Returns a FastText for .bin,
+        a WordVectors for .vec (ref WordVectorSerializer fastText surface)."""
+        with open(path, "rb") as f:
+            head = f.read(4)
+        from deeplearning4j_tpu.nlp.fasttext import FASTTEXT_MAGIC, FastText
+        if len(head) == 4 and struct.unpack("<i", head)[0] == FASTTEXT_MAGIC:
+            return FastText.load(path)
+        return WordVectorSerializer._read_text(path)
+    readFastText = read_fasttext
+
+    @staticmethod
+    def write_fasttext(model, path: str):
+        """Write a fastText `.bin` model file. Accepts a FastText, or any
+        WordVectors-shaped model (wrapped via FastText.from_word_vectors)."""
+        from deeplearning4j_tpu.nlp.fasttext import FastText
+        if not isinstance(model, FastText):
+            model = FastText.from_word_vectors(model)
+        model.save(path)
+    writeFastText = write_fasttext
 
     @staticmethod
     def _read_binary(path: str) -> WordVectors:

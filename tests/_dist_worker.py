@@ -52,6 +52,13 @@ def global_batches():
         yield x, y
 
 
+def eval_batch():
+    rng = np.random.RandomState(123)
+    x = rng.rand(GLOBAL_BATCH, 5)
+    y = np.eye(3)[rng.randint(0, 3, GLOBAL_BATCH)]
+    return x, y
+
+
 def main():
     from deeplearning4j_tpu.distributed import (
         DistributedMultiLayer, ParameterAveragingTrainingMaster,
@@ -77,10 +84,19 @@ def main():
         net.fit(DataSet(x[lo:hi], y[lo:hi]))
         score = net.score()
 
+    # distributed evaluate/score (ref SparkDl4jMultiLayer.evaluate /
+    # calculateScore): each process feeds its local eval rows; the confusion
+    # matrix merges across processes, the loss is a global mesh mean
+    w = net._wrapper
+    w._write_back()
+    ex, ey = eval_batch()
+    ev = net.evaluate([DataSet(ex[lo:hi], ey[lo:hi])], num_classes=3)
+    eval_score = net.calculate_score([DataSet(ex[lo:hi], ey[lo:hi])])
+
     if pid == 0:
-        w = net._wrapper
-        w._write_back()
-        np.savez(out_path, params=np.asarray(net.network.params()), score=score)
+        np.savez(out_path, params=np.asarray(net.network.params()), score=score,
+                 accuracy=ev.accuracy(), confusion=ev.confusion.matrix,
+                 eval_count=ev._count, eval_score=eval_score)
     print(f"worker {pid} done score={score}")
 
 

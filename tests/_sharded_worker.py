@@ -66,6 +66,10 @@ def main():
         st.fit(x[lo:hi], y[lo:hi])
         scores.append(st.score())
 
+    # multi-host checkpoint: every process joins the gather, process 0 writes
+    # the standard zip (VERDICT r3 missing#4)
+    st.save(out_path + ".model.zip")
+
     if pid == 0:
         # gather this process's addressable view: params replicated over data
         # and model-sharded within local devices -> process 0 addresses a full

@@ -42,17 +42,33 @@ def _run_cluster(mode):
     sys.path.insert(0, os.path.join(REPO, "tests"))
     from _cluster_utils import run_cluster
     out, _logs = run_cluster("_dist_worker.py", [mode])
-    data = np.load(out)
-    return data["params"], float(data["score"])
+    return dict(np.load(out))
 
 
 @pytest.mark.parametrize("mode", ["averaging", "shared_gradients"])
 def test_two_process_cluster_matches_single_process(mode):
-    params_mp, score_mp = _run_cluster(mode)
+    mp = _run_cluster(mode)
     params_sp, score_sp = _single_process_reference(mode)
-    assert np.isfinite(score_mp)
-    assert abs(score_mp - score_sp) < 1e-9
-    assert np.allclose(params_mp, params_sp, atol=1e-12)
+    assert np.isfinite(float(mp["score"]))
+    assert abs(float(mp["score"]) - score_sp) < 1e-9
+    assert np.allclose(mp["params"], params_sp, atol=1e-12)
+    # distributed evaluate/score parity (ref SparkDl4jMultiLayer.evaluate /
+    # calculateScore): the 2-process cluster's merged Evaluation and global
+    # mesh loss must equal a single-process oracle on the full eval batch
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import _dist_worker as w
+    from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    oracle = MultiLayerNetwork(
+        MultiLayerConfiguration.from_json(w.build_conf_json())).init()
+    oracle.set_params(np.asarray(mp["params"]))
+    ex, ey = w.eval_batch()
+    ev_sp = oracle.evaluate([DataSet(ex, ey)])
+    assert int(mp["eval_count"]) == ex.shape[0]
+    assert np.array_equal(mp["confusion"], ev_sp.confusion.matrix)
+    assert abs(float(mp["accuracy"]) - ev_sp.accuracy()) < 1e-12
+    assert abs(float(mp["eval_score"]) - oracle.score(DataSet(ex, ey))) < 1e-9
 
 
 def test_single_process_master_api():
@@ -140,3 +156,105 @@ def test_parameter_server_async_training():
         assert stats["num_params"] == final.num_params()
     finally:
         server.stop()
+
+
+def test_distributed_evaluate_and_score_single_process_mesh():
+    """Mesh-data-parallel evaluate/calculate_score on the 8-device virtual
+    mesh matches plain single-device evaluation exactly (the local[N] analog
+    of SparkDl4jMultiLayer.evaluate / calculateScore)."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import _dist_worker as w
+    from deeplearning4j_tpu.distributed import (
+        DistributedMultiLayer, ParameterAveragingTrainingMaster)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    tm = ParameterAveragingTrainingMaster.Builder(16).build()
+    net = DistributedMultiLayer(w.build_conf_json(), tm)
+    rng = np.random.RandomState(5)
+    x = rng.rand(32, 5)
+    y = np.eye(3)[rng.randint(0, 3, 32)]
+    net.fit(DataSet(x, y))
+    net._wrapper._write_back()
+
+    ex, ey = w.eval_batch()
+    batches = [DataSet(ex[:16], ey[:16]), DataSet(ex[16:], ey[16:])]
+    ev = net.evaluate(batches, num_classes=3)
+    ev_ref = net.network.evaluate(batches)
+    assert np.array_equal(ev.confusion.matrix, ev_ref.confusion.matrix)
+    assert ev.accuracy() == ev_ref.accuracy()
+    assert ev._count == 32
+
+    got = net.calculate_score(batches)
+    ref = np.mean([net.network.score(b) for b in batches])
+    assert abs(got - ref) < 1e-10
+    # summed variant
+    assert abs(net.calculate_score(batches, average=False)
+               - 32 * ref) < 1e-8
+
+
+def test_distributed_evaluate_regression_merge():
+    """evaluateRegression over mesh batches == single-pass regression eval."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import _dist_worker as w
+    from deeplearning4j_tpu.distributed import (
+        DistributedMultiLayer, ParameterAveragingTrainingMaster)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu import (
+        Activation, DenseLayer, InputType, NeuralNetConfiguration,
+        OutputLayer, Sgd, WeightInit)
+    from deeplearning4j_tpu.common.enums import LossFunction
+    from deeplearning4j_tpu.eval.evaluation import RegressionEvaluation
+
+    b = (NeuralNetConfiguration.Builder().seed(7).weight_init(WeightInit.XAVIER)
+         .activation(Activation.TANH).updater(Sgd(learning_rate=0.05))
+         .dtype("float64").list())
+    b.layer(DenseLayer(n_out=8))
+    b.layer(OutputLayer(n_out=2, loss_fn=LossFunction.MSE,
+                        activation=Activation.IDENTITY))
+    conf = b.set_input_type(InputType.feed_forward(5)).build()
+    tm = ParameterAveragingTrainingMaster.Builder(16).build()
+    net = DistributedMultiLayer(conf.to_json(), tm)
+    rng = np.random.RandomState(9)
+    x = rng.rand(32, 5)
+    y = x @ rng.randn(5, 2)
+    net.fit(DataSet(x, y))
+    net._wrapper._write_back()
+    batches = [DataSet(x[:16], y[:16]), DataSet(x[16:], y[16:])]
+    ev = net.evaluate_regression(batches)
+    ref = RegressionEvaluation()
+    for ds in batches:
+        ref.eval(ds.labels, np.asarray(net.network.output(ds.features)))
+    for c in range(2):
+        assert abs(ev.mean_squared_error(c) - ref.mean_squared_error(c)) < 1e-12
+        assert abs(ev.correlation_r2(c) - ref.correlation_r2(c)) < 1e-12
+
+
+def test_evaluation_merge_api():
+    """Evaluation.merge / RegressionEvaluation.merge: split-then-merge equals
+    single-pass (the reduction the cluster evaluate relies on)."""
+    from deeplearning4j_tpu.eval.evaluation import (
+        Evaluation, RegressionEvaluation)
+    rng = np.random.RandomState(3)
+    labels = np.eye(4)[rng.randint(0, 4, 64)]
+    preds = rng.rand(64, 4)
+    whole = Evaluation()
+    whole.eval(labels, preds)
+    a, b = Evaluation(), Evaluation()
+    a.eval(labels[:20], preds[:20])
+    b.eval(labels[20:], preds[20:])
+    a.merge(b)
+    assert np.array_equal(a.confusion.matrix, whole.confusion.matrix)
+    assert a.accuracy() == whole.accuracy()
+    assert a._count == whole._count
+
+    y = rng.randn(64, 3)
+    p = y + 0.1 * rng.randn(64, 3)
+    rw = RegressionEvaluation()
+    rw.eval(y, p)
+    ra, rb = RegressionEvaluation(), RegressionEvaluation()
+    ra.eval(y[:31], p[:31])
+    rb.eval(y[31:], p[31:])
+    ra.merge(rb)
+    for c in range(3):
+        assert abs(ra.mean_squared_error(c) - rw.mean_squared_error(c)) < 1e-12
+        assert abs(ra.correlation_r2(c) - rw.correlation_r2(c)) < 1e-12

@@ -1,0 +1,144 @@
+"""fastText serde (VERDICT r3 missing#2 / next#3): .bin model round-trip,
+subword-composed vectors incl. OOV, readWord2VecModel auto-detection, and the
+.vec text path (ref embeddings/loader/WordVectorSerializer.java fastText
+surface)."""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp.fasttext import (
+    FastText, FastTextArgs, compute_subwords, fasttext_hash)
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+
+
+WORDS = ["the", "quick", "brown", "fox", "jumps", "over", "lazy", "dog",
+         "naïve"]  # incl. multi-byte UTF-8
+
+
+def small_model(dim=16, bucket=512, minn=3, maxn=5, seed=0):
+    vocab = VocabCache()
+    for i, w in enumerate(WORDS):
+        vocab.add_token(VocabWord(w, 100 - i))
+    vocab.finish(min_word_frequency=0)
+    rng = np.random.RandomState(seed)
+    args = FastTextArgs(dim=dim, bucket=bucket, minn=minn, maxn=maxn,
+                        min_count=1, t=1e-4)
+    inp = rng.randn(vocab.num_words() + bucket, dim).astype(np.float32)
+    out = rng.randn(vocab.num_words(), dim).astype(np.float32)
+    return FastText(args, vocab, inp, out)
+
+
+def test_hash_matches_fasttext_reference_values():
+    # FNV-1a 32-bit with int8 sign extension: spot values computed by the
+    # published algorithm (hash("a") = (2166136261 ^ 97) * 16777619 mod 2^32)
+    assert fasttext_hash("a") == ((2166136261 ^ 97) * 16777619) % 2**32
+    h = 2166136261
+    for b in "ab".encode():
+        h = ((h ^ b) * 16777619) % 2**32
+    assert fasttext_hash("ab") == h
+    # multi-byte chars take the sign-extended path and stay in range
+    assert 0 <= fasttext_hash("ï") < 2**32
+    assert fasttext_hash("ï") != fasttext_hash("i")
+
+
+def test_subwords_window_and_exclusions():
+    # "<cat>": len 5; minn=3 maxn=3 -> {"<ca","cat","at>"}
+    ids = compute_subwords("cat", 3, 3, 1000, nwords=10)
+    assert len(ids) == 3
+    assert all(10 <= i < 1010 for i in ids)
+    expected = [10 + fasttext_hash(g) % 1000 for g in ("<ca", "cat", "at>")]
+    assert ids == expected
+    # the whole wrapped word appears when within [minn, maxn]
+    ids5 = compute_subwords("cat", 3, 5, 1000, nwords=10)
+    assert (10 + fasttext_hash("<cat>") % 1000) in ids5
+    assert compute_subwords("cat", 3, 3, 0, nwords=10) == []
+
+
+def test_bin_roundtrip_exact(tmp_path):
+    ft = small_model()
+    p = str(tmp_path / "model.bin")
+    ft.save(p)
+    ft2 = FastText.load(p)
+    assert ft2.args == ft.args
+    assert ft2.vocab.words() == ft.vocab.words()
+    assert [w.count for w in ft2.vocab.vocab_words()] == \
+        [w.count for w in ft.vocab.vocab_words()]
+    np.testing.assert_array_equal(ft2.input, ft.input)
+    np.testing.assert_array_equal(ft2.output, ft.output)
+    for w in WORDS + ["foxes", "überfox"]:
+        np.testing.assert_allclose(ft2.get_word_vector(w),
+                                   ft.get_word_vector(w), atol=0)
+
+
+def test_composed_vector_is_word_plus_ngram_average():
+    ft = small_model()
+    w = "fox"
+    ids = [ft.vocab.index_of(w)] + ft.subword_ids(w)
+    np.testing.assert_allclose(ft.get_word_vector(w),
+                               ft.input[np.asarray(ids)].mean(axis=0),
+                               rtol=1e-6)
+
+
+def test_oov_vector_composes_from_ngrams():
+    ft = small_model()
+    v = ft.get_word_vector("foxhound")  # OOV
+    assert not ft.has_word("foxhound")
+    assert np.linalg.norm(v) > 0
+    ids = ft.subword_ids("foxhound")
+    np.testing.assert_allclose(v, ft.input[np.asarray(ids)].mean(axis=0),
+                               rtol=1e-6)
+
+
+def test_read_word_vectors_autodetects_fasttext_bin(tmp_path):
+    ft = small_model()
+    p = str(tmp_path / "model.bin")
+    ft.save(p)
+    wv = WordVectorSerializer.read_word_vectors(p)
+    assert wv.has_word("quick")
+    np.testing.assert_allclose(wv.get_word_vector("quick"),
+                               ft.get_word_vector("quick"), rtol=1e-6)
+    # composed vectors power the similarity surface
+    assert "quick" not in wv.words_nearest("quick", top_n=3)
+
+
+def test_vec_text_roundtrip(tmp_path):
+    ft = small_model()
+    wv = ft.to_word_vectors()
+    p = str(tmp_path / "model.vec")
+    WordVectorSerializer.write_word_vectors(wv, p)
+    back = WordVectorSerializer.read_word_vectors(p)
+    assert back.vocab.words() == wv.vocab.words()
+    np.testing.assert_allclose(back.get_word_vector("brown"),
+                               wv.get_word_vector("brown"), atol=1e-5)
+
+
+def test_write_fasttext_wraps_word2vec_tables(tmp_path):
+    ft = small_model()
+    wv = ft.to_word_vectors()
+    p = str(tmp_path / "wrapped.bin")
+    WordVectorSerializer.write_fasttext(wv, p)
+    back = WordVectorSerializer.read_fasttext(p)
+    assert isinstance(back, FastText)
+    # bucket rows are zero-filled, so composed vector = syn0 / (1 + n_ngrams)
+    w = "quick"
+    n = 1 + len(back.subword_ids(w))
+    np.testing.assert_allclose(back.get_word_vector(w) * n,
+                               np.asarray(wv.get_word_vector(w)), rtol=1e-5)
+
+
+def test_quantized_model_rejected(tmp_path):
+    ft = small_model()
+    p = str(tmp_path / "model.bin")
+    ft.save(p)
+    raw = bytearray(open(p, "rb").read())
+    # flip the input-matrix quant flag (right after the dictionary block)
+    import struct
+    from deeplearning4j_tpu.nlp.fasttext import FastTextArgs as A
+    off = 8 + 4 * len(A._FIELDS) + 8 + 12 + 16
+    for w in ft.vocab.vocab_words():
+        off += len(w.word.encode()) + 1 + 9
+    assert raw[off] == 0
+    raw[off] = 1
+    open(p, "wb").write(bytes(raw))
+    with pytest.raises(ValueError, match="quantized"):
+        FastText.load(p)
