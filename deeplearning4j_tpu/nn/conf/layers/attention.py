@@ -4,16 +4,24 @@ Beyond-reference (the 2017 reference predates attention entirely — SURVEY §5
 long-context: "no attention layer at all"); this is the long-context primitive
 the TPU framework adds: a layer over the framework's recurrent activation
 layout (batch, size, time) that composes with configs, masking, serialization,
-and ShardedTrainer. Context parallelism comes in two forms:
+and ShardedTrainer.
+
+Long sequences never materialize the (B, H, T, T) score tensor: past
+`block_size` timesteps the layer computes attention through the online-softmax
+block recurrence (`blockwise_attention`, lax.scan over k/v blocks — peak
+activation memory O(T * block), flash-attention's recurrence on one device).
+Context parallelism comes in two forms:
 
 - GSPMD: ShardedTrainer.Builder().sequence_axis("seq") shards the TIME
   dimension of recurrent inputs over a mesh axis; the attention einsums then
   partition across chips with XLA inserting the collectives (correct for
   causal + masked attention — softmax normalizers reduce over the sharded
   axis).
-- hand-scheduled: parallel/sequence_parallel.py's ring_attention (k/v blocks
-  rotating via ppermute with online softmax) remains the explicitly-scheduled
-  alternative for very long sequences.
+- hand-scheduled ring: ShardedTrainer.Builder().sequence_axis("seq")
+  .ring_attention(True) routes this layer through
+  parallel/sequence_parallel.py's ring_attention — k/v (+ key-mask) blocks
+  rotate via ppermute with the same online-softmax accumulator, so per-chip
+  memory is O((T/n_chips) * block) and communication is nearest-neighbor ICI.
 """
 from __future__ import annotations
 
@@ -26,7 +34,9 @@ import numpy as np
 from deeplearning4j_tpu.nn.conf.input_type import InputType
 from deeplearning4j_tpu.nn.conf.layers.base import (
     FeedForwardLayerConf, register_layer)
-from deeplearning4j_tpu.parallel.sequence_parallel import NEG_INF as _NEG_INF
+from deeplearning4j_tpu.parallel.sequence_parallel import (
+    NEG_INF as _NEG_INF, blockwise_attention, current_attention_context,
+    ring_attention)
 
 
 @register_layer
@@ -34,9 +44,12 @@ from deeplearning4j_tpu.parallel.sequence_parallel import NEG_INF as _NEG_INF
 class SelfAttentionLayer(FeedForwardLayerConf):
     """(batch, n_in, time) -> (batch, n_out, time); n_out % n_heads == 0.
     Pre-softmax masking drops padded timesteps (the framework's (batch, time)
-    feature masks); `causal` gives autoregressive attention."""
+    feature masks); `causal` gives autoregressive attention. `block_size`:
+    sequences longer than this use the O(T * block) online-softmax path
+    (0 disables blockwise and forces the dense score tensor)."""
     n_heads: int = 4
     causal: bool = False
+    block_size: int = 128
 
     def set_n_in(self, input_type, override=False):
         if self.n_in == 0 or override:
@@ -71,14 +84,43 @@ class SelfAttentionLayer(FeedForwardLayerConf):
             return jnp.reshape(xt @ w, (B, T, H, Dh)).transpose(0, 2, 1, 3)
 
         q, k, v = heads(params["w_q"]), heads(params["w_k"]), heads(params["w_v"])
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(Dh)
-        if self.causal:
-            scores = jnp.where(jnp.tril(jnp.ones((T, T), bool)), scores,
-                               _NEG_INF)
-        if mask is not None:  # (B, T) padding mask: keys at padded steps drop
-            scores = jnp.where(mask[:, None, None, :] > 0, scores, _NEG_INF)
-        attn = jax.nn.softmax(scores, axis=-1)
-        out = jnp.einsum("bhqk,bhkv->bhqv", attn, v)     # (B, H, T, Dh)
+        ctx = current_attention_context()
+        seq_sharded = (ctx.mesh is not None and ctx.seq_axis is not None
+                       and ctx.seq_axis in ctx.mesh.axis_names
+                       and ctx.mesh.shape[ctx.seq_axis] > 1)
+        ring = seq_sharded and ctx.use_ring
+        if ring and T % ctx.mesh.shape[ctx.seq_axis] != 0:
+            # fall through to a single-device path, but say so: the user asked
+            # for ring CP and would otherwise discover the fallback as an OOM
+            import warnings
+            warnings.warn(
+                f"ring attention disabled: T={T} not divisible by mesh axis "
+                f"{ctx.seq_axis!r} ({ctx.mesh.shape[ctx.seq_axis]}); "
+                f"falling back to the unsharded attention path")
+            ring = False
+        if ring:
+            out = ring_attention(q, k, v, ctx.mesh, ctx.seq_axis,
+                                 causal=self.causal, mask=mask,
+                                 batch_axis=ctx.data_axis)
+        elif self.block_size and T > self.block_size and not seq_sharded:
+            # single-device long-context path (flash recurrence). Skipped
+            # under GSPMD context parallelism: there the DENSE einsums are
+            # what XLA partitions over the seq axis — a lax.scan over
+            # reshaped k/v blocks would force cross-shard gathers instead
+            out = blockwise_attention(q, k, v, self.block_size,
+                                      causal=self.causal, mask=mask)
+        else:
+            # dense path: small T, or GSPMD CP (ctx.seq_axis sharding — the
+            # einsums partition across chips with XLA inserting collectives)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(Dh)
+            if self.causal:
+                scores = jnp.where(jnp.tril(jnp.ones((T, T), bool)), scores,
+                                   _NEG_INF)
+            if mask is not None:  # (B, T) padding mask: padded keys drop
+                scores = jnp.where(mask[:, None, None, :] > 0, scores,
+                                   _NEG_INF)
+            attn = jax.nn.softmax(scores, axis=-1)
+            out = jnp.einsum("bhqk,bhkv->bhqv", attn, v)  # (B, H, T, Dh)
         out = out.transpose(0, 2, 1, 3).reshape(B, T, self.n_out)
         out = out @ params["w_o"] + params["b"]
         out = self._act(out)

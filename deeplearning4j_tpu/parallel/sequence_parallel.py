@@ -13,8 +13,9 @@ Design (the scaling-book / Ring Attention recipe, arXiv:2310.01889):
   folds it into an online-softmax accumulator (running max + normalizer), so
   the full S x S score matrix never materializes — flash-attention's recurrence
   across devices.
-- Causal masking is handled per block pair from the ring offset: fully-visible
-  blocks skip the elementwise mask entirely.
+- Causal masking is handled per block pair from the ring offset (a blk x blk
+  mask built from global row/col ids each round); unmasked non-causal rounds
+  skip elementwise masking (and the key-mask rotation) entirely.
 
 `ring_attention` is the shard_map collective form; `attention_reference` is the
 single-device oracle used by tests and small models.
@@ -71,24 +72,74 @@ def _merge(acc, o, m, l):
             acc_l * a[..., 0] + l * b[..., 0])
 
 
+def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
+                        mask=None, scale: Optional[float] = None):
+    """Single-device flash-attention recurrence: scan k/v in blocks of
+    `block_size` with the online-softmax accumulator, so peak activation
+    memory is O(T * block) instead of the dense O(T^2) score tensor
+    (arXiv:2205.14135 recurrence; autodiff-friendly — jax.grad differentiates
+    straight through the scan).
+
+    q/k/v: (batch, heads, T, dim); mask: optional (batch, T) key-padding mask
+    (padded keys drop from every softmax). T is padded internally up to a
+    block multiple; padding keys are masked, queries stay unpadded."""
+    B, H, T, D = q.shape
+    scale_ = scale if scale is not None else 1.0 / np.sqrt(D)
+    scale_ = jnp.asarray(scale_, q.dtype)  # no accidental x64 promotion
+    blk = max(1, min(int(block_size), T))
+    nb = -(-T // blk)
+    pad = nb * blk - T
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, pad), (0, 0)))
+    km = jnp.ones((B, T), bool) if mask is None else (mask > 0)
+    km = jnp.pad(km, ((0, 0), (0, pad)))                      # (B, Tp)
+    kb = jnp.moveaxis(kp.reshape(B, H, nb, blk, D), 2, 0)     # (nb,B,H,blk,D)
+    vb = jnp.moveaxis(vp.reshape(B, H, nb, blk, D), 2, 0)
+    kmb = jnp.moveaxis(km.reshape(B, nb, blk), 1, 0)          # (nb,B,blk)
+    ki = jnp.arange(nb * blk).reshape(nb, blk)
+    qi = jnp.arange(T)
+
+    def step(acc, inp):
+        kb_, vb_, kmb_, ki_ = inp
+        m = kmb_[:, None, None, :]  # (B,1,1,blk), broadcasts in _block_attn
+        if causal:
+            m = m & (qi[:, None] >= ki_[None, :])[None, None]
+        o, mx, l = _block_attn(q, kb_, vb_, scale_, m)
+        return _merge(acc, o, mx, l), None
+
+    acc0 = (jnp.zeros_like(q),
+            jnp.full((B, H, T), NEG_INF, q.dtype),
+            jnp.zeros((B, H, T), q.dtype))
+    (o, _, l), _ = lax.scan(step, acc0, (kb, vb, kmb, ki))
+    return o / jnp.maximum(l, 1e-30)[..., None]
+
+
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
-                   causal: bool = False, scale: Optional[float] = None):
+                   causal: bool = False, scale: Optional[float] = None,
+                   mask=None, batch_axis: Optional[str] = None):
     """Attention with q/k/v sequence-sharded over `axis`; k/v ride the ring.
 
     q/k/v: (batch, heads, seq, dim) GLOBAL arrays (sharded or to-be-sharded on
-    the seq axis). Returns output with the same sharding. Communication is N-1
+    the seq axis). `mask`: optional (batch, seq) key-padding mask; its blocks
+    rotate with k/v. `batch_axis`: name of the mesh axis the batch dim is
+    data-sharded over (so the shard_map composes with dp instead of gathering
+    the batch). Returns output with q's sharding. Communication is N-1
     `ppermute` neighbor hops over ICI, compute overlaps transfers under XLA's
     async collectives.
     """
     d = q.shape[-1]
-    scale_ = scale if scale is not None else 1.0 / np.sqrt(d)
+    scale_ = jnp.asarray(scale if scale is not None else 1.0 / np.sqrt(d),
+                         q.dtype)
     n_dev = mesh.shape[axis]
     seq = q.shape[2]
     assert seq % n_dev == 0, f"seq {seq} not divisible by mesh axis {n_dev}"
     blk = seq // n_dev
+    has_mask = mask is not None
 
-    def local(q_blk, k_blk, v_blk):
-        # q_blk etc: (b, h, blk, d) — this device's shard
+    def local(q_blk, k_blk, v_blk, m_blk):
+        # q_blk etc: (b, h, blk, d); m_blk: (b, blk) or None — this device's
+        # shard. Unmasked non-causal rounds skip the elementwise mask (and
+        # the third ppermute) entirely.
         my = lax.axis_index(axis)
 
         def causal_mask(kv_owner):
@@ -98,36 +149,83 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
             return (qi[:, None] >= ki[None, :])[None, None]  # (1,1,blk,blk)
 
         def step(carry, r):
-            acc, kb, vb = carry
+            acc, kb, vb, mb = carry
             owner = (my - r) % n_dev  # whose k/v block is resident this round
+            m = None if mb is None else (mb > 0)[:, None, None, :]  # (b,1,1,blk)
             if causal:
-                # blocks fully in the future are masked out entirely; fully
-                # visible blocks skip the mask. Done with where-on-scores since
-                # owner is traced: build the mask every step (blk x blk only).
-                mask = causal_mask(owner)
-                o, m_, l_ = _block_attn(q_blk, kb, vb, scale_, mask)
-            else:
-                o, m_, l_ = _block_attn(q_blk, kb, vb, scale_)
+                # blocks fully in the future are masked out entirely; since
+                # owner is traced, build the blk x blk mask every step
+                cm = causal_mask(owner)
+                m = cm if m is None else m & cm
+            o, m_, l_ = _block_attn(q_blk, kb, vb, scale_, m)
             acc = _merge(acc, o, m_, l_)
-            # rotate k/v to the next device on the ring (neighbor exchange)
+            # rotate k/v (+ key mask) to the next device on the ring
             perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
             kb = lax.ppermute(kb, axis, perm)
             vb = lax.ppermute(vb, axis, perm)
-            return (acc, kb, vb), None
+            if mb is not None:
+                mb = lax.ppermute(mb, axis, perm)
+            return (acc, kb, vb, mb), None
 
         b, h = q_blk.shape[0], q_blk.shape[1]
         acc0 = (jnp.zeros_like(q_blk),
                 jnp.full((b, h, blk), NEG_INF, q_blk.dtype),
                 jnp.zeros((b, h, blk), q_blk.dtype))
-        (acc, _, _), _ = lax.scan(step, (acc0, k_blk, v_blk),
-                                  jnp.arange(n_dev))
+        (acc, _, _, _), _ = lax.scan(step, (acc0, k_blk, v_blk, m_blk),
+                                     jnp.arange(n_dev))
         out, m_, l_ = acc
         return out / jnp.maximum(l_, 1e-30)[..., None]
 
-    spec = P(None, None, axis, None)
-    shmapped = jax.shard_map(local, mesh=mesh, in_specs=(spec, spec, spec),
-                             out_specs=spec, check_vma=False)
+    spec = P(batch_axis, None, axis, None)
+    if has_mask:
+        shmapped = jax.shard_map(
+            local, mesh=mesh,
+            in_specs=(spec, spec, spec, P(batch_axis, axis)),
+            out_specs=spec, check_vma=False)
+        return shmapped(q, k, v, mask)
+    shmapped = jax.shard_map(
+        lambda qb, kb, vb: local(qb, kb, vb, None), mesh=mesh,
+        in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return shmapped(q, k, v)
+
+
+class _AttentionContext:
+    """Trace-time channel from a mesh-aware trainer to SelfAttentionLayer:
+    which mesh/axes are active, and whether the layer should use the
+    hand-scheduled ring instead of GSPMD partitioning. Set around step-fn
+    tracing (jit caches the traced result, so the context only needs to be
+    live while tracing)."""
+
+    def __init__(self):
+        self.mesh: Optional[Mesh] = None
+        self.data_axis: Optional[str] = None
+        self.seq_axis: Optional[str] = None
+        self.use_ring: bool = False
+
+
+_ATTN_CTX = _AttentionContext()
+
+
+class attention_mesh_context:
+    """with attention_mesh_context(mesh, data_axis, seq_axis, use_ring): ..."""
+
+    def __init__(self, mesh, data_axis=None, seq_axis=None, use_ring=False):
+        self._new = (mesh, data_axis, seq_axis, use_ring)
+
+    def __enter__(self):
+        c = _ATTN_CTX
+        self._old = (c.mesh, c.data_axis, c.seq_axis, c.use_ring)
+        c.mesh, c.data_axis, c.seq_axis, c.use_ring = self._new
+        return c
+
+    def __exit__(self, *exc):
+        c = _ATTN_CTX
+        c.mesh, c.data_axis, c.seq_axis, c.use_ring = self._old
+        return False
+
+
+def current_attention_context() -> _AttentionContext:
+    return _ATTN_CTX
 
 
 class SequenceParallelAttention:

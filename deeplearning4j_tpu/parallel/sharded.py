@@ -174,6 +174,7 @@ class ShardedTrainer:
     def __init__(self, model, mesh: Mesh, data_axis: str = "data",
                  model_axis: str = "model", auto_shard: bool = True,
                  sequence_axis: Optional[str] = None,
+                 ring_attention: bool = False,
                  layer_overrides: Optional[Dict[int, Dict[str, Any]]] = None):
         if data_axis not in mesh.axis_names:
             raise ValueError(f"mesh has no data axis {data_axis!r}: {mesh}")
@@ -188,6 +189,14 @@ class ShardedTrainer:
         # attention/elementwise work and inserts the softmax-normalizer
         # collectives (module docstring of nn/conf/layers/attention.py)
         self.sequence_axis = sequence_axis
+        # hand-scheduled ring CP: SelfAttentionLayer routes through
+        # ring_attention (k/v blocks on a ppermute ring) instead of letting
+        # GSPMD partition the dense einsums
+        self.ring_attention = bool(ring_attention)
+        if self.ring_attention and sequence_axis is None:
+            raise ValueError(
+                "ring_attention=True requires sequence_axis(<mesh axis>) — "
+                "the ring rotates k/v blocks over that axis")
         has_model = model_axis in mesh.axis_names
         model._check_init()
         if auto_shard and has_model:
@@ -337,12 +346,21 @@ class ShardedTrainer:
         updaters = net._updaters
         layers = net.layers
 
+        from deeplearning4j_tpu.parallel.sequence_parallel import (
+            attention_mesh_context)
+
         def step_fn(carry, rng, x, y, fmask, lmask):
             params, opt, states, step = carry
 
             def loss_fn(p):
-                loss, (ns, _) = net._loss_fn(p, states, x, y, fmask, lmask,
-                                             rng, True, None)
+                # context is read at TRACE time by SelfAttentionLayer.forward
+                # (jit caches the traced program, so this costs nothing at run
+                # time); it selects the ring CP path when enabled
+                with attention_mesh_context(self.mesh, self.data_axis,
+                                            self.sequence_axis,
+                                            self.ring_attention):
+                    loss, (ns, _) = net._loss_fn(p, states, x, y, fmask,
+                                                 lmask, rng, True, None)
                 return loss, ns
 
             (loss, new_states), grads = jax.value_and_grad(
@@ -527,6 +545,13 @@ class ShardedTrainer:
             """Shard the time dimension of recurrent inputs over this mesh
             axis (context parallelism for attention nets)."""
             self._kw["sequence_axis"] = name
+            return self
+
+        def ring_attention(self, b: bool = True):
+            """Route SelfAttentionLayer through the hand-scheduled ring
+            (ppermute k/v rotation + online softmax) over the sequence axis
+            instead of GSPMD-partitioned dense attention."""
+            self._kw["ring_attention"] = bool(b)
             return self
 
         def auto_shard(self, b: bool):

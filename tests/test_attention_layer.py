@@ -105,3 +105,122 @@ def test_head_divisibility_check():
     layer = SelfAttentionLayer(n_in=8, n_out=10, n_heads=4)
     with pytest.raises(ValueError, match="n_heads"):
         layer.init_params(jax.random.PRNGKey(0), InputType.recurrent(8))
+
+
+# ---------------------------------------------------------------- blockwise
+# (VERDICT r3 next#2: the layer must compute attention via online-softmax
+# blocks so the advertised long-context capability doesn't O(T^2)-OOM)
+
+def test_blockwise_matches_oracle_fp64():
+    from deeplearning4j_tpu.parallel.sequence_parallel import (
+        attention_reference, blockwise_attention)
+    rng = np.random.RandomState(0)
+    q, k, v = (jnp.asarray(rng.randn(2, 3, 37, 8)) for _ in range(3))
+    for causal in (False, True):
+        ref = attention_reference(q, k, v, causal=causal)
+        for blk in (5, 8, 37, 64):
+            got = blockwise_attention(q, k, v, blk, causal=causal)
+            np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                       atol=1e-12, err_msg=f"blk={blk}")
+
+
+def test_blockwise_padding_mask_matches_dense_layer():
+    layer_d = SelfAttentionLayer(n_in=4, n_out=4, n_heads=1, block_size=0)
+    layer_b = SelfAttentionLayer(n_in=4, n_out=4, n_heads=1, block_size=2)
+    params = layer_d.init_params(jax.random.PRNGKey(1),
+                                 InputType.recurrent(4), jnp.float64)
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.randn(2, 4, 6))
+    mask = jnp.asarray([[1, 1, 1, 0, 0, 0], [1, 1, 1, 1, 1, 1]], jnp.float64)
+    out_d, _, _ = layer_d.forward(params, {}, x, train=False, mask=mask)
+    out_b, _, _ = layer_b.forward(params, {}, x, train=False, mask=mask)
+    np.testing.assert_allclose(np.asarray(out_b), np.asarray(out_d),
+                               atol=1e-12)
+
+
+def test_blockwise_layer_gradient_check():
+    from deeplearning4j_tpu.gradientcheck import check_gradients
+    net = attn_net(seed=7)  # default block_size=128
+    for lay in net.layers:
+        if isinstance(lay, SelfAttentionLayer):
+            lay.block_size = 3  # force the blockwise path at T=5
+    x, y = seq_data(b=3, t=5)
+    assert check_gradients(net, x, y, epsilon=1e-6, max_rel_error=1e-5)
+
+
+def test_blockwise_peak_memory_scales_with_block_not_T2():
+    """Compiled temp-buffer usage of the blockwise forward must be far below
+    the dense path's O(B*H*T^2) score tensor at long T."""
+    from deeplearning4j_tpu.parallel.sequence_parallel import (
+        attention_reference, blockwise_attention)
+    B, H, T, D, blk = 1, 2, 4096, 16, 128
+    args = [jax.ShapeDtypeStruct((B, H, T, D), jnp.float32)] * 3
+
+    def temp_bytes(fn):
+        compiled = jax.jit(fn).lower(*args).compile()
+        return compiled.memory_analysis().temp_size_in_bytes
+
+    dense = temp_bytes(lambda q, k, v:
+                       attention_reference(q, k, v, causal=True))
+    block = temp_bytes(lambda q, k, v:
+                       blockwise_attention(q, k, v, blk, causal=True))
+    score_tensor = B * H * T * T * 4  # what the dense path materializes
+    assert dense >= score_tensor  # sanity: dense really is O(T^2)
+    assert block < score_tensor / 8, (block, dense, score_tensor)
+
+
+def test_long_T_forward_runs_through_scan():
+    """T=2048 through the LAYER (default block_size) stays exact vs the
+    oracle on a slice and returns finite values."""
+    from deeplearning4j_tpu.parallel.sequence_parallel import (
+        attention_reference)
+    layer = SelfAttentionLayer(n_in=8, n_out=8, n_heads=2, causal=True)
+    params = layer.init_params(jax.random.PRNGKey(3),
+                               InputType.recurrent(8), jnp.float64)
+    rng = np.random.RandomState(4)
+    x = jnp.asarray(rng.randn(1, 8, 2048))
+    out, _, _ = layer.forward(params, {}, x, train=False)
+    assert np.isfinite(np.asarray(out)).all()
+    B, T, H, Dh = 1, 2048, 2, 4
+    xt = jnp.swapaxes(x, 1, 2)
+    heads = lambda w: jnp.reshape(xt @ w, (B, T, H, Dh)).transpose(0, 2, 1, 3)
+    ref = attention_reference(heads(params["w_q"]), heads(params["w_k"]),
+                              heads(params["w_v"]), causal=True)
+    ref = ref.transpose(0, 2, 1, 3).reshape(B, T, 8) @ params["w_o"] \
+        + params["b"]
+    np.testing.assert_allclose(np.asarray(out),
+                               np.asarray(jnp.swapaxes(ref, 1, 2)),
+                               atol=1e-10)
+
+
+# -------------------------------------------------------------------- ring
+def test_ring_routed_layer_parity_and_training():
+    """ShardedTrainer.ring_attention(True): same losses as the dense
+    single-device oracle, with the layer actually on the ring path."""
+    x, y = seq_data(b=4, t=16)
+    net0 = attn_net(seed=11)
+    ref = [float(net0.fit_on_device(x, y, steps=1)[0]) for _ in range(3)]
+    net1 = attn_net(seed=11)
+    mesh = make_mesh(8, axes=("data", "seq"), shape=(2, 4))
+    st = (ShardedTrainer.Builder(net1).mesh(mesh).model_axis("nope")
+          .sequence_axis("seq").ring_attention(True).build())
+    got = [float(st.fit_on_device(x, y, steps=1)[0]) for _ in range(3)]
+    np.testing.assert_allclose(got, ref, rtol=1e-9)
+
+
+def test_ring_routed_layer_with_padding_mask():
+    """Ring CP honors key-padding masks (mask blocks rotate with k/v)."""
+    x, y = seq_data(b=4, t=16)
+    rng = np.random.RandomState(8)
+    mask = (rng.rand(4, 16) > 0.25).astype(np.float64)
+    mask[:, 0] = 1.0
+    net0 = attn_net(seed=13)
+    ref = [float(net0.fit_on_device(x, y, steps=1, fmask=mask,
+                                    lmask=mask)[0]) for _ in range(2)]
+    net1 = attn_net(seed=13)
+    mesh = make_mesh(8, axes=("data", "seq"), shape=(2, 4))
+    st = (ShardedTrainer.Builder(net1).mesh(mesh).model_axis("nope")
+          .sequence_axis("seq").ring_attention(True).build())
+    got = [float(st.fit_on_device(x, y, steps=1, fmask=mask,
+                                  lmask=mask)[0]) for _ in range(2)]
+    np.testing.assert_allclose(got, ref, rtol=1e-9)
