@@ -66,13 +66,21 @@ class LSTM(FeedForwardLayerConf):
     def _step(self, params, xw_t, h, c):
         n = self.n_out
         gates = xw_t + h @ params["RW"]
-        if not self.peephole and self.gate_activation == Activation.SIGMOID \
-                and self.activation == Activation.TANH:
+        std_acts = (self.gate_activation == Activation.SIGMOID
+                    and self.activation == Activation.TANH)
+        if not self.peephole and std_acts:
             # helper seam (ref LSTMHelper.java fast path): fused Pallas gate
             # kernel when enabled, identical math either way
             from deeplearning4j_tpu.ops.helpers import helper_for
             from deeplearning4j_tpu.ops.pallas_kernels import lstm_gates_xla
             c_new, h_new = helper_for("lstm_gates", lstm_gates_xla)(gates, c)
+            return h_new, c_new
+        if self.peephole and std_acts:
+            # Graves/peephole fast path (ref CudnnLSTMHelper.java:175)
+            from deeplearning4j_tpu.ops.helpers import helper_for
+            from deeplearning4j_tpu.ops.pallas_kernels import graves_gates_xla
+            c_new, h_new = helper_for("graves_lstm_gates", graves_gates_xla)(
+                gates, c, params["pi"], params["pf"], params["po"])
             return h_new, c_new
         zi, zf, zo, zg = (gates[:, :n], gates[:, n:2 * n],
                           gates[:, 2 * n:3 * n], gates[:, 3 * n:])

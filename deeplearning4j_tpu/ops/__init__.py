@@ -9,6 +9,7 @@ from deeplearning4j_tpu.ops.helpers import (
     enable_helpers, helper_for, helpers_enabled, register_helper,
     registered_helpers)
 from deeplearning4j_tpu.ops import pallas_kernels  # registers kernels on import
+from deeplearning4j_tpu.ops import conv_fused  # registers conv1x1_bn_act
 
 __all__ = ["enable_helpers", "helpers_enabled", "helper_for", "register_helper",
-           "registered_helpers", "pallas_kernels"]
+           "registered_helpers", "pallas_kernels", "conv_fused"]

@@ -1,0 +1,229 @@
+"""Fused pointwise-conv + BatchNorm + ReLU (the cuDNN-analog conv kernel).
+
+Parity target: ref deeplearning4j-cuda/.../CudnnConvolutionHelper.java:46 —
+the reference's fused conv algos behind the ConvolutionHelper seam. TPU
+rendering: ResNet50's bottleneck blocks are 2/3 pointwise (1x1) convolutions,
+each followed by BatchNormalization (+ ReLU). XLA compiles that pattern as
+  conv(x) -> y ; reduce(y) twice (batch stats) ; elementwise(y) -> out
+which reads the conv output y from HBM twice (stats pass + normalize pass).
+This module's Pallas kernel computes the matmul AND the per-channel partial
+sums (sum y, sum y^2) in one VMEM-resident pass — y is read from HBM once —
+then a single XLA elementwise pass normalizes (+ReLU). On an HBM-bound model
+(see PERF.md roofline) removing one full activation read per conv+BN pair is
+the mechanism by which a hand kernel can beat the compiler at all.
+
+The op is training-complete: a custom VJP implements the analytic
+conv1x1+BN(+ReLU) backward with plain XLA matmuls (those are already
+MXU-optimal; only the forward's traffic pattern needed hand-scheduling).
+
+Layout: NCHW activations (framework standard), W (C_out, C_in) — the 1x1
+kernel's (O, I, 1, 1) squeezed. Spatial stride-2 subsampling happens before
+the kernel (a strided slice; the model's stride-2 1x1 convs drop those rows
+anyway).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.helpers import register_helper
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(n, m):
+    return (n + m - 1) // m * m
+
+
+def _conv1x1_stats_kernel(x_ref, w_ref, y_ref, s1_ref, s2_ref):
+    """One (batch b, spatial tile p) grid step: y tile = W @ x tile on the
+    MXU, plus per-channel partial sums accumulated across the whole grid
+    (the s1/s2 out blocks map to (0, 0) for every step, so they stay
+    VMEM-resident and accumulate). Stats accumulate in fp32 regardless of
+    activation dtype."""
+    from jax.experimental import pallas as pl
+    x = x_ref[0]                             # (C_in, P_t)
+    w = w_ref[:]                             # (C_out, C_in)
+    acc = s1_ref.dtype  # fp32 for <=fp32 activations, fp64 under x64 tests
+    y = jnp.dot(w, x, preferred_element_type=acc)  # (C_out, P_t)
+    y_ref[0] = y.astype(y_ref.dtype)
+    first = (pl.program_id(0) == 0) & (pl.program_id(1) == 0)
+
+    @pl.when(first)
+    def _():
+        s1_ref[:] = jnp.zeros_like(s1_ref)
+        s2_ref[:] = jnp.zeros_like(s2_ref)
+
+    s1_ref[:] += jnp.sum(y, axis=1, keepdims=True)
+    s2_ref[:] += jnp.sum(y * y, axis=1, keepdims=True)
+
+
+def conv1x1_stats_pallas(x3: jnp.ndarray, w: jnp.ndarray,
+                         p_tile: int = 1024) -> Tuple[jnp.ndarray, jnp.ndarray,
+                                                     jnp.ndarray]:
+    """x3 (B, C_in, P), w (C_out, C_in) -> (y (B, C_out, P) in x3.dtype,
+    sum_y (C_out,) fp32, sum_y2 (C_out,) fp32). One HBM read of x, one HBM
+    write of y, stats for free in the epilogue."""
+    from jax.experimental import pallas as pl
+    B, C_in, P = x3.shape
+    C_out = w.shape[0]
+    # stats accumulator: one width ABOVE the activation dtype where possible
+    # (sub-fp32 -> fp32; fp32 -> fp64) so the one-pass E[y^2]-E[y]^2 formula
+    # cannot cancel catastrophically (the normalization.py / ADVICE r3 low#1
+    # rule). fp64 activations stay fp64 (no wider type exists; fp64 is a
+    # test-only dtype for this opt-in perf path).
+    acc = jnp.float32 if jnp.dtype(x3.dtype).itemsize < 4 else jnp.float64
+    p_tile = min(p_tile, _round_up(P, 128))
+    Pp = _round_up(P, p_tile)
+    if Pp != P:
+        x3 = jnp.pad(x3, ((0, 0), (0, 0), (0, Pp - P)))
+    grid = (B, Pp // p_tile)
+    y, s1, s2 = pl.pallas_call(
+        _conv1x1_stats_kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, C_in, p_tile), lambda b, p: (b, 0, p)),
+            pl.BlockSpec((C_out, C_in), lambda b, p: (0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, C_out, p_tile), lambda b, p: (b, 0, p)),
+            pl.BlockSpec((C_out, 1), lambda b, p: (0, 0)),
+            pl.BlockSpec((C_out, 1), lambda b, p: (0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((B, C_out, Pp), x3.dtype),
+            jax.ShapeDtypeStruct((C_out, 1), acc),
+            jax.ShapeDtypeStruct((C_out, 1), acc),
+        ),
+        interpret=_interpret(),
+    )(x3, w)
+    if Pp != P:
+        # padded columns are zeros: they contributed 0 to s1/s2 — exact
+        y = y[:, :, :P]
+    return y, s1[:, 0], s2[:, 0]
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7))
+def conv1x1_bn_act(x, w, gamma, beta, bias, eps: float, relu: bool,
+                   stride: int):
+    """Fused 1x1 conv (+bias) + train-mode BatchNorm + optional ReLU.
+
+    x (B, C_in, H, W) NCHW; w (C_out, C_in); gamma/beta/bias (C_out,) (pass
+    zeros for bias when the conv has none). Returns (out, mean, var) with
+    mean/var the BATCH statistics (fp32) the caller feeds its running
+    averages. Training-differentiable via the analytic custom VJP below."""
+    out, mean, var, _y = _fwd_impl(x, w, gamma, beta, bias, eps, relu, stride)
+    return out, mean, var
+
+
+def _fwd_impl(x, w, gamma, beta, bias, eps, relu, stride):
+    B, C_in, H, W = x.shape
+    if stride != 1:
+        x = x[:, :, ::stride, ::stride]
+        H, W = x.shape[2], x.shape[3]
+    P = H * W
+    x3 = x.reshape(B, C_in, P)
+    y3, s1, s2 = conv1x1_stats_pallas(x3, w)
+    n = B * P
+    acc = s1.dtype
+    # bias shifts mean only; fold it in after the matmul-stats pass
+    mean = s1 / n + bias.astype(acc)
+    var = jnp.maximum(s2 / n - (s1 / n) ** 2, 0.0)  # bias cancels in var
+    invstd = jax.lax.rsqrt(var + eps)
+    scale = (gamma.astype(acc) * invstd)
+    shift = beta.astype(acc) - (mean - bias.astype(acc)) * scale
+    # NOTE: y3 excludes bias; normalize vs (mean - bias) == mean of y3
+    out = y3.astype(acc) * scale[None, :, None] + shift[None, :, None]
+    if relu:
+        out = jnp.maximum(out, 0.0)
+    out = out.astype(x.dtype).reshape(B, -1, H, W)
+    return out, mean, var, y3
+
+
+def _conv1x1_bn_act_fwd(x, w, gamma, beta, bias, eps, relu, stride):
+    out, mean, var, y3 = _fwd_impl(x, w, gamma, beta, bias, eps, relu, stride)
+    return (out, mean, var), (x, w, gamma, beta, bias, mean, var, out)
+
+
+def _conv1x1_bn_act_bwd(eps, relu, stride, saved, cots):
+    """Analytic backward: ReLU mask -> BN backward -> conv1x1 transposes.
+    All matmuls are plain XLA dots (MXU-optimal already)."""
+    x, w, gamma, beta, bias, mean, var, out = saved
+    g_out, g_mean, g_var = cots  # cotangents for (out, mean, var)
+    B, C_in, H0, W0 = x.shape
+    xs = x[:, :, ::stride, ::stride] if stride != 1 else x
+    H, W = xs.shape[2], xs.shape[3]
+    P = H * W
+    n = B * P
+    f32 = jnp.promote_types(x.dtype, jnp.float32)
+    g = g_out.astype(f32).reshape(B, -1, P)
+    if relu:
+        g = g * (out.reshape(B, -1, P) > 0)
+    invstd = jax.lax.rsqrt(var + eps)                       # (C,) f32
+    # recompute xhat from out? out = relu(xhat*gamma+beta) loses sign info —
+    # recompute y + xhat from x instead (remat: one extra matmul, no saved y).
+    # Matmuls stay in the ACTIVATION dtype (bf16 rides the MXU at full rate;
+    # an f32 recompute here was 2.5x the whole step, BENCH r4 first cut) and
+    # accumulate f32 via preferred_element_type.
+    x3 = xs.reshape(B, C_in, P)
+    y3 = jnp.einsum("oi,bip->bop", w, x3, preferred_element_type=f32)
+    yb = y3 + bias.astype(f32)[None, :, None]
+    xhat = (yb - mean[None, :, None]) * invstd[None, :, None]
+    dgamma = jnp.sum(g * xhat, axis=(0, 2))
+    dbeta = jnp.sum(g, axis=(0, 2))
+    dxhat = g * gamma.astype(f32)[None, :, None]
+    # BN backward (batch stats), plus pass-through cotangents for mean/var
+    # outputs (callers feeding running averages send zeros there; the running-
+    # average update is stop-gradiented in the layer, matching normalization.py)
+    dy = (dxhat - jnp.mean(dxhat, axis=(0, 2), keepdims=True)
+          - xhat * jnp.mean(dxhat * xhat, axis=(0, 2), keepdims=True)) \
+        * invstd[None, :, None]
+    if g_mean is not None:
+        dy = dy + (g_mean.astype(f32) / n)[None, :, None]
+    if g_var is not None:
+        dy = dy + (g_var.astype(f32) * 2.0 / n)[None, :, None] \
+            * (yb - mean[None, :, None])
+    dbias = jnp.sum(dy, axis=(0, 2))
+    dyl = dy.astype(x.dtype)  # MXU-rate matmuls, f32 accumulation
+    dw = jnp.einsum("bop,bip->oi", dyl, x3, preferred_element_type=f32)
+    dx3 = jnp.einsum("oi,bop->bip", w, dyl, preferred_element_type=f32)
+    dxs = dx3.reshape(B, C_in, H, W)
+    if stride != 1:
+        dx = jnp.zeros((B, C_in, H0, W0), f32)
+        dx = dx.at[:, :, ::stride, ::stride].set(dxs)
+    else:
+        dx = dxs
+    return (dx.astype(x.dtype), dw.astype(w.dtype), dgamma.astype(gamma.dtype),
+            dbeta.astype(beta.dtype), dbias.astype(bias.dtype))
+
+
+conv1x1_bn_act.defvjp(_conv1x1_bn_act_fwd, _conv1x1_bn_act_bwd)
+register_helper("conv1x1_bn_act")(conv1x1_bn_act)
+
+
+def conv1x1_bn_act_xla(x, w, gamma, beta, bias, eps: float, relu: bool,
+                       stride: int):
+    """Reference composition (what the unfused layers compute today):
+    lax-conv -> one-pass fp32 batch stats -> normalize (+ReLU)."""
+    if stride != 1:
+        x = x[:, :, ::stride, ::stride]
+    y = jax.lax.conv_general_dilated(
+        x, w[:, :, None, None], window_strides=(1, 1), padding=((0, 0), (0, 0)),
+        dimension_numbers=("NCHW", "OIHW", "NCHW"))
+    y = y + bias[None, :, None, None]
+    yf = y.astype(jnp.float32)
+    mean = jnp.mean(yf, axis=(0, 2, 3))
+    var = jnp.maximum(jnp.mean(yf * yf, axis=(0, 2, 3)) - mean * mean, 0.0)
+    invstd = jax.lax.rsqrt(var + eps)
+    out = (yf - mean[None, :, None, None]) * invstd[None, :, None, None] \
+        * gamma.astype(jnp.float32)[None, :, None, None] \
+        + beta.astype(jnp.float32)[None, :, None, None]
+    if relu:
+        out = jnp.maximum(out, 0.0)
+    return out.astype(x.dtype), mean, var

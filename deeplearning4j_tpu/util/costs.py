@@ -4,23 +4,30 @@ from __future__ import annotations
 from typing import Optional
 
 
-def lowered_flops(jitted, *args, **kwargs) -> Optional[float]:
-    """FLOPs of `jitted(*args, **kwargs)` per XLA's cost model, or None when the
-    backend exposes none. AOT lower/compile — nothing executes and no buffer is
-    donated. Note this pays one extra (cache-independent) compile; callers use
-    it once per bench config, outside timed regions."""
+def lowered_costs(jitted, *args, **kwargs) -> dict:
+    """{'flops', 'bytes_accessed'} of `jitted(*args, **kwargs)` per XLA's cost
+    model (AOT lower/compile, nothing executes). bytes_accessed is the
+    per-HLO-instruction sum — an upper-ish estimate of HBM traffic that
+    ignores fusion reuse; PERF.md's roofline uses it as the optimistic-roof
+    side of the bracket."""
     try:
         compiled = jitted.lower(*args, **kwargs).compile()
         ca = compiled.cost_analysis()
         if isinstance(ca, list):
             ca = ca[0] if ca else {}
-        flops = float(ca.get("flops", 0.0))
-        return flops if flops > 0 else None
+        return {"flops": float(ca.get("flops", 0.0)),
+                "bytes_accessed": float(ca.get("bytes accessed", 0.0))}
     except Exception as e:
-        # None disables the caller's peak-FLOPS sanity gate — never let that
-        # happen silently (the gate exists to catch measurement artifacts)
         import warnings
-        warnings.warn(f"XLA cost analysis unavailable ({type(e).__name__}: "
-                      f"{e}); MFU reporting and peak-sanity gating disabled "
-                      f"for this entry")
-        return None
+        warnings.warn(f"XLA cost analysis unavailable ({type(e).__name__}: {e})")
+        return {"flops": 0.0, "bytes_accessed": 0.0}
+
+
+def lowered_flops(jitted, *args, **kwargs) -> Optional[float]:
+    """FLOPs of `jitted(*args, **kwargs)` per XLA's cost model, or None when
+    the backend exposes none (which disables the caller's peak-FLOPS sanity
+    gate — lowered_costs warns in that case). AOT lower/compile — nothing
+    executes and no buffer is donated; callers use it once per bench config,
+    outside timed regions."""
+    flops = lowered_costs(jitted, *args, **kwargs)["flops"]
+    return flops if flops > 0 else None

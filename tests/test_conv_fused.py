@@ -1,0 +1,197 @@
+"""Fused conv1x1+BN+ReLU kernel equivalence (the CudnnConvolutionHelper
+pattern: accelerated path must match the built-in composition numerically,
+forward AND backward — ref deeplearning4j-cuda TestConvolution /
+CuDNNGradientChecks)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.conv_fused import (
+    conv1x1_bn_act, conv1x1_bn_act_xla, conv1x1_stats_pallas)
+
+RNG = np.random.RandomState(11)
+
+
+def _data(B=4, C_in=16, C_out=8, H=6, W=6, dtype=np.float32):
+    x = jnp.asarray(RNG.randn(B, C_in, H, W).astype(dtype))
+    w = jnp.asarray((RNG.randn(C_out, C_in) * 0.2).astype(dtype))
+    gamma = jnp.asarray(1.0 + 0.1 * RNG.randn(C_out).astype(dtype))
+    beta = jnp.asarray(0.1 * RNG.randn(C_out).astype(dtype))
+    bias = jnp.asarray(0.1 * RNG.randn(C_out).astype(dtype))
+    return x, w, gamma, beta, bias
+
+
+def test_stats_kernel_matches_direct():
+    x3 = jnp.asarray(RNG.randn(3, 16, 200).astype(np.float32))  # pads to 256
+    w = jnp.asarray(RNG.randn(8, 16).astype(np.float32) * 0.3)
+    y, s1, s2 = conv1x1_stats_pallas(x3, w, p_tile=128)
+    y_ref = jnp.einsum("oi,bip->bop", w, x3)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(s1), np.asarray(
+        jnp.sum(y_ref, axis=(0, 2))), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(s2), np.asarray(
+        jnp.sum(y_ref.astype(jnp.float32) ** 2, axis=(0, 2))), rtol=1e-5)
+
+
+@pytest.mark.parametrize("relu", [True, False])
+@pytest.mark.parametrize("stride", [1, 2])
+def test_forward_matches_xla_composition(relu, stride):
+    x, w, gamma, beta, bias = _data()
+    out_p, m_p, v_p = conv1x1_bn_act(x, w, gamma, beta, bias, 1e-5, relu,
+                                     stride)
+    out_x, m_x, v_x = conv1x1_bn_act_xla(x, w, gamma, beta, bias, 1e-5, relu,
+                                         stride)
+    np.testing.assert_allclose(np.asarray(m_p), np.asarray(m_x), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(v_p), np.asarray(v_x), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out_p), np.asarray(out_x), atol=1e-4)
+
+
+@pytest.mark.parametrize("relu", [True, False])
+@pytest.mark.parametrize("stride", [1, 2])
+def test_backward_matches_autodiff_of_xla_composition(relu, stride):
+    x, w, gamma, beta, bias = _data(B=3, C_in=8, C_out=8, H=4, W=4)
+
+    def loss_p(x, w, gamma, beta, bias):
+        out, m, v = conv1x1_bn_act(x, w, gamma, beta, bias, 1e-5, relu,
+                                   stride)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(m)) + jnp.sum(v)
+
+    def loss_x(x, w, gamma, beta, bias):
+        out, m, v = conv1x1_bn_act_xla(x, w, gamma, beta, bias, 1e-5, relu,
+                                       stride)
+        return jnp.sum(out ** 2) + jnp.sum(jnp.sin(m)) + jnp.sum(v)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2, 3, 4))(x, w, gamma, beta, bias)
+    gx = jax.grad(loss_x, argnums=(0, 1, 2, 3, 4))(x, w, gamma, beta, bias)
+    for name, a, b in zip(("dx", "dw", "dgamma", "dbeta", "dbias"), gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-3,
+                                   rtol=1e-3, err_msg=name)
+
+
+def test_fp64_gradient_check_fused():
+    """fp64 central differences directly against the fused op."""
+    from jax import config  # conftest enables x64
+    x, w, gamma, beta, bias = _data(B=2, C_in=4, C_out=4, H=3, W=3,
+                                    dtype=np.float64)
+
+    def loss(flat):
+        i = 0
+        parts = []
+        for ref in (x, w, gamma, beta, bias):
+            n = ref.size
+            parts.append(flat[i:i + n].reshape(ref.shape))
+            i += n
+        out, m, v = conv1x1_bn_act(*parts, 1e-5, True, 1)
+        return jnp.sum(out ** 2) + jnp.sum(m * v)
+
+    flat = jnp.concatenate([a.reshape(-1) for a in (x, w, gamma, beta, bias)])
+    ana = np.asarray(jax.grad(loss)(flat))
+    eps = 1e-6
+    idx = RNG.choice(flat.size, 40, replace=False)
+    for i in idx:
+        e = jnp.zeros_like(flat).at[i].set(eps)
+        num = (float(loss(flat + e)) - float(loss(flat - e))) / (2 * eps)
+        denom = max(abs(num), abs(ana[i]), 1e-8)
+        assert abs(num - ana[i]) / denom < 1e-5, (i, num, ana[i])
+
+
+def test_resnet50_graph_fusion_parity_fp64():
+    """The graph-level conv+BN fusion (helpers on) trains a bottleneck-style
+    ComputationGraph to the SAME fp64 losses/params as the plain path — the
+    ValidateCudnn pattern at network level."""
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    from deeplearning4j_tpu.common.enums import (
+        Activation, ConvolutionMode, LossFunction, WeightInit)
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+        ConvolutionLayer, SubsamplingLayer)
+    from deeplearning4j_tpu.nn.conf.layers.feedforward import (
+        ActivationLayer, OutputLayer)
+    from deeplearning4j_tpu.nn.conf.layers.normalization import (
+        BatchNormalization)
+    from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+    from deeplearning4j_tpu.nn.graph.vertices import ElementWiseVertex
+    from deeplearning4j_tpu.nn.updater.updaters import Adam
+    from deeplearning4j_tpu.ops.helpers import enable_helpers
+
+    def build():
+        g = (NeuralNetConfiguration.Builder().seed(17).dtype("float64")
+             .activation(Activation.IDENTITY)
+             .weight_init(WeightInit.XAVIER)
+             .convolution_mode(ConvolutionMode.Truncate)
+             .updater(Adam(learning_rate=1e-2)).graph_builder())
+        (g.add_inputs("in")
+          .add_layer("c1", ConvolutionLayer(n_out=8, kernel_size=(1, 1)), "in")
+          .add_layer("b1", BatchNormalization(activation=Activation.RELU), "c1")
+          .add_layer("c2", ConvolutionLayer(n_out=8, kernel_size=(1, 1),
+                                            stride=(2, 2)), "b1")
+          .add_layer("b2", BatchNormalization(), "c2")
+          .add_layer("sc", ConvolutionLayer(n_out=8, kernel_size=(1, 1),
+                                            stride=(2, 2)), "b1")
+          .add_layer("bs", BatchNormalization(), "sc")
+          .add_vertex("add", ElementWiseVertex(op="Add"), "b2", "bs")
+          .add_layer("relu", ActivationLayer(activation=Activation.RELU), "add")
+          .add_layer("pool", SubsamplingLayer(kernel_size=(4, 4),
+                                              stride=(4, 4)), "relu")
+          .add_layer("out", OutputLayer(n_out=3, loss_fn=LossFunction.MCXENT,
+                                        activation=Activation.SOFTMAX), "pool")
+          .set_outputs("out")
+          .set_input_types(InputType.convolutional(8, 8, 4)))
+        return ComputationGraph(g.build()).init()
+
+    rng = np.random.RandomState(0)
+    x = rng.rand(6, 4, 8, 8)
+    y = np.eye(3)[rng.randint(0, 3, 6)]
+
+    def run(on):
+        enable_helpers(on)
+        net = build()
+        assert net._conv_bn_fusable() == {"c1": "b1", "c2": "b2", "sc": "bs"}
+        losses = [float(net.fit_on_device(x, y, steps=1)[0]) for _ in range(4)]
+        enable_helpers(False)
+        return losses, np.asarray(net.params()), np.asarray(net.output(x))
+
+    try:
+        l_off, p_off, o_off = run(False)
+        l_on, p_on, o_on = run(True)
+    finally:
+        enable_helpers(False)
+    np.testing.assert_allclose(l_on, l_off, rtol=1e-9)
+    np.testing.assert_allclose(p_on, p_off, atol=1e-9)
+    np.testing.assert_allclose(o_on, o_off, atol=1e-9)
+
+
+def test_fusion_skips_multi_consumer_and_nonidentity():
+    """Pattern guard: a conv consumed by two nodes, or with its own
+    activation, must NOT fuse."""
+    from deeplearning4j_tpu import NeuralNetConfiguration
+    from deeplearning4j_tpu.common.enums import (
+        Activation, LossFunction, WeightInit)
+    from deeplearning4j_tpu.nn.conf.input_type import InputType
+    from deeplearning4j_tpu.nn.conf.layers.convolutional import (
+        ConvolutionLayer)
+    from deeplearning4j_tpu.nn.conf.layers.feedforward import OutputLayer
+    from deeplearning4j_tpu.nn.conf.layers.normalization import (
+        BatchNormalization)
+    from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+    from deeplearning4j_tpu.nn.graph.vertices import ElementWiseVertex
+    from deeplearning4j_tpu.nn.updater.updaters import Adam
+
+    g = (NeuralNetConfiguration.Builder().seed(3).dtype("float64")
+         .activation(Activation.IDENTITY).weight_init(WeightInit.XAVIER)
+         .updater(Adam(learning_rate=1e-2)).graph_builder())
+    (g.add_inputs("in")
+      .add_layer("c1", ConvolutionLayer(n_out=4, kernel_size=(1, 1)), "in")
+      .add_layer("b1", BatchNormalization(), "c1")
+      .add_vertex("both", ElementWiseVertex(op="Add"), "b1", "c1")  # 2nd use
+      .add_layer("c2", ConvolutionLayer(n_out=4, kernel_size=(1, 1),
+                                        activation=Activation.RELU), "both")
+      .add_layer("b2", BatchNormalization(), "c2")
+      .add_layer("out", OutputLayer(n_out=2, loss_fn=LossFunction.MCXENT,
+                                    activation=Activation.SOFTMAX), "b2")
+      .set_outputs("out")
+      .set_input_types(InputType.convolutional(2, 2, 4)))
+    net = ComputationGraph(g.build()).init()
+    assert net._conv_bn_fusable() == {}

@@ -83,3 +83,83 @@ def test_lstm_training_identical_with_seam_on():
     s_on, p_on = run()
     assert s_on == pytest.approx(s_off, abs=1e-10)
     assert np.allclose(p_on, p_off, atol=1e-10)
+
+
+def test_graves_gates_kernel_matches_xla_and_grads():
+    """Peephole (Graves) gate kernel: forward parity + custom-VJP parity
+    against jax.grad through the jnp fallback (fp64)."""
+    from deeplearning4j_tpu.ops.pallas_kernels import (
+        graves_gates_pallas, graves_gates_xla)
+    B, H = 8, 128
+    gates = jnp.asarray(RNG.randn(B, 4 * H))
+    c = jnp.asarray(RNG.randn(B, H))
+    pi, pf, po = (jnp.asarray(RNG.randn(H) * 0.1) for _ in range(3))
+    c_p, h_p = graves_gates_pallas(gates, c, pi, pf, po)
+    c_x, h_x = graves_gates_xla(gates, c, pi, pf, po)
+    np.testing.assert_allclose(np.asarray(c_p), np.asarray(c_x), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(h_p), np.asarray(h_x), atol=1e-12)
+
+    def loss_p(*a):
+        cn, hn = graves_gates_pallas(*a)
+        return jnp.sum(jnp.sin(cn) + hn ** 2)
+
+    def loss_x(*a):
+        cn, hn = graves_gates_xla(*a)
+        return jnp.sum(jnp.sin(cn) + hn ** 2)
+
+    gp = jax.grad(loss_p, argnums=(0, 1, 2, 3, 4))(gates, c, pi, pf, po)
+    gx = jax.grad(loss_x, argnums=(0, 1, 2, 3, 4))(gates, c, pi, pf, po)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-10)
+
+
+def test_graves_lstm_training_identical_with_seam_on():
+    """End-to-end: a GravesLSTM (peephole) net trains to the same params with
+    helpers on/off — the ValidateCudnnLSTM pattern for the Graves path."""
+    from deeplearning4j_tpu import (
+        Activation, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+        RnnOutputLayer, Sgd, WeightInit)
+    from deeplearning4j_tpu.nn.conf.layers.recurrent import GravesLSTM
+
+    def run():
+        b = (NeuralNetConfiguration.Builder().seed(9).weight_init(WeightInit.XAVIER)
+             .updater(Sgd(learning_rate=0.1)).dtype("float64").list())
+        b.layer(GravesLSTM(n_out=6, activation=Activation.TANH))
+        b.layer(RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX))
+        net = MultiLayerNetwork(
+            b.set_input_type(InputType.recurrent(3)).build()).init()
+        rng = np.random.RandomState(1)
+        x = rng.rand(4, 3, 7)
+        y = np.eye(2)[rng.randint(0, 2, (4, 7))].transpose(0, 2, 1)
+        for _ in range(5):
+            net.fit_batch(x, y)
+        return float(net.score()), np.asarray(net.params())
+
+    enable_helpers(False)
+    s_off, p_off = run()
+    enable_helpers(True)
+    s_on, p_on = run()
+    assert s_on == pytest.approx(s_off, abs=1e-10)
+    assert np.allclose(p_on, p_off, atol=1e-10)
+
+
+def test_graves_gradient_check_through_helper():
+    """fp64 finite-difference gradient check THROUGH the Pallas peephole
+    kernel (the CuDNNGradientChecks pattern)."""
+    from deeplearning4j_tpu import (
+        Activation, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+        RnnOutputLayer, Sgd, WeightInit)
+    from deeplearning4j_tpu.nn.conf.layers.recurrent import GravesLSTM
+    from deeplearning4j_tpu.gradientcheck import check_gradients
+
+    enable_helpers(True)
+    b = (NeuralNetConfiguration.Builder().seed(3).weight_init(WeightInit.XAVIER)
+         .updater(Sgd(learning_rate=0.1)).dtype("float64").list())
+    b.layer(GravesLSTM(n_out=4, activation=Activation.TANH))
+    b.layer(RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX))
+    net = MultiLayerNetwork(
+        b.set_input_type(InputType.recurrent(3)).build()).init()
+    rng = np.random.RandomState(2)
+    x = rng.rand(3, 3, 5)
+    y = np.eye(2)[rng.randint(0, 2, (3, 5))].transpose(0, 2, 1)
+    assert check_gradients(net, x, y, epsilon=1e-6, max_rel_error=1e-5)
