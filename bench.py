@@ -197,8 +197,10 @@ def bench_graves_lstm(batch=8192, seq_len=100, steps=8,
            "compute_dtype": compute_dtype or "float32",
            "mfu": _sanity_check_peak("graves_lstm", flops, ms)}
     if helpers:
-        out["helpers"] = ("on: fused Pallas Graves-peephole gate kernel "
-                          "(fwd + custom-VJP bwd) in the scan body")
+        out["helpers"] = ("on: whole-sequence fused Graves-LSTM scan kernel "
+                          "(ops/lstm_scan_fused.py — h/c resident in VMEM, "
+                          "remat backward; DEFAULT-ON for TPU users, "
+                          "explicitly disabled in the helpers-off entry)")
     return out
 
 

@@ -106,6 +106,30 @@ class LSTM(FeedForwardLayerConf):
         xt = jnp.moveaxis(x, 2, 0)  # (time, batch, size)
         # one big batched input projection — single MXU matmul over all timesteps
         xw = xt @ params["W"] + params["b"]
+        # helper seam, whole-sequence form (the cuDNN-LSTM analog, ref
+        # CudnnLSTMHelper.java:175): the ENTIRE recurrence as one Pallas
+        # kernel with h/c resident in VMEM (ops/lstm_scan_fused.py). Zero
+        # peepholes reduce exactly to the plain-LSTM math. Masked sequences
+        # keep the lax.scan path (the kernel has no state-hold select).
+        if mask is None and self.gate_activation == Activation.SIGMOID \
+                and self.activation == Activation.TANH:
+            from deeplearning4j_tpu.ops.helpers import (
+                helpers_enabled_for, registered_helpers)
+            from deeplearning4j_tpu.ops.lstm_scan_fused import fits_vmem
+            if helpers_enabled_for("graves_lstm_scan") \
+                    and "graves_lstm_scan" in registered_helpers() \
+                    and fits_vmem(b, n, jnp.dtype(dtype).itemsize):
+                fused = registered_helpers()["graves_lstm_scan"]
+                zero = jnp.zeros((n,), dtype)
+                pi = params.get("pi", zero)
+                pf = params.get("pf", zero)
+                po = params.get("po", zero)
+                xw_k = xw[::-1] if reverse else xw
+                ys, cs = fused(xw_k, params["RW"], pi, pf, po, h, c)
+                h_f, c_f = ys[-1], cs[-1]
+                if reverse:
+                    ys = ys[::-1]
+                return jnp.moveaxis(ys, 0, 2), (h_f, c_f)
         mt = None if mask is None else jnp.moveaxis(mask, 1, 0)[..., None].astype(dtype)
 
         def body(carry, inp):

@@ -277,7 +277,11 @@ class ComputationGraph:
                                            train=train, rng=lrng, mask=mask)
                 values[name], masks[name] = out, m
             else:
-                if isinstance(layer, _LSTM) and rnn_init_states is not None:
+                from deeplearning4j_tpu.nn.conf.layers.recurrent import (
+                    GravesBidirectionalLSTM as _BiLSTM)
+                if isinstance(layer, _LSTM) \
+                        and not isinstance(layer, _BiLSTM) \
+                        and rnn_init_states is not None:
                     # tBPTT segment: scan from the carried state, export final
                     init = rnn_init_states[len(final_rnn)]
                     out, (h, c) = layer._scan(

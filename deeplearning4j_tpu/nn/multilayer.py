@@ -271,7 +271,10 @@ class MultiLayerNetwork:
             lrng = None
             if rng is not None:
                 rng, lrng = jax.random.split(rng)
-            if isinstance(layer, LSTM) and rnn_init_states is not None:
+            from deeplearning4j_tpu.nn.conf.layers.recurrent import (
+                GravesBidirectionalLSTM as _BiLSTM)
+            if isinstance(layer, LSTM) and not isinstance(layer, _BiLSTM) \
+                    and rnn_init_states is not None:
                 init = rnn_init_states[len(final_rnn)]
                 cur, (h, c) = layer._scan(params_tree[i], cur, mask,
                                           h0=None if init is None else init[0],
@@ -280,6 +283,10 @@ class MultiLayerNetwork:
                 new_states.append(state_tree[i])
             else:
                 if isinstance(layer, LSTM):
+                    # bidirectional layers have no streamable state: carry a
+                    # None slot so tBPTT indexing stays aligned (its raw
+                    # param dict is per-direction-suffixed — _scan on it
+                    # used to KeyError on every fit_batch)
                     final_rnn.append(None)
 
                 def fwd(p, s, c, r, m, _layer=layer):

@@ -10,6 +10,8 @@ from deeplearning4j_tpu.ops.helpers import (
     registered_helpers)
 from deeplearning4j_tpu.ops import pallas_kernels  # registers kernels on import
 from deeplearning4j_tpu.ops import conv_fused  # registers conv1x1_bn_act
+from deeplearning4j_tpu.ops import lstm_scan_fused  # registers graves_lstm_scan
 
 __all__ = ["enable_helpers", "helpers_enabled", "helper_for", "register_helper",
-           "registered_helpers", "pallas_kernels", "conv_fused"]
+           "registered_helpers", "pallas_kernels", "conv_fused",
+           "lstm_scan_fused"]

@@ -34,7 +34,8 @@ from deeplearning4j_tpu.ops.helpers import register_helper
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from deeplearning4j_tpu.ops.helpers import interpret_mode
+    return interpret_mode()
 
 
 def _round_up(n, m):
@@ -122,7 +123,21 @@ def conv1x1_bn_act(x, w, gamma, beta, bias, eps: float, relu: bool,
     return out, mean, var
 
 
+def _x64_enabled() -> bool:
+    import jax
+    return bool(jax.config.jax_enable_x64)
+
+
 def _fwd_impl(x, w, gamma, beta, bias, eps, relu, stride):
+    if jnp.dtype(x.dtype).itemsize >= 4 and not _x64_enabled():
+        # fp32 activations with x64 disabled (the production default): the
+        # stats accumulator cannot go one width up (float64 silently
+        # canonicalizes to float32), so the one-pass formula could cancel
+        # catastrophically — take the two-pass XLA composition instead
+        # (normalization.py applies the same rule)
+        out, mean, var = conv1x1_bn_act_xla(x, w, gamma, beta, bias, eps,
+                                            relu, stride)
+        return out, mean, var, None
     B, C_in, H, W = x.shape
     if stride != 1:
         x = x[:, :, ::stride, ::stride]
@@ -217,9 +232,19 @@ def conv1x1_bn_act_xla(x, w, gamma, beta, bias, eps: float, relu: bool,
         x, w[:, :, None, None], window_strides=(1, 1), padding=((0, 0), (0, 0)),
         dimension_numbers=("NCHW", "OIHW", "NCHW"))
     y = y + bias[None, :, None, None]
-    yf = y.astype(jnp.float32)
-    mean = jnp.mean(yf, axis=(0, 2, 3))
-    var = jnp.maximum(jnp.mean(yf * yf, axis=(0, 2, 3)) - mean * mean, 0.0)
+    if jnp.dtype(x.dtype).itemsize < 4:
+        # one-pass stats, fp32-accumulated (XLA fuses the sibling reductions
+        # into one read; safe headroom above sub-fp32 activations)
+        yf = y.astype(jnp.float32)
+        mean = jnp.mean(yf, axis=(0, 2, 3))
+        var = jnp.maximum(jnp.mean(yf * yf, axis=(0, 2, 3)) - mean * mean,
+                          0.0)
+    else:
+        # fp32/fp64: shifted two-pass var — the one-pass formula in
+        # same-width arithmetic cancels when |mean| >> std (ADVICE r3 low#1)
+        yf = y
+        mean = jnp.mean(yf, axis=(0, 2, 3))
+        var = jnp.var(yf, axis=(0, 2, 3))
     invstd = jax.lax.rsqrt(var + eps)
     out = (yf - mean[None, :, None, None]) * invstd[None, :, None, None] \
         * gamma.astype(jnp.float32)[None, :, None, None] \

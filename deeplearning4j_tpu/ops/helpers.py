@@ -15,33 +15,62 @@ import os
 from typing import Callable, Dict, Optional
 
 _REGISTRY: Dict[str, Callable] = {}
+_DEFAULT_ON: set = set()
 _ENABLED: Optional[bool] = None
 
 
-def register_helper(op_name: str):
-    """Decorator: register an accelerated implementation for `op_name`."""
+def interpret_mode() -> bool:
+    """Pallas kernels run interpreted off-TPU so the CPU test mesh exercises
+    the same code path (single policy point for every kernel module)."""
+    import jax
+    return jax.default_backend() != "tpu"
+
+
+def register_helper(op_name: str, default_on: bool = False):
+    """Decorator: register an accelerated implementation for `op_name`.
+    `default_on=True` marks kernels that engage automatically on TPU when
+    nothing was set explicitly — the reference's 'cuDNN used when supported'
+    behavior (ConvolutionLayer.java:72 reflection-load) — reserved for
+    kernels with a MEASURED same-session win and exact-parity tests."""
     def deco(fn):
         _REGISTRY[op_name] = fn
+        if default_on:
+            _DEFAULT_ON.add(op_name)
         return fn
     return deco
 
 
-def enable_helpers(flag: bool = True) -> None:
-    """Programmatic switch (env DL4J_TPU_HELPERS=1 also enables)."""
+def enable_helpers(flag: Optional[bool] = True) -> None:
+    """Programmatic switch (env DL4J_TPU_HELPERS=1/0 also works; None resets
+    to the default policy: default_on kernels engage on TPU only)."""
     global _ENABLED
-    _ENABLED = bool(flag)
+    _ENABLED = None if flag is None else bool(flag)
 
 
 def helpers_enabled() -> bool:
+    """The explicit global switch (ignores per-op defaults)."""
     if _ENABLED is not None:
         return _ENABLED
     return os.environ.get("DL4J_TPU_HELPERS", "0") == "1"
 
 
+def helpers_enabled_for(op_name: str) -> bool:
+    """Per-op resolution: explicit switch > env var > per-op TPU default."""
+    if _ENABLED is not None:
+        return _ENABLED
+    env = os.environ.get("DL4J_TPU_HELPERS")
+    if env is not None and env in ("0", "1"):
+        return env == "1"
+    if op_name in _DEFAULT_ON:
+        import jax
+        return jax.default_backend() == "tpu"
+    return False
+
+
 def helper_for(op_name: str, fallback: Callable) -> Callable:
     """The seam: accelerated impl if registered+enabled, else the fallback
     (ref LayerHelper selection in BaseLayer.initializeHelper)."""
-    if helpers_enabled() and op_name in _REGISTRY:
+    if op_name in _REGISTRY and helpers_enabled_for(op_name):
         return _REGISTRY[op_name]
     return fallback
 

@@ -26,7 +26,8 @@ from deeplearning4j_tpu.ops.helpers import register_helper
 
 
 def _interpret() -> bool:
-    return jax.default_backend() != "tpu"
+    from deeplearning4j_tpu.ops.helpers import interpret_mode
+    return interpret_mode()
 
 
 # ------------------------------------------------------------------ lstm gates

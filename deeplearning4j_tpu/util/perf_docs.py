@@ -32,6 +32,10 @@ def load_artifact(root: str | None = None) -> dict:
         return json.load(f)
 
 
+def _pct(v) -> str:
+    return "n/a" if v is None else f"{v:.1%}"
+
+
 def render_block(art: dict) -> str:
     """Markdown bullet block rendered VERBATIM into README.md and PERF.md."""
     e = art["extra"]
@@ -49,9 +53,9 @@ def render_block(art: dict) -> str:
         f"- Headline: **{art['value']:,.0f} {art['unit']}** "
         f"({art['metric']}), {art['vs_baseline']}x the round-1 fp32 baseline.",
         f"- ResNet50 bf16 b{r['batch']}: {r['images_per_sec']:,.0f} img/s, "
-        f"{r['ms_per_iter']:.2f} ms/iter, MFU {r['mfu']:.1%}"
+        f"{r['ms_per_iter']:.2f} ms/iter, MFU {_pct(r['mfu'])}"
         + (f"; helpers-on (fused conv1x1+BN+relu): "
-           f"{rh['images_per_sec']:,.0f} img/s, MFU {rh['mfu']:.1%}"
+           f"{rh['images_per_sec']:,.0f} img/s, MFU {_pct(rh['mfu'])}"
            if rh.get("images_per_sec") else "") + ".",
     ]
     if roof.get("hand_lb_ms"):
@@ -67,10 +71,10 @@ def render_block(art: dict) -> str:
             f"the step is HBM-bandwidth-bound, not compute-bound.")
     lines.append(
         f"- GravesLSTM char-RNN b{lstm['batch']}x{lstm['seq_len']}: "
-        f"{lstm['tokens_per_sec'] / 1e6:.2f}M tokens/s, MFU {lstm['mfu']:.1%}"
-        + (f"; helpers-on (Pallas peephole gate kernel): "
-           f"{lstmh['tokens_per_sec'] / 1e6:.2f}M tokens/s, "
-           f"MFU {lstmh['mfu']:.1%}"
+        f"{lstm['tokens_per_sec'] / 1e6:.2f}M tokens/s, MFU {_pct(lstm['mfu'])}"
+        + (f"; helpers-on (fused whole-sequence scan kernel, default on "
+           f"TPU): {lstmh['tokens_per_sec'] / 1e6:.2f}M tokens/s, "
+           f"MFU {_pct(lstmh['mfu'])}"
            if lstmh.get("tokens_per_sec") else "") + ".")
     lines.append(
         f"- LeNet MNIST step: {e['lenet_mnist_step_ms']:.2f} ms "

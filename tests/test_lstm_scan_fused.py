@@ -1,0 +1,180 @@
+"""Whole-sequence fused Graves-LSTM scan kernel (the cuDNN-LSTM analog,
+ref CudnnLSTMHelper.java:175): forward + custom-VJP backward must match the
+lax.scan composition exactly (fp64) — the ValidateCudnnLSTM pattern."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.lstm_scan_fused import (
+    graves_lstm_scan_pallas, graves_lstm_scan_xla)
+
+RNG = np.random.RandomState(7)
+
+
+def _data(T=9, B=16, H=8, dtype=np.float64):
+    xw = jnp.asarray(RNG.randn(T, B, 4 * H).astype(dtype) * 0.5)
+    rw = jnp.asarray(RNG.randn(H, 4 * H).astype(dtype) * 0.3)
+    pi, pf, po = (jnp.asarray(RNG.randn(H).astype(dtype) * 0.1)
+                  for _ in range(3))
+    h0 = jnp.asarray(RNG.randn(B, H).astype(dtype) * 0.2)
+    c0 = jnp.asarray(RNG.randn(B, H).astype(dtype) * 0.2)
+    return xw, rw, pi, pf, po, h0, c0
+
+
+def test_forward_matches_scan_fp64():
+    args = _data()
+    ys_p, cs_p = graves_lstm_scan_pallas(*args)
+    ys_x, cs_x = graves_lstm_scan_xla(*args)
+    np.testing.assert_allclose(np.asarray(ys_p), np.asarray(ys_x), atol=1e-12)
+    np.testing.assert_allclose(np.asarray(cs_p), np.asarray(cs_x), atol=1e-12)
+
+
+def test_forward_batch_tiling():
+    # B=16 with a forced smaller tile via a second call shape (B=8 -> bt=8)
+    args = _data(T=5, B=8, H=8)
+    ys_p, cs_p = graves_lstm_scan_pallas(*args)
+    ys_x, cs_x = graves_lstm_scan_xla(*args)
+    np.testing.assert_allclose(np.asarray(ys_p), np.asarray(ys_x), atol=1e-12)
+
+
+@pytest.mark.parametrize("use_dcs", [False, True])
+def test_backward_matches_scan_autodiff_fp64(use_dcs):
+    args = _data(T=7, B=8, H=8)
+
+    def loss(fn):
+        def f(*a):
+            ys, cs = fn(*a)
+            val = jnp.sum(jnp.sin(ys)) + jnp.sum(ys[-1] ** 2)
+            if use_dcs:
+                val = val + jnp.sum(jnp.cos(cs)) + jnp.sum(cs[-1] * 0.5)
+            return val
+        return f
+
+    gp = jax.grad(loss(graves_lstm_scan_pallas),
+                  argnums=tuple(range(7)))(*args)
+    gx = jax.grad(loss(graves_lstm_scan_xla), argnums=tuple(range(7)))(*args)
+    names = ("dxw", "drw", "dpi", "dpf", "dpo", "dh0", "dc0")
+    for n, a, b in zip(names, gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-9,
+                                   err_msg=n)
+
+
+def test_fp64_finite_differences_through_kernel():
+    args = _data(T=4, B=4, H=8)
+    shapes = [a.shape for a in args]
+    sizes = [int(np.prod(s)) for s in shapes]
+
+    def loss(flat):
+        parts, i = [], 0
+        for s, n in zip(shapes, sizes):
+            parts.append(flat[i:i + n].reshape(s))
+            i += n
+        ys, cs = graves_lstm_scan_pallas(*parts)
+        return jnp.sum(jnp.tanh(ys)) + jnp.sum(cs ** 2) * 0.1
+
+    flat = jnp.concatenate([a.reshape(-1) for a in args])
+    ana = np.asarray(jax.grad(loss)(flat))
+    eps = 1e-6
+    for i in RNG.choice(flat.size, 30, replace=False):
+        e = jnp.zeros_like(flat).at[i].set(eps)
+        num = (float(loss(flat + e)) - float(loss(flat - e))) / (2 * eps)
+        denom = max(abs(num), abs(ana[i]), 1e-8)
+        assert abs(num - ana[i]) / denom < 1e-5, (i, num, ana[i])
+
+
+def test_multi_batch_tile_parity(monkeypatch):
+    """nb > 1: the VMEM state carries must be per-tile rows, not a shared
+    buffer (regression: a (bt, H) scratch was clobbered between tiles)."""
+    import deeplearning4j_tpu.ops.lstm_scan_fused as m
+    monkeypatch.setattr(m, "_pick_bt",
+                    lambda B, H, dtype_bytes=2, bwd=False: B // 4)
+    args = _data(T=6, B=16, H=8)
+    ys_p, cs_p = m.graves_lstm_scan_pallas(*args)
+    ys_x, cs_x = graves_lstm_scan_xla(*args)
+    np.testing.assert_allclose(np.asarray(ys_p), np.asarray(ys_x), atol=1e-12)
+
+    def loss(fn):
+        return lambda *a: jnp.sum(jnp.sin(fn(*a)[0]))
+
+    gp = jax.grad(loss(m.graves_lstm_scan_pallas),
+                  argnums=tuple(range(7)))(*args)
+    gx = jax.grad(loss(graves_lstm_scan_xla), argnums=tuple(range(7)))(*args)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-9)
+
+
+def test_net_level_training_identical_with_fused_scan(monkeypatch):
+    """GravesLSTM + plain LSTM nets train to identical fp64 params with the
+    fused-scan helper on/off (ValidateCudnnLSTM pattern, sequence form),
+    including a bidirectional net (reverse path)."""
+    from deeplearning4j_tpu import (
+        Activation, InputType, LSTM, MultiLayerNetwork,
+        NeuralNetConfiguration, RnnOutputLayer, Sgd, WeightInit)
+    from deeplearning4j_tpu.nn.conf.layers.recurrent import (
+        GravesBidirectionalLSTM, GravesLSTM)
+    from deeplearning4j_tpu.ops.helpers import enable_helpers
+
+    def run(layer_cls, on):
+        enable_helpers(on)
+        b = (NeuralNetConfiguration.Builder().seed(9)
+             .weight_init(WeightInit.XAVIER)
+             .updater(Sgd(learning_rate=0.1)).dtype("float64").list())
+        b.layer(layer_cls(n_out=6, activation=Activation.TANH))
+        b.layer(RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX))
+        net = MultiLayerNetwork(
+            b.set_input_type(InputType.recurrent(3)).build()).init()
+        rng = np.random.RandomState(1)
+        x = rng.rand(4, 3, 7)
+        y = np.eye(2)[rng.randint(0, 2, (4, 7))].transpose(0, 2, 1)
+        for _ in range(5):
+            net.fit_batch(x, y)
+        enable_helpers(False)
+        return float(net.score()), np.asarray(net.params())
+
+    try:
+        for cls in (GravesLSTM, LSTM, GravesBidirectionalLSTM):
+            s_off, p_off = run(cls, False)
+            s_on, p_on = run(cls, True)
+            assert s_on == pytest.approx(s_off, abs=1e-10), cls.__name__
+            np.testing.assert_allclose(p_on, p_off, atol=1e-10,
+                                       err_msg=cls.__name__)
+    finally:
+        enable_helpers(False)
+
+
+def test_masked_sequences_keep_the_scan_path():
+    """Masks must fall back to lax.scan (the kernel has no state-hold):
+    masked training with helpers on == helpers off exactly."""
+    from deeplearning4j_tpu import (
+        Activation, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+        RnnOutputLayer, Sgd, WeightInit)
+    from deeplearning4j_tpu.nn.conf.layers.recurrent import GravesLSTM
+    from deeplearning4j_tpu.ops.helpers import enable_helpers
+
+    def run(on):
+        enable_helpers(on)
+        b = (NeuralNetConfiguration.Builder().seed(3)
+             .weight_init(WeightInit.XAVIER)
+             .updater(Sgd(learning_rate=0.1)).dtype("float64").list())
+        b.layer(GravesLSTM(n_out=5, activation=Activation.TANH))
+        b.layer(RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX))
+        net = MultiLayerNetwork(
+            b.set_input_type(InputType.recurrent(3)).build()).init()
+        rng = np.random.RandomState(2)
+        x = rng.rand(4, 3, 6)
+        y = np.eye(2)[rng.randint(0, 2, (4, 6))].transpose(0, 2, 1)
+        mask = (rng.rand(4, 6) > 0.3).astype(np.float64)
+        mask[:, 0] = 1.0
+        for _ in range(3):
+            net.fit_batch(x, y, fmask=mask, lmask=mask)
+        enable_helpers(False)
+        return np.asarray(net.params())
+
+    try:
+        p_off = run(False)
+        p_on = run(True)
+    finally:
+        enable_helpers(False)
+    np.testing.assert_allclose(p_on, p_off, atol=1e-12)

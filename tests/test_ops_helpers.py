@@ -163,3 +163,31 @@ def test_graves_gradient_check_through_helper():
     x = rng.rand(3, 3, 5)
     y = np.eye(2)[rng.randint(0, 2, (3, 5))].transpose(0, 2, 1)
     assert check_gradients(net, x, y, epsilon=1e-6, max_rel_error=1e-5)
+
+
+def test_default_on_policy_engages_only_on_tpu(monkeypatch):
+    """default_on kernels (the fused LSTM scan) follow the reference's
+    'cuDNN used when supported' behavior: auto-on for TPU backends, off on
+    CPU, always overridable by the explicit switch / env var."""
+    import deeplearning4j_tpu.ops.helpers as h
+    import deeplearning4j_tpu.ops.lstm_scan_fused  # noqa: F401 registers
+
+    assert "graves_lstm_scan" in h._DEFAULT_ON
+    enable_helpers(None)  # reset to default policy
+    monkeypatch.delenv("DL4J_TPU_HELPERS", raising=False)
+    # CPU backend (tests): default policy keeps everything off
+    assert not h.helpers_enabled_for("graves_lstm_scan")
+    assert not h.helpers_enabled_for("lstm_gates")
+    # simulated TPU backend: default_on kernels engage, others stay off
+    import jax as _jax
+    monkeypatch.setattr(_jax, "default_backend", lambda: "tpu")
+    assert h.helpers_enabled_for("graves_lstm_scan")
+    assert not h.helpers_enabled_for("lstm_gates")
+    # explicit switch wins in both directions
+    enable_helpers(False)
+    assert not h.helpers_enabled_for("graves_lstm_scan")
+    enable_helpers(True)
+    assert h.helpers_enabled_for("lstm_gates")
+    enable_helpers(None)
+    monkeypatch.setenv("DL4J_TPU_HELPERS", "0")
+    assert not h.helpers_enabled_for("graves_lstm_scan")
