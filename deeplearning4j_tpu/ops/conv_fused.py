@@ -246,9 +246,10 @@ def conv1x1_bn_act_xla(x, w, gamma, beta, bias, eps: float, relu: bool,
         mean = jnp.mean(yf, axis=(0, 2, 3))
         var = jnp.var(yf, axis=(0, 2, 3))
     invstd = jax.lax.rsqrt(var + eps)
+    pdt = yf.dtype  # fp32 for sub-fp32 activations, fp64 stays fp64
     out = (yf - mean[None, :, None, None]) * invstd[None, :, None, None] \
-        * gamma.astype(jnp.float32)[None, :, None, None] \
-        + beta.astype(jnp.float32)[None, :, None, None]
+        * gamma.astype(pdt)[None, :, None, None] \
+        + beta.astype(pdt)[None, :, None, None]
     if relu:
         out = jnp.maximum(out, 0.0)
     return out.astype(x.dtype), mean, var

@@ -59,8 +59,8 @@ def helpers_enabled_for(op_name: str) -> bool:
     if _ENABLED is not None:
         return _ENABLED
     env = os.environ.get("DL4J_TPU_HELPERS")
-    if env is not None and env in ("0", "1"):
-        return env == "1"
+    if env is not None:
+        return env == "1"  # same parse as helpers_enabled: only "1" enables
     if op_name in _DEFAULT_ON:
         import jax
         return jax.default_backend() == "tpu"

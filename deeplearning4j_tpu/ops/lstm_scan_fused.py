@@ -8,8 +8,10 @@ matmul, gate fusion, state select); at bench shapes the scan is
 overhead-bound, not FLOP- or bandwidth-bound. This kernel runs the ENTIRE
 recurrence as one `pallas_call`:
 
-- grid (T, B/bt): time-major sequential; h and c live in VMEM scratch across
-  every grid step — the recurrent state never touches HBM;
+- grid (B/bt, T): BATCH-major — each batch tile runs its whole time sweep
+  before the next tile starts, so only a (bt, H) h/c scratch is resident
+  (the recurrent state never touches HBM) and the tile size is limited by
+  the streamed blocks alone, not by B;
 - per step: xw_t block streams in (double-buffered DMA under the grid
   pipeline), gates = xw_t + h @ RW on the MXU, peephole cell update on the
   VPU, h_t/c_t blocks stream out;
@@ -45,54 +47,62 @@ def _interpret() -> bool:
 VMEM_BUDGET = 14 * 1024 * 1024  # headroom under Mosaic's 16 MB scoped limit
 
 
-def _vmem_cost(B: int, H: int, db: int, bt: int, bwd: bool) -> int:
-    """Estimated resident VMEM: full (B, H) h/c carries + double-buffered
-    streamed blocks. Per-row block bytes: fwd = 2x xw(4H) + 2x2x out(H) +
-    2x2x init(H) = 16*H*db; bwd adds dxw out and four streamed (bt, H)
-    inputs = 28*H*db, plus the fp32 dRW/peephole accumulators."""
-    scratch = 2 * B * H * db + (4 * H * H * 4 + 3 * H * 4 if bwd else 0)
-    per_row = (28 if bwd else 16) * H * db
-    return scratch + bt * per_row
+def _vmem_cost(H: int, db: int, bt: int, bwd: bool) -> int:
+    """Estimated resident VMEM (batch-major grid): (bt, H) h/c carries x2 +
+    double-buffered streamed blocks. Per-row block bytes: fwd = 2x xw(4H) +
+    2x2x out(H) + 2x2x init(H) = 16*H*db; bwd adds dxw out and four
+    streamed (bt, H) inputs = 28*H*db, plus the fp32 dRW/peephole
+    accumulators."""
+    acc = 4 * H * H * 4 + 3 * H * 4 if bwd else 0
+    per_row = 2 * H * db + (28 if bwd else 16) * H * db
+    return acc + bt * per_row
 
 
 def _pick_bt(B: int, H: int, dtype_bytes: int = 2, bwd: bool = False) -> int:
-    """Largest batch tile whose streamed blocks fit beside the resident
-    (B, H) state scratch."""
-    for bt in (1024, 512, 256, 128, 64, 32, 16, 8):
-        if bt > B or B % bt:
+    """Largest VMEM-fitting batch tile; B is PADDED up to a tile multiple by
+    the callers (zero rows compute garbage that is sliced off; their zero
+    cotangents contribute nothing to parameter gradients)."""
+    for bt in (2048, 1024, 512, 256, 128, 64, 32, 16, 8):
+        if bt > B:
             continue
-        if _vmem_cost(B, H, dtype_bytes, bt, bwd) <= VMEM_BUDGET:
+        if _vmem_cost(H, dtype_bytes, bt, bwd) <= VMEM_BUDGET:
             return bt
     return min(B, 8)
+
+
+def _pad_batch(a, Bp):
+    """Zero-pad dim 1 (batch) of a (T/1, B, ...) array up to Bp rows."""
+    if a.shape[1] == Bp:
+        return a
+    pad = [(0, 0)] * a.ndim
+    pad[1] = (0, Bp - a.shape[1])
+    return jnp.pad(a, pad)
 
 
 def fits_vmem(B: int, H: int, dtype_bytes: int = 2) -> bool:
     """Callers fall back to lax.scan when even the smallest tile cannot fit —
     the kernel is default-on, so oversize batches must degrade gracefully,
     not fail to compile."""
-    return _vmem_cost(B, H, dtype_bytes, min(B, 8), bwd=True) <= VMEM_BUDGET
+    return _vmem_cost(H, dtype_bytes, min(B, 8), bwd=True) <= VMEM_BUDGET
 
 
 def _fwd_kernel(xw_ref, rw_ref, pi_ref, pf_ref, po_ref, h0_ref, c0_ref,
                 ys_ref, cs_ref, h_scr, c_scr):
-    """One (t, b) grid step of the forward recurrence. h_scr/c_scr hold the
-    FULL (B, H) state (every batch tile has its own rows — a per-tile
-    scratch would be clobbered between tiles of the same timestep)."""
+    """One (b, t) grid step of the forward recurrence. BATCH-major grid:
+    tile b finishes its entire time sweep before tile b+1 starts, so the
+    (bt, H) scratch is private to the running tile."""
     from jax.experimental import pallas as pl
-    t = pl.program_id(0)
-    b = pl.program_id(1)
+    t = pl.program_id(1)
     acc = jnp.promote_types(xw_ref.dtype, jnp.float32)
     H = c0_ref.shape[-1]
-    bt = xw_ref.shape[1]
-    rows = pl.ds(b * bt, bt)
 
     @pl.when(t == 0)
     def _():  # adopt the initial state for this batch tile
-        h_scr[rows] = h0_ref[0]
-        c_scr[rows] = c0_ref[0]
+        h_scr[:] = h0_ref[0]
+        c_scr[:] = c0_ref[0]
 
-    h_t = h_scr[rows]                               # (bt, H) storage dtype
-    c = c_scr[rows].astype(acc)
+    h_t = h_scr[:]                                  # (bt, H) storage dtype
+    c = c_scr[:].astype(acc)
     gates = xw_ref[0].astype(acc) + jnp.dot(
         h_t, rw_ref[:], preferred_element_type=acc)
     pi = pi_ref[:].astype(acc)
@@ -104,8 +114,8 @@ def _fwd_kernel(xw_ref, rw_ref, pi_ref, pf_ref, po_ref, h0_ref, c0_ref,
     c_new = f * c + i * g
     o = jax.nn.sigmoid(gates[:, 2 * H:3 * H] + c_new * po)
     h_new = o * jnp.tanh(c_new)
-    h_scr[rows] = h_new.astype(h_scr.dtype)
-    c_scr[rows] = c_new.astype(c_scr.dtype)
+    h_scr[:] = h_new.astype(h_scr.dtype)
+    c_scr[:] = c_new.astype(c_scr.dtype)
     ys_ref[0] = h_new.astype(ys_ref.dtype)
     cs_ref[0] = c_new.astype(cs_ref.dtype)
 
@@ -126,35 +136,39 @@ def _scan_fwd_impl(xw, rw, pi, pf, po, h0, c0):
     T, B, H4 = xw.shape
     H = H4 // 4
     bt = _pick_bt(B, H, jnp.dtype(xw.dtype).itemsize)
-    nb = B // bt
+    Bp = -(-B // bt) * bt
+    nb = Bp // bt
+    xw = _pad_batch(xw, Bp)
+    h0p = _pad_batch(h0[None], Bp)
+    c0p = _pad_batch(c0[None], Bp)
     p2 = lambda v: v.reshape(1, H)
     ys, cs = pl.pallas_call(
         _fwd_kernel,
-        grid=(T, nb),
+        grid=(nb, T),
         in_specs=[
-            pl.BlockSpec((1, bt, 4 * H), lambda t, b: (t, b, 0)),
-            pl.BlockSpec((H, 4 * H), lambda t, b: (0, 0)),
-            pl.BlockSpec((1, H), lambda t, b: (0, 0)),
-            pl.BlockSpec((1, H), lambda t, b: (0, 0)),
-            pl.BlockSpec((1, H), lambda t, b: (0, 0)),
-            pl.BlockSpec((1, bt, H), lambda t, b: (0, b, 0)),
-            pl.BlockSpec((1, bt, H), lambda t, b: (0, b, 0)),
+            pl.BlockSpec((1, bt, 4 * H), lambda b, t: (t, b, 0)),
+            pl.BlockSpec((H, 4 * H), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, H), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, H), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, H), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, bt, H), lambda b, t: (0, b, 0)),
+            pl.BlockSpec((1, bt, H), lambda b, t: (0, b, 0)),
         ],
         out_specs=(
-            pl.BlockSpec((1, bt, H), lambda t, b: (t, b, 0)),
-            pl.BlockSpec((1, bt, H), lambda t, b: (t, b, 0)),
+            pl.BlockSpec((1, bt, H), lambda b, t: (t, b, 0)),
+            pl.BlockSpec((1, bt, H), lambda b, t: (t, b, 0)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((T, B, H), xw.dtype),
-            jax.ShapeDtypeStruct((T, B, H), xw.dtype),
+            jax.ShapeDtypeStruct((T, Bp, H), xw.dtype),
+            jax.ShapeDtypeStruct((T, Bp, H), xw.dtype),
         ),
         scratch_shapes=[
-            pltpu.VMEM((B, H), xw.dtype),
-            pltpu.VMEM((B, H), xw.dtype),
+            pltpu.VMEM((bt, H), xw.dtype),
+            pltpu.VMEM((bt, H), xw.dtype),
         ],
         interpret=_interpret(),
-    )(xw, rw, p2(pi), p2(pf), p2(po), h0[None], c0[None])
-    return ys, cs
+    )(xw, rw, p2(pi), p2(pf), p2(po), h0p, c0p)
+    return ys[:, :B], cs[:, :B]
 
 
 def _scan_fwd(xw, rw, pi, pf, po, h0, c0):
@@ -170,7 +184,8 @@ def _scan_bwd(saved, cots):
     T, B, H4 = xw.shape
     H = H4 // 4
     bt = _pick_bt(B, H, jnp.dtype(xw.dtype).itemsize, bwd=True)
-    nb = B // bt
+    Bp = -(-B // bt) * bt
+    nb = Bp // bt
     p2 = lambda v: v.reshape(1, H)
     # dcs cotangents: cs is exposed mainly for the bwd itself; fold any
     # incoming dcs into dys-equivalent handling by adding dcs to the carried
@@ -179,19 +194,22 @@ def _scan_bwd(saved, cots):
     # dcs_t into dc BEFORE the gate backward of step t. Implementation:
     # absorb via an adjusted dys' = dys and initial-carry trick is NOT exact
     # for general dcs, so we add dcs inside the kernel stream instead.
-    hprev = jnp.concatenate([h0[None], ys[:-1]], axis=0)
-    cprev = jnp.concatenate([c0[None], cs[:-1]], axis=0)
+    hprev = _pad_batch(jnp.concatenate([h0[None], ys[:-1]], axis=0), Bp)
+    cprev = _pad_batch(jnp.concatenate([c0[None], cs[:-1]], axis=0), Bp)
+    xw = _pad_batch(xw, Bp)
+    dys = _pad_batch(dys, Bp)
+    dcs = _pad_batch(dcs, Bp)
     acc = jnp.promote_types(xw.dtype, jnp.float32)
-    rev = lambda t, b: (T - 1 - t, b, 0)
+    rev = lambda b, t: (T - 1 - t, b, 0)
     dxw, drw, dpi, dpf, dpo, dh0, dc0 = pl.pallas_call(
         functools.partial(_bwd_kernel_with_dcs),
-        grid=(T, nb),
+        grid=(nb, T),
         in_specs=[
             pl.BlockSpec((1, bt, 4 * H), rev),
-            pl.BlockSpec((H, 4 * H), lambda t, b: (0, 0)),
-            pl.BlockSpec((1, H), lambda t, b: (0, 0)),
-            pl.BlockSpec((1, H), lambda t, b: (0, 0)),
-            pl.BlockSpec((1, H), lambda t, b: (0, 0)),
+            pl.BlockSpec((H, 4 * H), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, H), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, H), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, H), lambda b, t: (0, 0)),
             pl.BlockSpec((1, bt, H), rev),
             pl.BlockSpec((1, bt, H), rev),
             pl.BlockSpec((1, bt, H), rev),
@@ -199,33 +217,34 @@ def _scan_bwd(saved, cots):
         ],
         out_specs=(
             pl.BlockSpec((1, bt, 4 * H), rev),
-            pl.BlockSpec((H, 4 * H), lambda t, b: (0, 0)),
-            pl.BlockSpec((1, H), lambda t, b: (0, 0)),
-            pl.BlockSpec((1, H), lambda t, b: (0, 0)),
-            pl.BlockSpec((1, H), lambda t, b: (0, 0)),
-            pl.BlockSpec((1, bt, H), lambda t, b: (0, b, 0)),
-            pl.BlockSpec((1, bt, H), lambda t, b: (0, b, 0)),
+            pl.BlockSpec((H, 4 * H), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, H), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, H), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, H), lambda b, t: (0, 0)),
+            pl.BlockSpec((1, bt, H), lambda b, t: (0, b, 0)),
+            pl.BlockSpec((1, bt, H), lambda b, t: (0, b, 0)),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((T, B, 4 * H), xw.dtype),
+            jax.ShapeDtypeStruct((T, Bp, 4 * H), xw.dtype),
             jax.ShapeDtypeStruct((H, 4 * H), acc),
             jax.ShapeDtypeStruct((1, H), acc),
             jax.ShapeDtypeStruct((1, H), acc),
             jax.ShapeDtypeStruct((1, H), acc),
-            jax.ShapeDtypeStruct((1, B, H), xw.dtype),
-            jax.ShapeDtypeStruct((1, B, H), xw.dtype),
+            jax.ShapeDtypeStruct((1, Bp, H), xw.dtype),
+            jax.ShapeDtypeStruct((1, Bp, H), xw.dtype),
         ),
         scratch_shapes=[
-            pltpu.VMEM((B, H), xw.dtype),
-            pltpu.VMEM((B, H), xw.dtype),
+            pltpu.VMEM((bt, H), xw.dtype),
+            pltpu.VMEM((bt, H), xw.dtype),
             pltpu.VMEM((H, 4 * H), acc),
             pltpu.VMEM((3, H), acc),
         ],
         interpret=_interpret(),
     )(xw, rw, p2(pi), p2(pf), p2(po), hprev, cprev, dys, dcs)
-    return (dxw, drw.astype(rw.dtype), dpi.reshape(H).astype(pi.dtype),
+    return (dxw[:, :B], drw.astype(rw.dtype),
+            dpi.reshape(H).astype(pi.dtype),
             dpf.reshape(H).astype(pf.dtype), dpo.reshape(H).astype(po.dtype),
-            dh0[0], dc0[0])
+            dh0[0, :B], dc0[0, :B])
 
 
 def _bwd_kernel_with_dcs(xw_ref, rw_ref, pi_ref, pf_ref, po_ref,
@@ -234,18 +253,17 @@ def _bwd_kernel_with_dcs(xw_ref, rw_ref, pi_ref, pf_ref, po_ref,
                          dh0_ref, dc0_ref, dh_scr, dc_scr, drw_scr, dp_scr):
     """Reverse-step kernel, with cs-cotangents folded into the carried dc."""
     from jax.experimental import pallas as pl
-    t = pl.program_id(0)
-    nb = pl.num_programs(1)
-    b = pl.program_id(1)
+    b = pl.program_id(0)
+    t = pl.program_id(1)          # 0 .. T-1, reversed via the index maps
+    nb = pl.num_programs(0)
     acc = jnp.promote_types(xw_ref.dtype, jnp.float32)
     H = pi_ref.shape[-1]
     bt = xw_ref.shape[1]
-    rows = pl.ds(b * bt, bt)  # dh/dc scratch holds the FULL (B, H) carries
 
     @pl.when(t == 0)
-    def _():
-        dh_scr[rows] = jnp.zeros((bt, H), dh_scr.dtype)
-        dc_scr[rows] = jnp.zeros((bt, H), dc_scr.dtype)
+    def _():  # start of this tile's reversed sweep
+        dh_scr[:] = jnp.zeros_like(dh_scr)
+        dc_scr[:] = jnp.zeros_like(dc_scr)
 
     @pl.when((t == 0) & (b == 0))
     def _():
@@ -265,8 +283,8 @@ def _bwd_kernel_with_dcs(xw_ref, rw_ref, pi_ref, pf_ref, po_ref,
     c_new = f * c_prev + i * g
     o = jax.nn.sigmoid(gates[:, 2 * H:3 * H] + c_new * po)
     t_new = jnp.tanh(c_new)
-    dh = dys_ref[0].astype(acc) + dh_scr[rows].astype(acc)
-    dc_in = dc_scr[rows].astype(acc) + dcs_ref[0].astype(acc)
+    dh = dys_ref[0].astype(acc) + dh_scr[:].astype(acc)
+    dc_in = dc_scr[:].astype(acc) + dcs_ref[0].astype(acc)
     one = jnp.ones((), acc)
     dzo = dh * t_new * o * (one - o)
     dct = dc_in + dh * o * (one - t_new * t_new) + dzo * po
@@ -278,8 +296,8 @@ def _bwd_kernel_with_dcs(xw_ref, rw_ref, pi_ref, pf_ref, po_ref,
     dgl = dgates.astype(h_prev.dtype)
     dh_prev = jnp.dot(dgl, rw_ref[:].T, preferred_element_type=acc)
     dc_prev = dct * f + dzi * pi + dzf * pf
-    dh_scr[rows] = dh_prev.astype(dh_scr.dtype)
-    dc_scr[rows] = dc_prev.astype(dc_scr.dtype)
+    dh_scr[:] = dh_prev.astype(dh_scr.dtype)
+    dc_scr[:] = dc_prev.astype(dc_scr.dtype)
     drw_scr[:] += jnp.dot(h_prev.T, dgl,
                           preferred_element_type=drw_scr.dtype)
     dp_scr[0:1] += jnp.sum(dzi * c_prev, axis=0,
@@ -289,17 +307,19 @@ def _bwd_kernel_with_dcs(xw_ref, rw_ref, pi_ref, pf_ref, po_ref,
     dp_scr[2:3] += jnp.sum(dzo * c_new, axis=0,
                            keepdims=True).astype(dp_scr.dtype)
 
-    @pl.when((t == pl.num_programs(0) - 1) & (b == nb - 1))
+    T_ = pl.num_programs(1)
+
+    @pl.when((t == T_ - 1) & (b == nb - 1))
     def _():
         drw_ref[:] = drw_scr[:]
         dpi_ref[:] = dp_scr[0:1]
         dpf_ref[:] = dp_scr[1:2]
         dpo_ref[:] = dp_scr[2:3]
 
-    @pl.when(t == pl.num_programs(0) - 1)
-    def _():
-        dh0_ref[0] = dh_scr[rows].astype(dh0_ref.dtype)
-        dc0_ref[0] = dc_scr[rows].astype(dc0_ref.dtype)
+    @pl.when(t == T_ - 1)
+    def _():  # after processing t=0 (reversed), the carries are dh0/dc0
+        dh0_ref[0] = dh_scr[:].astype(dh0_ref.dtype)
+        dc0_ref[0] = dc_scr[:].astype(dc0_ref.dtype)
 
 
 graves_lstm_scan_pallas.defvjp(_scan_fwd, _scan_bwd)

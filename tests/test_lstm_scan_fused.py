@@ -31,12 +31,30 @@ def test_forward_matches_scan_fp64():
     np.testing.assert_allclose(np.asarray(cs_p), np.asarray(cs_x), atol=1e-12)
 
 
-def test_forward_batch_tiling():
-    # B=16 with a forced smaller tile via a second call shape (B=8 -> bt=8)
-    args = _data(T=5, B=8, H=8)
-    ys_p, cs_p = graves_lstm_scan_pallas(*args)
-    ys_x, cs_x = graves_lstm_scan_xla(*args)
-    np.testing.assert_allclose(np.asarray(ys_p), np.asarray(ys_x), atol=1e-12)
+def test_non_divisible_batch_pads_exactly():
+    """B not divisible by any tile candidate (e.g. 20) must be padded, not
+    truncated — a truncating grid silently corrupted the trailing rows
+    (caught in review; the kernel is default-on, so this was a production
+    data-corruption bug)."""
+    for B in (20, 12, 9):
+        args = _data(T=5, B=B, H=8)
+        ys_p, cs_p = graves_lstm_scan_pallas(*args)
+        ys_x, cs_x = graves_lstm_scan_xla(*args)
+        np.testing.assert_allclose(np.asarray(ys_p), np.asarray(ys_x),
+                                   atol=1e-12, err_msg=f"B={B}")
+        np.testing.assert_allclose(np.asarray(cs_p), np.asarray(cs_x),
+                                   atol=1e-12, err_msg=f"B={B}")
+
+    # gradients through the padded path contribute nothing from pad rows
+    args = _data(T=4, B=10, H=8)
+
+    def loss(fn):
+        return lambda *a: jnp.sum(jnp.sin(fn(*a)[0])) + jnp.sum(fn(*a)[1] ** 2)
+
+    gp = jax.grad(loss(graves_lstm_scan_pallas), argnums=tuple(range(7)))(*args)
+    gx = jax.grad(loss(graves_lstm_scan_xla), argnums=tuple(range(7)))(*args)
+    for a, b in zip(gp, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-9)
 
 
 @pytest.mark.parametrize("use_dcs", [False, True])
