@@ -23,6 +23,14 @@ recurrence as one `pallas_call`:
 The input projection xw = x @ W + b stays OUTSIDE the kernel: it is one big
 MXU matmul over all timesteps that XLA already schedules optimally.
 
+Composition: under GSPMD (ShardedTrainer dp x tp) the kernel is an opaque
+custom call — XLA reshards its operands around it, so correctness holds at
+any sharding (parity-tested on the 8-device mesh). NOTE: default-on applies
+to tp runs too; there the custom call implies per-step gathers of the
+gate-dim-sharded RW — once real multi-chip hardware is available, measure
+that cost and add a sharding-aware guard here if it loses to GSPMD's
+partitioned lax.scan.
+
 Gate order [i|f|o|g] matches nn/conf/layers/recurrent.py. Internal math is
 fp32 (accumulated one width above bf16 activations); h/c carries are kept in
 the activation dtype exactly like the unfused scan, so helpers-on training

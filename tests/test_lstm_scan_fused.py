@@ -196,3 +196,37 @@ def test_masked_sequences_keep_the_scan_path():
     finally:
         enable_helpers(False)
     np.testing.assert_allclose(p_on, p_off, atol=1e-12)
+
+
+def test_fused_scan_composes_with_sharded_trainer_gspmd():
+    """The fused scan kernel (default-on for TPU) must stay CORRECT inside
+    ShardedTrainer's GSPMD-partitioned step: XLA reshards around the opaque
+    custom call (on multi-chip tp this costs RW gathers — a perf matter to
+    measure on real hardware, where a sharding-aware guard may be added —
+    but never correctness)."""
+    from deeplearning4j_tpu.models import TextGenerationLSTM
+    from deeplearning4j_tpu.parallel import ShardedTrainer, make_mesh
+    from deeplearning4j_tpu.ops.helpers import enable_helpers
+
+    vocab = 12
+    rng = np.random.RandomState(0)
+    idx = rng.randint(0, vocab, (8, 10))
+    x = np.eye(vocab)[idx].transpose(0, 2, 1).astype(np.float64)
+    y = np.eye(vocab)[np.roll(idx, -1, 1)].transpose(0, 2, 1).astype(
+        np.float64)
+
+    def build():
+        return TextGenerationLSTM(total_unique_characters=vocab, seed=5,
+                                  dtype="float64").init()
+
+    net0 = build()
+    ref = [float(net0.fit_on_device(x, y, steps=1)[0]) for _ in range(2)]
+    enable_helpers(True)
+    try:
+        net1 = build()
+        st = ShardedTrainer.Builder(net1).mesh(
+            make_mesh(8, axes=("data", "model"), shape=(2, 4))).build()
+        got = [float(st.fit_on_device(x, y, steps=1)[0]) for _ in range(2)]
+    finally:
+        enable_helpers(False)
+    np.testing.assert_allclose(got, ref, rtol=1e-9)
