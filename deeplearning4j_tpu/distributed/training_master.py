@@ -407,6 +407,35 @@ class DistributedMultiLayer:
             ev._count = cnt
         return ev
 
+    def score_examples(self, ds, add_regularization: bool = False):
+        """This process's LOCAL rows' per-example scores, computed over the
+        mesh-sharded global batch (ref SparkDl4jMultiLayer.scoreExamples /
+        SparkComputationGraph.scoreExamples — executors score their
+        partitions). Single-process: the full batch's scores. Works for
+        MultiLayerNetwork and single-output ComputationGraph facades (the
+        net-level score_examples traces over the sharded global arrays)."""
+        from deeplearning4j_tpu.datasets.dataset import DataSet, MultiDataSet
+        net = self.network
+        self._ensure_global_params()
+        sh = self._batch_sharding()
+        from deeplearning4j_tpu.parallel.sharded import _ds_masks
+        fm, lm = _ds_masks(ds)
+        put = lambda a: self._shard_eval_batch(a, sh)
+        put_m = lambda m: None if m is None else (
+            [None if v is None else put(v) for v in m]
+            if isinstance(m, (list, tuple)) else put(m))
+        if isinstance(ds.features, (list, tuple)):
+            sharded = MultiDataSet([put(f) for f in ds.features],
+                                   [put(l) for l in ds.labels],
+                                   put_m(fm), put_m(lm))
+        else:
+            sharded = DataSet(put(ds.features), put(ds.labels),
+                              put_m(fm), put_m(lm))
+        per = net.score_examples(sharded,
+                                 add_regularization=add_regularization)
+        return self._local_rows_of(per)
+    scoreExamples = score_examples
+
     def calculate_score(self, iterator, average: bool = True) -> float:
         """Mean (or summed) loss over the iterator, computed data-parallel
         over the global mesh (ref SparkDl4jMultiLayer.calculateScore /

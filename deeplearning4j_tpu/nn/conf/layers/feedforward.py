@@ -61,6 +61,13 @@ class OutputLayer(DenseLayer):
         return compute_loss(self.loss_fn, labels, self.preout(params, x),
                             self.activation, mask)
 
+    def compute_score_per_example(self, params, x, labels, mask=None):
+        """(batch,) per-example losses (ref MultiLayerNetwork.scoreExamples)."""
+        from deeplearning4j_tpu.nn.losses import compute_loss_per_example
+        return compute_loss_per_example(self.loss_fn, labels,
+                                        self.preout(params, x),
+                                        self.activation, mask)
+
 
 @register_layer
 @dataclass
@@ -86,6 +93,11 @@ class LossLayer(BaseLayerConf):
 
     def compute_score(self, params, x, labels, mask=None):
         return compute_loss(self.loss_fn, labels, x, self.activation, mask)
+
+    def compute_score_per_example(self, params, x, labels, mask=None):
+        from deeplearning4j_tpu.nn.losses import compute_loss_per_example
+        return compute_loss_per_example(self.loss_fn, labels, x,
+                                        self.activation, mask)
 
 
 @register_layer

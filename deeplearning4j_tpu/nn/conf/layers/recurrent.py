@@ -240,6 +240,20 @@ class RnnOutputLayer(FeedForwardLayerConf):
         m2 = None if mask is None else mask.reshape(-1)
         return compute_loss(self.loss_fn, l2, z2, self.activation, m2)
 
+    def compute_score_per_example(self, params, x, labels, mask=None):
+        """(batch,) scores: each example's loss summed over its (unmasked)
+        timesteps (ref scoreExamples time-series semantics; the scalar score
+        normalizes by batch*time, so mean(per_example)/T == score)."""
+        from deeplearning4j_tpu.nn.losses import compute_loss_per_example
+        B = x.shape[0]
+        z = self.preout(params, x)
+        z2 = jnp.moveaxis(z, 1, 2).reshape(-1, self.n_out)
+        l2 = jnp.moveaxis(labels, 1, 2).reshape(-1, self.n_out)
+        m2 = None if mask is None else mask.reshape(-1)
+        per_bt = compute_loss_per_example(self.loss_fn, l2, z2,
+                                          self.activation, m2)
+        return per_bt.reshape(B, -1).sum(axis=1)
+
 
 @register_layer
 @dataclass

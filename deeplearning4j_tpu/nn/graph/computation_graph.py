@@ -648,6 +648,42 @@ class ComputationGraph:
         return flatten_params(grads), float(loss)
 
     # ------------------------------------------------------------- misc
+    def score_examples(self, ds, add_regularization: bool = False):
+        """(batch,) per-example scores for SINGLE-output graphs (ref
+        SparkComputationGraph.scoreExamples): the output head's loss per
+        example (summed over unmasked timesteps for RNN heads);
+        `add_regularization` adds the net's L1/L2 penalty to every entry."""
+        self._check_init()
+        if len(self.conf.outputs) != 1:
+            raise NotImplementedError(
+                "score_examples supports single-output graphs")
+        out_name = self.conf.outputs[0]
+        node = self.conf.nodes[out_name]
+        out_layer = node.conf
+        fn = getattr(out_layer, "compute_score_per_example", None)
+        if fn is None:
+            raise NotImplementedError(
+                f"{type(out_layer).__name__} has no per-example scoring")
+        xs = [jnp.asarray(v, self.dtype) for v in _as_list(ds.features)]
+        y = _as_list(ds.labels)[0]
+        from deeplearning4j_tpu.parallel.sharded import _ds_masks
+        fm, lm = _ds_masks(ds)
+        fmasks = None if fm is None else list(_as_list(fm))
+        lmask = None if lm is None else _as_list(lm)[0]
+        values, _, _ = self._forward_all(self.params_tree, self.state_tree,
+                                         xs, train=False, fmasks=fmasks)
+        cur = values[node.inputs[0]].astype(self.dtype)
+        if node.preprocessor is not None:
+            cur = node.preprocessor.preprocess(cur)
+        li = self.layer_names.index(out_name)
+        per = fn(self.params_tree[li], cur, jnp.asarray(y, self.dtype), lmask)
+        if add_regularization:
+            reg = sum((layer.regularization_score(p) for layer, p in
+                       zip(self.layers, self.params_tree)), jnp.asarray(0.0))
+            per = per + reg
+        return per
+    scoreExamples = score_examples
+
     def evaluate(self, iterator):
         from deeplearning4j_tpu.eval.evaluation import Evaluation
         ev = Evaluation()

@@ -40,6 +40,22 @@ def compute_loss(
     mask: Optional[jnp.ndarray] = None,
 ) -> jnp.ndarray:
     """Mean-over-examples scalar loss from pre-activations `z`."""
+    return jnp.mean(compute_loss_per_example(loss_fn, labels, z, activation,
+                                             mask))
+
+
+def compute_loss_per_example(
+    loss_fn: Union[LossFunction, str],
+    labels: jnp.ndarray,
+    z: jnp.ndarray,
+    activation: Union[Activation, str, None],
+    mask: Optional[jnp.ndarray] = None,
+) -> jnp.ndarray:
+    """(batch,) per-example losses from pre-activations `z` — the reference's
+    scoreExamples semantics (ref SparkDl4jMultiLayer.scoreExamples /
+    impl/multilayer/scoring): each example's loss summed over its outputs/
+    timesteps (masked entries dropped); the scalar score is exactly the mean
+    of this vector."""
     if isinstance(loss_fn, str):
         loss_fn = LossFunction(loss_fn.lower())
     if isinstance(activation, str):
@@ -96,21 +112,16 @@ def compute_loss(
             # per-example; broadcast back to elementwise/num-outputs not meaningful here
             per_ex = -jnp.sum((ln * on).reshape(labels.shape[0], -1), axis=-1)
             if mask is not None:
-                m = jnp.broadcast_to(mask.reshape(mask.shape[0], -1)[:, :1], per_ex.shape)
-                per_ex = per_ex * m
-                # same policy as every other masked loss: divide by minibatch size
-                return jnp.sum(per_ex) / per_ex.shape[0]
-            return jnp.mean(per_ex)
+                per_ex = per_ex * mask.reshape(mask.shape[0], -1)[:, 0]
+            return per_ex
         else:
             raise ValueError(f"Unsupported loss function: {loss_fn}")
 
     if mask is not None:
+        # Reference scoring semantics: sum masked loss over all outputs/
+        # timesteps per example (the scalar score divides by MINIBATCH size,
+        # so masked and unmasked training see the same loss scale)
         m = jnp.broadcast_to(mask.reshape(mask.shape + (1,) * (per_elem.ndim - mask.ndim)),
                              per_elem.shape).astype(per_elem.dtype)
         per_elem = per_elem * m
-        # Reference scoring semantics: sum masked loss over all outputs/timesteps,
-        # divide by MINIBATCH size (matches the unmasked branch below, which also
-        # normalizes by examples only — so masked and unmasked training see the same
-        # effective loss scale / learning rate).
-        return jnp.sum(per_elem) / per_elem.shape[0]
-    return jnp.mean(_sum_per_example(per_elem))
+    return _sum_per_example(per_elem)

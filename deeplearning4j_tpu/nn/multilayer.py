@@ -236,7 +236,7 @@ class MultiLayerNetwork:
 
     # ------------------------------------------------------------- loss
     def _loss_fn(self, params_tree, state_tree, x, y, fmask, lmask, rng, train=True,
-                 rnn_init_states=None):
+                 rnn_init_states=None, per_example=False):
         out_layer = self.layers[-1]
         if not out_layer.is_output_layer():
             raise ValueError("Last layer must be an output/loss layer for scoring")
@@ -317,8 +317,20 @@ class MultiLayerNetwork:
             # output-layer matmul + loss in storage dtype for numerical stability
             cur = cur.astype(self.dtype)
             new_states = cast_floats(new_states, self.dtype)
-        loss = out_layer.compute_score(params_full[-1], cur, y, score_mask)
+        if per_example:
+            fn = getattr(out_layer, "compute_score_per_example", None)
+            if fn is None:
+                raise NotImplementedError(
+                    f"{type(out_layer).__name__} has no per-example scoring")
+            loss = fn(params_full[-1], cur, y, score_mask)
+        else:
+            loss = out_layer.compute_score(params_full[-1], cur, y, score_mask)
         new_states.append(state_tree[-1])
+        if per_example:
+            # bare per-example data losses; callers add reg/aux themselves
+            # (ref scoreExamples addRegularization semantics) — returning
+            # before the reg/aux sums keeps the eager path free of dead work
+            return loss, (new_states, final_rnn)
         reg = sum((layer.regularization_score(p)
                    for layer, p in zip(self.layers, params_full)), jnp.asarray(0.0))
         # auxiliary-loss seam: layers that contribute a data-dependent loss
@@ -641,6 +653,26 @@ class MultiLayerNetwork:
         loss, _ = self._loss_fn(self.params_tree, self.state_tree, x, y,
                                 ds.features_mask, ds.labels_mask, None, training, None)
         return float(loss)
+
+    def score_examples(self, ds, add_regularization: bool = False):
+        """(batch,) per-example scores (ref MultiLayerNetwork.scoreExamples /
+        SparkDl4jMultiLayer.scoreExamples): each example's loss summed over
+        its outputs (and unmasked timesteps for RNN heads);
+        `add_regularization` adds the net's L1/L2 penalty to every entry,
+        matching the reference's addRegularizationTerms flag. The scalar
+        `score()` equals mean(score_examples) (divided by T for RNN heads)."""
+        self._check_init()
+        x = jnp.asarray(ds.features, self.dtype)
+        y = jnp.asarray(ds.labels, self.dtype)
+        per, _ = self._loss_fn(self.params_tree, self.state_tree, x, y,
+                               ds.features_mask, ds.labels_mask, None, False,
+                               None, per_example=True)
+        if add_regularization:
+            reg = sum((layer.regularization_score(p) for layer, p in
+                       zip(self.layers, self.params_tree)), jnp.asarray(0.0))
+            per = per + reg
+        return per
+    scoreExamples = score_examples
 
     def gradient_and_score(self, x, y, fmask=None, lmask=None):
         """(flat gradient, score) — used by gradient checks."""

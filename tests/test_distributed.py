@@ -258,3 +258,107 @@ def test_evaluation_merge_api():
     for c in range(3):
         assert abs(ra.mean_squared_error(c) - rw.mean_squared_error(c)) < 1e-12
         assert abs(ra.correlation_r2(c) - rw.correlation_r2(c)) < 1e-12
+
+
+def test_score_examples_parity():
+    """scoreExamples: per-example scores whose mean equals score(), computed
+    mesh-data-parallel with the same values as the single-device net (ref
+    SparkDl4jMultiLayer.scoreExamples / MultiLayerNetwork.scoreExamples)."""
+    sys.path.insert(0, os.path.join(REPO, "tests"))
+    import _dist_worker as w
+    from deeplearning4j_tpu.distributed import (
+        DistributedMultiLayer, ParameterAveragingTrainingMaster)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    tm = ParameterAveragingTrainingMaster.Builder(16).build()
+    net = DistributedMultiLayer(w.build_conf_json(), tm)
+    rng = np.random.RandomState(5)
+    x = rng.rand(32, 5)
+    y = np.eye(3)[rng.randint(0, 3, 32)]
+    net.fit(DataSet(x, y))
+    net._wrapper._write_back()
+
+    ds = DataSet(*w.eval_batch())
+    per_local = np.asarray(net.network.score_examples(ds))
+    assert per_local.shape == (32,)
+    # mean of per-example scores == the scalar score (no regularization here)
+    np.testing.assert_allclose(per_local.mean(), net.network.score(ds),
+                               rtol=1e-12)
+    # mesh-parallel facade returns the same values
+    per_mesh = np.asarray(net.score_examples(ds))
+    np.testing.assert_allclose(per_mesh, per_local, atol=1e-10)
+    # addRegularization shifts every entry by the same penalty
+    net2 = net.network
+    per_reg = np.asarray(net2.score_examples(ds, add_regularization=True))
+    np.testing.assert_allclose(per_reg - per_local,
+                               np.full(32, (per_reg - per_local)[0]),
+                               atol=1e-12)
+
+
+def test_score_examples_rnn_and_masks():
+    """RNN heads: per-example = loss summed over unmasked timesteps;
+    mean/T equals the scalar score."""
+    from deeplearning4j_tpu import (
+        Activation, InputType, NeuralNetConfiguration, Sgd, WeightInit)
+    from deeplearning4j_tpu.nn.conf.layers.recurrent import (
+        GravesLSTM, RnnOutputLayer)
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+
+    b = (NeuralNetConfiguration.Builder().seed(3).weight_init(WeightInit.XAVIER)
+         .activation(Activation.TANH).updater(Sgd(learning_rate=0.1))
+         .dtype("float64").list())
+    b.layer(GravesLSTM(n_out=5))
+    b.layer(RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX))
+    net = MultiLayerNetwork(
+        b.set_input_type(InputType.recurrent(3)).build()).init()
+    rng = np.random.RandomState(1)
+    T = 6
+    x = rng.rand(4, 3, T)
+    y = np.eye(2)[rng.randint(0, 2, (4, T))].transpose(0, 2, 1)
+    mask = (rng.rand(4, T) > 0.3).astype(np.float64)
+    mask[:, 0] = 1.0
+    ds = DataSet(x, y, features_mask=mask, labels_mask=mask)
+    per = np.asarray(net.score_examples(ds))
+    assert per.shape == (4,)
+    np.testing.assert_allclose(per.mean() / T, net.score(ds), rtol=1e-12)
+
+
+def test_score_examples_graph_facade():
+    """Single-output ComputationGraph scoreExamples (net + distributed
+    facade), incl. a merge-vertex graph (ref SparkComputationGraph)."""
+    from deeplearning4j_tpu import (
+        Activation, InputType, NeuralNetConfiguration, Sgd, WeightInit)
+    from deeplearning4j_tpu.common.enums import LossFunction
+    from deeplearning4j_tpu.nn.conf.layers.feedforward import (
+        DenseLayer, OutputLayer)
+    from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+    from deeplearning4j_tpu.nn.graph.vertices import MergeVertex
+    from deeplearning4j_tpu.datasets.dataset import MultiDataSet
+    from deeplearning4j_tpu.distributed import (
+        DistributedComputationGraph, ParameterAveragingTrainingMaster)
+
+    g = (NeuralNetConfiguration.Builder().seed(5).weight_init(WeightInit.XAVIER)
+         .activation(Activation.TANH).updater(Sgd(learning_rate=0.1))
+         .dtype("float64").graph_builder()
+         .add_inputs("a", "b")
+         .add_layer("da", DenseLayer(n_out=6), "a")
+         .add_layer("db", DenseLayer(n_out=6), "b")
+         .add_vertex("m", MergeVertex(), "da", "db")
+         .add_layer("out", OutputLayer(n_out=3, loss_fn=LossFunction.MCXENT,
+                                       activation=Activation.SOFTMAX), "m")
+         .set_outputs("out")
+         .set_input_types(InputType.feed_forward(4), InputType.feed_forward(3)))
+    net = ComputationGraph(g.build()).init()
+    rng = np.random.RandomState(2)
+    xa, xb = rng.rand(16, 4), rng.rand(16, 3)
+    y = np.eye(3)[rng.randint(0, 3, 16)]
+    mds = MultiDataSet([xa, xb], [y])
+    per = np.asarray(net.score_examples(mds))
+    assert per.shape == (16,)
+    np.testing.assert_allclose(per.mean(), float(net.score(mds)), rtol=1e-12)
+
+    dg = DistributedComputationGraph(
+        net, ParameterAveragingTrainingMaster.Builder(16).build())
+    per_mesh = np.asarray(dg.score_examples(mds))
+    np.testing.assert_allclose(per_mesh, per, atol=1e-10)
