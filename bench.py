@@ -74,18 +74,15 @@ def bench_resnet50(batch=256, steps=30, compute_dtype="bfloat16",
     # batch 256 is the measured throughput knee (r3 sweep: 256 -> 7.1k,
     # 512 -> 6.6k, 1024 -> 6.6k img/s) — bigger batches go HBM-bound
     from deeplearning4j_tpu.models import ResNet50
-    from deeplearning4j_tpu.ops.helpers import enable_helpers
+    from deeplearning4j_tpu.ops.helpers import helpers_enabled_ctx
 
-    enable_helpers(helpers)
-    try:
+    with helpers_enabled_ctx(helpers):  # scoped: restores prior policy
         net = ResNet50(num_labels=1000, seed=42,
                        compute_dtype=compute_dtype).init()
         rng = np.random.RandomState(0)
         x, y = _synth(rng, batch, 1000, 3, 224, 224)
         flops = net.train_step_flops(x, y)
         dt, dt_min = _device_loop_time(net, x, y, steps)
-    finally:
-        enable_helpers(False)
     ms = dt / steps * 1e3
     name = f"resnet50_{compute_dtype or 'float32'}_b{batch}" + \
         ("_helpers" if helpers else "")
@@ -173,10 +170,9 @@ def bench_graves_lstm(batch=8192, seq_len=100, steps=8,
     8192 -> 5.9M tokens/s — the recurrent scan amortizes over the batch."""
     import jax.numpy as jnp
     from deeplearning4j_tpu.models import TextGenerationLSTM
-    from deeplearning4j_tpu.ops.helpers import enable_helpers
+    from deeplearning4j_tpu.ops.helpers import helpers_enabled_ctx
 
-    enable_helpers(helpers)
-    try:
+    with helpers_enabled_ctx(helpers):  # scoped: restores prior policy
         vocab = 47
         net = TextGenerationLSTM(total_unique_characters=vocab, seed=42,
                                  compute_dtype=compute_dtype).init()
@@ -188,8 +184,6 @@ def bench_graves_lstm(batch=8192, seq_len=100, steps=8,
             np.roll(idx, -1, axis=1)].transpose(0, 2, 1))
         flops = net.train_step_flops(x, y)
         dt, dt_min = _device_loop_time(net, x, y, steps)
-    finally:
-        enable_helpers(False)
     ms = dt / steps * 1e3
     out = {"tokens_per_sec": batch * seq_len * steps / dt,
            "ms_per_iter": ms, "min_ms_per_iter": dt_min / steps * 1e3,

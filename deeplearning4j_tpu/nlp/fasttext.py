@@ -197,6 +197,13 @@ class FastText:
             args = FastTextArgs.read(f)
             size, nwords, nlabels = struct.unpack("<iii", f.read(12))
             ntokens, pruneidx_size = struct.unpack("<qq", f.read(16))
+            if pruneidx_size > 0:
+                # pruned models remap ngram hashes -> surviving rows; without
+                # applying the remap, subword/OOV composition would silently
+                # read wrong rows — fail loudly like the quantized case
+                raise ValueError(
+                    "pruned fastText models are not supported (pruneidx "
+                    f"size {pruneidx_size})")
             vocab = VocabCache()
             true_counts: List[int] = []
             for i in range(size):
@@ -218,9 +225,6 @@ class FastText:
             for i, c in enumerate(true_counts):
                 vocab.element_at_index(i).count = c
             vocab.total_word_occurrences = sum(true_counts)
-            if pruneidx_size > 0:
-                f.read(8 * pruneidx_size)  # pruned-bucket remap: skip
-
             def read_matrix():
                 quant, = struct.unpack("<b", f.read(1))
                 if quant:

@@ -11,6 +11,7 @@ hand-tiling beats the compiler, and everything keeps working with the seam off.
 """
 from __future__ import annotations
 
+import contextlib
 import os
 from typing import Callable, Dict, Optional
 
@@ -45,6 +46,25 @@ def enable_helpers(flag: Optional[bool] = True) -> None:
     to the default policy: default_on kernels engage on TPU only)."""
     global _ENABLED
     _ENABLED = None if flag is None else bool(flag)
+
+
+def helpers_override() -> Optional[bool]:
+    """The current explicit override, for save/restore around temporary
+    enable_helpers() flips (None = default per-op policy active)."""
+    return _ENABLED
+
+
+@contextlib.contextmanager
+def helpers_enabled_ctx(flag: Optional[bool]):
+    """Scoped enable_helpers: restores the previous override on exit, so a
+    temporary flip can never pin the global switch for the rest of the
+    process."""
+    prev = helpers_override()
+    enable_helpers(flag)
+    try:
+        yield
+    finally:
+        enable_helpers(prev)
 
 
 def helpers_enabled() -> bool:

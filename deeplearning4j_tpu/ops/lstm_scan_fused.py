@@ -57,13 +57,18 @@ VMEM_BUDGET = 14 * 1024 * 1024  # headroom under Mosaic's 16 MB scoped limit
 
 def _vmem_cost(H: int, db: int, bt: int, bwd: bool) -> int:
     """Estimated resident VMEM (batch-major grid): (bt, H) h/c carries x2 +
-    double-buffered streamed blocks. Per-row block bytes: fwd = 2x xw(4H) +
-    2x2x out(H) + 2x2x init(H) = 16*H*db; bwd adds dxw out and four
-    streamed (bt, H) inputs = 28*H*db, plus the fp32 dRW/peephole
-    accumulators."""
-    acc = 4 * H * H * 4 + 3 * H * 4 if bwd else 0
+    double-buffered streamed blocks + the (H, 4H) RW block (constant across
+    the grid but resident) + the fp32 (bt, 4H) gate intermediates the kernel
+    body materializes. Per-row block bytes: fwd = 2x xw(4H) + 2x2x out(H) +
+    2x2x init(H) = 16*H*db; bwd adds dxw out and four streamed (bt, H)
+    inputs = 28*H*db, plus the fp32 dRW/peephole accumulators."""
+    rw = 4 * H * H * db              # streamed (H, 4H) weight block
+    # bwd: fp32 dRW scratch + the constant-index-map (H, 4H) fp32 dRW OUTPUT
+    # block (both resident for the whole grid) + peephole acc/outputs
+    acc = 2 * (4 * H * H * 4) + 2 * (3 * H * 4) if bwd else 0
+    interm = bt * 4 * H * 4 * (2 if bwd else 1)      # fp32 gates (+dgates bwd)
     per_row = 2 * H * db + (28 if bwd else 16) * H * db
-    return acc + bt * per_row
+    return rw + acc + interm + bt * per_row
 
 
 def _pick_bt(B: int, H: int, dtype_bytes: int = 2, bwd: bool = False) -> int:

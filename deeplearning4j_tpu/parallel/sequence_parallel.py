@@ -48,16 +48,21 @@ def attention_reference(q, k, v, causal: bool = False, scale: Optional[float] = 
 
 def _block_attn(q, k, v, scale, mask=None):
     """One q-block x k-block contribution: returns (unnormalized out, row max,
-    row normalizer) for online-softmax accumulation."""
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    row normalizer) for online-softmax accumulation. Score math runs fp32
+    (flash-attention convention) with bf16 MXU inputs — matmuls accumulate one
+    width up via preferred_element_type, exp/sum stay fp32 throughout."""
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                        preferred_element_type=acc_dt) * scale
     if mask is not None:
         scores = jnp.where(mask, scores, NEG_INF)
-    m = jnp.max(scores, axis=-1)                      # (b,h,q)
+    m = jnp.max(scores, axis=-1)                      # (b,h,q) fp32
     p = jnp.exp(scores - m[..., None])
     if mask is not None:  # rows with no visible keys: exp(NEG_INF - NEG_INF)=1 junk
         p = jnp.where(mask, p, 0.0)
-    l = jnp.sum(p, axis=-1)                           # (b,h,q)
-    o = jnp.einsum("bhqk,bhkv->bhqv", p, v)
+    l = jnp.sum(p, axis=-1)                           # (b,h,q) fp32
+    o = jnp.einsum("bhqk,bhkv->bhqv", p.astype(q.dtype), v,
+                   preferred_element_type=acc_dt)
     return o, m, l
 
 
@@ -99,19 +104,24 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
     ki = jnp.arange(nb * blk).reshape(nb, blk)
     qi = jnp.arange(T)
 
+    # flash-attention convention: the online-softmax accumulators stay fp32
+    # even for bf16 activations — repeated rescaling of a bf16 accumulator
+    # across nb blocks degrades vs the dense softmax it replaces
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+
     def step(acc, inp):
         kb_, vb_, kmb_, ki_ = inp
         m = kmb_[:, None, None, :]  # (B,1,1,blk), broadcasts in _block_attn
         if causal:
             m = m & (qi[:, None] >= ki_[None, :])[None, None]
-        o, mx, l = _block_attn(q, kb_, vb_, scale_, m)
+        o, mx, l = _block_attn(q, kb_, vb_, scale_, m)  # fp32 already
         return _merge(acc, o, mx, l), None
 
-    acc0 = (jnp.zeros_like(q),
-            jnp.full((B, H, T), NEG_INF, q.dtype),
-            jnp.zeros((B, H, T), q.dtype))
+    acc0 = (jnp.zeros(q.shape, acc_dt),
+            jnp.full((B, H, T), NEG_INF, acc_dt),
+            jnp.zeros((B, H, T), acc_dt))
     (o, _, l), _ = lax.scan(step, acc0, (kb, vb, kmb, ki))
-    return o / jnp.maximum(l, 1e-30)[..., None]
+    return (o / jnp.maximum(l, 1e-30)[..., None]).astype(q.dtype)
 
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
@@ -130,6 +140,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
     d = q.shape[-1]
     scale_ = jnp.asarray(scale if scale is not None else 1.0 / np.sqrt(d),
                          q.dtype)
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)  # fp32 accumulators
     n_dev = mesh.shape[axis]
     seq = q.shape[2]
     assert seq % n_dev == 0, f"seq {seq} not divisible by mesh axis {n_dev}"
@@ -157,7 +168,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
                 # owner is traced, build the blk x blk mask every step
                 cm = causal_mask(owner)
                 m = cm if m is None else m & cm
-            o, m_, l_ = _block_attn(q_blk, kb, vb, scale_, m)
+            o, m_, l_ = _block_attn(q_blk, kb, vb, scale_, m)  # fp32 already
             acc = _merge(acc, o, m_, l_)
             # rotate k/v (+ key mask) to the next device on the ring
             perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
@@ -168,13 +179,13 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
             return (acc, kb, vb, mb), None
 
         b, h = q_blk.shape[0], q_blk.shape[1]
-        acc0 = (jnp.zeros_like(q_blk),
-                jnp.full((b, h, blk), NEG_INF, q_blk.dtype),
-                jnp.zeros((b, h, blk), q_blk.dtype))
+        acc0 = (jnp.zeros(q_blk.shape, acc_dt),
+                jnp.full((b, h, blk), NEG_INF, acc_dt),
+                jnp.zeros((b, h, blk), acc_dt))
         (acc, _, _, _), _ = lax.scan(step, (acc0, k_blk, v_blk, m_blk),
                                      jnp.arange(n_dev))
         out, m_, l_ = acc
-        return out / jnp.maximum(l_, 1e-30)[..., None]
+        return (out / jnp.maximum(l_, 1e-30)[..., None]).astype(q_blk.dtype)
 
     spec = P(batch_axis, None, axis, None)
     if has_mask:

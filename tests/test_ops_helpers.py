@@ -165,6 +165,27 @@ def test_graves_gradient_check_through_helper():
     assert check_gradients(net, x, y, epsilon=1e-6, max_rel_error=1e-5)
 
 
+def test_helpers_enabled_ctx_restores_prior_override():
+    """The scoped switch restores the PREVIOUS override (not False) on exit
+    and on exception — a temporary bench/test flip must never pin the global
+    policy for the rest of the process (ADVICE r4)."""
+    from deeplearning4j_tpu.ops.helpers import (
+        helpers_enabled_ctx, helpers_override)
+
+    enable_helpers(None)  # default policy active
+    with helpers_enabled_ctx(True):
+        assert helpers_override() is True
+        with helpers_enabled_ctx(False):  # nesting restores one level
+            assert helpers_override() is False
+        assert helpers_override() is True
+    assert helpers_override() is None
+    enable_helpers(True)
+    with pytest.raises(RuntimeError):
+        with helpers_enabled_ctx(False):
+            raise RuntimeError("boom")
+    assert helpers_override() is True  # restored on exception too
+
+
 def test_default_on_policy_engages_only_on_tpu(monkeypatch):
     """default_on kernels (the fused LSTM scan) follow the reference's
     'cuDNN used when supported' behavior: auto-on for TPU backends, off on
