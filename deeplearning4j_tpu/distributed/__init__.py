@@ -13,9 +13,16 @@ from deeplearning4j_tpu.distributed.training_master import (
     ParameterAveragingTrainingMaster, SharedTrainingMaster)
 from deeplearning4j_tpu.distributed.param_server import (
     ParameterServer, ParameterServerClient, ParameterServerTrainer)
+from deeplearning4j_tpu.distributed.early_stopping import (
+    DistributedDataSetLossCalculator, DistributedEarlyStoppingGraphTrainer,
+    DistributedEarlyStoppingTrainer, DistributedLossCalculatorComputationGraph)
+from deeplearning4j_tpu.distributed.stats import export_stats_as_html
 
 __all__ = [
     "VoidConfiguration", "initialize_cluster", "ParameterAveragingTrainingMaster",
     "SharedTrainingMaster", "DistributedMultiLayer", "DistributedComputationGraph",
     "ParameterServer", "ParameterServerClient", "ParameterServerTrainer",
+    "DistributedDataSetLossCalculator", "DistributedEarlyStoppingTrainer",
+    "DistributedEarlyStoppingGraphTrainer",
+    "DistributedLossCalculatorComputationGraph", "export_stats_as_html",
 ]

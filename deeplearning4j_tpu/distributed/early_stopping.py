@@ -1,0 +1,79 @@
+"""Distributed early stopping (L6).
+
+Parity: ref dl4j-spark/.../spark/earlystopping/SparkEarlyStoppingTrainer.java
+(+ SparkEarlyStoppingGraphTrainer.java, SparkDataSetLossCalculator.java,
+SparkLossCalculatorComputationGraph.java) — train-with-early-stopping where
+BOTH the fit and the scoring run on the cluster. TPU rendering: the
+Distributed facade's fit() already trains mesh-sharded through its
+TrainingMaster wrapper, and calculate_score() runs one GSPMD forward per
+batch with a host-side merge across processes — so this trainer composes
+those two, and the conditions / savers / EarlyStoppingResult are the SAME
+classes as local early stopping (earlystopping/early_stopping.py): one
+early-stopping vocabulary across local and cluster training, like the
+reference shares its termination/ package between both trainers.
+
+The Spark trainer fits the whole RDD once per epoch and applies iteration
+conditions per fit (BaseSparkEarlyStoppingTrainer.java:126-150); the loop
+below mirrors that granularity — one distributed fit over the local-shard
+iterator per epoch (every process calls fit with its own shard, SPMD), then
+iteration conditions against the training score, then the distributed score
+calculator + epoch conditions.
+"""
+from __future__ import annotations
+
+from deeplearning4j_tpu.earlystopping.early_stopping import (
+    EarlyStoppingTrainer)
+
+
+class DistributedDataSetLossCalculator:
+    """(ref spark/earlystopping/SparkDataSetLossCalculator.java) — average
+    loss over an iterator, computed by the distributed facade's mesh-sharded
+    scorer (every device of every process forwards its shard; host merge)."""
+
+    def __init__(self, iterator, average: bool = True):
+        self.iterator = iterator
+        self.average = average
+
+    def calculate_score(self, net) -> float:
+        return net.calculate_score(self.iterator, average=self.average)
+
+
+# the ComputationGraph facade shares the scorer (ref
+# SparkLossCalculatorComputationGraph.java is the same logic over graphs)
+DistributedLossCalculatorComputationGraph = DistributedDataSetLossCalculator
+
+
+class DistributedEarlyStoppingTrainer(EarlyStoppingTrainer):
+    """(ref spark/earlystopping/SparkEarlyStoppingTrainer.java) — early
+    stopping over a DistributedMultiLayer / DistributedComputationGraph.
+    Shares the epoch loop with the local EarlyStoppingTrainer (the reference
+    shares its termination/ package the same way); only the epoch-fit
+    granularity and the saver unwrap differ.
+
+    `net` is the distributed facade; `train_iterator` yields THIS process's
+    local shard (same number of batches on every process — SPMD)."""
+
+    def _network_for_saver(self):
+        """Pull the mesh-sharded parameters back into the underlying network
+        before handing it to a saver (savers serialize plain networks)."""
+        if hasattr(self.net, "_ensure_global_params"):
+            self.net._ensure_global_params()
+        return self.net.get_network()
+
+    def _run_epoch(self, cfg):
+        """Spark granularity: one distributed fit over the whole local-shard
+        iterator per epoch, iteration conditions checked per fit (ref
+        BaseSparkEarlyStoppingTrainer.java:126-150)."""
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        self.net.fit(self.iterator)
+        last = self.net.score()
+        for c in cfg.iteration_conditions:
+            if c.terminate(last):
+                return type(c).__name__
+        return None
+
+
+# alias matching reference naming (SparkEarlyStoppingGraphTrainer — the graph
+# facade subclasses DistributedMultiLayer, so one trainer serves both)
+DistributedEarlyStoppingGraphTrainer = DistributedEarlyStoppingTrainer

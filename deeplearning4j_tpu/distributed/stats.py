@@ -1,0 +1,83 @@
+"""Training-stats HTML timeline export (L6 observability).
+
+Parity: ref dl4j-spark/.../spark/stats/StatsUtils.java:72-86
+(`exportStatsAsHtml`) — the Spark training masters record per-phase
+EventStats (fit / broadcast / evaluation timings) and StatsUtils renders
+them as an HTML page of timeline charts + summary components. TPU
+rendering: `BaseTrainingMaster.record_stat` collects {event, start,
+seconds, ...} dicts; this module lays them out as one `ComponentTimeline`
+lane per event type over the shared wall clock, a per-phase summary table,
+and a score-vs-step line chart when scores were recorded — all through the
+dependency-free SVG components in ui/components.py.
+"""
+from __future__ import annotations
+
+from typing import IO, List, Optional, Union
+
+from deeplearning4j_tpu.ui.components import (
+    ComponentChartLine, ComponentHtmlRenderer, ComponentTable, ComponentText,
+    ComponentTimeline)
+
+
+def _lanes(stats: List[dict]):
+    """Group events by type into timeline lanes. Entries without a `start`
+    (older recorders) are laid out back-to-back from the end of the previous
+    entry so the page still renders."""
+    lanes: dict = {}
+    cursor = 0.0
+    for s in stats:
+        ev = str(s.get("event", "event"))
+        start = s.get("start")
+        dur = float(s.get("seconds", 0.0))
+        if start is None:
+            start = cursor
+        cursor = float(start) + dur
+        label = ev
+        if "steps" in s:
+            label += f" @step {s['steps']}"
+        if "score" in s:
+            label += f" score={s['score']:.4g}"
+        lanes.setdefault(ev, []).append((float(start), dur, label))
+    return [(name, bars) for name, bars in lanes.items()]
+
+
+def export_stats_as_html(stats: List[dict],
+                         path: Optional[Union[str, IO]] = None,
+                         title: str = "Training Stats") -> str:
+    """Render recorded training stats to a standalone HTML page (ref
+    StatsUtils.exportStatsAsHtml). `path` may be a filename, a writable
+    file object, or None (return the HTML string only)."""
+    lanes = _lanes(stats)
+    components = [ComponentText(title)]
+    if lanes:
+        t0 = min(s for _, bars in lanes for s, _, _ in bars)
+        components.append(ComponentTimeline(
+            "Phase timeline (wall clock)",
+            [(n, [(s - t0, l, lab) for s, l, lab in bars])
+             for n, bars in lanes]))
+        rows = []
+        for name, bars in lanes:
+            tot = sum(l for _, l, _ in bars)
+            rows.append([name, len(bars), f"{tot:.3f}",
+                         f"{tot / len(bars):.3f}"])
+        components.append(ComponentTable(
+            ["phase", "count", "total s", "mean s"], rows))
+    else:
+        components.append(ComponentText("No training stats recorded "
+                                        "(enable collectTrainingStats).",
+                                        heading=False))
+    scored = [(s.get("steps", i), s["score"])
+              for i, s in enumerate(stats) if "score" in s]
+    if scored:
+        components.append(ComponentChartLine(
+            "Training score", [([x for x, _ in scored],
+                                [y for _, y in scored], "score")],
+            x_label="step"))
+    html = ComponentHtmlRenderer().render(*components, title=title)
+    if path is not None:
+        if hasattr(path, "write"):
+            path.write(html)
+        else:
+            with open(path, "w") as f:
+                f.write(html)
+    return html

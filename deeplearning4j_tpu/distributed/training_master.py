@@ -161,6 +161,13 @@ class BaseTrainingMaster:
         """(ref ParameterAveragingTrainingMaster.getTrainingStats)"""
         return list(self._stats)
 
+    def export_stats_as_html(self, path=None, title="Training Stats") -> str:
+        """Render collected stats as an HTML timeline page (ref
+        spark/stats/StatsUtils.java:72-86 exportStatsAsHtml)."""
+        from deeplearning4j_tpu.distributed.stats import export_stats_as_html
+        return export_stats_as_html(self.get_training_stats(), path,
+                                    title=title)
+
 
 class ParameterAveragingTrainingMaster(BaseTrainingMaster):
     """DP-3: synchronous parameter averaging every `averaging_frequency` steps
@@ -281,7 +288,7 @@ class DistributedMultiLayer:
         t0 = time.perf_counter()
         w.fit(data, labels, epochs=epochs)
         self.training_master.record_stat(
-            event="fit", seconds=time.perf_counter() - t0,
+            event="fit", start=t0, seconds=time.perf_counter() - t0,
             steps=w._host_step, score=float(w.score()))
         return self.network
 
@@ -373,7 +380,9 @@ class DistributedMultiLayer:
         """Data-parallel classification evaluation over the global mesh with
         metric merge — parity with single-device MultiLayerNetwork.evaluate
         (ref SparkDl4jMultiLayer.evaluate)."""
+        import time
         from deeplearning4j_tpu.eval.evaluation import Evaluation
+        t0 = time.perf_counter()
         self._ensure_global_params()
         ev = Evaluation(num_classes, top_n=top_n)
         if hasattr(iterator, "reset"):
@@ -381,7 +390,10 @@ class DistributedMultiLayer:
         for ds in iterator:
             out, labels, lmask = self._eval_forward(ds)
             ev.eval(labels, out, mask=lmask)
-        return self._merge_across_processes(ev)
+        merged = self._merge_across_processes(ev)
+        self.training_master.record_stat(
+            event="evaluate", start=t0, seconds=time.perf_counter() - t0)
+        return merged
 
     def evaluate_regression(self, iterator):
         """(ref SparkDl4jMultiLayer.evaluateRegression)"""
@@ -442,6 +454,8 @@ class DistributedMultiLayer:
         impl/multilayer/scoring). Every process feeds its local shard; the
         jitted loss is a global mean, so all processes return the same value."""
         import functools
+        import time
+        t_start = time.perf_counter()
         net = self.network
         self._ensure_global_params()
         if getattr(self, "_score_jit", None) is None:
@@ -474,6 +488,9 @@ class DistributedMultiLayer:
             b = (gx[0] if multi else gx).shape[0]  # GLOBAL batch rows
             total += float(loss) * b
             n += b
+        self.training_master.record_stat(
+            event="score", start=t_start,
+            seconds=time.perf_counter() - t_start)
         if n == 0:
             return float("nan")
         return total / n if average else total

@@ -247,6 +247,26 @@ class EarlyStoppingTrainer:
         self.net = net
         self.iterator = train_iterator
 
+    # -- hooks subclasses override (DistributedEarlyStoppingTrainer) --------
+    def _network_for_saver(self):
+        """What the savers serialize (distributed facades sync + unwrap)."""
+        return self.net
+
+    def _run_epoch(self, cfg) -> Optional[str]:
+        """One training epoch; returns the firing iteration-condition's name
+        or None. Local granularity: per-minibatch checks (ref
+        BaseEarlyStoppingTrainer.java:100-150)."""
+        if hasattr(self.iterator, "reset"):
+            self.iterator.reset()
+        for ds in self.iterator:
+            self.net.fit(ds)
+            last = self.net.score()
+            for c in cfg.iteration_conditions:
+                if c.terminate(last):
+                    return type(c).__name__
+        return None
+
+    # -- the loop shared by local and distributed trainers ------------------
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
         for c in cfg.epoch_conditions + cfg.iteration_conditions:
@@ -256,22 +276,9 @@ class EarlyStoppingTrainer:
         epoch = 0
         reason, details = "Unknown", ""
         while True:
-            # one training epoch with per-iteration termination checks
-            if hasattr(self.iterator, "reset"):
-                self.iterator.reset()
-            terminated = False
-            for ds in self.iterator:
-                self.net.fit(ds)
-                last = self.net.score()
-                for c in cfg.iteration_conditions:
-                    if c.terminate(last):
-                        reason = "IterationTerminationCondition"
-                        details = type(c).__name__
-                        terminated = True
-                        break
-                if terminated:
-                    break
-            if terminated:
+            fired = self._run_epoch(cfg)
+            if fired is not None:
+                reason, details = "IterationTerminationCondition", fired
                 break
 
             if epoch % cfg.evaluate_every_n_epochs == 0:
@@ -279,9 +286,11 @@ class EarlyStoppingTrainer:
                 score_vs_epoch[epoch] = score
                 if score < best_score:
                     best_score, best_epoch = score, epoch
-                    cfg.model_saver.save_best_model(self.net, score)
+                    cfg.model_saver.save_best_model(
+                        self._network_for_saver(), score)
                 if cfg.save_last_model:
-                    cfg.model_saver.save_latest_model(self.net, score)
+                    cfg.model_saver.save_latest_model(
+                        self._network_for_saver(), score)
                 stop = False
                 for c in cfg.epoch_conditions:
                     if c.terminate(epoch, score):
@@ -293,7 +302,7 @@ class EarlyStoppingTrainer:
                     break
             epoch += 1
 
-        best = cfg.model_saver.get_best_model() or self.net
+        best = cfg.model_saver.get_best_model() or self._network_for_saver()
         return EarlyStoppingResult(reason, details, score_vs_epoch, best_epoch,
                                    best_score, epoch + 1, best)
 

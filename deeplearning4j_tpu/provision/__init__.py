@@ -1,0 +1,18 @@
+"""Cluster provisioning + object-storage transfer (L10 infra glue).
+
+TPU-native rendering of the reference's deeplearning4j-aws module: EC2 box
+creation becomes TPU-VM slice management (tpu_vm.py), S3 transfer becomes
+GCS transfer behind the same API shapes (gcs.py). All cloud interaction is
+transport-injected, so the module is fully testable with zero egress.
+"""
+from deeplearning4j_tpu.provision.gcs import (
+    GcsDownloader, GcsTransport, GcsUploader, GsutilTransport,
+    InMemoryGcsTransport)
+from deeplearning4j_tpu.provision.tpu_vm import (
+    ClusterSetup, ProvisioningError, TpuVmCreator, gcloud_transport)
+
+__all__ = [
+    "TpuVmCreator", "ClusterSetup", "ProvisioningError", "gcloud_transport",
+    "GcsDownloader", "GcsUploader", "GcsTransport", "GsutilTransport",
+    "InMemoryGcsTransport",
+]

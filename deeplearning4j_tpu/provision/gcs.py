@@ -1,0 +1,235 @@
+"""GCS object-storage transfer (L10 infra glue).
+
+Parity: ref deeplearning4j-aws/.../s3/reader/S3Downloader.java +
+s3/uploader/S3Uploader.java (+ BaseS3 session plumbing) — move datasets and
+checkpoints between the training cluster and object storage. The TPU-native
+rendering targets Google Cloud Storage with the SAME API shapes
+(keysForBucket / iterateBucket / objectForKey / download / downloadFolder;
+upload / multiPartUpload / uploadFolder / uploadFileList), so reference
+users find the operations where they expect them.
+
+Storage access goes through a `GcsTransport`; the default shells out to
+`gsutil`, and `InMemoryGcsTransport` backs the zero-egress tests (and doubles
+as a local fake for development). Checkpoint zips from
+util/model_serializer.py are plain files, so CheckpointListener output can
+ride `GcsUploader.upload_folder` directly.
+"""
+from __future__ import annotations
+
+import io
+import os
+import subprocess
+from typing import Callable, Dict, Iterator, List, Optional
+
+
+class GcsTransport:
+    """Minimal storage verbs the up/downloaders need."""
+
+    def list_buckets(self) -> List[str]:
+        raise NotImplementedError
+
+    def list_keys(self, bucket: str, prefix: str = "") -> List[str]:
+        raise NotImplementedError
+
+    def get(self, bucket: str, key: str) -> bytes:
+        raise NotImplementedError
+
+    def put(self, bucket: str, key: str, data: bytes) -> None:
+        raise NotImplementedError
+
+    def compose(self, bucket: str, part_keys: List[str],
+                dest_key: str) -> None:
+        """Server-side concatenation of parts into dest (GCS compose)."""
+        raise NotImplementedError
+
+    def delete(self, bucket: str, key: str) -> None:
+        raise NotImplementedError
+
+
+class GsutilTransport(GcsTransport):
+    """Default transport: the gsutil CLI (requires install + auth; never
+    exercised by the test suite)."""
+
+    def _run(self, argv, data: Optional[bytes] = None) -> bytes:
+        proc = subprocess.run(argv, input=data, capture_output=True)
+        if proc.returncode != 0:
+            raise RuntimeError(f"gsutil failed: {' '.join(argv)}: "
+                               f"{proc.stderr.decode(errors='replace')}")
+        return proc.stdout
+
+    def list_buckets(self):
+        out = self._run(["gsutil", "ls"]).decode()
+        return [l.removeprefix("gs://").rstrip("/")
+                for l in out.splitlines() if l.startswith("gs://")]
+
+    def list_keys(self, bucket, prefix=""):
+        out = self._run(["gsutil", "ls", "-r",
+                         f"gs://{bucket}/{prefix}**"]).decode()
+        pre = f"gs://{bucket}/"
+        return [l.removeprefix(pre) for l in out.splitlines()
+                if l.startswith(pre) and not l.endswith("/")]
+
+    def get(self, bucket, key):
+        return self._run(["gsutil", "cp", f"gs://{bucket}/{key}", "-"])
+
+    def put(self, bucket, key, data):
+        self._run(["gsutil", "cp", "-", f"gs://{bucket}/{key}"], data=data)
+
+    def compose(self, bucket, part_keys, dest_key):
+        self._run(["gsutil", "compose"]
+                  + [f"gs://{bucket}/{k}" for k in part_keys]
+                  + [f"gs://{bucket}/{dest_key}"])
+
+    def delete(self, bucket, key):
+        self._run(["gsutil", "rm", f"gs://{bucket}/{key}"])
+
+
+class InMemoryGcsTransport(GcsTransport):
+    """Dict-backed fake for tests / local development."""
+
+    def __init__(self):
+        self.store: Dict[str, Dict[str, bytes]] = {}
+
+    def list_buckets(self):
+        return sorted(self.store)
+
+    def list_keys(self, bucket, prefix=""):
+        return sorted(k for k in self.store.get(bucket, {})
+                      if k.startswith(prefix))
+
+    def get(self, bucket, key):
+        try:
+            return self.store[bucket][key]
+        except KeyError:
+            raise FileNotFoundError(f"gs://{bucket}/{key}")
+
+    def put(self, bucket, key, data):
+        self.store.setdefault(bucket, {})[key] = bytes(data)
+
+    def compose(self, bucket, part_keys, dest_key):
+        self.store.setdefault(bucket, {})[dest_key] = b"".join(
+            self.store[bucket][k] for k in part_keys)
+
+    def delete(self, bucket, key):
+        self.store.get(bucket, {}).pop(key, None)
+
+
+class GcsDownloader:
+    """(ref s3/reader/S3Downloader.java API shape)."""
+
+    def __init__(self, transport: Optional[GcsTransport] = None):
+        self.transport = transport or GsutilTransport()
+
+    def buckets(self) -> List[str]:
+        return self.transport.list_buckets()
+
+    def keys_for_bucket(self, bucket: str) -> List[str]:
+        return self.transport.list_keys(bucket)
+    keysForBucket = keys_for_bucket
+
+    def object_for_key(self, bucket: str, key: str) -> io.BytesIO:
+        return io.BytesIO(self.transport.get(bucket, key))
+    objectForKey = object_for_key
+
+    def iterate_bucket(self, bucket: str) -> Iterator[io.BytesIO]:
+        for key in self.keys_for_bucket(bucket):
+            yield self.object_for_key(bucket, key)
+    iterateBucket = iterate_bucket
+
+    def paginate(self, bucket: str,
+                 listener: Callable[[str], None]) -> None:
+        """(ref S3Downloader.paginate + BucketKeyListener) — callback per key."""
+        for key in self.keys_for_bucket(bucket):
+            listener(key)
+
+    def download(self, bucket: str, key: str, to) -> None:
+        """`to`: a path or a writable binary file object."""
+        data = self.transport.get(bucket, key)
+        if hasattr(to, "write"):
+            to.write(data)
+        else:
+            with open(to, "wb") as f:
+                f.write(data)
+
+    def download_folder(self, bucket: str, key_prefix: str,
+                        folder_path: str) -> List[str]:
+        """(ref S3Downloader.downloadFolder) — every object under the prefix
+        lands under folder_path with its relative key path."""
+        written = []
+        for key in self.transport.list_keys(bucket, key_prefix):
+            rel = key[len(key_prefix):].lstrip("/")
+            dest = os.path.join(folder_path, rel or os.path.basename(key))
+            os.makedirs(os.path.dirname(dest) or ".", exist_ok=True)
+            self.download(bucket, key, dest)
+            written.append(dest)
+        return written
+    downloadFolder = download_folder
+
+
+class GcsUploader:
+    """(ref s3/uploader/S3Uploader.java API shape)."""
+
+    MULTIPART_CHUNK = 8 * 1024 * 1024
+
+    def __init__(self, transport: Optional[GcsTransport] = None):
+        self.transport = transport or GsutilTransport()
+
+    def upload(self, file_path: str, bucket: str,
+               name: Optional[str] = None) -> None:
+        """upload(file, bucket) | upload(file, bucket, name) (the reference's
+        two overloads)."""
+        key = name or os.path.basename(file_path)
+        with open(file_path, "rb") as f:
+            self.transport.put(bucket, key, f.read())
+
+    def multi_part_upload(self, file_path: str, bucket: str,
+                          name: Optional[str] = None) -> int:
+        """(ref S3Uploader.multiPartUpload) — true chunked streaming: each
+        part is PUT as it is read (peak memory = one chunk), then composed
+        server-side into the destination and the parts deleted. Returns the
+        number of parts sent."""
+        key = name or os.path.basename(file_path)
+        part_keys = []
+        with open(file_path, "rb") as f:
+            while True:
+                chunk = f.read(self.MULTIPART_CHUNK)
+                if not chunk:
+                    break
+                pk = f"{key}.part{len(part_keys)}"
+                self.transport.put(bucket, pk, chunk)
+                part_keys.append(pk)
+        if not part_keys:  # empty file: one empty object
+            self.transport.put(bucket, key, b"")
+            return 1
+        self.transport.compose(bucket, part_keys, key)
+        for pk in part_keys:
+            self.transport.delete(bucket, pk)
+        return len(part_keys)
+    multiPartUpload = multi_part_upload
+
+    def upload_folder(self, bucket: str, key_prefix: str,
+                      folder_path: str) -> List[str]:
+        """(ref S3Uploader.uploadFolder) — recursive, keys mirror the tree."""
+        keys = []
+        for root, _, files in os.walk(folder_path):
+            for fn in sorted(files):
+                full = os.path.join(root, fn)
+                rel = os.path.relpath(full, folder_path)
+                key = f"{key_prefix.rstrip('/')}/{rel}" if key_prefix else rel
+                self.upload(full, bucket, key)
+                keys.append(key)
+        return keys
+    uploadFolder = upload_folder
+
+    def upload_file_list(self, bucket: str, folder_path: str,
+                         file_list: List[str],
+                         key_prefix: str = "") -> List[str]:
+        """(ref S3Uploader.uploadFileList)."""
+        keys = []
+        for fn in file_list:
+            full = os.path.join(folder_path, fn)
+            key = f"{key_prefix.rstrip('/')}/{fn}" if key_prefix else fn
+            self.upload(full, bucket, key)
+            keys.append(key)
+        return keys
+    uploadFileList = upload_file_list

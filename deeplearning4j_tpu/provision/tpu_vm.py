@@ -1,0 +1,185 @@
+"""TPU-VM cluster provisioning (L10 infra glue).
+
+Parity: ref deeplearning4j-aws/.../ec2/Ec2BoxCreator.java (create/blow-away
+EC2 boxes for a training cluster) + ec2/provision/HostProvisioner.java /
+ClusterSetup.java (ship files + run commands on every box over SSH). The
+TPU-native rendering of "provision a training cluster" is TPU-VM slice
+management: `gcloud compute tpus tpu-vm create/list/delete`, startup-script
+config shipping, and `ssh --worker=all` fan-out — the exact workflow a
+multi-host `jax.distributed` run needs (distributed/conf.py consumes the
+host list these commands produce).
+
+All cloud interaction goes through an injected `transport` (a callable
+`transport(argv) -> (returncode, stdout)`). The default shells out to the
+`gcloud` CLI; tests inject a mock transport, so everything here is testable
+with zero egress — and the command lines the mock records are exactly what
+an operator could paste into a shell.
+"""
+from __future__ import annotations
+
+import json
+import shlex
+import subprocess
+from typing import Callable, List, Optional, Sequence, Tuple
+
+Transport = Callable[[Sequence[str]], Tuple[int, str]]
+
+
+def gcloud_transport(argv: Sequence[str]) -> Tuple[int, str]:
+    """Default transport: run the real gcloud CLI (requires it installed and
+    authenticated; never exercised by the test suite)."""
+    proc = subprocess.run(list(argv), capture_output=True, text=True)
+    return proc.returncode, proc.stdout or proc.stderr
+
+
+class ProvisioningError(RuntimeError):
+    pass
+
+
+class TpuVmCreator:
+    """(ref ec2/Ec2BoxCreator.java — create()/createSpot()/blowAway()) —
+    creates, lists, and deletes TPU-VM slices.
+
+    accelerator_type is the 'instance size' analog (v5litepod-8 ...);
+    runtime_version the AMI analog."""
+
+    DEFAULT_RUNTIME = "tpu-ubuntu2204-base"
+
+    def __init__(self, name_prefix: str, num_slices: int,
+                 accelerator_type: str, zone: str,
+                 runtime_version: str = DEFAULT_RUNTIME,
+                 project: Optional[str] = None,
+                 startup_script: Optional[str] = None,
+                 transport: Transport = gcloud_transport):
+        self.name_prefix = str(name_prefix)
+        self.num_slices = int(num_slices)
+        self.accelerator_type = str(accelerator_type)
+        self.zone = str(zone)
+        self.runtime_version = str(runtime_version)
+        self.project = project
+        self.startup_script = startup_script
+        self.transport = transport
+        self.nodes_created: List[str] = []
+
+    def _base(self) -> List[str]:
+        argv = ["gcloud", "compute", "tpus", "tpu-vm"]
+        return argv
+
+    def _common(self) -> List[str]:
+        argv = [f"--zone={self.zone}"]
+        if self.project:
+            argv.append(f"--project={self.project}")
+        return argv
+
+    def _run(self, argv: Sequence[str]) -> str:
+        code, out = self.transport(argv)
+        if code != 0:
+            raise ProvisioningError(
+                f"command failed ({code}): {' '.join(map(str, argv))}\n{out}")
+        return out
+
+    def create(self, spot: bool = False) -> List[str]:
+        """Create `num_slices` TPU-VM slices (ref Ec2BoxCreator.create();
+        spot=True is the createSpot() analog — preemptible capacity)."""
+        for i in range(self.num_slices):
+            name = f"{self.name_prefix}-{i}"
+            argv = self._base() + ["create", name] + self._common() + [
+                f"--accelerator-type={self.accelerator_type}",
+                f"--version={self.runtime_version}"]
+            if spot:
+                argv.append("--spot")
+            if self.startup_script is not None:
+                argv.append(
+                    "--metadata=startup-script=" + self.startup_script)
+            self._run(argv)
+            self.nodes_created.append(name)
+        return list(self.nodes_created)
+
+    def create_spot(self) -> List[str]:
+        return self.create(spot=True)
+    createSpot = create_spot
+
+    def list_nodes(self) -> List[dict]:
+        """All slices in the zone, as parsed JSON (name/state/endpoints)."""
+        out = self._run(self._base() + ["list", "--format=json"]
+                        + self._common())
+        return json.loads(out) if out.strip() else []
+
+    def hosts(self) -> List[str]:
+        """Worker endpoint IPs of the slices this creator made — the
+        coordinator address list a jax.distributed run consumes
+        (ref Ec2BoxCreator.getHosts())."""
+        ips = []
+        mine = set(self.nodes_created)
+        for node in self.list_nodes():
+            # exact last-path-segment match: endswith would also claim a
+            # foreign 'retrain-0' for our 'train-0'
+            if node.get("name", "").split("/")[-1] in mine:
+                for ep in node.get("networkEndpoints", []):
+                    ip = ep.get("ipAddress")
+                    if ip:
+                        ips.append(ip)
+        return ips
+    getHosts = hosts
+
+    def blow_away(self) -> None:
+        """Delete every slice this creator made (ref Ec2BoxCreator.blowAway)."""
+        for name in self.nodes_created:
+            self._run(self._base() + ["delete", name, "--quiet"]
+                      + self._common())
+        self.nodes_created = []
+    blowAway = blow_away
+
+
+class ClusterSetup:
+    """(ref ec2/provision/ClusterSetup.java + HostProvisioner.java — upload
+    artifacts and run commands on every box over SSH) — the TPU-VM analogs
+    are `tpu-vm scp` and `tpu-vm ssh --worker=all`."""
+
+    def __init__(self, creator: TpuVmCreator):
+        self.creator = creator
+
+    def _each_node(self):
+        if not self.creator.nodes_created:
+            raise ProvisioningError("no nodes created yet")
+        return list(self.creator.nodes_created)
+
+    def upload(self, local_path: str, remote_path: str = "~/") -> None:
+        """Ship a file to every worker of every slice (HostProvisioner
+        .uploadAndRun's scp half; config-as-JSON shipping rides this)."""
+        for name in self._each_node():
+            self.creator._run(
+                self.creator._base() + [
+                    "scp", local_path, f"{name}:{remote_path}",
+                    "--worker=all"] + self.creator._common())
+
+    def run_on_all(self, command: str) -> List[str]:
+        """Run a shell command on every worker of every slice (ref
+        HostProvisioner.runRemoteCommand)."""
+        outs = []
+        for name in self._each_node():
+            outs.append(self.creator._run(
+                self.creator._base() + [
+                    "ssh", name, "--worker=all",
+                    f"--command={command}"] + self.creator._common()))
+        return outs
+
+    def launch_distributed(self, script_path: str,
+                           env: Optional[dict] = None,
+                           log_file: str = "dl4jtpu_train.log") -> List[str]:
+        """Upload a training script and start it on all workers — the
+        DistributedDeepLearningTrainer.java entry-point analog. JAX's TPU-VM
+        runtime wires process_id/coordinator automatically, so plain
+        `python script` on every worker forms the jax.distributed world.
+
+        The command is BACKGROUNDED (nohup ... &) so the ssh returns
+        immediately on every slice: the jax.distributed world needs all
+        slices' processes alive simultaneously — a blocking sequential
+        launch would deadlock slice 0 waiting for slice 1 to join."""
+        self.upload(script_path)
+        exports = "".join(f"export {k}={shlex.quote(str(v))} && "
+                          for k, v in (env or {}).items())
+        base = script_path.rsplit("/", 1)[-1]
+        inner = f"{exports}python3 {base}"
+        return self.run_on_all(
+            f"nohup sh -c {shlex.quote(inner)} > {log_file} 2>&1 &")

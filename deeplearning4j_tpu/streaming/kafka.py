@@ -1,0 +1,108 @@
+"""NDArray streaming-ingest client (L10 infra glue).
+
+Parity: ref dl4j-streaming/.../kafka/NDArrayKafkaClient.java (+
+NDArrayPublisher.java, NDArrayConsumer.java) — publish NDArrays to a topic
+and consume them on the training side. The reference routes through
+Camel+Kafka with base64'd Nd4j serde; the TPU rendering keeps the client
+shape (client.create_publisher() / client.create_consumer(), publish one or
+many arrays, get_arrays()/get_ndarray()) over a pluggable broker. The
+default `InProcessBroker` is the zero-dependency bounded-queue broker (the
+same backpressure contract as streaming/stream.py); a real Kafka/PubSub
+broker plugs in by implementing `send`/`poll` — the wire format (npy bytes)
+is already broker-agnostic.
+"""
+from __future__ import annotations
+
+import io
+import queue
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+def ndarray_to_bytes(arr) -> bytes:
+    """npy serde — the Nd4j base64 serde analog, but a standard format."""
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(arr), allow_pickle=False)
+    return buf.getvalue()
+
+
+def ndarray_from_bytes(data: bytes):
+    return np.load(io.BytesIO(data), allow_pickle=False)
+
+
+class InProcessBroker:
+    """Bounded per-topic queues — the in-process stand-in for the Kafka
+    broker (backpressure like streaming/stream.py: send blocks when the
+    consumer lags by `capacity` messages)."""
+
+    def __init__(self, capacity: int = 64):
+        self.capacity = int(capacity)
+        self._topics: Dict[str, "queue.Queue"] = {}
+
+    def _topic(self, name: str) -> "queue.Queue":
+        if name not in self._topics:
+            self._topics[name] = queue.Queue(maxsize=self.capacity)
+        return self._topics[name]
+
+    def send(self, topic: str, data: bytes,
+             timeout: Optional[float] = None) -> None:
+        self._topic(topic).put(data, timeout=timeout)
+
+    def poll(self, topic: str, timeout: Optional[float] = None) -> bytes:
+        return self._topic(topic).get(timeout=timeout)
+
+
+class NDArrayPublisher:
+    """(ref kafka/NDArrayPublisher.java) — publish(arr) | publish([arrs])."""
+
+    def __init__(self, broker, topic: str):
+        self.broker = broker
+        self.topic = topic
+
+    def publish(self, arr, timeout: Optional[float] = None) -> None:
+        if isinstance(arr, (list, tuple)):
+            for a in arr:
+                self.broker.send(self.topic, ndarray_to_bytes(a),
+                                 timeout=timeout)
+        else:
+            self.broker.send(self.topic, ndarray_to_bytes(arr),
+                             timeout=timeout)
+
+
+class NDArrayConsumer:
+    """(ref kafka/NDArrayConsumer.java) — getArrays(n) / getINDArray()."""
+
+    def __init__(self, broker, topic: str):
+        self.broker = broker
+        self.topic = topic
+
+    def get_arrays(self, count: int,
+                   timeout: Optional[float] = None) -> List[np.ndarray]:
+        return [ndarray_from_bytes(self.broker.poll(self.topic,
+                                                    timeout=timeout))
+                for _ in range(count)]
+    getArrays = get_arrays
+
+    def get_ndarray(self, timeout: Optional[float] = None) -> np.ndarray:
+        return self.get_arrays(1, timeout=timeout)[0]
+    getINDArray = get_ndarray
+
+
+class NDArrayStreamClient:
+    """(ref kafka/NDArrayKafkaClient.java) — the client facade: one broker
+    connection + topic, handing out publishers/consumers."""
+
+    def __init__(self, broker=None, topic: str = "ndarrays",
+                 capacity: int = 64):
+        self.broker = broker if broker is not None \
+            else InProcessBroker(capacity)
+        self.topic = topic
+
+    def create_publisher(self) -> NDArrayPublisher:
+        return NDArrayPublisher(self.broker, self.topic)
+    createPublisher = create_publisher
+
+    def create_consumer(self) -> NDArrayConsumer:
+        return NDArrayConsumer(self.broker, self.topic)
+    createConsumer = create_consumer

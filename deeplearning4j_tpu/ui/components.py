@@ -170,6 +170,73 @@ class ComponentChartHistogram(Component):
                 + "".join(parts) + "</svg>")
 
 
+class ComponentTimeline(Component):
+    """(ref the timeline charts StatsUtils.exportStatsAsHtml builds from
+    EventStats, dl4j-spark/.../stats/StatsUtils.java:72-86) — horizontal
+    lanes of [start, start+length) bars over a shared wall-clock axis;
+    hover shows the bar's label + duration (SVG <title>, dependency-free
+    like every component here)."""
+    component_type = "timeline"
+
+    def __init__(self, title: str,
+                 lanes: Sequence[Tuple[str, Sequence[Tuple[float, float, str]]]],
+                 width: int = 760, lane_height: int = 26):
+        # lanes: [(lane_name, [(start_s, length_s, bar_label), ...]), ...]
+        self.title = title
+        self.lanes = [(str(n), [(float(s), float(l), str(t)) for s, l, t in bars])
+                      for n, bars in lanes]
+        self.width = int(width)
+        self.lane_height = int(lane_height)
+
+    def to_dict(self):
+        return {"type": self.component_type, "title": self.title,
+                "lanes": [{"name": n,
+                           "bars": [{"start": s, "length": l, "label": t}
+                                    for s, l, t in bars]}
+                          for n, bars in self.lanes]}
+
+    def render_html(self):
+        W, LH, P = self.width, self.lane_height, 110  # left gutter for names
+        allb = [b for _, bars in self.lanes for b in bars]
+        if not allb:
+            return f"<h4>{_html.escape(self.title)}</h4><svg/>"
+        t0 = min(s for s, _, _ in allb)
+        t1 = max(s + l for s, l, _ in allb)
+        span = max(1e-9, t1 - t0)
+
+        def sx(v):
+            return P + (W - P - 10) * (v - t0) / span
+
+        H = LH * len(self.lanes) + 34
+        parts = []
+        for i, (name, bars) in enumerate(self.lanes):
+            y = i * LH + 4
+            color = _COLORS[i % len(_COLORS)]
+            parts.append(f'<text x="4" y="{y + LH - 12}" font-size="11">'
+                         f"{_html.escape(name)}</text>")
+            parts.append(f'<line x1="{P}" y1="{y + LH - 4}" x2="{W - 10}" '
+                         f'y2="{y + LH - 4}" stroke="#eee"/>')
+            for s, l, label in bars:
+                x = sx(s)
+                w = max(1.0, sx(s + l) - x)
+                parts.append(
+                    f'<rect x="{x:.1f}" y="{y}" width="{w:.1f}" '
+                    f'height="{LH - 8}" fill="{color}" fill-opacity="0.75">'
+                    f"<title>{_html.escape(label)} "
+                    f"({l * 1e3:.1f} ms)</title></rect>")
+        axis_y = LH * len(self.lanes) + 12
+        parts.append(f'<line x1="{P}" y1="{axis_y}" x2="{W - 10}" '
+                     f'y2="{axis_y}" stroke="#999"/>')
+        parts.append(f'<text x="{P}" y="{axis_y + 14}" font-size="11">'
+                     f"0 s</text>")
+        parts.append(f'<text x="{W - 70}" y="{axis_y + 14}" font-size="11">'
+                     f"{span:.3g} s</text>")
+        return (f"<h4>{_html.escape(self.title)}</h4>"
+                f'<svg xmlns="http://www.w3.org/2000/svg" width="{W}" '
+                f'height="{H}" style="background:#fff">'
+                + "".join(parts) + "</svg>")
+
+
 class ComponentDiv(Component):
     """(ref component/ComponentDiv.java) — container with child components."""
     component_type = "div"

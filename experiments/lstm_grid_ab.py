@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Same-session A/B of fused-LSTM grid layouts on the real TPU.
+
+Times value_and_grad through graves_lstm_scan_pallas at the bench layer shape
+(T=100, B=8192, H=256, bf16) with an on-device lax.scan loop (data dependence
+in the carry so XLA cannot hoist), min-of-3 per config, all configs in ONE
+session (the tunneled chip shows +-10-15% across sessions).
+
+Also calibrates the VMEM cost model: forced tile sizes that the model rejects
+are attempted anyway to find the real Mosaic compile limit.
+
+Usage: python experiments/lstm_grid_ab.py [quick]
+"""
+import sys
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+import deeplearning4j_tpu.ops.lstm_scan_fused as m
+
+T, B, H = 100, 8192, 256
+DTYPE = jnp.bfloat16
+REPS = 3
+LOOP = 5
+
+
+def make_args(dtype=DTYPE):
+    rng = np.random.RandomState(0)
+    mk = lambda *s: jnp.asarray(rng.randn(*s).astype(np.float32) * 0.1, dtype)
+    return (mk(T, B, 4 * H), mk(H, 4 * H), mk(H), mk(H), mk(H),
+            mk(B, H), mk(B, H))
+
+
+def timed(fn_jitted, args):
+    out = fn_jitted(*args)
+    jax.block_until_ready(out)
+    times = []
+    for _ in range(REPS):
+        t0 = time.perf_counter()
+        out = fn_jitted(*args)
+        jax.block_until_ready(out)
+        times.append(time.perf_counter() - t0)
+    return min(times) / LOOP * 1e3  # ms per fwd+bwd
+
+
+def build(args):
+    def step(xw, rest):
+        rw, pi, pf, po, h0, c0 = rest
+
+        def loss(*a):
+            ys, cs = m.graves_lstm_scan_pallas(*a)
+            return jnp.sum(ys.astype(jnp.float32)) + \
+                jnp.sum(cs.astype(jnp.float32))
+
+        _, grads = jax.value_and_grad(loss, argnums=(0,))(
+            xw, rw, pi, pf, po, h0, c0)
+        return xw + grads[0] * jnp.asarray(1e-6, xw.dtype)  # data dependence
+
+    def loop(xw, *rest):
+        def body(c, _):
+            return step(c, rest), ()
+        out, _ = jax.lax.scan(body, xw, None, length=LOOP)
+        return out
+
+    return jax.jit(loop)
+
+
+def run(tag, grid, K, gate="fp32", force_bt=None):
+    prev = m.configure(grid=grid, k_steps=K, gate_math=gate)
+    orig_pick = m._pick_bt
+    if force_bt is not None:
+        m._pick_bt = lambda B_, H_, db, bwd, tm_, K_=1: \
+            force_bt[1] if bwd else force_bt[0]
+    try:
+        args = make_args()
+        db = 2
+        tm, k, btf, btb = m._pick_layout(T, B, H, db)
+        ms = timed(build(args), args)
+        toks = B * T / (ms * 1e-3)
+        print(f"{tag:34s} tm={tm} K={k} bt_f={btf} bt_b={btb} "
+              f"{ms:8.2f} ms  {toks / 1e6:7.2f} M tok/s(kernel-only)")
+        return ms
+    except Exception as e:
+        print(f"{tag:34s} FAILED: {type(e).__name__}: "
+              f"{str(e).splitlines()[0][:90]}")
+        return None
+    finally:
+        m._pick_bt = orig_pick
+        m.configure(**prev)
+
+
+def main():
+    quick = "quick" in sys.argv
+    jax.config.update("jax_compilation_cache_dir",
+                      "/root/.cache/dl4jtpu_xla")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    print(f"device: {jax.devices()[0]}")
+    results = {}
+    results["bm_K1"] = run("bm K=1 (r5 cost model tiles)", "bm", 1)
+    results["tm_K1"] = run("tm K=1", "tm", 1)
+    if not quick:
+        results["bm_K1_r4tiles"] = run(
+            "bm K=1 FORCED r4 tiles 1024/512", "bm", 1,
+            force_bt=(1024, 512))
+        results["tm_K1_big"] = run(
+            "tm K=1 FORCED 1024/512", "tm", 1, force_bt=(1024, 512))
+        results["bm_K2"] = run("bm K=2", "bm", 2)
+        results["bm_K4"] = run("bm K=4", "bm", 4)
+        results["tm_K2"] = run("tm K=2", "tm", 2)
+        results["tm_K4"] = run("tm K=4", "tm", 4)
+        results["tm_K5"] = run("tm K=5", "tm", 5)
+        results["bm_K5"] = run("bm K=5", "bm", 5)
+    best = min((v, k) for k, v in results.items() if v)
+    print(f"\nbest: {best[1]} at {best[0]:.2f} ms")
+    # gate-math A/B on the best layout
+    cfg = {"bm": ("bm",), "tm": ("tm",)}
+    name = best[1]
+    grid = "tm" if name.startswith("tm") else "bm"
+    K = int(name.split("K")[1].split("_")[0]) if "K" in name else 1
+    run(f"{grid} K={K} gate=native (bf16)", grid, K, gate="native")
+    run(f"{grid} K={K} gate=fp32 (recheck)", grid, K, gate="fp32")
+
+
+if __name__ == "__main__":
+    main()

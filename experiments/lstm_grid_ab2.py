@@ -1,0 +1,31 @@
+#!/usr/bin/env python
+"""Round 2: probe the REAL Mosaic VMEM limit with forced tiles — the round-1
+plateau (~40 ms at ~2400 grid steps) is grid-step-count-bound, so push K*bt.
+Same protocol as lstm_grid_ab.py (same session, min-of-3, on-device loop)."""
+import sys
+
+sys.path.insert(0, "/root/repo")
+from experiments.lstm_grid_ab import run  # noqa: E402
+
+import jax  # noqa: E402
+
+
+def main():
+    jax.config.update("jax_compilation_cache_dir", "/root/.cache/dl4jtpu_xla")
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    print(f"device: {jax.devices()[0]}")
+    run("bm K=1 FORCED 1024/512 (recheck)", "bm", 1, force_bt=(1024, 512))
+    run("tm K=1 FORCED 1024/512 (retry)", "tm", 1, force_bt=(1024, 512))
+    run("bm K=1 FORCED 2048/1024", "bm", 1, force_bt=(2048, 1024))
+    run("bm K=2 FORCED 1024/512", "bm", 2, force_bt=(1024, 512))
+    run("bm K=2 FORCED 2048/1024", "bm", 2, force_bt=(2048, 1024))
+    run("bm K=4 FORCED 1024/512", "bm", 4, force_bt=(1024, 512))
+    run("bm K=5 FORCED 512/256", "bm", 5, force_bt=(512, 256))
+    run("bm K=10 FORCED 512/256", "bm", 10, force_bt=(512, 256))
+    # gate math on the forced big-tile layout
+    run("bm K=1 1024/512 gate=native", "bm", 1, gate="native",
+        force_bt=(1024, 512))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,17 @@
+#!/usr/bin/env python
+"""Round 3: the r4 time-major config (bt 512/256 — the recorded +57.7%
+session) vs today's best batch-major, same session."""
+import sys
+
+sys.path.insert(0, "/root/repo")
+from experiments.lstm_grid_ab import run  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/root/.cache/dl4jtpu_xla")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+print(f"device: {jax.devices()[0]}")
+run("tm K=1 FORCED 512/256 (r4 cfg)", "tm", 1, force_bt=(512, 256))
+run("tm K=1 FORCED 1024/256", "tm", 1, force_bt=(1024, 256))
+run("bm K=1 FORCED 1024/512 (anchor)", "bm", 1, force_bt=(1024, 512))
+run("tm K=2 FORCED 512/256", "tm", 2, force_bt=(512, 256))

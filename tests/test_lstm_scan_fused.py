@@ -57,6 +57,34 @@ def test_non_divisible_batch_pads_exactly():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-9)
 
 
+@pytest.mark.parametrize("grid,K", [("bm", 1), ("bm", 2), ("bm", 5),
+                                    ("tm", 1), ("tm", 2), ("tm", 5)])
+def test_layout_matrix_value_and_grad_fp64(grid, K):
+    """Every grid layout x K-step combination the dispatcher can pick must
+    match the lax.scan oracle exactly — value AND all seven gradients
+    (non-divisible B exercises the padding path in both layouts)."""
+    import deeplearning4j_tpu.ops.lstm_scan_fused as m
+    args = _data(T=10, B=12, H=8)
+
+    def loss(fn):
+        def f(*a):
+            ys, cs = fn(*a)
+            return jnp.sum(jnp.sin(ys)) + jnp.sum(cs ** 2)
+        return f
+
+    ref_v, ref_g = jax.value_and_grad(
+        loss(graves_lstm_scan_xla), argnums=tuple(range(7)))(*args)
+    prev = m.configure(grid=grid, k_steps=K)
+    try:
+        v, g = jax.value_and_grad(
+            loss(graves_lstm_scan_pallas), argnums=tuple(range(7)))(*args)
+    finally:
+        m.configure(**prev)
+    assert abs(float(v - ref_v)) < 1e-10
+    for a, b in zip(g, ref_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-9)
+
+
 @pytest.mark.parametrize("use_dcs", [False, True])
 def test_backward_matches_scan_autodiff_fp64(use_dcs):
     args = _data(T=7, B=8, H=8)
@@ -102,25 +130,34 @@ def test_fp64_finite_differences_through_kernel():
         assert abs(num - ana[i]) / denom < 1e-5, (i, num, ana[i])
 
 
-def test_multi_batch_tile_parity(monkeypatch):
-    """nb > 1: the VMEM state carries must be per-tile rows, not a shared
-    buffer (regression: a (bt, H) scratch was clobbered between tiles)."""
+@pytest.mark.parametrize("grid", ["bm", "tm"])
+def test_multi_batch_tile_parity(monkeypatch, grid):
+    """nb > 1 in BOTH grid layouts: the VMEM state carries must be per-tile
+    rows, not a shared buffer (regression: a (bt, H) scratch was clobbered
+    between tiles)."""
     import deeplearning4j_tpu.ops.lstm_scan_fused as m
-    monkeypatch.setattr(m, "_pick_bt",
-                    lambda B, H, dtype_bytes=2, bwd=False: B // 4)
-    args = _data(T=6, B=16, H=8)
-    ys_p, cs_p = m.graves_lstm_scan_pallas(*args)
-    ys_x, cs_x = graves_lstm_scan_xla(*args)
-    np.testing.assert_allclose(np.asarray(ys_p), np.asarray(ys_x), atol=1e-12)
+    monkeypatch.setattr(
+        m, "_pick_bt", lambda B, H, db, bwd, time_major, K=1: B // 4)
+    prev = m.configure(grid=grid)
+    try:
+        args = _data(T=6, B=16, H=8)
+        ys_p, cs_p = m.graves_lstm_scan_pallas(*args)
+        ys_x, cs_x = graves_lstm_scan_xla(*args)
+        np.testing.assert_allclose(np.asarray(ys_p), np.asarray(ys_x),
+                                   atol=1e-12)
 
-    def loss(fn):
-        return lambda *a: jnp.sum(jnp.sin(fn(*a)[0]))
+        def loss(fn):
+            return lambda *a: jnp.sum(jnp.sin(fn(*a)[0]))
 
-    gp = jax.grad(loss(m.graves_lstm_scan_pallas),
-                  argnums=tuple(range(7)))(*args)
-    gx = jax.grad(loss(graves_lstm_scan_xla), argnums=tuple(range(7)))(*args)
-    for a, b in zip(gp, gx):
-        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-9)
+        gp = jax.grad(loss(m.graves_lstm_scan_pallas),
+                      argnums=tuple(range(7)))(*args)
+        gx = jax.grad(loss(graves_lstm_scan_xla),
+                      argnums=tuple(range(7)))(*args)
+        for a, b in zip(gp, gx):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-9)
+    finally:
+        m.configure(**prev)
 
 
 def test_net_level_training_identical_with_fused_scan(monkeypatch):
