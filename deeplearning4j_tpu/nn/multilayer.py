@@ -522,6 +522,32 @@ class MultiLayerNetwork:
             jnp.asarray(self._step, jnp.int32), self._rng, x, y, None, None,
             n=1)
 
+    def train_step_costs(self, x, y) -> dict:
+        """{'flops', 'bytes_accessed'} of ONE fit_on_device training step per
+        XLA's cost model — the roofline inputs (bench.py)."""
+        self._check_init()
+        x = jnp.asarray(x, self.dtype)
+        y = jnp.asarray(y, self.dtype)
+        from deeplearning4j_tpu.util.costs import lowered_costs
+        run = self._get_device_loop(False, False, False)
+        return lowered_costs(
+            run, self.params_tree, self._opt_state, self.state_tree,
+            jnp.asarray(self._step, jnp.int32), self._rng, x, y, None, None,
+            n=1)
+
+    def activation_bytes(self, x) -> int:
+        """Sum of per-layer training activation bytes for input x, via
+        abstract eval (nothing allocates) — the unavoidable-traffic side of
+        the roofline."""
+        self._check_init()
+        shapes = jax.eval_shape(
+            lambda p, s, xx: self._forward(p, s, xx, train=True,
+                                           collect=True)[1],
+            self.params_tree, self.state_tree,
+            jax.ShapeDtypeStruct(np.asarray(x).shape, self.compute_dtype))
+        return sum(l.size * l.dtype.itemsize
+                   for l in jax.tree_util.tree_leaves(shapes))
+
     def fit(self, data, labels=None, epochs: int = 1):
         """fit(x, y) | fit(DataSet) | fit(DataSetIterator[, epochs])
         (ref MultiLayerNetwork.fit :1149)."""

@@ -6,20 +6,28 @@ with ONE fused sequence kernel. The round-4 per-gate Pallas kernel still left
 the `lax.scan` dispatching several XLA kernels per timestep (recurrent
 matmul, gate fusion, state select); at bench shapes the scan is
 overhead-bound, not FLOP- or bandwidth-bound. This kernel runs the ENTIRE
-recurrence as one `pallas_call`, in one of two grid layouts picked by shape:
+recurrence as one `pallas_call`. Two grid layouts share one kernel body
+(`_make_fwd_kernel`/`_make_bwd_kernel`), plus a K-timestep tile factor:
 
-- TIME-major grid (T/K, B/bt): the FULL (B, H) h/c state is resident in
-  VMEM scratch; batch tiles iterate fastest, so consecutive grid steps
-  pipeline independent tiles' DMAs and MXU work (measured faster than
-  batch-major when the full state fits — it needs 2*B*H bytes of scratch);
-- BATCH-major grid (B/bt, T/K): each batch tile runs its whole time sweep
-  before the next tile starts, so only a (bt, H) h/c scratch is resident —
-  works at ANY batch size and is the fallback when time-major cannot fit.
+- BATCH-major grid (B/bt, T/K) — THE DEFAULT: each batch tile runs its
+  whole time sweep before the next tile starts, so only a (bt, H) h/c
+  scratch is resident and the streamed tiles can be as large as VMEM
+  allows; works at ANY batch size.
+- TIME-major grid (T/K, B/bt): the FULL (B, H) h/c state resident in VMEM
+  scratch, batch tiles iterating fastest. The r5 same-session A/B measured
+  it SLOWER at every VMEM-feasible tile (43-51 ms vs batch-major's 39.5 ms
+  kernel-level at the bench shape; the state scratch crowds out streamed
+  tile bytes, adding grid steps). Kept selectable via configure(grid="tm").
+- K > 1 processes K consecutive timesteps per grid step (streaming a
+  (K, bt, 4H) xw block). Measured: no win — VMEM caps K*bt, so K>1 only
+  shrinks bt (39.96-40.91 ms vs 39.51 ms). The auto-picker prefers the
+  biggest tiles at K=1 accordingly; K stays available for future chips
+  with more VMEM.
 
-Both layouts share one kernel body (`_make_fwd_kernel`/`_make_bwd_kernel`);
-K > 1 processes K consecutive timesteps per grid step (streaming a
-(K, bt, 4H) xw block) to amortize per-grid-step latency — the dominant cost
-at bench shapes (see PERF.md roofline).
+The backward reads h_prev/c_prev DIRECTLY from the forward's ys/cs outputs
+via a one-step-shifted clamped index map (initial state substituted
+in-kernel at the t=0 boundary), deleting two (T, B, H) HBM concat copies
+per backward.
 
 - per step: xw_t block streams in (double-buffered DMA under the grid
   pipeline), gates = xw_t + h @ RW on the MXU, peephole cell update on the
@@ -64,13 +72,18 @@ def _interpret() -> bool:
     return interpret_mode()
 
 
-VMEM_BUDGET = 14 * 1024 * 1024  # headroom under Mosaic's 16 MB scoped limit
+# Headroom under Mosaic's 16 MB scoped VMEM limit, CALIBRATED against real
+# compiles (r5 A/B, experiments/lstm_grid_ab*.py): at the bench shape
+# (H=256, bf16) the estimate for the largest config that compiles (bwd
+# bt=512) is 14.69 MB and the smallest that fails (bwd bt=1024, fwd
+# bt=2048, tm 1024/512) estimates >= 19 MB — 15 MB splits them.
+VMEM_BUDGET = 15 * 1024 * 1024
 
 _TILES = (2048, 1024, 512, 256, 128, 64, 32, 16, 8)
 
 # Dispatch knobs — production defaults; configure() overrides for A/Bs.
-#   grid: "auto" (time-major when the full state fits, else batch-major),
-#         "tm" / "bm" force one layout.
+#   grid: "auto" = batch-major (the r5 A/B refuted time-major at every
+#         VMEM-feasible tile); "tm" / "bm" force one layout.
 #   k_steps: 0 = auto (largest of _K_CANDIDATES dividing T that fits VMEM),
 #            n >= 1 forces K=n (requires K | T).
 #   gate_math: "fp32" promotes gate math one width up; "native" keeps the
@@ -146,15 +159,31 @@ def _pick_layout(T: int, B: int, H: int, db: int):
                 f"forced k_steps={ks[0]} does not divide T={T}")
     else:
         ks = _K_CANDIDATES
-    for tm in ((True, False) if mode == "auto" else
-               ((mode == "tm"),)):
+    # auto grid = batch-major: the r5 same-session A/B measured tm SLOWER at
+    # every VMEM-feasible tile (its full-state scratch shrinks the streamed
+    # tiles, adding grid steps — 43-51 ms vs bm's 39.5 ms kernel-level; the
+    # r4 "+57.7% tm" config measured 48.6 ms on recheck). tm stays
+    # selectable via configure(grid="tm") for future hardware.
+    modes = (mode == "tm",) if mode in ("tm", "bm") else (False,)
+    best = None
+    for tm in modes:
         for K in ks:
             if T % K:
                 continue
             bt_f = _pick_bt(B, H, db, False, tm, K)
             bt_b = _pick_bt(B, H, db, True, tm, K)
-            if bt_f is not None and bt_b is not None:
-                return tm, K, bt_f, bt_b
+            if bt_f is None or bt_b is None:
+                continue
+            # MEASURED objective (r5 A/B): the biggest tiles win — bm K=1
+            # 1024/512 at 39.5 ms beat every K>1 config (39.96-40.91 ms)
+            # even when K*bt said fewer grid steps; per-step DMA/MXU
+            # efficiency of large tiles dominates. Prefer max tile bytes,
+            # then smaller K.
+            score = (bt_f + bt_b, -K)
+            if best is None or score > best[0]:
+                best = (score, (tm, K, bt_f, bt_b))
+    if best is not None:
+        return best[1]
     if mode != "auto" or _CONFIG["k_steps"]:
         raise ValueError(
             f"forced layout grid={mode!r} k_steps={_CONFIG['k_steps']} "
@@ -239,15 +268,21 @@ def _make_fwd_kernel(time_major: bool, K: int):
     return kernel
 
 
-def _make_bwd_kernel(time_major: bool, K: int):
+def _make_bwd_kernel(time_major: bool, K: int, direct_prev: bool = False):
     """Reverse-sweep grid step covering K timesteps, recomputing the gates
     from streamed (xw, h_prev, c_prev) and folding the cs-cotangents into
     the carried dc. dRW / peephole grads accumulate in VMEM scratch across
-    the whole grid (zeroed on the first step, flushed on the last)."""
+    the whole grid (zeroed on the first step, flushed on the last).
+
+    direct_prev (K=1 only): h_prev/c_prev are read DIRECTLY from the fwd's
+    ys/cs outputs with a one-step-shifted (clamped) index map, selecting the
+    streamed h0/c0 block at the time-0 step in-kernel — this deletes the
+    hprev/cprev concat materialization (two (T, B, H) HBM copies per
+    backward) the non-direct path pays."""
     from jax.experimental import pallas as pl
 
     def kernel(xw_ref, rw_ref, pi_ref, pf_ref, po_ref,
-               hprev_ref, cprev_ref, dys_ref, dcs_ref,
+               hprev_ref, cprev_ref, h0_ref, c0_ref, dys_ref, dcs_ref,
                dxw_ref, drw_ref, dpi_ref, dpf_ref, dpo_ref,
                dh0_ref, dc0_ref, dh_scr, dc_scr, drw_scr, dp_scr):
         bt = xw_ref.shape[1]
@@ -283,8 +318,17 @@ def _make_bwd_kernel(time_major: bool, K: int):
         # the block holds K timesteps in ascending time order; the reversed
         # sweep processes them k = K-1 .. 0
         for k in reversed(range(K)):
-            h_prev = hprev_ref[k]
-            c_prev = cprev_ref[k].astype(acc)
+            if direct_prev:
+                # grid step t (reversed) handles time nt-1-t; its h_prev is
+                # ys[time-1], streamed via the clamped shifted index map —
+                # at time 0 (t == nt-1) substitute the initial state
+                is_first = (t == nt - 1)
+                h_prev = jnp.where(is_first, h0_ref[0], hprev_ref[k])
+                c_prev = jnp.where(is_first, c0_ref[0],
+                                   cprev_ref[k]).astype(acc)
+            else:
+                h_prev = hprev_ref[k]
+                c_prev = cprev_ref[k].astype(acc)
             gates = xw_ref[k].astype(acc) + jnp.dot(
                 h_prev, rw_ref[:], preferred_element_type=acc)
             i = jax.nn.sigmoid(gates[:, :H] + c_prev * pi)
@@ -415,11 +459,6 @@ def _scan_bwd(saved, cots):
     # integration dcs is all-zeros except where the final cell state is
     # consumed; support general dcs exactly by folding dcs_t into the
     # carried dc BEFORE the gate backward of step t, inside the kernel.
-    hprev = _pad_batch(jnp.concatenate([h0[None], ys[:-1]], axis=0), Bp)
-    cprev = _pad_batch(jnp.concatenate([c0[None], cs[:-1]], axis=0), Bp)
-    xw = _pad_batch(xw, Bp)
-    dys = _pad_batch(dys, Bp)
-    dcs = _pad_batch(dcs, Bp)
     acc = jnp.promote_types(xw.dtype, jnp.float32)
     grid = (nt, nb) if tm else (nb, nt)
     if tm:
@@ -430,8 +469,28 @@ def _scan_bwd(saved, cots):
         rev = lambda b, t: (nt - 1 - t, b, 0)
         cmap = lambda b, t: (0, 0)
         pmap_ = lambda b, t: (0, b, 0)
+    direct = K == 1
+    if direct:
+        # read h_prev/c_prev straight from ys/cs via the one-step-shifted
+        # clamped map (the t==0 boundary substitutes h0/c0 in-kernel) —
+        # no (T, B, H) concat copies
+        hsrc = _pad_batch(ys, Bp)
+        csrc = _pad_batch(cs, Bp)
+        if tm:
+            prev_map = lambda t, b: (jnp.maximum(nt - 2 - t, 0), b, 0)
+        else:
+            prev_map = lambda b, t: (jnp.maximum(nt - 2 - t, 0), b, 0)
+    else:
+        hsrc = _pad_batch(jnp.concatenate([h0[None], ys[:-1]], axis=0), Bp)
+        csrc = _pad_batch(jnp.concatenate([c0[None], cs[:-1]], axis=0), Bp)
+        prev_map = rev
+    h0p = _pad_batch(h0[None], Bp)
+    c0p = _pad_batch(c0[None], Bp)
+    xw = _pad_batch(xw, Bp)
+    dys = _pad_batch(dys, Bp)
+    dcs = _pad_batch(dcs, Bp)
     dxw, drw, dpi, dpf, dpo, dh0, dc0 = pl.pallas_call(
-        _make_bwd_kernel(tm, K),
+        _make_bwd_kernel(tm, K, direct_prev=direct),
         grid=grid,
         in_specs=[
             pl.BlockSpec((K, bt, 4 * H), rev),
@@ -439,8 +498,10 @@ def _scan_bwd(saved, cots):
             pl.BlockSpec((1, H), cmap),
             pl.BlockSpec((1, H), cmap),
             pl.BlockSpec((1, H), cmap),
-            pl.BlockSpec((K, bt, H), rev),
-            pl.BlockSpec((K, bt, H), rev),
+            pl.BlockSpec((K, bt, H), prev_map),
+            pl.BlockSpec((K, bt, H), prev_map),
+            pl.BlockSpec((1, bt, H), pmap_),
+            pl.BlockSpec((1, bt, H), pmap_),
             pl.BlockSpec((K, bt, H), rev),
             pl.BlockSpec((K, bt, H), rev),
         ],
@@ -469,7 +530,7 @@ def _scan_bwd(saved, cots):
             pltpu.VMEM((3, H), acc),
         ],
         interpret=_interpret(),
-    )(xw, rw, p2(pi), p2(pf), p2(po), hprev, cprev, dys, dcs)
+    )(xw, rw, p2(pi), p2(pf), p2(po), hsrc, csrc, h0p, c0p, dys, dcs)
     return (dxw[:, :B], drw.astype(rw.dtype),
             dpi.reshape(H).astype(pi.dtype),
             dpf.reshape(H).astype(pf.dtype), dpo.reshape(H).astype(po.dtype),
@@ -477,10 +538,12 @@ def _scan_bwd(saved, cots):
 
 
 graves_lstm_scan_pallas.defvjp(_scan_fwd, _scan_bwd)
-# default-on for TPU: BENCH_r04 artifact measured +47% tokens/s (6.36M ->
-# 9.34M, batch-major grid); the time-major grid measured +57.7% same-session
-# (6.49M -> 10.23M) and is now auto-selected when the full state fits.
-# Exact fp64 parity + bf16 net-level equivalence tests gate every layout.
+# default-on for TPU: the r5 full-bench artifact measures 11.14M tokens/s
+# helpers-on vs 6.47M off (+72%; batch-major fwd-1024/bwd-512 K=1 with the
+# direct-prev backward). The r4 "+57.7% time-major" result was REFUTED on
+# recheck (48.6 ms vs batch-major's 39.5 ms kernel-level) — auto dispatch
+# is batch-major; tm stays selectable via configure(grid="tm"). Exact fp64
+# parity + bf16 net-level equivalence tests gate every layout.
 register_helper("graves_lstm_scan", default_on=True)(graves_lstm_scan_pallas)
 
 

@@ -109,7 +109,13 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
     # across nb blocks degrades vs the dense softmax it replaces
     acc_dt = jnp.promote_types(q.dtype, jnp.float32)
 
+    @jax.checkpoint
     def step(acc, inp):
+        # rematerialized: without checkpoint, jax.grad through the scan
+        # saves each block's (B, H, T, blk) scores/mask residuals — O(T^2)
+        # training memory, exactly what blockwise attention exists to avoid
+        # (measured: T=8192 b4 d256 OOM'd at 24.6 GB on a 16 GB chip; with
+        # remat it trains). Flash-attention recomputes per block; so do we.
         kb_, vb_, kmb_, ki_ = inp
         m = kmb_[:, None, None, :]  # (B,1,1,blk), broadcasts in _block_attn
         if causal:
@@ -159,7 +165,10 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
             ki = kv_owner * blk + jnp.arange(blk)
             return (qi[:, None] >= ki[None, :])[None, None]  # (1,1,blk,blk)
 
+        @jax.checkpoint
         def step(carry, r):
+            # rematerialized for the same reason as blockwise_attention's
+            # step: per-round score residuals under jax.grad are O(T^2/n)
             acc, kb, vb, mb = carry
             owner = (my - r) % n_dev  # whose k/v block is resident this round
             m = None if mb is None else (mb > 0)[:, None, None, :]  # (b,1,1,blk)

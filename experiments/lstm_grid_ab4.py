@@ -1,0 +1,42 @@
+#!/usr/bin/env python
+"""Round 4: final config (auto = bm K=1 1024/512 + direct-prev bwd) vs the
+round-1 anchor, kernel-level, same session; then END-TO-END bench_graves_lstm
+helpers on/off with jaxpr engagement check."""
+import sys
+
+sys.path.insert(0, "/root/repo")
+from experiments.lstm_grid_ab import run  # noqa: E402
+
+import jax  # noqa: E402
+
+jax.config.update("jax_compilation_cache_dir", "/root/.cache/dl4jtpu_xla")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+print(f"device: {jax.devices()[0]}")
+run("AUTO (bm K=1 1024/512 direct-prev)", "auto", 0)
+run("bm K=1 1024/512 direct (forced)", "bm", 1, force_bt=(1024, 512))
+
+# end-to-end: the real model through the helper seam
+import numpy as np  # noqa: E402
+import bench  # noqa: E402
+
+for helpers in (False, True, True):  # on measured twice (variance read)
+    r = bench.bench_graves_lstm(helpers=helpers)
+    print(f"e2e helpers={helpers}: {r['tokens_per_sec'] / 1e6:.2f}M tok/s "
+          f"({r['ms_per_iter']:.1f} ms)")
+
+# engagement check: the kernel name must appear in the jaxpr of the
+# helpers-on layer path (memory: never trust a helper A/B without this)
+from deeplearning4j_tpu.models import TextGenerationLSTM  # noqa: E402
+from deeplearning4j_tpu.ops.helpers import helpers_enabled_ctx  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+with helpers_enabled_ctx(True):
+    net = TextGenerationLSTM(total_unique_characters=47, seed=42,
+                             compute_dtype="bfloat16").init()
+    x = jnp.zeros((8192, 47, 100), jnp.float32)
+    y = jnp.zeros((8192, 47, 100), jnp.float32)
+    jaxpr = str(jax.make_jaxpr(
+        lambda p, s, xx, yy: net._loss_fn(p, s, xx, yy, None, None, None,
+                                          True, None)[0])(
+        net.params_tree, net.state_tree, x, y))
+    print("kernel engaged:", "lstm" in jaxpr and "pallas" in jaxpr.lower())
