@@ -103,12 +103,27 @@ class SelfAttentionLayer(FeedForwardLayerConf):
                                  causal=self.causal, mask=mask,
                                  batch_axis=ctx.data_axis)
         elif self.block_size and T > self.block_size and not seq_sharded:
-            # single-device long-context path (flash recurrence). Skipped
-            # under GSPMD context parallelism: there the DENSE einsums are
-            # what XLA partitions over the seq axis — a lax.scan over
-            # reshaped k/v blocks would force cross-shard gathers instead
-            out = blockwise_attention(q, k, v, self.block_size,
-                                      causal=self.causal, mask=mask)
+            # single-device long-context path. Preferred impl: the fused
+            # flash-attention Pallas kernel (ops/flash_attention.py,
+            # default-on for TPU) — the whole online-softmax recurrence in
+            # one kernel with an fp32-exact custom VJP that recomputes p
+            # per tile. Fallback: the lax.scan blockwise recurrence (same
+            # math, XLA-scheduled). Both skipped under GSPMD context
+            # parallelism: there the DENSE einsums are what XLA partitions
+            # over the seq axis — a lax.scan over reshaped k/v blocks
+            # would force cross-shard gathers instead
+            from deeplearning4j_tpu.ops.helpers import (
+                helpers_enabled_for, registered_helpers)
+            if "flash_attention" in registered_helpers() \
+                    and helpers_enabled_for("flash_attention"):
+                from deeplearning4j_tpu.ops.flash_attention import (
+                    flash_attention)
+                # the kernel picks its own MXU-sized tiles; the layer's
+                # block_size only governs the fallback scan granularity
+                out = flash_attention(q, k, v, mask, self.causal)
+            else:
+                out = blockwise_attention(q, k, v, self.block_size,
+                                          causal=self.causal, mask=mask)
         else:
             # dense path: small T, or GSPMD CP (ctx.seq_axis sharding — the
             # einsums partition across chips with XLA inserting collectives)

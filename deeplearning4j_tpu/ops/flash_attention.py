@@ -1,0 +1,380 @@
+"""Fused flash-attention Pallas kernels — the long-context hot path.
+
+Beyond-reference (the 2017 reference has no attention at all; SURVEY §5
+long-context). The framework's blockwise/ring attention
+(parallel/sequence_parallel.py) implements the flash RECURRENCE as a
+lax.scan — correct and O(T*block) memory, but each block step dispatches
+thin XLA ops (scores matmul, exp/merge chain on the VPU, rescale) and
+training rematerializes the whole scan body. These kernels fuse the
+recurrence on-chip (arXiv:2205.14135 / flash-attention-2 schedule):
+
+- forward: grid (B*H, T/bq, T/bk) with the k index FASTEST — the online
+  softmax accumulator (acc, m, l) lives in VMEM scratch across each q
+  block's k sweep (sequential, grid-order guarantee as in
+  lstm_scan_fused), one (bq, bk) score tile at a time; emits o and the
+  row logsumexp L = m + log(l) for the backward;
+- backward (flash-2 two-pass): dq kernel over the same grid accumulating
+  dq in scratch; dkv kernel with the q index fastest accumulating dk/dv.
+  p is RECOMPUTED from (q, k, L) — nothing but o/L is saved;
+  D_i = rowsum(dO * o) is one cheap XLA reduction outside.
+
+Causal masking and the framework's (B, T) key-padding masks are applied
+per score tile from global row/col ids. Score/softmax math is fp32
+(flash convention); q/k/v stream in their storage dtype (bf16 on TPU).
+
+Registered as helper "flash_attention" (default-on for TPU);
+SelfAttentionLayer's long-context path dispatches here when enabled, with
+the lax.scan blockwise recurrence as the universal fallback.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.helpers import register_helper
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    from deeplearning4j_tpu.ops.helpers import interpret_mode
+    return interpret_mode()
+
+
+DEFAULT_BQ = 512
+DEFAULT_BK = 512
+
+
+def _blocks(T: int, b: int) -> int:
+    return -(-T // b)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, l_ref,
+                acc_scr, m_scr, l_scr, *, causal, scale, bq, bk, T, Tp,
+                has_mask, acc_dt):
+    from jax.experimental import pallas as pl
+    j = pl.program_id(2)
+    i = pl.program_id(1)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    def update(masked):
+        def body():
+            s = jax.lax.dot_general(
+                q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=acc_dt) * scale
+            if masked:
+                valid = _valid_tile(pl, i, j, bq, bk, T, Tp, causal,
+                                    has_mask, km_ref)
+                s = jnp.where(valid, s, NEG_INF)
+            m_new = jnp.maximum(m_scr[:], jnp.max(s, axis=1))
+            p = jnp.exp(s - m_new[:, None])
+            if masked:
+                p = jnp.where(valid, p, 0.0)
+            alpha = jnp.exp(m_scr[:] - m_new)
+            l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=1)
+            acc_scr[:] = acc_scr[:] * alpha[:, None] + jax.lax.dot_general(
+                p.astype(v_ref.dtype), v_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=acc_dt)
+            m_scr[:] = m_new
+        return body
+
+    _dispatch_tile(pl, update, i, j, nk, bq, bk, T, Tp, causal, has_mask)
+
+    @pl.when(j == nk - 1)
+    def _():
+        l = l_scr[:]
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l, 1e-30)[:, None]).astype(
+            o_ref.dtype)
+        # L for the backward: rows with no visible key keep L = NEG_INF
+        # (their recomputed p is masked to 0 anyway)
+        l_ref[0, 0, pl.ds(i * bq, bq)] = jnp.where(
+            l > 0, m_scr[:] + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+
+
+def _valid_tile(pl, i, j, bq, bk, T, Tp, causal, has_mask, km_ref):
+    """(bq, bk) validity of this score tile — built ONLY for tiles that
+    need masking (the dispatcher routes interior causal tiles to the fast
+    body with none of these VPU passes)."""
+    qi = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kj = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    valid = None
+
+    def _and(a, b):
+        return b if a is None else a & b
+
+    if Tp != T:
+        valid = _and(valid, kj < T)      # tail-block padding keys drop
+    if causal:
+        valid = _and(valid, qi >= kj)
+    if has_mask:
+        valid = _and(valid, (km_ref[0, 0, pl.ds(j * bk, bk)] > 0)[None, :])
+    if valid is None:                     # dispatcher never does this
+        valid = jnp.ones((bq, bk), bool)
+    return valid
+
+
+def _dispatch_tile(pl, update, i, j, nk, bq, bk, T, Tp, causal, has_mask):
+    """Route this tile to the fast (unmasked) or masked body. Causal
+    interior tiles — the majority — skip every mask pass; fully-future
+    tiles skip the math entirely (the DMA still streams: rectangular
+    grid)."""
+    if causal:
+        run = (j * bk) <= (i * bq + bq - 1)
+        if has_mask:
+            pl.when(run)(update(True))
+            return
+        crosses_diag = (j * bk + bk - 1) > (i * bq)
+        masked = crosses_diag if Tp == T else \
+            crosses_diag | (j == nk - 1)
+        pl.when(run & masked)(update(True))
+        pl.when(run & jnp.logical_not(masked))(update(False))
+    elif has_mask or Tp != T:
+        update(True)()
+    else:
+        update(False)()
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, L_ref, Di_ref,
+               dq_ref, dq_scr, *, causal, scale, bq, bk, T, Tp, has_mask,
+               acc_dt):
+    from jax.experimental import pallas as pl
+    j = pl.program_id(2)
+    i = pl.program_id(1)
+    nk = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    def update(masked):
+        def body():
+            s = jax.lax.dot_general(
+                q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=acc_dt) * scale
+            p = jnp.exp(s - L_ref[0, 0, pl.ds(i * bq, bq)][:, None])
+            if masked:
+                valid = _valid_tile(pl, i, j, bq, bk, T, Tp, causal,
+                                    has_mask, km_ref)
+                p = jnp.where(valid, p, 0.0)
+            dp = jax.lax.dot_general(
+                do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=acc_dt)
+            ds = p * (dp - Di_ref[0, 0, pl.ds(i * bq, bq)][:, None])
+            dq_scr[:] += scale * jax.lax.dot_general(
+                ds.astype(k_ref.dtype), k_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=acc_dt)
+        return body
+
+    _dispatch_tile(pl, update, i, j, nk, bq, bk, T, Tp, causal, has_mask)
+
+    @pl.when(j == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, L_ref, Di_ref,
+                dk_ref, dv_ref, dk_scr, dv_scr, *, causal, scale, bq, bk,
+                T, Tp, has_mask, acc_dt):
+    from jax.experimental import pallas as pl
+    i = pl.program_id(2)        # q block index — FASTEST (the k sweep)
+    j = pl.program_id(1)        # k block index
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def update(masked):
+        def body():
+            s = jax.lax.dot_general(
+                q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=acc_dt) * scale
+            p = jnp.exp(s - L_ref[0, 0, pl.ds(i * bq, bq)][:, None])
+            if masked:
+                valid = _valid_tile(pl, i, j, bq, bk, T, Tp, causal,
+                                    has_mask, km_ref)
+                p = jnp.where(valid, p, 0.0)
+            pl_ = p.astype(do_ref.dtype)
+            dv_scr[:] += jax.lax.dot_general(
+                pl_, do_ref[0], (((0,), (0,)), ((), ())),
+                preferred_element_type=acc_dt)
+            dp = jax.lax.dot_general(
+                do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=acc_dt)
+            ds = (p * (dp - Di_ref[0, 0, pl.ds(i * bq, bq)][:, None])).astype(
+                q_ref.dtype)
+            dk_scr[:] += scale * jax.lax.dot_general(
+                ds, q_ref[0], (((0,), (0,)), ((), ())),
+                preferred_element_type=acc_dt)
+        return body
+
+    # note the swapped loop order: i is fastest here
+    _dispatch_tile(pl, update, i, j, nq, bq, bk, T, Tp, causal, has_mask)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _prep(q, k, v, mask, bq, bk):
+    """(B, H, T, D) -> (BH, Tp, D) padded to block multiples + (BH, Tkp)
+    key mask (pad keys masked out; pad QUERY rows compute garbage that the
+    caller slices off)."""
+    B, H, T, D = q.shape
+    Tqp = _blocks(T, bq) * bq
+    Tkp = _blocks(T, bk) * bk
+    Tp = max(Tqp, Tkp)
+
+    def r(a):
+        a = a.reshape(B * H, T, D)
+        return jnp.pad(a, ((0, 0), (0, Tp - T), (0, 0)))
+
+    km = jnp.ones((B, T), jnp.int32) if mask is None \
+        else (mask > 0).astype(jnp.int32)
+    km = jnp.repeat(km, H, axis=0)                       # (BH, T)
+    km = jnp.pad(km, ((0, 0), (0, Tp - T)))              # pad keys -> 0
+    return r(q), r(k), r(v), km[:, None, :], Tp           # (BH, 1, Tp)
+
+
+def _call_fwd(qp, kp, vp, km, causal, scale, bq, bk, T, has_mask):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    BH, Tp, D = qp.shape
+    nq, nk = Tp // bq, Tp // bk
+    acc_dt = jnp.promote_types(qp.dtype, jnp.float32)
+    kern = functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                             bq=bq, bk=bk, T=T, Tp=Tp, has_mask=has_mask,
+                             acc_dt=acc_dt)
+    o, L = pl.pallas_call(
+        kern,
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, 1, Tp), lambda b, i, j: (b, 0, 0)),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, Tp), lambda b, i, j: (b, 0, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((BH, Tp, D), qp.dtype),
+            jax.ShapeDtypeStruct((BH, 1, Tp), acc_dt),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((bq, D), acc_dt),
+            pltpu.VMEM((bq,), acc_dt),
+            pltpu.VMEM((bq,), acc_dt),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp, km)
+    return o, L
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention(q, k, v, mask=None, causal: bool = False,
+                    scale: float | None = None, bq: int = DEFAULT_BQ,
+                    bk: int = DEFAULT_BK):
+    """q/k/v: (B, H, T, D); mask: optional (B, T) key-padding mask.
+    Returns (B, H, T, D). Fused online-softmax attention; see module
+    docstring."""
+    out, _ = _fa_fwd(q, k, v, mask, causal, scale, bq, bk)
+    return out
+
+
+def _fa_fwd(q, k, v, mask, causal, scale, bq, bk):
+    B, H, T, D = q.shape
+    scale_ = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
+    qp, kp, vp, km, Tp = _prep(q, k, v, mask, bq, bk)
+    o, L = _call_fwd(qp, kp, vp, km, causal, scale_, bq, bk, T,
+                     mask is not None)
+    out = o[:, :T].reshape(B, H, T, D)
+    return out, (q, k, v, mask, o, L)
+
+
+def _fa_bwd(causal, scale, bq, bk, saved, dout):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    q, k, v, mask, o, L = saved
+    B, H, T, D = q.shape
+    scale_ = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
+    qp, kp, vp, km, Tp = _prep(q, k, v, mask, bq, bk)
+    dop = jnp.pad(dout.reshape(B * H, T, D), ((0, 0), (0, Tp - T), (0, 0)))
+    acc_dt = jnp.promote_types(qp.dtype, jnp.float32)
+    # D_i = rowsum(dO * o) — one cheap XLA reduction, accumulated one width up
+    Di = jnp.sum(dop.astype(acc_dt) * o.astype(acc_dt), axis=-1)[:, None, :]
+    BH = B * H
+    nq, nk = Tp // bq, Tp // bk
+    qspec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
+    kspec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
+    dq = pl.pallas_call(
+        functools.partial(_dq_kernel, causal=causal, scale=scale_,
+                          bq=bq, bk=bk, T=T, Tp=Tp,
+                          has_mask=mask is not None, acc_dt=acc_dt),
+        grid=(BH, nq, nk),
+        in_specs=[qspec, kspec, kspec,
+                  pl.BlockSpec((1, 1, Tp), lambda b, i, j: (b, 0, 0)),
+                  qspec,
+                  pl.BlockSpec((1, 1, Tp), lambda b, i, j: (b, 0, 0)),
+                  pl.BlockSpec((1, 1, Tp), lambda b, i, j: (b, 0, 0))],
+        out_specs=qspec,
+        out_shape=jax.ShapeDtypeStruct((BH, Tp, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, D), acc_dt)],
+        interpret=_interpret(),
+    )(qp, kp, vp, km, dop, L, Di)
+    # dk/dv: q index fastest — grid (BH, nk, nq)
+    qspec2 = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
+    kspec2 = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_dkv_kernel, causal=causal, scale=scale_,
+                          bq=bq, bk=bk, T=T, Tp=Tp,
+                          has_mask=mask is not None, acc_dt=acc_dt),
+        grid=(BH, nk, nq),
+        in_specs=[qspec2, kspec2, kspec2,
+                  pl.BlockSpec((1, 1, Tp), lambda b, j, i: (b, 0, 0)),
+                  qspec2,
+                  pl.BlockSpec((1, 1, Tp), lambda b, j, i: (b, 0, 0)),
+                  pl.BlockSpec((1, 1, Tp), lambda b, j, i: (b, 0, 0))],
+        out_specs=(kspec2, kspec2),
+        out_shape=(jax.ShapeDtypeStruct((BH, Tp, D), k.dtype),
+                   jax.ShapeDtypeStruct((BH, Tp, D), v.dtype)),
+        scratch_shapes=[pltpu.VMEM((bk, D), acc_dt),
+                        pltpu.VMEM((bk, D), acc_dt)],
+        interpret=_interpret(),
+    )(qp, kp, vp, km, dop, L, Di)
+    shp = lambda a: a[:, :T].reshape(B, H, T, D)
+    dmask = None if mask is None else jnp.zeros_like(mask)
+    return shp(dq), shp(dk), shp(dv), dmask
+
+
+flash_attention.defvjp(_fa_fwd, _fa_bwd)
+register_helper("flash_attention", default_on=True)(flash_attention)
+
+
+def flash_attention_reference(q, k, v, mask=None, causal=False, scale=None):
+    """Dense oracle with identical mask semantics (tests)."""
+    D = q.shape[-1]
+    scale_ = scale if scale is not None else 1.0 / np.sqrt(D)
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale_
+    T = q.shape[2]
+    valid = jnp.ones((1, 1, T, T), bool)
+    if causal:
+        valid = valid & jnp.tril(jnp.ones((T, T), bool))[None, None]
+    if mask is not None:
+        valid = valid & (mask > 0)[:, None, None, :]
+    s = jnp.where(valid, s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(valid, p, 0.0)  # fully-masked rows -> zero output
+    return jnp.einsum("bhqk,bhkv->bhqv", p, v)

@@ -1,0 +1,151 @@
+"""Fused flash-attention Pallas kernels (ops/flash_attention.py).
+
+Beyond-reference long-context hot path (SURVEY §5): value AND gradient
+parity against the dense softmax oracle in fp64 through interpret mode
+(finite differences through the custom VJP included), across causal x
+key-mask x block-size combinations including non-divisible T, plus the
+SelfAttentionLayer integration (helpers-on must match the lax.scan
+blockwise path the layer otherwise uses)."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.flash_attention import (
+    flash_attention, flash_attention_reference)
+
+RNG = np.random.RandomState(11)
+
+
+def _data(B=2, H=3, T=23, D=8):
+    q, k, v = (jnp.asarray(RNG.randn(B, H, T, D) * 0.5) for _ in range(3))
+    mask = jnp.asarray((RNG.rand(B, T) > 0.25).astype(np.int32))
+    return q, k, v, mask
+
+
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("use_mask", [False, True])
+@pytest.mark.parametrize("blk", [8, 16])
+def test_value_and_grad_match_dense_oracle(causal, use_mask, blk):
+    q, k, v, mask = _data()
+    m = mask if use_mask else None
+
+    def lf(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(q, k, v, m, causal, None,
+                                               blk, blk)))
+
+    def lr(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention_reference(q, k, v, m, causal)))
+
+    vf, gf = jax.value_and_grad(lf, argnums=(0, 1, 2))(q, k, v)
+    vr, gr = jax.value_and_grad(lr, argnums=(0, 1, 2))(q, k, v)
+    assert abs(float(vf - vr)) < 1e-10
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-10)
+
+
+def test_fully_masked_rows_zero_output_and_grads():
+    """A batch row whose mask drops EVERY key must produce zero output and
+    zero gradients, not NaNs (the L = NEG_INF guard)."""
+    q, k, v, _ = _data(B=2, T=12)
+    mask = jnp.asarray(np.stack([np.zeros(12), np.ones(12)]).astype(np.int32))
+
+    def loss(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, mask, False, None, 8, 8) ** 2)
+
+    val, grads = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+    out = flash_attention(q, k, v, mask, False, None, 8, 8)
+    assert np.allclose(np.asarray(out[0]), 0.0)
+    assert np.isfinite(float(val))
+    for g in grads:
+        assert np.isfinite(np.asarray(g)).all()
+        assert np.allclose(np.asarray(g[0]), 0.0)  # masked batch row
+
+
+def test_finite_differences_through_custom_vjp():
+    q, k, v, mask = _data(B=1, H=2, T=10, D=4)
+
+    def loss(flat):
+        qq = flat[:80].reshape(1, 2, 10, 4)
+        kk = flat[80:160].reshape(1, 2, 10, 4)
+        vv = flat[160:].reshape(1, 2, 10, 4)
+        return jnp.sum(jnp.tanh(
+            flash_attention(qq, kk, vv, mask, True, None, 8, 8)))
+
+    flat = jnp.concatenate([a.reshape(-1) for a in (q, k, v)])
+    ana = np.asarray(jax.grad(loss)(flat))
+    eps = 1e-6
+    for i in RNG.choice(flat.size, 25, replace=False):
+        e = jnp.zeros_like(flat).at[i].set(eps)
+        num = (float(loss(flat + e)) - float(loss(flat - e))) / (2 * eps)
+        denom = max(abs(num), abs(ana[i]), 1e-8)
+        assert abs(num - ana[i]) / denom < 1e-5, (i, num, ana[i])
+
+
+def test_layer_dispatch_flash_matches_blockwise():
+    """SelfAttentionLayer long-context path: helpers-on (flash kernel) must
+    match helpers-off (lax.scan blockwise) — the ValidateCudnn pattern for
+    the attention seam, end to end through fit_batch."""
+    from deeplearning4j_tpu import (
+        Activation, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+        RnnOutputLayer, Sgd, WeightInit)
+    from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.ops.helpers import helpers_enabled_ctx
+
+    def run(helpers):
+        b = (NeuralNetConfiguration.Builder().seed(5)
+             .weight_init(WeightInit.XAVIER)
+             .updater(Sgd(learning_rate=0.05)).dtype("float64").list())
+        b.layer(SelfAttentionLayer(n_out=8, n_heads=2, causal=True,
+                                   block_size=4))  # T=12 > 4: long-ctx path
+        b.layer(RnnOutputLayer(n_out=3, activation=Activation.SOFTMAX))
+        net = MultiLayerNetwork(
+            b.set_input_type(InputType.recurrent(6)).build()).init()
+        rng = np.random.RandomState(3)
+        x = rng.rand(4, 6, 12)
+        y = np.eye(3)[rng.randint(0, 3, (4, 12))].transpose(0, 2, 1)
+        with helpers_enabled_ctx(helpers):
+            for _ in range(3):
+                net.fit_batch(x, y)
+            return float(net.score()), np.asarray(net.params())
+
+    s_off, p_off = run(False)
+    s_on, p_on = run(True)
+    assert s_on == pytest.approx(s_off, abs=1e-9)
+    np.testing.assert_allclose(p_on, p_off, atol=1e-9)
+
+
+def test_layer_dispatch_flash_with_padding_mask():
+    """Same equivalence with a feature mask (padded timesteps) flowing to
+    the kernel's key-padding mask."""
+    from deeplearning4j_tpu import (
+        Activation, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+        RnnOutputLayer, Sgd, WeightInit)
+    from deeplearning4j_tpu.datasets.dataset import DataSet
+    from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.ops.helpers import helpers_enabled_ctx
+
+    rng = np.random.RandomState(8)
+    x = rng.rand(3, 5, 10)
+    y = np.eye(2)[rng.randint(0, 2, (3, 10))].transpose(0, 2, 1)
+    fm = (np.arange(10)[None, :] < np.array([10, 7, 4])[:, None]).astype(
+        np.float64)
+    ds = DataSet(x, y, features_mask=fm, labels_mask=fm)
+
+    def run(helpers):
+        b = (NeuralNetConfiguration.Builder().seed(9)
+             .weight_init(WeightInit.XAVIER)
+             .updater(Sgd(learning_rate=0.05)).dtype("float64").list())
+        b.layer(SelfAttentionLayer(n_out=6, n_heads=2, block_size=4))
+        b.layer(RnnOutputLayer(n_out=2, activation=Activation.SOFTMAX))
+        net = MultiLayerNetwork(
+            b.set_input_type(InputType.recurrent(5)).build()).init()
+        with helpers_enabled_ctx(helpers):
+            net.fit(ds)
+            return float(net.score()), np.asarray(net.params())
+
+    s_off, p_off = run(False)
+    s_on, p_on = run(True)
+    assert s_on == pytest.approx(s_off, abs=1e-9)
+    np.testing.assert_allclose(p_on, p_off, atol=1e-9)
