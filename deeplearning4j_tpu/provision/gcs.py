@@ -201,10 +201,28 @@ class GcsUploader:
         if not part_keys:  # empty file: one empty object
             self.transport.put(bucket, key, b"")
             return 1
-        self.transport.compose(bucket, part_keys, key)
-        for pk in part_keys:
-            self.transport.delete(bucket, pk)
-        return len(part_keys)
+        n_parts = len(part_keys)
+        # GCS compose takes at most 32 components per call; fold larger
+        # uploads in <=32-wide rounds (composites may be re-composed)
+        round_ = 0
+        while len(part_keys) > 1:
+            next_keys = []
+            for gi in range(0, len(part_keys), 32):
+                group = part_keys[gi:gi + 32]
+                if len(group) == 1:
+                    next_keys.append(group[0])
+                    continue
+                ck = f"{key}.compose{round_}.{gi // 32}"
+                self.transport.compose(bucket, group, ck)
+                for pk in group:
+                    self.transport.delete(bucket, pk)
+                next_keys.append(ck)
+            part_keys = next_keys
+            round_ += 1
+        if part_keys[0] != key:
+            self.transport.compose(bucket, part_keys, key)
+            self.transport.delete(bucket, part_keys[0])
+        return n_parts
     multiPartUpload = multi_part_upload
 
     def upload_folder(self, bucket: str, key_prefix: str,

@@ -41,9 +41,10 @@ class InProcessBroker:
         self._topics: Dict[str, "queue.Queue"] = {}
 
     def _topic(self, name: str) -> "queue.Queue":
-        if name not in self._topics:
-            self._topics[name] = queue.Queue(maxsize=self.capacity)
-        return self._topics[name]
+        # setdefault is atomic in CPython: concurrent first touches of a
+        # topic from publisher + consumer threads must agree on ONE queue
+        return self._topics.setdefault(name,
+                                       queue.Queue(maxsize=self.capacity))
 
     def send(self, topic: str, data: bytes,
              timeout: Optional[float] = None) -> None:
