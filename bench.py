@@ -272,8 +272,8 @@ def bench_graves_lstm_roofline(lstm_entry, batch=8192, seq_len=100,
 
     T, B, H, db = seq_len, batch, hidden, 2
     tm, K, btf, btb = m._pick_layout(T, B, H, db)
-    steps_f = (T // K) * ((-(-B // btf) * btf) // btf)
-    steps_b = (T // K) * ((-(-B // btb) * btb) // btb)
+    steps_f = (T // K) * -(-B // btf)   # time-blocks x padded batch tiles
+    steps_b = (T // K) * -(-B // btb)
     rng = np.random.RandomState(0)
     mk = lambda *s: jnp.asarray(rng.randn(*s).astype(np.float32) * 0.1,
                                 jnp.bfloat16)
@@ -415,7 +415,7 @@ def _write_vgg16_h5(path):
 
 
 def bench_vgg16_transfer(batch=32, steps=10, num_classes=10,
-                         sweep=(64, 128)):
+                         sweep=(64, 128, 256)):
     """BASELINE config 3: Keras VGG16 import -> TransferLearning (freeze features,
     replace 1000-way head) -> train. Reports import-to-first-step time + images/sec
     (ref KerasModelImport.java + TransferLearning.java:35). r5: batch sweep +
@@ -522,11 +522,18 @@ def bench_attention_longcontext(batch=4, seq_len=8192, d_model=256, heads=4,
     x = jnp.asarray(rng.rand(batch, d_model, seq_len).astype(np.float32))
     y = jnp.asarray(np.eye(64, dtype=np.float32)[
         rng.randint(0, 64, (batch, seq_len))].transpose(0, 2, 1))
-    flops = net.train_step_flops(x, y)
-    dt, dt_min = _device_loop_time(net, x, y, steps)
-    ms = dt / steps * 1e3
     from deeplearning4j_tpu.ops.helpers import helpers_enabled_for
     flash_on = helpers_enabled_for("flash_attention")
+    flops = net.train_step_flops(x, y)
+    if flash_on and flops:
+        # XLA's cost model reports ~0 FLOPs for Pallas custom calls; add
+        # the analytic attention FLOPs (standard flash accounting): fwd =
+        # 4*B*H*T^2*Dh (two matmuls, 2 FLOP/MAC), halved causal; bwd ~2.5x
+        # fwd (the dq/dkv passes recompute p). 2 attention layers.
+        attn_f = 4 * batch * heads * seq_len ** 2 * (d_model // heads) / 2
+        flops += 2 * 3.5 * attn_f
+    dt, dt_min = _device_loop_time(net, x, y, steps)
+    ms = dt / steps * 1e3
     out = {"tokens_per_sec": batch * seq_len * steps / dt,
            "ms_per_iter": ms, "min_ms_per_iter": dt_min / steps * 1e3,
            "batch": batch, "seq_len": seq_len, "d_model": d_model,
@@ -538,10 +545,12 @@ def bench_attention_longcontext(batch=4, seq_len=8192, d_model=256, heads=4,
                       if flash_on else
                       "lax.scan blockwise recurrence (helpers off)"),
            "note": ("2x causal SelfAttentionLayer(d256,h4) + softmax head, "
-                    "O(T*block) memory either engine. MFU caveat: XLA cost "
-                    "analysis cannot see inside Pallas custom calls, so "
-                    "the attention FLOPs are EXCLUDED from mfu when the "
-                    "flash kernel is engaged — compare tokens/s, not mfu")}
+                    "O(T*block) memory either engine."
+                    + (" MFU accounting: XLA's cost model cannot see "
+                       "inside Pallas custom calls, so the attention FLOPs "
+                       "are added ANALYTICALLY (4*B*H*T^2*Dh fwd halved "
+                       "causal, 2.5x bwd with recompute, 2 layers)"
+                       if flash_on else ""))}
     try:
         stats = jax.devices()[0].memory_stats() or {}
         peak = stats.get("peak_bytes_in_use")
@@ -580,6 +589,12 @@ def main():
         attn = bench_attention_longcontext()
     except Exception as e:
         attn = {"error": f"{type(e).__name__}: {e}"}
+    try:  # same-run helpers-off comparison (the lax.scan blockwise path)
+        from deeplearning4j_tpu.ops.helpers import helpers_enabled_ctx
+        with helpers_enabled_ctx(False):
+            attn_off = bench_attention_longcontext(steps=3)
+    except Exception as e:
+        attn_off = {"error": f"{type(e).__name__}: {e}"}
     resnet_bf16 = bench_resnet50()
     try:  # experimental Pallas path must never cost us the headline record
         resnet_helpers = bench_resnet50(helpers=True)
@@ -642,6 +657,7 @@ def main():
             "lenet_samples_per_sec": round(lenet["samples_per_sec"], 1),
             "lenet_roofline": lenet.get("roofline"),
             "attention_longcontext": _r(attn),
+            "attention_longcontext_helpers_off": _r(attn_off),
             "graves_lstm_tokens_per_sec": round(lstm_best["tokens_per_sec"], 1),
             "graves_lstm": _r(lstm),
             "graves_lstm_helpers_on": _r(lstm_helpers),

@@ -107,6 +107,11 @@ class InMemoryGcsTransport(GcsTransport):
         self.store.setdefault(bucket, {})[key] = bytes(data)
 
     def compose(self, bucket, part_keys, dest_key):
+        # the real GCS compose rejects >32 components — the fake must too,
+        # or tests would pass code that fails in production
+        if len(part_keys) > 32:
+            raise ValueError(
+                f"compose takes at most 32 components, got {len(part_keys)}")
         self.store.setdefault(bucket, {})[dest_key] = b"".join(
             self.store[bucket][k] for k in part_keys)
 
@@ -203,9 +208,10 @@ class GcsUploader:
             return 1
         n_parts = len(part_keys)
         # GCS compose takes at most 32 components per call; fold larger
-        # uploads in <=32-wide rounds (composites may be re-composed)
+        # uploads in <=32-wide rounds (composites may be re-composed), the
+        # final round composing STRAIGHT into the destination key
         round_ = 0
-        while len(part_keys) > 1:
+        while len(part_keys) > 32:
             next_keys = []
             for gi in range(0, len(part_keys), 32):
                 group = part_keys[gi:gi + 32]
@@ -219,9 +225,10 @@ class GcsUploader:
                 next_keys.append(ck)
             part_keys = next_keys
             round_ += 1
-        if part_keys[0] != key:
-            self.transport.compose(bucket, part_keys, key)
-            self.transport.delete(bucket, part_keys[0])
+        self.transport.compose(bucket, part_keys, key)
+        for pk in part_keys:
+            if pk != key:
+                self.transport.delete(bucket, pk)
         return n_parts
     multiPartUpload = multi_part_upload
 

@@ -41,6 +41,9 @@ class InProcessBroker:
         self._topics: Dict[str, "queue.Queue"] = {}
 
     def _topic(self, name: str) -> "queue.Queue":
+        q = self._topics.get(name)   # hot path: no per-message allocation
+        if q is not None:
+            return q
         # setdefault is atomic in CPython: concurrent first touches of a
         # topic from publisher + consumer threads must agree on ONE queue
         return self._topics.setdefault(name,

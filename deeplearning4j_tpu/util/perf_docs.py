@@ -94,17 +94,18 @@ def render_block(art: dict) -> str:
         lines.append(line + ".")
     attn = e.get("attention_longcontext", {})
     if attn.get("tokens_per_sec"):
+        engine = attn.get("engine", "")
         line = (
             f"- Long-context attention (beyond-reference): "
             f"{attn['tokens_per_sec'] / 1e6:.2f}M tokens/s training "
             f"2x causal SelfAttentionLayer at T={attn['seq_len']:,} "
-            f"b{attn['batch']} — fused flash-attention Pallas kernel, "
-            f"default-on")
+            f"b{attn['batch']}"
+            + (f" — {engine}" if engine else ""))
         off = e.get("attention_longcontext_helpers_off", {})
         if off.get("tokens_per_sec"):
             ratio = attn["tokens_per_sec"] / off["tokens_per_sec"]
             line += (f"; {ratio:.2f}x the lax.scan blockwise path "
-                     f"({off['tokens_per_sec'] / 1e6:.2f}M) same-session")
+                     f"({off['tokens_per_sec'] / 1e6:.2f}M)")
         if attn.get("peak_hbm_gb"):
             line += f", peak HBM {attn['peak_hbm_gb']} GB"
         lines.append(line + ". A dense-softmax path at this T needs the "

@@ -154,6 +154,25 @@ def test_gcs_folder_roundtrip_and_multipart(tmp_path):
         open(big, "rb").read()
 
 
+def test_multipart_compose_fold_past_32_parts(tmp_path):
+    """GCS compose accepts at most 32 components (the in-memory fake
+    enforces it too) — a 70-part upload must fold in <=32-wide rounds,
+    reproduce the bytes exactly, and leave no intermediate objects."""
+    tr = InMemoryGcsTransport()
+    up, down = GcsUploader(tr), GcsDownloader(tr)
+    data = bytes(range(256)) * 70  # 70 parts at 256-byte chunks
+    big = os.path.join(tmp_path, "big.bin")
+    open(big, "wb").write(data)
+    GcsUploader.MULTIPART_CHUNK = 256
+    try:
+        parts = up.multi_part_upload(big, "bkt", "ckpt.bin")
+    finally:
+        GcsUploader.MULTIPART_CHUNK = 8 * 1024 * 1024
+    assert parts == 70
+    assert down.object_for_key("bkt", "ckpt.bin").read() == data
+    assert down.keys_for_bucket("bkt") == ["ckpt.bin"]  # no leftovers
+
+
 def test_ndarray_stream_client_roundtrip():
     """(ref NDArrayKafkaClient + KafkaNDArrayPublishTests pattern) —
     publish one / many, consume across threads with backpressure."""
