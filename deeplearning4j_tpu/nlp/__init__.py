@@ -9,6 +9,7 @@ scatter-add updates inside single jitted XLA steps.
 from deeplearning4j_tpu.nlp.tokenization import (
     CommonPreprocessor, DefaultTokenizerFactory, EndingPreProcessor,
     NGramTokenizerFactory, UnicodeScriptTokenizerFactory)
+from deeplearning4j_tpu.nlp.bpe import BPETokenizerFactory, BytePairEncoding
 from deeplearning4j_tpu.nlp.sentence_iterator import (
     BasicLineIterator, CollectionSentenceIterator, FileSentenceIterator,
     SentenceIterator)
@@ -26,7 +27,7 @@ from deeplearning4j_tpu.nlp.cnn_sentence_iterator import (
 
 __all__ = [
     "DefaultTokenizerFactory", "NGramTokenizerFactory", "CommonPreprocessor",
-    "UnicodeScriptTokenizerFactory",
+    "UnicodeScriptTokenizerFactory", "BPETokenizerFactory", "BytePairEncoding",
     "EndingPreProcessor", "SentenceIterator", "BasicLineIterator",
     "CollectionSentenceIterator", "FileSentenceIterator", "CountVectorizer",
     "TfidfVectorizer", "VocabWord", "VocabCache", "VocabConstructor",

@@ -1,0 +1,174 @@
+"""Learned subword tokenization (byte-pair encoding).
+
+The TPU-native rendering of the reference's CJK language packs
+(deeplearning4j-nlp-{chinese,japanese,korean} bundle ~18k LoC of
+kuromoji/analyzer DICTIONARIES): a learned, dictionary-free segmenter.
+BPE (the publicly specified Sennrich et al. 2016 algorithm) merges the
+most frequent adjacent symbol pairs of a training corpus, so it acquires
+script-appropriate units from data alone — multi-character CJK words,
+English subwords, anything — with zero shipped dictionary data, and the
+result plugs into the same `TokenizerFactory` seam every NLP pipeline
+component consumes (Word2Vec, ParagraphVectors, TF-IDF, the CNN sentence
+iterator).
+
+`BPETokenizerFactory` upgrades `UnicodeScriptTokenizerFactory`'s
+char-unigram CJK baseline: train once on in-domain text, serialize the
+merge table as JSON, reload anywhere.
+"""
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from deeplearning4j_tpu.nlp.tokenization import Tokenizer, TokenizerFactory
+
+EOW = "</w>"  # end-of-word marker: lets merges distinguish suffixes
+UNK = "<unk>"
+
+
+class BytePairEncoding:
+    """Merge table + vocabulary. Build with `train`, persist with
+    `save`/`load`, segment with `segment_word`/`tokenize`."""
+
+    def __init__(self, merges: List[Tuple[str, str]],
+                 vocab: Optional[List[str]] = None,
+                 lowercase: bool = False):
+        self.merges = [tuple(m) for m in merges]
+        self.lowercase = bool(lowercase)
+        self._rank: Dict[Tuple[str, str], int] = {
+            m: i for i, m in enumerate(self.merges)}
+        if vocab is None:
+            vocab = sorted({s for m in self.merges for s in
+                            (m[0], m[1], m[0] + m[1])})
+        self.vocab = list(dict.fromkeys([UNK] + list(vocab)))
+        self._ids = {t: i for i, t in enumerate(self.vocab)}
+        # segment_word returns word-final tokens with the EOW marker
+        # STRIPPED — alias the stripped surface form to the suffixed
+        # symbol's id so encode() finds it (else every fully-merged word
+        # maps to <unk>)
+        for i, t in enumerate(self.vocab):
+            if t.endswith(EOW) and t != EOW:
+                self._ids.setdefault(t[:-len(EOW)], i)
+
+    # ------------------------------------------------------------- training
+    @classmethod
+    def train(cls, lines: Iterable[str], vocab_size: int = 1000,
+              min_pair_count: int = 2,
+              lowercase: bool = False) -> "BytePairEncoding":
+        """Learn merges until `vocab_size` symbols exist or no adjacent
+        pair reaches `min_pair_count`. Words are whitespace units; scripts
+        without spaces (CJK) contribute whole runs whose frequent
+        character pairs merge into learned words."""
+        words: Counter = Counter()
+        for line in lines:
+            if lowercase:
+                line = line.lower()
+            for w in line.split():
+                words[w] += 1
+        # each distinct word as a tuple of symbols (chars + EOW)
+        seqs: Dict[Tuple[str, ...], int] = {
+            tuple(w) + (EOW,): c for w, c in words.items()}
+        symbols = {s for seq in seqs for s in seq}
+        merges: List[Tuple[str, str]] = []
+        while len(symbols) < vocab_size:
+            pairs: Counter = Counter()
+            for seq, c in seqs.items():
+                for a, b in zip(seq, seq[1:]):
+                    pairs[(a, b)] += c
+            if not pairs:
+                break
+            (a, b), count = max(pairs.items(),
+                                key=lambda kv: (kv[1], kv[0]))
+            if count < min_pair_count:
+                break
+            merges.append((a, b))
+            ab = a + b
+            symbols.add(ab)
+            new_seqs: Dict[Tuple[str, ...], int] = {}
+            for seq, c in seqs.items():
+                out: List[str] = []
+                i = 0
+                while i < len(seq):
+                    if i + 1 < len(seq) and seq[i] == a and seq[i + 1] == b:
+                        out.append(ab)
+                        i += 2
+                    else:
+                        out.append(seq[i])
+                        i += 1
+                key = tuple(out)
+                new_seqs[key] = new_seqs.get(key, 0) + c
+            seqs = new_seqs
+        return cls(merges, sorted(symbols), lowercase=lowercase)
+
+    # ---------------------------------------------------------- segmenting
+    def segment_word(self, word: str) -> List[str]:
+        """Apply the learned merges (lowest-rank first) to one word."""
+        if not word:
+            return []
+        if self.lowercase:  # match train-time casing or merges never fire
+            word = word.lower()
+        seq: List[str] = list(word) + [EOW]
+        while len(seq) > 1:
+            best = None
+            for i, (a, b) in enumerate(zip(seq, seq[1:])):
+                r = self._rank.get((a, b))
+                if r is not None and (best is None or r < best[0]):
+                    best = (r, i)
+            if best is None:
+                break
+            _, i = best
+            seq = seq[:i] + [seq[i] + seq[i + 1]] + seq[i + 2:]
+        if seq and seq[-1] == EOW:
+            seq = seq[:-1]
+        elif seq and seq[-1].endswith(EOW):
+            seq = seq[:-1] + [seq[-1][:-len(EOW)]]
+        return [s for s in seq if s]
+
+    def tokenize(self, text: str) -> List[str]:
+        return [s for w in text.split() for s in self.segment_word(w)]
+
+    # --------------------------------------------------------------- serde
+    def encode(self, text: str) -> List[int]:
+        unk = self._ids[UNK]
+        return [self._ids.get(t, unk) for t in self.tokenize(text)]
+
+    def decode(self, ids: List[int]) -> List[str]:
+        """Surface forms (EOW marker stripped, like tokenize's output)."""
+        out = []
+        for i in ids:
+            t = self.vocab[i]
+            if t.endswith(EOW) and t != EOW:
+                t = t[:-len(EOW)]
+            out.append(t)
+        return out
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump({"merges": self.merges, "vocab": self.vocab,
+                       "lowercase": self.lowercase}, f, ensure_ascii=False)
+
+    @classmethod
+    def load(cls, path: str) -> "BytePairEncoding":
+        with open(path) as f:
+            d = json.load(f)
+        return cls([tuple(m) for m in d["merges"]], d["vocab"],
+                   lowercase=d.get("lowercase", False))
+
+
+class BPETokenizerFactory(TokenizerFactory):
+    """The TokenizerFactory seam over a trained BPE model — drop-in for
+    any pipeline component that takes a factory (ref the language packs'
+    tokenizer factories; here the 'dictionary' is learned and ~KB-sized)."""
+
+    def __init__(self, bpe: BytePairEncoding):
+        super().__init__()
+        self.bpe = bpe
+
+    @classmethod
+    def train(cls, lines: Iterable[str], vocab_size: int = 1000,
+              **kw) -> "BPETokenizerFactory":
+        return cls(BytePairEncoding.train(lines, vocab_size, **kw))
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer(self._apply_pre(self.bpe.tokenize(text)))

@@ -1,0 +1,105 @@
+"""Learned BPE subword tokenizer (nlp/bpe.py) — the dictionary-free
+rendering of the reference's CJK language packs (SURVEY §2.4 row 40):
+merge learning, deterministic segmentation, JSON round-trip, CJK
+acquisition without any shipped dictionary, and the TokenizerFactory seam
+(Word2Vec consumes the factory unchanged)."""
+import os
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.bpe import (
+    BPETokenizerFactory, BytePairEncoding)
+
+
+CORPUS = [
+    "the lowest lower low",
+    "the newest newer new",
+    "lowest newest lowest newest",
+    "low low low new new",
+]
+
+
+def test_learns_frequent_merges_and_segments():
+    bpe = BytePairEncoding.train(CORPUS, vocab_size=60, min_pair_count=2)
+    assert bpe.merges  # learned something
+    toks = bpe.tokenize("lowest newest")
+    # frequent stems surface as single units; rare strings fall to pieces
+    joined = "".join(t.replace("</w>", "") for t in toks)
+    assert joined == "lowestnewest"
+    assert len(toks) < len("lowest newest".replace(" ", ""))  # merged
+    # segmentation is deterministic
+    assert toks == bpe.tokenize("lowest newest")
+
+
+def test_unseen_word_degrades_to_pieces_not_failure():
+    bpe = BytePairEncoding.train(CORPUS, vocab_size=40)
+    toks = bpe.segment_word("lowly")
+    assert toks and "".join(toks).startswith("low")
+    ids = bpe.encode("zzz")  # chars never seen -> <unk> ids, no crash
+    assert all(isinstance(i, int) for i in ids)
+
+
+def test_cjk_words_learned_without_dictionary():
+    """Frequent multi-character CJK sequences become single tokens purely
+    from statistics — the capability the reference ships dictionaries
+    for."""
+    corpus = ["机器学习 是 人工智能 的 分支"] * 8 + \
+             ["机器学习 模型", "人工智能 应用"] * 4
+    bpe = BytePairEncoding.train(corpus, vocab_size=80, min_pair_count=3)
+    toks = bpe.tokenize("机器学习")
+    assert len(toks) == 1 and toks[0].replace("</w>", "") == "机器学习"
+    # an unseen combination still segments (into learned sub-units)
+    toks2 = bpe.tokenize("机器智能")
+    assert "".join(t.replace("</w>", "") for t in toks2) == "机器智能"
+
+
+def test_encode_frequent_word_is_not_unk_and_roundtrips():
+    """A fully-merged frequent word must get a REAL id (regression: the
+    EOW-stripped surface form mapped to <unk>), and decode(encode(x))
+    reproduces the surface tokens."""
+    bpe = BytePairEncoding.train(CORPUS, vocab_size=60, min_pair_count=2)
+    unk = bpe.encode("zzzzqqq")[0]
+    ids = bpe.encode("lowest newest low")
+    assert all(i != unk for i in ids), (ids, unk)
+    assert "".join(bpe.decode(ids)) == "lowestnewestlow"
+
+
+def test_lowercase_flag_applies_at_inference_and_survives_serde(tmp_path):
+    bpe = BytePairEncoding.train(CORPUS, vocab_size=60, lowercase=True)
+    assert bpe.tokenize("LOWEST") == bpe.tokenize("lowest")
+    p = os.path.join(tmp_path, "bpe.json")
+    bpe.save(p)
+    loaded = BytePairEncoding.load(p)
+    assert loaded.lowercase is True
+    assert loaded.tokenize("Lowest") == bpe.tokenize("lowest")
+
+
+def test_json_round_trip(tmp_path):
+    bpe = BytePairEncoding.train(CORPUS, vocab_size=50)
+    p = os.path.join(tmp_path, "bpe.json")
+    bpe.save(p)
+    loaded = BytePairEncoding.load(p)
+    assert loaded.merges == bpe.merges
+    assert loaded.vocab == bpe.vocab
+    assert loaded.tokenize("the lowest") == bpe.tokenize("the lowest")
+    assert loaded.encode("the lowest") == bpe.encode("the lowest")
+
+
+def test_factory_seam_feeds_word2vec():
+    """The factory drops into the same pipeline slot the language packs
+    fill in the reference: Word2Vec trains over BPE units end to end."""
+    from deeplearning4j_tpu.nlp.sentence_iterator import (
+        CollectionSentenceIterator)
+    from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+    fac = BPETokenizerFactory.train(CORPUS, vocab_size=60)
+    assert fac.tokenize("lowest") == fac.bpe.tokenize("lowest")
+    w2v = (Word2Vec.Builder()
+           .minWordFrequency(1).layerSize(8).epochs(1).seed(7)
+           .iterate(CollectionSentenceIterator(CORPUS))
+           .tokenizerFactory(fac)
+           .build())
+    w2v.fit()
+    some_token = fac.tokenize("lowest")[0]
+    vec = w2v.get_word_vector(some_token)
+    assert vec is not None and np.isfinite(np.asarray(vec)).all()
