@@ -295,16 +295,15 @@ def flash_attention(q, k, v, mask=None, causal: bool = False,
 
 
 def _fa_fwd(q, k, v, mask, causal, scale, bq, bk):
-    B, H, T, D = q.shape
-    scale_ = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
-    qp, kp, vp, km, Tp = _prep(q, k, v, mask, bq, bk)
-    o, L = _call_fwd(qp, kp, vp, km, causal, scale_, bq, bk, T,
-                     mask is not None)
-    out = o[:, :T].reshape(B, H, T, D)
-    return out, (q, k, v, mask, o, L)
+    (out, _), res = _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk)
+    return out, res
 
 
 def _fa_bwd(causal, scale, bq, bk, saved, dout):
+    return _fa_bwd_impl(causal, scale, bq, bk, saved, dout, None)
+
+
+def _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     q, k, v, mask, o, L = saved
@@ -315,6 +314,12 @@ def _fa_bwd(causal, scale, bq, bk, saved, dout):
     acc_dt = jnp.promote_types(qp.dtype, jnp.float32)
     # D_i = rowsum(dO * o) — one cheap XLA reduction, accumulated one width up
     Di = jnp.sum(dop.astype(acc_dt) * o.astype(acc_dt), axis=-1)[:, None, :]
+    if dlse is not None:
+        # L as an OUTPUT: dL_i/ds_ij = p_ij, so ds gains p * dL - absorbed
+        # by shifting the D_i term (ds = p * (dp - (Di - dL)))
+        dl = jnp.pad(dlse.reshape(B * H, T).astype(acc_dt),
+                     ((0, 0), (0, Tp - T)))[:, None, :]
+        Di = Di - dl
     BH = B * H
     nq, nk = Tp // bq, Tp // bk
     qspec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
@@ -361,6 +366,37 @@ def _fa_bwd(causal, scale, bq, bk, saved, dout):
 
 flash_attention.defvjp(_fa_fwd, _fa_bwd)
 register_helper("flash_attention", default_on=True)(flash_attention)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+def flash_attention_lse(q, k, v, mask=None, causal: bool = False,
+                        scale: float | None = None, bq: int = DEFAULT_BQ,
+                        bk: int = DEFAULT_BK):
+    '''Like flash_attention but ALSO returns the per-row logsumexp
+    (B, H, T) fp32 - the quantity ring/context-parallel callers need to
+    merge partial attention across k/v shards: (out_a, L_a) + (out_b, L_b)
+    combine via logaddexp. Differentiable in BOTH outputs.'''
+    (out, lse), _ = _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk)
+    return out, lse
+
+
+def _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk):
+    B, H, T, D = q.shape
+    scale_ = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
+    qp, kp, vp, km, Tp = _prep(q, k, v, mask, bq, bk)
+    o, L = _call_fwd(qp, kp, vp, km, causal, scale_, bq, bk, T,
+                     mask is not None)
+    out = o[:, :T].reshape(B, H, T, D)
+    lse = L[:, 0, :T].reshape(B, H, T)
+    return (out, lse), (q, k, v, mask, o, L)
+
+
+def _fa_lse_bwd(causal, scale, bq, bk, saved, cots):
+    dout, dlse = cots
+    return _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse)
+
+
+flash_attention_lse.defvjp(_fa_lse_fwd, _fa_lse_bwd)
 
 
 def flash_attention_reference(q, k, v, mask=None, causal=False, scale=None):

@@ -132,7 +132,9 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
 
 def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
                    causal: bool = False, scale: Optional[float] = None,
-                   mask=None, batch_axis: Optional[str] = None):
+                   mask=None, batch_axis: Optional[str] = None,
+                   use_flash: Optional[bool] = None,
+                   flash_bq: int = 512, flash_bk: int = 512):
     """Attention with q/k/v sequence-sharded over `axis`; k/v ride the ring.
 
     q/k/v: (batch, heads, seq, dim) GLOBAL arrays (sharded or to-be-sharded on
@@ -142,16 +144,94 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
     the batch). Returns output with q's sharding. Communication is N-1
     `ppermute` neighbor hops over ICI, compute overlaps transfers under XLA's
     async collectives.
+
+    `use_flash` (None = the helper seam's policy, default-on for TPU): each
+    ring round's local block runs through the fused flash-attention kernel
+    (ops/flash_attention.py) returning (out, logsumexp), and rounds merge
+    via logaddexp — the per-chip compute rides the MXU-fused kernel while
+    ppermute still provides the ICI ring. Under causal masking the round
+    where the visiting k/v block is the device's OWN block is flash-causal,
+    earlier blocks are fully visible, future blocks contribute nothing.
     """
     d = q.shape[-1]
     scale_ = jnp.asarray(scale if scale is not None else 1.0 / np.sqrt(d),
                          q.dtype)
+    scale_f = float(scale) if scale is not None else 1.0 / float(np.sqrt(d))
     acc_dt = jnp.promote_types(q.dtype, jnp.float32)  # fp32 accumulators
     n_dev = mesh.shape[axis]
     seq = q.shape[2]
     assert seq % n_dev == 0, f"seq {seq} not divisible by mesh axis {n_dev}"
     blk = seq // n_dev
     has_mask = mask is not None
+    if use_flash is None:
+        from deeplearning4j_tpu.ops.helpers import helpers_enabled_for
+        use_flash = helpers_enabled_for("flash_attention")
+
+    def _rotate(kb, vb, mb):
+        """One neighbor hop of the visiting k/v (+ key-mask) blocks —
+        shared by both ring implementations."""
+        perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        if mb is not None:
+            mb = lax.ppermute(mb, axis, perm)
+        return kb, vb, mb
+
+    # Both ring bodies do round 0 on the RESIDENT block outside the scan and
+    # rotate FIRST inside it, so exactly n_dev - 1 ppermute hops happen (a
+    # rotate-after-last-round variant ships one dead full-block hop whose
+    # result nothing reads — and its transpose in the backward).
+
+    def local_flash(q_blk, k_blk, v_blk, m_blk):
+        # per-round fused kernel + logaddexp merge across ring hops
+        from deeplearning4j_tpu.ops.flash_attention import (
+            NEG_INF as F_NEG_INF, flash_attention_lse)
+        my = lax.axis_index(axis)
+        b, h = q_blk.shape[0], q_blk.shape[1]
+        # clamp tiles to the per-device block: the kernel pads T up to a
+        # tile multiple, so an unclamped 512 tile would compute 512-wide
+        # score tiles for e.g. 128-row blocks (16x wasted FLOPs)
+        fbq = max(8, min(flash_bq, blk))
+        fbk = max(8, min(flash_bk, blk))
+
+        def round_fn(causal_flag):
+            def f(args):
+                kb, vb, mb = args
+                o, L = flash_attention_lse(q_blk, kb, vb, mb, causal_flag,
+                                           scale_f, fbq, fbk)
+                return o.astype(acc_dt), L.astype(acc_dt)
+            return f
+
+        def skip_fn(args):
+            return (jnp.zeros(q_blk.shape, acc_dt),
+                    jnp.full((b, h, blk), F_NEG_INF, acc_dt))
+
+        def merge(acc_o, acc_L, o_r, L_r):
+            new_L = jnp.logaddexp(acc_L, L_r)
+            w1 = jnp.exp(acc_L - new_L)[..., None]
+            w2 = jnp.exp(L_r - new_L)[..., None]
+            return acc_o * w1 + o_r * w2, new_L
+
+        def step(carry, r):
+            acc_o, acc_L, kb, vb, mb = carry
+            kb, vb, mb = _rotate(kb, vb, mb)
+            owner = (my - r) % n_dev
+            args = (kb, vb, mb)
+            if causal:  # rounds >= 1 never visit the own (diagonal) block
+                o_r, L_r = lax.cond(owner < my, round_fn(False), skip_fn,
+                                    args)
+            else:
+                o_r, L_r = round_fn(False)(args)
+            acc_o, acc_L = merge(acc_o, acc_L, o_r, L_r)
+            return (acc_o, acc_L, kb, vb, mb), None
+
+        # round 0: the resident block — the causal diagonal when masking
+        o0, L0 = round_fn(causal)((k_blk, v_blk, m_blk))
+        acc0 = merge(jnp.zeros(q_blk.shape, acc_dt),
+                     jnp.full((b, h, blk), F_NEG_INF, acc_dt), o0, L0)
+        (out, _, _, _, _), _ = lax.scan(
+            step, acc0 + (k_blk, v_blk, m_blk), jnp.arange(1, n_dev))
+        return out.astype(q_blk.dtype)
 
     def local(q_blk, k_blk, v_blk, m_blk):
         # q_blk etc: (b, h, blk, d); m_blk: (b, blk) or None — this device's
@@ -165,12 +245,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
             ki = kv_owner * blk + jnp.arange(blk)
             return (qi[:, None] >= ki[None, :])[None, None]  # (1,1,blk,blk)
 
-        @jax.checkpoint
-        def step(carry, r):
-            # rematerialized for the same reason as blockwise_attention's
-            # step: per-round score residuals under jax.grad are O(T^2/n)
-            acc, kb, vb, mb = carry
-            owner = (my - r) % n_dev  # whose k/v block is resident this round
+        def round_(acc, kb, vb, mb, owner):
             m = None if mb is None else (mb > 0)[:, None, None, :]  # (b,1,1,blk)
             if causal:
                 # blocks fully in the future are masked out entirely; since
@@ -178,33 +253,37 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
                 cm = causal_mask(owner)
                 m = cm if m is None else m & cm
             o, m_, l_ = _block_attn(q_blk, kb, vb, scale_, m)  # fp32 already
-            acc = _merge(acc, o, m_, l_)
-            # rotate k/v (+ key mask) to the next device on the ring
-            perm = [(i, (i + 1) % n_dev) for i in range(n_dev)]
-            kb = lax.ppermute(kb, axis, perm)
-            vb = lax.ppermute(vb, axis, perm)
-            if mb is not None:
-                mb = lax.ppermute(mb, axis, perm)
+            return _merge(acc, o, m_, l_)
+
+        @jax.checkpoint
+        def step(carry, r):
+            # rematerialized for the same reason as blockwise_attention's
+            # step: per-round score residuals under jax.grad are O(T^2/n)
+            acc, kb, vb, mb = carry
+            kb, vb, mb = _rotate(kb, vb, mb)
+            acc = round_(acc, kb, vb, mb, (my - r) % n_dev)
             return (acc, kb, vb, mb), None
 
         b, h = q_blk.shape[0], q_blk.shape[1]
         acc0 = (jnp.zeros(q_blk.shape, acc_dt),
                 jnp.full((b, h, blk), NEG_INF, acc_dt),
                 jnp.zeros((b, h, blk), acc_dt))
+        acc0 = round_(acc0, k_blk, v_blk, m_blk, my)  # resident block
         (acc, _, _, _), _ = lax.scan(step, (acc0, k_blk, v_blk, m_blk),
-                                     jnp.arange(n_dev))
+                                     jnp.arange(1, n_dev))
         out, m_, l_ = acc
         return (out / jnp.maximum(l_, 1e-30)[..., None]).astype(q_blk.dtype)
 
+    impl = local_flash if use_flash else local
     spec = P(batch_axis, None, axis, None)
     if has_mask:
         shmapped = jax.shard_map(
-            local, mesh=mesh,
+            impl, mesh=mesh,
             in_specs=(spec, spec, spec, P(batch_axis, axis)),
             out_specs=spec, check_vma=False)
         return shmapped(q, k, v, mask)
     shmapped = jax.shard_map(
-        lambda qb, kb, vb: local(qb, kb, vb, None), mesh=mesh,
+        lambda qb, kb, vb: impl(qb, kb, vb, None), mesh=mesh,
         in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     return shmapped(q, k, v)
 
