@@ -13,9 +13,19 @@ recurrence on-chip (arXiv:2205.14135 / flash-attention-2 schedule):
   block's k sweep (sequential, grid-order guarantee as in
   lstm_scan_fused), one (bq, bk) score tile at a time; emits o and the
   row logsumexp L = m + log(l) for the backward;
-- backward (flash-2 two-pass): dq kernel over the same grid accumulating
-  dq in scratch; dkv kernel with the q index fastest accumulating dk/dv.
-  p is RECOMPUTED from (q, k, L) — nothing but o/L is saved;
+- backward, default "fused" single pass (grid = the dkv sweep, q index
+  fastest): p is RECOMPUTED once per score tile from (q, k, L) — nothing
+  but o/L is saved — and feeds dv/dk (VMEM scratch) AND dq in the same
+  tile visit. dq accumulates across the SLOW grid axis, which TPU output
+  revisiting cannot express, so each tile writes a (bq, D) partial to a
+  per-k-block HBM buffer summed by one XLA reduction (nk*|dq| extra
+  traffic — measured cheaper than paying the exp/softmax VPU chain twice;
+  at these head dims the VPU, not the MXU, is the wall). Partials are
+  stored in the fp32 accumulator dtype by default (full-precision dq
+  accumulation, same as the two-pass scratch; measured +3.7% step cost vs
+  bf16 partials — configure(dq_partials="io") buys it back if wanted).
+  configure(bwd="two_pass") selects the flash-2 schedule (separate dq and
+  dkv kernels, each recomputing p) for A/B.
   D_i = rowsum(dO * o) is one cheap XLA reduction outside.
 
 Causal masking and the framework's (B, T) key-padding masks are applied
@@ -29,6 +39,7 @@ the lax.scan blockwise recurrence as the universal fallback.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
@@ -44,8 +55,51 @@ def _interpret() -> bool:
     return interpret_mode()
 
 
-DEFAULT_BQ = 512
-DEFAULT_BK = 512
+# bq/bk = 0 means "auto": 1024 tiles at long T, 512 below (A/B'd on chip,
+# experiments/flash_block_ab.py — 1024/1024 is +13% over 512/512 at the
+# bench shape T=8192 Dh=64; 256 tiles are 15-28% WORSE, so 512 floors it).
+DEFAULT_BQ = 0
+DEFAULT_BK = 0
+
+# Backward schedule: "fused" = one pass computing p once per tile (dk/dv in
+# scratch, per-k-block dq partials to HBM + XLA reduce); "two_pass" =
+# flash-2 style separate dq and dk/dv kernels (each recomputes p).
+# dq_partials: dtype the fused schedule stores its per-k-block dq partials
+# in before the XLA sum — "acc" (the fp32/fp64 accumulator dtype; default,
+# matching the two-pass dq scratch's full-precision accumulation) or "io"
+# (q.dtype — halves the partial-buffer HBM traffic at the cost of one bf16
+# rounding per k block before the sum).
+_CONFIG = {"bwd": os.environ.get("DL4J_TPU_FLASH_BWD", "fused"),
+           "dq_partials": os.environ.get("DL4J_TPU_FLASH_DQ_PARTIALS", "acc")}
+
+
+def configure(bwd: str | None = None, dq_partials: str | None = None):
+    """Override the backward schedule ('fused' | 'two_pass') and/or the
+    fused-schedule dq-partials dtype ('acc' | 'io'); returns the previous
+    (bwd, dq_partials) pair.
+
+    NOTE: the config is read at TRACE time. A jit-compiled caller that has
+    already traced flash_attention keeps its traced schedule — call
+    configure() BEFORE the caller's first call (or clear its jit cache)
+    when A/B-ing schedules."""
+    prev = (_CONFIG["bwd"], _CONFIG["dq_partials"])
+    if bwd is not None:
+        if bwd not in ("fused", "two_pass"):
+            raise ValueError(f"unknown flash bwd mode {bwd!r}")
+        _CONFIG["bwd"] = bwd
+    if dq_partials is not None:
+        if dq_partials not in ("acc", "io"):
+            raise ValueError(f"unknown dq_partials mode {dq_partials!r}")
+        _CONFIG["dq_partials"] = dq_partials
+    return prev
+
+
+def _resolve_blocks(bq: int, bk: int, T: int) -> tuple[int, int]:
+    if not bq:
+        bq = 1024 if T >= 4096 else 512
+    if not bk:
+        bk = 1024 if T >= 4096 else 512
+    return bq, bk
 
 
 def _blocks(T: int, b: int) -> int:
@@ -122,13 +176,18 @@ def _valid_tile(pl, i, j, bq, bk, T, Tp, causal, has_mask, km_ref):
     return valid
 
 
-def _dispatch_tile(pl, update, i, j, nk, bq, bk, T, Tp, causal, has_mask):
+def _dispatch_tile(pl, update, i, j, nk, bq, bk, T, Tp, causal, has_mask,
+                   on_skip=None):
     """Route this tile to the fast (unmasked) or masked body. Causal
     interior tiles — the majority — skip every mask pass; fully-future
     tiles skip the math entirely (the DMA still streams: rectangular
-    grid)."""
+    grid). `on_skip` runs INSTEAD of the body on those skipped tiles —
+    kernels whose per-tile output block must always be written (the fused
+    backward's dq partials) zero-fill there."""
     if causal:
         run = (j * bk) <= (i * bq + bq - 1)
+        if on_skip is not None:
+            pl.when(jnp.logical_not(run))(on_skip)
         if has_mask:
             pl.when(run)(update(True))
             return
@@ -218,8 +277,71 @@ def _dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, L_ref, Di_ref,
                 preferred_element_type=acc_dt)
         return body
 
-    # note the swapped loop order: i is fastest here
-    _dispatch_tile(pl, update, i, j, nq, bq, bk, T, Tp, causal, has_mask)
+    # note the swapped loop order: i is fastest here; the dispatcher's nk
+    # (tail-k-block test) is this grid's dim 1, NOT nq
+    _dispatch_tile(pl, update, i, j, pl.num_programs(1), bq, bk, T, Tp,
+                   causal, has_mask)
+
+    @pl.when(i == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _fused_bwd_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, L_ref, Di_ref,
+                      dk_ref, dv_ref, dqp_ref, dk_scr, dv_scr, *, causal,
+                      scale, bq, bk, T, Tp, has_mask, acc_dt):
+    """One-pass backward: p is computed ONCE per score tile and feeds all
+    three cotangents (the two-pass schedule pays the exp/softmax VPU chain
+    twice — the measured wall at these head dims, not the MXU). dk/dv
+    accumulate in VMEM scratch across the q sweep (i fastest, like
+    _dkv_kernel); dq cannot share that residency (it accumulates across
+    the SLOW axis j, and revisiting an output block on non-consecutive
+    grid steps is not legal on TPU), so each tile writes its (bq, D)
+    partial to a per-k-block HBM buffer that one XLA reduction sums —
+    nk*|dq| extra traffic, far cheaper than a third tile pass."""
+    from jax.experimental import pallas as pl
+    i = pl.program_id(2)        # q block index — FASTEST (the k sweep)
+    j = pl.program_id(1)        # k block index
+    nq = pl.num_programs(2)
+
+    @pl.when(i == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    def update(masked):
+        def body():
+            s = jax.lax.dot_general(
+                q_ref[0], k_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=acc_dt) * scale
+            p = jnp.exp(s - L_ref[0, 0, pl.ds(i * bq, bq)][:, None])
+            if masked:
+                valid = _valid_tile(pl, i, j, bq, bk, T, Tp, causal,
+                                    has_mask, km_ref)
+                p = jnp.where(valid, p, 0.0)
+            dv_scr[:] += jax.lax.dot_general(
+                p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
+                preferred_element_type=acc_dt)
+            dp = jax.lax.dot_general(
+                do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
+                preferred_element_type=acc_dt)
+            ds = (p * (dp - Di_ref[0, 0, pl.ds(i * bq, bq)][:, None])).astype(
+                q_ref.dtype)
+            dk_scr[:] += scale * jax.lax.dot_general(
+                ds, q_ref[0], (((0,), (0,)), ((), ())),
+                preferred_element_type=acc_dt)
+            dqp_ref[0, 0] = (scale * jax.lax.dot_general(
+                ds, k_ref[0], (((1,), (0,)), ((), ())),
+                preferred_element_type=acc_dt)).astype(dqp_ref.dtype)
+        return body
+
+    def skip():
+        dqp_ref[0, 0] = jnp.zeros_like(dqp_ref[0, 0])
+
+    # i fastest: the dispatcher's nk (tail-k-block test) is grid dim 1
+    _dispatch_tile(pl, update, i, j, pl.num_programs(1), bq, bk, T, Tp,
+                   causal, has_mask, on_skip=skip)
 
     @pl.when(i == nq - 1)
     def _():
@@ -308,6 +430,7 @@ def _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse):
     from jax.experimental.pallas import tpu as pltpu
     q, k, v, mask, o, L = saved
     B, H, T, D = q.shape
+    bq, bk = _resolve_blocks(bq, bk, T)
     scale_ = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
     qp, kp, vp, km, Tp = _prep(q, k, v, mask, bq, bk)
     dop = jnp.pad(dout.reshape(B * H, T, D), ((0, 0), (0, Tp - T), (0, 0)))
@@ -322,6 +445,34 @@ def _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse):
         Di = Di - dl
     BH = B * H
     nq, nk = Tp // bq, Tp // bk
+    if _CONFIG["bwd"] == "fused":
+        dqp_dt = acc_dt if _CONFIG["dq_partials"] == "acc" else q.dtype
+        qspec2 = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
+        kspec2 = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
+        dk, dv, dqp = pl.pallas_call(
+            functools.partial(_fused_bwd_kernel, causal=causal, scale=scale_,
+                              bq=bq, bk=bk, T=T, Tp=Tp,
+                              has_mask=mask is not None, acc_dt=acc_dt),
+            grid=(BH, nk, nq),
+            in_specs=[qspec2, kspec2, kspec2,
+                      pl.BlockSpec((1, 1, Tp), lambda b, j, i: (b, 0, 0)),
+                      qspec2,
+                      pl.BlockSpec((1, 1, Tp), lambda b, j, i: (b, 0, 0)),
+                      pl.BlockSpec((1, 1, Tp), lambda b, j, i: (b, 0, 0))],
+            out_specs=(kspec2, kspec2,
+                       pl.BlockSpec((1, 1, bq, D),
+                                    lambda b, j, i: (b, j, i, 0))),
+            out_shape=(jax.ShapeDtypeStruct((BH, Tp, D), k.dtype),
+                       jax.ShapeDtypeStruct((BH, Tp, D), v.dtype),
+                       jax.ShapeDtypeStruct((BH, nk, Tp, D), dqp_dt)),
+            scratch_shapes=[pltpu.VMEM((bk, D), acc_dt),
+                            pltpu.VMEM((bk, D), acc_dt)],
+            interpret=_interpret(),
+        )(qp, kp, vp, km, dop, L, Di)
+        dq = jnp.sum(dqp.astype(acc_dt), axis=1).astype(q.dtype)
+        shp = lambda a: a[:, :T].reshape(B, H, T, D)
+        dmask = None if mask is None else jnp.zeros_like(mask)
+        return shp(dq), shp(dk), shp(dv), dmask
     qspec = pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0))
     kspec = pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0))
     dq = pl.pallas_call(
@@ -382,6 +533,7 @@ def flash_attention_lse(q, k, v, mask=None, causal: bool = False,
 
 def _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk):
     B, H, T, D = q.shape
+    bq, bk = _resolve_blocks(bq, bk, T)
     scale_ = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
     qp, kp, vp, km, Tp = _prep(q, k, v, mask, bq, bk)
     o, L = _call_fwd(qp, kp, vp, km, causal, scale_, bq, bk, T,

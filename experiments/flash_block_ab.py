@@ -1,0 +1,86 @@
+"""A/B flash-attention block sizes on the real chip (bench shape).
+
+Bench entry shape: B=4, H=4, T=8192, Dh=64, causal, bf16.
+Grid steps per kernel = BH * (T/bq) * (T/bk); per-step MXU work is small
+(2*bq*bk*D FLOP), so tile size trades grid/DMA overhead against VMEM.
+
+Protocol: on-device lax.scan loop (steps iterations per dispatch — the
+tunneled chip adds tens of ms of RPC latency per dispatch, so single-step
+timing is useless), min of 3 dispatches, same session. A dummy SGD update
+on q/k/v keeps the scan carry honest (XLA can't DCE the backward).
+
+CAVEAT (discovered after these runs): every call additionally pays a
+~70-110 ms relay-latency tick, so the ms/iter printed here carries a
++~(tick/STEPS) constant offset. The RANKING between configs is unaffected
+(same offset everywhere, same session); bench.py's _device_loop_time now
+uses a two-point slope that cancels the offset for recorded numbers.
+"""
+import functools
+import time
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.flash_attention import flash_attention
+
+B, H, T, D = 4, 4, 8192, 64
+STEPS = 5
+
+
+def mk():
+    k1, k2, k3 = jax.random.split(jax.random.PRNGKey(0), 3)
+    q = jax.random.normal(k1, (B, H, T, D), jnp.bfloat16)
+    k = jax.random.normal(k2, (B, H, T, D), jnp.bfloat16)
+    v = jax.random.normal(k3, (B, H, T, D), jnp.bfloat16)
+    return q, k, v
+
+
+def bench(bq, bk, label=""):
+    params = mk()
+
+    def loss(q, k, v):
+        o = flash_attention(q, k, v, None, True, None, bq, bk)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    @jax.jit
+    def loop(params):
+        def body(c, _):
+            g = jax.grad(loss, argnums=(0, 1, 2))(*c)
+            c = tuple(p - 1e-6 * gg.astype(p.dtype) for p, gg in zip(c, g))
+            return c, None
+        out, _ = jax.lax.scan(body, params, None, length=STEPS)
+        return out
+
+    try:
+        r = loop(params)
+        jax.block_until_ready(r)
+    except Exception as e:
+        print(f"bq={bq:5d} bk={bk:5d}  FAIL: {type(e).__name__}: {str(e)[:110]}")
+        return None
+    ts = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        r = loop(params)
+        jax.block_until_ready(r)
+        ts.append((time.perf_counter() - t0) * 1e3 / STEPS)
+    best = min(ts)
+    steps = (B * H) * (-(-T // bq)) * (-(-T // bk))
+    print(f"bq={bq:5d} bk={bk:5d}  min={best:8.2f} ms/iter  "
+          f"(3 kernels x {steps} grid steps){label}")
+    return best
+
+
+if __name__ == "__main__":
+    print(f"device: {jax.devices()[0]}")
+    results = {}
+    for bq, bk in [(512, 512), (1024, 512), (512, 1024), (1024, 1024),
+                   (2048, 1024), (1024, 2048), (2048, 512), (512, 2048),
+                   (256, 512), (512, 256)]:
+        r = bench(bq, bk)
+        if r is not None:
+            results[(bq, bk)] = r
+    base = results.get((512, 512))
+    if base:
+        print("\nvs current default 512/512 (fwd+bwd, one attention op):")
+        for kk, vv in sorted(results.items(), key=lambda x: x[1]):
+            print(f"  {kk}: {vv:8.2f} ms  ({base / vv:4.2f}x)")

@@ -24,10 +24,20 @@ def _data(B=2, H=3, T=23, D=8):
     return q, k, v, mask
 
 
+@pytest.fixture(params=["fused", "two_pass"])
+def bwd_mode(request):
+    """Run the parametrized tests under BOTH backward schedules (the
+    default fused single-pass and the flash-2 two-pass)."""
+    from deeplearning4j_tpu.ops import flash_attention as fa
+    prev, _ = fa.configure(bwd=request.param)
+    yield request.param
+    fa.configure(bwd=prev)
+
+
 @pytest.mark.parametrize("causal", [False, True])
 @pytest.mark.parametrize("use_mask", [False, True])
 @pytest.mark.parametrize("blk", [8, 16])
-def test_value_and_grad_match_dense_oracle(causal, use_mask, blk):
+def test_value_and_grad_match_dense_oracle(causal, use_mask, blk, bwd_mode):
     q, k, v, mask = _data()
     m = mask if use_mask else None
 
@@ -43,6 +53,33 @@ def test_value_and_grad_match_dense_oracle(causal, use_mask, blk):
     assert abs(float(vf - vr)) < 1e-10
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-10)
+
+
+def test_rectangular_blocks_and_auto_resolution():
+    """bq != bk (the auto-resolver can pick asymmetric tiles) and the
+    bq=bk=0 'auto' default must both match the oracle — values and grads,
+    both backward schedules."""
+    from deeplearning4j_tpu.ops import flash_attention as fa
+    q, k, v, mask = _data(T=40)
+
+    def lr(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention_reference(q, k, v, mask, True)))
+
+    gr = jax.grad(lr, argnums=(0, 1, 2))(q, k, v)
+    for mode in ("fused", "two_pass"):
+        prev, _ = fa.configure(bwd=mode)
+        try:
+            for bq, bk in ((8, 16), (16, 8), (0, 0)):
+                def lf(q, k, v):
+                    return jnp.sum(jnp.sin(flash_attention(
+                        q, k, v, mask, True, None, bq, bk)))
+                gf = jax.grad(lf, argnums=(0, 1, 2))(q, k, v)
+                for a, b in zip(gf, gr):
+                    np.testing.assert_allclose(
+                        np.asarray(a), np.asarray(b), atol=1e-10,
+                        err_msg=f"{mode} bq={bq} bk={bk}")
+        finally:
+            fa.configure(bwd=prev)
 
 
 def test_fully_masked_rows_zero_output_and_grads():
