@@ -268,7 +268,12 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
         acc0 = (jnp.zeros(q_blk.shape, acc_dt),
                 jnp.full((b, h, blk), NEG_INF, acc_dt),
                 jnp.zeros((b, h, blk), acc_dt))
-        acc0 = round_(acc0, k_blk, v_blk, m_blk, my)  # resident block
+        # resident block — checkpointed like the scan rounds, else its
+        # (b, h, blk, blk) score/softmax residuals alone are saved by
+        # autodiff (O(T^2/n) memory, the exact thing this path avoids)
+        acc0 = jax.checkpoint(
+            lambda a, kb, vb, mb: round_(a, kb, vb, mb, my))(
+            acc0, k_blk, v_blk, m_blk)
         (acc, _, _, _), _ = lax.scan(step, (acc0, k_blk, v_blk, m_blk),
                                      jnp.arange(1, n_dev))
         out, m_, l_ = acc
