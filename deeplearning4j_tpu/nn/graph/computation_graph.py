@@ -19,6 +19,7 @@ import numpy as np
 from deeplearning4j_tpu.common.enums import BackpropType
 from deeplearning4j_tpu.nn.conf.graph_configuration import ComputationGraphConfiguration
 from deeplearning4j_tpu.nn.conf.layers.base import BaseLayerConf, apply_dropout
+from deeplearning4j_tpu.nn.divergence import DivergenceSentinelMixin
 from deeplearning4j_tpu.nn.multilayer import (
     _apply_updates, _compute_updates, _normalize_gradients)
 from deeplearning4j_tpu.nn.updater.updaters import BaseUpdater
@@ -33,7 +34,7 @@ def _as_list(x) -> List:
     return [x]
 
 
-class ComputationGraph:
+class ComputationGraph(DivergenceSentinelMixin):
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
         # layer nodes in topo order define the flat-param-view ordering
@@ -419,41 +420,44 @@ class ComputationGraph:
         for lst in self._listeners:
             lst.iteration_done(self, self._step)
 
-    def fit_on_device(self, x, y, steps: Optional[int] = None, fmask=None, lmask=None):
-        """Jitted lax.scan training loop (see MultiLayerNetwork.fit_on_device).
-        Benchmark mode only here: the same batch is reused `steps` times."""
+    def fit_on_device(self, x, y, steps: Optional[int] = None, fmask=None, lmask=None,
+                      sync: bool = True, vary_batch: bool = False):
+        """Jitted lax.scan training loop (see MultiLayerNetwork.fit_on_device,
+        including `sync=False` deferred-readback and `vary_batch` anti-hoisting
+        semantics). Benchmark mode only here: the same batch is reused `steps`
+        times (rotated per step when vary_batch)."""
         self._check_init()
         x = tuple(jnp.asarray(v, self.dtype) for v in _as_list(x))
         y = tuple(jnp.asarray(v, self.dtype) for v in _as_list(y))
         if steps is None:
             raise ValueError("steps is required (single-batch device loop)")
 
-        run = self._get_device_loop()
+        run = self._get_device_loop(vary_batch)
 
         self._rng, sub = jax.random.split(self._rng)
         (self.params_tree, self._opt_state, self.state_tree, _, _, div), losses = run(
             self.params_tree, self._opt_state, self.state_tree,
             jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask, n=int(steps))
         self._step += int(steps)
-        losses = np.asarray(losses)
+        # sticky device-side stash (see DivergenceSentinelMixin)
+        self._stash_pending_div(div)
+        if not sync:
+            self._score = losses[-1]      # device scalar; host sync deferred
+            return losses                 # divergence resolves on _diverged_at
+        losses, div = jax.device_get((losses, self._pending_div))  # ONE readback
         self._score = float(losses[-1])
-        div = int(div)
-        self._diverged_at = div if div >= 0 else None
-        if self._diverged_at is not None:
-            import warnings
-            warnings.warn(
-                f"Training diverged: non-finite loss at step {self._diverged_at}; "
-                f"parameters frozen at the last finite step")
+        self._resolve_divergence(int(div))
         return losses
 
-    def _get_device_loop(self):
+    def _get_device_loop(self, vary_batch: bool = False):
         """Build (or fetch from cache) the jitted scan loop used by fit_on_device /
         train_step_flops. Data (x/y/masks) is passed as jit arguments — never
         captured as traced constants — so a warm cache cannot replay the first
-        call's batch."""
+        call's batch. vary_batch: see MultiLayerNetwork.fit_on_device (defeats
+        loop-invariant hoisting of frozen-vertex forwards)."""
         import functools
 
-        cache_key = ("cg",)
+        cache_key = ("cg", vary_batch)
         if not hasattr(self, "_device_loop_cache"):
             self._device_loop_cache = {}
         run = self._device_loop_cache.get(cache_key)
@@ -467,10 +471,17 @@ class ComputationGraph:
                 def body(carry, _):
                     params_c, opt_c, states_c, step_c, rng_c, div_c = carry
                     rng_c, sub = jax.random.split(rng_c)
+                    if vary_batch:
+                        roll = lambda t: jax.tree_util.tree_map(
+                            lambda a: jnp.roll(a, step_c, axis=0), t)
+                        bx, by, bfm, blm = roll(x), roll(y), roll(fmask), \
+                            roll(lmask)
+                    else:
+                        bx, by, bfm, blm = x, y, fmask, lmask
 
                     def loss_fn(p):
-                        loss, (ns, _) = self._loss_fn(p, states_c, x, y, fmask,
-                                                      lmask, sub, True, None)
+                        loss, (ns, _) = self._loss_fn(p, states_c, bx, by, bfm,
+                                                      blm, sub, True, None)
                         return loss, ns
 
                     (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(

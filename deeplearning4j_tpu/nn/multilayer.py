@@ -22,6 +22,7 @@ from deeplearning4j_tpu.nn.conf.configuration import MultiLayerConfiguration
 from deeplearning4j_tpu.nn.conf.input_type import InputType
 from deeplearning4j_tpu.nn.conf.layers.base import BaseLayerConf, apply_dropout
 from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM
+from deeplearning4j_tpu.nn.divergence import DivergenceSentinelMixin
 from deeplearning4j_tpu.nn.conf.preprocessors import (
     FeedForwardToRnnPreProcessor, RnnToFeedForwardPreProcessor)
 from deeplearning4j_tpu.nn.updater.updaters import BaseUpdater, Sgd
@@ -77,7 +78,7 @@ def _apply_updates(layers, updaters, grads, opt_state, params_tree, step):
     return new_params, new_opt
 
 
-class MultiLayerNetwork:
+class MultiLayerNetwork(DivergenceSentinelMixin):
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers: List[BaseLayerConf] = conf.layers
@@ -408,12 +409,29 @@ class MultiLayerNetwork:
             lst.iteration_done(self, self._step)
         return final_rnn
 
-    def fit_on_device(self, x, y, steps: Optional[int] = None, fmask=None, lmask=None):
+    def fit_on_device(self, x, y, steps: Optional[int] = None, fmask=None, lmask=None,
+                      sync: bool = True, vary_batch: bool = False):
         """Run many training steps as ONE jitted lax.scan on device — no per-step host
         dispatch. TPU-idiomatic epoch runner: if x/y carry a leading step axis
         (steps, batch, ...) each scan step consumes its own minibatch; otherwise the
         same batch is reused `steps` times (benchmark mode). Returns the per-step loss
-        array (one host transfer at the end)."""
+        array (one host transfer at the end).
+
+        `sync=False` defers EVERY device->host readback: losses return as a device
+        array (np.asarray it on demand) and the divergence check resolves lazily on
+        the next `_diverged_at` access. Host readback of a computed result is pure
+        overhead for a training loop (and costs ~100 ms per fetch over a tunneled
+        chip) — timed callers want the device time, not the link.
+
+        `vary_batch=True` (benchmark mode only) rotates the resident batch by the
+        step index each iteration (jnp.roll along the batch axis — compute-identical
+        permutations, zero extra HBM). Without it, any step computation that does
+        not depend on the carry is LOOP-INVARIANT and XLA hoists it out of the scan
+        — with frozen layers (transfer learning) that silently caches the whole
+        frozen forward pass across "steps" and a throughput reading becomes a
+        features-cached number (discovered when the VGG16-transfer slope implied
+        269 TFLOPS on a 197 TFLOPS chip). Rolling by the traced step index makes
+        every step's input distinct, like a real data pipeline."""
         self._check_init()
         x = jnp.asarray(x, self.dtype)
         y = jnp.asarray(y, self.dtype)
@@ -428,29 +446,32 @@ class MultiLayerNetwork:
         # (a warm cache must not replay the first call's data). jax.jit's own aval
         # cache handles shape/dtype/None changes. In per-step mode masks (when given)
         # carry a leading step axis and are scanned alongside x/y.
-        run = self._get_device_loop(per_step_data, has_fm, has_lm)
+        if vary_batch and per_step_data:
+            raise ValueError("vary_batch applies to the same-batch benchmark "
+                             "mode only (steps=int)")
+        run = self._get_device_loop(per_step_data, has_fm, has_lm, vary_batch)
 
         self._rng, sub = jax.random.split(self._rng)
         (self.params_tree, self._opt_state, self.state_tree, _, _, div), losses = run(
             self.params_tree, self._opt_state, self.state_tree,
             jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask, n=int(steps))
         self._step += int(steps)
-        losses = np.asarray(losses)
+        # sticky device-side stash: a clean later call must not clobber an
+        # unobserved divergence from an earlier deferred call
+        self._stash_pending_div(div)
+        if not sync:
+            self._score = losses[-1]      # device scalar; host sync deferred
+            return losses                 # divergence resolves on _diverged_at
+        losses, div = jax.device_get((losses, self._pending_div))  # ONE readback
         self._score = float(losses[-1])
-        div = int(div)
-        self._diverged_at = div if div >= 0 else None
-        if self._diverged_at is not None:
-            import warnings
-            warnings.warn(
-                f"Training diverged: non-finite loss at step {self._diverged_at}; "
-                f"parameters frozen at the last finite step "
-                f"(ref InvalidScoreIterationTerminationCondition semantics)")
+        self._resolve_divergence(int(div))
         return losses
 
-    def _get_device_loop(self, per_step_data: bool, has_fm: bool, has_lm: bool):
+    def _get_device_loop(self, per_step_data: bool, has_fm: bool, has_lm: bool,
+                         vary_batch: bool = False):
         """Build (or fetch from cache) the jitted scan training loop used by
         fit_on_device / train_step_flops."""
-        cache_key = ("mln", per_step_data, has_fm, has_lm)
+        cache_key = ("mln", per_step_data, has_fm, has_lm, vary_batch)
         if not hasattr(self, "_device_loop_cache"):
             self._device_loop_cache = {}
         run = self._device_loop_cache.get(cache_key)
@@ -467,6 +488,13 @@ class MultiLayerNetwork:
                         bx, by = xs[0], xs[1]
                         bfm = xs[2] if has_fm else None
                         blm = xs[2 + has_fm] if has_lm else None
+                    elif vary_batch:
+                        # rotate by the traced step index: defeats
+                        # loop-invariant hoisting (see fit_on_device doc)
+                        roll = lambda a: None if a is None else \
+                            jnp.roll(a, step_c, axis=0)
+                        bx, by, bfm, blm = roll(x), roll(y), roll(fmask), \
+                            roll(lmask)
                     else:
                         bx, by, bfm, blm = x, y, fmask, lmask
                     rng_c, sub = jax.random.split(rng_c)

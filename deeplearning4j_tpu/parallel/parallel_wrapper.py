@@ -358,7 +358,7 @@ class ParallelWrapper:
         for lst in self._listeners:
             lst.iteration_done(self, self._host_step)
 
-    def fit_on_device(self, x, y, steps: int):
+    def fit_on_device(self, x, y, steps: int, sync: bool = True):
         """Run `steps` data-parallel training steps as ONE jitted lax.scan on device
         (same batch each step — benchmark/epoch-runner mode, see
         MultiLayerNetwork.fit_on_device). This is the TPU-idiomatic measurement path:
@@ -400,8 +400,15 @@ class ParallelWrapper:
         net._rng, sub = jax.random.split(net._rng)
         self._carry, losses = self._scan_fn(self._carry, sub, x, y, n=int(steps))
         self._host_step += int(steps)
-        # host transfer doubles as the synchronization point: callers (and the
-        # bench timing loop) must observe completed work, not queued dispatches
+        if not sync:
+            # deferred readback (see MultiLayerNetwork.fit_on_device): the
+            # returned device array is the completion handle — timed callers
+            # block_until_ready on it rather than paying a host copy per call
+            self._score = losses[-1]
+            self._write_back()
+            return losses
+        # host transfer doubles as the synchronization point: callers must
+        # observe completed work, not queued dispatches
         losses = np.asarray(losses)
         self._score = float(losses[-1])
         self._write_back()

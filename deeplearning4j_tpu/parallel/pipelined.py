@@ -399,13 +399,17 @@ class PipelinedTrainer:
         for lst in self._listeners:
             lst.iteration_done(self, self._host_step)
 
-    def fit_on_device(self, x, y, steps: int):
+    def fit_on_device(self, x, y, steps: int, sync: bool = True):
         self._ensure_setup()
         net = self.net
         x = jnp.asarray(x, net.dtype)
         y = jnp.asarray(y, net.dtype)
         self._carry, losses = self._scan_fn(self._carry, x, y, n=int(steps))
         self._host_step += int(steps)
+        if not sync:
+            self._score = losses[-1]  # deferred readback (see MultiLayerNetwork)
+            self.write_back()
+            return losses
         losses = np.asarray(losses)  # host transfer = sync point
         self._score = float(losses[-1])
         self.write_back()

@@ -421,9 +421,12 @@ class ShardedTrainer:
         for lst in self._listeners:
             lst.iteration_done(self, self._host_step)
 
-    def fit_on_device(self, x, y, steps: int, fmask=None, lmask=None):
+    def fit_on_device(self, x, y, steps: int, fmask=None, lmask=None,
+                      sync: bool = True):
         """`steps` sharded training steps as ONE jitted lax.scan (same batch each
-        step — benchmark/epoch-runner mode; no per-step host dispatch)."""
+        step — benchmark/epoch-runner mode; no per-step host dispatch).
+        `sync=False` defers the host readback of the losses (see
+        MultiLayerNetwork.fit_on_device)."""
         self._ensure_setup()
         net = self.net
         x, y, fmask, lmask = self._place_batch(x, y, fmask, lmask)
@@ -431,6 +434,10 @@ class ShardedTrainer:
         self._carry, losses = self._scan_fn(self._carry, sub, x, y, fmask,
                                             lmask, n=int(steps))
         self._host_step += int(steps)
+        if not sync:
+            self._score = losses[-1]
+            self.write_back()
+            return losses
         # host transfer = synchronization point (timed callers must see real work)
         losses = np.asarray(losses)
         self._score = float(losses[-1])

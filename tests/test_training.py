@@ -182,6 +182,36 @@ def test_fit_on_device_warm_cache_uses_new_data():
         "warm cache ignored the new batch"
 
 
+def test_fit_on_device_vary_batch_mode():
+    """vary_batch=True (benchmark mode): per-step batch rotation trains with
+    finite decreasing loss, step t sees roll(x, t) — equivalent data, but the
+    step input depends on the step index so XLA cannot hoist loop-invariant
+    (e.g. frozen-layer) forwards out of the scan. Per-step-data mode rejects
+    the flag."""
+    from deeplearning4j_tpu import (
+        Activation, DenseLayer, InputType, MultiLayerNetwork,
+        NeuralNetConfiguration, OutputLayer, Sgd, WeightInit)
+    import pytest
+
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(3).weight_init(WeightInit.XAVIER)
+            .updater(Sgd(learning_rate=0.1)).dtype("float64")
+            .list()
+            .layer(DenseLayer(n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(5))
+            .build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.RandomState(0)
+    x = rng.rand(12, 5)
+    y = np.eye(3)[rng.randint(0, 3, 12)]
+    losses = np.asarray(net.fit_on_device(x, y, steps=6, vary_batch=True))
+    assert np.isfinite(losses).all() and losses[-1] < losses[0]
+    with pytest.raises(ValueError, match="vary_batch"):
+        net.fit_on_device(np.stack([x] * 3), np.stack([y] * 3),
+                          vary_batch=True)
+
+
 def test_bf16_mixed_precision_params_stay_fp32_and_learn():
     """compute_dtype=bfloat16: layer math in bf16, params/updater state/score in the
     storage dtype; training still converges on a toy problem."""
