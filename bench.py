@@ -49,17 +49,80 @@ def _sanity_check_peak(name, flops_per_step, ms_per_iter, n_chips=1):
     return round(achieved / peak, 4)
 
 
-def _device_loop_time(net, x, y, steps, reps=3):
-    """(median, min) wall time over `reps` runs of the jitted scan loop; the first
-    call compiles and is discarded."""
-    net.fit_on_device(x, y, steps=steps)  # compile + warm
-    times = []
-    for _ in range(reps):
-        t0 = time.perf_counter()
-        net.fit_on_device(x, y, steps=steps)
-        times.append(time.perf_counter() - t0)
-    times.sort()
-    return times[len(times) // 2], times[0]
+def _slope_time(run, n1, n2, reps=4, flops_per_iter=None):
+    """(median, min) wall seconds PER ITERATION of an n-iteration device loop,
+    measured as the two-point slope call(n) = fixed + n*S between n1 and n2
+    (interleaved reps, min/median at each point, compile warmed and excluded
+    at both). `run(n)` must execute n iterations and block until complete.
+
+    Why a slope and not a stopwatch around one call: completing/fetching a
+    call's result over the tunneled chip costs ~70-110 ms of relay latency
+    per call (measured: np.asarray of a fresh (6,) result and of a 33 MB one
+    both ~108 ms; block_until_ready on small fresh buffers ~107 ms; real
+    TPU-VM sync is microseconds). Single-call timing therefore inflates
+    ms/iter by ~(relay latency)/steps — +45 ms/iter at steps=5, the dominant
+    term for every small-step entry recorded before r5. The slope cancels ANY
+    per-call fixed cost, whatever the relay does; device work still bounds it
+    below.
+
+    Noise guards: relay-tick PHASE (up to ~1 tick per endpoint) makes the
+    slope noisy when (n2-n1)*S is not >> 100 ms, and host contention breaks
+    the fixed-cost-cancels assumption outright (observed: a concurrent
+    pytest run collapsed a slope to ~0, which a naive clamp would publish as
+    a 0.0 ms kernel). A median slope that is non-positive, or faster than
+    the hard MXU floor (flops_per_iter / chip peak), is therefore REMEASURED
+    with a doubled span up to twice, then raises — never published. The
+    min-slope falls back to the median under the same tests."""
+    floor = (flops_per_iter / PEAK_FLOPS_PER_CHIP) if flops_per_iter else 0.0
+    med = mn = -1.0
+    for attempt in range(3):
+        run(n1)
+        run(n2)
+        t1, t2 = [], []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            run(n1)
+            t1.append(time.perf_counter() - t0)
+            t0 = time.perf_counter()
+            run(n2)
+            t2.append(time.perf_counter() - t0)
+        t1.sort(); t2.sort()
+        dn = n2 - n1
+        med = (t2[len(t2) // 2] - t1[len(t1) // 2]) / dn
+        mn = (t2[0] - t1[0]) / dn
+        if med > floor:
+            break
+        n1, n2 = 2 * n1, 2 * n2    # widen the span and try again
+    else:
+        raise AssertionError(
+            f"slope measurement noise-dominated after 3 attempts "
+            f"(median slope {med * 1e3:.4f} ms/iter vs MXU floor "
+            f"{floor * 1e3:.4f} ms) — refusing to publish")
+    if mn <= floor or mn > med:
+        mn = med          # min faster than physics (or > med): noise
+    return med, mn
+
+
+def _device_loop_time(net, x, y, steps, reps=4, flops=None,
+                      vary_batch=False):
+    """(median, min) wall seconds PER `steps` ITERATIONS of the jitted
+    fit_on_device scan loop (see _slope_time; sync=False defers the host
+    readback so it never mixes into either point; block_until_ready on the
+    device losses is the honest sync — losses[-1] exists only after every
+    step ran). vary_batch=True rotates the batch per step — REQUIRED for
+    nets with frozen layers, where a loop-invariant frozen forward would
+    otherwise be hoisted out of the scan and the slope would measure a
+    features-cached step (the VGG16 entry implied 269 TFLOPS without it)."""
+    import jax
+    kw = {"vary_batch": True} if vary_batch else {}
+
+    def run(n):
+        jax.block_until_ready(
+            net.fit_on_device(x, y, steps=n, sync=False, **kw))
+
+    med, mn = _slope_time(run, steps, 5 * steps, reps=reps,
+                          flops_per_iter=flops)
+    return med * steps, mn * steps
 
 
 def _synth(rng, batch, classes, *feature_shape):
@@ -82,7 +145,7 @@ def bench_resnet50(batch=256, steps=30, compute_dtype="bfloat16",
         rng = np.random.RandomState(0)
         x, y = _synth(rng, batch, 1000, 3, 224, 224)
         flops = net.train_step_flops(x, y)
-        dt, dt_min = _device_loop_time(net, x, y, steps)
+        dt, dt_min = _device_loop_time(net, x, y, steps, flops=flops)
     ms = dt / steps * 1e3
     name = f"resnet50_{compute_dtype or 'float32'}_b{batch}" + \
         ("_helpers" if helpers else "")
@@ -129,25 +192,52 @@ def bench_resnet50_roofline(resnet_entry, batch=256):
         run, net.params_tree, net._opt_state, net.state_tree,
         jnp.asarray(0, jnp.int32), net._rng, (x,), (y,), None, None, n=1)
     ms = resnet_entry["ms_per_iter"]
+    mxu_ms = costs["flops"] / PEAK_FLOPS_PER_CHIP * 1e3
+    lb_ms = lb_bytes / HBM_GBS * 1e3
     return {
         "batch": batch,
         "flops_per_step_g": round(costs["flops"] / 1e9, 1),
-        "mxu_floor_ms": round(costs["flops"] / PEAK_FLOPS_PER_CHIP * 1e3, 2),
+        "mxu_floor_ms": round(mxu_ms, 2),
         "activations_gb": round(acts / 1e9, 3),
         "hand_lb_traffic_gb": round(lb_bytes / 1e9, 3),
-        "hand_lb_ms": round(lb_bytes / HBM_GBS * 1e3, 2),
+        "hand_lb_ms": round(lb_ms, 2),
         "xla_hlo_bytes_gb": round(costs["bytes_accessed"] / 1e9, 3),
         "xla_hlo_bytes_ms": round(costs["bytes_accessed"] / HBM_GBS * 1e3, 2),
         "measured_ms": round(ms, 2),
-        "measured_over_hand_lb": round(ms / (lb_bytes / HBM_GBS * 1e3), 3),
-        "measured_over_mxu_floor": round(
-            ms / (costs["flops"] / PEAK_FLOPS_PER_CHIP * 1e3), 2),
-        "verdict": ("HBM-bound: measured time sits at the unavoidable-traffic "
-                    "floor (819 GB/s) with the MXU floor far below"),
+        "measured_over_hand_lb": round(ms / lb_ms, 3),
+        "measured_over_mxu_floor": round(ms / mxu_ms, 2),
+        "verdict": _roofline_verdict(ms, lb_ms, mxu_ms),
     }
 
 
 HBM_GBS = 819e9  # v5e public spec
+
+
+def _roofline_verdict(measured_ms, lb_ms, mxu_ms):
+    """Derive the roofline verdict from where measured lands. The hand
+    traffic count (5 x activations + per-param bytes) is a MODEL, not a
+    physical bound — XLA fusion can keep chains of intermediates in
+    VMEM/registers and emit less HBM traffic than the per-boundary count, so
+    a measurement below it demotes the model rather than claiming
+    impossible sub-floor throughput. The MXU floor IS a hard bound (the
+    peak-sanity assert enforces it separately)."""
+    floor = max(lb_ms, mxu_ms)
+    if not floor:
+        return "no cost model available"
+    if lb_ms and measured_ms < 0.95 * lb_ms:
+        return (f"measured ({measured_ms:.2f} ms) lands BELOW the hand "
+                f"traffic model ({lb_ms:.2f} ms): the 5x-activation count "
+                "overstates the traffic XLA's fusion actually emits — the "
+                "model is an estimate, not a floor; the MXU floor "
+                f"({mxu_ms:.2f} ms) remains the hard bound")
+    if measured_ms < 1.5 * floor:
+        return ("HBM-bandwidth-bound" if lb_ms >= mxu_ms
+                else "MXU-compute-bound") + \
+            ": measured sits at the hardware floor"
+    return (f"NOT at a hardware floor: measured is "
+            f"{measured_ms / floor:.1f}x the higher floor "
+            f"({'traffic' if lb_ms >= mxu_ms else 'MXU'}) — "
+            "remainder is dispatch/latency overhead")
 
 
 def _hand_roofline(measured_ms, flops, act_bytes, param_traffic_bytes,
@@ -163,18 +253,7 @@ def _hand_roofline(measured_ms, flops, act_bytes, param_traffic_bytes,
     lb_ms = lb_bytes / HBM_GBS * 1e3
     over_lb = measured_ms / lb_ms if lb_ms else None
     over_mxu = measured_ms / mxu_ms if mxu_ms else None
-    floor = max(lb_ms, mxu_ms)
-    if floor and measured_ms < 1.5 * floor:
-        verdict = ("HBM-bandwidth-bound" if lb_ms >= mxu_ms
-                   else "MXU-compute-bound") + \
-            ": measured sits at the hardware floor"
-    elif floor:
-        verdict = (f"NOT at a hardware floor: measured is "
-                   f"{measured_ms / floor:.1f}x the higher floor "
-                   f"({'traffic' if lb_ms >= mxu_ms else 'MXU'}) — "
-                   "remainder is dispatch/latency overhead")
-    else:
-        verdict = "no cost model available"
+    verdict = _roofline_verdict(measured_ms, lb_ms, mxu_ms)
     return {
         "flops_per_step_g": round(flops / 1e9, 2),
         "mxu_floor_ms": round(mxu_ms, 3),
@@ -200,7 +279,7 @@ def bench_lenet(batch=128, steps=200):
     x, y = _synth(rng, batch, 10, 784)
     costs = net.train_step_costs(x, y)
     flops = costs["flops"] or None
-    dt, dt_min = _device_loop_time(net, x, y, steps)
+    dt, dt_min = _device_loop_time(net, x, y, steps, flops=flops)
     ms = dt / steps * 1e3
     out = {"ms_per_iter": ms, "min_ms_per_iter": dt_min / steps * 1e3,
            "samples_per_sec": batch * steps / dt, "batch": batch,
@@ -239,7 +318,7 @@ def bench_graves_lstm(batch=8192, seq_len=100, steps=8,
         y = jnp.asarray(np.eye(vocab, dtype=np.float32)[
             np.roll(idx, -1, axis=1)].transpose(0, 2, 1))
         flops = net.train_step_flops(x, y)
-        dt, dt_min = _device_loop_time(net, x, y, steps)
+        dt, dt_min = _device_loop_time(net, x, y, steps, flops=flops)
     ms = dt / steps * 1e3
     out = {"tokens_per_sec": batch * seq_len * steps / dt,
            "ms_per_iter": ms, "min_ms_per_iter": dt_min / steps * 1e3,
@@ -285,21 +364,21 @@ def bench_graves_lstm_roofline(lstm_entry, batch=8192, seq_len=100,
         return jnp.sum(ys.astype(jnp.float32)) + \
             jnp.sum(cs.astype(jnp.float32))
 
-    def chain(xw, *rest):
+    def chain(xw, *rest, n):
         def body(c, _):
             _, g = jax.value_and_grad(loss, argnums=(0,))(c, *rest)
             return c + g[0] * jnp.asarray(1e-6, c.dtype), ()
-        out, _ = jax.lax.scan(body, xw, None, length=loop)
+        out, _ = jax.lax.scan(body, xw, None, length=n)
         return out
 
-    jitted = jax.jit(chain)
-    jax.block_until_ready(jitted(*args))
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        jax.block_until_ready(jitted(*args))
-        times.append(time.perf_counter() - t0)
-    kernel_ms = min(times) / loop * 1e3  # fwd+bwd, ONE layer's shape
+    # two-point slope (see _slope_time): per-call relay latency would
+    # otherwise inflate the kernel time by ~(70-110 ms)/loop; the MXU-floor
+    # guard (3x gate-matmul FLOPs) catches contention-collapsed slopes
+    jitted = jax.jit(chain, static_argnames=("n",))
+    run = lambda n: jax.block_until_ready(jitted(*args, n=n))
+    _, kernel_s = _slope_time(run, loop, 5 * loop,
+                              flops_per_iter=3 * (2 * B * H * 4 * H * T))
+    kernel_ms = kernel_s * 1e3  # fwd+bwd, ONE layer's shape
 
     stream_ms = (6 + 12) * T * B * H * db / HBM_GBS * 1e3
     mxu_ms = 3 * (2 * B * H * 4 * H * T) / PEAK_FLOPS_PER_CHIP * 1e3
@@ -350,7 +429,7 @@ def bench_parallel_wrapper(batch=256, steps=15, compute_dtype="bfloat16"):
     # per-step FLOPs floor = the plain net's step (PW adds encode/psum on top),
     # enough for the peak-sanity gate; MFU reported against this floor.
     flops = net.train_step_flops(x, y)
-    dt, dt_min = _device_loop_time(pw, x, y, steps)
+    dt, dt_min = _device_loop_time(pw, x, y, steps, flops=flops)
     ms = dt / steps * 1e3
     return {"images_per_sec": batch * steps / dt, "ms_per_iter": ms,
             "min_ms_per_iter": dt_min / steps * 1e3,
@@ -414,7 +493,7 @@ def _write_vgg16_h5(path):
                 g.create_dataset(wn, data=arr)
 
 
-def bench_vgg16_transfer(batch=32, steps=10, num_classes=10,
+def bench_vgg16_transfer(batch=32, steps=20, num_classes=10,
                          sweep=(64, 128, 256)):
     """BASELINE config 3: Keras VGG16 import -> TransferLearning (freeze features,
     replace 1000-way head) -> train. Reports import-to-first-step time + images/sec
@@ -450,14 +529,26 @@ def bench_vgg16_transfer(batch=32, steps=10, num_classes=10,
         import_to_first_step_s = time.perf_counter() - t_import
         costs = tuned.train_step_costs(x, y)
         flops = costs["flops"] or None
-        dt, dt_min = _device_loop_time(tuned, x, y, steps)
+        dt, dt_min = _device_loop_time(tuned, x, y, steps, flops=flops,
+                                       vary_batch=True)
         ms = dt / steps * 1e3
+        try:
+            mfu = _sanity_check_peak("vgg16_transfer", flops, ms)
+        except AssertionError:
+            # small-batch VGG steps are short enough that relay-tick phase
+            # noise can corrupt one slope; remeasure once with a wider span
+            # before giving up (a second impossible number DOES raise)
+            dt, dt_min = _device_loop_time(tuned, x, y, 3 * steps,
+                                           flops=flops, vary_batch=True)
+            dt, dt_min = dt / 3, dt_min / 3
+            ms = dt / steps * 1e3
+            mfu = _sanity_check_peak("vgg16_transfer", flops, ms)
         out = {"images_per_sec": batch * steps / dt,
                "ms_per_iter": ms, "min_ms_per_iter": dt_min / steps * 1e3,
                "batch": batch,
                "import_to_first_step_s": import_to_first_step_s,
                "params": tuned.num_params(),
-               "mfu": _sanity_check_peak("vgg16_transfer", flops, ms)}
+               "mfu": mfu}
         try:
             # LB param traffic: every param at least reads its fp32 master
             # (4 B) — frozen layers have no grad/updater traffic, so 4 B/param
@@ -473,7 +564,8 @@ def bench_vgg16_transfer(batch=32, steps=10, num_classes=10,
             try:
                 xb, yb = _synth(rng, b, num_classes, 3, 224, 224)
                 fb = tuned.train_step_flops(xb, yb)
-                dtb, _ = _device_loop_time(tuned, xb, yb, max(3, steps // 2))
+                dtb, _ = _device_loop_time(tuned, xb, yb, max(3, steps // 2),
+                                           flops=fb, vary_batch=True)
                 msb = dtb / max(3, steps // 2) * 1e3
                 out[f"sweep_b{b}"] = {
                     "images_per_sec": round(b * max(3, steps // 2) / dtb, 1),
@@ -532,7 +624,7 @@ def bench_attention_longcontext(batch=4, seq_len=8192, d_model=256, heads=4,
         # fwd (the dq/dkv passes recompute p). 2 attention layers.
         attn_f = 4 * batch * heads * seq_len ** 2 * (d_model // heads) / 2
         flops += 2 * 3.5 * attn_f
-    dt, dt_min = _device_loop_time(net, x, y, steps)
+    dt, dt_min = _device_loop_time(net, x, y, steps, flops=flops)
     ms = dt / steps * 1e3
     out = {"tokens_per_sec": batch * seq_len * steps / dt,
            "ms_per_iter": ms, "min_ms_per_iter": dt_min / steps * 1e3,
@@ -644,11 +736,16 @@ def main():
         "extra": {
             "baseline_def": (
                 "round-1 fp32 batch-32 fit_on_device result (2954.4 img/s). "
-                "DISCLOSURE: that run used the pre-audit zoo ResNet50 variant "
-                "(31.7M params, head-pool stride bug) — a cheaper network "
-                "than the corrected 25.6M-param model benched since r2, so "
-                "the ratio slightly understates like-for-like progress on "
-                "fp32 and the bf16 ratio mixes dtype + model changes"),
+                "DISCLOSURE (model): that run used the pre-audit zoo ResNet50 "
+                "variant (31.7M params, head-pool stride bug) — a cheaper "
+                "network than the corrected 25.6M-param model benched since "
+                "r2. DISCLOSURE (protocol): r1-r4 numbers were stopwatch-"
+                "per-call and therefore inflated by ~(70-110 ms relay "
+                "latency)/steps per iteration (see protocol); the r5 slope "
+                "protocol removes that artifact from the numerator but the "
+                "r1 denominator cannot be re-measured (model since "
+                "corrected), so vs_baseline OVERSTATES like-for-like "
+                "progress and is a series marker, not a speedup claim"),
             "resnet50_bf16": _r(resnet_bf16),
             "resnet50_bf16_helpers_on": _r(resnet_helpers),
             "resnet50_roofline": roofline,
@@ -669,9 +766,17 @@ def main():
                                       "needs real hardware)"),
             "vgg16_transfer": _r(vgg),
             "device": str(jax.devices()[0]),
-            "protocol": ("on-device lax.scan loop, median+min of 3, compile "
-                         "excluded; mfu = XLA cost-analysis FLOPs / 197 TFLOPS "
-                         "v5e bf16 peak, peak-sanity-asserted"),
+            "protocol": ("on-device lax.scan loop timed as the two-point "
+                         "slope call(n) = fixed + n*S between n=steps and "
+                         "n=5*steps (interleaved, median+min of 4, compile "
+                         "excluded at both points) — a stopwatch around one "
+                         "call includes ~70-110 ms of tunneled-chip relay "
+                         "latency per call, which inflated every r1-r4 "
+                         "ms/iter by ~(that)/steps; host loss-readback "
+                         "deferred via fit_on_device(sync=False). mfu = XLA "
+                         "cost-analysis FLOPs / 197 TFLOPS v5e bf16 peak, "
+                         "peak-sanity-asserted on the median; min falls back "
+                         "to median when noise implies > peak"),
         },
     }))
 

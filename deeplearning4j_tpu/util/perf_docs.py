@@ -62,13 +62,13 @@ def render_block(art: dict) -> str:
         lines.append(
             f"- ResNet50 roofline (b{roof['batch']}): "
             f"{roof['flops_per_step_g']:,.0f} GFLOP/step → MXU floor "
-            f"{roof['mxu_floor_ms']:.2f} ms; unavoidable HBM traffic "
-            f"{roof['hand_lb_traffic_gb']:.1f} GB → bandwidth floor "
+            f"{roof['mxu_floor_ms']:.2f} ms; hand traffic model "
+            f"{roof['hand_lb_traffic_gb']:.1f} GB → "
             f"{roof['hand_lb_ms']:.2f} ms at 819 GB/s; measured "
             f"{roof['measured_ms']:.2f} ms = "
-            f"{roof['measured_over_hand_lb']:.2f}x the bandwidth floor and "
-            f"{roof['measured_over_mxu_floor']:.1f}x the MXU floor — "
-            f"the step is HBM-bandwidth-bound, not compute-bound.")
+            f"{roof['measured_over_hand_lb']:.2f}x the traffic model and "
+            f"{roof['measured_over_mxu_floor']:.1f}x the MXU floor. "
+            f"Verdict: {roof.get('verdict', 'n/a')}.")
     lines.append(
         f"- GravesLSTM char-RNN b{lstm['batch']}x{lstm['seq_len']}: "
         f"{lstm['tokens_per_sec'] / 1e6:.2f}M tokens/s, MFU {_pct(lstm['mfu'])}"
