@@ -585,7 +585,7 @@ def bench_vgg16_transfer(batch=32, steps=20, num_classes=10,
 
 def bench_attention_longcontext(batch=4, seq_len=8192, d_model=256, heads=4,
                                 steps=5, block_size=512,
-                                compute_dtype="bfloat16"):
+                                compute_dtype="bfloat16", window=0):
     """Flagship beyond-reference feature (VERDICT r4 next#3): long-context
     SelfAttentionLayer training on ONE chip via the blockwise online-softmax
     path (T >> block_size, so the dense (B,H,T,T) score tensor — 2 GB at
@@ -604,9 +604,9 @@ def bench_attention_longcontext(batch=4, seq_len=8192, d_model=256, heads=4,
          .updater(Sgd(learning_rate=1e-3))
          .compute_dtype(compute_dtype).list())
     b.layer(SelfAttentionLayer(n_out=d_model, n_heads=heads, causal=True,
-                               block_size=block_size))
+                               block_size=block_size, attention_window=window))
     b.layer(SelfAttentionLayer(n_out=d_model, n_heads=heads, causal=True,
-                               block_size=block_size))
+                               block_size=block_size, attention_window=window))
     b.layer(RnnOutputLayer(n_out=64, activation=Activation.SOFTMAX))
     net = MultiLayerNetwork(
         b.set_input_type(InputType.recurrent(d_model, seq_len)).build()).init()
@@ -622,14 +622,19 @@ def bench_attention_longcontext(batch=4, seq_len=8192, d_model=256, heads=4,
         # the analytic attention FLOPs (standard flash accounting): fwd =
         # 4*B*H*T^2*Dh (two matmuls, 2 FLOP/MAC), halved causal; bwd ~2.5x
         # fwd (the dq/dkv passes recompute p). 2 attention layers.
-        attn_f = 4 * batch * heads * seq_len ** 2 * (d_model // heads) / 2
+        if window:
+            # banded causal: each query sees min(window, qi+1) keys
+            pairs = sum(min(window, t + 1) for t in range(seq_len))
+        else:
+            pairs = seq_len ** 2 / 2
+        attn_f = 4 * batch * heads * pairs * (d_model // heads)
         flops += 2 * 3.5 * attn_f
     dt, dt_min = _device_loop_time(net, x, y, steps, flops=flops)
     ms = dt / steps * 1e3
     out = {"tokens_per_sec": batch * seq_len * steps / dt,
            "ms_per_iter": ms, "min_ms_per_iter": dt_min / steps * 1e3,
            "batch": batch, "seq_len": seq_len, "d_model": d_model,
-           "heads": heads, "block_size": block_size,
+           "heads": heads, "block_size": block_size, "window": window,
            "compute_dtype": compute_dtype or "float32",
            "mfu": _sanity_check_peak("attention_longcontext", flops, ms),
            "engine": ("fused flash-attention Pallas kernel "
@@ -687,6 +692,10 @@ def main():
             attn_off = bench_attention_longcontext(steps=3)
     except Exception as e:
         attn_off = {"error": f"{type(e).__name__}: {e}"}
+    try:  # sliding-window variant (beyond-reference long-context feature)
+        attn_win = bench_attention_longcontext(window=1024)
+    except Exception as e:
+        attn_win = {"error": f"{type(e).__name__}: {e}"}
     resnet_bf16 = bench_resnet50()
     try:  # experimental Pallas path must never cost us the headline record
         resnet_helpers = bench_resnet50(helpers=True)
@@ -755,6 +764,7 @@ def main():
             "lenet_roofline": lenet.get("roofline"),
             "attention_longcontext": _r(attn),
             "attention_longcontext_helpers_off": _r(attn_off),
+            "attention_longcontext_window1024": _r(attn_win),
             "graves_lstm_tokens_per_sec": round(lstm_best["tokens_per_sec"], 1),
             "graves_lstm": _r(lstm),
             "graves_lstm_helpers_on": _r(lstm_helpers),

@@ -50,6 +50,10 @@ class SelfAttentionLayer(FeedForwardLayerConf):
     n_heads: int = 4
     causal: bool = False
     block_size: int = 128
+    # sliding-window (local) attention: > 0 limits each query to the
+    # trailing `attention_window` keys (causal) or the symmetric band
+    # (non-causal) — flash_attention semantics; cost scales with T*window
+    attention_window: int = 0
 
     def set_n_in(self, input_type, override=False):
         if self.n_in == 0 or override:
@@ -101,7 +105,8 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         if ring:
             out = ring_attention(q, k, v, ctx.mesh, ctx.seq_axis,
                                  causal=self.causal, mask=mask,
-                                 batch_axis=ctx.data_axis)
+                                 batch_axis=ctx.data_axis,
+                                 window=self.attention_window)
         elif self.block_size and T > self.block_size and not seq_sharded:
             # single-device long-context path. Preferred impl: the fused
             # flash-attention Pallas kernel (ops/flash_attention.py,
@@ -120,10 +125,12 @@ class SelfAttentionLayer(FeedForwardLayerConf):
                     flash_attention)
                 # the kernel picks its own MXU-sized tiles; the layer's
                 # block_size only governs the fallback scan granularity
-                out = flash_attention(q, k, v, mask, self.causal)
+                out = flash_attention(q, k, v, mask, self.causal, None,
+                                      0, 0, self.attention_window)
             else:
                 out = blockwise_attention(q, k, v, self.block_size,
-                                          causal=self.causal, mask=mask)
+                                          causal=self.causal, mask=mask,
+                                          window=self.attention_window)
         else:
             # dense path: small T, or GSPMD CP (ctx.seq_axis sharding — the
             # einsums partition across chips with XLA inserting collectives)
@@ -131,6 +138,13 @@ class SelfAttentionLayer(FeedForwardLayerConf):
             if self.causal:
                 scores = jnp.where(jnp.tril(jnp.ones((T, T), bool)), scores,
                                    _NEG_INF)
+            if self.attention_window:
+                qi = jnp.arange(T)[:, None]
+                kj = jnp.arange(T)[None, :]
+                wm = qi - kj < self.attention_window
+                if not self.causal:
+                    wm = wm & (kj - qi < self.attention_window)
+                scores = jnp.where(wm[None, None], scores, _NEG_INF)
             if mask is not None:  # (B, T) padding mask: padded keys drop
                 scores = jnp.where(mask[:, None, None, :] > 0, scores,
                                    _NEG_INF)

@@ -28,9 +28,12 @@ recurrence on-chip (arXiv:2205.14135 / flash-attention-2 schedule):
   dkv kernels, each recomputing p) for A/B.
   D_i = rowsum(dO * o) is one cheap XLA reduction outside.
 
-Causal masking and the framework's (B, T) key-padding masks are applied
-per score tile from global row/col ids. Score/softmax math is fp32
-(flash convention); q/k/v stream in their storage dtype (bf16 on TPU).
+Causal masking, sliding-window (local) attention, and the framework's
+(B, T) key-padding masks are applied per score tile from global row/col
+ids; tiles with no valid pair (fully future, fully outside the window)
+skip the score math entirely, so windowed cost scales with T*window.
+Score/softmax math is fp32 (flash convention); q/k/v stream in their
+storage dtype (bf16 on TPU).
 
 Registered as helper "flash_attention" (default-on for TPU);
 SelfAttentionLayer's long-context path dispatches here when enabled, with
@@ -108,7 +111,7 @@ def _blocks(T: int, b: int) -> int:
 
 def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, l_ref,
                 acc_scr, m_scr, l_scr, *, causal, scale, bq, bk, T, Tp,
-                has_mask, acc_dt):
+                has_mask, acc_dt, window=0):
     from jax.experimental import pallas as pl
     j = pl.program_id(2)
     i = pl.program_id(1)
@@ -127,7 +130,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, l_ref,
                 preferred_element_type=acc_dt) * scale
             if masked:
                 valid = _valid_tile(pl, i, j, bq, bk, T, Tp, causal,
-                                    has_mask, km_ref)
+                                    has_mask, km_ref, window)
                 s = jnp.where(valid, s, NEG_INF)
             m_new = jnp.maximum(m_scr[:], jnp.max(s, axis=1))
             p = jnp.exp(s - m_new[:, None])
@@ -141,7 +144,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, l_ref,
             m_scr[:] = m_new
         return body
 
-    _dispatch_tile(pl, update, i, j, nk, bq, bk, T, Tp, causal, has_mask)
+    _dispatch_tile(pl, update, i, j, nk, bq, bk, T, Tp, causal,
+                   has_mask, window)
 
     @pl.when(j == nk - 1)
     def _():
@@ -154,10 +158,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, km_ref, o_ref, l_ref,
             l > 0, m_scr[:] + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
 
 
-def _valid_tile(pl, i, j, bq, bk, T, Tp, causal, has_mask, km_ref):
+def _valid_tile(pl, i, j, bq, bk, T, Tp, causal, has_mask, km_ref,
+                window=0):
     """(bq, bk) validity of this score tile — built ONLY for tiles that
-    need masking (the dispatcher routes interior causal tiles to the fast
-    body with none of these VPU passes)."""
+    need masking (the dispatcher routes interior tiles to the fast body
+    with none of these VPU passes). `window` > 0 limits attention to
+    qi - kj < window (causal: a trailing window ending at qi; non-causal:
+    additionally kj - qi < window, a symmetric band)."""
     qi = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     kj = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     valid = None
@@ -169,6 +176,10 @@ def _valid_tile(pl, i, j, bq, bk, T, Tp, causal, has_mask, km_ref):
         valid = _and(valid, kj < T)      # tail-block padding keys drop
     if causal:
         valid = _and(valid, qi >= kj)
+    if window:
+        valid = _and(valid, qi - kj < window)
+        if not causal:
+            valid = _and(valid, kj - qi < window)
     if has_mask:
         valid = _and(valid, (km_ref[0, 0, pl.ds(j * bk, bk)] > 0)[None, :])
     if valid is None:                     # dispatcher never does this
@@ -177,34 +188,69 @@ def _valid_tile(pl, i, j, bq, bk, T, Tp, causal, has_mask, km_ref):
 
 
 def _dispatch_tile(pl, update, i, j, nk, bq, bk, T, Tp, causal, has_mask,
-                   on_skip=None):
-    """Route this tile to the fast (unmasked) or masked body. Causal
-    interior tiles — the majority — skip every mask pass; fully-future
-    tiles skip the math entirely (the DMA still streams: rectangular
-    grid). `on_skip` runs INSTEAD of the body on those skipped tiles —
-    kernels whose per-tile output block must always be written (the fused
-    backward's dq partials) zero-fill there."""
+                   window=0, on_skip=None):
+    """Route this tile to the fast (unmasked) body, the masked body, or
+    skip it. Interior tiles — the majority at long T — take the fast body
+    with zero mask passes; tiles with NO valid pair (fully future under
+    causal, fully outside the sliding window) skip the math entirely (the
+    DMA still streams: rectangular grid). `on_skip` runs INSTEAD of the
+    body on skipped tiles — kernels whose per-tile output block must
+    always be written (the fused backward's dq partials) zero-fill there."""
+    q_lo, q_hi = i * bq, i * bq + bq - 1
+    k_lo, k_hi = j * bk, j * bk + bk - 1
+
+    # any-valid-pair conditions (tile runs at all)
+    run_conds = []
     if causal:
-        run = (j * bk) <= (i * bq + bq - 1)
-        if on_skip is not None:
-            pl.when(jnp.logical_not(run))(on_skip)
-        if has_mask:
+        run_conds.append(k_lo <= q_hi)
+    if window:
+        run_conds.append(k_hi >= q_lo - (window - 1))
+        if not causal:
+            run_conds.append(k_lo <= q_hi + (window - 1))
+    run = None
+    for c in run_conds:
+        run = c if run is None else run & c
+    if run is not None and on_skip is not None:
+        pl.when(jnp.logical_not(run))(on_skip)
+
+    if has_mask:   # key-padding mask: every running tile takes the mask
+        if run is None:
+            update(True)()
+        else:
             pl.when(run)(update(True))
-            return
-        crosses_diag = (j * bk + bk - 1) > (i * bq)
-        masked = crosses_diag if Tp == T else \
-            crosses_diag | (j == nk - 1)
+        return
+
+    # edge-crossing conditions (tile needs the masked body)
+    mask_conds = []
+    if causal:
+        mask_conds.append(k_hi > q_lo)                    # crosses diagonal
+    if window:
+        mask_conds.append(q_hi - k_lo > window - 1)       # crosses back edge
+        if not causal:
+            mask_conds.append(k_hi - q_lo > window - 1)   # crosses front edge
+    if Tp != T:
+        mask_conds.append(j == nk - 1)                    # pad-key tail block
+    masked = None
+    for c in mask_conds:
+        masked = c if masked is None else masked | c
+
+    if masked is None:
+        if run is None:
+            update(False)()
+        else:
+            pl.when(run)(update(False))
+        return
+    if run is None:
+        pl.when(masked)(update(True))
+        pl.when(jnp.logical_not(masked))(update(False))
+    else:
         pl.when(run & masked)(update(True))
         pl.when(run & jnp.logical_not(masked))(update(False))
-    elif has_mask or Tp != T:
-        update(True)()
-    else:
-        update(False)()
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, L_ref, Di_ref,
                dq_ref, dq_scr, *, causal, scale, bq, bk, T, Tp, has_mask,
-               acc_dt):
+               acc_dt, window=0):
     from jax.experimental import pallas as pl
     j = pl.program_id(2)
     i = pl.program_id(1)
@@ -222,7 +268,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, L_ref, Di_ref,
             p = jnp.exp(s - L_ref[0, 0, pl.ds(i * bq, bq)][:, None])
             if masked:
                 valid = _valid_tile(pl, i, j, bq, bk, T, Tp, causal,
-                                    has_mask, km_ref)
+                                    has_mask, km_ref, window)
                 p = jnp.where(valid, p, 0.0)
             dp = jax.lax.dot_general(
                 do_ref[0], v_ref[0], (((1,), (1,)), ((), ())),
@@ -233,7 +279,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, L_ref, Di_ref,
                 preferred_element_type=acc_dt)
         return body
 
-    _dispatch_tile(pl, update, i, j, nk, bq, bk, T, Tp, causal, has_mask)
+    _dispatch_tile(pl, update, i, j, nk, bq, bk, T, Tp, causal,
+                   has_mask, window)
 
     @pl.when(j == nk - 1)
     def _():
@@ -242,7 +289,7 @@ def _dq_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, L_ref, Di_ref,
 
 def _dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, L_ref, Di_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr, *, causal, scale, bq, bk,
-                T, Tp, has_mask, acc_dt):
+                T, Tp, has_mask, acc_dt, window=0):
     from jax.experimental import pallas as pl
     i = pl.program_id(2)        # q block index — FASTEST (the k sweep)
     j = pl.program_id(1)        # k block index
@@ -261,7 +308,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, L_ref, Di_ref,
             p = jnp.exp(s - L_ref[0, 0, pl.ds(i * bq, bq)][:, None])
             if masked:
                 valid = _valid_tile(pl, i, j, bq, bk, T, Tp, causal,
-                                    has_mask, km_ref)
+                                    has_mask, km_ref, window)
                 p = jnp.where(valid, p, 0.0)
             pl_ = p.astype(do_ref.dtype)
             dv_scr[:] += jax.lax.dot_general(
@@ -280,7 +327,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, L_ref, Di_ref,
     # note the swapped loop order: i is fastest here; the dispatcher's nk
     # (tail-k-block test) is this grid's dim 1, NOT nq
     _dispatch_tile(pl, update, i, j, pl.num_programs(1), bq, bk, T, Tp,
-                   causal, has_mask)
+                   causal, has_mask, window)
 
     @pl.when(i == nq - 1)
     def _():
@@ -290,7 +337,7 @@ def _dkv_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, L_ref, Di_ref,
 
 def _fused_bwd_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, L_ref, Di_ref,
                       dk_ref, dv_ref, dqp_ref, dk_scr, dv_scr, *, causal,
-                      scale, bq, bk, T, Tp, has_mask, acc_dt):
+                      scale, bq, bk, T, Tp, has_mask, acc_dt, window=0):
     """One-pass backward: p is computed ONCE per score tile and feeds all
     three cotangents (the two-pass schedule pays the exp/softmax VPU chain
     twice — the measured wall at these head dims, not the MXU). dk/dv
@@ -318,7 +365,7 @@ def _fused_bwd_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, L_ref, Di_ref,
             p = jnp.exp(s - L_ref[0, 0, pl.ds(i * bq, bq)][:, None])
             if masked:
                 valid = _valid_tile(pl, i, j, bq, bk, T, Tp, causal,
-                                    has_mask, km_ref)
+                                    has_mask, km_ref, window)
                 p = jnp.where(valid, p, 0.0)
             dv_scr[:] += jax.lax.dot_general(
                 p.astype(do_ref.dtype), do_ref[0], (((0,), (0,)), ((), ())),
@@ -341,7 +388,7 @@ def _fused_bwd_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, L_ref, Di_ref,
 
     # i fastest: the dispatcher's nk (tail-k-block test) is grid dim 1
     _dispatch_tile(pl, update, i, j, pl.num_programs(1), bq, bk, T, Tp,
-                   causal, has_mask, on_skip=skip)
+                   causal, has_mask, window, on_skip=skip)
 
     @pl.when(i == nq - 1)
     def _():
@@ -369,7 +416,8 @@ def _prep(q, k, v, mask, bq, bk):
     return r(q), r(k), r(v), km[:, None, :], Tp           # (BH, 1, Tp)
 
 
-def _call_fwd(qp, kp, vp, km, causal, scale, bq, bk, T, has_mask):
+def _call_fwd(qp, kp, vp, km, causal, scale, bq, bk, T, has_mask,
+              window=0):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     BH, Tp, D = qp.shape
@@ -377,7 +425,7 @@ def _call_fwd(qp, kp, vp, km, causal, scale, bq, bk, T, has_mask):
     acc_dt = jnp.promote_types(qp.dtype, jnp.float32)
     kern = functools.partial(_fwd_kernel, causal=causal, scale=scale,
                              bq=bq, bk=bk, T=T, Tp=Tp, has_mask=has_mask,
-                             acc_dt=acc_dt)
+                             acc_dt=acc_dt, window=window)
     o, L = pl.pallas_call(
         kern,
         grid=(BH, nq, nk),
@@ -405,27 +453,31 @@ def _call_fwd(qp, kp, vp, km, causal, scale, bq, bk, T, has_mask):
     return o, L
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention(q, k, v, mask=None, causal: bool = False,
                     scale: float | None = None, bq: int = DEFAULT_BQ,
-                    bk: int = DEFAULT_BK):
+                    bk: int = DEFAULT_BK, window: int = 0):
     """q/k/v: (B, H, T, D); mask: optional (B, T) key-padding mask.
     Returns (B, H, T, D). Fused online-softmax attention; see module
-    docstring."""
-    out, _ = _fa_fwd(q, k, v, mask, causal, scale, bq, bk)
+    docstring. `window` > 0 = sliding-window (local) attention: causal
+    keeps the trailing window qi-window < kj <= qi; non-causal keeps the
+    symmetric band |qi-kj| < window. Tiles fully outside the window are
+    SKIPPED (no score math), so cost scales with T*window, not T^2."""
+    out, _ = _fa_fwd(q, k, v, mask, causal, scale, bq, bk, window)
     return out
 
 
-def _fa_fwd(q, k, v, mask, causal, scale, bq, bk):
-    (out, _), res = _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk)
+def _fa_fwd(q, k, v, mask, causal, scale, bq, bk, window):
+    (out, _), res = _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk,
+                                window)
     return out, res
 
 
-def _fa_bwd(causal, scale, bq, bk, saved, dout):
-    return _fa_bwd_impl(causal, scale, bq, bk, saved, dout, None)
+def _fa_bwd(causal, scale, bq, bk, window, saved, dout):
+    return _fa_bwd_impl(causal, scale, bq, bk, saved, dout, None, window)
 
 
-def _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse):
+def _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse, window=0):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     q, k, v, mask, o, L = saved
@@ -452,7 +504,8 @@ def _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse):
         dk, dv, dqp = pl.pallas_call(
             functools.partial(_fused_bwd_kernel, causal=causal, scale=scale_,
                               bq=bq, bk=bk, T=T, Tp=Tp,
-                              has_mask=mask is not None, acc_dt=acc_dt),
+                              has_mask=mask is not None, acc_dt=acc_dt,
+                              window=window),
             grid=(BH, nk, nq),
             in_specs=[qspec2, kspec2, kspec2,
                       pl.BlockSpec((1, 1, Tp), lambda b, j, i: (b, 0, 0)),
@@ -478,7 +531,8 @@ def _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse):
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, causal=causal, scale=scale_,
                           bq=bq, bk=bk, T=T, Tp=Tp,
-                          has_mask=mask is not None, acc_dt=acc_dt),
+                          has_mask=mask is not None, acc_dt=acc_dt,
+                          window=window),
         grid=(BH, nq, nk),
         in_specs=[qspec, kspec, kspec,
                   pl.BlockSpec((1, 1, Tp), lambda b, i, j: (b, 0, 0)),
@@ -496,7 +550,8 @@ def _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse):
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, causal=causal, scale=scale_,
                           bq=bq, bk=bk, T=T, Tp=Tp,
-                          has_mask=mask is not None, acc_dt=acc_dt),
+                          has_mask=mask is not None, acc_dt=acc_dt,
+                          window=window),
         grid=(BH, nk, nq),
         in_specs=[qspec2, kspec2, kspec2,
                   pl.BlockSpec((1, 1, Tp), lambda b, j, i: (b, 0, 0)),
@@ -519,40 +574,42 @@ flash_attention.defvjp(_fa_fwd, _fa_bwd)
 register_helper("flash_attention", default_on=True)(flash_attention)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
 def flash_attention_lse(q, k, v, mask=None, causal: bool = False,
                         scale: float | None = None, bq: int = DEFAULT_BQ,
-                        bk: int = DEFAULT_BK):
+                        bk: int = DEFAULT_BK, window: int = 0):
     '''Like flash_attention but ALSO returns the per-row logsumexp
     (B, H, T) fp32 - the quantity ring/context-parallel callers need to
     merge partial attention across k/v shards: (out_a, L_a) + (out_b, L_b)
     combine via logaddexp. Differentiable in BOTH outputs.'''
-    (out, lse), _ = _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk)
+    (out, lse), _ = _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk,
+                                window)
     return out, lse
 
 
-def _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk):
+def _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk, window=0):
     B, H, T, D = q.shape
     bq, bk = _resolve_blocks(bq, bk, T)
     scale_ = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
     qp, kp, vp, km, Tp = _prep(q, k, v, mask, bq, bk)
     o, L = _call_fwd(qp, kp, vp, km, causal, scale_, bq, bk, T,
-                     mask is not None)
+                     mask is not None, window)
     out = o[:, :T].reshape(B, H, T, D)
     lse = L[:, 0, :T].reshape(B, H, T)
     return (out, lse), (q, k, v, mask, o, L)
 
 
-def _fa_lse_bwd(causal, scale, bq, bk, saved, cots):
+def _fa_lse_bwd(causal, scale, bq, bk, window, saved, cots):
     dout, dlse = cots
-    return _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse)
+    return _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse, window)
 
 
 flash_attention_lse.defvjp(_fa_lse_fwd, _fa_lse_bwd)
 
 
-def flash_attention_reference(q, k, v, mask=None, causal=False, scale=None):
-    """Dense oracle with identical mask semantics (tests)."""
+def flash_attention_reference(q, k, v, mask=None, causal=False, scale=None,
+                              window=0):
+    """Dense oracle with identical mask/window semantics (tests)."""
     D = q.shape[-1]
     scale_ = scale if scale is not None else 1.0 / np.sqrt(D)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale_
@@ -560,6 +617,13 @@ def flash_attention_reference(q, k, v, mask=None, causal=False, scale=None):
     valid = jnp.ones((1, 1, T, T), bool)
     if causal:
         valid = valid & jnp.tril(jnp.ones((T, T), bool))[None, None]
+    if window:
+        qi = jnp.arange(T)[:, None]
+        kj = jnp.arange(T)[None, :]
+        w = (qi - kj < window)
+        if not causal:
+            w = w & (kj - qi < window)
+        valid = valid & w[None, None]
     if mask is not None:
         valid = valid & (mask > 0)[:, None, None, :]
     s = jnp.where(valid, s, NEG_INF)

@@ -78,7 +78,8 @@ def _merge(acc, o, m, l):
 
 
 def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
-                        mask=None, scale: Optional[float] = None):
+                        mask=None, scale: Optional[float] = None,
+                        window: int = 0):
     """Single-device flash-attention recurrence: scan k/v in blocks of
     `block_size` with the online-softmax accumulator, so peak activation
     memory is O(T * block) instead of the dense O(T^2) score tensor
@@ -87,7 +88,10 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
 
     q/k/v: (batch, heads, T, dim); mask: optional (batch, T) key-padding mask
     (padded keys drop from every softmax). T is padded internally up to a
-    block multiple; padding keys are masked, queries stay unpadded."""
+    block multiple; padding keys are masked, queries stay unpadded.
+    `window` > 0 = sliding-window attention (same semantics as
+    ops/flash_attention.py: causal keeps the trailing window, non-causal
+    the symmetric band)."""
     B, H, T, D = q.shape
     scale_ = scale if scale is not None else 1.0 / np.sqrt(D)
     scale_ = jnp.asarray(scale_, q.dtype)  # no accidental x64 promotion
@@ -120,6 +124,11 @@ def blockwise_attention(q, k, v, block_size: int, causal: bool = False,
         m = kmb_[:, None, None, :]  # (B,1,1,blk), broadcasts in _block_attn
         if causal:
             m = m & (qi[:, None] >= ki_[None, :])[None, None]
+        if window:
+            wm = (qi[:, None] - ki_[None, :] < window)
+            if not causal:
+                wm = wm & (ki_[None, :] - qi[:, None] < window)
+            m = m & wm[None, None]
         o, mx, l = _block_attn(q, kb_, vb_, scale_, m)  # fp32 already
         return _merge(acc, o, mx, l), None
 
@@ -134,7 +143,8 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
                    causal: bool = False, scale: Optional[float] = None,
                    mask=None, batch_axis: Optional[str] = None,
                    use_flash: Optional[bool] = None,
-                   flash_bq: int = 512, flash_bk: int = 512):
+                   flash_bq: int = 512, flash_bk: int = 512,
+                   window: int = 0):
     """Attention with q/k/v sequence-sharded over `axis`; k/v ride the ring.
 
     q/k/v: (batch, heads, seq, dim) GLOBAL arrays (sharded or to-be-sharded on
@@ -152,6 +162,14 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
     ppermute still provides the ICI ring. Under causal masking the round
     where the visiting k/v block is the device's OWN block is flash-causal,
     earlier blocks are fully visible, future blocks contribute nothing.
+
+    `window` > 0 = sliding-window attention (flash_attention semantics).
+    Windowed rings use the classic masked round body — the kernel's window
+    is a static (trace-time) parameter and cannot express the TRACED ring
+    offset between a q block and its visiting k/v block — and SKIP rounds
+    whose visiting block lies fully outside the window (for window <= blk
+    that is all but 1-2 neighbors: the ring degrades gracefully into
+    neighbor-exchange local attention).
     """
     d = q.shape[-1]
     scale_ = jnp.asarray(scale if scale is not None else 1.0 / np.sqrt(d),
@@ -166,6 +184,8 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
     if use_flash is None:
         from deeplearning4j_tpu.ops.helpers import helpers_enabled_for
         use_flash = helpers_enabled_for("flash_attention")
+    if window:
+        use_flash = False  # see docstring: the ring offset is traced
 
     def _rotate(kb, vb, mb):
         """One neighbor hop of the visiting k/v (+ key-mask) blocks —
@@ -239,21 +259,46 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
         # the third ppermute) entirely.
         my = lax.axis_index(axis)
 
-        def causal_mask(kv_owner):
-            # global row ids of my q block vs col ids of the visiting k block
+        def band_mask(kv_owner):
+            # global row ids of my q block vs col ids of the visiting k
+            # block; combines the causal triangle and the sliding window
             qi = my * blk + jnp.arange(blk)
             ki = kv_owner * blk + jnp.arange(blk)
-            return (qi[:, None] >= ki[None, :])[None, None]  # (1,1,blk,blk)
+            m = None
+            if causal:
+                m = (qi[:, None] >= ki[None, :])
+            if window:
+                wm = (qi[:, None] - ki[None, :] < window)
+                if not causal:
+                    wm = wm & (ki[None, :] - qi[:, None] < window)
+                m = wm if m is None else m & wm
+            return None if m is None else m[None, None]  # (1,1,blk,blk)
 
         def round_(acc, kb, vb, mb, owner):
             m = None if mb is None else (mb > 0)[:, None, None, :]  # (b,1,1,blk)
-            if causal:
-                # blocks fully in the future are masked out entirely; since
-                # owner is traced, build the blk x blk mask every step
-                cm = causal_mask(owner)
-                m = cm if m is None else m & cm
+            if causal or window:
+                # blocks fully outside the visible band are masked out
+                # entirely; since owner is traced, build the blk x blk mask
+                # every step
+                bm = band_mask(owner)
+                m = bm if m is None else m & bm
             o, m_, l_ = _block_attn(q_blk, kb, vb, scale_, m)  # fp32 already
             return _merge(acc, o, m_, l_)
+
+        def _round_visible(owner):
+            # any valid (qi, ki) pair between my q rows and owner's keys?
+            q_lo, q_hi = my * blk, my * blk + blk - 1
+            k_lo, k_hi = owner * blk, owner * blk + blk - 1
+            pred = None
+            if causal:
+                pred = k_lo <= q_hi
+            if window:
+                c = k_hi >= q_lo - (window - 1)
+                pred = c if pred is None else pred & c
+                if not causal:
+                    c = k_lo <= q_hi + (window - 1)
+                    pred = pred & c
+            return pred
 
         @jax.checkpoint
         def step(carry, r):
@@ -261,7 +306,15 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
             # step: per-round score residuals under jax.grad are O(T^2/n)
             acc, kb, vb, mb = carry
             kb, vb, mb = _rotate(kb, vb, mb)
-            acc = round_(acc, kb, vb, mb, (my - r) % n_dev)
+            owner = (my - r) % n_dev
+            if window:
+                # skip rounds fully outside the window: zero compute for
+                # the (majority of) rounds local attention never sees
+                acc = lax.cond(_round_visible(owner),
+                               lambda a: round_(a, kb, vb, mb, owner),
+                               lambda a: a, acc)
+            else:
+                acc = round_(acc, kb, vb, mb, owner)
             return (acc, kb, vb, mb), None
 
         b, h = q_blk.shape[0], q_blk.shape[1]

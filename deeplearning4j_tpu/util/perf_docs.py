@@ -108,6 +108,12 @@ def render_block(art: dict) -> str:
                      f"({off['tokens_per_sec'] / 1e6:.2f}M)")
         if attn.get("peak_hbm_gb"):
             line += f", peak HBM {attn['peak_hbm_gb']} GB"
+        win = e.get("attention_longcontext_window1024", {})
+        if win.get("tokens_per_sec"):
+            line += (f"; sliding-window w={win.get('window', 1024)}: "
+                     f"{win['tokens_per_sec'] / 1e6:.2f}M tokens/s "
+                     f"({win['tokens_per_sec'] / attn['tokens_per_sec']:.2f}x "
+                     f"full-causal — out-of-window tiles are skipped)")
         lines.append(line + ". A dense-softmax path at this T needs the "
                      "O(T^2) score tensor (2 GB/layer + autodiff "
                      "residuals) — it OOMs; both paths here are O(T*block).")

@@ -82,6 +82,110 @@ def test_rectangular_blocks_and_auto_resolution():
             fa.configure(bwd=prev)
 
 
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("use_mask", [False, True])
+@pytest.mark.parametrize("window", [1, 5, 12, 100])
+def test_sliding_window_matches_dense_oracle(causal, use_mask, window,
+                                             bwd_mode):
+    """Sliding-window (local) attention: kernel AND lax.scan blockwise path
+    must match the dense oracle with the band mask — values and grads, fp64,
+    windows below/at/above the block size and covering the whole sequence."""
+    from deeplearning4j_tpu.parallel.sequence_parallel import (
+        blockwise_attention)
+    q, k, v, mask = _data(T=23)
+    m = mask if use_mask else None
+
+    def lf(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention(q, k, v, m, causal, None,
+                                               8, 8, window)))
+
+    def lb(q, k, v):
+        return jnp.sum(jnp.sin(blockwise_attention(
+            q, k, v, 8, causal=causal, mask=m, window=window)))
+
+    def lr(q, k, v):
+        return jnp.sum(jnp.sin(flash_attention_reference(
+            q, k, v, m, causal, None, window)))
+
+    vr, gr = jax.value_and_grad(lr, argnums=(0, 1, 2))(q, k, v)
+    for name, fn in (("flash", lf), ("blockwise", lb)):
+        vf, gf = jax.value_and_grad(fn, argnums=(0, 1, 2))(q, k, v)
+        assert abs(float(vf - vr)) < 1e-10, name
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-10, err_msg=name)
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_ring_attention_with_window_matches_oracle(causal):
+    """Windowed ring CP (classic masked body + out-of-window round
+    skipping) must match the dense banded oracle — values and grads on the
+    multi-device mesh."""
+    from jax.sharding import Mesh
+    from deeplearning4j_tpu.parallel.sequence_parallel import ring_attention
+
+    n = 4
+    mesh = Mesh(np.asarray(jax.devices()[:n]), ("seq",))
+    B, H, T, D, W = 2, 2, 4 * n, 8, 5   # window crosses block boundaries
+    rng = np.random.RandomState(4)
+    q, k, v = (jnp.asarray(rng.randn(B, H, T, D) * 0.5) for _ in range(3))
+    mask = jnp.asarray((rng.rand(B, T) > 0.3).astype(np.int64))
+
+    for m in (None, mask):
+        ring_f = lambda q, k, v: ring_attention(
+            q, k, v, mesh, causal=causal, mask=m, window=W)
+        ref_f = lambda q, k, v: flash_attention_reference(
+            q, k, v, m, causal, None, W)
+        loss = lambda fn: (lambda q, k, v: jnp.sum(jnp.sin(fn(q, k, v))))
+        vf, gf = jax.value_and_grad(loss(ring_f), argnums=(0, 1, 2))(q, k, v)
+        vr, gr = jax.value_and_grad(loss(ref_f), argnums=(0, 1, 2))(q, k, v)
+        assert abs(float(vf - vr)) < 1e-9
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       atol=1e-9)
+
+
+def test_layer_sliding_window_helpers_on_off_and_serde():
+    """SelfAttentionLayer(attention_window=...): flash (helpers on) ==
+    blockwise (helpers off) end to end through fit_batch, and the window
+    survives the config JSON round-trip."""
+    from deeplearning4j_tpu import (
+        Activation, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+        RnnOutputLayer, Sgd, WeightInit)
+    from deeplearning4j_tpu.nn.conf.configuration import (
+        MultiLayerConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.ops.helpers import helpers_enabled_ctx
+
+    def build():
+        b = (NeuralNetConfiguration.Builder().seed(5)
+             .weight_init(WeightInit.XAVIER)
+             .updater(Sgd(learning_rate=0.05)).dtype("float64").list())
+        b.layer(SelfAttentionLayer(n_out=8, n_heads=2, causal=True,
+                                   block_size=4, attention_window=6))
+        b.layer(RnnOutputLayer(n_out=3, activation=Activation.SOFTMAX))
+        return b.set_input_type(InputType.recurrent(6)).build()
+
+    conf = build()
+    rt = MultiLayerConfiguration.from_json(conf.to_json())
+    assert rt.layers[0].attention_window == 6
+
+    def run(helpers):
+        net = MultiLayerNetwork(build()).init()
+        rng = np.random.RandomState(3)
+        x = rng.rand(4, 6, 12)
+        y = np.eye(3)[rng.randint(0, 3, (4, 12))].transpose(0, 2, 1)
+        with helpers_enabled_ctx(helpers):
+            for _ in range(3):
+                net.fit_batch(x, y)
+            return float(net.score()), np.asarray(net.params())
+
+    s_off, p_off = run(False)
+    s_on, p_on = run(True)
+    assert s_on == pytest.approx(s_off, abs=1e-9)
+    np.testing.assert_allclose(p_on, p_off, atol=1e-9)
+
+
 def test_fully_masked_rows_zero_output_and_grads():
     """A batch row whose mask drops EVERY key must produce zero output and
     zero gradients, not NaNs (the L = NEG_INF guard)."""
