@@ -397,16 +397,25 @@ def _fused_bwd_kernel(q_ref, k_ref, v_ref, km_ref, do_ref, L_ref, Di_ref,
 
 
 def _prep(q, k, v, mask, bq, bk):
-    """(B, H, T, D) -> (BH, Tp, D) padded to block multiples + (BH, Tkp)
-    key mask (pad keys masked out; pad QUERY rows compute garbage that the
-    caller slices off)."""
+    """(B, H, T, D) q and (B, Hk, T, D) k/v -> (B*H, Tp, D) / (B*Hk, Tp, D)
+    padded to block multiples + (B*H, 1, Tp) key mask (pad keys masked out;
+    pad QUERY rows compute garbage that the caller slices off). Hk may
+    divide H (grouped-query attention: each group of H/Hk query heads
+    shares one k/v head — the kernels never materialize the repeat, their
+    k/v BlockSpecs map the grid's q-head index to its kv row)."""
     B, H, T, D = q.shape
+    Hk = k.shape[1]
+    if k.shape != v.shape or k.shape[0] != B or k.shape[2] != T \
+            or k.shape[3] != D or H % Hk != 0:
+        raise ValueError(
+            f"bad GQA shapes: q {q.shape}, k {k.shape}, v {v.shape} "
+            f"(need k == v, same B/T/D, and n_heads % n_kv_heads == 0)")
     Tqp = _blocks(T, bq) * bq
     Tkp = _blocks(T, bk) * bk
     Tp = max(Tqp, Tkp)
 
     def r(a):
-        a = a.reshape(B * H, T, D)
+        a = a.reshape(-1, T, D)
         return jnp.pad(a, ((0, 0), (0, Tp - T), (0, 0)))
 
     km = jnp.ones((B, T), jnp.int32) if mask is None \
@@ -416,11 +425,21 @@ def _prep(q, k, v, mask, bq, bk):
     return r(q), r(k), r(v), km[:, None, :], Tp           # (BH, 1, Tp)
 
 
+def _kv_row(H: int, Hk: int):
+    """Grid q-head index b in [0, B*H) -> its kv row in [0, B*Hk): query
+    head h = b % H belongs to kv head h // (H // Hk)."""
+    if H == Hk:
+        return lambda b: b
+    g = H // Hk
+    return lambda b: (b // H) * Hk + (b % H) // g
+
+
 def _call_fwd(qp, kp, vp, km, causal, scale, bq, bk, T, has_mask,
-              window=0):
+              window=0, H=None, Hk=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     BH, Tp, D = qp.shape
+    kv = _kv_row(H, Hk) if H else (lambda b: b)
     nq, nk = Tp // bq, Tp // bk
     acc_dt = jnp.promote_types(qp.dtype, jnp.float32)
     kern = functools.partial(_fwd_kernel, causal=causal, scale=scale,
@@ -431,8 +450,8 @@ def _call_fwd(qp, kp, vp, km, causal, scale, bq, bk, T, has_mask,
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, bq, D), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, D), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (kv(b), j, 0)),
+            pl.BlockSpec((1, bk, D), lambda b, i, j: (kv(b), j, 0)),
             pl.BlockSpec((1, 1, Tp), lambda b, i, j: (b, 0, 0)),
         ],
         out_specs=(
@@ -593,7 +612,7 @@ def _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk, window=0):
     scale_ = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
     qp, kp, vp, km, Tp = _prep(q, k, v, mask, bq, bk)
     o, L = _call_fwd(qp, kp, vp, km, causal, scale_, bq, bk, T,
-                     mask is not None, window)
+                     mask is not None, window, H, k.shape[1])
     out = o[:, :T].reshape(B, H, T, D)
     lse = L[:, 0, :T].reshape(B, H, T)
     return (out, lse), (q, k, v, mask, o, L)
