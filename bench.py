@@ -122,6 +122,14 @@ def _device_loop_time(net, x, y, steps, reps=4, flops=None,
 
     med, mn = _slope_time(run, steps, 5 * steps, reps=reps,
                           flops_per_iter=flops)
+    # sync=False stashes the divergence sentinel without resolving it; a
+    # diverged (NaN/inf) run would otherwise publish normal-looking
+    # throughput. One readback AFTER the timed runs — never inside them.
+    div = getattr(net, "_diverged_at", None)
+    if div is not None:
+        raise AssertionError(
+            f"training diverged at step {div} during the timed runs — "
+            "refusing to publish throughput for a NaN loss")
     return med * steps, mn * steps
 
 
@@ -658,6 +666,80 @@ def bench_attention_longcontext(batch=4, seq_len=8192, d_model=256, heads=4,
     return out
 
 
+def bench_decode_serving(vocab=64, d_model=256, heads=4, kv_heads=2,
+                         prefill_len=512, new_tokens=256, first_wave=4,
+                         second_wave=4, compute_dtype="bfloat16"):
+    """Autoregressive serving throughput through the KV-cache decode engine
+    (serving/engine.py): prefill T=512 prompts, decode 256 tokens each,
+    MIXED arrivals (a second wave of requests is admitted mid-stream via
+    continuous batching — iteration-level scheduling, the Orca shape).
+    Reports decode_tokens_per_sec = generated tokens / wall time of the
+    whole serve (prefills included — the number a serving operator sees).
+
+    Protocol note: unlike the training entries, per-iteration wall time
+    here INCLUDES one small host readback per decode step (the (S,) active
+    mask every continuous-batching scheduler needs to learn about
+    completions), so the stopwatch is honest — there is no deferred-sync
+    artifact to cancel with a slope. Compile is excluded by a warmup
+    request through both the prefill bucket and the decode step."""
+    import time as _time
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import (
+        Activation, InputType, NeuralNetConfiguration, RnnOutputLayer,
+        Sgd, WeightInit)
+    from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import Request, ServingEngine
+
+    b = (NeuralNetConfiguration.Builder().seed(42)
+         .weight_init(WeightInit.XAVIER)
+         .updater(Sgd(learning_rate=1e-3)).list())
+    for _ in range(2):
+        b.layer(SelfAttentionLayer(n_out=d_model, n_heads=heads,
+                                   n_kv_heads=kv_heads, causal=True,
+                                   block_size=0))
+    b.layer(RnnOutputLayer(n_out=vocab, activation=Activation.SOFTMAX))
+    net = MultiLayerNetwork(
+        b.set_input_type(InputType.recurrent(vocab)).build()).init()
+    max_seqs = first_wave + second_wave
+    max_len = 1 << (prefill_len + new_tokens - 1).bit_length()
+    eng = ServingEngine(net, max_seqs=max_seqs, max_len=max_len,
+                        dtype=jnp.dtype(compute_dtype) if compute_dtype
+                        else None, max_new_tokens_cap=new_tokens)
+    rng = np.random.RandomState(0)
+    prompt = lambda: rng.randint(0, vocab, prefill_len).tolist()
+    # warmup: compile the prefill bucket, the decode step, and admission
+    eng.generate([Request(prompt(), max_new_tokens=2)])
+    t0 = _time.perf_counter()
+    futs = [eng.submit(Request(prompt(), max_new_tokens=new_tokens))
+            for _ in range(first_wave)]
+    for _ in range(new_tokens // 2):        # first wave halfway through...
+        eng.step()
+    futs += [eng.submit(Request(prompt(), max_new_tokens=new_tokens))
+             for _ in range(second_wave)]   # ...second wave arrives
+    eng.drain()
+    wall = _time.perf_counter() - t0
+    results = [f.get(timeout=0) for f in futs]
+    total = sum(len(r.tokens) for r in results)
+    assert total == max_seqs * new_tokens, \
+        f"expected {max_seqs * new_tokens} tokens, got {total}"
+    return {"decode_tokens_per_sec": total / wall,
+            "total_tokens": total, "wall_s": wall,
+            "prefill_len": prefill_len, "new_tokens": new_tokens,
+            "requests": max_seqs, "mixed_arrivals": f"{first_wave}+"
+            f"{second_wave} (second wave admitted mid-decode)",
+            "kv_cache_gb": round(eng.decoder.cache.bytes() / 1e9, 3),
+            "model": f"2x SelfAttentionLayer(d{d_model},h{heads},"
+                     f"kv{kv_heads}) + softmax head, vocab {vocab}",
+            "compute_dtype": compute_dtype or "float32",
+            "engine": "serving/engine.py continuous batching over the "
+                      "slot-based KV cache (single-query cached decode, "
+                      "no per-token retrace)"}
+
+
 def _r(d):
     return {k: (round(v, 4 if k == "mfu" else 2) if isinstance(v, float) else v)
             for k, v in d.items()}
@@ -722,6 +804,10 @@ def main():
         vgg = bench_vgg16_transfer()
     except Exception as e:  # keep the headline robust to fixture issues
         vgg = {"error": f"{type(e).__name__}: {e}"}
+    try:  # autoregressive serving: KV-cache decode + continuous batching
+        decode = bench_decode_serving()
+    except Exception as e:
+        decode = {"error": f"{type(e).__name__}: {e}"}
     # headline takes the better of helpers on/off — both honest fit_on_device
     # protocol; entry names record which path won
     if resnet_helpers.get("images_per_sec", 0) > resnet_bf16["images_per_sec"]:
@@ -775,6 +861,9 @@ def main():
                                       "scaling number (workers=1; multi-chip "
                                       "needs real hardware)"),
             "vgg16_transfer": _r(vgg),
+            "decode_serving": _r(decode),
+            "decode_tokens_per_sec": round(
+                decode.get("decode_tokens_per_sec", 0.0), 1),
             "device": str(jax.devices()[0]),
             "protocol": ("on-device lax.scan loop timed as the two-point "
                          "slope call(n) = fixed + n*S between n=steps and "

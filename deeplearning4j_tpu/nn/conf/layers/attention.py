@@ -54,6 +54,14 @@ class SelfAttentionLayer(FeedForwardLayerConf):
     # trailing `attention_window` keys (causal) or the symmetric band
     # (non-causal) — flash_attention semantics; cost scales with T*window
     attention_window: int = 0
+    # grouped-query attention: 0 -> n_heads (plain MHA); otherwise k/v are
+    # projected to n_kv_heads heads and query head h reads kv head
+    # h // (n_heads // n_kv_heads) — the same grouping as
+    # ops/flash_attention._kv_row. Shrinks the k/v params and, above all,
+    # the serving KV cache (serving/kv_cache.py) by the group factor; the
+    # training forward broadcasts k/v back to n_heads, so every attention
+    # path (dense/blockwise/ring/flash) and its backward stay unchanged
+    n_kv_heads: int = 0
 
     def set_n_in(self, input_type, override=False):
         if self.n_in == 0 or override:
@@ -65,13 +73,21 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         return InputType.recurrent(self.n_out,
                                    getattr(input_type, "timeseries_length", -1))
 
+    @property
+    def kv_heads(self) -> int:
+        return self.n_kv_heads or self.n_heads
+
     def init_params(self, key, input_type, dtype=jnp.float32):
         if self.n_out % self.n_heads != 0:
             raise ValueError(f"n_out {self.n_out} % n_heads {self.n_heads} != 0")
+        if self.n_heads % self.kv_heads != 0:
+            raise ValueError(f"n_heads {self.n_heads} % n_kv_heads "
+                             f"{self.kv_heads} != 0")
         kq, kk, kv, ko = jax.random.split(key, 4)
-        shape = (self.n_in, self.n_out)
-        w = lambda k: self._winit(k, shape, self.n_in, self.n_out, dtype)
-        return {"w_q": w(kq), "w_k": w(kk), "w_v": w(kv),
+        kv_out = self.kv_heads * (self.n_out // self.n_heads)
+        w = lambda k, o: self._winit(k, (self.n_in, o), self.n_in, o, dtype)
+        return {"w_q": w(kq, self.n_out), "w_k": w(kk, kv_out),
+                "w_v": w(kv, kv_out),
                 "w_o": self._winit(ko, (self.n_out, self.n_out), self.n_out,
                                    self.n_out, dtype),
                 "b": jnp.full((self.n_out,), self.bias_init, dtype)}
@@ -84,10 +100,16 @@ class SelfAttentionLayer(FeedForwardLayerConf):
         Dh = self.n_out // H
         xt = jnp.swapaxes(x, 1, 2)                       # (B, T, n_in)
 
-        def heads(w):
-            return jnp.reshape(xt @ w, (B, T, H, Dh)).transpose(0, 2, 1, 3)
+        Hk = self.kv_heads
 
-        q, k, v = heads(params["w_q"]), heads(params["w_k"]), heads(params["w_v"])
+        def heads(w, h=H):
+            return jnp.reshape(xt @ w, (B, T, h, Dh)).transpose(0, 2, 1, 3)
+
+        q = heads(params["w_q"])
+        k, v = heads(params["w_k"], Hk), heads(params["w_v"], Hk)
+        if Hk != H:   # broadcast kv groups to full heads (see n_kv_heads doc)
+            k = jnp.repeat(k, H // Hk, axis=1)
+            v = jnp.repeat(v, H // Hk, axis=1)
         ctx = current_attention_context()
         seq_sharded = (ctx.mesh is not None and ctx.seq_axis is not None
                        and ctx.seq_axis in ctx.mesh.axis_names

@@ -75,16 +75,27 @@ DEFAULT_BK = 0
 _CONFIG = {"bwd": os.environ.get("DL4J_TPU_FLASH_BWD", "fused"),
            "dq_partials": os.environ.get("DL4J_TPU_FLASH_DQ_PARTIALS", "acc")}
 
+# HBM ceiling for the fused schedule's (BH, nk, Tp, D) dq-partials buffer —
+# it grows O(T^2 * D / bk), so long contexts (T=32k is ~4.3 GB fp32 at the
+# bench head count) must not pay it. Above the cap the backward silently
+# takes the two_pass schedule (O(T * block) memory, same math). The bench
+# shape T=8192 stays comfortably under the default 2 GiB.
+DQ_PARTIALS_MAX_BYTES = int(os.environ.get(
+    "DL4J_TPU_FLASH_DQP_MAX_BYTES", 2 * 1024 ** 3))
+
 
 def configure(bwd: str | None = None, dq_partials: str | None = None):
-    """Override the backward schedule ('fused' | 'two_pass') and/or the
-    fused-schedule dq-partials dtype ('acc' | 'io'); returns the previous
-    (bwd, dq_partials) pair.
+    """Override the default backward schedule ('fused' | 'two_pass') and/or
+    the fused-schedule dq-partials dtype ('acc' | 'io'); returns the
+    previous (bwd, dq_partials) pair.
 
-    NOTE: the config is read at TRACE time. A jit-compiled caller that has
-    already traced flash_attention keeps its traced schedule — call
-    configure() BEFORE the caller's first call (or clear its jit cache)
-    when A/B-ing schedules."""
+    The defaults are resolved when flash_attention() is CALLED (threaded
+    through the custom VJP as explicit non-diff arguments), so configure()
+    takes effect for every subsequent call — including the backward of a
+    forward traced after the change. A jit-compiled CALLER that already
+    baked a traced flash_attention keeps its schedule until that outer jit
+    retraces (per-call schedule can also be forced explicitly:
+    flash_attention(..., bwd='two_pass'))."""
     prev = (_CONFIG["bwd"], _CONFIG["dq_partials"])
     if bwd is not None:
         if bwd not in ("fused", "two_pass"):
@@ -472,34 +483,69 @@ def _call_fwd(qp, kp, vp, km, causal, scale, bq, bk, T, has_mask,
     return o, L
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def flash_attention(q, k, v, mask=None, causal: bool = False,
-                    scale: float | None = None, bq: int = DEFAULT_BQ,
-                    bk: int = DEFAULT_BK, window: int = 0):
-    """q/k/v: (B, H, T, D); mask: optional (B, T) key-padding mask.
-    Returns (B, H, T, D). Fused online-softmax attention; see module
-    docstring. `window` > 0 = sliding-window (local) attention: causal
-    keeps the trailing window qi-window < kj <= qi; non-causal keeps the
-    symmetric band |qi-kj| < window. Tiles fully outside the window are
-    SKIPPED (no score math), so cost scales with T*window, not T^2."""
-    out, _ = _fa_fwd(q, k, v, mask, causal, scale, bq, bk, window)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash_core(q, k, v, mask, causal, scale, bq, bk, window, bwd,
+                dq_partials):
+    """custom_vjp core with the backward schedule as explicit non-diff
+    arguments (resolved from _CONFIG by the public wrapper at CALL time, so
+    configure() is never silently ignored by an already-traced vjp)."""
+    out, _ = _fa_fwd(q, k, v, mask, causal, scale, bq, bk, window, bwd,
+                     dq_partials)
     return out
 
 
-def _fa_fwd(q, k, v, mask, causal, scale, bq, bk, window):
+def flash_attention(q, k, v, mask=None, causal: bool = False,
+                    scale: float | None = None, bq: int = DEFAULT_BQ,
+                    bk: int = DEFAULT_BK, window: int = 0,
+                    bwd: str | None = None, dq_partials: str | None = None):
+    """q/k/v: (B, H, T, D); k/v may carry Hk | H heads (grouped-query
+    attention — forward only; the grouped backward is not implemented and
+    raises). mask: optional (B, T) key-padding mask. Returns (B, H, T, D).
+    Fused online-softmax attention; see module docstring. `window` > 0 =
+    sliding-window (local) attention: causal keeps the trailing window
+    qi-window < kj <= qi; non-causal keeps the symmetric band |qi-kj| <
+    window. Tiles fully outside the window are SKIPPED (no score math), so
+    cost scales with T*window, not T^2. bwd/dq_partials: per-call backward
+    schedule override (None -> the configure() defaults, read NOW)."""
+    if bwd is None:
+        bwd = _CONFIG["bwd"]
+    if dq_partials is None:
+        dq_partials = _CONFIG["dq_partials"]
+    return _flash_core(q, k, v, mask, causal, scale, bq, bk, window, bwd,
+                       dq_partials)
+
+
+def _fa_fwd(q, k, v, mask, causal, scale, bq, bk, window, bwd, dq_partials):
     (out, _), res = _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk,
                                 window)
     return out, res
 
 
-def _fa_bwd(causal, scale, bq, bk, window, saved, dout):
-    return _fa_bwd_impl(causal, scale, bq, bk, saved, dout, None, window)
+def _fa_bwd(causal, scale, bq, bk, window, bwd, dq_partials, saved, dout):
+    return _fa_bwd_impl(causal, scale, bq, bk, saved, dout, None, window,
+                        bwd, dq_partials)
 
 
-def _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse, window=0):
+def _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse, window=0,
+                 bwd=None, dq_partials=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
+    if bwd is None:
+        bwd = _CONFIG["bwd"]
+    if dq_partials is None:
+        dq_partials = _CONFIG["dq_partials"]
     q, k, v, mask, o, L = saved
+    if k.shape[1] != q.shape[1]:
+        # the kernels below index the (B*Hk, ...) k/v buffers with the
+        # q-head grid index and would return dk/dv with the q aval —
+        # silently wrong for grouped-query attention. The grouped backward
+        # (head-group segment-sum of dk/dv partials) is not implemented;
+        # GQA TRAINING paths must broadcast k/v to full heads first (what
+        # SelfAttentionLayer does), GQA INFERENCE may use this forward.
+        raise NotImplementedError(
+            f"flash_attention backward with grouped k/v heads "
+            f"(H={q.shape[1]}, Hk={k.shape[1]}) is not implemented; "
+            "repeat k/v to the full head count before differentiating")
     B, H, T, D = q.shape
     bq, bk = _resolve_blocks(bq, bk, T)
     scale_ = float(scale) if scale is not None else 1.0 / float(np.sqrt(D))
@@ -516,8 +562,14 @@ def _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse, window=0):
         Di = Di - dl
     BH = B * H
     nq, nk = Tp // bq, Tp // bk
-    if _CONFIG["bwd"] == "fused":
-        dqp_dt = acc_dt if _CONFIG["dq_partials"] == "acc" else q.dtype
+    if bwd == "fused":
+        dqp_dt = acc_dt if dq_partials == "acc" else q.dtype
+        # the dq-partials buffer is O(T^2 * D / bk) — above the HBM cap the
+        # two_pass schedule (O(T * block) memory) takes over
+        dqp_bytes = BH * nk * Tp * D * jnp.dtype(dqp_dt).itemsize
+        if dqp_bytes > DQ_PARTIALS_MAX_BYTES:
+            bwd = "two_pass"
+    if bwd == "fused":
         qspec2 = pl.BlockSpec((1, bq, D), lambda b, j, i: (b, i, 0))
         kspec2 = pl.BlockSpec((1, bk, D), lambda b, j, i: (b, j, 0))
         dk, dv, dqp = pl.pallas_call(
@@ -589,21 +641,38 @@ def _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse, window=0):
     return shp(dq), shp(dk), shp(dv), dmask
 
 
-flash_attention.defvjp(_fa_fwd, _fa_bwd)
+_flash_core.defvjp(_fa_fwd, _fa_bwd)
 register_helper("flash_attention", default_on=True)(flash_attention)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8, 9, 10))
+def _flash_lse_core(q, k, v, mask, causal, scale, bq, bk, window, bwd,
+                    dq_partials):
+    (out, lse), _ = _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk,
+                                window)
+    return out, lse
+
+
 def flash_attention_lse(q, k, v, mask=None, causal: bool = False,
                         scale: float | None = None, bq: int = DEFAULT_BQ,
-                        bk: int = DEFAULT_BK, window: int = 0):
+                        bk: int = DEFAULT_BK, window: int = 0,
+                        bwd: str | None = None,
+                        dq_partials: str | None = None):
     '''Like flash_attention but ALSO returns the per-row logsumexp
     (B, H, T) fp32 - the quantity ring/context-parallel callers need to
     merge partial attention across k/v shards: (out_a, L_a) + (out_b, L_b)
     combine via logaddexp. Differentiable in BOTH outputs.'''
-    (out, lse), _ = _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk,
-                                window)
-    return out, lse
+    if bwd is None:
+        bwd = _CONFIG["bwd"]
+    if dq_partials is None:
+        dq_partials = _CONFIG["dq_partials"]
+    return _flash_lse_core(q, k, v, mask, causal, scale, bq, bk, window,
+                           bwd, dq_partials)
+
+
+def _fa_lse_fwd_core(q, k, v, mask, causal, scale, bq, bk, window, bwd,
+                     dq_partials):
+    return _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk, window)
 
 
 def _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk, window=0):
@@ -618,18 +687,26 @@ def _fa_lse_fwd(q, k, v, mask, causal, scale, bq, bk, window=0):
     return (out, lse), (q, k, v, mask, o, L)
 
 
-def _fa_lse_bwd(causal, scale, bq, bk, window, saved, cots):
+def _fa_lse_bwd(causal, scale, bq, bk, window, bwd, dq_partials, saved,
+                cots):
     dout, dlse = cots
-    return _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse, window)
+    return _fa_bwd_impl(causal, scale, bq, bk, saved, dout, dlse, window,
+                        bwd, dq_partials)
 
 
-flash_attention_lse.defvjp(_fa_lse_fwd, _fa_lse_bwd)
+_flash_lse_core.defvjp(_fa_lse_fwd_core, _fa_lse_bwd)
 
 
 def flash_attention_reference(q, k, v, mask=None, causal=False, scale=None,
                               window=0):
-    """Dense oracle with identical mask/window semantics (tests)."""
+    """Dense oracle with identical mask/window/GQA semantics (tests):
+    grouped k/v heads (Hk | H) broadcast to full heads with _kv_row's
+    grouping (query head h reads kv head h // (H // Hk))."""
     D = q.shape[-1]
+    H, Hk = q.shape[1], k.shape[1]
+    if Hk != H:
+        k = jnp.repeat(k, H // Hk, axis=1)
+        v = jnp.repeat(v, H // Hk, axis=1)
     scale_ = scale if scale is not None else 1.0 / np.sqrt(D)
     s = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale_
     T = q.shape[2]

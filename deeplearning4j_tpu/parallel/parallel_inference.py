@@ -25,6 +25,12 @@ class InferenceMode:
     # the shared jitted executable directly — no queue, no observable machinery,
     # no batch padding. Lowest latency; best when callers already batch.
     INPLACE = "inplace"
+    # GENERATE (beyond-reference): autoregressive token generation through the
+    # serving subsystem (KV-cache decode + continuous batching, see
+    # serving/engine.py). Requests are token-id sequences; results are
+    # serving.GenerationResult. Scheduling is iteration-level on the engine's
+    # background loop, not request-level batching.
+    GENERATE = "generate"
 
 
 class _Observable:
@@ -54,7 +60,7 @@ class _Observable:
 class ParallelInference:
     def __init__(self, model, inference_mode: str = InferenceMode.BATCHED,
                  batch_limit: int = 32, queue_limit: int = 64, workers: int = 1,
-                 mesh=None, max_wait_ms: float = 5.0):
+                 mesh=None, max_wait_ms: float = 5.0, generate_kwargs=None):
         self.model = model
         self.inference_mode = inference_mode
         self.batch_limit = int(batch_limit)
@@ -64,13 +70,25 @@ class ParallelInference:
         self._queue: "queue.Queue" = queue.Queue(maxsize=self.queue_limit)
         self._shutdown = threading.Event()
         self._worker = None
-        if inference_mode == InferenceMode.BATCHED:
+        self._engine = None
+        if inference_mode == InferenceMode.GENERATE:
+            from deeplearning4j_tpu.serving.engine import ServingEngine
+            gkw = dict(generate_kwargs or {})
+            max_seqs = gkw.pop("max_seqs", self.batch_limit)
+            max_len = gkw.pop("max_len", 2048)
+            self._engine = ServingEngine(model, max_seqs, max_len,
+                                         **gkw).start()
+        elif inference_mode == InferenceMode.BATCHED:
             self._worker = threading.Thread(target=self._batch_loop, daemon=True)
             self._worker.start()
 
     # ---------------- public API (ref ParallelInference.output) ----------------
     def output(self, x) -> np.ndarray:
-        """Synchronous single-request inference."""
+        """Synchronous single-request inference. Under GENERATE, `x` is a
+        token-id sequence (or serving.Request) and the return value is a
+        serving.GenerationResult."""
+        if self.inference_mode == InferenceMode.GENERATE:
+            return self._engine.submit(x).get()
         if self.inference_mode == InferenceMode.INPLACE:
             out = self.model.output(np.asarray(x))
             out = out[0] if isinstance(out, list) else out
@@ -81,6 +99,8 @@ class ParallelInference:
         return obs.get()
 
     def output_async(self, x) -> _Observable:
+        if self.inference_mode == InferenceMode.GENERATE:
+            return self._engine.submit(x)
         obs = _Observable()
         if self.inference_mode in (InferenceMode.SEQUENTIAL,
                                    InferenceMode.INPLACE):
@@ -92,8 +112,10 @@ class ParallelInference:
         self._queue.put((np.asarray(x), obs))
         return obs
 
-    def shutdown(self):
+    def shutdown(self, wait: bool = True):
         self._shutdown.set()
+        if self._engine is not None:
+            self._engine.shutdown(wait=wait)
 
     # ---------------- internals ----------------
     def _run(self, batch: np.ndarray):

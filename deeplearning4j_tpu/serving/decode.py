@@ -1,0 +1,311 @@
+"""Incremental (KV-cached) decode for SelfAttentionLayer transformer stacks.
+
+Beyond-reference: the attention stack recomputes all T x T scores per token
+(O(T^2) per generated token); this module makes generation O(T) per token by
+attending a SINGLE query position against the slot-based cache
+(serving/kv_cache.py).
+
+Two pieces:
+
+- `decode_attention`: the masked single-query dot-product against the cache,
+  GQA-aware without materializing the head repeat (q is reshaped to
+  (S, Hk, G, D) and contracted directly against the (S, L, Hk, D) cache —
+  query head h = hk*G + g reads kv head hk, the SAME grouping as
+  ops/flash_attention._kv_row and the layer's jnp.repeat fallback). Scores
+  and softmax run in fp32 (fp64 under x64), streams stay in the cache dtype
+  (bf16 on TPU).
+
+- `StackDecoder`: a stateful prefill-then-decode wrapper over an already
+  initialized MultiLayerNetwork / ComputationGraph whose hidden layers are
+  causal SelfAttentionLayers (plus position-wise layers). It re-derives each
+  attention layer's q/k/v from the layer's OWN params with the exact math of
+  SelfAttentionLayer.forward, so cached decode is position-for-position
+  equal to the full-recompute forward oracle (tests/test_serving.py pins
+  this in fp64). Both steps are pure functions of (params, cache state,
+  activations) with FIXED shapes — the serving engine jits them ONCE and
+  never retraces per token (prompt lengths are bucketed to powers of two;
+  padded tail writes are harmless, see kv_cache.py's visibility invariant).
+"""
+from __future__ import annotations
+
+import functools
+from typing import Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.common.enums import Activation
+from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
+from deeplearning4j_tpu.nn.conf.layers.feedforward import (
+    ActivationLayer, DropoutLayer, LossLayer)
+from deeplearning4j_tpu.nn.conf.layers.recurrent import RnnOutputLayer
+from deeplearning4j_tpu.serving import kv_cache
+
+NEG_INF = -1e30
+
+# Non-attention layers a decode step may apply one position at a time.
+# Anything else (LSTM state, normalization statistics over time, pooling)
+# is NOT position-wise and must fail loudly rather than decode garbage.
+_POSITIONWISE = (RnnOutputLayer, ActivationLayer, DropoutLayer, LossLayer)
+
+
+def decode_attention(q, kc, vc, visible, scale, window: int = 0):
+    """Single-query attention against the cache.
+
+    q: (S, H, D) current-position queries; kc/vc: (S, L, Hk, D) cache
+    (current position already appended); visible: (S,) number of visible
+    positions per slot (= position index + 1); `window` > 0 applies the
+    layer's sliding-window semantics (query at position visible-1 sees keys
+    j with (visible-1) - j < window). Returns (S, H, D) in q.dtype."""
+    S, H, D = q.shape
+    L, Hk = kc.shape[1], kc.shape[2]
+    if H % Hk != 0:
+        raise ValueError(f"n_heads {H} % n_kv_heads {Hk} != 0")
+    G = H // Hk
+    acc = jnp.promote_types(q.dtype, jnp.float32)
+    q4 = q.reshape(S, Hk, G, D)
+    s = jnp.einsum("shgd,slhd->shgl", q4.astype(acc), kc.astype(acc)) * scale
+    j = jnp.arange(L)[None, :]                       # (1, L)
+    valid = j < visible[:, None]                     # (S, L)
+    if window:
+        valid = valid & (visible[:, None] - 1 - j < window)
+    s = jnp.where(valid[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(valid[:, None, None, :], p, 0.0)   # fully-masked rows -> 0
+    out = jnp.einsum("shgl,slhd->shgd", p, vc.astype(acc))
+    return out.reshape(S, H, D).astype(q.dtype)
+
+
+def _attn_heads(layer: SelfAttentionLayer, params, xt):
+    """(.., n_in) -> q (.., H, Dh), k/v (.., Hk, Dh) with the layer's exact
+    projection math (SelfAttentionLayer.forward's `heads`)."""
+    H = layer.n_heads
+    Hk = getattr(layer, "n_kv_heads", 0) or H
+    Dh = layer.n_out // H
+
+    def proj(w, h):
+        return jnp.reshape(xt @ w, xt.shape[:-1] + (h, Dh))
+
+    return (proj(params["w_q"], H), proj(params["w_k"], Hk),
+            proj(params["w_v"], Hk))
+
+
+def _dense_causal_attention(layer, q, k, v):
+    """Prefill attention: dense causal scores over the padded prompt block
+    (B=1). q (T, H, Dh); k/v (T, Hk, Dh). Padded tail keys are masked by
+    causality alone for the valid rows, so no key-padding mask is needed
+    (see kv_cache.py's visibility invariant)."""
+    T, H, Dh = q.shape
+    Hk = k.shape[1]
+    G = H // Hk
+    if G > 1:   # same grouping as the layer's jnp.repeat fallback
+        k = jnp.repeat(k, G, axis=1)
+        v = jnp.repeat(v, G, axis=1)
+    acc = jnp.promote_types(q.dtype, jnp.float32)
+    s = jnp.einsum("qhd,khd->hqk", q.astype(acc), k.astype(acc)) \
+        / np.sqrt(Dh)
+    qi = jnp.arange(T)[:, None]
+    kj = jnp.arange(T)[None, :]
+    valid = qi >= kj
+    if layer.attention_window:
+        valid = valid & (qi - kj < layer.attention_window)
+    s = jnp.where(valid[None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("hqk,khd->qhd", p, v.astype(acc))
+    return out.astype(q.dtype)
+
+
+class StackDecoder:
+    """Prefill-then-decode wrapper for a causal SelfAttentionLayer stack.
+
+    Owns the KVCache geometry and the two jitted pure steps; the serving
+    engine composes them with token embedding and sampling. `net` may be a
+    MultiLayerNetwork or a linear-chain ComputationGraph."""
+
+    def __init__(self, net, max_seqs: int, max_len: int,
+                 dtype=None):
+        layers, params = _extract_stack(net)
+        self.layers = layers
+        self.dtype = jnp.dtype(dtype) if dtype is not None else net.dtype
+        from deeplearning4j_tpu.util.dtypes import cast_floats
+        self.params = cast_floats(params, self.dtype) \
+            if self.dtype != net.dtype else params
+
+        self.attn_idx = [i for i, l in enumerate(layers)
+                         if isinstance(l, SelfAttentionLayer)]
+        if not self.attn_idx:
+            raise ValueError("StackDecoder needs at least one "
+                             "SelfAttentionLayer in the stack")
+        shapes = set()
+        for i in self.attn_idx:
+            l = layers[i]
+            if not l.causal:
+                raise ValueError(
+                    f"layer {i} ({type(l).__name__}) is not causal — "
+                    "autoregressive decode needs causal attention")
+            Hk = getattr(l, "n_kv_heads", 0) or l.n_heads
+            shapes.add((Hk, l.n_out // l.n_heads))
+        if len(shapes) != 1:
+            raise ValueError(f"attention layers disagree on (n_kv_heads, "
+                             f"head_dim): {sorted(shapes)} — the stacked "
+                             "cache needs a uniform shape")
+        for i, l in enumerate(layers[:-1]):
+            if not isinstance(l, (SelfAttentionLayer,) + _POSITIONWISE):
+                raise NotImplementedError(
+                    f"layer {i} ({type(l).__name__}) has no incremental "
+                    "decode path (not position-wise)")
+        (self.n_kv_heads, self.head_dim), = shapes
+        self.n_in = layers[0].n_in if hasattr(layers[0], "n_in") else None
+        self.cache = kv_cache.KVCache(len(self.attn_idx), max_seqs, max_len,
+                                      self.n_kv_heads, self.head_dim,
+                                      self.dtype)
+        self._prefill_jit = jax.jit(self._prefill_fn)
+        self._decode_jit = jax.jit(self._decode_fn)
+
+    # ------------------------------------------------------------ pure fns
+    def _positionwise(self, layer, params, x):
+        """Apply a non-attention layer per position: x (..., n_feat) is fed
+        as a 1-timestep recurrent activation (B, n_feat, 1)."""
+        out, _, _ = layer.forward(params, {}, x[..., None], train=False,
+                                  rng=None, mask=None)
+        return out[..., 0]
+
+    def _head_logprobs(self, h):
+        """Log-probabilities from the final (output) layer given its input
+        activations h (S, n_feat): preout -> log_softmax, the numerically
+        exact log of the layer's softmax output."""
+        out_layer = self.layers[-1]
+        p = self.params[-1]
+        if isinstance(out_layer, RnnOutputLayer):
+            z = h @ p["W"]
+            if out_layer.has_bias:
+                z = z + p["b"]
+        elif hasattr(out_layer, "preout"):
+            z = out_layer.preout(p, h)
+        else:
+            z = self._positionwise(out_layer, p, h)
+            if out_layer.activation == Activation.SOFTMAX:
+                return jnp.log(jnp.clip(z, 1e-30, None))
+            return jax.nn.log_softmax(z, axis=-1)
+        if out_layer.activation != Activation.SOFTMAX:
+            z = out_layer._act(z)
+        return jax.nn.log_softmax(z, axis=-1)
+
+    def _prefill_fn(self, params, cache_state, x, slot, plen):
+        """Prompt pass: x (n_in, T_pad) features of ONE request; writes every
+        attention layer's k/v block into `slot`, sets lengths[slot] = plen,
+        returns (new_cache_state, (vocab,) logprobs at position plen-1).
+        Positions >= plen are padding — their k/v writes are harmless and
+        their outputs are discarded."""
+        xt = jnp.swapaxes(x, 0, 1).astype(self.dtype)       # (T_pad, n_in)
+        li = 0
+        for i, layer in enumerate(self.layers[:-1]):
+            p = params[i]
+            if isinstance(layer, SelfAttentionLayer):
+                q, k, v = _attn_heads(layer, p, xt)
+                cache_state = kv_cache.write_prefill(cache_state, li, slot,
+                                                     k, v)
+                li += 1
+                out = _dense_causal_attention(layer, q, k, v)
+                out = out.reshape(xt.shape[0], layer.n_out)
+                out = layer._act(out @ p["w_o"] + p["b"])
+                xt = out
+            else:
+                xt = self._positionwise(layer, p, xt)
+        cache_state = kv_cache.set_length(cache_state, slot, plen)
+        h_last = jax.lax.dynamic_index_in_dim(xt, plen - 1, axis=0,
+                                              keepdims=False)
+        return cache_state, self._head_logprobs(h_last[None])[0]
+
+    def _decode_fn(self, params, cache_state, x, active):
+        """One decode iteration for ALL slots: x (S, n_in) current-token
+        features, active (S,) bool. Appends each attention layer's k/v at
+        the slot's current position, attends the single query against the
+        cache, advances lengths on active slots, returns
+        (new_cache_state, (S, vocab) logprobs)."""
+        h = x.astype(self.dtype)                            # (S, n_in)
+        pos = cache_state["lengths"]                        # pre-advance
+        li = 0
+        for i, layer in enumerate(self.layers[:-1]):
+            p = params[i]
+            if isinstance(layer, SelfAttentionLayer):
+                q, k_t, v_t = _attn_heads(layer, p, h)      # (S, H/Hk, Dh)
+                cache_state = kv_cache.append_token(cache_state, li, k_t, v_t)
+                out = decode_attention(
+                    q, cache_state["k"][li], cache_state["v"][li],
+                    pos + 1, 1.0 / np.sqrt(self.head_dim),
+                    layer.attention_window)
+                li += 1
+                out = out.reshape(h.shape[0], layer.n_out)
+                h = layer._act(out @ p["w_o"] + p["b"])
+            else:
+                h = self._positionwise(layer, p, h)
+        cache_state = kv_cache.advance_lengths(cache_state, active)
+        return cache_state, self._head_logprobs(h)
+
+    # ------------------------------------------------------- stateful API
+    def prefill(self, slot: int, x) -> jnp.ndarray:
+        """Write a prompt into `slot`; returns the (vocab,) logprobs of the
+        next-token distribution. x: (n_in, T) features. T is padded up to
+        the next power of two so ragged prompts hit a bounded set of
+        compiled shapes (ParallelInference._run's bucketing)."""
+        x = jnp.asarray(x, self.dtype)
+        T = x.shape[1]
+        if T < 1 or T >= self.cache.max_len:
+            raise ValueError(f"prompt length {T} outside [1, max_len)")
+        Tp = min(self.cache.max_len, 1 << max(0, (T - 1)).bit_length())
+        if Tp != T:
+            x = jnp.pad(x, ((0, 0), (0, Tp - T)))
+        self.cache.state, logprobs = self._prefill_jit(
+            self.params, self.cache.state, x,
+            jnp.asarray(slot, jnp.int32), jnp.asarray(T, jnp.int32))
+        return logprobs
+
+    def decode_step(self, x, active) -> jnp.ndarray:
+        """One cached decode iteration over all slots; returns (S, vocab)
+        logprobs. Advances lengths on active slots."""
+        self.cache.state, logprobs = self._decode_jit(
+            self.params, self.cache.state, jnp.asarray(x, self.dtype),
+            jnp.asarray(active, bool))
+        return logprobs
+
+
+def _extract_stack(net) -> Tuple[List, List]:
+    """(layers, params_tree) for a MultiLayerNetwork or a linear-chain
+    ComputationGraph. Anything with branching/merging or preprocessors has
+    no incremental path yet — fail loudly."""
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    if isinstance(net, MultiLayerNetwork):
+        if getattr(net.conf, "preprocessors", None):
+            raise NotImplementedError(
+                "StackDecoder does not support input preprocessors")
+        if not net._initialized:
+            raise RuntimeError("Call net.init() before building a decoder")
+        return net.layers, net.params_tree
+    from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+    if isinstance(net, ComputationGraph):
+        if not net._initialized:
+            raise RuntimeError("Call net.init() before building a decoder")
+        conf = net.conf
+        order = [n for n in conf.topo_order]
+        for name in order:
+            node = conf.nodes[name]
+            if node.kind != "layer":
+                raise NotImplementedError(
+                    f"graph vertex {name!r} is not a layer — only linear "
+                    "layer chains decode incrementally")
+            if len(node.inputs) != 1 or node.preprocessor is not None:
+                raise NotImplementedError(
+                    f"graph node {name!r} is not a single-input chain link")
+        # topo order == layer_names order for a chain; params align with it
+        return net.layers, net.params_tree
+    raise TypeError(f"unsupported model type {type(net).__name__}")
+
+
+def one_hot_embedder(n_in: int, dtype=jnp.float32) -> Callable:
+    """Default token->features map: one-hot into the stack's n_in (the
+    framework's char-RNN convention). Jit-safe."""
+    def embed(tokens):
+        return jax.nn.one_hot(tokens, n_in, dtype=dtype)
+    return embed

@@ -1,0 +1,334 @@
+"""Continuous-batching autoregressive serving engine.
+
+Orca-style ITERATION-LEVEL scheduling over the slot-based KV cache
+(serving/kv_cache.py): the unit of scheduling is one decode iteration, not a
+static batch. Between iterations the engine (host side, no device sync
+needed beyond the per-iteration active-mask read) admits queued requests
+into free slots, retires finished ones, and frees their slots — so a long
+generation never holds short requests hostage and new arrivals start
+decoding on the very next iteration.
+
+Hot-loop design (why this never retraces and rarely syncs):
+- ONE jitted step function over fixed shapes (S slots, vocab V): embeds each
+  slot's last token (on-device token feedback — sampled ids never round-trip
+  through the host per token), runs StackDecoder's cached single-query
+  attention, samples under a threaded PRNG key, scatters the new token into
+  a device-side history buffer, and updates the active mask (EOS /
+  max-token tests happen ON DEVICE).
+- The host reads back only the small (S,) active mask each iteration (the
+  minimum any continuous-batching scheduler needs to learn about
+  completions) and a request's history row ONCE at completion.
+- Prefill runs per admission via StackDecoder.prefill (power-of-two length
+  buckets -> bounded trace count).
+
+Per-request controls: max_new_tokens, temperature (0 = greedy), eos_id,
+timeout_s (wall-clock, checked between iterations). Results are delivered
+through the same observable-future shape as parallel/parallel_inference.py;
+`ParallelInference(inference_mode=InferenceMode.GENERATE)` wraps this engine
+behind the existing output()/output_async() API.
+"""
+from __future__ import annotations
+
+import functools
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.serving.decode import StackDecoder, one_hot_embedder
+from deeplearning4j_tpu.serving.sampler import Sampler, sample_tokens
+
+
+@dataclass
+class Request:
+    """One generation request (token ids in, token ids out)."""
+    tokens: Sequence[int]
+    max_new_tokens: int = 64
+    temperature: float = 0.0
+    eos_id: Optional[int] = None
+    timeout_s: Optional[float] = None
+
+
+@dataclass
+class GenerationResult:
+    tokens: List[int]                 # generated ids (prompt NOT included)
+    finish_reason: str                # "length" | "eos" | "timeout" | "shutdown"
+    prompt_len: int
+    # per-generated-token (V,) logprob rows, only when the engine was built
+    # with capture_logprobs=True (parity tests); row i conditions token i
+    logprobs: Optional[List[np.ndarray]] = None
+
+
+class _Future:
+    """Observable-future result holder (same shape as
+    parallel_inference._Observable)."""
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value: Optional[GenerationResult] = None
+        self._error: Optional[BaseException] = None
+
+    def _set(self, value):
+        self._value = value
+        self._event.set()
+
+    def _set_error(self, e: BaseException):
+        self._error = e
+        self._event.set()
+
+    def get(self, timeout: Optional[float] = None) -> GenerationResult:
+        if not self._event.wait(timeout):
+            raise TimeoutError("generation result not ready")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+@dataclass
+class _Active:
+    """Host-side bookkeeping for a request occupying a slot."""
+    req: Request
+    fut: _Future
+    slot: int
+    n_generated: int                  # includes the prefill-sampled token
+    deadline: Optional[float]
+    logprobs: Optional[List[np.ndarray]] = None
+
+
+def _build_step(decoder: StackDecoder, embed: Callable, top_k: int,
+                cap: int):
+    """The single jitted decode iteration (see module docstring)."""
+
+    def step(params, cache_state, hist, last, plens, eos, maxgen, active,
+             key, temps):
+        x = embed(last)                                      # (S, n_in)
+        cache_state, lp = decoder._decode_fn(params, cache_state, x, active)
+        toks = sample_tokens(key, lp, temps, top_k)
+        gen_idx = cache_state["lengths"] - plens             # post-advance
+        gi = jnp.clip(gen_idx, 0, cap - 1)
+        s = jnp.arange(hist.shape[0])
+        hist = hist.at[s, gi].set(jnp.where(active, toks, hist[s, gi]))
+        last = jnp.where(active, toks, last)
+        new_active = active & (toks != eos) & (gen_idx + 1 < maxgen)
+        return cache_state, hist, last, new_active, lp
+
+    return jax.jit(step)
+
+
+class ServingEngine:
+    """Continuous-batching generation over a StackDecoder.
+
+    Drive it either synchronously (`generate`, or `submit` + `step` in a
+    loop — deterministic, what the tests use) or via the background thread
+    (`start`, then `submit` from any thread; `shutdown` to stop)."""
+
+    def __init__(self, net, max_seqs: int, max_len: int, *, dtype=None,
+                 seed: int = 0, top_k: int = 0,
+                 max_new_tokens_cap: int = 512,
+                 embed: Optional[Callable] = None,
+                 capture_logprobs: bool = False):
+        self.decoder = StackDecoder(net, max_seqs, max_len, dtype=dtype)
+        if embed is None:
+            if self.decoder.n_in is None:
+                raise ValueError("stack has no n_in; pass embed=")
+            embed = one_hot_embedder(self.decoder.n_in, self.decoder.dtype)
+        self.embed = embed
+        self.sampler = Sampler(seed, top_k)
+        self.capture_logprobs = bool(capture_logprobs)
+        self._cap = int(max_new_tokens_cap)
+        S = self.decoder.cache.max_seqs
+        self._step_jit = _build_step(self.decoder, embed, self.sampler.top_k,
+                                     self._cap)
+        # device-side per-slot state (fixed shapes, threaded through the jit)
+        self._hist = jnp.zeros((S, self._cap), jnp.int32)
+        self._last = jnp.zeros((S,), jnp.int32)
+        self._plens = jnp.zeros((S,), jnp.int32)
+        self._eos = jnp.full((S,), -1, jnp.int32)
+        self._maxgen = jnp.ones((S,), jnp.int32)
+        # host-side
+        self._active_mask = np.zeros((S,), bool)
+        self._temps = np.zeros((S,), np.float32)
+        self._by_slot: Dict[int, _Active] = {}
+        self._queue: List[_Active] = []
+        self._lock = threading.RLock()
+        self._work = threading.Condition(self._lock)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    # ------------------------------------------------------------- submit
+    def submit(self, request) -> _Future:
+        """Queue a request; returns a future resolving to GenerationResult."""
+        req = request if isinstance(request, Request) else Request(request)
+        plen = len(req.tokens)
+        if plen < 1 or plen >= self.decoder.cache.max_len:
+            raise ValueError(f"prompt length {plen} outside [1, max_len)")
+        if not 1 <= req.max_new_tokens <= self._cap:
+            raise ValueError(f"max_new_tokens {req.max_new_tokens} outside "
+                             f"[1, {self._cap}] (max_new_tokens_cap)")
+        if plen + req.max_new_tokens > self.decoder.cache.max_len:
+            raise ValueError(
+                f"prompt ({plen}) + max_new_tokens ({req.max_new_tokens}) "
+                f"exceeds cache max_len {self.decoder.cache.max_len}")
+        fut = _Future()
+        deadline = None if req.timeout_s is None else \
+            time.monotonic() + req.timeout_s
+        with self._work:
+            if self._stop.is_set():
+                raise RuntimeError("engine is shut down")
+            self._queue.append(_Active(req, fut, -1, 0, deadline))
+            self._work.notify()
+        return fut
+
+    # ---------------------------------------------------------- iteration
+    def _admit(self) -> None:
+        """Move queued requests into free cache slots (prefill + first
+        token). Called with the lock held."""
+        cache = self.decoder.cache
+        while self._queue and cache.n_free > 0:
+            act = self._queue.pop(0)
+            if act.deadline is not None and time.monotonic() > act.deadline:
+                act.fut._set(GenerationResult([], "timeout",
+                                              len(act.req.tokens)))
+                continue
+            slot = cache.allocate(act)
+            act.slot = slot
+            req = act.req
+            toks = np.asarray(req.tokens, np.int32)
+            feats = np.asarray(self.embed(jnp.asarray(toks))).T  # (n_in, T)
+            lp = self.decoder.prefill(slot, feats)
+            t0 = sample_tokens(self.sampler.next_key(), lp[None],
+                               jnp.full((1,), req.temperature, jnp.float32),
+                               self.sampler.top_k)[0]
+            act.n_generated = 1
+            if self.capture_logprobs:
+                act.logprobs = [np.asarray(lp)]
+            self._hist = self._hist.at[slot, 0].set(t0)
+            self._last = self._last.at[slot].set(t0)
+            self._plens = self._plens.at[slot].set(len(req.tokens))
+            self._eos = self._eos.at[slot].set(
+                -1 if req.eos_id is None else int(req.eos_id))
+            self._maxgen = self._maxgen.at[slot].set(int(req.max_new_tokens))
+            self._temps[slot] = req.temperature
+            self._active_mask[slot] = True
+            self._by_slot[slot] = act
+            # single-token request: finished at admission
+            if req.max_new_tokens == 1 or (req.eos_id is not None
+                                           and int(t0) == req.eos_id):
+                self._active_mask[slot] = False
+                self._retire(slot, "shutdown")  # reason fixed inside
+
+    def _retire(self, slot: int, default_reason: str) -> None:
+        """Resolve the request in `slot` and free it. Lock held."""
+        act = self._by_slot.pop(slot)
+        n = act.n_generated
+        row = np.asarray(self._hist[slot])[:n].tolist()
+        req = act.req
+        if req.eos_id is not None and n and row[-1] == req.eos_id:
+            reason = "eos"
+        elif n >= req.max_new_tokens:
+            reason = "length"
+        else:
+            reason = default_reason
+        lps = act.logprobs[:n] if act.logprobs is not None else None
+        self.decoder.cache.free(slot)
+        act.fut._set(GenerationResult(row, reason, len(req.tokens), lps))
+
+    def step(self) -> bool:
+        """One scheduler iteration: admit, decode one token for every active
+        slot, retire completions/timeouts. Returns True while any request is
+        active or queued."""
+        with self._lock:
+            self._admit()
+            if not self._by_slot:
+                return bool(self._queue)
+            # expire timed-out requests before spending device time on them
+            now = time.monotonic()
+            for slot, act in list(self._by_slot.items()):
+                if act.deadline is not None and now > act.deadline:
+                    self._active_mask[slot] = False
+                    self._retire(slot, "timeout")
+            if not self._by_slot:
+                return bool(self._queue)
+            active = jnp.asarray(self._active_mask)
+            (self.decoder.cache.state, self._hist, self._last, new_active,
+             lp) = self._step_jit(
+                self.decoder.params, self.decoder.cache.state, self._hist,
+                self._last, self._plens, self._eos, self._maxgen, active,
+                self.sampler.next_key(), jnp.asarray(self._temps))
+            new_np = np.asarray(new_active)        # the per-iteration sync
+            if self.capture_logprobs:
+                lp_np = np.asarray(lp)
+            for slot, act in list(self._by_slot.items()):
+                if not self._active_mask[slot]:
+                    continue
+                act.n_generated += 1
+                if self.capture_logprobs:
+                    act.logprobs.append(lp_np[slot])
+                if not new_np[slot]:
+                    self._active_mask[slot] = False
+                    self._retire(slot, "length")
+            self._active_mask &= new_np
+            return bool(self._by_slot or self._queue)
+
+    def drain(self) -> None:
+        """Run iterations until no active or queued work remains."""
+        while self.step():
+            pass
+
+    def generate(self, prompts, **kw) -> List[GenerationResult]:
+        """Synchronous convenience: submit every prompt (a Request or a
+        token-id sequence; **kw applies to bare sequences), drain, return
+        results in submission order."""
+        futs = [self.submit(p if isinstance(p, Request) else Request(p, **kw))
+                for p in prompts]
+        self.drain()
+        return [f.get(timeout=0) for f in futs]
+
+    # --------------------------------------------------- background thread
+    def start(self) -> "ServingEngine":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+        return self
+
+    def _loop(self):
+        while not self._stop.is_set():
+            with self._work:
+                while not (self._queue or self._by_slot
+                           or self._stop.is_set()):
+                    self._work.wait(timeout=0.1)
+                if self._stop.is_set():
+                    break
+            self.step()
+        # graceful drain: finish in-flight work unless told to abandon it
+        if self._drain_on_stop:
+            self.drain()
+
+    def shutdown(self, wait: bool = True) -> None:
+        """Stop the background loop. wait=True finishes in-flight requests
+        first; wait=False resolves them with finish_reason='shutdown'."""
+        self._drain_on_stop = wait
+        self._stop.set()
+        with self._work:
+            self._work.notify_all()
+        if self._thread is not None:
+            self._thread.join()
+            self._thread = None
+        with self._lock:
+            if not wait:
+                for slot in list(self._by_slot):
+                    self._active_mask[slot] = False
+                    self._retire(slot, "shutdown")
+                for act in self._queue:
+                    act.fut._set(GenerationResult([], "shutdown",
+                                                  len(act.req.tokens)))
+                self._queue.clear()
+            elif self._by_slot or self._queue:
+                self.drain()
+
+    _drain_on_stop = True

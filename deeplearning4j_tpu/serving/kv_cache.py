@@ -1,0 +1,168 @@
+"""Slot-based preallocated KV cache for autoregressive decode.
+
+Beyond-reference (the 2017 reference has no incremental-decode path at all;
+the attention stack recomputes all T x T scores per generated token). This is
+the vLLM/Orca-shaped cache the serving engine (serving/engine.py) schedules
+over: ONE preallocated pair of buffers
+
+    k, v: (n_layers, max_seqs, max_len, n_kv_heads, head_dim)
+
+plus a per-slot `lengths` vector. Every request lives in one SLOT for its
+whole lifetime (prefill writes positions [0, prompt_len); decode appends one
+position per iteration), so admission/eviction never reshapes device memory —
+the jitted prefill/decode steps see fixed shapes and NEVER retrace as
+requests come and go (the whole point: per-token XLA retracing costs more
+than the decode math).
+
+Device-side mutation is functional and jit-friendly:
+- prefill: `lax.dynamic_update_slice` of a (T_pad, Hk, D) block at
+  (layer, slot, 0) — slot is a TRACED index, so one compiled prefill serves
+  every slot;
+- decode append: a batched scatter `k.at[layer, arange(S), pos].set(k_t)` —
+  each slot writes at its OWN position (ragged lengths), one op for the
+  whole batch.
+
+Safety invariant (why padded/stale writes are harmless): a position p of
+slot s is VISIBLE to attention iff p < lengths[s], and lengths[s] only ever
+reaches p+1 in the same decode step that wrote fresh k/v at p. Prefill may
+therefore write its whole padded block and a freed slot needs no zeroing on
+reuse — stale garbage beyond `lengths` is never attended to.
+
+Host-side slot management (free list, eviction) lives in `KVCache`; the
+device arrays are a plain dict pytree (`state`) threaded through the jitted
+steps, so the engine can donate the buffers and update in place.
+
+KV-cache HBM footprint = n_layers * max_seqs * max_len * n_kv_heads *
+head_dim * 2 (k+v) * itemsize — with grouped-query attention (n_kv_heads <
+n_heads) the cache shrinks by the group factor, which is why the decode path
+is GQA-aware end to end (PERF.md note).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def init_cache_state(n_layers: int, max_seqs: int, max_len: int,
+                     n_kv_heads: int, head_dim: int,
+                     dtype=jnp.bfloat16) -> Dict[str, jnp.ndarray]:
+    """Allocate the device-side cache pytree (all-zero, all slots free)."""
+    shape = (n_layers, max_seqs, max_len, n_kv_heads, head_dim)
+    return {
+        "k": jnp.zeros(shape, dtype),
+        "v": jnp.zeros(shape, dtype),
+        # number of CACHED positions per slot; position p is visible iff
+        # p < lengths[slot]
+        "lengths": jnp.zeros((max_seqs,), jnp.int32),
+    }
+
+
+def write_prefill(state: Dict[str, jnp.ndarray], layer: int, slot,
+                  k_block: jnp.ndarray, v_block: jnp.ndarray
+                  ) -> Dict[str, jnp.ndarray]:
+    """Write one layer's prompt k/v block (T_pad, Hk, D) into `slot` at
+    positions [0, T_pad). `slot` may be a traced scalar — one compiled
+    prefill serves every slot. Padded tail positions are fine to write (see
+    module invariant); the caller sets `lengths` to the TRUE prompt length
+    via set_length()."""
+    blk = lambda b: b[None, None].astype(state["k"].dtype)
+    start = (jnp.asarray(layer, jnp.int32), jnp.asarray(slot, jnp.int32),
+             jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
+             jnp.asarray(0, jnp.int32))
+    return {**state,
+            "k": jax.lax.dynamic_update_slice(state["k"], blk(k_block), start),
+            "v": jax.lax.dynamic_update_slice(state["v"], blk(v_block), start)}
+
+
+def set_length(state: Dict[str, jnp.ndarray], slot, length
+               ) -> Dict[str, jnp.ndarray]:
+    return {**state, "lengths": state["lengths"].at[slot].set(
+        jnp.asarray(length, jnp.int32))}
+
+
+def append_token(state: Dict[str, jnp.ndarray], layer: int,
+                 k_t: jnp.ndarray, v_t: jnp.ndarray
+                 ) -> Dict[str, jnp.ndarray]:
+    """Batched one-position append for ALL slots: k_t/v_t (S, Hk, D) land at
+    each slot's current `lengths` position (ragged scatter). Does NOT bump
+    `lengths` — the decode step advances lengths ONCE after all layers wrote
+    (see advance_lengths), so every layer of one iteration writes at the
+    same position."""
+    s = jnp.arange(state["k"].shape[1])
+    pos = state["lengths"]
+    return {**state,
+            "k": state["k"].at[layer, s, pos].set(k_t.astype(state["k"].dtype)),
+            "v": state["v"].at[layer, s, pos].set(v_t.astype(state["v"].dtype))}
+
+
+def advance_lengths(state: Dict[str, jnp.ndarray], active: jnp.ndarray
+                    ) -> Dict[str, jnp.ndarray]:
+    """lengths += 1 on active slots only (inactive slots may have received
+    harmless scatter writes at their stale position — never visible)."""
+    return {**state, "lengths": state["lengths"] + active.astype(jnp.int32)}
+
+
+class KVCache:
+    """Host-side slot allocator around the device `state` pytree.
+
+    The engine owns one KVCache; the jitted steps consume/return
+    `cache.state`. Allocation and eviction are host decisions made BETWEEN
+    decode iterations (iteration-level scheduling), so they need no device
+    sync: freeing is just host bookkeeping plus a lengths[slot]=0 write."""
+
+    def __init__(self, n_layers: int, max_seqs: int, max_len: int,
+                 n_kv_heads: int, head_dim: int, dtype=jnp.bfloat16):
+        if max_seqs < 1 or max_len < 1:
+            raise ValueError(f"bad cache shape: max_seqs={max_seqs}, "
+                             f"max_len={max_len}")
+        self.n_layers = int(n_layers)
+        self.max_seqs = int(max_seqs)
+        self.max_len = int(max_len)
+        self.n_kv_heads = int(n_kv_heads)
+        self.head_dim = int(head_dim)
+        self.dtype = jnp.dtype(dtype)
+        self.state = init_cache_state(n_layers, max_seqs, max_len,
+                                      n_kv_heads, head_dim, dtype)
+        self._free: List[int] = list(range(max_seqs))
+        self._owner: Dict[int, object] = {}   # slot -> opaque request handle
+
+    # ---------------- slot management ----------------
+    def allocate(self, owner=None) -> Optional[int]:
+        """Claim a free slot (lowest id first) or None when full."""
+        if not self._free:
+            return None
+        slot = self._free.pop(0)
+        self._owner[slot] = owner
+        return slot
+
+    def free(self, slot: int) -> None:
+        """Return a slot to the free list and hide its contents
+        (lengths[slot]=0 — the buffers themselves need no zeroing, see the
+        module invariant)."""
+        if slot in self._free:
+            raise ValueError(f"slot {slot} already free")
+        self._owner.pop(slot, None)
+        self.state = set_length(self.state, slot, 0)
+        self._free.append(slot)
+        self._free.sort()
+
+    def owner(self, slot: int):
+        return self._owner.get(slot)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_active(self) -> int:
+        return self.max_seqs - len(self._free)
+
+    def active_slots(self) -> List[int]:
+        return sorted(self._owner)
+
+    def bytes(self) -> int:
+        """Device HBM held by the k/v buffers (the PERF.md formula)."""
+        return 2 * self.n_layers * self.max_seqs * self.max_len * \
+            self.n_kv_heads * self.head_dim * self.dtype.itemsize

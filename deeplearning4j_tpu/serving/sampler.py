@@ -1,0 +1,62 @@
+"""Token sampling for the serving engine: greedy / temperature / top-k.
+
+One jittable pure function (`sample_tokens`) over a BATCH of logprob rows
+with per-request temperatures, plus a tiny `Sampler` that threads a PRNG key
+functionally (`jax.random.split` per step — the framework's rng convention,
+never reusing a key).
+
+Greedy is expressed as temperature == 0 so a single compiled step serves a
+mixed batch of greedy and sampling requests (continuous batching admits both
+into the same decode iteration): the categorical draw happens for every row,
+and `jnp.where(temp > 0, draw, argmax)` selects per row. `top_k` is a STATIC
+python int (part of the jit cache key) — the engine fixes it per-engine, not
+per-request, to keep one compiled decode step.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def sample_tokens(key, logprobs, temperature, top_k: int = 0):
+    """Draw one token per row.
+
+    key: PRNG key; logprobs: (S, V) float rows (any log-space scores work —
+    normalization cancels); temperature: (S,) per-row, 0 -> greedy;
+    top_k: static int, 0/>=V -> disabled. Returns (S,) int32 tokens."""
+    logprobs = logprobs.astype(jnp.float32)
+    S, V = logprobs.shape
+    greedy = jnp.argmax(logprobs, axis=-1).astype(jnp.int32)
+    temperature = jnp.asarray(temperature, jnp.float32)
+    # guard temp=0 rows: scaled logits are never *selected* there, but must
+    # not produce NaNs that poison the whole categorical draw
+    safe_t = jnp.where(temperature > 0, temperature, 1.0)
+    scaled = logprobs / safe_t[:, None]
+    if top_k and top_k < V:
+        kth = jax.lax.top_k(scaled, top_k)[0][:, -1]        # (S,)
+        scaled = jnp.where(scaled >= kth[:, None], scaled, NEG_INF)
+    drawn = jax.random.categorical(key, scaled, axis=-1).astype(jnp.int32)
+    return jnp.where(temperature > 0, drawn, greedy)
+
+
+class Sampler:
+    """Holds the sampling config and threads the PRNG key across steps."""
+
+    def __init__(self, seed: int = 0, top_k: int = 0):
+        if top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {top_k}")
+        self.top_k = int(top_k)
+        self._key = jax.random.PRNGKey(seed)
+
+    def next_key(self):
+        """Split off a fresh per-step key (functional; never reused)."""
+        self._key, sub = jax.random.split(self._key)
+        return sub
+
+    def sample(self, logprobs, temperature):
+        return sample_tokens(self.next_key(), logprobs, temperature,
+                             self.top_k)

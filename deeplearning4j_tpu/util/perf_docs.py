@@ -117,6 +117,16 @@ def render_block(art: dict) -> str:
         lines.append(line + ". A dense-softmax path at this T needs the "
                      "O(T^2) score tensor (2 GB/layer + autodiff "
                      "residuals) — it OOMs; both paths here are O(T*block).")
+    dec = e.get("decode_serving", {})
+    if dec.get("decode_tokens_per_sec"):
+        lines.append(
+            f"- Autoregressive serving (beyond-reference): "
+            f"{dec['decode_tokens_per_sec']:,.0f} decode tokens/s — "
+            f"{dec['requests']} requests, prefill T={dec['prefill_len']}, "
+            f"{dec['new_tokens']} tokens each, mixed arrivals "
+            f"({dec.get('mixed_arrivals', 'n/a')}) through the KV-cache "
+            f"continuous-batching engine (serving/), KV cache "
+            f"{dec.get('kv_cache_gb', 0)} GB.")
     lines.append(
         f"- ParallelWrapper ResNet50: {pw['images_per_sec']:,.0f} img/s — "
         f"single-chip shard_map OVERHEAD-PARITY number (workers={pw['workers']}"

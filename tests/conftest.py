@@ -14,3 +14,11 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; long decodes (>64 tokens) and other
+    # minute-scale tests opt out of it with @pytest.mark.slow
+    config.addinivalue_line(
+        "markers", "slow: long-running test, excluded from tier-1 "
+        "(-m 'not slow')")

@@ -332,3 +332,128 @@ def test_layer_dispatch_flash_with_padding_mask():
     s_on, p_on = run(True)
     assert s_on == pytest.approx(s_off, abs=1e-9)
     np.testing.assert_allclose(p_on, p_off, atol=1e-9)
+
+# ------------------------------------------------------------------- GQA
+@pytest.mark.parametrize("causal", [False, True])
+@pytest.mark.parametrize("hk", [1, 2])
+def test_gqa_forward_matches_dense_oracle(causal, hk):
+    """Grouped-query FORWARD (k/v with Hk | H heads, never materializing
+    the repeat — the kernels' BlockSpecs map q-head rows to kv rows) must
+    match the dense oracle with the same grouping."""
+    B, H, T, D = 2, 4, 23, 8
+    q = jnp.asarray(RNG.randn(B, H, T, D) * 0.5)
+    k, v = (jnp.asarray(RNG.randn(B, hk, T, D) * 0.5) for _ in range(2))
+    mask = jnp.asarray((RNG.rand(B, T) > 0.25).astype(np.int32))
+    out = flash_attention(q, k, v, mask, causal, None, 8, 8)
+    ref = flash_attention_reference(q, k, v, mask, causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-10)
+    # explicit repeat equivalence (the grouping is _kv_row's: query head h
+    # reads kv head h // (H // Hk))
+    kr = jnp.repeat(k, H // hk, axis=1)
+    vr = jnp.repeat(v, H // hk, axis=1)
+    full = flash_attention(q, kr, vr, mask, causal, None, 8, 8)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(full), atol=1e-10)
+
+
+def test_gqa_backward_raises_not_implemented():
+    """The grouped backward is a known hole: the kernels would index the
+    (B*Hk, ...) buffers with the q-head grid index and return dk/dv with
+    the wrong aval. It must fail LOUDLY, not silently corrupt gradients."""
+    B, H, T, D = 1, 4, 16, 8
+    q = jnp.asarray(RNG.randn(B, H, T, D))
+    k, v = (jnp.asarray(RNG.randn(B, 2, T, D)) for _ in range(2))
+    with pytest.raises(NotImplementedError, match="grouped"):
+        jax.grad(lambda q: jnp.sum(flash_attention(q, k, v, None, True,
+                                                   None, 8, 8)))(q)
+
+
+def test_gqa_layer_trains_and_roundtrips():
+    """SelfAttentionLayer(n_kv_heads=...) trains (k/v broadcast to full
+    heads keeps every backward path valid), matches an equal-weight MHA
+    layer when the GQA weights are tiled, and survives config serde."""
+    from deeplearning4j_tpu import (
+        Activation, InputType, MultiLayerNetwork, NeuralNetConfiguration,
+        RnnOutputLayer, Sgd, WeightInit)
+    from deeplearning4j_tpu.nn.conf.configuration import (
+        MultiLayerConfiguration)
+    from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
+
+    def build(n_kv):
+        b = (NeuralNetConfiguration.Builder().seed(5)
+             .weight_init(WeightInit.XAVIER)
+             .updater(Sgd(learning_rate=0.05)).dtype("float64").list())
+        b.layer(SelfAttentionLayer(n_out=8, n_heads=4, n_kv_heads=n_kv,
+                                   causal=True, block_size=0))
+        b.layer(RnnOutputLayer(n_out=3, activation=Activation.SOFTMAX))
+        return b.set_input_type(InputType.recurrent(6)).build()
+
+    conf = build(2)
+    rt = MultiLayerConfiguration.from_json(conf.to_json())
+    assert rt.layers[0].n_kv_heads == 2
+
+    gqa = MultiLayerNetwork(build(2)).init()
+    assert gqa.params_tree[0]["w_k"].shape == (6, 4)   # Hk * Dh = 2 * 2
+    mha = MultiLayerNetwork(build(0)).init()
+    # tile the GQA k/v weights into the MHA net: outputs must agree exactly
+    pt = [dict(p) for p in gqa.params_tree]
+    wk = pt[0]["w_k"].reshape(6, 2, 2)                 # (n_in, Hk, Dh)
+    pt0 = dict(pt[0])
+    pt0["w_k"] = jnp.repeat(wk, 2, axis=1).reshape(6, 8)
+    pt0["w_v"] = jnp.repeat(pt[0]["w_v"].reshape(6, 2, 2), 2,
+                            axis=1).reshape(6, 8)
+    pt0["w_q"], pt0["w_o"], pt0["b"] = (pt[0]["w_q"], pt[0]["w_o"],
+                                        pt[0]["b"])
+    mha.params_tree = [pt0] + pt[1:]
+    rng = np.random.RandomState(3)
+    x = rng.rand(2, 6, 10)
+    np.testing.assert_allclose(np.asarray(gqa.output(x)),
+                               np.asarray(mha.output(x)), atol=1e-12)
+    # and it trains without error
+    y = np.eye(3)[rng.randint(0, 3, (2, 10))].transpose(0, 2, 1)
+    gqa.fit_batch(x, y)
+    assert np.isfinite(gqa.score())
+
+
+# -------------------------------------------------- schedule config plumbing
+def test_configure_takes_effect_after_first_trace():
+    """The r5 hole: _CONFIG used to be read at trace time, so configure()
+    after the first backward was silently ignored. The schedule is now
+    threaded through the custom VJP as a non-diff argument resolved at
+    call time — both schedules must produce oracle-matching grads when
+    selected AFTER a first trace of the other."""
+    from deeplearning4j_tpu.ops import flash_attention as fa
+    q, k, v, _ = _data(T=16)
+
+    def g(bwd=None):
+        return jax.grad(lambda q: jnp.sum(
+            flash_attention(q, k, v, None, True, None, 8, 8, 0,
+                            bwd)))(q)
+
+    ref = jax.grad(lambda q: jnp.sum(
+        flash_attention_reference(q, k, v, None, True)))(q)
+    prev = fa.configure(bwd="fused")
+    try:
+        np.testing.assert_allclose(np.asarray(g()), np.asarray(ref),
+                                   atol=1e-10)
+        fa.configure(bwd="two_pass")          # AFTER the fused trace
+        np.testing.assert_allclose(np.asarray(g()), np.asarray(ref),
+                                   atol=1e-10)
+        # per-call override beats the global default
+        np.testing.assert_allclose(np.asarray(g(bwd="fused")),
+                                   np.asarray(ref), atol=1e-10)
+    finally:
+        fa.configure(bwd=prev[0], dq_partials=prev[1])
+
+
+def test_fused_dq_partials_byte_cap_falls_back_to_two_pass(monkeypatch):
+    """Above DQ_PARTIALS_MAX_BYTES the fused schedule's O(T^2*D/bk)
+    partials buffer must not be allocated — the backward silently takes
+    the two_pass schedule and still matches the oracle."""
+    from deeplearning4j_tpu.ops import flash_attention as fa
+    monkeypatch.setattr(fa, "DQ_PARTIALS_MAX_BYTES", 1)   # force fallback
+    q, k, v, _ = _data(T=16)
+    gf = jax.grad(lambda q: jnp.sum(
+        flash_attention(q, k, v, None, True, None, 8, 8, 0, "fused")))(q)
+    ref = jax.grad(lambda q: jnp.sum(
+        flash_attention_reference(q, k, v, None, True)))(q)
+    np.testing.assert_allclose(np.asarray(gf), np.asarray(ref), atol=1e-10)
