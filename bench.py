@@ -668,20 +668,25 @@ def bench_attention_longcontext(batch=4, seq_len=8192, d_model=256, heads=4,
 
 def bench_decode_serving(vocab=64, d_model=256, heads=4, kv_heads=2,
                          prefill_len=512, new_tokens=256, first_wave=4,
-                         second_wave=4, compute_dtype="bfloat16"):
+                         second_wave=4, compute_dtype="bfloat16",
+                         decode_chunk=None, overlap=True):
     """Autoregressive serving throughput through the KV-cache decode engine
     (serving/engine.py): prefill T=512 prompts, decode 256 tokens each,
     MIXED arrivals (a second wave of requests is admitted mid-stream via
     continuous batching — iteration-level scheduling, the Orca shape).
     Reports decode_tokens_per_sec = generated tokens / wall time of the
-    whole serve (prefills included — the number a serving operator sees).
+    whole serve (prefills included — the number a serving operator sees),
+    plus the engine's sync counters: host_syncs_per_token ~ 1/decode_chunk
+    + one readback per admission (the chunked-decode amortization that
+    perf_docs surfaces; `decode_chunk=None` takes the engine default).
 
     Protocol note: unlike the training entries, per-iteration wall time
-    here INCLUDES one small host readback per decode step (the (S,) active
-    mask every continuous-batching scheduler needs to learn about
-    completions), so the stopwatch is honest — there is no deferred-sync
-    artifact to cancel with a slope. Compile is excluded by a warmup
-    request through both the prefill bucket and the decode step."""
+    here INCLUDES every host readback the scheduler performs (one small
+    mask bundle per decode CHUNK — the minimum a continuous-batching
+    scheduler needs to learn about completions), so the stopwatch is
+    honest — there is no deferred-sync artifact to cancel with a slope.
+    Compile is excluded by a warmup request long enough to hit the chunk
+    scan and its power-of-two tail buckets as well as the prefill bucket."""
     import time as _time
 
     import jax
@@ -708,16 +713,21 @@ def bench_decode_serving(vocab=64, d_model=256, heads=4, kv_heads=2,
     max_len = 1 << (prefill_len + new_tokens - 1).bit_length()
     eng = ServingEngine(net, max_seqs=max_seqs, max_len=max_len,
                         dtype=jnp.dtype(compute_dtype) if compute_dtype
-                        else None, max_new_tokens_cap=new_tokens)
+                        else None, max_new_tokens_cap=new_tokens,
+                        decode_chunk=decode_chunk, overlap=overlap)
     rng = np.random.RandomState(0)
     prompt = lambda: rng.randint(0, vocab, prefill_len).tolist()
-    # warmup: compile the prefill bucket, the decode step, and admission
-    eng.generate([Request(prompt(), max_new_tokens=2)])
+    # warmup: compile the prefill bucket, admission, the chunk scan, and
+    # its power-of-two tail buckets (2*K decodes as K, K/2, ..., 1)
+    eng.generate([Request(prompt(),
+                          max_new_tokens=max(2, 2 * eng.decode_chunk))])
+    eng.host_syncs = eng.tokens_out = 0     # count only the timed serve
     t0 = _time.perf_counter()
     futs = [eng.submit(Request(prompt(), max_new_tokens=new_tokens))
             for _ in range(first_wave)]
-    for _ in range(new_tokens // 2):        # first wave halfway through...
-        eng.step()
+    midpoint = first_wave * (new_tokens // 2)
+    while eng.tokens_out < midpoint and eng.step():
+        pass                                # first wave halfway through...
     futs += [eng.submit(Request(prompt(), max_new_tokens=new_tokens))
              for _ in range(second_wave)]   # ...second wave arrives
     eng.drain()
@@ -726,18 +736,26 @@ def bench_decode_serving(vocab=64, d_model=256, heads=4, kv_heads=2,
     total = sum(len(r.tokens) for r in results)
     assert total == max_seqs * new_tokens, \
         f"expected {max_seqs * new_tokens} tokens, got {total}"
+    st = eng.stats()
+    ttfts = [r.ttft_s for r in results if r.ttft_s is not None]
     return {"decode_tokens_per_sec": total / wall,
             "total_tokens": total, "wall_s": wall,
             "prefill_len": prefill_len, "new_tokens": new_tokens,
             "requests": max_seqs, "mixed_arrivals": f"{first_wave}+"
             f"{second_wave} (second wave admitted mid-decode)",
+            "decode_chunk": st["decode_chunk"],
+            "host_syncs": st["host_syncs"],
+            "host_syncs_per_token": round(st["host_syncs_per_token"], 4),
+            "mean_ttft_s": round(float(np.mean(ttfts)), 4) if ttfts
+            else None,
             "kv_cache_gb": round(eng.decoder.cache.bytes() / 1e9, 3),
             "model": f"2x SelfAttentionLayer(d{d_model},h{heads},"
                      f"kv{kv_heads}) + softmax head, vocab {vocab}",
             "compute_dtype": compute_dtype or "float32",
             "engine": "serving/engine.py continuous batching over the "
-                      "slot-based KV cache (single-query cached decode, "
-                      "no per-token retrace)"}
+                      "slot-based KV cache (chunked device-resident "
+                      "decode, overlapped scheduling, split-K cached "
+                      "attention via the helper seam on TPU)"}
 
 
 def _r(d):
@@ -808,6 +826,10 @@ def main():
         decode = bench_decode_serving()
     except Exception as e:
         decode = {"error": f"{type(e).__name__}: {e}"}
+    try:  # same-session A/B: chunking off (K=1, per-token sync) as control
+        decode_k1 = bench_decode_serving(decode_chunk=1, overlap=False)
+    except Exception as e:
+        decode_k1 = {"error": f"{type(e).__name__}: {e}"}
     # headline takes the better of helpers on/off — both honest fit_on_device
     # protocol; entry names record which path won
     if resnet_helpers.get("images_per_sec", 0) > resnet_bf16["images_per_sec"]:
@@ -862,6 +884,7 @@ def main():
                                       "needs real hardware)"),
             "vgg16_transfer": _r(vgg),
             "decode_serving": _r(decode),
+            "decode_serving_k1": _r(decode_k1),
             "decode_tokens_per_sec": round(
                 decode.get("decode_tokens_per_sec", 0.0), 1),
             "device": str(jax.devices()[0]),

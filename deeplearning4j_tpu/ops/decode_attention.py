@@ -1,0 +1,207 @@
+"""Split-K flash-decode kernel: single-query cached attention for serving.
+
+Beyond-reference (Flash-Decoding, Dao et al. 2023; SURVEY §5 serving). The
+serving engine's decode step attends ONE query per slot against that slot's
+KV-cache prefix (serving/kv_cache.py). The dense path
+(`decode_attention_dense`, the fp64 oracle and universal fallback) builds the
+full (S, H, L) score tensor and softmaxes over the whole max_len axis no
+matter how short the actual sequences are. At decode there is no query-axis
+parallelism to tile over (q is a single position), so the flash trick that
+matters is SPLIT-K: partition the cache LENGTH axis into nk chunks of bkv
+positions, compute each partition's softmax-weighted partial sum and row
+logsumexp independently (one grid cell per (slot, kv-head, partition)), and
+merge the partials outside the kernel with the SAME logaddexp algebra that
+ring attention and `flash_attention_lse` use:
+
+    out = sum_p exp(L_p - L_tot) * o_p,   L_tot = logsumexp_p L_p.
+
+Partitions entirely beyond a slot's visible length — or entirely behind its
+sliding window — are skipped inside the kernel (zero output block, L_p =
+NEG_INF, which the merge weighs to zero), so per-slot cost follows the
+slot's TRUE length, not max_len: a freshly admitted request in a mostly
+empty cache does bkv worth of score math, not max_len worth.
+
+GQA-aware without materializing the head repeat: q arrives reshaped
+(S, Hk, G, D) and each grid cell contracts its (G, D) query group against
+the (bkv, D) k/v tile of its kv head — the same grouping as
+ops/flash_attention._kv_row and serving/decode.decode_attention. Score and
+softmax math run in fp32 (fp64 under x64); k/v stream in the cache dtype
+(bf16 on TPU).
+
+Registered as helper "decode_attention" (default-on for TPU);
+serving/decode.py dispatches here through the helper seam with the dense
+path as oracle and fallback. Falls back to dense automatically when the
+cache length cannot be partitioned (L not divisible down to a >= 8 block).
+Inference-only: no custom VJP (the dense fallback is differentiable if
+anyone ever needs gradients through decode).
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.helpers import register_helper
+
+NEG_INF = -1e30
+
+# 0 = auto: 256-position partitions (A/B-able; at serving shapes the kernel
+# is HBM-bound on the k/v stream, so the block size mostly sets how much
+# work the visible-length skip can drop).
+DEFAULT_BKV = 0
+
+
+def _interpret() -> bool:
+    from deeplearning4j_tpu.ops.helpers import interpret_mode
+    return interpret_mode()
+
+
+def decode_attention_dense(q, kc, vc, visible, scale, window: int = 0):
+    """Dense single-query attention against the cache — the fp64 oracle and
+    universal fallback (bit-identical to the pre-split-K serving decode).
+
+    q: (S, H, D) current-position queries; kc/vc: (S, L, Hk, D) cache
+    (current position already appended); visible: (S,) number of visible
+    positions per slot (= position index + 1); `window` > 0 applies sliding-
+    window semantics (query at position visible-1 sees keys j with
+    (visible-1) - j < window). Returns (S, H, D) in q.dtype."""
+    S, H, D = q.shape
+    L, Hk = kc.shape[1], kc.shape[2]
+    if H % Hk != 0:
+        raise ValueError(f"n_heads {H} % n_kv_heads {Hk} != 0")
+    G = H // Hk
+    acc = jnp.promote_types(q.dtype, jnp.float32)
+    q4 = q.reshape(S, Hk, G, D)
+    s = jnp.einsum("shgd,slhd->shgl", q4.astype(acc), kc.astype(acc)) * scale
+    j = jnp.arange(L)[None, :]                       # (1, L)
+    valid = j < visible[:, None]                     # (S, L)
+    if window:
+        valid = valid & (visible[:, None] - 1 - j < window)
+    s = jnp.where(valid[:, None, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    p = jnp.where(valid[:, None, None, :], p, 0.0)   # fully-masked rows -> 0
+    out = jnp.einsum("shgl,slhd->shgd", p, vc.astype(acc))
+    return out.reshape(S, H, D).astype(q.dtype)
+
+
+def _decode_kernel(q_ref, k_ref, v_ref, m_ref, vis_ref, o_ref, l_ref, *,
+                   bkv, window, scale, acc_dt):
+    """One grid cell = (slot, kv head, length partition): partial
+    softmax-weighted sum o_p (G, D) and row logsumexp L_p (G,) over this
+    partition's bkv cache positions. Partitions with no visible position
+    (fully beyond the slot's length, or fully behind its sliding window)
+    skip the score math and emit (0, NEG_INF) — the merge weighs them to
+    zero."""
+    from jax.experimental import pallas as pl
+    j = pl.program_id(2)
+    vis = vis_ref[0, 0]                              # slot's visible length
+    lo = j * bkv
+    run = lo < vis                                   # any position visible?
+    if window:
+        run = run & (lo + bkv > vis - window)        # any inside the window?
+
+    @pl.when(run)
+    def _():
+        q = q_ref[0, 0].astype(acc_dt)               # (G, D)
+        k = k_ref[0, :, 0, :].astype(acc_dt)         # (bkv, D)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=acc_dt) * scale
+        valid = m_ref[0, :] > 0                      # (bkv,) per-position
+        s = jnp.where(valid[None, :], s, NEG_INF)
+        m = jnp.max(s, axis=1)                       # (G,)
+        p = jnp.exp(s - m[:, None])
+        p = jnp.where(valid[None, :], p, 0.0)
+        l = jnp.sum(p, axis=1)                       # (G,)
+        o = jax.lax.dot_general(p, v_ref[0, :, 0, :].astype(acc_dt),
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=acc_dt)
+        o_ref[0, 0, 0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(
+            o_ref.dtype)
+        l_ref[0, 0, 0] = jnp.where(
+            l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+
+    @pl.when(jnp.logical_not(run))
+    def _():
+        o_ref[0, 0, 0] = jnp.zeros_like(o_ref[0, 0, 0])
+        l_ref[0, 0, 0] = jnp.full_like(l_ref[0, 0, 0], NEG_INF)
+
+
+def _resolve_bkv(bkv: int, L: int) -> int:
+    """Largest feasible partition size <= the request that divides L (the
+    cache is never copied/padded — partitions must tile max_len exactly)."""
+    if not bkv:
+        bkv = 256
+    bkv = min(bkv, L)
+    while bkv > 1 and L % bkv:
+        bkv //= 2
+    return bkv
+
+
+def flash_decode_attention(q, kc, vc, visible, scale, window: int = 0,
+                           bkv: int = DEFAULT_BKV):
+    """Split-K flash-decode: same contract as `decode_attention_dense`
+    (q (S, H, D), kc/vc (S, L, Hk, D), visible (S,)), computed as nk
+    independent length partitions merged via logaddexp. Falls back to the
+    dense path when L cannot be split into >= 8-position partitions."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    S, H, D = q.shape
+    L, Hk = kc.shape[1], kc.shape[2]
+    if H % Hk != 0:
+        raise ValueError(f"n_heads {H} % n_kv_heads {Hk} != 0")
+    bkv = _resolve_bkv(bkv, L)
+    if bkv < 8 or L % bkv:
+        return decode_attention_dense(q, kc, vc, visible, scale, window)
+    nk = L // bkv
+    G = H // Hk
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+    q4 = q.reshape(S, Hk, G, D)
+    visible = jnp.asarray(visible, jnp.int32)
+    # per-position visibility (the same mask algebra as the dense path);
+    # the kernel reads one (bkv,) stripe per grid cell
+    j = jnp.arange(L)[None, :]
+    valid = j < visible[:, None]
+    if window:
+        valid = valid & (visible[:, None] - 1 - j < window)
+    valid = valid.astype(jnp.int32)                  # (S, L)
+    vis2 = visible[:, None]                          # (S, 1) SMEM scalar feed
+
+    kern = functools.partial(_decode_kernel, bkv=bkv, window=window,
+                             scale=float(scale), acc_dt=acc_dt)
+    o_p, l_p = pl.pallas_call(
+        kern,
+        grid=(S, Hk, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, D), lambda s, h, j: (s, h, 0, 0)),
+            pl.BlockSpec((1, bkv, 1, D), lambda s, h, j: (s, j, h, 0)),
+            pl.BlockSpec((1, bkv, 1, D), lambda s, h, j: (s, j, h, 0)),
+            pl.BlockSpec((1, bkv), lambda s, h, j: (s, j)),
+            pl.BlockSpec((1, 1), lambda s, h, j: (s, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, 1, G, D), lambda s, h, j: (s, h, j, 0, 0)),
+            pl.BlockSpec((1, 1, 1, G), lambda s, h, j: (s, h, j, 0)),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((S, Hk, nk, G, D), acc_dt),
+            jax.ShapeDtypeStruct((S, Hk, nk, G), acc_dt),
+        ),
+        interpret=_interpret(),
+    )(q4, kc, vc, valid, vis2)
+
+    # logaddexp merge across partitions (the flash_attention_lse algebra):
+    # out = sum_p exp(L_p - L_tot) * o_p. Skipped partitions carry
+    # L_p = NEG_INF -> weight 0; a fully-masked row (cannot happen for
+    # visible >= 1, but kept safe) gets denom >= 1 and o_p = 0 -> output 0,
+    # matching the dense path's zeroed fully-masked rows.
+    m = jnp.max(l_p, axis=2, keepdims=True)          # (S, Hk, 1, G)
+    w = jnp.exp(l_p - jnp.maximum(m, NEG_INF))       # (S, Hk, nk, G)
+    denom = jnp.maximum(jnp.sum(w, axis=2), 1e-30)   # (S, Hk, G)
+    out = jnp.einsum("shkg,shkgd->shgd", w, o_p) / denom[..., None]
+    return out.reshape(S, H, D).astype(q.dtype)
+
+
+register_helper("decode_attention", default_on=True)(flash_decode_attention)

@@ -72,6 +72,9 @@ class ParallelInference:
         self._worker = None
         self._engine = None
         if inference_mode == InferenceMode.GENERATE:
+            # generate_kwargs pass straight through to ServingEngine —
+            # including decode_chunk (micro-steps per host sync) and
+            # overlap; results carry ttft_s / tokens_per_sec
             from deeplearning4j_tpu.serving.engine import ServingEngine
             gkw = dict(generate_kwargs or {})
             max_seqs = gkw.pop("max_seqs", self.batch_limit)
@@ -111,6 +114,13 @@ class ParallelInference:
             return obs
         self._queue.put((np.asarray(x), obs))
         return obs
+
+    def generation_stats(self):
+        """GENERATE mode only: the engine's lifetime perf counters
+        (host_syncs, tokens_out, decode_chunk, host_syncs_per_token)."""
+        if self._engine is None:
+            raise RuntimeError("generation_stats requires GENERATE mode")
+        return self._engine.stats()
 
     def shutdown(self, wait: bool = True):
         self._shutdown.set()

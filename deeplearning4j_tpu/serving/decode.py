@@ -13,7 +13,10 @@ Two pieces:
   query head h = hk*G + g reads kv head hk, the SAME grouping as
   ops/flash_attention._kv_row and the layer's jnp.repeat fallback). Scores
   and softmax run in fp32 (fp64 under x64), streams stay in the cache dtype
-  (bf16 on TPU).
+  (bf16 on TPU). Dispatches through the helper seam to the split-K
+  flash-decode Pallas kernel (ops/decode_attention.py, default-on for TPU)
+  which partitions the cache length axis and merges partials via logaddexp;
+  the dense einsum path here is the fp64 oracle and universal fallback.
 
 - `StackDecoder`: a stateful prefill-then-decode wrapper over an already
   initialized MultiLayerNetwork / ComputationGraph whose hidden layers are
@@ -40,6 +43,8 @@ from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
 from deeplearning4j_tpu.nn.conf.layers.feedforward import (
     ActivationLayer, DropoutLayer, LossLayer)
 from deeplearning4j_tpu.nn.conf.layers.recurrent import RnnOutputLayer
+from deeplearning4j_tpu.ops.decode_attention import decode_attention_dense
+from deeplearning4j_tpu.ops.helpers import helper_for
 from deeplearning4j_tpu.serving import kv_cache
 
 NEG_INF = -1e30
@@ -57,24 +62,14 @@ def decode_attention(q, kc, vc, visible, scale, window: int = 0):
     (current position already appended); visible: (S,) number of visible
     positions per slot (= position index + 1); `window` > 0 applies the
     layer's sliding-window semantics (query at position visible-1 sees keys
-    j with (visible-1) - j < window). Returns (S, H, D) in q.dtype."""
-    S, H, D = q.shape
-    L, Hk = kc.shape[1], kc.shape[2]
-    if H % Hk != 0:
-        raise ValueError(f"n_heads {H} % n_kv_heads {Hk} != 0")
-    G = H // Hk
-    acc = jnp.promote_types(q.dtype, jnp.float32)
-    q4 = q.reshape(S, Hk, G, D)
-    s = jnp.einsum("shgd,slhd->shgl", q4.astype(acc), kc.astype(acc)) * scale
-    j = jnp.arange(L)[None, :]                       # (1, L)
-    valid = j < visible[:, None]                     # (S, L)
-    if window:
-        valid = valid & (visible[:, None] - 1 - j < window)
-    s = jnp.where(valid[:, None, None, :], s, NEG_INF)
-    p = jax.nn.softmax(s, axis=-1)
-    p = jnp.where(valid[:, None, None, :], p, 0.0)   # fully-masked rows -> 0
-    out = jnp.einsum("shgl,slhd->shgd", p, vc.astype(acc))
-    return out.reshape(S, H, D).astype(q.dtype)
+    j with (visible-1) - j < window). Returns (S, H, D) in q.dtype.
+
+    Resolved through the helper seam at trace time: the split-K
+    flash-decode Pallas kernel (ops/decode_attention.flash_decode_attention,
+    default-on for TPU) when enabled, else the dense einsum oracle
+    (ops/decode_attention.decode_attention_dense)."""
+    fn = helper_for("decode_attention", decode_attention_dense)
+    return fn(q, kc, vc, visible, scale, window)
 
 
 def _attn_heads(layer: SelfAttentionLayer, params, xt):

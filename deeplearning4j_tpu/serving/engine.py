@@ -2,11 +2,10 @@
 
 Orca-style ITERATION-LEVEL scheduling over the slot-based KV cache
 (serving/kv_cache.py): the unit of scheduling is one decode iteration, not a
-static batch. Between iterations the engine (host side, no device sync
-needed beyond the per-iteration active-mask read) admits queued requests
-into free slots, retires finished ones, and frees their slots — so a long
-generation never holds short requests hostage and new arrivals start
-decoding on the very next iteration.
+static batch. Between iterations the engine (host side) admits queued
+requests into free slots, retires finished ones, and frees their slots — so
+a long generation never holds short requests hostage and new arrivals start
+decoding on the very next scheduling opportunity.
 
 Hot-loop design (why this never retraces and rarely syncs):
 - ONE jitted step function over fixed shapes (S slots, vocab V): embeds each
@@ -15,21 +14,49 @@ Hot-loop design (why this never retraces and rarely syncs):
   attention, samples under a threaded PRNG key, scatters the new token into
   a device-side history buffer, and updates the active mask (EOS /
   max-token tests happen ON DEVICE).
-- The host reads back only the small (S,) active mask each iteration (the
-  minimum any continuous-batching scheduler needs to learn about
-  completions) and a request's history row ONCE at completion.
+- CHUNKED decode (Orca needs a sync per scheduling OPPORTUNITY, not per
+  token): `decode_chunk` = K micro-steps run as one `lax.scan` inside one
+  dispatch, so the host reads back one small mask bundle per K tokens
+  instead of per token — syncs/token = 1/K. Finished slots ride out at most
+  K-1 masked micro-steps (their cache/history writes are invisible under
+  the lengths-visibility invariant). K adapts DOWN: to 1 whenever the
+  admission queue is non-empty (time-to-first-token stays bounded by one
+  iteration, the Orca property), and to a power-of-two bucket of the
+  largest remaining token budget (bounded trace count, no over-run waste
+  at the tail). K=1 takes the original single-step function — bit-for-bit
+  the pre-chunking behavior.
+- Sampler keys are threaded so chunking never changes tokens: the host
+  PEEKS K subkeys from the PRNG chain for a chunk (micro-step i uses
+  exactly the key the i-th sequential step would have), then COMMITS only
+  the number of micro-steps that ran with any active slot — so K in
+  {2,4,8} is token-for-token identical to K=1 even when EOS lands
+  mid-chunk (sampler.Sampler.peek_keys/advance).
+- OVERLAPPED scheduling (`overlap=True`, the drain/background path):
+  chunk i+1 is dispatched BEFORE chunk i's masks are materialized — the
+  device-side active mask threads chunk-to-chunk without a host round-trip
+  (JAX async dispatch), and the host consumes a one-chunk-stale mask for
+  bookkeeping. Stale scheduling is safe: a finished slot decodes at most
+  one extra chunk with active=False (all writes invisible), and host
+  events (admissions, timeouts) patch the device mask functionally.
+  Overlap consumes keys unconditionally (no rewind — the strict cross-K
+  key schedule is a synchronous-step guarantee), so it is used only when
+  token-level capture is off.
 - Prefill runs per admission via StackDecoder.prefill (power-of-two length
   buckets -> bounded trace count).
 
 Per-request controls: max_new_tokens, temperature (0 = greedy), eos_id,
-timeout_s (wall-clock, checked between iterations). Results are delivered
-through the same observable-future shape as parallel/parallel_inference.py;
+timeout_s (wall-clock, checked between iterations). Results carry cheap
+host-timestamp stats (ttft_s, tokens_per_sec) and are delivered through the
+same observable-future shape as parallel/parallel_inference.py;
 `ParallelInference(inference_mode=InferenceMode.GENERATE)` wraps this engine
-behind the existing output()/output_async() API.
+behind the existing output()/output_async() API. Engine-wide counters
+(`stats()`): host_syncs, tokens_out — bench.py publishes
+host_syncs_per_token from their ratio.
 """
 from __future__ import annotations
 
 import functools
+import os
 import threading
 import time
 from dataclasses import dataclass, field
@@ -61,6 +88,10 @@ class GenerationResult:
     # per-generated-token (V,) logprob rows, only when the engine was built
     # with capture_logprobs=True (parity tests); row i conditions token i
     logprobs: Optional[List[np.ndarray]] = None
+    # cheap host-timestamp stats (no extra device syncs): submit -> first
+    # token, and generated tokens (after the first) / decode span
+    ttft_s: Optional[float] = None
+    tokens_per_sec: Optional[float] = None
 
 
 class _Future:
@@ -97,11 +128,14 @@ class _Active:
     n_generated: int                  # includes the prefill-sampled token
     deadline: Optional[float]
     logprobs: Optional[List[np.ndarray]] = None
+    t_submit: float = 0.0
+    t_first: float = 0.0              # first token materialized (admission)
 
 
 def _build_step(decoder: StackDecoder, embed: Callable, top_k: int,
                 cap: int):
-    """The single jitted decode iteration (see module docstring)."""
+    """The single jitted decode iteration (the K=1 path — kept verbatim so
+    decode_chunk=1 preserves the pre-chunking behavior bit-for-bit)."""
 
     def step(params, cache_state, hist, last, plens, eos, maxgen, active,
              key, temps):
@@ -119,18 +153,61 @@ def _build_step(decoder: StackDecoder, embed: Callable, top_k: int,
     return jax.jit(step)
 
 
+def _build_chunk(decoder: StackDecoder, embed: Callable, top_k: int,
+                 cap: int):
+    """K micro-steps as ONE dispatch: `lax.scan` over a (K, ...) stack of
+    per-micro-step PRNG keys. Each micro-step is exactly the K=1 step body;
+    the scan additionally stacks each micro-step's ENTRY active mask (the
+    host learns per-slot token counts and the effective step count from one
+    (K, S) readback) and the (K, S, V) logprob rows (materialized only under
+    capture_logprobs). Finished slots run masked: their sampled tokens are
+    discarded by the same `where(active, ...)` writes as the K=1 path, and
+    their cache appends land at a stale, never-visible position."""
+
+    def chunk(params, cache_state, hist, last, plens, eos, maxgen, active,
+              keys, temps):
+        def micro(carry, key):
+            cache_state, hist, last, active = carry
+            x = embed(last)                                  # (S, n_in)
+            cache_state, lp = decoder._decode_fn(params, cache_state, x,
+                                                 active)
+            toks = sample_tokens(key, lp, temps, top_k)
+            gen_idx = cache_state["lengths"] - plens         # post-advance
+            gi = jnp.clip(gen_idx, 0, cap - 1)
+            s = jnp.arange(hist.shape[0])
+            hist = hist.at[s, gi].set(jnp.where(active, toks, hist[s, gi]))
+            new_last = jnp.where(active, toks, last)
+            new_active = active & (toks != eos) & (gen_idx + 1 < maxgen)
+            return (cache_state, hist, new_last, new_active), (active, lp)
+
+        (cache_state, hist, last, active), (entries, lps) = jax.lax.scan(
+            micro, (cache_state, hist, last, active), keys)
+        return cache_state, hist, last, active, entries, lps
+
+    return jax.jit(chunk)
+
+
 class ServingEngine:
     """Continuous-batching generation over a StackDecoder.
 
     Drive it either synchronously (`generate`, or `submit` + `step` in a
     loop — deterministic, what the tests use) or via the background thread
-    (`start`, then `submit` from any thread; `shutdown` to stop)."""
+    (`start`, then `submit` from any thread; `shutdown` to stop).
+
+    `decode_chunk` (default 8; env `DL4J_TPU_DECODE_CHUNK`) sets the number
+    of decode micro-steps per host scheduling opportunity — syncs/token =
+    1/K, with K adapting to 1 whenever requests are queued. `overlap`
+    (default True) lets `drain`/`generate` dispatch the next chunk before
+    reading the previous chunk's mask, hiding host scheduling under device
+    compute (disabled automatically under capture_logprobs)."""
 
     def __init__(self, net, max_seqs: int, max_len: int, *, dtype=None,
                  seed: int = 0, top_k: int = 0,
                  max_new_tokens_cap: int = 512,
                  embed: Optional[Callable] = None,
-                 capture_logprobs: bool = False):
+                 capture_logprobs: bool = False,
+                 decode_chunk: Optional[int] = None,
+                 overlap: bool = True):
         self.decoder = StackDecoder(net, max_seqs, max_len, dtype=dtype)
         if embed is None:
             if self.decoder.n_in is None:
@@ -140,15 +217,26 @@ class ServingEngine:
         self.sampler = Sampler(seed, top_k)
         self.capture_logprobs = bool(capture_logprobs)
         self._cap = int(max_new_tokens_cap)
+        if decode_chunk is None:
+            decode_chunk = int(os.environ.get("DL4J_TPU_DECODE_CHUNK", "8"))
+        if decode_chunk < 1:
+            raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
+        self.decode_chunk = int(decode_chunk)
+        self.overlap = bool(overlap)
         S = self.decoder.cache.max_seqs
         self._step_jit = _build_step(self.decoder, embed, self.sampler.top_k,
                                      self._cap)
+        self._chunk_jit = _build_chunk(self.decoder, embed,
+                                       self.sampler.top_k, self._cap)
         # device-side per-slot state (fixed shapes, threaded through the jit)
         self._hist = jnp.zeros((S, self._cap), jnp.int32)
         self._last = jnp.zeros((S,), jnp.int32)
         self._plens = jnp.zeros((S,), jnp.int32)
         self._eos = jnp.full((S,), -1, jnp.int32)
         self._maxgen = jnp.ones((S,), jnp.int32)
+        # device-side active mask — only threaded while the overlapped drain
+        # pipeline is live (None = synchronous mode, host mask authoritative)
+        self._dev_active: Optional[jnp.ndarray] = None
         # host-side
         self._active_mask = np.zeros((S,), bool)
         self._temps = np.zeros((S,), np.float32)
@@ -158,6 +246,19 @@ class ServingEngine:
         self._work = threading.Condition(self._lock)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
+        # perf counters (host view): every materialization of device data in
+        # the serve loop counts as one sync — per-chunk mask reads AND
+        # per-admission first-token reads (scheduling events)
+        self.host_syncs = 0
+        self.tokens_out = 0
+
+    def stats(self) -> Dict[str, float]:
+        """Engine-lifetime perf counters (bench.py publishes the ratio as
+        host_syncs_per_token)."""
+        return {"host_syncs": self.host_syncs, "tokens_out": self.tokens_out,
+                "decode_chunk": self.decode_chunk,
+                "host_syncs_per_token":
+                    self.host_syncs / max(1, self.tokens_out)}
 
     # ------------------------------------------------------------- submit
     def submit(self, request) -> _Future:
@@ -179,7 +280,8 @@ class ServingEngine:
         with self._work:
             if self._stop.is_set():
                 raise RuntimeError("engine is shut down")
-            self._queue.append(_Active(req, fut, -1, 0, deadline))
+            self._queue.append(_Active(req, fut, -1, 0, deadline,
+                                       t_submit=time.monotonic()))
             self._work.notify()
         return fut
 
@@ -214,18 +316,30 @@ class ServingEngine:
             self._maxgen = self._maxgen.at[slot].set(int(req.max_new_tokens))
             self._temps[slot] = req.temperature
             self._active_mask[slot] = True
+            if self._dev_active is not None:
+                self._dev_active = self._dev_active.at[slot].set(True)
             self._by_slot[slot] = act
+            first = int(t0)            # admission readback (scheduling event)
+            self.host_syncs += 1
+            self.tokens_out += 1
+            act.t_first = time.monotonic()
             # single-token request: finished at admission
             if req.max_new_tokens == 1 or (req.eos_id is not None
-                                           and int(t0) == req.eos_id):
+                                           and first == req.eos_id):
                 self._active_mask[slot] = False
+                if self._dev_active is not None:
+                    self._dev_active = self._dev_active.at[slot].set(False)
                 self._retire(slot, "shutdown")  # reason fixed inside
 
-    def _retire(self, slot: int, default_reason: str) -> None:
-        """Resolve the request in `slot` and free it. Lock held."""
+    def _retire(self, slot: int, default_reason: str, hist=None) -> None:
+        """Resolve the request in `slot` and free it. Lock held. `hist`
+        overrides the history source (the overlapped pipeline reads a
+        finished slot's row from the chunk that finished it, so the read
+        does not block on the chunk already in flight)."""
         act = self._by_slot.pop(slot)
         n = act.n_generated
-        row = np.asarray(self._hist[slot])[:n].tolist()
+        src = self._hist if hist is None else hist
+        row = np.asarray(src[slot])[:n].tolist()
         req = act.req
         if req.eos_id is not None and n and row[-1] == req.eos_id:
             reason = "eos"
@@ -235,47 +349,162 @@ class ServingEngine:
             reason = default_reason
         lps = act.logprobs[:n] if act.logprobs is not None else None
         self.decoder.cache.free(slot)
-        act.fut._set(GenerationResult(row, reason, len(req.tokens), lps))
+        now = time.monotonic()
+        ttft = act.t_first - act.t_submit if act.t_first else None
+        span = now - act.t_first if act.t_first else 0.0
+        tps = (n - 1) / span if n > 1 and span > 0 else None
+        act.fut._set(GenerationResult(row, reason, len(req.tokens), lps,
+                                      ttft_s=ttft, tokens_per_sec=tps))
+
+    def _expire_timeouts(self) -> None:
+        """Retire timed-out requests before spending device time on them.
+        Lock held."""
+        now = time.monotonic()
+        for slot, act in list(self._by_slot.items()):
+            if act.deadline is not None and now > act.deadline:
+                self._active_mask[slot] = False
+                if self._dev_active is not None:
+                    self._dev_active = self._dev_active.at[slot].set(False)
+                self._retire(slot, "timeout")
+
+    def _chunk_size(self) -> int:
+        """Adaptive K: 1 while the admission queue is non-empty (a freed
+        slot is detected within one token — bounded time-to-first-token),
+        else decode_chunk capped at the largest remaining token budget,
+        rounded down to a power of two (bounded set of compiled scan
+        lengths, no over-run waste at the tail)."""
+        if self._queue or self.decode_chunk <= 1:
+            return 1
+        rem = max(act.req.max_new_tokens - act.n_generated
+                  for slot, act in self._by_slot.items()
+                  if self._active_mask[slot])
+        k = min(self.decode_chunk, max(1, rem))
+        if k < self.decode_chunk:
+            k = 1 << (k.bit_length() - 1)
+        return k
+
+    def _finish_steps(self, snapshot: Dict[int, _Active], entry_np, new_np,
+                      lp_np, hist=None) -> None:
+        """Host bookkeeping after a chunk's masks materialize: credit each
+        slot one token per micro-step it entered active, retire slots whose
+        final mask dropped. `snapshot` is the slot->request map AT DISPATCH
+        — the overlapped pipeline may have retired/reassigned a slot since,
+        and a stale mask must never touch the new occupant (identity
+        check). Lock held."""
+        K = entry_np.shape[0]
+        for slot, act in snapshot.items():
+            if self._by_slot.get(slot) is not act \
+                    or not self._active_mask[slot]:
+                continue
+            n_new = int(entry_np[:, slot].sum())
+            act.n_generated += n_new
+            self.tokens_out += n_new
+            if lp_np is not None and act.logprobs is not None:
+                act.logprobs.extend(lp_np[i, slot] for i in range(K)
+                                    if entry_np[i, slot])
+            if not new_np[slot]:
+                self._active_mask[slot] = False
+                self._retire(slot, "length", hist=hist)
 
     def step(self) -> bool:
-        """One scheduler iteration: admit, decode one token for every active
-        slot, retire completions/timeouts. Returns True while any request is
-        active or queued."""
+        """One scheduler iteration: admit, decode ONE CHUNK (adaptive K
+        micro-steps, one host sync) for every active slot, retire
+        completions/timeouts. Returns True while any request is active or
+        queued. Synchronous: cross-K token parity is exact (peeked keys,
+        effective-step commit)."""
         with self._lock:
             self._admit()
             if not self._by_slot:
                 return bool(self._queue)
-            # expire timed-out requests before spending device time on them
-            now = time.monotonic()
-            for slot, act in list(self._by_slot.items()):
-                if act.deadline is not None and now > act.deadline:
-                    self._active_mask[slot] = False
-                    self._retire(slot, "timeout")
+            self._expire_timeouts()
             if not self._by_slot:
                 return bool(self._queue)
+            snapshot = dict(self._by_slot)
             active = jnp.asarray(self._active_mask)
-            (self.decoder.cache.state, self._hist, self._last, new_active,
-             lp) = self._step_jit(
-                self.decoder.params, self.decoder.cache.state, self._hist,
-                self._last, self._plens, self._eos, self._maxgen, active,
-                self.sampler.next_key(), jnp.asarray(self._temps))
-            new_np = np.asarray(new_active)        # the per-iteration sync
-            if self.capture_logprobs:
-                lp_np = np.asarray(lp)
-            for slot, act in list(self._by_slot.items()):
-                if not self._active_mask[slot]:
-                    continue
-                act.n_generated += 1
-                if self.capture_logprobs:
-                    act.logprobs.append(lp_np[slot])
-                if not new_np[slot]:
-                    self._active_mask[slot] = False
-                    self._retire(slot, "length")
-            self._active_mask &= new_np
+            k_eff = self._chunk_size()
+            if k_eff == 1:             # the pre-chunking path, bit-for-bit
+                (self.decoder.cache.state, self._hist, self._last,
+                 new_active, lp) = self._step_jit(
+                    self.decoder.params, self.decoder.cache.state,
+                    self._hist, self._last, self._plens, self._eos,
+                    self._maxgen, active, self.sampler.next_key(),
+                    jnp.asarray(self._temps))
+                entry_np = self._active_mask.copy()[None]    # (1, S)
+                lps = lp[None]
+            else:
+                keys = self.sampler.peek_keys(k_eff)
+                (self.decoder.cache.state, self._hist, self._last,
+                 new_active, entries, lps) = self._chunk_jit(
+                    self.decoder.params, self.decoder.cache.state,
+                    self._hist, self._last, self._plens, self._eos,
+                    self._maxgen, active, keys, jnp.asarray(self._temps))
+                entry_np = np.asarray(entries)               # (K, S)
+                # commit exactly the micro-steps that ran with active work —
+                # a chunk over-running the last completion consumes no chain
+                # state, so K>1 stays token-identical to K=1 stepping
+                self.sampler.advance(int(entry_np.any(axis=1).sum()))
+            new_np = np.asarray(new_active)    # the per-iteration sync
+            self.host_syncs += 1
+            lp_np = np.asarray(lps) if self.capture_logprobs else None
+            self._finish_steps(snapshot, entry_np, new_np, lp_np)
             return bool(self._by_slot or self._queue)
 
+    # ------------------------------------------------- overlapped pipeline
+    def _drain_overlapped(self) -> None:
+        """Run chunks with one-chunk-deep pipelining: dispatch chunk i+1
+        (consuming the DEVICE-side active mask — no host round-trip), then
+        materialize chunk i's masks while the device computes. Scheduling
+        decisions run one chunk stale, which is safe: finished slots decode
+        at most one extra chunk fully masked, and admissions/timeouts patch
+        the device mask before the next dispatch. Keys are consumed
+        unconditionally here (throughput mode — the strict cross-K key
+        schedule is a synchronous-step guarantee)."""
+        pending = None       # (snapshot, entries_dev, final_dev, hist_dev)
+        with self._lock:
+            self._dev_active = jnp.asarray(self._active_mask)
+        try:
+            while True:
+                with self._lock:
+                    self._admit()
+                    self._expire_timeouts()
+                    dispatched = None
+                    if self._active_mask.any():
+                        k_eff = self._chunk_size()
+                        keys = self.sampler.peek_keys(k_eff)
+                        self.sampler.advance(k_eff)
+                        snapshot = dict(self._by_slot)
+                        (self.decoder.cache.state, self._hist, self._last,
+                         self._dev_active, entries, _lps) = self._chunk_jit(
+                            self.decoder.params, self.decoder.cache.state,
+                            self._hist, self._last, self._plens, self._eos,
+                            self._maxgen, self._dev_active, keys,
+                            jnp.asarray(self._temps))
+                        dispatched = (snapshot, entries, self._dev_active,
+                                      self._hist)
+                    # chunk i+1 is enqueued; materializing chunk i's masks
+                    # now overlaps host bookkeeping with device compute
+                    if pending is not None:
+                        snapshot, entries, final, hist = pending
+                        entry_np = np.asarray(entries)
+                        new_np = np.asarray(final)
+                        self.host_syncs += 1
+                        self._finish_steps(snapshot, entry_np, new_np, None,
+                                           hist=hist)
+                    pending = dispatched
+                    if pending is None and not (self._by_slot or self._queue):
+                        return
+        finally:
+            with self._lock:
+                self._dev_active = None
+
     def drain(self) -> None:
-        """Run iterations until no active or queued work remains."""
+        """Run iterations until no active or queued work remains. Uses the
+        overlapped pipeline when enabled (and token-level logprob capture is
+        off — capture needs the synchronous per-chunk readback)."""
+        if self.overlap and self.decode_chunk > 1 \
+                and not self.capture_logprobs:
+            self._drain_overlapped()
+            return
         while self.step():
             pass
 

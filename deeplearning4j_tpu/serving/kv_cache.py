@@ -28,6 +28,16 @@ reaches p+1 in the same decode step that wrote fresh k/v at p. Prefill may
 therefore write its whole padded block and a freed slot needs no zeroing on
 reuse — stale garbage beyond `lengths` is never attended to.
 
+The same invariant is what licenses the engine's CHUNKED and OVERLAPPED
+scheduling (engine decode_chunk / overlap): a slot that finishes mid-chunk
+keeps appending for the rest of the chunk — and, under overlap, for up to
+one more whole chunk, because the host scheduler runs on a one-chunk-stale
+active mask — but every one of those appends is MASKED (`advance_lengths`
+only advances active slots), so the write lands at a position `lengths`
+never reaches and is invisible forever. Freeing and reusing the slot resets
+`lengths` to 0 and the new occupant's prefill overwrites from position 0
+up; no readback barrier between chunks is ever needed for correctness.
+
 Host-side slot management (free list, eviction) lives in `KVCache`; the
 device arrays are a plain dict pytree (`state`) threaded through the jitted
 steps, so the engine can donate the buffers and update in place.

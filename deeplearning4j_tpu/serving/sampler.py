@@ -44,7 +44,16 @@ def sample_tokens(key, logprobs, temperature, top_k: int = 0):
 
 
 class Sampler:
-    """Holds the sampling config and threads the PRNG key across steps."""
+    """Holds the sampling config and threads the PRNG key across steps.
+
+    Chunked decode (engine decode_chunk > 1) needs K per-micro-step keys up
+    front, but only the micro-steps that actually ran with active slots may
+    consume chain state — otherwise a chunk that over-runs past the last
+    completion would leave the chain in a different state than K=1
+    stepping, breaking cross-K token parity. `peek_keys` materializes the
+    next K subkeys WITHOUT advancing, and `advance` commits exactly the
+    effective number of steps afterwards; `next_key` == peek_keys(1)[0] +
+    advance(1), so K=1 remains bit-for-bit the pre-chunking behavior."""
 
     def __init__(self, seed: int = 0, top_k: int = 0):
         if top_k < 0:
@@ -56,6 +65,23 @@ class Sampler:
         """Split off a fresh per-step key (functional; never reused)."""
         self._key, sub = jax.random.split(self._key)
         return sub
+
+    def peek_keys(self, n: int):
+        """The next `n` subkeys of the chain, stacked (n, ...), WITHOUT
+        advancing the chain — subkey i is exactly what the i-th future
+        next_key() call would return."""
+        k = self._key
+        subs = []
+        for _ in range(n):
+            k, sub = jax.random.split(k)
+            subs.append(sub)
+        return jnp.stack(subs)
+
+    def advance(self, n: int) -> None:
+        """Commit `n` splits to the chain (pairs with peek_keys: peek K,
+        consume the first n <= K on device, advance by n)."""
+        for _ in range(n):
+            self._key, _ = jax.random.split(self._key)
 
     def sample(self, logprobs, temperature):
         return sample_tokens(self.next_key(), logprobs, temperature,

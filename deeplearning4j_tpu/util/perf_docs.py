@@ -119,7 +119,7 @@ def render_block(art: dict) -> str:
                      "residuals) — it OOMs; both paths here are O(T*block).")
     dec = e.get("decode_serving", {})
     if dec.get("decode_tokens_per_sec"):
-        lines.append(
+        line = (
             f"- Autoregressive serving (beyond-reference): "
             f"{dec['decode_tokens_per_sec']:,.0f} decode tokens/s — "
             f"{dec['requests']} requests, prefill T={dec['prefill_len']}, "
@@ -127,6 +127,18 @@ def render_block(art: dict) -> str:
             f"({dec.get('mixed_arrivals', 'n/a')}) through the KV-cache "
             f"continuous-batching engine (serving/), KV cache "
             f"{dec.get('kv_cache_gb', 0)} GB.")
+        if dec.get("host_syncs_per_token") is not None:
+            line += (
+                f" Chunked decode K={dec.get('decode_chunk', '?')}: "
+                f"{dec['host_syncs_per_token']:.3f} host syncs/token")
+            k1 = e.get("decode_serving_k1", {})
+            if k1.get("decode_tokens_per_sec"):
+                line += (
+                    f" ({dec['decode_tokens_per_sec'] / k1['decode_tokens_per_sec']:.2f}x "
+                    f"the same-session K=1 per-token-sync control at "
+                    f"{k1['decode_tokens_per_sec']:,.0f} tok/s)")
+            line += "."
+        lines.append(line)
     lines.append(
         f"- ParallelWrapper ResNet50: {pw['images_per_sec']:,.0f} img/s — "
         f"single-chip shard_map OVERHEAD-PARITY number (workers={pw['workers']}"

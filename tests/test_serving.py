@@ -293,3 +293,93 @@ def test_computation_graph_linear_chain_decode_parity():
     for i, lp in enumerate(res.logprobs):
         np.testing.assert_allclose(lp, ref[:, len(prompt) - 1 + i],
                                    atol=1e-9)
+
+
+# -------------------------------------------------------- chunked decode
+def _run_chunked(net, prompts, chunk, seed=3, overlap=False, max_seqs=4,
+                 **kw):
+    eng = ServingEngine(net, max_seqs=max_seqs, max_len=64, seed=seed,
+                        decode_chunk=chunk, overlap=overlap)
+    return eng.generate([Request(list(p), **kw) for p in prompts]), eng
+
+
+def test_chunked_decode_token_parity_across_k():
+    """The chunking guarantee: K in {2, 4, 8} is token-for-token identical
+    to K=1 single-stepping — greedy AND temperature sampling (the peeked-
+    key schedule), with max_new_tokens=11 exercising the power-of-two tail
+    buckets (8 + 2 + 1)."""
+    net = _build_net(n_kv=2)
+    prompts = [[1, 2, 3, 4], [5, 6, 7], [2, 2, 2, 2, 2]]
+    for kw in ({"max_new_tokens": 11},
+               {"max_new_tokens": 11, "temperature": 1.3}):
+        ref, _ = _run_chunked(net, prompts, chunk=1, **kw)
+        for k in (2, 4, 8):
+            got, _ = _run_chunked(net, prompts, chunk=k, **kw)
+            for r, g in zip(ref, got):
+                assert g.tokens == r.tokens, (k, kw)
+                assert g.finish_reason == r.finish_reason
+        # determinism across chunk boundaries: same seed -> same stream
+        again, _ = _run_chunked(net, prompts, chunk=8, **kw)
+        assert [g.tokens for g in again] == [r.tokens for r in ref]
+
+
+def test_chunked_decode_eos_mid_chunk():
+    """EOS landing inside a chunk stops the request at the same token as
+    K=1 (finished slots ride out the rest of the chunk masked), and the
+    unconsumed micro-step keys are rewound so a FOLLOWING sampled request
+    also matches its K=1 stream."""
+    net = _build_net()
+    probe, _ = _run_chunked(net, [[1, 2, 3]], chunk=1,
+                            max_new_tokens=8)
+    eos = probe[0].tokens[1]           # greedy emits this at position 1
+    for k in (1, 8):
+        eng = ServingEngine(net, max_seqs=2, max_len=64, seed=5,
+                            decode_chunk=k, overlap=False)
+        res = eng.generate([Request([1, 2, 3], max_new_tokens=8,
+                                    eos_id=eos)])[0]
+        assert res.finish_reason == "eos" and res.tokens[-1] == eos
+        assert res.tokens == probe[0].tokens[:len(res.tokens)]
+        after = eng.generate([Request([4, 5, 6], max_new_tokens=6,
+                                      temperature=1.1)])[0]
+        if k == 1:
+            ref_after = after.tokens
+    assert after.tokens == ref_after   # key chain identical across K
+
+
+def test_chunked_admission_forces_k_to_one():
+    """A non-empty queue drops the chunk size to 1 (bounded TTFT: a freed
+    slot is noticed within one token, the Orca property), and the queued
+    request still decodes the same stream as running alone."""
+    net = _build_net()
+    solo, _ = _run_chunked(net, [[7, 8, 9]], chunk=1, seed=0, max_seqs=1,
+                           max_new_tokens=5)
+    eng = ServingEngine(net, max_seqs=1, max_len=64, seed=0, decode_chunk=8,
+                        overlap=False)
+    f1 = eng.submit(Request([1, 2, 3], max_new_tokens=5))
+    f2 = eng.submit(Request([7, 8, 9], max_new_tokens=5))
+    assert eng.step()                  # admits #1; #2 queued -> k_eff == 1
+    assert eng._by_slot[0].n_generated == 2   # exactly ONE micro-step ran
+    eng.drain()
+    r1, r2 = f1.get(timeout=0), f2.get(timeout=0)
+    assert len(r1.tokens) == 5 and r2.tokens == solo[0].tokens
+    assert r1.ttft_s is not None and r1.ttft_s >= 0
+    assert r2.ttft_s >= r1.ttft_s      # second waited for the slot
+
+
+def test_overlapped_drain_matches_sync_and_amortizes_syncs():
+    """The overlapped pipeline (dispatch chunk i+1 before materializing
+    chunk i's mask) produces the same greedy streams as synchronous
+    stepping, and the engine's sync counter shows the 1/K amortization."""
+    net = _build_net(n_kv=2)
+    prompts = [[1, 2, 3, 4], [5, 6, 7], [2, 2, 2, 2, 2]]
+    ref, e1 = _run_chunked(net, prompts, chunk=1, max_new_tokens=16)
+    got, eo = _run_chunked(net, prompts, chunk=8, overlap=True,
+                           max_new_tokens=16)
+    for r, g in zip(ref, got):
+        assert g.tokens == r.tokens and g.finish_reason == r.finish_reason
+        assert g.tokens_per_sec is None or g.tokens_per_sec > 0
+    s1, so = e1.stats(), eo.stats()
+    assert s1["tokens_out"] == so["tokens_out"] == 48
+    # 1/K amortization: syncs/token = 1/8 plus the 3 admission events
+    assert so["host_syncs"] <= s1["host_syncs"] / 2
+    assert so["host_syncs_per_token"] <= 1.0 / 8 + 3.0 / 48 + 1e-9
