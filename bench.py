@@ -721,7 +721,7 @@ def bench_decode_serving(vocab=64, d_model=256, heads=4, kv_heads=2,
     # its power-of-two tail buckets (2*K decodes as K, K/2, ..., 1)
     eng.generate([Request(prompt(),
                           max_new_tokens=max(2, 2 * eng.decode_chunk))])
-    eng.host_syncs = eng.tokens_out = 0     # count only the timed serve
+    eng.metrics.reset()                     # count only the timed serve
     t0 = _time.perf_counter()
     futs = [eng.submit(Request(prompt(), max_new_tokens=new_tokens))
             for _ in range(first_wave)]
@@ -738,6 +738,15 @@ def bench_decode_serving(vocab=64, d_model=256, heads=4, kv_heads=2,
         f"expected {max_seqs * new_tokens} tokens, got {total}"
     st = eng.stats()
     ttfts = [r.ttft_s for r in results if r.ttft_s is not None]
+    # telemetry snapshot of the timed serve (registry was reset post-warmup,
+    # so jit_compiles counts only shapes first seen during the measurement)
+    snap = eng.metrics.snapshot()
+    ttft_h = snap.get("serving.ttft_s") or {}
+    chunk_h = snap.get("serving.decode_chunk_ms") or {}
+    tel = {"ttft_p50_s": ttft_h.get("p50"), "ttft_p99_s": ttft_h.get("p99"),
+           "decode_chunk_ms_p50": chunk_h.get("p50"),
+           "decode_chunk_ms_p99": chunk_h.get("p99"),
+           "jit_compiles": snap.get("serving.jit_compiles", 0)}
     return {"decode_tokens_per_sec": total / wall,
             "total_tokens": total, "wall_s": wall,
             "prefill_len": prefill_len, "new_tokens": new_tokens,
@@ -748,6 +757,7 @@ def bench_decode_serving(vocab=64, d_model=256, heads=4, kv_heads=2,
             "host_syncs_per_token": round(st["host_syncs_per_token"], 4),
             "mean_ttft_s": round(float(np.mean(ttfts)), 4) if ttfts
             else None,
+            "telemetry": tel,
             "kv_cache_gb": round(eng.decoder.cache.bytes() / 1e9, 3),
             "model": f"2x SelfAttentionLayer(d{d_model},h{heads},"
                      f"kv{kv_heads}) + softmax head, vocab {vocab}",

@@ -45,7 +45,14 @@ class ScoreIterationListener(IterationListener):
 
 class PerformanceListener(IterationListener):
     """Iteration time / samples/sec / batches/sec, ETL time separated
-    (ref PerformanceListener.java:118-124)."""
+    (ref PerformanceListener.java:118-124).
+
+    Score reporting is SYNC-FREE: `model.score()` would force a device sync
+    per iteration (block until the in-flight step's loss materializes), so
+    the record instead carries the LAST MATERIALIZED score — the previous
+    iteration's loss, whose buffer completed while the current step ran.
+    `rec["score"]` is therefore one step stale (None until iteration 2);
+    staleness is the price of keeping the training loop fully async."""
 
     def __init__(self, frequency: int = 1, report: bool = True):
         self.frequency = max(1, int(frequency))
@@ -54,7 +61,9 @@ class PerformanceListener(IterationListener):
         self.history: List[dict] = []
 
     def iteration_done(self, model, iteration: int):
+        from deeplearning4j_tpu.telemetry.training import lagged_score
         now = time.time()
+        score = lagged_score(self, model)   # one step stale, no forced sync
         if self._last is not None and iteration % self.frequency == 0:
             dt = now - self._last
             batch = getattr(model, "_last_batch_size", None)
@@ -64,6 +73,7 @@ class PerformanceListener(IterationListener):
                 "batches_per_sec": 1.0 / dt if dt > 0 else float("inf"),
                 "samples_per_sec": (batch / dt) if (batch and dt > 0) else None,
                 "etl_ms": getattr(model, "last_etl_ms", 0.0),
+                "score": score,             # previous iteration's (stale)
             }
             self.history.append(rec)
             if self.report:
@@ -225,6 +235,51 @@ class ParamAndGradientIterationListener(IterationListener):
         if self.output_to_file and self.file_path:
             with open(self.file_path, "a") as f:
                 f.write(line + "\n")
+
+
+class TelemetryListener(TrainingListener):
+    """Bridge from the DL4J TrainingListener API onto the telemetry
+    subsystem (deeplearning4j_tpu/telemetry/): per-iteration wall time and
+    count go to the metrics registry (histogram `training.iteration_ms`,
+    counter `training.iterations` — shared, idempotent bookkeeping with
+    ui/stats.StatsListener via telemetry.training.mark_iteration), the
+    one-step-stale materialized score to gauge `training.score`, and epochs
+    become trace spans. NOTHING here forces a device sync: timing is host
+    clocks, the score read is the lagged already-materialized buffer.
+
+    Attach like any listener: `net.set_listeners(TelemetryListener())`;
+    scrape via the UIServer /metrics endpoint or registry().snapshot(), and
+    set DL4J_TPU_TRACE_PATH to get a Chrome trace per epoch."""
+
+    def __init__(self, registry=None):
+        from deeplearning4j_tpu import telemetry
+        self.registry = registry or telemetry.registry()
+        self._epoch_span = None
+        self._c_epochs = self.registry.counter(
+            "training.epochs", "training epochs completed")
+        self._g_score = self.registry.gauge(
+            "training.score", "last materialized score (one step stale)")
+
+    def iteration_done(self, model, iteration: int):
+        from deeplearning4j_tpu.telemetry.training import (lagged_score,
+                                                           mark_iteration)
+        mark_iteration(iteration, self.registry)
+        s = lagged_score(self, model)
+        if s is not None and s == s:        # skip the initial NaN
+            self._g_score.set(s)
+
+    def on_epoch_start(self, model):
+        from deeplearning4j_tpu import telemetry
+        self._epoch_span = telemetry.span("epoch")
+        self._epoch_span.__enter__()
+
+    def on_epoch_end(self, model):
+        from deeplearning4j_tpu import telemetry
+        if self._epoch_span is not None:
+            self._epoch_span.__exit__(None, None, None)
+            self._epoch_span = None
+        self._c_epochs.inc()
+        telemetry.maybe_export_trace()
 
 
 class SleepyTrainingListener(TrainingListener):

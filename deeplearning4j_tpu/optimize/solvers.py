@@ -12,10 +12,13 @@ from __future__ import annotations
 
 from typing import Callable, List, Optional, Tuple
 
+import time
+
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.common.enums import OptimizationAlgorithm
 
 
@@ -43,7 +46,11 @@ def backtrack_line_search(loss_fn, x0: jnp.ndarray, f0: float, g0: np.ndarray,
     """Armijo backtracking (ref BackTrackLineSearch.java). Returns (step, f_new)."""
     slope = float(np.dot(g0, direction))
     step = step0
+    evals = telemetry.registry().counter(
+        "solver.line_search_evals", "compiled loss evaluations spent in "
+        "backtracking line search")
     for _ in range(max_steps):
+        evals.inc()
         f_new = float(loss_fn(x0 + step * jnp.asarray(direction)))
         if np.isfinite(f_new) and f_new <= f0 + c1 * step * slope:
             return step, f_new
@@ -56,6 +63,23 @@ class BaseSolver:
         self.max_iterations = int(max_iterations)
         self.tolerance = float(tolerance)
         self.score_history: List[float] = []
+        self._t_iter = None
+
+    def _iter_done(self, f: float) -> None:
+        """One accepted solver iteration: history + telemetry (counter,
+        per-iteration wall histogram, score gauge — all host values the
+        solver already holds, no extra syncs)."""
+        self.score_history.append(f)
+        reg = telemetry.registry()
+        reg.counter("solver.iterations",
+                    "accepted second-order solver iterations").inc()
+        reg.gauge("solver.score", "latest solver objective value").set(f)
+        now = time.perf_counter()
+        if self._t_iter is not None:
+            reg.histogram("solver.iteration_ms",
+                          "wall time per solver iteration").observe(
+                (now - self._t_iter) * 1e3)
+        self._t_iter = now
 
     def optimize(self, net, x, y, fmask=None, lmask=None) -> float:
         raise NotImplementedError
@@ -77,7 +101,7 @@ class LineGradientDescent(BaseSolver):
             flat = flat - step * g
             f, g = vg(flat)
             f = float(f)
-            self.score_history.append(f)
+            self._iter_done(f)
         net.set_params(flat)
         net._score = f
         return f
@@ -108,7 +132,7 @@ class ConjugateGradient(BaseSolver):
                 beta = 0.0  # restart: steepest descent
             d = -g2_np + beta * d
             f, g_np = float(f2), g2_np
-            self.score_history.append(f)
+            self._iter_done(f)
         net.set_params(flat)
         net._score = f
         return f
@@ -159,7 +183,7 @@ class LBFGS(BaseSolver):
                 s_hist.pop(0)
                 y_hist.pop(0)
             flat, f, g_np = new_flat, float(f2), g2_np
-            self.score_history.append(f)
+            self._iter_done(f)
         net.set_params(flat)
         net._score = f
         return f
@@ -186,11 +210,12 @@ class Solver:
                                     "optimization_algo",
                                     OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT)
         algo = OptimizationAlgorithm(algo)
-        if algo == OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
-            self.net.fit_batch(x, y, fmask, lmask)
-            return float(self.net.score())
-        solver = self._MAP[algo](self.max_iterations, self.tolerance)
-        return solver.optimize(self.net, x, y, fmask, lmask)
+        with telemetry.span("solver.optimize", algorithm=algo.name):
+            if algo == OptimizationAlgorithm.STOCHASTIC_GRADIENT_DESCENT:
+                self.net.fit_batch(x, y, fmask, lmask)
+                return float(self.net.score())
+            solver = self._MAP[algo](self.max_iterations, self.tolerance)
+            return solver.optimize(self.net, x, y, fmask, lmask)
 
     class Builder:
         def __init__(self):

@@ -17,6 +17,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu import telemetry
+
 
 class InferenceMode:
     SEQUENTIAL = "sequential"
@@ -71,6 +73,12 @@ class ParallelInference:
         self._shutdown = threading.Event()
         self._worker = None
         self._engine = None
+        reg = telemetry.registry()
+        self._g_queue = reg.gauge(
+            "parallel.queue_depth", "requests waiting in the batch queue")
+        self._h_batch = reg.histogram(
+            "parallel.batch_size", "aggregated request count per device call",
+            buckets=(1, 2, 4, 8, 16, 32, 64, 128))
         if inference_mode == InferenceMode.GENERATE:
             # generate_kwargs pass straight through to ServingEngine —
             # including decode_chunk (micro-steps per host sync) and
@@ -167,7 +175,11 @@ class ParallelInference:
                     break
             try:
                 big = np.concatenate([p[0] for p in pending], axis=0)
-                out = np.asarray(self._run(big))
+                self._g_queue.set(self._queue.qsize())
+                self._h_batch.observe(big.shape[0])
+                with telemetry.span("parallel.infer", batch=int(big.shape[0]),
+                                    requests=len(pending)):
+                    out = np.asarray(self._run(big))
                 pos = 0
                 for arr, obs in pending:
                     n = arr.shape[0]

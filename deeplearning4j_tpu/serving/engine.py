@@ -66,6 +66,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.serving.decode import StackDecoder, one_hot_embedder
 from deeplearning4j_tpu.serving.sampler import Sampler, sample_tokens
 
@@ -246,19 +247,83 @@ class ServingEngine:
         self._work = threading.Condition(self._lock)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
-        # perf counters (host view): every materialization of device data in
-        # the serve loop counts as one sync — per-chunk mask reads AND
-        # per-admission first-token reads (scheduling events)
-        self.host_syncs = 0
-        self.tokens_out = 0
+        # telemetry (ISSUE 4): a per-engine child registry — visible from
+        # the process-wide /metrics exposition, isolated for stats()/tests.
+        # Every metric below is fed from values the scheduler already holds
+        # on the host (counters, materialized masks, host timestamps):
+        # recording adds ZERO device syncs, so counts are bit-identical with
+        # telemetry on or off (tests/test_telemetry.py asserts this). The
+        # sync counters themselves live here too: every materialization of
+        # device data in the serve loop counts as one sync — per-chunk mask
+        # reads AND per-admission first-token reads (scheduling events).
+        self.metrics = telemetry.MetricsRegistry(parent=telemetry.registry())
+        self._c_syncs = self.metrics.counter(
+            "serving.host_syncs", "device->host materializations in the "
+            "serve loop")
+        self._c_tokens = self.metrics.counter(
+            "serving.tokens_out", "generated tokens delivered")
+        self._c_admits = self.metrics.counter(
+            "serving.admissions", "requests admitted into slots")
+        self._c_retires = self.metrics.counter(
+            "serving.retirements", "requests retired")
+        self._c_timeouts = self.metrics.counter(
+            "serving.timeouts", "requests expired before completion")
+        self._c_compiles = self.metrics.counter(
+            "serving.jit_compiles", "first-use compiled shapes (prefill "
+            "buckets + chunk scan lengths)")
+        self._h_ttft = self.metrics.histogram(
+            "serving.ttft_s", "submit -> first token (s)",
+            buckets=telemetry.DEFAULT_S_BUCKETS)
+        self._h_tps = self.metrics.histogram(
+            "serving.tokens_per_sec", "per-request decode throughput",
+            buckets=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000,
+                     10000, 50000))
+        self._h_chunk_k = self.metrics.histogram(
+            "serving.chunk_k", "adaptive chunk size chosen per iteration",
+            buckets=(1, 2, 4, 8, 16, 32, 64))
+        self._h_chunk_ms = self.metrics.histogram(
+            "serving.decode_chunk_ms", "dispatch+readback wall per chunk")
+        self._g_queue = self.metrics.gauge(
+            "serving.queue_depth", "requests waiting for a slot")
+        self._g_occ = self.metrics.gauge(
+            "serving.slot_occupancy", "slots holding an active request")
+        self._seen_shapes: set = set()   # jit cache-miss attribution
+
+    # host_syncs / tokens_out live on the registry (ISSUE 4 satellite) but
+    # stay assignable attributes for callers that reset them (bench.py)
+    @property
+    def host_syncs(self) -> int:
+        return self._c_syncs.value
+
+    @host_syncs.setter
+    def host_syncs(self, v: int) -> None:
+        self._c_syncs.reset(int(v))
+
+    @property
+    def tokens_out(self) -> int:
+        return self._c_tokens.value
+
+    @tokens_out.setter
+    def tokens_out(self, v: int) -> None:
+        self._c_tokens.reset(int(v))
 
     def stats(self) -> Dict[str, float]:
-        """Engine-lifetime perf counters (bench.py publishes the ratio as
-        host_syncs_per_token)."""
-        return {"host_syncs": self.host_syncs, "tokens_out": self.tokens_out,
-                "decode_chunk": self.decode_chunk,
-                "host_syncs_per_token":
-                    self.host_syncs / max(1, self.tokens_out)}
+        """One consistent snapshot (taken under the scheduler lock) of the
+        engine-lifetime perf counters plus the live queue/slot state
+        (bench.py publishes the ratio as host_syncs_per_token)."""
+        with self._lock:
+            syncs, toks = self._c_syncs.value, self._c_tokens.value
+            return {"host_syncs": syncs, "tokens_out": toks,
+                    "decode_chunk": self.decode_chunk,
+                    "host_syncs_per_token": syncs / max(1, toks),
+                    "queue_depth": len(self._queue),
+                    "free_slots": self.decoder.cache.n_free,
+                    "active_slots": len(self._by_slot)}
+
+    def export_trace(self, path: str) -> str:
+        """Write the global tracer's Chrome-trace JSON (prefill / decode
+        chunk / host sync / compile spans) to `path`."""
+        return telemetry.tracer().export(path)
 
     # ------------------------------------------------------------- submit
     def submit(self, request) -> _Future:
@@ -301,7 +366,19 @@ class ServingEngine:
             req = act.req
             toks = np.asarray(req.tokens, np.int32)
             feats = np.asarray(self.embed(jnp.asarray(toks))).T  # (n_in, T)
-            lp = self.decoder.prefill(slot, feats)
+            # compile attribution: the prefill jit retraces once per
+            # power-of-two length bucket — first sighting is a cache miss
+            plen = len(req.tokens)
+            bucket = min(cache.max_len, 1 << max(0, (plen - 1)).bit_length())
+            miss = ("prefill", bucket) not in self._seen_shapes
+            if miss:
+                self._seen_shapes.add(("prefill", bucket))
+                self._c_compiles.inc()
+            cm = telemetry.span("jit_compile", kind="prefill",
+                                bucket=bucket) if miss else telemetry.NULL_SPAN
+            with cm, telemetry.span("prefill", slot=slot, plen=plen,
+                                    bucket=bucket):
+                lp = self.decoder.prefill(slot, feats)
             t0 = sample_tokens(self.sampler.next_key(), lp[None],
                                jnp.full((1,), req.temperature, jnp.float32),
                                self.sampler.top_k)[0]
@@ -319,10 +396,15 @@ class ServingEngine:
             if self._dev_active is not None:
                 self._dev_active = self._dev_active.at[slot].set(True)
             self._by_slot[slot] = act
-            first = int(t0)            # admission readback (scheduling event)
-            self.host_syncs += 1
-            self.tokens_out += 1
+            with telemetry.span("host_sync", what="first_token", slot=slot):
+                first = int(t0)        # admission readback (scheduling event)
+            self._c_syncs.inc()
+            self._c_tokens.inc()
+            self._c_admits.inc()
             act.t_first = time.monotonic()
+            telemetry.instant("admit", slot=slot, plen=plen,
+                              queued=len(self._queue))
+            self._h_ttft.observe(act.t_first - act.t_submit)
             # single-token request: finished at admission
             if req.max_new_tokens == 1 or (req.eos_id is not None
                                            and first == req.eos_id):
@@ -352,9 +434,22 @@ class ServingEngine:
         now = time.monotonic()
         ttft = act.t_first - act.t_submit if act.t_first else None
         span = now - act.t_first if act.t_first else 0.0
-        tps = (n - 1) / span if n > 1 and span > 0 else None
+        total = now - act.t_submit if act.t_submit else 0.0
+        if n > 1 and span > 0:
+            tps = (n - 1) / span       # decode-span rate (post-first-token)
+        elif n >= 1 and total > 0:
+            # 1-token generations (and sub-resolution decode spans) fall
+            # back to tokens / whole-request wall — never None for a
+            # request that produced output (ISSUE 4 satellite)
+            tps = n / total
+        else:
+            tps = None
         act.fut._set(GenerationResult(row, reason, len(req.tokens), lps,
                                       ttft_s=ttft, tokens_per_sec=tps))
+        self._c_retires.inc()
+        if tps is not None:
+            self._h_tps.observe(tps)
+        telemetry.instant("retire", slot=slot, reason=reason, tokens=n)
 
     def _expire_timeouts(self) -> None:
         """Retire timed-out requests before spending device time on them.
@@ -365,6 +460,7 @@ class ServingEngine:
                 self._active_mask[slot] = False
                 if self._dev_active is not None:
                     self._dev_active = self._dev_active.at[slot].set(False)
+                self._c_timeouts.inc()
                 self._retire(slot, "timeout")
 
     def _chunk_size(self) -> int:
@@ -398,7 +494,7 @@ class ServingEngine:
                 continue
             n_new = int(entry_np[:, slot].sum())
             act.n_generated += n_new
-            self.tokens_out += n_new
+            self._c_tokens.inc(n_new)
             if lp_np is not None and act.logprobs is not None:
                 act.logprobs.extend(lp_np[i, slot] for i in range(K)
                                     if entry_np[i, slot])
@@ -422,29 +518,44 @@ class ServingEngine:
             snapshot = dict(self._by_slot)
             active = jnp.asarray(self._active_mask)
             k_eff = self._chunk_size()
-            if k_eff == 1:             # the pre-chunking path, bit-for-bit
-                (self.decoder.cache.state, self._hist, self._last,
-                 new_active, lp) = self._step_jit(
-                    self.decoder.params, self.decoder.cache.state,
-                    self._hist, self._last, self._plens, self._eos,
-                    self._maxgen, active, self.sampler.next_key(),
-                    jnp.asarray(self._temps))
-                entry_np = self._active_mask.copy()[None]    # (1, S)
-                lps = lp[None]
-            else:
-                keys = self.sampler.peek_keys(k_eff)
-                (self.decoder.cache.state, self._hist, self._last,
-                 new_active, entries, lps) = self._chunk_jit(
-                    self.decoder.params, self.decoder.cache.state,
-                    self._hist, self._last, self._plens, self._eos,
-                    self._maxgen, active, keys, jnp.asarray(self._temps))
-                entry_np = np.asarray(entries)               # (K, S)
-                # commit exactly the micro-steps that ran with active work —
-                # a chunk over-running the last completion consumes no chain
-                # state, so K>1 stays token-identical to K=1 stepping
-                self.sampler.advance(int(entry_np.any(axis=1).sum()))
-            new_np = np.asarray(new_active)    # the per-iteration sync
-            self.host_syncs += 1
+            t_chunk = time.perf_counter()
+            self._h_chunk_k.observe(k_eff)
+            self._g_queue.set(len(self._queue))
+            self._g_occ.set(len(self._by_slot))
+            miss = ("chunk", k_eff) not in self._seen_shapes
+            if miss:
+                self._seen_shapes.add(("chunk", k_eff))
+                self._c_compiles.inc()
+            cm = telemetry.span("jit_compile", kind="chunk",
+                                k=k_eff) if miss else telemetry.NULL_SPAN
+            with cm, telemetry.span("decode_chunk", k=k_eff,
+                                    active=int(self._active_mask.sum())):
+                if k_eff == 1:         # the pre-chunking path, bit-for-bit
+                    (self.decoder.cache.state, self._hist, self._last,
+                     new_active, lp) = self._step_jit(
+                        self.decoder.params, self.decoder.cache.state,
+                        self._hist, self._last, self._plens, self._eos,
+                        self._maxgen, active, self.sampler.next_key(),
+                        jnp.asarray(self._temps))
+                    entry_np = self._active_mask.copy()[None]    # (1, S)
+                    lps = lp[None]
+                else:
+                    keys = self.sampler.peek_keys(k_eff)
+                    (self.decoder.cache.state, self._hist, self._last,
+                     new_active, entries, lps) = self._chunk_jit(
+                        self.decoder.params, self.decoder.cache.state,
+                        self._hist, self._last, self._plens, self._eos,
+                        self._maxgen, active, keys, jnp.asarray(self._temps))
+                    entry_np = np.asarray(entries)               # (K, S)
+                    # commit exactly the micro-steps that ran with active
+                    # work — a chunk over-running the last completion
+                    # consumes no chain state, so K>1 stays token-identical
+                    # to K=1 stepping
+                    self.sampler.advance(int(entry_np.any(axis=1).sum()))
+            with telemetry.span("host_sync", what="chunk_masks", k=k_eff):
+                new_np = np.asarray(new_active)    # the per-iteration sync
+            self._c_syncs.inc()
+            self._h_chunk_ms.observe((time.perf_counter() - t_chunk) * 1e3)
             lp_np = np.asarray(lps) if self.capture_logprobs else None
             self._finish_steps(snapshot, entry_np, new_np, lp_np)
             return bool(self._by_slot or self._queue)
@@ -459,7 +570,7 @@ class ServingEngine:
         the device mask before the next dispatch. Keys are consumed
         unconditionally here (throughput mode — the strict cross-K key
         schedule is a synchronous-step guarantee)."""
-        pending = None       # (snapshot, entries_dev, final_dev, hist_dev)
+        pending = None   # (snapshot, entries_dev, final_dev, hist_dev, t0)
         with self._lock:
             self._dev_active = jnp.asarray(self._active_mask)
         try:
@@ -470,24 +581,42 @@ class ServingEngine:
                     dispatched = None
                     if self._active_mask.any():
                         k_eff = self._chunk_size()
+                        self._h_chunk_k.observe(k_eff)
+                        self._g_queue.set(len(self._queue))
+                        self._g_occ.set(len(self._by_slot))
+                        miss = ("chunk", k_eff) not in self._seen_shapes
+                        if miss:
+                            self._seen_shapes.add(("chunk", k_eff))
+                            self._c_compiles.inc()
+                        cm = telemetry.span(
+                            "jit_compile", kind="chunk",
+                            k=k_eff) if miss else telemetry.NULL_SPAN
                         keys = self.sampler.peek_keys(k_eff)
                         self.sampler.advance(k_eff)
                         snapshot = dict(self._by_slot)
-                        (self.decoder.cache.state, self._hist, self._last,
-                         self._dev_active, entries, _lps) = self._chunk_jit(
-                            self.decoder.params, self.decoder.cache.state,
-                            self._hist, self._last, self._plens, self._eos,
-                            self._maxgen, self._dev_active, keys,
-                            jnp.asarray(self._temps))
+                        with cm, telemetry.span(
+                                "decode_chunk", k=k_eff, overlap=True,
+                                active=int(self._active_mask.sum())):
+                            (self.decoder.cache.state, self._hist,
+                             self._last, self._dev_active, entries,
+                             _lps) = self._chunk_jit(
+                                self.decoder.params, self.decoder.cache.state,
+                                self._hist, self._last, self._plens,
+                                self._eos, self._maxgen, self._dev_active,
+                                keys, jnp.asarray(self._temps))
                         dispatched = (snapshot, entries, self._dev_active,
-                                      self._hist)
+                                      self._hist, time.perf_counter())
                     # chunk i+1 is enqueued; materializing chunk i's masks
                     # now overlaps host bookkeeping with device compute
                     if pending is not None:
-                        snapshot, entries, final, hist = pending
-                        entry_np = np.asarray(entries)
-                        new_np = np.asarray(final)
-                        self.host_syncs += 1
+                        snapshot, entries, final, hist, t_disp = pending
+                        with telemetry.span("host_sync", what="chunk_masks",
+                                            overlap=True):
+                            entry_np = np.asarray(entries)
+                            new_np = np.asarray(final)
+                        self._c_syncs.inc()
+                        self._h_chunk_ms.observe(
+                            (time.perf_counter() - t_disp) * 1e3)
                         self._finish_steps(snapshot, entry_np, new_np, None,
                                            hist=hist)
                     pending = dispatched
@@ -504,9 +633,12 @@ class ServingEngine:
         if self.overlap and self.decode_chunk > 1 \
                 and not self.capture_logprobs:
             self._drain_overlapped()
-            return
-        while self.step():
-            pass
+        else:
+            while self.step():
+                pass
+        # $DL4J_TPU_TRACE_PATH: export the recorded spans after every full
+        # drain (last writer wins) — cheap host I/O, outside the hot loop
+        telemetry.maybe_export_trace()
 
     def generate(self, prompts, **kw) -> List[GenerationResult]:
         """Synchronous convenience: submit every prompt (a Request or a

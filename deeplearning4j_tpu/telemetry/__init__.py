@@ -1,0 +1,106 @@
+"""Framework-wide observability (ISSUE 4): sync-free metrics + span tracing.
+
+Three pieces:
+- `MetricsRegistry` (registry.py): lock-free counters/gauges/fixed-bucket
+  histograms fed ONLY from values the caller already holds on the host —
+  recording a metric never adds a device sync. `registry()` returns the
+  process-wide default; subsystems that want isolation (one per
+  ServingEngine) build `MetricsRegistry(parent=registry())` so the global
+  Prometheus exposition still sees them.
+- `Tracer` (tracing.py): context-manager spans -> Chrome-trace/Perfetto
+  JSON. `span("name", **args)` on the module records into the global
+  tracer; `maybe_export_trace()` writes it to `$DL4J_TPU_TRACE_PATH`.
+- Prometheus text exposition: `registry().prometheus_text()`, served by
+  ui/server.py at GET /metrics, or mount `metrics_route()` on any
+  util/http.JsonHttpServer.
+
+Env toggles:
+- DL4J_TPU_TELEMETRY=0 disables span RECORDING (metrics counting stays on —
+  it is what `engine.stats()` is built from, and it is sync-free either
+  way; the on-vs-off regression test asserts identical sync counts).
+- DL4J_TPU_TRACE_PATH=/path/trace.json makes instrumented drains/epochs
+  export the trace there (last writer wins).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from deeplearning4j_tpu.telemetry.registry import (Counter,
+                                                   DEFAULT_MS_BUCKETS,
+                                                   DEFAULT_S_BUCKETS, Gauge,
+                                                   Histogram,
+                                                   MetricsRegistry)
+from deeplearning4j_tpu.telemetry.tracing import NULL_SPAN, Tracer
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
+    "DEFAULT_MS_BUCKETS", "DEFAULT_S_BUCKETS", "registry", "tracer", "span",
+    "instant", "enabled", "configure", "maybe_export_trace", "metrics_route",
+    "PROMETHEUS_CONTENT_TYPE",
+]
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+_ENABLED = os.environ.get("DL4J_TPU_TELEMETRY", "1").lower() \
+    not in ("0", "false", "off")
+_REGISTRY = MetricsRegistry()
+_TRACER = Tracer(enabled=_ENABLED)
+
+
+def registry() -> MetricsRegistry:
+    """The process-wide default metrics registry."""
+    return _REGISTRY
+
+
+def tracer() -> Tracer:
+    """The process-wide default tracer."""
+    return _TRACER
+
+
+def enabled() -> bool:
+    """Whether span recording is on (DL4J_TPU_TELEMETRY, default on)."""
+    return _ENABLED
+
+
+def configure(enabled: Optional[bool] = None) -> None:
+    """Override the env default at runtime (tests, embedding apps)."""
+    global _ENABLED
+    if enabled is not None:
+        _ENABLED = bool(enabled)
+        _TRACER.enabled = _ENABLED
+
+
+def span(name: str, **args):
+    """Record a span into the global tracer (no-op when disabled)."""
+    if not _ENABLED:
+        return NULL_SPAN
+    return _TRACER.span(name, **args)
+
+
+def instant(name: str, **args) -> None:
+    """Record an instant event into the global tracer (no-op when
+    disabled)."""
+    if _ENABLED:
+        _TRACER.instant(name, **args)
+
+
+def maybe_export_trace(path: Optional[str] = None) -> Optional[str]:
+    """Export the global tracer's Chrome trace to `path` or
+    `$DL4J_TPU_TRACE_PATH`; returns the written path or None when no
+    destination is configured / tracing is disabled / nothing recorded."""
+    path = path or os.environ.get("DL4J_TPU_TRACE_PATH")
+    if not path or not _ENABLED or _TRACER.n_events == 0:
+        return None
+    return _TRACER.export(path)
+
+
+def metrics_route(reg: Optional[MetricsRegistry] = None):
+    """A GET route fn for util/http.JsonHttpServer serving the Prometheus
+    text exposition: JsonHttpServer({"GET /metrics": metrics_route()})."""
+    from deeplearning4j_tpu.util.http import PlainTextResponse
+
+    def handler(_query):
+        return PlainTextResponse((reg or _REGISTRY).prometheus_text(),
+                                 content_type=PROMETHEUS_CONTENT_TYPE)
+    return handler

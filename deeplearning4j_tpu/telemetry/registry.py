@@ -1,0 +1,263 @@
+"""Sync-free metrics registry: counters, gauges, fixed-bucket histograms.
+
+Design constraints (ISSUE 4 tentpole):
+- ZERO device syncs: every metric is fed from values the caller already holds
+  on the host (Python ints/floats, host timestamps, materialized masks). The
+  registry never touches jax — it is pure stdlib + numpy.
+- Lock-free hot path: `Counter.inc` / `Gauge.set` / `Histogram.observe` take
+  no locks. Counters are single-writer by design (the serving engine's
+  scheduler thread, the training loop's listener thread); under the GIL a
+  plain int add from one writer is exact, and concurrent writers at worst
+  lose an increment — never corrupt state or block the decode path. The only
+  lock in the module guards metric REGISTRATION (get-or-create), which is
+  off the hot path.
+- Preallocated storage: histogram bucket counts live in a fixed numpy int64
+  array and recent raw observations in a preallocated float64 ring buffer,
+  so steady-state observation allocates nothing.
+
+Exposition: `snapshot()` returns a point-in-time dict (exact ring-buffer
+quantiles over the recent window); `prometheus_text()` renders the standard
+text format (names sanitized, histogram `_bucket{le=...}`/`_sum`/`_count`).
+A registry built with `parent=` is also reachable from the parent's
+exposition (weakly referenced), so per-engine registries show up on the
+process-wide /metrics endpoint without double bookkeeping.
+"""
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+import weakref
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# default latency buckets (milliseconds): sub-ms dispatches up to minute-scale
+DEFAULT_MS_BUCKETS = (0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
+                      1000, 2500, 5000, 10000, 30000, 60000)
+# default duration buckets (seconds): TTFT / request-level spans
+DEFAULT_S_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                     0.5, 1, 2.5, 5, 10, 30, 60)
+_RING = 1024              # exact-quantile window per histogram
+
+
+class Counter:
+    """Monotonic (resettable) event counter. Single-writer, lock-free."""
+    __slots__ = ("name", "help", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0
+
+    def inc(self, n: int = 1) -> None:
+        self._value += n
+
+    def reset(self, value: int = 0) -> None:
+        self._value = int(value)
+
+    @property
+    def value(self) -> int:
+        return self._value
+
+
+class Gauge:
+    """Last-set instantaneous value. Lock-free."""
+    __slots__ = ("name", "help", "_value")
+
+    def __init__(self, name: str, help: str = ""):
+        self.name = name
+        self.help = help
+        self._value = 0.0
+
+    def set(self, value: float) -> None:
+        self._value = float(value)
+
+    def reset(self, value: float = 0.0) -> None:
+        self._value = float(value)
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+
+class Histogram:
+    """Fixed-bucket latency histogram with a preallocated ring buffer of
+    recent raw observations (exact quantiles over the last `_RING` samples;
+    bucket interpolation would lose precision exactly where p99 matters)."""
+    __slots__ = ("name", "help", "bounds", "_counts", "_sum", "_ring",
+                 "_written")
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Sequence[float] = DEFAULT_MS_BUCKETS):
+        self.name = name
+        self.help = help
+        self.bounds: Tuple[float, ...] = tuple(sorted(float(b)
+                                                      for b in buckets))
+        # one extra slot for the +Inf bucket
+        self._counts = np.zeros(len(self.bounds) + 1, np.int64)
+        self._sum = 0.0
+        self._ring = np.zeros(_RING, np.float64)
+        self._written = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        self._counts[bisect.bisect_left(self.bounds, v)] += 1
+        self._sum += v
+        self._ring[self._written % _RING] = v
+        self._written += 1
+
+    def reset(self) -> None:
+        self._counts[:] = 0
+        self._sum = 0.0
+        self._written = 0
+
+    @property
+    def count(self) -> int:
+        return int(self._counts.sum())
+
+    @property
+    def sum(self) -> float:
+        return self._sum
+
+    def quantile(self, q: float) -> Optional[float]:
+        """Exact quantile over the recent window (last `_RING` samples)."""
+        n = min(self._written, _RING)
+        if n == 0:
+            return None
+        window = np.sort(self._ring[:n])
+        idx = min(n - 1, max(0, int(math.ceil(q * n)) - 1))
+        return float(window[idx])
+
+    def snapshot(self) -> dict:
+        out = {"count": self.count, "sum": round(self._sum, 6)}
+        for q, key in ((0.5, "p50"), (0.9, "p90"), (0.99, "p99")):
+            v = self.quantile(q)
+            out[key] = None if v is None else round(v, 6)
+        out["buckets"] = {("+Inf" if i == len(self.bounds)
+                           else repr(self.bounds[i])): int(c)
+                          for i, c in enumerate(self._counts) if c}
+        return out
+
+
+class MetricsRegistry:
+    """Get-or-create home for named metrics. Metric names use dotted paths
+    ("serving.host_syncs"); Prometheus exposition sanitizes them to
+    underscores. A child registry (parent=...) keeps its own storage but is
+    included in the parent's `prometheus_text()` — same-named counters and
+    histogram buckets aggregate across children (the process-level view),
+    gauges take the last registry's value."""
+
+    def __init__(self, parent: Optional["MetricsRegistry"] = None):
+        self._metrics: Dict[str, object] = {}
+        self._lock = threading.Lock()           # registration only
+        self._children: List[weakref.ref] = []
+        if parent is not None:
+            parent._adopt(self)
+
+    def _adopt(self, child: "MetricsRegistry") -> None:
+        with self._lock:
+            self._children = [r for r in self._children if r() is not None]
+            self._children.append(weakref.ref(child))
+
+    def _get_or_create(self, name: str, cls, **kw):
+        m = self._metrics.get(name)
+        if m is None:
+            with self._lock:
+                m = self._metrics.get(name)
+                if m is None:
+                    m = cls(name, **kw)
+                    self._metrics[name] = m
+        if not isinstance(m, cls):
+            raise TypeError(f"metric {name!r} already registered as "
+                            f"{type(m).__name__}, not {cls.__name__}")
+        return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, Counter, help=help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(name, Gauge, help=help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_MS_BUCKETS) -> Histogram:
+        return self._get_or_create(name, Histogram, help=help,
+                                   buckets=buckets)
+
+    def get(self, name: str):
+        return self._metrics.get(name)
+
+    def reset(self) -> None:
+        """Zero every metric (bench warm-up exclusion)."""
+        for m in list(self._metrics.values()):
+            m.reset()
+
+    def snapshot(self) -> Dict[str, object]:
+        """Point-in-time view: counters/gauges as scalars, histograms as
+        {count, sum, p50, p90, p99, buckets}. Best-effort consistency — no
+        locks are taken, matching the lock-free write side."""
+        out: Dict[str, object] = {}
+        for name, m in list(self._metrics.items()):
+            out[name] = m.snapshot() if isinstance(m, Histogram) else m.value
+        return out
+
+    # ------------------------------------------------------- exposition
+    def _all_registries(self) -> List["MetricsRegistry"]:
+        regs = [self]
+        with self._lock:
+            children = [r() for r in self._children]
+        regs.extend(c for c in children if c is not None)
+        return regs
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition (version 0.0.4) over this registry and
+        its live children. Same-named counters and histogram buckets sum
+        across registries; gauges take the last value seen."""
+        families: Dict[str, List[object]] = {}
+        for reg in self._all_registries():
+            for name, m in list(reg._metrics.items()):
+                families.setdefault(name, []).append(m)
+        lines: List[str] = []
+        for name in sorted(families):
+            ms = families[name]
+            pname = _sanitize(name)
+            first = ms[0]
+            if isinstance(first, Counter):
+                if first.help:
+                    lines.append(f"# HELP {pname} {first.help}")
+                lines.append(f"# TYPE {pname} counter")
+                lines.append(f"{pname} {sum(m.value for m in ms)}")
+            elif isinstance(first, Gauge):
+                if first.help:
+                    lines.append(f"# HELP {pname} {first.help}")
+                lines.append(f"# TYPE {pname} gauge")
+                lines.append(f"{pname} {_fmt(ms[-1].value)}")
+            elif isinstance(first, Histogram):
+                if first.help:
+                    lines.append(f"# HELP {pname} {first.help}")
+                lines.append(f"# TYPE {pname} histogram")
+                bounds = first.bounds
+                totals = np.zeros(len(bounds) + 1, np.int64)
+                total_sum = 0.0
+                for m in ms:
+                    if m.bounds == bounds:
+                        totals += m._counts
+                        total_sum += m.sum
+                cum = 0
+                for i, b in enumerate(bounds):
+                    cum += int(totals[i])
+                    lines.append(f'{pname}_bucket{{le="{_fmt(b)}"}} {cum}')
+                cum += int(totals[-1])
+                lines.append(f'{pname}_bucket{{le="+Inf"}} {cum}')
+                lines.append(f"{pname}_sum {_fmt(total_sum)}")
+                lines.append(f"{pname}_count {cum}")
+        return "\n".join(lines) + "\n"
+
+
+def _sanitize(name: str) -> str:
+    return "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+
+
+def _fmt(v: float) -> str:
+    f = float(v)
+    return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)

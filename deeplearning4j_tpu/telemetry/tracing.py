@@ -1,0 +1,138 @@
+"""Span tracing with Chrome-trace/Perfetto JSON export.
+
+Spans are recorded from HOST timestamps only (`time.perf_counter`) — entering
+or exiting a span never materializes device data, so tracing the decode hot
+loop adds zero host syncs (the ISSUE 4 invariant, asserted in
+tests/test_telemetry.py). Events land in a bounded in-memory buffer
+(preallocated-size list, drops counted past the cap) and export as standard
+Chrome trace JSON (`{"traceEvents": [...]}` — load in chrome://tracing or
+https://ui.perfetto.dev).
+
+Span vocabulary used across the framework (see serving/engine.py,
+optimize/solvers.py, optimize/listeners.py):
+- "prefill"       — one admission's prompt prefill dispatch
+- "decode_chunk"  — one chunked-decode dispatch (args: k, active)
+- "host_sync"     — an existing device->host materialization (args: what)
+- "jit_compile"   — first-use of a compiled shape (cache-miss attribution);
+                    wraps the dispatch that triggered the compile
+- "admit"/"retire" — instant events for scheduling decisions
+- "epoch"/"solver.optimize" — training-side phases
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Dict, List, Optional
+
+_US = 1e6
+
+
+class _NullSpan:
+    """No-op context manager returned when tracing is disabled — the hot
+    path pays one attribute check and nothing else."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "args", "_t0", "_tid")
+
+    def __init__(self, tracer: "Tracer", name: str, args: Optional[dict]):
+        self._tracer = tracer
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._tid = threading.get_ident()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        self._tracer._record("X", self.name, self._t0, t1 - self._t0,
+                             self._tid, self.args)
+        return False
+
+
+class Tracer:
+    """Bounded in-memory span recorder. All methods are cheap host work;
+    `export()` is the only I/O."""
+
+    def __init__(self, max_events: int = 65536, enabled: bool = True):
+        self.max_events = int(max_events)
+        self.enabled = bool(enabled)
+        self._events: List[dict] = []
+        self._dropped = 0
+        self._epoch = time.perf_counter()
+        self._lock = threading.Lock()   # append-side: list.append is atomic
+        #                                 under the GIL; the lock guards only
+        #                                 clear()/export() vs. appends
+
+    # ------------------------------------------------------------ record
+    def span(self, name: str, **args):
+        """Context manager timing a region as one Chrome 'X' complete
+        event. Returns a no-op when the tracer is disabled."""
+        if not self.enabled:
+            return NULL_SPAN
+        return _Span(self, name, args or None)
+
+    def instant(self, name: str, **args) -> None:
+        """Zero-duration instant event (scheduling decisions)."""
+        if not self.enabled:
+            return
+        self._record("i", name, time.perf_counter(), None,
+                     threading.get_ident(), args or None)
+
+    def _record(self, ph: str, name: str, t0: float, dur: Optional[float],
+                tid: int, args: Optional[dict]) -> None:
+        if len(self._events) >= self.max_events:
+            self._dropped += 1
+            return
+        ev: Dict[str, object] = {
+            "name": name, "ph": ph, "pid": 1, "tid": tid,
+            "ts": round((t0 - self._epoch) * _US, 3),
+            "cat": name.split(".")[0].split("_")[0],
+        }
+        if ph == "X":
+            ev["dur"] = round((dur or 0.0) * _US, 3)
+        elif ph == "i":
+            ev["s"] = "t"
+        if args:
+            ev["args"] = args
+        self._events.append(ev)
+
+    # ------------------------------------------------------------ export
+    def clear(self) -> None:
+        with self._lock:
+            self._events = []
+            self._dropped = 0
+
+    @property
+    def n_events(self) -> int:
+        return len(self._events)
+
+    def chrome_trace(self) -> dict:
+        """The exported document: Chrome trace 'JSON Object Format'."""
+        with self._lock:
+            events = list(self._events)
+            dropped = self._dropped
+        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+               "otherData": {"producer": "deeplearning4j_tpu.telemetry"}}
+        if dropped:
+            doc["otherData"]["dropped_events"] = dropped
+        return doc
+
+    def export(self, path: str) -> str:
+        """Write the Chrome trace JSON to `path`; returns the path."""
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(), f)
+        return path

@@ -1,0 +1,82 @@
+"""Canonical per-iteration training bookkeeping on top of the registry.
+
+Both `optimize.listeners.TelemetryListener` and `ui.stats.StatsListener`
+report per-iteration wall time; before ISSUE 4 each kept its own
+`_last_report_time` stopwatch. This module is the single source: every
+listener calls `mark_iteration(iteration)` and the FIRST call for a given
+iteration number observes the timing into the registry (histogram
+`training.iteration_ms`, counter `training.iterations`); later calls for
+the same iteration get the cached record back — attach as many listeners
+as you like, the iteration is timed once.
+
+`lagged_score` is the sync-free score read (satellite: PerformanceListener
+must not force a device sync per iteration): it returns the PREVIOUS
+iteration's score — whose device buffer has materialized while the current
+step ran — and stashes the current handle for next time. One step stale by
+construction, never a forced pipeline flush.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from deeplearning4j_tpu.telemetry.registry import (DEFAULT_MS_BUCKETS,
+                                                   MetricsRegistry)
+
+_lock = threading.Lock()
+_last_time: Optional[float] = None
+_last_iter: Optional[int] = None
+_last_record: dict = {"iteration": None, "iteration_ms": None}
+
+
+def mark_iteration(iteration: int, registry: Optional[MetricsRegistry] = None
+                   ) -> dict:
+    """Record one training iteration boundary (idempotent per iteration
+    number). Returns {"iteration", "iteration_ms"} where iteration_ms is the
+    host wall time since the previous distinct iteration (None on the
+    first)."""
+    global _last_time, _last_iter, _last_record
+    from deeplearning4j_tpu import telemetry
+    reg = registry or telemetry.registry()
+    now = time.perf_counter()
+    with _lock:
+        if iteration == _last_iter:
+            return dict(_last_record)
+        ms = None if _last_time is None else (now - _last_time) * 1e3
+        _last_time, _last_iter = now, iteration
+        _last_record = {"iteration": iteration, "iteration_ms": ms}
+        record = dict(_last_record)
+    reg.counter("training.iterations",
+                "training iterations completed").inc()
+    if ms is not None:
+        reg.histogram("training.iteration_ms",
+                      "wall time per training iteration (host clock)",
+                      buckets=DEFAULT_MS_BUCKETS).observe(ms)
+    return record
+
+
+def reset() -> None:
+    """Forget iteration-boundary state (tests)."""
+    global _last_time, _last_iter, _last_record
+    with _lock:
+        _last_time = _last_iter = None
+        _last_record = {"iteration": None, "iteration_ms": None}
+
+
+def lagged_score(store, model) -> Optional[float]:
+    """One-step-stale, sync-free score read. `store` holds the stash (any
+    object with settable attributes — typically the listener); `model` is
+    the network whose `_score` is a deferred device scalar. Returns the
+    score the model had BEFORE its latest step (that buffer has had a full
+    step's wall time to materialize, so reading it is a copy of a completed
+    result, not a forced `block_until_ready` on in-flight compute), or None
+    until two iterations have run."""
+    prev = getattr(store, "_telemetry_prev_score", None)
+    store._telemetry_prev_score = getattr(model, "_score", None)
+    if prev is None:
+        return None
+    try:
+        return float(prev)
+    except Exception:
+        return None

@@ -15,6 +15,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.ui.storage import StatsStorage, StatsStorageRouter
 
 _PAGE = """<!DOCTYPE html>
@@ -176,6 +177,19 @@ class _Handler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if parts == ["metrics"]:
+            # Prometheus text exposition over the attached registry (default:
+            # the process-wide telemetry registry + its per-engine children)
+            reg = getattr(self.server, "metrics_registry", None) \
+                or telemetry.registry()
+            body = reg.prometheus_text().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             telemetry.PROMETHEUS_CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if parts[0] != "train" or st is None:
             self._json({"error": "not found"}, 404)
             return
@@ -215,6 +229,7 @@ class UIServer:
     def __init__(self, port: int = 9000):
         self._httpd = ThreadingHTTPServer(("localhost", port), _Handler)
         self._httpd.stats_storage = None  # type: ignore[attr-defined]
+        self._httpd.metrics_registry = None  # type: ignore[attr-defined]
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
@@ -232,6 +247,11 @@ class UIServer:
 
     def attach(self, storage: StatsStorage) -> None:
         self._httpd.stats_storage = storage  # type: ignore[attr-defined]
+
+    def attach_metrics(self, registry) -> None:
+        """Scope GET /metrics to a specific MetricsRegistry (e.g. one
+        engine's `eng.metrics`) instead of the process-wide default."""
+        self._httpd.metrics_registry = registry  # type: ignore[attr-defined]
 
     def detach(self, storage: StatsStorage = None) -> None:
         self._httpd.stats_storage = None  # type: ignore[attr-defined]

@@ -20,6 +20,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
+from deeplearning4j_tpu.telemetry.training import mark_iteration
 from deeplearning4j_tpu.ui.storage import StatsStorageRouter
 
 _HIST_BINS = 20
@@ -68,7 +69,6 @@ class StatsListener(TrainingListener):
         self._static_posted = False
         self._prev_params = None
         self._summary_jit = None
-        self._last_report_time = None
 
     # ------------- static info (ref listener initialization records) -------------
     def _post_static(self, model):
@@ -117,6 +117,12 @@ class StatsListener(TrainingListener):
         return jax.jit(f)
 
     def iteration_done(self, model, iteration: int):
+        # iteration timing comes from the telemetry registry's canonical
+        # per-iteration bookkeeping (telemetry/training.py) instead of a
+        # private `_last_report_time` stopwatch — mark EVERY iteration
+        # (idempotent: a co-attached TelemetryListener and this listener
+        # together still time each iteration once), report every Nth
+        it_rec = mark_iteration(iteration)
         if iteration % self.frequency != 0:
             return
         if not self._static_posted:
@@ -158,10 +164,8 @@ class StatsListener(TrainingListener):
             "stats": stats_py,
             "learning_rates": self._learning_rates(model),
         }
-        if self._last_report_time is not None:
-            record["iteration_ms"] = (now - self._last_report_time) * 1e3 \
-                / self.frequency
-        self._last_report_time = now
+        if it_rec["iteration_ms"] is not None:
+            record["iteration_ms"] = it_rec["iteration_ms"]
         if self.collect_memory:
             record["memory"] = _memory_stats()
         self.storage.put_update(record)

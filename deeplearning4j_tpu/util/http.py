@@ -9,9 +9,22 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
 
+class PlainTextResponse:
+    """Route return value that bypasses JSON rendering — the body is sent
+    verbatim with the given content type (Prometheus text exposition, raw
+    dumps)."""
+
+    def __init__(self, body: str, content_type: str = "text/plain; "
+                 "charset=utf-8", status: int = 200):
+        self.body = body
+        self.content_type = content_type
+        self.status = int(status)
+
+
 class JsonHttpServer:
     """Routes: dict "METHOD /path" -> fn. GET fns take (query: dict) and POST
-    fns take (body: dict); both return a JSON-able object. Exceptions render as
+    fns take (body: dict); both return a JSON-able object, or a
+    PlainTextResponse for non-JSON bodies. Exceptions render as
     {"error": ...} with status 500 (ValueError/KeyError: 400); unknown paths
     404. Start is immediate (daemon thread); `port`/`address`/`stop` as in the
     reference servers."""
@@ -26,8 +39,11 @@ class JsonHttpServer:
 
             def _json(self, obj, code=200):
                 body = json.dumps(obj, default=str).encode()
+                self._send(body, "application/json", code)
+
+            def _send(self, body: bytes, content_type: str, code: int):
                 self.send_response(code)
-                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Type", content_type)
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
@@ -47,7 +63,12 @@ class JsonHttpServer:
                     else:
                         payload = {k: v[0] for k, v in
                                    parse_qs(url.query).items()}
-                    self._json(fn(payload))
+                    result = fn(payload)
+                    if isinstance(result, PlainTextResponse):
+                        self._send(result.body.encode(),
+                                   result.content_type, result.status)
+                    else:
+                        self._json(result)
                 except (ValueError, KeyError, IndexError) as e:
                     self._json({"error": f"{type(e).__name__}: {e}"}, 400)
                 except Exception as e:

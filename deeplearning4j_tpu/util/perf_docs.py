@@ -138,6 +138,14 @@ def render_block(art: dict) -> str:
                     f"the same-session K=1 per-token-sync control at "
                     f"{k1['decode_tokens_per_sec']:,.0f} tok/s)")
             line += "."
+        tel = dec.get("telemetry") or {}
+        if tel.get("decode_chunk_ms_p50") is not None:
+            line += (
+                f" Decode chunk latency p50/p99 "
+                f"{tel['decode_chunk_ms_p50']:.2f}/"
+                f"{tel.get('decode_chunk_ms_p99', float('nan')):.2f} ms, "
+                f"{tel.get('jit_compiles', 0)} jit compiles in the timed "
+                f"serve (telemetry registry).")
         lines.append(line)
     lines.append(
         f"- ParallelWrapper ResNet50: {pw['images_per_sec']:,.0f} img/s — "
