@@ -168,6 +168,34 @@ def bench_resnet50(batch=256, steps=30, compute_dtype="bfloat16",
     return out
 
 
+def bench_training_health(batch=256, steps=30, compute_dtype="bfloat16",
+                          reps=4, policy="record"):
+    """In-step training-health monitor A/B on the ResNet50 bench path
+    (ISSUE 5): the same _device_loop_time slope protocol run twice on
+    identically-seeded nets — health off vs `configure_health(policy=
+    "record")` — publishing the measured overhead of the diagnostics
+    side-outputs. The record policy is bit-parity-tested
+    (tests/test_health.py), so the delta is pure side-output cost: a
+    handful of float32 norms per layer folded into the scan carry, read
+    back lazily (never inside the timed loop)."""
+    from deeplearning4j_tpu.models import ResNet50
+    rng = np.random.RandomState(0)
+    x, y = _synth(rng, batch, 1000, 3, 224, 224)
+    ms = {}
+    for mode in ("off", "on"):
+        net = ResNet50(num_labels=1000, seed=42,
+                       compute_dtype=compute_dtype).init()
+        if mode == "on":
+            net.configure_health(policy=policy)
+        dt, _ = _device_loop_time(net, x, y, steps, reps=reps)
+        ms[mode] = dt / steps * 1e3
+    return {"ms_per_iter_health_off": ms["off"],
+            "ms_per_iter_health_on": ms["on"],
+            "overhead_pct": (ms["on"] - ms["off"]) / ms["off"] * 100.0,
+            "policy": policy, "batch": batch, "steps": steps,
+            "compute_dtype": compute_dtype or "float32"}
+
+
 def bench_resnet50_roofline(resnet_entry, batch=256):
     """HBM roofline for the headline config (VERDICT r3 next#1: prove the
     ceiling with numbers). Brackets the bandwidth floor two ways:
@@ -198,7 +226,8 @@ def bench_resnet50_roofline(resnet_entry, batch=256):
     run = net._get_device_loop()
     costs = lowered_costs(
         run, net.params_tree, net._opt_state, net.state_tree,
-        jnp.asarray(0, jnp.int32), net._rng, (x,), (y,), None, None, n=1)
+        jnp.asarray(0, jnp.int32), net._rng, (x,), (y,), None, None,
+        net._health_nf_in(), n=1)
     ms = resnet_entry["ms_per_iter"]
     mxu_ms = costs["flops"] / PEAK_FLOPS_PER_CHIP * 1e3
     lb_ms = lb_bytes / HBM_GBS * 1e3
@@ -823,6 +852,10 @@ def main():
         roofline = bench_resnet50_roofline(resnet_bf16)
     except Exception as e:
         roofline = {"error": f"{type(e).__name__}: {e}"}
+    try:  # health-monitor A/B (ISSUE 5): overhead must stay a rounding error
+        health_ab = bench_training_health()
+    except Exception as e:
+        health_ab = {"error": f"{type(e).__name__}: {e}"}
     try:
         lstm_roofline = bench_graves_lstm_roofline(
             lstm_helpers if "ms_per_iter" in lstm_helpers else lstm)
@@ -877,6 +910,7 @@ def main():
             "resnet50_bf16_helpers_on": _r(resnet_helpers),
             "resnet50_roofline": roofline,
             "resnet50_fp32": _r(resnet_fp32),
+            "training_health": _r(health_ab),
             "lenet_mnist_step_ms": round(lenet["ms_per_iter"], 3),
             "lenet_samples_per_sec": round(lenet["samples_per_sec"], 1),
             "lenet_roofline": lenet.get("roofline"),

@@ -23,6 +23,7 @@ from deeplearning4j_tpu.nn.divergence import DivergenceSentinelMixin
 from deeplearning4j_tpu.nn.multilayer import (
     _apply_updates, _compute_updates, _normalize_gradients)
 from deeplearning4j_tpu.nn.updater.updaters import BaseUpdater
+from deeplearning4j_tpu.telemetry import health as _health
 from deeplearning4j_tpu.util.flat_params import flatten_params, num_params, unflatten_params
 
 
@@ -34,7 +35,7 @@ def _as_list(x) -> List:
     return [x]
 
 
-class ComputationGraph(DivergenceSentinelMixin):
+class ComputationGraph(DivergenceSentinelMixin, _health.HealthMonitorMixin):
     def __init__(self, conf: ComputationGraphConfiguration):
         self.conf = conf
         # layer nodes in topo order define the flat-param-view ordering
@@ -366,15 +367,33 @@ class ComputationGraph(DivergenceSentinelMixin):
     def _build_train_step(self):
         updaters = self._updaters
         layer_confs = self.layers
+        hc = self.health_config  # snapshot; configure_health retraces
+        health_on = hc is not None and hc.enabled
+        protect = health_on and hc.protects
 
         def train_step(params_tree, opt_state, state_tree, step, rng, x, y,
-                       fmask, lmask, rnn_init_states):
+                       fmask, lmask, rnn_init_states, health_nf_in):
             (loss, (new_states, final_rnn)), grads = jax.value_and_grad(
                 self._loss_fn, has_aux=True)(params_tree, state_tree, x, y, fmask,
                                              lmask, rng, True, rnn_init_states)
-            new_params, new_opt = _apply_updates(layer_confs, updaters, grads,
-                                                 opt_state, params_tree, step)
-            return new_params, new_opt, new_states, loss, final_rnn
+            if not health_on:
+                new_params, new_opt = _apply_updates(layer_confs, updaters, grads,
+                                                     opt_state, params_tree, step)
+                return new_params, new_opt, new_states, loss, final_rnn, None
+            # health side-output — see MultiLayerNetwork._build_train_step
+            upds, new_opt = _compute_updates(layer_confs, updaters, grads,
+                                             opt_state, params_tree, step)
+            new_params = [jax.tree_util.tree_map(lambda p, d: p - d, pt, ut)
+                          for pt, ut in zip(params_tree, upds)]
+            stats, bad = _health.summarize(params_tree, grads, upds, loss)
+            if protect:
+                keep = lambda new, old: jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(bad, b, a), new, old)
+                new_params = keep(new_params, params_tree)
+                new_opt = keep(new_opt, opt_state)
+                new_states = keep(new_states, state_tree)
+            stash = _health.step_stash(stats, bad, step, health_nf_in)
+            return new_params, new_opt, new_states, loss, final_rnn, stash
 
         self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2))
         return self._train_step_fn
@@ -392,15 +411,18 @@ class ComputationGraph(DivergenceSentinelMixin):
         if self._accumulator is not None:
             return self._fit_batch_accumulated(x, y, fmask, lmask, sub)
 
-        new_params, new_opt, new_states, loss, final_rnn = self._train_step_fn(
-            self.params_tree, self._opt_state, self.state_tree,
-            jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask,
-            rnn_init_states)
+        new_params, new_opt, new_states, loss, final_rnn, health_stash = \
+            self._train_step_fn(
+                self.params_tree, self._opt_state, self.state_tree,
+                jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask,
+                rnn_init_states, self._health_nf_in())
         self.params_tree = new_params
         self._opt_state = new_opt
         self.state_tree = new_states
         self._step += 1
         self._score = loss
+        if health_stash is not None:
+            self._stash_health(health_stash, steps=1)  # raises under policy="raise"
         for lst in self._listeners:
             lst.iteration_done(self, self._step)
         return final_rnn
@@ -435,12 +457,16 @@ class ComputationGraph(DivergenceSentinelMixin):
         run = self._get_device_loop(vary_batch)
 
         self._rng, sub = jax.random.split(self._rng)
-        (self.params_tree, self._opt_state, self.state_tree, _, _, div), losses = run(
-            self.params_tree, self._opt_state, self.state_tree,
-            jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask, n=int(steps))
+        (self.params_tree, self._opt_state, self.state_tree, _, _, div), losses, \
+            health_out = run(
+                self.params_tree, self._opt_state, self.state_tree,
+                jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask,
+                self._health_nf_in(), n=int(steps))
         self._step += int(steps)
         # sticky device-side stash (see DivergenceSentinelMixin)
         self._stash_pending_div(div)
+        if health_out is not None:
+            self._stash_health(health_out, steps=int(steps))
         if not sync:
             self._score = losses[-1]      # device scalar; host sync deferred
             return losses                 # divergence resolves on _diverged_at
@@ -457,19 +483,23 @@ class ComputationGraph(DivergenceSentinelMixin):
         loop-invariant hoisting of frozen-vertex forwards)."""
         import functools
 
-        cache_key = ("cg", vary_batch)
+        cache_key = ("cg", vary_batch, self._health_key())
         if not hasattr(self, "_device_loop_cache"):
             self._device_loop_cache = {}
         run = self._device_loop_cache.get(cache_key)
         if run is None:
             updaters = self._updaters
             layer_confs = self.layers
+            hc = self.health_config
+            health_on = hc is not None and hc.enabled
+            protect = health_on and hc.protects
 
             @functools.partial(jax.jit, donate_argnums=(0, 1, 2),
                                static_argnames=("n",))
-            def run(params, opt, states, step, rng, x, y, fmask, lmask, n):
+            def run(params, opt, states, step, rng, x, y, fmask, lmask,
+                    health_nf_in, n):
                 def body(carry, _):
-                    params_c, opt_c, states_c, step_c, rng_c, div_c = carry
+                    params_c, opt_c, states_c, step_c, rng_c, div_c, acc = carry
                     rng_c, sub = jax.random.split(rng_c)
                     if vary_batch:
                         roll = lambda t: jax.tree_util.tree_map(
@@ -486,24 +516,45 @@ class ComputationGraph(DivergenceSentinelMixin):
 
                     (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                         params_c)
-                    newp, newo = _apply_updates(layer_confs, updaters, grads, opt_c,
-                                                params_c, step_c)
-                    # divergence sentinel — see MultiLayerNetwork.fit_on_device
-                    bad = jnp.logical_or(~jnp.isfinite(loss), div_c >= 0)
+                    if health_on:
+                        # health side-output — see MultiLayerNetwork._get_device_loop
+                        upds, newo = _compute_updates(layer_confs, updaters,
+                                                      grads, opt_c, params_c,
+                                                      step_c)
+                        newp = [jax.tree_util.tree_map(lambda p, d: p - d, pt, ut)
+                                for pt, ut in zip(params_c, upds)]
+                        stats, badg = _health.summarize(params_c, grads, upds,
+                                                        loss)
+                        acc = _health.accumulate(acc, stats, badg, step_c)
+                    else:
+                        newp, newo = _apply_updates(layer_confs, updaters, grads,
+                                                    opt_c, params_c, step_c)
+                    if protect:
+                        # skip/raise policy: drop only the nonfinite step
+                        bad = badg
+                    else:
+                        # divergence sentinel — see MultiLayerNetwork.fit_on_device
+                        bad = jnp.logical_or(~jnp.isfinite(loss), div_c >= 0)
                     keep = lambda new, old: jax.tree_util.tree_map(
                         lambda a, b: jnp.where(bad, b, a), new, old)
                     newp = keep(newp, params_c)
                     newo = keep(newo, opt_c)
                     ns = keep(ns, states_c)
-                    div_c = jnp.where(jnp.logical_and(div_c < 0,
-                                                      ~jnp.isfinite(loss)),
-                                      step_c, div_c)
-                    return (newp, newo, ns, step_c + 1, rng_c, div_c), loss
+                    if not protect:
+                        div_c = jnp.where(jnp.logical_and(div_c < 0,
+                                                          ~jnp.isfinite(loss)),
+                                          step_c, div_c)
+                    return (newp, newo, ns, step_c + 1, rng_c, div_c, acc), loss
 
                 div0 = jnp.asarray(-1, jnp.int32)
+                acc0 = _health.init_accum(len(layer_confs)) if health_on else None
                 carry, losses = jax.lax.scan(
-                    body, (params, opt, states, step, rng, div0), None, length=n)
-                return carry, losses
+                    body, (params, opt, states, step, rng, div0, acc0), None,
+                    length=n)
+                newp, newo, ns, stepf, rngf, divf, accf = carry
+                health_out = _health.finalize(accf, n, health_nf_in) \
+                    if health_on else None
+                return (newp, newo, ns, stepf, rngf, divf), losses, health_out
             self._device_loop_cache[cache_key] = run
         return run
 
@@ -518,7 +569,7 @@ class ComputationGraph(DivergenceSentinelMixin):
         return lowered_flops(
             run, self.params_tree, self._opt_state, self.state_tree,
             jnp.asarray(self._step, jnp.int32), self._rng, x, y, None, None,
-            n=1)
+            self._health_nf_in(), n=1)
 
     def fit(self, data, labels=None, epochs: int = 1):
         """fit(x(s), y(s)) | fit(DataSet/MultiDataSet) | fit(iterator[, epochs])

@@ -23,6 +23,7 @@ from deeplearning4j_tpu.nn.conf.input_type import InputType
 from deeplearning4j_tpu.nn.conf.layers.base import BaseLayerConf, apply_dropout
 from deeplearning4j_tpu.nn.conf.layers.recurrent import LSTM
 from deeplearning4j_tpu.nn.divergence import DivergenceSentinelMixin
+from deeplearning4j_tpu.telemetry import health as _health
 from deeplearning4j_tpu.nn.conf.preprocessors import (
     FeedForwardToRnnPreProcessor, RnnToFeedForwardPreProcessor)
 from deeplearning4j_tpu.nn.updater.updaters import BaseUpdater, Sgd
@@ -78,7 +79,7 @@ def _apply_updates(layers, updaters, grads, opt_state, params_tree, step):
     return new_params, new_opt
 
 
-class MultiLayerNetwork(DivergenceSentinelMixin):
+class MultiLayerNetwork(DivergenceSentinelMixin, _health.HealthMonitorMixin):
     def __init__(self, conf: MultiLayerConfiguration):
         self.conf = conf
         self.layers: List[BaseLayerConf] = conf.layers
@@ -346,15 +347,37 @@ class MultiLayerNetwork(DivergenceSentinelMixin):
     def _build_train_step(self):
         updaters = self._updaters
         layers = self.layers
+        hc = self.health_config  # snapshot: config changes retrace via configure_health
+        health_on = hc is not None and hc.enabled
+        protect = health_on and hc.protects
 
         def train_step(params_tree, opt_state, state_tree, step, rng, x, y, fmask, lmask,
-                       rnn_init_states):
+                       rnn_init_states, health_nf_in):
             (loss, (new_states, final_rnn)), grads = jax.value_and_grad(
                 self._loss_fn, has_aux=True)(params_tree, state_tree, x, y, fmask,
                                              lmask, rng, True, rnn_init_states)
-            new_params, new_opt = _apply_updates(layers, updaters, grads,
-                                                 opt_state, params_tree, step)
-            return new_params, new_opt, new_states, loss, final_rnn
+            if not health_on:
+                new_params, new_opt = _apply_updates(layers, updaters, grads,
+                                                     opt_state, params_tree, step)
+                return new_params, new_opt, new_states, loss, final_rnn, None
+            # health side-output (ISSUE 5): same update math as _apply_updates,
+            # split so the pre-subtraction updates feed the summary — pure
+            # observation under policy="record" (bit-parity tested)
+            upds, new_opt = _compute_updates(layers, updaters, grads, opt_state,
+                                             params_tree, step)
+            new_params = [jax.tree_util.tree_map(lambda p, d: p - d, pt, ut)
+                          for pt, ut in zip(params_tree, upds)]
+            stats, bad = _health.summarize(params_tree, grads, upds, loss)
+            if protect:
+                # skip/raise policy: a nonfinite step leaves every training
+                # buffer untouched — one select per buffer, no host sync
+                keep = lambda new, old: jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(bad, b, a), new, old)
+                new_params = keep(new_params, params_tree)
+                new_opt = keep(new_opt, opt_state)
+                new_states = keep(new_states, state_tree)
+            stash = _health.step_stash(stats, bad, step, health_nf_in)
+            return new_params, new_opt, new_states, loss, final_rnn, stash
 
         # donate params/opt-state/bn-state buffers: in-place update on device
         self._train_step_fn = jax.jit(train_step, donate_argnums=(0, 1, 2),
@@ -376,14 +399,18 @@ class MultiLayerNetwork(DivergenceSentinelMixin):
         if self._accumulator is not None:
             return self._fit_batch_accumulated(x, y, fmask, lmask, rnn_init_states)
 
-        new_params, new_opt, new_states, loss, final_rnn = self._train_step_fn(
-            self.params_tree, self._opt_state, self.state_tree,
-            jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask, rnn_init_states)
+        new_params, new_opt, new_states, loss, final_rnn, health_stash = \
+            self._train_step_fn(
+                self.params_tree, self._opt_state, self.state_tree,
+                jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask,
+                rnn_init_states, self._health_nf_in())
         self.params_tree = new_params
         self._opt_state = new_opt
         self.state_tree = new_states
         self._step += 1
         self._score = loss  # device scalar; host sync deferred to score()
+        if health_stash is not None:
+            self._stash_health(health_stash, steps=1)  # raises under policy="raise"
         for lst in self._listeners:
             lst.iteration_done(self, self._step)
         return final_rnn
@@ -452,13 +479,19 @@ class MultiLayerNetwork(DivergenceSentinelMixin):
         run = self._get_device_loop(per_step_data, has_fm, has_lm, vary_batch)
 
         self._rng, sub = jax.random.split(self._rng)
-        (self.params_tree, self._opt_state, self.state_tree, _, _, div), losses = run(
-            self.params_tree, self._opt_state, self.state_tree,
-            jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask, n=int(steps))
+        (self.params_tree, self._opt_state, self.state_tree, _, _, div), losses, \
+            health_out = run(
+                self.params_tree, self._opt_state, self.state_tree,
+                jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask,
+                self._health_nf_in(), n=int(steps))
         self._step += int(steps)
         # sticky device-side stash: a clean later call must not clobber an
         # unobserved divergence from an earlier deferred call
         self._stash_pending_div(div)
+        if health_out is not None:
+            # ONE device-side aggregate per fit_on_device call; materializes
+            # lazily via health_report() (raises now under policy="raise")
+            self._stash_health(health_out, steps=int(steps))
         if not sync:
             self._score = losses[-1]      # device scalar; host sync deferred
             return losses                 # divergence resolves on _diverged_at
@@ -471,19 +504,24 @@ class MultiLayerNetwork(DivergenceSentinelMixin):
                          vary_batch: bool = False):
         """Build (or fetch from cache) the jitted scan training loop used by
         fit_on_device / train_step_flops."""
-        cache_key = ("mln", per_step_data, has_fm, has_lm, vary_batch)
+        cache_key = ("mln", per_step_data, has_fm, has_lm, vary_batch,
+                     self._health_key())
         if not hasattr(self, "_device_loop_cache"):
             self._device_loop_cache = {}
         run = self._device_loop_cache.get(cache_key)
         if run is None:
             updaters = self._updaters
             layers = self.layers
+            hc = self.health_config
+            health_on = hc is not None and hc.enabled
+            protect = health_on and hc.protects
 
             @functools.partial(jax.jit, donate_argnums=(0, 1, 2),
                                static_argnames=("n",))
-            def run(params, opt, states, step, rng, x, y, fmask, lmask, n):
+            def run(params, opt, states, step, rng, x, y, fmask, lmask,
+                    health_nf_in, n):
                 def body(carry, xs):
-                    params_c, opt_c, states_c, step_c, rng_c, div_c = carry
+                    params_c, opt_c, states_c, step_c, rng_c, div_c, acc = carry
                     if per_step_data:
                         bx, by = xs[0], xs[1]
                         bfm = xs[2] if has_fm else None
@@ -506,22 +544,41 @@ class MultiLayerNetwork(DivergenceSentinelMixin):
 
                     (loss, ns), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                         params_c)
-                    newp, newo = _apply_updates(layers, updaters, grads, opt_c,
-                                                params_c, step_c)
-                    # divergence sentinel (SURVEY §5 failure detection): once a
-                    # non-finite loss appears, freeze params/opt/state for the rest
-                    # of the scan and record the first bad step — a cheap select per
-                    # buffer, no host sync inside the loop
-                    bad = jnp.logical_or(~jnp.isfinite(loss), div_c >= 0)
+                    if health_on:
+                        # health side-output accumulated in the carry (ISSUE 5):
+                        # same update math, split to expose the updates
+                        upds, newo = _compute_updates(layers, updaters, grads,
+                                                      opt_c, params_c, step_c)
+                        newp = [jax.tree_util.tree_map(lambda p, d: p - d, pt, ut)
+                                for pt, ut in zip(params_c, upds)]
+                        stats, badg = _health.summarize(params_c, grads, upds,
+                                                        loss)
+                        acc = _health.accumulate(acc, stats, badg, step_c)
+                    else:
+                        newp, newo = _apply_updates(layers, updaters, grads,
+                                                    opt_c, params_c, step_c)
+                    if protect:
+                        # skip/raise policy: drop ONLY the nonfinite step and
+                        # keep training (replaces the sticky freeze below —
+                        # div_c stays clean, health carries the counts)
+                        bad = badg
+                    else:
+                        # divergence sentinel (SURVEY §5 failure detection):
+                        # once a non-finite loss appears, freeze
+                        # params/opt/state for the rest of the scan and record
+                        # the first bad step — a cheap select per buffer, no
+                        # host sync inside the loop
+                        bad = jnp.logical_or(~jnp.isfinite(loss), div_c >= 0)
                     keep = lambda new, old: jax.tree_util.tree_map(
                         lambda a, b: jnp.where(bad, b, a), new, old)
                     newp = keep(newp, params_c)
                     newo = keep(newo, opt_c)
                     ns = keep(ns, states_c)
-                    div_c = jnp.where(jnp.logical_and(div_c < 0,
-                                                      ~jnp.isfinite(loss)),
-                                      step_c, div_c)
-                    return (newp, newo, ns, step_c + 1, rng_c, div_c), loss
+                    if not protect:
+                        div_c = jnp.where(jnp.logical_and(div_c < 0,
+                                                          ~jnp.isfinite(loss)),
+                                          step_c, div_c)
+                    return (newp, newo, ns, step_c + 1, rng_c, div_c, acc), loss
 
                 if per_step_data:
                     xs = (x, y) + ((fmask,) if has_fm else ()) \
@@ -529,9 +586,14 @@ class MultiLayerNetwork(DivergenceSentinelMixin):
                 else:
                     xs = None
                 div0 = jnp.asarray(-1, jnp.int32)
+                acc0 = _health.init_accum(len(layers)) if health_on else None
                 carry, losses = jax.lax.scan(
-                    body, (params, opt, states, step, rng, div0), xs, length=n)
-                return carry, losses
+                    body, (params, opt, states, step, rng, div0, acc0), xs,
+                    length=n)
+                newp, newo, ns, stepf, rngf, divf, accf = carry
+                health_out = _health.finalize(accf, n, health_nf_in) \
+                    if health_on else None
+                return (newp, newo, ns, stepf, rngf, divf), losses, health_out
             self._device_loop_cache[cache_key] = run
         return run
 
@@ -548,7 +610,7 @@ class MultiLayerNetwork(DivergenceSentinelMixin):
         return lowered_flops(
             run, self.params_tree, self._opt_state, self.state_tree,
             jnp.asarray(self._step, jnp.int32), self._rng, x, y, None, None,
-            n=1)
+            self._health_nf_in(), n=1)
 
     def train_step_costs(self, x, y) -> dict:
         """{'flops', 'bytes_accessed'} of ONE fit_on_device training step per
@@ -561,7 +623,7 @@ class MultiLayerNetwork(DivergenceSentinelMixin):
         return lowered_costs(
             run, self.params_tree, self._opt_state, self.state_tree,
             jnp.asarray(self._step, jnp.int32), self._rng, x, y, None, None,
-            n=1)
+            self._health_nf_in(), n=1)
 
     def activation_bytes(self, x) -> int:
         """Sum of per-layer training activation bytes for input x, via

@@ -211,22 +211,24 @@ class ParamAndGradientIterationListener(IterationListener):
 
     def iteration_done(self, model, iteration: int):
         import numpy as np
-        params = np.asarray(model.params())
+        params = np.asarray(model.params())  # sync-ok: listener contract — host param snapshot
         if iteration % self.iterations != 0:
             self._prev = params
             return
-        rec = {"iteration": iteration, "score": float(model.score())}
+        rec = {"iteration": iteration,
+               "score": float(model.score())}  # sync-ok: listener contract
         sources = {"param": params}
         if self._prev is not None:
             sources["update"] = params - self._prev
         for kind, arr in sources.items():
             if self.print_mean:
-                rec[f"{kind}_mean"] = float(arr.mean())
+                rec[f"{kind}_mean"] = float(arr.mean())  # sync-ok: host numpy
             if self.print_min_max:
-                rec[f"{kind}_min"] = float(arr.min())
-                rec[f"{kind}_max"] = float(arr.max())
+                rec[f"{kind}_min"] = float(arr.min())  # sync-ok: host numpy
+                rec[f"{kind}_max"] = float(arr.max())  # sync-ok: host numpy
             if self.print_mean_abs_value:
-                rec[f"{kind}_mean_abs"] = float(np.abs(arr).mean())
+                rec[f"{kind}_mean_abs"] = \
+                    float(np.abs(arr).mean())  # sync-ok: host numpy
         self._prev = params
         self.history.append(rec)
         line = self.delimiter.join(f"{k}={v}" for k, v in rec.items())
@@ -263,7 +265,7 @@ class TelemetryListener(TrainingListener):
     def iteration_done(self, model, iteration: int):
         from deeplearning4j_tpu.telemetry.training import (lagged_score,
                                                            mark_iteration)
-        mark_iteration(iteration, self.registry)
+        mark_iteration(iteration, self.registry, store=model)
         s = lagged_score(self, model)
         if s is not None and s == s:        # skip the initial NaN
             self._g_score.set(s)

@@ -149,7 +149,10 @@ def _build_step(decoder: StackDecoder, embed: Callable, top_k: int,
         hist = hist.at[s, gi].set(jnp.where(active, toks, hist[s, gi]))
         last = jnp.where(active, toks, last)
         new_active = active & (toks != eos) & (gen_idx + 1 < maxgen)
-        return cache_state, hist, last, new_active, lp
+        # nonfinite-logits sentinel (ISSUE 5): scalar OR over active rows,
+        # computed on device and read back WITH the mask (no extra sync)
+        nf = jnp.any(active & jnp.any(~jnp.isfinite(lp), axis=-1))
+        return cache_state, hist, last, new_active, lp, nf
 
     return jax.jit(step)
 
@@ -168,7 +171,7 @@ def _build_chunk(decoder: StackDecoder, embed: Callable, top_k: int,
     def chunk(params, cache_state, hist, last, plens, eos, maxgen, active,
               keys, temps):
         def micro(carry, key):
-            cache_state, hist, last, active = carry
+            cache_state, hist, last, active, nf = carry
             x = embed(last)                                  # (S, n_in)
             cache_state, lp = decoder._decode_fn(params, cache_state, x,
                                                  active)
@@ -179,11 +182,16 @@ def _build_chunk(decoder: StackDecoder, embed: Callable, top_k: int,
             hist = hist.at[s, gi].set(jnp.where(active, toks, hist[s, gi]))
             new_last = jnp.where(active, toks, last)
             new_active = active & (toks != eos) & (gen_idx + 1 < maxgen)
-            return (cache_state, hist, new_last, new_active), (active, lp)
+            # nonfinite-logits sentinel (ISSUE 5): OR-reduced across the
+            # chunk's micro-steps, masked to rows that entered active
+            nf = nf | jnp.any(active & jnp.any(~jnp.isfinite(lp), axis=-1))
+            return ((cache_state, hist, new_last, new_active, nf),
+                    (active, lp))
 
-        (cache_state, hist, last, active), (entries, lps) = jax.lax.scan(
-            micro, (cache_state, hist, last, active), keys)
-        return cache_state, hist, last, active, entries, lps
+        (cache_state, hist, last, active, nf), (entries, lps) = jax.lax.scan(
+            micro, (cache_state, hist, last, active, jnp.zeros((), bool)),
+            keys)
+        return cache_state, hist, last, active, entries, lps, nf
 
     return jax.jit(chunk)
 
@@ -268,6 +276,10 @@ class ServingEngine:
             "serving.retirements", "requests retired")
         self._c_timeouts = self.metrics.counter(
             "serving.timeouts", "requests expired before completion")
+        self._c_nonfinite = self.metrics.counter(
+            "serving.nonfinite_chunks", "decode chunks whose logits held "
+            "nonfinite values in an active row (sentinel rides the existing "
+            "mask readback — zero added syncs)")
         self._c_compiles = self.metrics.counter(
             "serving.jit_compiles", "first-use compiled shapes (prefill "
             "buckets + chunk scan lengths)")
@@ -316,6 +328,7 @@ class ServingEngine:
             return {"host_syncs": syncs, "tokens_out": toks,
                     "decode_chunk": self.decode_chunk,
                     "host_syncs_per_token": syncs / max(1, toks),
+                    "nonfinite_chunks": self._c_nonfinite.value,
                     "queue_depth": len(self._queue),
                     "free_slots": self.decoder.cache.n_free,
                     "active_slots": len(self._by_slot)}
@@ -364,7 +377,8 @@ class ServingEngine:
             slot = cache.allocate(act)
             act.slot = slot
             req = act.req
-            toks = np.asarray(req.tokens, np.int32)
+            toks = np.asarray(req.tokens, np.int32)  # sync-ok: host list
+            # sync-ok: admission prefill input prep (scheduling event)
             feats = np.asarray(self.embed(jnp.asarray(toks))).T  # (n_in, T)
             # compile attribution: the prefill jit retraces once per
             # power-of-two length bucket — first sighting is a cache miss
@@ -384,7 +398,7 @@ class ServingEngine:
                                self.sampler.top_k)[0]
             act.n_generated = 1
             if self.capture_logprobs:
-                act.logprobs = [np.asarray(lp)]
+                act.logprobs = [np.asarray(lp)]  # sync-ok: capture_logprobs mode
             self._hist = self._hist.at[slot, 0].set(t0)
             self._last = self._last.at[slot].set(t0)
             self._plens = self._plens.at[slot].set(len(req.tokens))
@@ -421,7 +435,7 @@ class ServingEngine:
         act = self._by_slot.pop(slot)
         n = act.n_generated
         src = self._hist if hist is None else hist
-        row = np.asarray(src[slot])[:n].tolist()
+        row = np.asarray(src[slot])[:n].tolist()  # sync-ok: retirement readback
         req = act.req
         if req.eos_id is not None and n and row[-1] == req.eos_id:
             reason = "eos"
@@ -532,7 +546,7 @@ class ServingEngine:
                                     active=int(self._active_mask.sum())):
                 if k_eff == 1:         # the pre-chunking path, bit-for-bit
                     (self.decoder.cache.state, self._hist, self._last,
-                     new_active, lp) = self._step_jit(
+                     new_active, lp, nf) = self._step_jit(
                         self.decoder.params, self.decoder.cache.state,
                         self._hist, self._last, self._plens, self._eos,
                         self._maxgen, active, self.sampler.next_key(),
@@ -542,10 +556,11 @@ class ServingEngine:
                 else:
                     keys = self.sampler.peek_keys(k_eff)
                     (self.decoder.cache.state, self._hist, self._last,
-                     new_active, entries, lps) = self._chunk_jit(
+                     new_active, entries, lps, nf) = self._chunk_jit(
                         self.decoder.params, self.decoder.cache.state,
                         self._hist, self._last, self._plens, self._eos,
                         self._maxgen, active, keys, jnp.asarray(self._temps))
+                    # sync-ok: the counted per-chunk readback
                     entry_np = np.asarray(entries)               # (K, S)
                     # commit exactly the micro-steps that ran with active
                     # work — a chunk over-running the last completion
@@ -553,9 +568,16 @@ class ServingEngine:
                     # to K=1 stepping
                     self.sampler.advance(int(entry_np.any(axis=1).sum()))
             with telemetry.span("host_sync", what="chunk_masks", k=k_eff):
-                new_np = np.asarray(new_active)    # the per-iteration sync
+                # sync-ok: the counted per-iteration sync
+                new_np = np.asarray(new_active)
+                # nf is an output of the SAME dispatch: once the mask above
+                # materialized the whole chunk completed, so this bool() is
+                # a copy of a finished scalar, not an added sync
+                if bool(nf):
+                    self._c_nonfinite.inc()
             self._c_syncs.inc()
             self._h_chunk_ms.observe((time.perf_counter() - t_chunk) * 1e3)
+            # sync-ok: capture_logprobs mode only
             lp_np = np.asarray(lps) if self.capture_logprobs else None
             self._finish_steps(snapshot, entry_np, new_np, lp_np)
             return bool(self._by_slot or self._queue)
@@ -570,7 +592,7 @@ class ServingEngine:
         the device mask before the next dispatch. Keys are consumed
         unconditionally here (throughput mode — the strict cross-K key
         schedule is a synchronous-step guarantee)."""
-        pending = None   # (snapshot, entries_dev, final_dev, hist_dev, t0)
+        pending = None  # (snapshot, entries_dev, final_dev, hist_dev, nf, t0)
         with self._lock:
             self._dev_active = jnp.asarray(self._active_mask)
         try:
@@ -599,21 +621,26 @@ class ServingEngine:
                                 active=int(self._active_mask.sum())):
                             (self.decoder.cache.state, self._hist,
                              self._last, self._dev_active, entries,
-                             _lps) = self._chunk_jit(
+                             _lps, nf) = self._chunk_jit(
                                 self.decoder.params, self.decoder.cache.state,
                                 self._hist, self._last, self._plens,
                                 self._eos, self._maxgen, self._dev_active,
                                 keys, jnp.asarray(self._temps))
                         dispatched = (snapshot, entries, self._dev_active,
-                                      self._hist, time.perf_counter())
+                                      self._hist, nf, time.perf_counter())
                     # chunk i+1 is enqueued; materializing chunk i's masks
                     # now overlaps host bookkeeping with device compute
                     if pending is not None:
-                        snapshot, entries, final, hist, t_disp = pending
+                        snapshot, entries, final, hist, nf, t_disp = pending
                         with telemetry.span("host_sync", what="chunk_masks",
                                             overlap=True):
+                            # sync-ok: the counted per-chunk readback
                             entry_np = np.asarray(entries)
-                            new_np = np.asarray(final)
+                            new_np = np.asarray(final)  # sync-ok: same dispatch
+                            # same dispatch as the masks just materialized —
+                            # reading the sentinel scalar adds no sync
+                            if bool(nf):
+                                self._c_nonfinite.inc()
                         self._c_syncs.inc()
                         self._h_chunk_ms.observe(
                             (time.perf_counter() - t_disp) * 1e3)

@@ -20,6 +20,10 @@ Env toggles:
   way; the on-vs-off regression test asserts identical sync counts).
 - DL4J_TPU_TRACE_PATH=/path/trace.json makes instrumented drains/epochs
   export the trace there (last writer wins).
+- DL4J_TPU_HEALTH=record|skip|raise (or 1/0) sets the default in-step
+  training-health policy for models that did not call `configure_health`
+  (health.py, ISSUE 5). Unset means health is off unless a listener or the
+  model opts in.
 """
 from __future__ import annotations
 
@@ -37,8 +41,17 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
     "DEFAULT_MS_BUCKETS", "DEFAULT_S_BUCKETS", "registry", "tracer", "span",
     "instant", "enabled", "configure", "maybe_export_trace", "metrics_route",
-    "PROMETHEUS_CONTENT_TYPE",
+    "PROMETHEUS_CONTENT_TYPE", "health",
 ]
+
+
+def __getattr__(name):
+    # `telemetry.health` (ISSUE 5) is the one jax-importing module in the
+    # package — loaded lazily so registry/tracing users stay jax-free
+    if name == "health":
+        import importlib
+        return importlib.import_module("deeplearning4j_tpu.telemetry.health")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 

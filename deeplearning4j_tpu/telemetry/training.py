@@ -14,39 +14,77 @@ must not force a device sync per iteration): it returns the PREVIOUS
 iteration's score — whose device buffer has materialized while the current
 step ran — and stashes the current handle for next time. One step stale by
 construction, never a forced pipeline flush.
+
+Iteration bookkeeping is keyed PER STORE (ISSUE 5 satellite): pass the
+model as `store` so two networks training concurrently in one process each
+get their own stopwatch — with a single process-global one their
+interleaved iteration numbers corrupted `iteration_ms` (every boundary
+measured listener-to-listener across models). `store=None` keeps the old
+process-global behavior for single-model callers.
 """
 from __future__ import annotations
 
 import threading
 import time
-from typing import Optional
+import weakref
+from typing import Any, Optional
 
 from deeplearning4j_tpu.telemetry.registry import (DEFAULT_MS_BUCKETS,
                                                    MetricsRegistry)
 
-_lock = threading.Lock()
-_last_time: Optional[float] = None
-_last_iter: Optional[int] = None
-_last_record: dict = {"iteration": None, "iteration_ms": None}
+
+class _IterState:
+    """Per-store iteration stopwatch (idempotent-per-iteration record)."""
+
+    __slots__ = ("lock", "last_time", "last_iter", "last_record")
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.last_time: Optional[float] = None
+        self.last_iter: Optional[int] = None
+        self.last_record: dict = {"iteration": None, "iteration_ms": None}
 
 
-def mark_iteration(iteration: int, registry: Optional[MetricsRegistry] = None
-                   ) -> dict:
+_GLOBAL_STATE = _IterState()
+# weak keys: a model's stopwatch dies with the model, no registry leak
+_STATES: "weakref.WeakKeyDictionary[Any, _IterState]" = \
+    weakref.WeakKeyDictionary()
+_STATES_LOCK = threading.Lock()
+
+
+def _state_for(store: Any) -> _IterState:
+    if store is None:
+        return _GLOBAL_STATE
+    try:
+        with _STATES_LOCK:
+            st = _STATES.get(store)
+            if st is None:
+                st = _STATES[store] = _IterState()
+        return st
+    except TypeError:  # unhashable / not weakref-able store
+        return _GLOBAL_STATE
+
+
+def mark_iteration(iteration: int, registry: Optional[MetricsRegistry] = None,
+                   store: Any = None) -> dict:
     """Record one training iteration boundary (idempotent per iteration
-    number). Returns {"iteration", "iteration_ms"} where iteration_ms is the
-    host wall time since the previous distinct iteration (None on the
-    first)."""
-    global _last_time, _last_iter, _last_record
+    number per `store`). Returns {"iteration", "iteration_ms"} where
+    iteration_ms is the host wall time since the previous distinct
+    iteration of the SAME store (None on the first). Listeners pass the
+    model as `store`: co-attached listeners on one model still time each
+    iteration exactly once, while concurrent models no longer interleave
+    into one shared stopwatch."""
     from deeplearning4j_tpu import telemetry
     reg = registry or telemetry.registry()
+    st = _state_for(store)
     now = time.perf_counter()
-    with _lock:
-        if iteration == _last_iter:
-            return dict(_last_record)
-        ms = None if _last_time is None else (now - _last_time) * 1e3
-        _last_time, _last_iter = now, iteration
-        _last_record = {"iteration": iteration, "iteration_ms": ms}
-        record = dict(_last_record)
+    with st.lock:
+        if iteration == st.last_iter:
+            return dict(st.last_record)
+        ms = None if st.last_time is None else (now - st.last_time) * 1e3
+        st.last_time, st.last_iter = now, iteration
+        st.last_record = {"iteration": iteration, "iteration_ms": ms}
+        record = dict(st.last_record)
     reg.counter("training.iterations",
                 "training iterations completed").inc()
     if ms is not None:
@@ -57,11 +95,11 @@ def mark_iteration(iteration: int, registry: Optional[MetricsRegistry] = None
 
 
 def reset() -> None:
-    """Forget iteration-boundary state (tests)."""
-    global _last_time, _last_iter, _last_record
-    with _lock:
-        _last_time = _last_iter = None
-        _last_record = {"iteration": None, "iteration_ms": None}
+    """Forget iteration-boundary state, global and per-store (tests)."""
+    global _GLOBAL_STATE
+    with _STATES_LOCK:
+        _GLOBAL_STATE = _IterState()
+        _STATES.clear()
 
 
 def lagged_score(store, model) -> Optional[float]:
@@ -77,6 +115,6 @@ def lagged_score(store, model) -> Optional[float]:
     if prev is None:
         return None
     try:
-        return float(prev)
+        return float(prev)  # sync-ok: buffer materialized one step ago (lagged)
     except Exception:
         return None

@@ -4,10 +4,13 @@ Parity: ref deeplearning4j-ui-model/.../stats/BaseStatsListener.java:44 —
 initialization records (hardware/software/model info) + per-iteration updates (score,
 per-layer parameter/update summary stats: mean, stdev, mean magnitude, histograms;
 learning rates; memory; timing). TPU-first delta: all numeric summaries are computed
-ON DEVICE in one fused jitted computation per report (one host transfer), and
-"updates" are exact applied parameter deltas (previous snapshot minus current) rather
-than re-captured gradients — identical information post-updater, no training-path
-instrumentation needed.
+ON DEVICE in one fused jitted computation per report (one host transfer). "updates"
+summary stats come from applied parameter deltas (previous snapshot minus current);
+since ISSUE 5 the gradient norms and update:param ratios come from the in-step
+training-health monitor (telemetry/health.py) when the model has it enabled —
+exact per-step values computed inside the jitted train step, read back lagged
+(sync-free) — with the param-delta ratio as the fallback. The score is the
+one-step-stale materialized loss (lagged_score), never a forced device sync.
 """
 from __future__ import annotations
 
@@ -20,7 +23,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.optimize.listeners import TrainingListener
-from deeplearning4j_tpu.telemetry.training import mark_iteration
+from deeplearning4j_tpu.telemetry.training import lagged_score, mark_iteration
 from deeplearning4j_tpu.ui.storage import StatsStorageRouter
 
 _HIST_BINS = 20
@@ -58,7 +61,7 @@ class StatsListener(TrainingListener):
     def __init__(self, storage: StatsStorageRouter, frequency: int = 1,
                  session_id: Optional[str] = None, worker_id: str = "0",
                  collect_histograms: bool = True, collect_updates: bool = True,
-                 collect_memory: bool = True):
+                 collect_memory: bool = True, collect_health: bool = True):
         self.storage = storage
         self.frequency = max(1, int(frequency))
         self.session_id = session_id or f"session-{uuid.uuid4().hex[:12]}"
@@ -66,6 +69,7 @@ class StatsListener(TrainingListener):
         self.collect_histograms = collect_histograms
         self.collect_updates = collect_updates
         self.collect_memory = collect_memory
+        self.collect_health = collect_health
         self._static_posted = False
         self._prev_params = None
         self._summary_jit = None
@@ -122,7 +126,17 @@ class StatsListener(TrainingListener):
         # private `_last_report_time` stopwatch — mark EVERY iteration
         # (idempotent: a co-attached TelemetryListener and this listener
         # together still time each iteration once), report every Nth
-        it_rec = mark_iteration(iteration)
+        it_rec = mark_iteration(iteration, store=model)
+        # in-step training-health monitor (ISSUE 5): opt the model in once so
+        # the jitted step emits true gradient/update diagnostics; explicit
+        # user/env configuration always wins over this listener default
+        if self.collect_health and hasattr(model, "configure_health") \
+                and not getattr(model, "_health_explicit", True) \
+                and model.health_config is None:
+            model.configure_health(policy="record")
+        # sync-free score (satellite 1): the previous iteration's
+        # materialized loss, not a float(model.score()) pipeline flush
+        score = lagged_score(self, model)
         if iteration % self.frequency != 0:
             return
         if not self._static_posted:
@@ -156,14 +170,32 @@ class StatsListener(TrainingListener):
                 if p and p.get("mean_magnitude"):
                     ratios[k] = u["mean_magnitude"] / p["mean_magnitude"]
             stats_py["update_ratios"] = ratios
+        # true in-step diagnostics (ISSUE 5): when the model's health monitor
+        # is on, the lagged device-computed record replaces the param-delta
+        # approximation for gradient/update stats — exact per-step gradient
+        # norms and post-updater update:param ratios, still sync-free (the
+        # stash materialized while the latest step ran)
+        health_rec = model.health_report() \
+            if (self.collect_health and hasattr(model, "health_report")) else None
+        if health_rec is not None:
+            stats_py["gradient_norms"] = {
+                str(i): g for i, (g, pm) in enumerate(
+                    zip(health_rec["grad_norm"], health_rec["param_mag"]))
+                if pm > 0}
+            stats_py["update_ratios"] = {
+                str(i): r for i, (r, pm) in enumerate(
+                    zip(health_rec["update_ratio"], health_rec["param_mag"]))
+                if pm > 0}
         record: Dict[str, Any] = {
             "session_id": self.session_id, "type_id": "StatsListener",
             "worker_id": self.worker_id, "timestamp": now,
             "iteration": int(iteration),
-            "score": float(model.score()),
+            "score": score,            # one step stale, None on iteration 1
             "stats": stats_py,
             "learning_rates": self._learning_rates(model),
         }
+        if health_rec is not None:
+            record["health"] = health_rec
         if it_rec["iteration_ms"] is not None:
             record["iteration_ms"] = it_rec["iteration_ms"]
         if self.collect_memory:
@@ -174,6 +206,7 @@ class StatsListener(TrainingListener):
         out = {}
         for i, u in enumerate(getattr(model, "_updaters", [])):
             try:
+                # sync-ok: scalar LR schedule evaluation
                 out[str(i)] = float(u.lr(model._step))
             except Exception:
                 pass
@@ -183,9 +216,9 @@ class StatsListener(TrainingListener):
 def _to_python(obj):
     if isinstance(obj, dict):
         return {k: _to_python(v) for k, v in obj.items()}
-    a = np.asarray(obj)
+    a = np.asarray(obj)  # sync-ok: input already device_get
     if a.ndim == 0:
-        return float(a)
+        return float(a)  # sync-ok: input already device_get
     return a.tolist()
 
 

@@ -69,6 +69,18 @@ def render_block(art: dict) -> str:
             f"{roof['measured_over_hand_lb']:.2f}x the traffic model and "
             f"{roof['measured_over_mxu_floor']:.1f}x the MXU floor. "
             f"Verdict: {roof.get('verdict', 'n/a')}.")
+    th = e.get("training_health", {})
+    if th.get("overhead_pct") is not None:
+        line = (
+            f"- Training-health monitor (in-step gradient/update "
+            f"diagnostics, policy={th.get('policy', 'record')}): "
+            f"{th['ms_per_iter_health_on']:.2f} ms/iter on vs "
+            f"{th['ms_per_iter_health_off']:.2f} ms/iter off — "
+            f"{th['overhead_pct']:+.2f}% overhead on the ResNet50 "
+            f"b{th['batch']} {th.get('compute_dtype', '')} path")
+        if th.get("note"):
+            line += f" ({th['note']})"
+        lines.append(line + ".")
     lines.append(
         f"- GravesLSTM char-RNN b{lstm['batch']}x{lstm['seq_len']}: "
         f"{lstm['tokens_per_sec'] / 1e6:.2f}M tokens/s, MFU {_pct(lstm['mfu'])}"

@@ -1,0 +1,384 @@
+"""In-step training-health monitor tests (ISSUE 5).
+
+Load-bearing guarantees:
+- policy="record" is PURE OBSERVATION: health-on training is bit-identical
+  to health-off (losses and every parameter buffer), on both the per-batch
+  jitted step and the fit_on_device lax.scan.
+- policy="skip": a step with nonfinite gradients leaves params bitwise
+  unchanged, increments training.nonfinite_steps, and training recovers on
+  the next clean batch.
+- policy="raise": NonfiniteGradientError with params protected.
+- the serving nonfinite-logits sentinel rides the existing chunk-mask
+  readback (sync parity is asserted in tests/test_telemetry.py; here we
+  assert it actually fires).
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu import (
+    Activation, ComputationGraph, DenseLayer, InputType, MultiLayerNetwork,
+    NeuralNetConfiguration, OutputLayer, Sgd, WeightInit)
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.telemetry import health as H
+
+RNG = np.random.RandomState(11)
+
+
+def _mlp(seed=1, lr=0.5):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).weight_init(WeightInit.XAVIER)
+            .activation(Activation.TANH)
+            .updater(Sgd(learning_rate=lr)).dtype("float64")
+            .list()
+            .layer(DenseLayer(n_out=8))
+            .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(2))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def _graph(seed=1):
+    conf = (NeuralNetConfiguration.Builder()
+            .seed(seed).weight_init(WeightInit.XAVIER)
+            .activation(Activation.TANH)
+            .updater(Sgd(learning_rate=0.5)).dtype("float64")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d0", DenseLayer(n_out=8), "in")
+            .add_layer("out",
+                       OutputLayer(n_out=2, activation=Activation.SOFTMAX),
+                       "d0")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(2))
+            .build())
+    return ComputationGraph(conf).init()
+
+
+def _batches(n=4, b=16):
+    xs, ys = [], []
+    for _ in range(n):
+        x = RNG.randint(0, 2, (b, 2)).astype(np.float64)
+        y = np.eye(2)[x[:, 0].astype(int) ^ x[:, 1].astype(int)]
+        xs.append(x)
+        ys.append(y)
+    return xs, ys
+
+
+def _leaves(net):
+    return [np.asarray(v) for v in jax.tree_util.tree_leaves(net.params_tree)]
+
+
+def _assert_params_equal(a, b):
+    la, lb = _leaves(a) if hasattr(a, "params_tree") else a, \
+        _leaves(b) if hasattr(b, "params_tree") else b
+    assert len(la) == len(lb)
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(x, y)
+
+
+# ------------------------------------------------ record-policy bit parity
+def test_fit_batch_record_is_bit_identical_to_health_off():
+    xs, ys = _batches(4)
+    off, on = _mlp(seed=7), _mlp(seed=7)
+    on.configure_health(policy="record", registry=telemetry.MetricsRegistry())
+    for x, y in zip(xs, ys):
+        off.fit_batch(x, y)
+        on.fit_batch(x, y)
+        assert off.score() == on.score()    # bitwise: same float
+    _assert_params_equal(off, on)
+    rec = on.health_report(sync=True)
+    assert rec is not None and rec["nonfinite_steps"] == 0
+    assert rec["grad_norm_global"] > 0
+    assert off.health_report(sync=True) is None   # health off: no stash
+
+
+def test_fit_on_device_record_is_bit_identical_to_health_off():
+    xs, ys = _batches(6)
+    x = np.stack(xs)    # (steps, batch, n_in) per-step data mode
+    y = np.stack(ys)
+    off, on = _mlp(seed=3), _mlp(seed=3)
+    on.configure_health(policy="record", registry=telemetry.MetricsRegistry())
+    l_off = np.asarray(off.fit_on_device(x, y))
+    l_on = np.asarray(on.fit_on_device(x, y))
+    np.testing.assert_array_equal(l_off, l_on)
+    _assert_params_equal(off, on)
+    rec = on.health_report(sync=True)
+    assert rec["steps"] == 6
+    assert rec["nonfinite_steps"] == 0
+    assert rec["first_nonfinite_step"] is None
+    # per-layer vectors sized by layer count; output layer has params
+    assert len(rec["grad_norm"]) == 2
+    assert all(g > 0 for g in rec["grad_norm"])
+    assert all(r > 0 for r in rec["update_ratio"])
+
+
+# ----------------------------------------------------------- skip policy
+def test_fit_batch_skip_freezes_params_on_nonfinite_and_recovers():
+    xs, ys = _batches(3)
+    reg = telemetry.MetricsRegistry()
+    net = _mlp(seed=9).configure_health(policy="skip", registry=reg)
+    net.fit_batch(xs[0], ys[0])
+    before = _leaves(net)
+    bad = xs[1].copy()
+    bad[0, 0] = np.nan
+    net.fit_batch(bad, ys[1])
+    _assert_params_equal(before, _leaves(net))   # poisoned step: no-op
+    rec = net.health_report(sync=True)
+    assert rec["nonfinite_steps"] == 1
+    assert rec["nonfinite_total"] == 1
+    c = reg.counter("training.nonfinite_steps")
+    assert c.value == 1
+    # recovery: the next clean batch trains normally
+    net.fit_batch(xs[2], ys[2])
+    after = _leaves(net)
+    assert np.isfinite(net.score())
+    assert all(np.isfinite(a).all() for a in after)
+    assert any(not np.array_equal(a, b) for a, b in zip(before, after))
+    rec2 = net.health_report(sync=True)
+    assert rec2["nonfinite_steps"] == 0          # latest stash is clean
+    assert rec2["nonfinite_total"] == 1          # cumulative survives
+    assert c.value == 1                          # published once, no double
+
+
+def test_fit_on_device_skip_protects_and_counts():
+    xs, ys = _batches(5)
+    xs[2][0, 0] = np.nan                 # poison step index 2
+    x, y = np.stack(xs), np.stack(ys)
+    net = _mlp(seed=5).configure_health(policy="skip",
+                                        registry=telemetry.MetricsRegistry())
+    losses = np.asarray(net.fit_on_device(x, y))
+    finite = np.isfinite(losses)
+    assert list(finite) == [True, True, False, True, True]
+    rec = net.health_report(sync=True)
+    assert rec["nonfinite_steps"] == 1
+    assert rec["first_nonfinite_step"] == 2
+    assert all(np.isfinite(a).all() for a in _leaves(net))
+
+
+def test_raise_policy_raises_and_protects_params():
+    xs, ys = _batches(2)
+    net = _mlp(seed=2).configure_health(policy="raise",
+                                        registry=telemetry.MetricsRegistry())
+    net.fit_batch(xs[0], ys[0])
+    before = _leaves(net)
+    bad = xs[1].copy()
+    bad[:, :] = np.inf
+    with pytest.raises(H.NonfiniteGradientError):
+        net.fit_batch(bad, ys[1])
+    _assert_params_equal(before, _leaves(net))
+
+
+# ------------------------------------------------------- computation graph
+def test_graph_record_parity_and_skip():
+    xs, ys = _batches(3)
+    off, on = _graph(seed=21), _graph(seed=21)
+    on.configure_health(policy="record", registry=telemetry.MetricsRegistry())
+    for x, y in zip(xs, ys):
+        off.fit_batch(x, y)
+        on.fit_batch(x, y)
+        assert off.score() == on.score()
+    _assert_params_equal(off, on)
+    assert on.health_report(sync=True)["nonfinite_steps"] == 0
+    # skip on the graph path
+    g = _graph(seed=22).configure_health(policy="skip",
+                                         registry=telemetry.MetricsRegistry())
+    g.fit_batch(xs[0], ys[0])
+    before = _leaves(g)
+    bad = xs[1].copy()
+    bad[0, 0] = np.nan
+    g.fit_batch(bad, ys[1])
+    _assert_params_equal(before, _leaves(g))
+    assert g.health_report(sync=True)["nonfinite_steps"] == 1
+
+
+def test_graph_fit_on_device_record_parity():
+    # CG's device loop is single-batch benchmark mode (steps required)
+    xs, ys = _batches(1)
+    off, on = _graph(seed=23), _graph(seed=23)
+    on.configure_health(policy="record", registry=telemetry.MetricsRegistry())
+    l_off = np.asarray(off.fit_on_device(xs[0], ys[0], steps=4))
+    l_on = np.asarray(on.fit_on_device(xs[0], ys[0], steps=4))
+    np.testing.assert_array_equal(l_off, l_on)
+    _assert_params_equal(off, on)
+    assert on.health_report(sync=True)["steps"] == 4
+
+
+# ------------------------------------------------------ registry / report
+def test_registry_gauges_histograms_and_prometheus_text():
+    xs, ys = _batches(3)
+    reg = telemetry.MetricsRegistry()
+    net = _mlp(seed=13).configure_health(policy="record", registry=reg)
+    for x, y in zip(xs, ys):
+        net.fit_batch(x, y)
+    rec = net.health_report(sync=True)
+    snap = reg.snapshot()
+    assert snap["training.health.grad_norm_global"] == rec["grad_norm_global"]
+    assert snap["training.health.param_norm_global"] == \
+        rec["param_norm_global"]
+    assert snap["training.health.layer_grad_norm"]["count"] >= 2
+    assert snap["training.health.update_ratio"]["count"] >= 2
+    text = reg.prometheus_text()
+    for name in ("training_health_grad_norm_global",
+                 "training_health_layer_grad_norm",
+                 "training_health_update_ratio"):
+        assert name in text
+
+
+def test_health_report_is_lagged_by_default():
+    xs, ys = _batches(3)
+    net = _mlp(seed=17).configure_health(policy="record",
+                                         registry=telemetry.MetricsRegistry())
+    assert net.health_report() is None          # nothing stashed yet
+    net.fit_batch(xs[0], ys[0])
+    assert net.health_report() is None          # lagged: one stash = no prev
+    first_sync = net.health_report(sync=True)
+    net.fit_batch(xs[1], ys[1])
+    lagged = net.health_report()
+    assert lagged == first_sync                 # prev stash == step 1's
+
+
+# ------------------------------------------------------------- env toggle
+def test_config_from_env(monkeypatch):
+    monkeypatch.delenv("DL4J_TPU_HEALTH", raising=False)
+    assert H.config_from_env() is None
+    monkeypatch.setenv("DL4J_TPU_HEALTH", "0")
+    assert H.config_from_env().enabled is False
+    monkeypatch.setenv("DL4J_TPU_HEALTH", "1")
+    cfg = H.config_from_env()
+    assert cfg.enabled and cfg.policy == "record"
+    monkeypatch.setenv("DL4J_TPU_HEALTH", "skip")
+    assert H.config_from_env().policy == "skip"
+    monkeypatch.setenv("DL4J_TPU_HEALTH", "bogus")
+    with pytest.warns(UserWarning):
+        assert H.config_from_env().policy == "record"
+
+
+def test_env_toggle_enables_monitor_without_code_changes(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_HEALTH", "record")
+    xs, ys = _batches(2)
+    net = _mlp(seed=19)
+    net._health_registry = telemetry.MetricsRegistry()
+    assert net.health_enabled
+    net.fit_batch(xs[0], ys[0])
+    assert net.health_report(sync=True) is not None
+    # explicit configuration beats the env default
+    net.configure_health(enabled=False)
+    assert not net.health_enabled
+
+
+def test_invalid_policy_rejected():
+    with pytest.raises(ValueError):
+        H.HealthConfig(policy="explode")
+    with pytest.raises(ValueError):
+        _mlp().configure_health(policy="explode")
+
+
+# -------------------------------------------------- stats listener bridge
+def test_stats_listener_reports_health_block():
+    from deeplearning4j_tpu.ui.stats import StatsListener
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+    xs, ys = _batches(1, b=16)
+    x, y = xs[0], ys[0]
+    storage = InMemoryStatsStorage()
+    net = _mlp(seed=29)
+    net._health_registry = telemetry.MetricsRegistry()
+    net.set_listeners(StatsListener(storage, session_id="h1", frequency=1))
+    for _ in range(5):
+        net.fit(x, y)
+    updates = storage.get_all_updates("h1")
+    assert updates, "listener posted no update records"
+    last = updates[-1]
+    # the listener opted the model into policy="record"
+    assert net.health_config is not None
+    assert net.health_config.policy == "record"
+    assert "health" in last
+    assert last["health"]["nonfinite_steps"] == 0
+    # true in-step diagnostics replace the param-delta approximation
+    assert last["stats"]["gradient_norms"]
+    assert all(v > 0 for v in last["stats"]["gradient_norms"].values())
+    assert all(v > 0 for v in last["stats"]["update_ratios"].values())
+    # sync-free score: one step stale, never None after two iterations
+    assert last["score"] is not None and np.isfinite(last["score"])
+
+
+def test_stats_listener_respects_explicit_health_off():
+    from deeplearning4j_tpu.ui.stats import StatsListener
+    from deeplearning4j_tpu.ui.storage import InMemoryStatsStorage
+    xs, ys = _batches(1)
+    storage = InMemoryStatsStorage()
+    net = _mlp(seed=31).configure_health(enabled=False)
+    net.set_listeners(StatsListener(storage, session_id="h2", frequency=1))
+    for _ in range(3):
+        net.fit(xs[0], ys[0])
+    assert not net.health_enabled            # listener did not override
+    assert all("health" not in u for u in storage.get_all_updates("h2"))
+
+
+# -------------------------------------------- per-store iteration timing
+def test_mark_iteration_keyed_per_store():
+    import time as _time
+    from deeplearning4j_tpu.telemetry import training as T
+
+    class _Model:
+        pass
+
+    T.reset()
+    reg = telemetry.MetricsRegistry()
+    a, b = _Model(), _Model()
+    assert T.mark_iteration(0, reg, store=a)["iteration_ms"] is None
+    _time.sleep(0.02)
+    # first mark for b: its OWN stopwatch, not a's boundary
+    assert T.mark_iteration(0, reg, store=b)["iteration_ms"] is None
+    ra = T.mark_iteration(1, reg, store=a)
+    assert ra["iteration_ms"] is not None and ra["iteration_ms"] >= 15
+    # idempotent within one store, isolated across stores
+    assert T.mark_iteration(1, reg, store=a) == ra
+    assert T.mark_iteration(1, reg, store=b)["iteration_ms"] is not None
+    T.reset()
+
+
+# -------------------------------------------------------- serving sentinel
+def _serving_net(seed=5):
+    from deeplearning4j_tpu import RnnOutputLayer
+    from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
+    b = (NeuralNetConfiguration.Builder().seed(seed)
+         .weight_init(WeightInit.XAVIER)
+         .updater(Sgd(learning_rate=0.05)).dtype("float64").list())
+    b.layer(SelfAttentionLayer(n_out=8, n_heads=4, causal=True,
+                               block_size=0))
+    b.layer(RnnOutputLayer(n_out=13, activation=Activation.SOFTMAX))
+    return MultiLayerNetwork(
+        b.set_input_type(InputType.recurrent(13)).build()).init()
+
+
+def _poison(engine):
+    engine.decoder.params = jax.tree_util.tree_map(
+        lambda a: jnp.full_like(a, jnp.nan), engine.decoder.params)
+
+
+def test_serving_nonfinite_sentinel_clean_run_is_zero():
+    from deeplearning4j_tpu.serving import ServingEngine
+    eng = ServingEngine(_serving_net(), max_seqs=2, max_len=32,
+                        decode_chunk=4)
+    res = eng.generate([[1, 2, 3]], max_new_tokens=6)
+    assert len(res[0].tokens) == 6
+    assert eng.stats()["nonfinite_chunks"] == 0
+
+
+def test_serving_nonfinite_sentinel_fires_on_nan_logits():
+    from deeplearning4j_tpu.serving import ServingEngine
+    net = _serving_net()
+    # overlapped pipeline (the default drain for chunk > 1)
+    eng = ServingEngine(net, max_seqs=2, max_len=32, decode_chunk=4)
+    _poison(eng)
+    eng.generate([[1, 2, 3]], max_new_tokens=6)
+    assert eng.stats()["nonfinite_chunks"] > 0
+    assert eng.metrics.counter("serving.nonfinite_chunks").value > 0
+    # K=1 synchronous path (the pre-chunking step jit)
+    eng1 = ServingEngine(net, max_seqs=2, max_len=32, decode_chunk=1)
+    _poison(eng1)
+    eng1.generate([[1, 2, 3]], max_new_tokens=4)
+    assert eng1.stats()["nonfinite_chunks"] > 0

@@ -1,0 +1,116 @@
+"""Static sync-discipline scan (ISSUE 5 satellite).
+
+The hot-path observability modules promise "recording a metric never adds a
+device sync". That invariant is easy to erode one innocent-looking
+`float(...)` at a time, so this test tokenizes each hot-path module and
+fails when a sync-prone call pattern — `float(`, `np.asarray(`,
+`.block_until_ready(` — appears WITHOUT an explicit
+``# sync-ok: <reason>`` annotation on the same or the preceding line.
+
+The scan is token-based (not regex over raw source) so string literals,
+docstrings, and comments never false-positive, and `jnp.asarray(` (device
+side, not a readback) is not confused with `np.asarray(`. `float("...")`
+literals (e.g. float("inf")) are exempt — a string argument cannot be a
+device buffer.
+"""
+import io
+import pathlib
+import tokenize
+
+import pytest
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "deeplearning4j_tpu"
+
+HOT_PATH_MODULES = sorted(
+    [PKG / "optimize" / "listeners.py",
+     PKG / "ui" / "stats.py",
+     PKG / "serving" / "engine.py"]
+    + list((PKG / "telemetry").glob("*.py")))
+
+ANNOTATION = "sync-ok:"
+
+
+def scan_source(src: str):
+    """Return [(line, pattern)] for unannotated sync-prone calls in `src`."""
+    toks = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    comments = {}
+    for t in toks:
+        if t.type == tokenize.COMMENT:
+            comments[t.start[0]] = t.string
+    violations = []
+    for i, t in enumerate(toks):
+        if t.type != tokenize.NAME:
+            continue
+        nxt = toks[i + 1] if i + 1 < len(toks) else None
+        if nxt is None or nxt.type != tokenize.OP or nxt.string != "(":
+            continue
+        prev = toks[i - 1] if i > 0 else None
+        prev_is_dot = prev is not None and prev.type == tokenize.OP \
+            and prev.string == "."
+        if t.string == "float" and not prev_is_dot:
+            arg = toks[i + 2] if i + 2 < len(toks) else None
+            if arg is not None and arg.type == tokenize.STRING:
+                continue                      # float("inf"): host literal
+            pattern = "float("
+        elif t.string == "asarray" and prev_is_dot and i >= 2 \
+                and toks[i - 2].type == tokenize.NAME \
+                and toks[i - 2].string == "np":
+            pattern = "np.asarray("
+        elif t.string == "block_until_ready" and prev_is_dot:
+            pattern = ".block_until_ready("
+        else:
+            continue
+        line = t.start[0]
+        if any(ANNOTATION in comments.get(ln, "")
+               for ln in (line, line - 1)):
+            continue
+        violations.append((line, pattern))
+    return violations
+
+
+@pytest.mark.parametrize("path", HOT_PATH_MODULES,
+                         ids=[str(p.relative_to(REPO))
+                              for p in HOT_PATH_MODULES])
+def test_hot_path_module_has_no_unannotated_syncs(path):
+    violations = scan_source(path.read_text())
+    msg = "\n".join(
+        f"  {path.relative_to(REPO)}:{ln}: {pat} without '# sync-ok: "
+        f"<reason>' on the same or preceding line" for ln, pat in violations)
+    assert not violations, (
+        f"unannotated sync-prone calls in a hot-path module — either make "
+        f"the code sync-free or annotate WHY the read is safe:\n{msg}")
+
+
+def test_all_hot_path_modules_exist():
+    # the scan must not silently pass because a module moved
+    for p in HOT_PATH_MODULES:
+        assert p.is_file(), f"hot-path module missing: {p}"
+    assert any(p.name == "health.py" for p in HOT_PATH_MODULES)
+
+
+# ------------------------------------------------ scanner self-tests
+def test_scanner_catches_each_pattern():
+    bad = ("x = float(model.score())\n"
+           "y = np.asarray(dev_buf)\n"
+           "z = arr.block_until_ready()\n")
+    pats = {p for _, p in scan_source(bad)}
+    assert pats == {"float(", "np.asarray(", ".block_until_ready("}
+
+
+def test_scanner_honors_annotations_and_exemptions():
+    ok = ('a = float(x)  # sync-ok: host value\n'
+          '# sync-ok: materialized one step ago\n'
+          'b = np.asarray(prev)\n'
+          'c = float("inf")\n'
+          'd = jnp.asarray(host_list)\n'
+          's = "float(x) inside a string"\n'
+          '# float(y) inside a comment\n'
+          'def block_until_ready(): pass\n')
+    assert scan_source(ok) == []
+
+
+def test_scanner_ignores_docstrings():
+    src = '"""mentions float(score) and np.asarray(buf) and\n' \
+          '.block_until_ready() in prose."""\n'
+    assert scan_source(src) == []
