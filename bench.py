@@ -34,6 +34,19 @@ R01_RESNET50_IMG_S = 2954.4  # BENCH_r01.json: fp32 batch-32 on v5e-1
 PEAK_FLOPS_PER_CHIP = 197e12
 
 
+def _platform():
+    import jax
+    return jax.default_backend()
+
+
+def _label(entry, platform=None):
+    """Attach the platform label (ISSUE 6: every measurement in the artifact
+    says where it ran, so a CPU ms can never read as a TPU claim)."""
+    if isinstance(entry, dict) and "error" not in entry:
+        entry.setdefault("platform", platform or _platform())
+    return entry
+
+
 def _sanity_check_peak(name, flops_per_step, ms_per_iter, n_chips=1):
     """Hard gate: achieved FLOP/s must not exceed the participating chips'
     aggregate peak. Returns achieved MFU (per chip)."""
@@ -797,6 +810,133 @@ def bench_decode_serving(vocab=64, d_model=256, heads=4, kv_heads=2,
                       "attention via the helper seam on TPU)"}
 
 
+def bench_serving_profile(vocab=32, d_model=64, heads=2, kv_heads=1,
+                          prefill_len=8, new_tokens=16, requests=2):
+    """Reduced serving pass under the device-time profiler (ISSUE 6): a
+    small 2-layer attention stack through the same continuous-batching
+    engine as bench_decode_serving, with `telemetry.profiler` cost
+    registration ON, returning the live prefill-bucket and decode-chunk
+    roofline rows (XLA cost-model FLOPs vs measured wall). Sized for CPU
+    so EVERY artifact carries serving roofline rows even when the full
+    decode_serving bench is skipped off-TPU; the engine's phase-boundary
+    memory polls ride along. A warmup serve compiles everything, then the
+    profiler's host aggregates are cleared so the reported means are
+    compile-free."""
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu import (
+        Activation, InputType, NeuralNetConfiguration, RnnOutputLayer,
+        Sgd, WeightInit)
+    from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import Request, ServingEngine
+    from deeplearning4j_tpu.telemetry import profiler
+
+    was_enabled = profiler.enabled()
+    profiler.configure(enabled=True)
+    try:
+        b = (NeuralNetConfiguration.Builder().seed(42)
+             .weight_init(WeightInit.XAVIER)
+             .updater(Sgd(learning_rate=1e-3)).list())
+        for _ in range(2):
+            b.layer(SelfAttentionLayer(n_out=d_model, n_heads=heads,
+                                       n_kv_heads=kv_heads, causal=True,
+                                       block_size=0))
+        b.layer(RnnOutputLayer(n_out=vocab, activation=Activation.SOFTMAX))
+        net = MultiLayerNetwork(
+            b.set_input_type(InputType.recurrent(vocab)).build()).init()
+        max_len = 1 << (prefill_len + new_tokens - 1).bit_length()
+        eng = ServingEngine(net, max_seqs=requests, max_len=max_len,
+                            max_new_tokens_cap=new_tokens)
+        rng = np.random.RandomState(0)
+        mk = lambda: Request(rng.randint(0, vocab, prefill_len).tolist(),
+                             max_new_tokens=new_tokens)
+        eng.generate([mk() for _ in range(requests)])   # compile + register
+        profiler.clear_observations()                   # drop compile-polluted
+        eng.generate([mk() for _ in range(requests)])   # warm, timed
+        rows = [r for r in profiler.roofline_table()
+                if r["function"].startswith(("prefill", "decode_chunk"))]
+        return {"platform": profiler.platform(),
+                "rows": rows,
+                "config": {"d_model": d_model, "heads": heads,
+                           "kv_heads": kv_heads, "prefill_len": prefill_len,
+                           "new_tokens": new_tokens, "requests": requests},
+                "note": ("reduced profiler pass — flops from XLA "
+                         "cost_analysis at compile time, wall from the "
+                         "engine's existing host stopwatches (zero added "
+                         "syncs); floors/MFU use the v5e reference peak "
+                         "off-TPU (rows carry reference_peak=true)")}
+    finally:
+        profiler.configure(enabled=was_enabled)
+
+
+def _row_from_roofline(function, roof, plat):
+    """Roofline-table row from a bench *_roofline entry (exact XLA flops)."""
+    if not isinstance(roof, dict) or not roof.get("measured_ms"):
+        return None
+    flops = (roof.get("flops_per_step_g") or 0.0) * 1e9
+    ms = roof["measured_ms"]
+    mfu = (round(flops / (ms * 1e-3) / PEAK_FLOPS_PER_CHIP, 4)
+           if flops and ms else None)
+    return {"function": function, "platform": plat, "flops": flops,
+            "bytes_accessed": round((roof.get("xla_hlo_bytes_gb") or 0.0)
+                                    * 1e9),
+            "mxu_floor_ms": roof.get("mxu_floor_ms"), "measured_ms": ms,
+            "calls": 0, "mfu": mfu,
+            "x_floor": roof.get("measured_over_mxu_floor"),
+            "hand_lb_ms": roof.get("hand_lb_ms"),
+            "reference_peak": plat != "tpu", "source": "bench roofline entry"}
+
+
+def _row_from_entry(function, entry):
+    """Roofline-table row from a measured bench entry whose mfu is already
+    flops / peak / ms — inverting it recovers the cost-model flops."""
+    if not isinstance(entry, dict):
+        return None
+    ms, mfu = entry.get("ms_per_iter"), entry.get("mfu")
+    if not ms or not mfu:
+        return None
+    plat = entry.get("platform", "tpu")
+    flops = mfu * PEAK_FLOPS_PER_CHIP * ms * 1e-3
+    floor = flops / PEAK_FLOPS_PER_CHIP * 1e3
+    return {"function": function, "platform": plat, "flops": round(flops),
+            "bytes_accessed": None, "mxu_floor_ms": round(floor, 4),
+            "measured_ms": round(ms, 4), "calls": 0, "mfu": mfu,
+            "x_floor": round(ms / floor, 2) if floor else None,
+            "reference_peak": plat != "tpu",
+            "source": "bench entry (mfu x peak x ms)"}
+
+
+def build_roofline_table(extra, serving_profile=None):
+    """Auto-generated roofline attribution (ISSUE 6 tentpole, part 4): one
+    row per tracked compiled function — train_step per model from the
+    measured entries / roofline blocks, prefill + decode_chunk from the
+    live profiler rows of the reduced serving pass. perf_docs renders this
+    table verbatim into README.md/PERF.md, replacing the hand-maintained
+    roofline numbers."""
+    rows = []
+    e = extra
+    r = _row_from_roofline("train_step[resnet50_bf16_b256]",
+                           e.get("resnet50_roofline"),
+                           (e.get("resnet50_bf16") or {}).get(
+                               "platform", "tpu"))
+    rows.append(r or _row_from_entry("train_step[resnet50_bf16_b256]",
+                                     e.get("resnet50_bf16")))
+    rows.append(_row_from_roofline("train_step[lenet_b128]",
+                                   e.get("lenet_roofline"),
+                                   (e.get("lenet_roofline") or {}).get(
+                                       "platform", "tpu")))
+    rows.append(_row_from_entry("train_step[graves_lstm_b8192]",
+                                e.get("graves_lstm")))
+    rows.append(_row_from_entry("train_step[vgg16_transfer]",
+                                e.get("vgg16_transfer")))
+    rows.append(_row_from_entry("train_step[attention_longcontext]",
+                                e.get("attention_longcontext")))
+    if isinstance(serving_profile, dict):
+        rows.extend(serving_profile.get("rows") or [])
+    return [r for r in rows if r]
+
+
 def _r(d):
     return {k: (round(v, 4 if k == "mfu" else 2) if isinstance(v, float) else v)
             for k, v in d.items()}
@@ -865,14 +1005,32 @@ def main():
         vgg = bench_vgg16_transfer()
     except Exception as e:  # keep the headline robust to fixture issues
         vgg = {"error": f"{type(e).__name__}: {e}"}
-    try:  # autoregressive serving: KV-cache decode + continuous batching
-        decode = bench_decode_serving()
+    # autoregressive serving: KV-cache decode + continuous batching. ALWAYS
+    # emitted (ISSUE 6 satellite): off-TPU the TPU-sized config (8 requests x
+    # T=512 prefill x 256 new tokens) is minutes of wall clock, so the entry
+    # records the skip + reason instead of silently vanishing, and the
+    # reduced serving-profile pass below still exercises the engine.
+    plat = _platform()
+    if plat == "tpu":
+        try:
+            decode = bench_decode_serving()
+        except Exception as e:
+            decode = {"error": f"{type(e).__name__}: {e}"}
+        try:  # same-session A/B: chunking off (K=1, per-token sync) control
+            decode_k1 = bench_decode_serving(decode_chunk=1, overlap=False)
+        except Exception as e:
+            decode_k1 = {"error": f"{type(e).__name__}: {e}"}
+    else:
+        reason = (f"TPU-sized serving bench skipped on '{plat}' — "
+                  "serving_profile carries the reduced-config engine run "
+                  "and its prefill/decode_chunk roofline rows")
+        decode = {"platform": plat, "skipped": True, "skipped_reason": reason}
+        decode_k1 = {"platform": plat, "skipped": True,
+                     "skipped_reason": reason}
+    try:  # reduced engine run under the device-time profiler (any platform)
+        serving_profile = bench_serving_profile()
     except Exception as e:
-        decode = {"error": f"{type(e).__name__}: {e}"}
-    try:  # same-session A/B: chunking off (K=1, per-token sync) as control
-        decode_k1 = bench_decode_serving(decode_chunk=1, overlap=False)
-    except Exception as e:
-        decode_k1 = {"error": f"{type(e).__name__}: {e}"}
+        serving_profile = {"error": f"{type(e).__name__}: {e}"}
     # headline takes the better of helpers on/off — both honest fit_on_device
     # protocol; entry names record which path won
     if resnet_helpers.get("images_per_sec", 0) > resnet_bf16["images_per_sec"]:
@@ -888,12 +1046,7 @@ def main():
         lstm_best = lstm_helpers
     else:
         lstm_best = lstm
-    print(json.dumps({
-        "metric": "resnet50_imagenet_images_per_sec_per_chip",
-        "value": value,
-        "unit": "images/sec",
-        "vs_baseline": round(value / R01_RESNET50_IMG_S, 3),
-        "extra": {
+    extra = {
             "baseline_def": (
                 "round-1 fp32 batch-32 fit_on_device result (2954.4 img/s). "
                 "DISCLOSURE (model): that run used the pre-audit zoo ResNet50 "
@@ -931,6 +1084,8 @@ def main():
             "decode_serving_k1": _r(decode_k1),
             "decode_tokens_per_sec": round(
                 decode.get("decode_tokens_per_sec", 0.0), 1),
+            "serving_profile": serving_profile,
+            "platform": plat,
             "device": str(jax.devices()[0]),
             "protocol": ("on-device lax.scan loop timed as the two-point "
                          "slope call(n) = fixed + n*S between n=steps and "
@@ -943,8 +1098,23 @@ def main():
                          "cost-analysis FLOPs / 197 TFLOPS v5e bf16 peak, "
                          "peak-sanity-asserted on the median; min falls back "
                          "to median when noise implies > peak"),
-        },
-    }))
+        }
+    # platform label on every measurement dict (ISSUE 6 satellite; _label is
+    # setdefault, so entries that already carry one — e.g. a skipped decode —
+    # keep theirs)
+    for v in extra.values():
+        _label(v, plat)
+    extra["roofline_table"] = build_roofline_table(extra, serving_profile)
+    art = {
+        "metric": "resnet50_imagenet_images_per_sec_per_chip",
+        "value": value,
+        "unit": "images/sec",
+        "vs_baseline": round(value / R01_RESNET50_IMG_S, 3),
+        "extra": extra,
+    }
+    from deeplearning4j_tpu.util.bench_schema import assert_valid
+    assert_valid(art)           # the docs are generated from this artifact —
+    print(json.dumps(art))      # never print a malformed one
 
 
 if __name__ == "__main__":

@@ -399,11 +399,23 @@ class MultiLayerNetwork(DivergenceSentinelMixin, _health.HealthMonitorMixin):
         if self._accumulator is not None:
             return self._fit_batch_accumulated(x, y, fmask, lmask, rnn_init_states)
 
+        step_args = (self.params_tree, self._opt_state, self.state_tree,
+                     jnp.asarray(self._step, jnp.int32), sub, x, y, fmask,
+                     lmask, rnn_init_states, self._health_nf_in())
+        # profiler cost registry (ISSUE 6): file train_step costs once,
+        # BEFORE the dispatch donates params/opt/state (AOT — no exec);
+        # telemetry.training.mark_iteration feeds the measured ms side
+        from deeplearning4j_tpu.telemetry import profiler as _profiler
+        if _profiler.enabled() \
+                and not getattr(self, "_profiled_fit_batch", False):
+            self._profiled_fit_batch = True
+            try:
+                _profiler.register("train_step", self._train_step_fn,
+                                   step_args, meta={"loop": "fit_batch"})
+            except Exception:
+                pass
         new_params, new_opt, new_states, loss, final_rnn, health_stash = \
-            self._train_step_fn(
-                self.params_tree, self._opt_state, self.state_tree,
-                jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask,
-                rnn_init_states, self._health_nf_in())
+            self._train_step_fn(*step_args)
         self.params_tree = new_params
         self._opt_state = new_opt
         self.state_tree = new_states
@@ -479,11 +491,22 @@ class MultiLayerNetwork(DivergenceSentinelMixin, _health.HealthMonitorMixin):
         run = self._get_device_loop(per_step_data, has_fm, has_lm, vary_batch)
 
         self._rng, sub = jax.random.split(self._rng)
-        (self.params_tree, self._opt_state, self.state_tree, _, _, div), losses, \
-            health_out = run(
-                self.params_tree, self._opt_state, self.state_tree,
+        args = (self.params_tree, self._opt_state, self.state_tree,
                 jnp.asarray(self._step, jnp.int32), sub, x, y, fmask, lmask,
-                self._health_nf_in(), n=int(steps))
+                self._health_nf_in())
+        # profiler cost registry (ISSUE 6): file per-step train_step costs
+        # BEFORE the dispatch below donates params/opt/state; `warm` gates
+        # the wall-time observation so compile time never pollutes it
+        import time as _time
+        from deeplearning4j_tpu import telemetry as _telemetry
+        from deeplearning4j_tpu.telemetry import profiler as _profiler
+        warm = _profiler.register_train_loop(
+            self, ("mln", per_step_data, has_fm, has_lm, vary_batch,
+                   self._health_key()), run, args, int(steps))
+        t_run = _time.perf_counter()
+        with _telemetry.span("fit_on_device", steps=int(steps), model="mln"):
+            (self.params_tree, self._opt_state, self.state_tree, _, _, div), \
+                losses, health_out = run(*args, n=int(steps))
         self._step += int(steps)
         # sticky device-side stash: a clean later call must not clobber an
         # unobserved divergence from an earlier deferred call
@@ -496,6 +519,11 @@ class MultiLayerNetwork(DivergenceSentinelMixin, _health.HealthMonitorMixin):
             self._score = losses[-1]      # device scalar; host sync deferred
             return losses                 # divergence resolves on _diverged_at
         losses, div = jax.device_get((losses, self._pending_div))  # ONE readback
+        if warm:
+            # warm + sync: the wall spans the whole device loop plus its one
+            # readback — a host value the sync path already paid for
+            _profiler.observe("train_step", (_time.perf_counter() - t_run)
+                              * 1e3 / max(1, int(steps)))
         self._score = float(losses[-1])
         self._resolve_divergence(int(div))
         return losses

@@ -90,7 +90,19 @@ def helpers_enabled_for(op_name: str) -> bool:
 def helper_for(op_name: str, fallback: Callable) -> Callable:
     """The seam: accelerated impl if registered+enabled, else the fallback
     (ref LayerHelper selection in BaseLayer.initializeHelper)."""
-    if op_name in _REGISTRY and helpers_enabled_for(op_name):
+    engaged = op_name in _REGISTRY and helpers_enabled_for(op_name)
+    # seam attribution (ISSUE 6): count which path resolved, at resolve
+    # time — under jit that is trace time, never per step. Sanitized: op
+    # names are free-form ("conv1x1-bn-relu" would break exposition).
+    try:
+        from deeplearning4j_tpu import telemetry
+        telemetry.registry().counter(
+            f"ops.helper.{telemetry.sanitize_component(op_name)}."
+            f"{'kernel' if engaged else 'fallback'}",
+            "helper-seam resolutions by path (counted at trace time)").inc()
+    except Exception:
+        pass
+    if engaged:
         return _REGISTRY[op_name]
     return fallback
 

@@ -157,6 +157,9 @@ class StackDecoder:
                                       self.dtype)
         self._prefill_jit = jax.jit(self._prefill_fn)
         self._decode_jit = jax.jit(self._decode_fn)
+        self._profiled_buckets: set = set()   # prefill cost-registry dedup
+        self.metrics = None    # engine installs its child registry here so
+        # prefill cost gauges land next to the engine's observe() gauges
 
     # ------------------------------------------------------------ pure fns
     def _positionwise(self, layer, params, x):
@@ -252,9 +255,24 @@ class StackDecoder:
         Tp = min(self.cache.max_len, 1 << max(0, (T - 1)).bit_length())
         if Tp != T:
             x = jnp.pad(x, ((0, 0), (0, Tp - T)))
+        slot_a = jnp.asarray(slot, jnp.int32)
+        plen_a = jnp.asarray(T, jnp.int32)
+        # profiler cost registry (ISSUE 6): file this bucket's XLA
+        # cost_analysis once per compiled shape when profiling is on — AOT
+        # lower/compile, nothing executes, no buffer donated
+        from deeplearning4j_tpu.telemetry import profiler
+        if profiler.enabled() and Tp not in self._profiled_buckets:
+            self._profiled_buckets.add(Tp)
+            try:
+                profiler.register(f"prefill_b{Tp}", self._prefill_jit,
+                                  (self.params, self.cache.state, x,
+                                   slot_a, plen_a),
+                                  meta={"bucket": Tp},
+                                  registry=self.metrics)
+            except Exception:
+                pass
         self.cache.state, logprobs = self._prefill_jit(
-            self.params, self.cache.state, x,
-            jnp.asarray(slot, jnp.int32), jnp.asarray(T, jnp.int32))
+            self.params, self.cache.state, x, slot_a, plen_a)
         return logprobs
 
     def decode_step(self, x, active) -> jnp.ndarray:

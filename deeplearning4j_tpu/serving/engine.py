@@ -67,6 +67,8 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.telemetry import memory as _tmemory
+from deeplearning4j_tpu.telemetry import profiler as _profiler
 from deeplearning4j_tpu.serving.decode import StackDecoder, one_hot_embedder
 from deeplearning4j_tpu.serving.sampler import Sampler, sample_tokens
 
@@ -300,6 +302,26 @@ class ServingEngine:
         self._g_occ = self.metrics.gauge(
             "serving.slot_occupancy", "slots holding an active request")
         self._seen_shapes: set = set()   # jit cache-miss attribution
+        # HBM accounting (ISSUE 6): param and KV-cache bytes are geometry
+        # the host already knows; residency is updated only at scheduling
+        # events (admit/retire/chunk bookkeeping) from host counters —
+        # never a device read. memory.poll() runs at phase boundaries only
+        # (construction here, end of drain).
+        cache = self.decoder.cache
+        self.decoder.metrics = self.metrics   # prefill cost gauges land on
+        # the same child registry as the engine's observe() gauges
+        self._kv_bytes_per_pos = cache.bytes() // (cache.max_seqs
+                                                   * cache.max_len)
+        self._g_kv_total = self.metrics.gauge(
+            "serving.kv_cache_bytes", "preallocated KV cache footprint")
+        self._g_kv_total.set(cache.bytes())
+        self._g_kv_res = self.metrics.gauge(
+            "serving.kv_bytes_resident", "KV bytes holding live "
+            "prompt+generated positions across active slots")
+        self._g_params = self.metrics.gauge(
+            "serving.param_bytes", "decoder parameter bytes")
+        self._g_params.set(_tmemory.param_bytes(self.decoder.params))
+        _tmemory.poll("serving.engine_init", registry=self.metrics)
 
     # host_syncs / tokens_out live on the registry (ISSUE 4 satellite) but
     # stay assignable attributes for callers that reset them (bench.py)
@@ -390,6 +412,7 @@ class ServingEngine:
                 self._c_compiles.inc()
             cm = telemetry.span("jit_compile", kind="prefill",
                                 bucket=bucket) if miss else telemetry.NULL_SPAN
+            t_pf = time.perf_counter()
             with cm, telemetry.span("prefill", slot=slot, plen=plen,
                                     bucket=bucket):
                 lp = self.decoder.prefill(slot, feats)
@@ -416,6 +439,14 @@ class ServingEngine:
             self._c_tokens.inc()
             self._c_admits.inc()
             act.t_first = time.monotonic()
+            if _profiler.enabled():
+                # the admission's device work (prefill dispatch + first
+                # sample + the counted readback), from the host wall the
+                # scheduler already measures — no added sync
+                _profiler.observe(f"prefill_b{bucket}",
+                                  (time.perf_counter() - t_pf) * 1e3,
+                                  registry=self.metrics)
+            self._update_kv_resident()
             telemetry.instant("admit", slot=slot, plen=plen,
                               queued=len(self._queue))
             self._h_ttft.observe(act.t_first - act.t_submit)
@@ -463,7 +494,40 @@ class ServingEngine:
         self._c_retires.inc()
         if tps is not None:
             self._h_tps.observe(tps)
+        self._update_kv_resident()
         telemetry.instant("retire", slot=slot, reason=reason, tokens=n)
+
+    def _update_kv_resident(self) -> None:
+        """Publish resident KV bytes: cache positions actually holding a
+        live prompt+generated token across active slots, from the host's
+        own bookkeeping (no device read). Lock held."""
+        pos = sum(len(a.req.tokens) + a.n_generated
+                  for a in self._by_slot.values())
+        self._g_kv_res.set(pos * self._kv_bytes_per_pos)
+
+    def _register_chunk_costs(self, k: int, active) -> None:
+        """File the decode-chunk jit's XLA cost_analysis under
+        `decode_chunk_k<K>` (ISSUE 6) — called on a compile-cache miss,
+        BEFORE the dispatch, only when profiling is on. AOT lower/compile:
+        nothing executes, nothing is donated, no sync — the counted sync
+        sequence is bit-identical with profiling on or off."""
+        try:
+            temps = jnp.asarray(self._temps)
+            common = (self.decoder.params, self.decoder.cache.state,
+                      self._hist, self._last, self._plens, self._eos,
+                      self._maxgen, active)
+            if k == 1:
+                _profiler.register("decode_chunk_k1", self._step_jit,
+                                   common + (self.sampler.peek_keys(1)[0],
+                                             temps),
+                                   meta={"k": 1}, registry=self.metrics)
+            else:
+                _profiler.register(f"decode_chunk_k{k}", self._chunk_jit,
+                                   common + (self.sampler.peek_keys(k),
+                                             temps),
+                                   meta={"k": k}, registry=self.metrics)
+        except Exception:
+            pass
 
     def _expire_timeouts(self) -> None:
         """Retire timed-out requests before spending device time on them.
@@ -515,6 +579,7 @@ class ServingEngine:
             if not new_np[slot]:
                 self._active_mask[slot] = False
                 self._retire(slot, "length", hist=hist)
+        self._update_kv_resident()
 
     def step(self) -> bool:
         """One scheduler iteration: admit, decode ONE CHUNK (adaptive K
@@ -540,6 +605,8 @@ class ServingEngine:
             if miss:
                 self._seen_shapes.add(("chunk", k_eff))
                 self._c_compiles.inc()
+                if _profiler.enabled():
+                    self._register_chunk_costs(k_eff, active)
             cm = telemetry.span("jit_compile", kind="chunk",
                                 k=k_eff) if miss else telemetry.NULL_SPAN
             with cm, telemetry.span("decode_chunk", k=k_eff,
@@ -576,7 +643,11 @@ class ServingEngine:
                 if bool(nf):
                     self._c_nonfinite.inc()
             self._c_syncs.inc()
-            self._h_chunk_ms.observe((time.perf_counter() - t_chunk) * 1e3)
+            chunk_ms = (time.perf_counter() - t_chunk) * 1e3
+            self._h_chunk_ms.observe(chunk_ms)
+            if _profiler.enabled():
+                _profiler.observe(f"decode_chunk_k{k_eff}", chunk_ms,
+                                  registry=self.metrics)
             # sync-ok: capture_logprobs mode only
             lp_np = np.asarray(lps) if self.capture_logprobs else None
             self._finish_steps(snapshot, entry_np, new_np, lp_np)
@@ -610,6 +681,9 @@ class ServingEngine:
                         if miss:
                             self._seen_shapes.add(("chunk", k_eff))
                             self._c_compiles.inc()
+                            if _profiler.enabled():
+                                self._register_chunk_costs(
+                                    k_eff, self._dev_active)
                         cm = telemetry.span(
                             "jit_compile", kind="chunk",
                             k=k_eff) if miss else telemetry.NULL_SPAN
@@ -627,11 +701,13 @@ class ServingEngine:
                                 self._eos, self._maxgen, self._dev_active,
                                 keys, jnp.asarray(self._temps))
                         dispatched = (snapshot, entries, self._dev_active,
-                                      self._hist, nf, time.perf_counter())
+                                      self._hist, nf, time.perf_counter(),
+                                      k_eff)
                     # chunk i+1 is enqueued; materializing chunk i's masks
                     # now overlaps host bookkeeping with device compute
                     if pending is not None:
-                        snapshot, entries, final, hist, nf, t_disp = pending
+                        (snapshot, entries, final, hist, nf, t_disp,
+                         k_prev) = pending
                         with telemetry.span("host_sync", what="chunk_masks",
                                             overlap=True):
                             # sync-ok: the counted per-chunk readback
@@ -642,8 +718,15 @@ class ServingEngine:
                             if bool(nf):
                                 self._c_nonfinite.inc()
                         self._c_syncs.inc()
-                        self._h_chunk_ms.observe(
-                            (time.perf_counter() - t_disp) * 1e3)
+                        chunk_ms = (time.perf_counter() - t_disp) * 1e3
+                        self._h_chunk_ms.observe(chunk_ms)
+                        if _profiler.enabled():
+                            # overlapped wall spans dispatch->readback of
+                            # the SAME chunk (one pipeline stage) — still a
+                            # host value the loop already computes
+                            _profiler.observe(f"decode_chunk_k{k_prev}",
+                                              chunk_ms,
+                                              registry=self.metrics)
                         self._finish_steps(snapshot, entry_np, new_np, None,
                                            hist=hist)
                     pending = dispatched
@@ -666,6 +749,9 @@ class ServingEngine:
         # $DL4J_TPU_TRACE_PATH: export the recorded spans after every full
         # drain (last writer wins) — cheap host I/O, outside the hot loop
         telemetry.maybe_export_trace()
+        # HBM phase-boundary probe (ISSUE 6): the drain just ended, the
+        # host owns this boundary — never polled per token/step
+        _tmemory.poll("serving.drain", registry=self.metrics)
 
     def generate(self, prompts, **kw) -> List[GenerationResult]:
         """Synchronous convenience: submit every prompt (a Request or a

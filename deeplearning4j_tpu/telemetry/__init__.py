@@ -24,6 +24,12 @@ Env toggles:
   training-health policy for models that did not call `configure_health`
   (health.py, ISSUE 5). Unset means health is off unless a listener or the
   model opts in.
+- DL4J_TPU_PROFILE=1|costs enables the compiled-function cost registry +
+  per-function MFU/roofline gauges (profiler.py, ISSUE 6); any other
+  non-empty value is additionally the jax.profiler capture directory —
+  `profiler.maybe_capture()` regions write a device trace there and merge
+  it with this tracer's timeline into one Perfetto view. Unset/0 keeps the
+  profiling call sites inert (default).
 """
 from __future__ import annotations
 
@@ -41,16 +47,21 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
     "DEFAULT_MS_BUCKETS", "DEFAULT_S_BUCKETS", "registry", "tracer", "span",
     "instant", "enabled", "configure", "maybe_export_trace", "metrics_route",
-    "PROMETHEUS_CONTENT_TYPE", "health",
+    "PROMETHEUS_CONTENT_TYPE", "sanitize_component", "health", "profiler",
+    "memory",
 ]
+
+from deeplearning4j_tpu.telemetry.registry import sanitize_component  # noqa: E402,F401
 
 
 def __getattr__(name):
-    # `telemetry.health` (ISSUE 5) is the one jax-importing module in the
-    # package — loaded lazily so registry/tracing users stay jax-free
-    if name == "health":
+    # health (ISSUE 5) / profiler / memory (ISSUE 6) import jax (lazily in
+    # the ISSUE 6 pair's case, but profiler also pulls util.costs) — loaded
+    # on first attribute access so registry/tracing users stay jax-free
+    if name in ("health", "profiler", "memory"):
         import importlib
-        return importlib.import_module("deeplearning4j_tpu.telemetry.health")
+        return importlib.import_module(
+            f"deeplearning4j_tpu.telemetry.{name}")
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -58,7 +69,10 @@ PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 _ENABLED = os.environ.get("DL4J_TPU_TELEMETRY", "1").lower() \
     not in ("0", "false", "off")
 _REGISTRY = MetricsRegistry()
-_TRACER = Tracer(enabled=_ENABLED)
+_TRACER = Tracer(enabled=_ENABLED,
+                 drop_counter=_REGISTRY.counter(
+                     "telemetry.trace.dropped_events",
+                     "span events dropped by the tracer's bounded buffer"))
 
 
 def registry() -> MetricsRegistry:

@@ -1,0 +1,200 @@
+"""HBM memory accounting (ISSUE 6 tentpole, part 2).
+
+Device memory is read ONLY at phase boundaries the host already owns —
+engine construction, end of drain, end of a fit_on_device call, explicit
+bench probes — never per token or per step, so the PR 4 zero-added-syncs
+invariant holds with memory accounting on.
+
+Two data sources, degrading gracefully:
+- `device.memory_stats()` — TPU/GPU allocator stats (bytes_in_use,
+  peak_bytes_in_use, bytes_limit...). Returns None on CPU.
+- live-buffer fallback — `sum(a.nbytes for a in jax.live_arrays())`.
+  nbytes is shape/dtype METADATA on the host-side array object: summing it
+  never materializes device data (no sync). No allocator limit exists in
+  this mode, so headroom/peak gauges stay unset and the returned dict says
+  `stats_available: False` with the `platform` label making the CPU case
+  explicit.
+
+Published gauges (process registry by default; the serving engine passes
+its per-engine child registry so per-engine residency shows up under the
+parent's /metrics via adoption):
+- memory.device.bytes_in_use / .peak_bytes / .bytes_limit
+- memory.device.headroom_bytes    — bytes_limit - bytes_in_use (OOM margin)
+- memory.device.watermark_bytes   — process-lifetime max bytes_in_use seen
+                                    by any poll (peak tracking survives
+                                    allocator resets)
+- memory.device.stats_available   — 1/0 (0 = live-buffer fallback platform)
+- memory.live_buffer_bytes        — fallback total (also useful on TPU as
+                                    the framework's-eye view)
+- memory.params.<name>.bytes      — per-model parameter bytes (metadata)
+- counter memory.polls
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from deeplearning4j_tpu.telemetry.registry import (MetricsRegistry,
+                                                   sanitize_component)
+
+_WATERMARK = 0.0
+_WATERMARK_LOCK = threading.Lock()
+
+
+def _default_registry() -> MetricsRegistry:
+    from deeplearning4j_tpu import telemetry
+    return telemetry.registry()
+
+
+def _default_device():
+    import jax
+    return jax.devices()[0]
+
+
+def live_buffer_bytes() -> int:
+    """Total bytes of live jax arrays (host-side metadata sum — no device
+    sync). 0 when jax is unavailable."""
+    try:
+        import jax
+        # sync-ok: nbytes is shape/dtype metadata on the host array object
+        return int(sum(a.nbytes for a in jax.live_arrays()))
+    except Exception:
+        return 0
+
+
+def stats(device: Any = None) -> dict:
+    """Point-in-time device-memory view. Keys: platform, stats_available,
+    bytes_in_use, peak_bytes_in_use, bytes_limit, headroom_bytes (None
+    where the allocator exposes nothing), live_buffer_bytes (always).
+    On CPU, `memory_stats()` returns None: stats_available is False and
+    bytes_in_use falls back to the live-buffer sum."""
+    if device is None:
+        try:
+            device = _default_device()
+        except Exception:
+            return {"platform": "unknown", "stats_available": False,
+                    "bytes_in_use": None, "peak_bytes_in_use": None,
+                    "bytes_limit": None, "headroom_bytes": None,
+                    "live_buffer_bytes": 0}
+    plat = getattr(device, "platform", "unknown")
+    raw = None
+    try:
+        raw = device.memory_stats()
+    except Exception:
+        raw = None
+    live = live_buffer_bytes()
+    if not raw:
+        return {"platform": plat, "stats_available": False,
+                "bytes_in_use": live, "peak_bytes_in_use": None,
+                "bytes_limit": None, "headroom_bytes": None,
+                "live_buffer_bytes": live}
+    in_use = raw.get("bytes_in_use")
+    limit = raw.get("bytes_limit", raw.get("bytes_reservable_limit"))
+    return {
+        "platform": plat,
+        "stats_available": True,
+        "bytes_in_use": None if in_use is None else int(in_use),
+        "peak_bytes_in_use": (None if raw.get("peak_bytes_in_use") is None
+                              else int(raw["peak_bytes_in_use"])),
+        "bytes_limit": None if limit is None else int(limit),
+        "headroom_bytes": (None if in_use is None or limit is None
+                           else int(limit) - int(in_use)),
+        "live_buffer_bytes": live,
+    }
+
+
+def poll(phase: str = "", registry: Optional[MetricsRegistry] = None,
+         device: Any = None) -> dict:
+    """Read device memory once (a phase-boundary probe — NOT for hot
+    loops) and publish the gauge set. Returns the `stats()` dict plus
+    {"phase", "watermark_bytes"}. Also drops a tracer instant event so
+    memory probes are visible on the merged timeline."""
+    global _WATERMARK
+    s = stats(device)
+    reg = registry or _default_registry()
+    reg.counter("memory.polls", "device memory polls (phase boundaries)"
+                ).inc()
+    reg.gauge("memory.device.stats_available",
+              "1 when device.memory_stats() works; 0 = live-buffer "
+              "fallback (CPU)").set(1.0 if s["stats_available"] else 0.0)
+    reg.gauge("memory.live_buffer_bytes",
+              "total bytes of live jax arrays (metadata sum)"
+              ).set(s["live_buffer_bytes"])
+    observed = s["bytes_in_use"]
+    if s["peak_bytes_in_use"] is not None:
+        observed = max(observed or 0, s["peak_bytes_in_use"])
+    with _WATERMARK_LOCK:
+        if observed is not None and observed > _WATERMARK:
+            # sync-ok: allocator-stat int from memory_stats(), a host value
+            _WATERMARK = float(observed)
+        watermark = _WATERMARK
+    reg.gauge("memory.device.watermark_bytes",
+              "process-lifetime max device bytes_in_use seen by polls"
+              ).set(watermark)
+    if s["bytes_in_use"] is not None:
+        reg.gauge("memory.device.bytes_in_use",
+                  "device allocator bytes in use (live-buffer sum on CPU)"
+                  ).set(s["bytes_in_use"])
+    if s["peak_bytes_in_use"] is not None:
+        reg.gauge("memory.device.peak_bytes",
+                  "device allocator peak bytes in use"
+                  ).set(s["peak_bytes_in_use"])
+    if s["bytes_limit"] is not None:
+        reg.gauge("memory.device.bytes_limit",
+                  "device allocator capacity").set(s["bytes_limit"])
+    if s["headroom_bytes"] is not None:
+        reg.gauge("memory.device.headroom_bytes",
+                  "bytes_limit - bytes_in_use (OOM margin)"
+                  ).set(s["headroom_bytes"])
+    try:
+        from deeplearning4j_tpu import telemetry
+        telemetry.instant("memory.poll", phase=phase,
+                          bytes_in_use=s["bytes_in_use"],
+                          platform=s["platform"])
+    except Exception:
+        pass
+    out = dict(s)
+    out["phase"] = phase
+    out["watermark_bytes"] = watermark
+    return out
+
+
+def watermark_bytes() -> float:
+    """Process-lifetime max device bytes_in_use seen by any poll."""
+    return _WATERMARK
+
+
+def reset_watermark() -> None:
+    """Forget the watermark (tests / bench warm-up exclusion)."""
+    global _WATERMARK
+    with _WATERMARK_LOCK:
+        _WATERMARK = 0.0
+
+
+def param_bytes(params: Any) -> int:
+    """Total parameter bytes of a pytree (or an object exposing `.params`):
+    sum of size*itemsize over leaves — pure metadata, no device sync."""
+    try:
+        import jax
+        tree = getattr(params, "params", params)
+        leaves = jax.tree_util.tree_leaves(tree)
+    except Exception:
+        return 0
+    total = 0
+    for leaf in leaves:
+        size = getattr(leaf, "size", None)
+        itemsize = getattr(getattr(leaf, "dtype", None), "itemsize", None)
+        if size is not None and itemsize is not None:
+            total += int(size) * int(itemsize)
+    return total
+
+
+def publish_param_bytes(params: Any, name: str = "model",
+                        registry: Optional[MetricsRegistry] = None) -> int:
+    """Publish `memory.params.<name>.bytes` for a model/pytree and return
+    the byte count."""
+    total = param_bytes(params)
+    reg = registry or _default_registry()
+    reg.gauge(f"memory.params.{sanitize_component(name)}.bytes",
+              "model parameter bytes (metadata sum)").set(total)
+    return total

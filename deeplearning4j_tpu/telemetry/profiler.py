@@ -1,0 +1,454 @@
+"""Device-time profiler: compiled-function costs -> live roofline gauges.
+
+ISSUE 6 tentpole, part 1+3. Three jobs:
+
+1. **Compiled-function cost registry.** Every jit entry point (train_step,
+   the fit_on_device scan, prefill buckets, decode_chunk per K, helper
+   kernels) calls `register(name, jitted, args...)` at compile time — an
+   AOT `lower().compile().cost_analysis()` via util/costs, nothing
+   executes, no buffer is donated — filing FLOPs/bytes under the function
+   name and publishing `profiler.fn.<name>.{flops,bytes,mxu_floor_ms}`
+   gauges.
+
+2. **Live roofline attribution.** Call sites feed `observe(name, ms)` with
+   wall times they ALREADY measure on the host (the same perf_counter
+   deltas the tracer's spans record) — combining a host float with a
+   registered cost is pure host arithmetic, so the PR 4 zero-added-syncs
+   invariant holds with profiling on (regression-tested in
+   tests/test_profiler.py). Published per function: an `ms` histogram plus
+   `measured_ms` / `mfu` / `roofline_frac` / `x_floor` gauges.
+
+3. **`jax.profiler` capture.** `DL4J_TPU_PROFILE=/some/dir` (or
+   `capture(dir)`) wraps a region in `jax.profiler.start_trace(...,
+   create_perfetto_trace=True)` and `merge_with_tracer` folds the host
+   Tracer timeline into the device trace (host events shifted onto the
+   device trace's clock) so host spans and device ops land in one Perfetto
+   view.
+
+Honesty notes (the roofline table in PERF.md is generated from this data):
+- `mxu_floor_ms` is flops / peak-FLOPs. On platforms without a peak entry
+  (CPU test runs) the **reference** peak — TPU v5e bf16, 197 TFLOP/s, the
+  ROADMAP's roofline target — is used so attribution ratios exist
+  everywhere; rows and gauges carry the platform so a CPU-measured ms is
+  never mistaken for a TPU claim (`profiler.platform_has_peak` gauge,
+  `platform` field in `roofline_table()`).
+- `bytes_accessed` is XLA's per-HLO sum (ignores fusion reuse) — the
+  optimistic-roof side of the bracket, same caveat as PERF.md.
+
+Env toggle: DL4J_TPU_PROFILE=1|true|costs enables cost registration at the
+instrumented call sites; any other non-empty value additionally names the
+capture directory for `maybe_capture()`. Unset/0 keeps every site inert
+(one dict/flag check on the compile-miss path, nothing per token/step).
+"""
+from __future__ import annotations
+
+import contextlib
+import glob as _glob
+import gzip
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.telemetry.registry import (DEFAULT_MS_BUCKETS,
+                                                   MetricsRegistry,
+                                                   sanitize_component)
+from deeplearning4j_tpu.util import costs as _costs
+
+# bf16 peak FLOP/s per chip by jax.default_backend() name. TPU v5e (lite)
+# MXU peak — the denominator the ROADMAP roofline item tracks. Extend via
+# configure(peak_flops=...) for other parts.
+PEAK_FLOPS: Dict[str, float] = {"tpu": 197e12}
+HBM_GBS: Dict[str, float] = {"tpu": 819e9}
+REFERENCE_PLATFORM = "tpu"
+
+_FALSEY = ("", "0", "false", "off")
+_TRUTHY_COSTS_ONLY = ("1", "true", "on", "costs", "yes")
+
+_env = os.environ.get("DL4J_TPU_PROFILE", "")
+_ENABLED = _env.lower() not in _FALSEY
+_CAPTURE_DIR: Optional[str] = (
+    _env if _ENABLED and _env.lower() not in _TRUTHY_COSTS_ONLY else None)
+_PLATFORM: Optional[str] = None          # lazy jax.default_backend()
+
+# host-side per-function aggregates: name -> {count, total_ms, last_ms}
+_OBSERVED: Dict[str, dict] = {}
+
+
+def enabled() -> bool:
+    """Whether instrumented call sites should register costs / feed
+    observations (DL4J_TPU_PROFILE, default off)."""
+    return _ENABLED
+
+
+def capture_dir() -> Optional[str]:
+    """The jax.profiler capture directory, when DL4J_TPU_PROFILE named one
+    (any value that is not a plain on/off token)."""
+    return _CAPTURE_DIR
+
+
+def configure(enabled: Optional[bool] = None,
+              platform: Optional[str] = None,
+              capture_dir: Optional[str] = None,
+              peak_flops: Optional[float] = None,
+              hbm_gbs: Optional[float] = None) -> None:
+    """Override env defaults at runtime (tests, bench, embedding apps).
+    `peak_flops`/`hbm_gbs` install an entry for the current (or given)
+    platform."""
+    global _ENABLED, _PLATFORM, _CAPTURE_DIR
+    if enabled is not None:
+        _ENABLED = bool(enabled)
+    if platform is not None:
+        _PLATFORM = str(platform)
+    if capture_dir is not None:
+        _CAPTURE_DIR = capture_dir or None
+    if peak_flops is not None:
+        # sync-ok: configuration scalar from the caller, never a device buffer
+        PEAK_FLOPS[platform or _detect_platform()] = float(peak_flops)
+    if hbm_gbs is not None:
+        # sync-ok: configuration scalar from the caller, never a device buffer
+        HBM_GBS[platform or _detect_platform()] = float(hbm_gbs)
+
+
+def clear_observations() -> None:
+    """Drop the host wall-time aggregates, keeping registered costs and
+    config — callers use this between a compile warmup and the timed runs
+    so `roofline_table()` means are compile-free (bench_serving_profile)."""
+    _OBSERVED.clear()
+
+
+def reset() -> None:
+    """Forget observations and restore env-derived config (tests)."""
+    global _ENABLED, _PLATFORM, _CAPTURE_DIR
+    _OBSERVED.clear()
+    env = os.environ.get("DL4J_TPU_PROFILE", "")
+    _ENABLED = env.lower() not in _FALSEY
+    _CAPTURE_DIR = (env if _ENABLED
+                    and env.lower() not in _TRUTHY_COSTS_ONLY else None)
+    _PLATFORM = None
+
+
+def _detect_platform() -> str:
+    global _PLATFORM
+    if _PLATFORM is None:
+        try:
+            import jax
+            _PLATFORM = jax.default_backend()
+        except Exception:
+            _PLATFORM = "unknown"
+    return _PLATFORM
+
+
+def platform() -> str:
+    """The accelerator platform name ("tpu"/"cpu"/...), detected lazily."""
+    return _detect_platform()
+
+
+def reference_peak_flops(plat: Optional[str] = None) -> float:
+    """Peak FLOP/s used for floors/MFU: the platform's entry when known,
+    otherwise the v5e REFERENCE peak (attribution aid on CPU, not a
+    hardware claim — `platform_has_peak(plat)` says which case applies)."""
+    plat = plat or _detect_platform()
+    return PEAK_FLOPS.get(plat, PEAK_FLOPS[REFERENCE_PLATFORM])
+
+
+def platform_has_peak(plat: Optional[str] = None) -> bool:
+    return (plat or _detect_platform()) in PEAK_FLOPS
+
+
+def mxu_floor_ms(flops: float, plat: Optional[str] = None) -> float:
+    """Compute-roofline floor in ms for `flops` on `plat` (reference peak
+    when the platform has no entry)."""
+    peak = reference_peak_flops(plat)
+    return flops / peak * 1e3 if peak > 0 else 0.0
+
+
+def _default_registry() -> MetricsRegistry:
+    from deeplearning4j_tpu import telemetry
+    return telemetry.registry()
+
+
+# ------------------------------------------------------------- register
+def register(name: str, jitted=None, args=(), kwargs=None, *,
+             flops: Optional[float] = None,
+             bytes_accessed: Optional[float] = None,
+             meta: Optional[dict] = None,
+             registry: Optional[MetricsRegistry] = None) -> dict:
+    """Register a compiled function's cost-model numbers under `name`.
+
+    Either pass `jitted` (+ the call args about to be dispatched) for an
+    AOT `cost_analysis()`, or pass `flops`/`bytes_accessed` directly (bench
+    replays already-measured numbers). Registration is explicit — the
+    instrumented call sites gate on `enabled()` so default runs never pay
+    the extra lower/compile. Publishes `profiler.fn.<name>.flops/.bytes/
+    .mxu_floor_ms` gauges and returns the cost record.
+
+    Safe to call immediately before dispatching a donated-arg jit (AOT
+    lowering does not consume buffers) — and that ordering is REQUIRED for
+    train_step, whose params are donated by the real call."""
+    plat = _detect_platform()
+    meta = dict(meta or {})
+    meta.setdefault("platform", plat)
+    if jitted is not None:
+        rec = _costs.analyze_and_record(name, jitted, *args,
+                                        meta=meta, **(kwargs or {}))
+    else:
+        rec = _costs.record_costs(name, flops or 0.0, bytes_accessed or 0.0,
+                                  meta=meta)
+    reg = registry or _default_registry()
+    n = sanitize_component(name)
+    reg.gauge(f"profiler.fn.{n}.flops",
+              "XLA cost-model FLOPs per call").set(rec["flops"])
+    reg.gauge(f"profiler.fn.{n}.bytes",
+              "XLA cost-model bytes accessed per call (per-HLO sum)"
+              ).set(rec["bytes_accessed"])
+    reg.gauge(f"profiler.fn.{n}.mxu_floor_ms",
+              "compute-roofline floor ms (reference peak off-TPU)"
+              ).set(mxu_floor_ms(rec["flops"], plat))
+    reg.gauge("profiler.platform_has_peak",
+              "1 when the platform has a real peak-FLOPs entry; 0 means "
+              "floors/MFU use the v5e reference peak (attribution aid)"
+              ).set(1.0 if platform_has_peak(plat) else 0.0)
+    return rec
+
+
+# -------------------------------------------------------------- observe
+def observe(name: str, ms: float,
+            registry: Optional[MetricsRegistry] = None) -> None:
+    """Feed one measured wall-time (milliseconds, a HOST value the caller
+    already holds — never a device read) for a registered function.
+    Publishes the ms histogram + measured_ms gauge, and when costs are on
+    file, the mfu / roofline_frac / x_floor gauges. Pure host arithmetic:
+    zero added syncs."""
+    ms = float(ms)  # sync-ok: caller passes a host wall-clock delta
+    agg = _OBSERVED.get(name)
+    if agg is None:
+        agg = _OBSERVED.setdefault(name, {"count": 0, "total_ms": 0.0,
+                                          "last_ms": 0.0})
+    agg["count"] += 1
+    agg["total_ms"] += ms
+    agg["last_ms"] = ms
+    reg = registry or _default_registry()
+    n = sanitize_component(name)
+    reg.histogram(f"profiler.fn.{n}.ms",
+                  "measured wall time per call (host clock)",
+                  buckets=DEFAULT_MS_BUCKETS).observe(ms)
+    reg.gauge(f"profiler.fn.{n}.measured_ms",
+              "last measured wall time per call").set(ms)
+    rec = _costs.get_costs(name)
+    if rec is None or ms <= 0.0:
+        return
+    plat = rec.get("meta", {}).get("platform") or _detect_platform()
+    floor = mxu_floor_ms(rec["flops"], plat)
+    if floor > 0.0:
+        reg.gauge(f"profiler.fn.{n}.roofline_frac",
+                  "MXU-floor ms / measured ms (1.0 = at the roofline)"
+                  ).set(floor / ms)
+        reg.gauge(f"profiler.fn.{n}.x_floor",
+                  "measured ms / MXU-floor ms").set(ms / floor)
+    peak = reference_peak_flops(plat)
+    if rec["flops"] > 0.0 and peak > 0.0:
+        reg.gauge(f"profiler.fn.{n}.mfu",
+                  "model FLOPs utilization vs platform peak "
+                  "(reference peak off-TPU)"
+                  ).set(rec["flops"] / (ms * 1e-3) / peak)
+
+
+def register_train_loop(owner, key, run, args, steps: int,
+                        name: str = "train_step") -> bool:
+    """fit_on_device hook: register per-step `train_step` costs for a
+    jitted scan loop, once per loop cache key, and report warmness.
+
+    MUST be called BEFORE the dispatch — the real call donates the
+    params/opt/state buffers in `args`, while the AOT cost analysis here
+    only lowers (nothing executes, nothing is donated). Costs are analyzed
+    at the loop's real signature (n=steps) and normalized to per-step so
+    the `train_step` entry is comparable across step counts.
+
+    Returns True when this key has dispatched before (WARM) — the caller
+    observes wall time only then, so the first call's jit compile never
+    pollutes the measured ms. No-op returning False when profiling is off."""
+    if not enabled():
+        return False
+    profiled = owner.__dict__.setdefault("_profiler_loop_keys", set())
+    warm = key in profiled
+    if warm:
+        return True
+    profiled.add(key)
+    try:
+        costs = _costs.lowered_costs(run, *args, n=int(steps))
+        register(name,
+                 flops=costs["flops"] / max(1, int(steps)),
+                 bytes_accessed=costs["bytes_accessed"] / max(1, int(steps)),
+                 meta={"normalized_per_step": True, "steps_analyzed":
+                       int(steps), "loop": str(key[0])})
+    except Exception:
+        pass
+    return False
+
+
+def observed(name: str) -> Optional[dict]:
+    """Host aggregate for `name`: {count, total_ms, last_ms} or None."""
+    agg = _OBSERVED.get(name)
+    return dict(agg) if agg else None
+
+
+# ------------------------------------------------------- roofline table
+def roofline_table(registry: Optional[MetricsRegistry] = None) -> List[dict]:
+    """Join registered costs with host aggregates into the rows perf_docs
+    renders: one dict per function with measured vs floor, MFU, bytes.
+    Functions registered but never observed get measured_ms None (compile
+    happened, no timed call yet)."""
+    rows: List[dict] = []
+    for name, rec in sorted(_costs.all_costs().items()):
+        plat = rec.get("meta", {}).get("platform") or _detect_platform()
+        agg = _OBSERVED.get(name)
+        mean_ms = (agg["total_ms"] / agg["count"]
+                   if agg and agg["count"] else None)
+        floor = mxu_floor_ms(rec["flops"], plat)
+        peak = reference_peak_flops(plat)
+        row = {
+            "function": name,
+            "platform": plat,
+            "flops": rec["flops"],
+            "bytes_accessed": rec["bytes_accessed"],
+            "mxu_floor_ms": round(floor, 4),
+            "measured_ms": None if mean_ms is None else round(mean_ms, 4),
+            "calls": agg["count"] if agg else 0,
+            "mfu": None,
+            "x_floor": None,
+            "reference_peak": not platform_has_peak(plat),
+        }
+        if mean_ms and mean_ms > 0.0:
+            if rec["flops"] > 0.0 and peak > 0.0:
+                mfu = rec["flops"] / (mean_ms * 1e-3) / peak
+                # keep tiny utilizations exact — rounding a CPU row to 0.0
+                # would read as "no flops ran" (and fail the schema's (0,1))
+                row["mfu"] = round(mfu, 4) if mfu >= 1e-4 else mfu
+            if floor > 0.0:
+                row["x_floor"] = round(mean_ms / floor, 2)
+        rows.append(row)
+    return rows
+
+
+def attribute_from_tracer(tracer=None,
+                          names: Optional[List[str]] = None) -> Dict[str, dict]:
+    """Aggregate the Tracer's recorded 'X' spans by name — total/mean ms
+    and count per span name — and join registered costs where the span
+    name matches a cost entry (floor, x_floor vs the span mean). Pure
+    post-hoc host work over the already-recorded buffer; records nothing
+    back (call `observe` for live gauges)."""
+    if tracer is None:
+        from deeplearning4j_tpu import telemetry
+        tracer = telemetry.tracer()
+    agg: Dict[str, dict] = {}
+    for ev in tracer.chrome_trace()["traceEvents"]:
+        if ev.get("ph") != "X":
+            continue
+        name = ev.get("name", "")
+        if names is not None and name not in names:
+            continue
+        a = agg.setdefault(name, {"count": 0, "total_ms": 0.0})
+        a["count"] += 1
+        a["total_ms"] += ev.get("dur", 0.0) / 1e3
+    for name, a in agg.items():
+        a["mean_ms"] = a["total_ms"] / a["count"] if a["count"] else None
+        rec = _costs.get_costs(name)
+        if rec is not None and a["mean_ms"]:
+            plat = rec.get("meta", {}).get("platform") or _detect_platform()
+            floor = mxu_floor_ms(rec["flops"], plat)
+            a["mxu_floor_ms"] = floor
+            if floor > 0.0:
+                a["x_floor"] = a["mean_ms"] / floor
+    return agg
+
+
+# ------------------------------------------------- jax.profiler capture
+@contextlib.contextmanager
+def capture(log_dir: str, merge: bool = True):
+    """Wrap a region in `jax.profiler.start_trace(log_dir,
+    create_perfetto_trace=True)`. On exit, stop the trace and (when
+    `merge`) fold the host Tracer timeline into the device trace via
+    `merge_with_tracer`. Degrades to a no-op (with a warning) when the
+    backend's profiler is unavailable — never takes the workload down."""
+    import warnings
+    started = False
+    t_start = time.perf_counter()
+    try:
+        import jax
+        jax.profiler.start_trace(log_dir, create_perfetto_trace=True)
+        started = True
+    except Exception as e:
+        warnings.warn(f"jax.profiler capture unavailable "
+                      f"({type(e).__name__}: {e})")
+    try:
+        yield
+    finally:
+        if started:
+            try:
+                import jax
+                jax.profiler.stop_trace()
+                if merge:
+                    merge_with_tracer(log_dir, capture_t0=t_start)
+            except Exception as e:
+                warnings.warn(f"jax.profiler capture failed "
+                              f"({type(e).__name__}: {e})")
+
+
+def maybe_capture(log_dir: Optional[str] = None):
+    """`capture(...)` when a directory is configured (argument or
+    DL4J_TPU_PROFILE=<dir>), else a null context. Lets call sites write
+    `with profiler.maybe_capture(): ...` unconditionally."""
+    log_dir = log_dir or _CAPTURE_DIR
+    if not log_dir:
+        return contextlib.nullcontext()
+    return capture(log_dir)
+
+
+def merge_with_tracer(log_dir: str, out_path: Optional[str] = None,
+                      tracer=None,
+                      capture_t0: Optional[float] = None) -> Optional[str]:
+    """Merge the newest `perfetto_trace.json.gz` under `log_dir` (the
+    jax.profiler device timeline) with the host Tracer's Chrome events
+    into one Perfetto-loadable JSON at `out_path` (default
+    `<log_dir>/merged_trace.json`). Host events keep pid=1 (named
+    "dl4j_tpu host tracer") and are shifted onto the device trace's clock
+    when `capture_t0` (the host perf_counter at capture start) is given —
+    the device trace's ts origin is its own start. Returns the written
+    path, or None when no device trace was found."""
+    if tracer is None:
+        from deeplearning4j_tpu import telemetry
+        tracer = telemetry.tracer()
+    pats = sorted(_glob.glob(os.path.join(
+        log_dir, "**", "perfetto_trace.json.gz"), recursive=True))
+    if not pats:
+        pats = sorted(_glob.glob(os.path.join(
+            log_dir, "**", "*.trace.json.gz"), recursive=True))
+    if not pats:
+        return None
+    with gzip.open(pats[-1], "rt") as f:
+        device_doc = json.load(f)
+    device_events = (device_doc.get("traceEvents", [])
+                     if isinstance(device_doc, dict) else device_doc)
+    host_doc = tracer.chrome_trace()
+    shift_us = 0.0
+    if capture_t0 is not None:
+        # host events' ts origin is the tracer's epoch; the device trace's
+        # is the capture start — shift host events onto the device clock
+        shift_us = (tracer._epoch - capture_t0) * 1e6
+    host_events: List[dict] = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "dl4j_tpu host tracer"}}]
+    for ev in host_doc["traceEvents"]:
+        ev = dict(ev)
+        if "ts" in ev:
+            ev["ts"] = round(ev["ts"] + shift_us, 3)
+        host_events.append(ev)
+    merged = {"displayTimeUnit": "ms",
+              "traceEvents": list(device_events) + host_events,
+              "otherData": {"producer": "deeplearning4j_tpu.telemetry."
+                                        "profiler"}}
+    out_path = out_path or os.path.join(log_dir, "merged_trace.json")
+    with open(out_path, "w") as f:
+        json.dump(merged, f)
+    return out_path

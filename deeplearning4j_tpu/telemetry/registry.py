@@ -255,8 +255,33 @@ class MetricsRegistry:
         return "\n".join(lines) + "\n"
 
 
+def sanitize_component(part: str) -> str:
+    """Sanitize ONE dotted-path component derived from a user-controlled
+    name (layer name, function label, file path) before embedding it in a
+    metric name: dots, dashes, slashes and any other non-alphanumeric
+    character become `_`, so `conv2d-1x1/bn.relu` cannot smuggle extra
+    dotted-path levels or break Prometheus exposition. Idempotent; a
+    leading digit gets a `_` prefix (Prometheus names must not start with
+    a digit). Empty input sanitizes to `_`. ASCII-only: Prometheus names
+    match [a-zA-Z_:][a-zA-Z0-9_:]*, so non-ASCII "alphanumerics" (Ω, ①)
+    must also fold to `_`."""
+    out = "".join(c if ((c.isascii() and c.isalnum()) or c == "_") else "_"
+                  for c in part)
+    if not out:
+        return "_"
+    if out[0].isdigit():
+        out = "_" + out
+    return out
+
+
 def _sanitize(name: str) -> str:
-    return "".join(c if (c.isalnum() or c == "_") else "_" for c in name)
+    out = "".join(c if ((c.isascii() and c.isalnum()) or c == "_") else "_"
+                  for c in name)
+    # Prometheus metric names match [a-zA-Z_:][a-zA-Z0-9_:]* — a leading
+    # digit (possible when a whole name is user-derived) needs a prefix
+    if out and out[0].isdigit():
+        out = "_" + out
+    return out
 
 
 def _fmt(v: float) -> str:

@@ -67,11 +67,16 @@ class Tracer:
     """Bounded in-memory span recorder. All methods are cheap host work;
     `export()` is the only I/O."""
 
-    def __init__(self, max_events: int = 65536, enabled: bool = True):
+    def __init__(self, max_events: int = 65536, enabled: bool = True,
+                 drop_counter=None):
         self.max_events = int(max_events)
         self.enabled = bool(enabled)
         self._events: List[dict] = []
         self._dropped = 0
+        # optional registry Counter mirroring the drop count on /metrics
+        # (ISSUE 6 satellite) — before, drops were only visible in the
+        # exported JSON, i.e. precisely when the buffer was already full
+        self._drop_counter = drop_counter
         self._epoch = time.perf_counter()
         self._lock = threading.Lock()   # append-side: list.append is atomic
         #                                 under the GIL; the lock guards only
@@ -96,6 +101,8 @@ class Tracer:
                 tid: int, args: Optional[dict]) -> None:
         if len(self._events) >= self.max_events:
             self._dropped += 1
+            if self._drop_counter is not None:
+                self._drop_counter.inc()
             return
         ev: Dict[str, object] = {
             "name": name, "ph": ph, "pid": 1, "tid": tid,

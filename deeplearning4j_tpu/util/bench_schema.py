@@ -1,0 +1,115 @@
+"""Schema gate for the published bench artifact (ISSUE 6 satellite).
+
+`BENCH_LATEST.json` is the single source the docs are generated from
+(util/perf_docs.py), so a malformed artifact silently becomes malformed
+published numbers. `validate_artifact` checks the structural contract —
+and the ISSUE 6 additions: every measured entry carries a `platform`
+label, `decode_serving`/`decode_serving_k1` are ALWAYS present (skipped
+runs say so via `skipped_reason` instead of vanishing), and the
+auto-generated `roofline_table` rows are well-formed. bench.py calls
+`assert_valid` on the dict it is about to print, and
+tests/test_bench_schema.py re-validates the committed artifact, so the
+contract holds at write time and at review time.
+"""
+from __future__ import annotations
+
+from typing import List
+
+TOP_KEYS = ("metric", "value", "unit", "vs_baseline", "extra")
+
+# extra[] entries that are measurement dicts and must carry `platform`
+# (ISSUE 6 satellite: a CPU-measured ms must never read as a TPU claim).
+# Any dict entry holding one of these keys counts as a measurement.
+_MEASUREMENT_KEYS = ("images_per_sec", "tokens_per_sec", "samples_per_sec",
+                     "ms_per_iter", "decode_tokens_per_sec",
+                     "ms_per_iter_health_on")
+
+_ROOFLINE_ROW_REQ = ("function", "platform", "flops", "mxu_floor_ms",
+                     "measured_ms", "calls")
+
+
+def _is_num(v) -> bool:
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
+
+
+def validate_artifact(art: dict) -> List[str]:
+    """Return a list of human-readable schema violations (empty = valid)."""
+    errs: List[str] = []
+    if not isinstance(art, dict):
+        return ["artifact is not a dict"]
+    for k in TOP_KEYS:
+        if k not in art:
+            errs.append(f"missing top-level key '{k}'")
+    if errs:
+        return errs
+    if not _is_num(art["value"]):
+        errs.append("'value' is not a number")
+    if not isinstance(art["unit"], str) or not art["unit"]:
+        errs.append("'unit' is not a non-empty string")
+    e = art["extra"]
+    if not isinstance(e, dict):
+        return errs + ["'extra' is not a dict"]
+
+    # decode_serving must ALWAYS exist: measured (decode_tokens_per_sec),
+    # skipped (skipped_reason), or errored (error) — never absent.
+    for key in ("decode_serving", "decode_serving_k1"):
+        d = e.get(key)
+        if not isinstance(d, dict):
+            errs.append(f"extra['{key}'] missing or not a dict "
+                        "(skipped runs must still emit it)")
+            continue
+        if "error" in d:
+            continue
+        if "platform" not in d:
+            errs.append(f"extra['{key}'] has no 'platform' label")
+        if "decode_tokens_per_sec" not in d and "skipped_reason" not in d:
+            errs.append(f"extra['{key}'] has neither decode_tokens_per_sec "
+                        "nor skipped_reason")
+
+    # every measurement dict carries a platform label
+    for name, entry in e.items():
+        if not isinstance(entry, dict) or "error" in entry:
+            continue
+        if any(k in entry for k in _MEASUREMENT_KEYS):
+            if "platform" not in entry:
+                errs.append(f"extra['{name}'] is a measurement dict without "
+                            "a 'platform' label")
+
+    # roofline_table rows (auto-generated attribution, rendered into docs)
+    table = e.get("roofline_table")
+    if table is not None:
+        if not isinstance(table, list):
+            errs.append("extra['roofline_table'] is not a list")
+        else:
+            for i, row in enumerate(table):
+                if not isinstance(row, dict):
+                    errs.append(f"roofline_table[{i}] is not a dict")
+                    continue
+                for k in _ROOFLINE_ROW_REQ:
+                    if k not in row:
+                        errs.append(f"roofline_table[{i}] missing '{k}'")
+                if not isinstance(row.get("function"), str):
+                    errs.append(f"roofline_table[{i}].function not a string")
+                if not isinstance(row.get("platform"), str):
+                    errs.append(f"roofline_table[{i}].platform not a string")
+                m = row.get("measured_ms")
+                if m is not None and (not _is_num(m) or m < 0):
+                    errs.append(f"roofline_table[{i}].measured_ms invalid: "
+                                f"{m!r}")
+                mfu = row.get("mfu")
+                if mfu is not None and not (_is_num(mfu) and 0 < mfu < 1):
+                    errs.append(
+                        f"roofline_table[{i}] ('{row.get('function')}') mfu "
+                        f"{mfu!r} outside (0, 1) — implies past peak or a "
+                        "degenerate measurement")
+                xf = row.get("x_floor")
+                if xf is not None and (not _is_num(xf) or xf <= 0):
+                    errs.append(f"roofline_table[{i}].x_floor invalid: {xf!r}")
+    return errs
+
+
+def assert_valid(art: dict) -> None:
+    """Raise AssertionError listing every violation (bench.py gate)."""
+    errs = validate_artifact(art)
+    assert not errs, "bench artifact schema violations:\n" + \
+        "\n".join(f"  - {x}" for x in errs)

@@ -36,6 +36,39 @@ def _pct(v) -> str:
     return "n/a" if v is None else f"{v:.1%}"
 
 
+def _roofline_table_lines(table) -> list:
+    """Markdown table from extra['roofline_table'] (ISSUE 6: the roofline
+    numbers in the docs are GENERATED from the bench artifact's attribution
+    rows — XLA cost-analysis FLOPs joined with measured wall — never
+    hand-maintained). Rows marked `(ref)` were measured on a platform
+    without a real peak entry: their floor/MFU use the TPU v5e reference
+    peak as an attribution aid, not a hardware claim."""
+    if not table:
+        return []
+    lines = [
+        "",
+        "Roofline attribution (auto-generated: XLA `cost_analysis()` FLOPs "
+        "per compiled function vs measured wall; `(ref)` rows use the v5e "
+        "197 TFLOPS reference peak off-TPU):",
+        "",
+        "| function | platform | GFLOP/call | MXU floor ms | measured ms "
+        "| MFU | x floor |",
+        "|---|---|---:|---:|---:|---:|---:|",
+    ]
+    for row in table:
+        ref = " (ref)" if row.get("reference_peak") else ""
+        ms = row.get("measured_ms")
+        ms_s = "n/a" if ms is None else f"{ms:.3f}"
+        xf = row.get("x_floor")
+        xf_s = "n/a" if xf is None else f"{xf:.1f}x"
+        floor = row.get("mxu_floor_ms") or 0.0
+        lines.append(
+            f"| {row.get('function', '?')} | {row.get('platform', '?')}{ref} "
+            f"| {(row.get('flops') or 0.0) / 1e9:,.2f} | {floor:.3f} | "
+            f"{ms_s} | {_pct(row.get('mfu'))} | {xf_s} |")
+    return lines
+
+
 def render_block(art: dict) -> str:
     """Markdown bullet block rendered VERBATIM into README.md and PERF.md."""
     e = art["extra"]
@@ -159,6 +192,13 @@ def render_block(art: dict) -> str:
                 f"{tel.get('jit_compiles', 0)} jit compiles in the timed "
                 f"serve (telemetry registry).")
         lines.append(line)
+    elif dec.get("skipped_reason"):
+        # a skipped bench still shows up in the docs with the reason —
+        # silent absence reads as "never existed" (ISSUE 6 satellite)
+        lines.append(
+            f"- Autoregressive serving bench: {dec['skipped_reason']} "
+            f"(platform: {dec.get('platform', '?')}).")
+    lines.extend(_roofline_table_lines(e.get("roofline_table")))
     lines.append(
         f"- ParallelWrapper ResNet50: {pw['images_per_sec']:,.0f} img/s — "
         f"single-chip shard_map OVERHEAD-PARITY number (workers={pw['workers']}"

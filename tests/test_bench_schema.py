@@ -1,0 +1,121 @@
+"""BENCH_LATEST.json schema gate (ISSUE 6 satellite).
+
+The docs are generated from the artifact, so a malformed artifact becomes
+malformed published numbers. bench.py validates the dict it prints; this
+test validates the validator AND re-validates the committed artifact, so
+the contract holds at write time and at review time.
+"""
+import copy
+
+import pytest
+
+from deeplearning4j_tpu.util.bench_schema import (assert_valid,
+                                                  validate_artifact)
+from deeplearning4j_tpu.util.perf_docs import load_artifact
+
+
+def _minimal_art():
+    return {
+        "metric": "m", "value": 2000.0, "unit": "images/sec",
+        "vs_baseline": 1.0,
+        "extra": {
+            "resnet50_bf16": {"images_per_sec": 2000.0, "ms_per_iter": 1.0,
+                              "platform": "tpu"},
+            "decode_serving": {"platform": "cpu", "skipped": True,
+                               "skipped_reason": "no TPU"},
+            "decode_serving_k1": {"platform": "cpu", "skipped": True,
+                                  "skipped_reason": "no TPU"},
+            "roofline_table": [
+                {"function": "train_step", "platform": "tpu",
+                 "flops": 1e12, "bytes_accessed": 1e9,
+                 "mxu_floor_ms": 5.0, "measured_ms": 10.0, "calls": 3,
+                 "mfu": 0.5, "x_floor": 2.0},
+            ],
+        },
+    }
+
+
+def test_minimal_artifact_valid():
+    assert validate_artifact(_minimal_art()) == []
+    assert_valid(_minimal_art())            # must not raise
+
+
+def test_missing_top_key_caught():
+    art = _minimal_art()
+    del art["vs_baseline"]
+    assert any("vs_baseline" in e for e in validate_artifact(art))
+
+
+def test_decode_serving_must_always_exist():
+    art = _minimal_art()
+    del art["extra"]["decode_serving"]
+    errs = validate_artifact(art)
+    assert any("decode_serving" in e and "skipped" in e for e in errs)
+
+
+def test_decode_serving_needs_reason_or_throughput():
+    art = _minimal_art()
+    art["extra"]["decode_serving"] = {"platform": "cpu"}
+    assert any("neither" in e for e in validate_artifact(art))
+    # a measured entry is fine without a reason
+    art["extra"]["decode_serving"] = {"platform": "tpu",
+                                      "decode_tokens_per_sec": 9000.0}
+    assert validate_artifact(art) == []
+    # an errored entry is exempt (the error IS the record)
+    art["extra"]["decode_serving"] = {"error": "ValueError: boom"}
+    assert validate_artifact(art) == []
+
+
+def test_measurement_dict_requires_platform_label():
+    art = _minimal_art()
+    del art["extra"]["resnet50_bf16"]["platform"]
+    errs = validate_artifact(art)
+    assert any("resnet50_bf16" in e and "platform" in e for e in errs)
+    # non-measurement dicts (notes, rooflines) need no label
+    art = _minimal_art()
+    art["extra"]["some_note"] = {"verdict": "fine"}
+    assert validate_artifact(art) == []
+
+
+def test_roofline_row_validation():
+    art = _minimal_art()
+    row = art["extra"]["roofline_table"][0]
+    row["mfu"] = 1.6                         # past peak: impossible
+    assert any("mfu" in e for e in validate_artifact(art))
+    row["mfu"] = 2.9e-10                     # tiny CPU row: legal
+    assert validate_artifact(art) == []
+    row["mfu"] = None                        # unmeasured: legal
+    assert validate_artifact(art) == []
+    del row["function"]
+    assert any("function" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["roofline_table"][0]["measured_ms"] = -1.0
+    assert any("measured_ms" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["roofline_table"] = "oops"
+    assert any("not a list" in e for e in validate_artifact(art))
+
+
+def test_assert_valid_raises_with_all_violations():
+    art = _minimal_art()
+    del art["extra"]["decode_serving"]
+    del art["extra"]["resnet50_bf16"]["platform"]
+    with pytest.raises(AssertionError) as ei:
+        assert_valid(art)
+    msg = str(ei.value)
+    assert "decode_serving" in msg and "resnet50_bf16" in msg
+
+
+def test_committed_artifact_passes_schema():
+    """The artifact the docs are generated from must satisfy the contract —
+    including the ISSUE 6 additions (platform labels everywhere, always-
+    present decode_serving, well-formed roofline_table)."""
+    art = load_artifact()
+    assert validate_artifact(art) == []
+    e = art["extra"]
+    assert isinstance(e["roofline_table"], list) and e["roofline_table"]
+    fns = {r["function"] for r in e["roofline_table"]}
+    # at least one training row and the serving rows must be attributed
+    assert any(f.startswith("train_step") for f in fns)
+    assert any(f.startswith("prefill_b") for f in fns)
+    assert any(f.startswith("decode_chunk_k") for f in fns)
