@@ -34,6 +34,22 @@ path as oracle and fallback. Falls back to dense automatically when the
 cache length cannot be partitioned (L not divisible down to a >= 8 block).
 Inference-only: no custom VJP (the dense fallback is differentiable if
 anyone ever needs gradients through decode).
+
+PAGED variant (ISSUE 7): the serving cache is now block-paged
+(serving/kv_cache.py) — k/v live as (num_blocks + 1, block_size, Hk, D)
+physical blocks and each slot maps logical blocks through a
+(max_seqs, blocks_per_seq) int32 block table. The split-K partition
+structure aligns PERFECTLY with paging: one length partition = one
+physical block, so `flash_decode_attention_paged` keeps the gather
+INSIDE the kernel by feeding the block table through
+`pltpu.PrefetchScalarGridSpec` (scalar-prefetch operand) and letting each
+grid cell's k/v index_map resolve (slot, logical block j) ->
+`bt_ref[s, j]` — no (S, L, Hk, D) contiguous copy of the cache is ever
+materialized. The kernel body is the SAME `_decode_kernel` (same math,
+same skip logic, bkv = block_size); `decode_attention_dense_paged`
+extends the fp64 oracle to resolve block tables (gather + reshape, then
+the unchanged dense math) so the parity harness covers the paged path
+end to end. Falls back to the dense-paged path when block_size < 8.
 """
 from __future__ import annotations
 
@@ -205,3 +221,108 @@ def flash_decode_attention(q, kc, vc, visible, scale, window: int = 0,
 
 
 register_helper("decode_attention", default_on=True)(flash_decode_attention)
+
+
+# --------------------------------------------------------------- paged path
+def decode_attention_dense_paged(q, kp, vp, block_tables, visible, scale,
+                                 window: int = 0):
+    """Dense paged oracle: gather each slot's cache through its block table
+    into the (S, L, Hk, D) layout, then run the UNCHANGED dense math — so
+    paged parity reduces to the already-trusted oracle. q: (S, H, D);
+    kp/vp: (num_blocks + 1, block_size, Hk, D) physical blocks (last block
+    is the trash block); block_tables: (S, blocks_per_seq) int32."""
+    S = q.shape[0]
+    bs, Hk, D = kp.shape[1], kp.shape[2], kp.shape[3]
+    bps = block_tables.shape[1]
+    kc = kp[block_tables].reshape(S, bps * bs, Hk, D)
+    vc = vp[block_tables].reshape(S, bps * bs, Hk, D)
+    return decode_attention_dense(q, kc, vc, visible, scale, window)
+
+
+def flash_decode_attention_paged(q, kp, vp, block_tables, visible, scale,
+                                 window: int = 0):
+    """Block-table-aware split-K flash-decode: same contract as
+    `decode_attention_dense_paged`, computed with one grid cell per
+    (slot, kv head, LOGICAL block) and the logical -> physical lookup done
+    by the k/v index_maps through the scalar-prefetched block table. A
+    partition IS a physical block (bkv = block_size — physical blocks are
+    not contiguous in HBM, so larger partitions cannot be one tile); the
+    kernel body and the logaddexp merge are shared with the slot-path
+    kernel. Falls back to the dense paged path when block_size < 8 (tile
+    too small for the TPU layout) — fallback and kernel are value-identical
+    either way."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    S, H, D = q.shape
+    bs, Hk = kp.shape[1], kp.shape[2]
+    bps = block_tables.shape[1]
+    if H % Hk != 0:
+        raise ValueError(f"n_heads {H} % n_kv_heads {Hk} != 0")
+    if bs < 8:
+        return decode_attention_dense_paged(q, kp, vp, block_tables,
+                                            visible, scale, window)
+    G = H // Hk
+    L = bps * bs
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+    q4 = q.reshape(S, Hk, G, D)
+    visible = jnp.asarray(visible, jnp.int32)
+    # per-position visibility over the LOGICAL length axis (identical mask
+    # algebra to the slot path — the kernel reads one (bs,) stripe per cell)
+    j = jnp.arange(L)[None, :]
+    valid = j < visible[:, None]
+    if window:
+        valid = valid & (visible[:, None] - 1 - j < window)
+    valid = valid.astype(jnp.int32)                  # (S, L)
+    vis2 = visible[:, None]                          # (S, 1) SMEM scalar feed
+
+    def kern(bt_ref, *refs):
+        # the scalar-prefetch operand arrives as the leading kernel ref; the
+        # body only needs it in the index_maps — drop it and run the SAME
+        # math as the slot-path kernel
+        _decode_kernel(*refs, bkv=bs, window=window, scale=float(scale),
+                       acc_dt=acc_dt)
+    # PrefetchScalarGridSpec: block_tables rides as the scalar-prefetch
+    # operand and every index_map takes it as a trailing ref — the k/v maps
+    # do the paging gather (logical block j of slot s lives at physical
+    # block bt_ref[s, j]); q/mask/visible index on logical coordinates.
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(S, Hk, bps),
+        in_specs=[
+            pl.BlockSpec((1, 1, G, D),
+                         lambda s, h, j, bt_ref: (s, h, 0, 0)),
+            pl.BlockSpec((1, bs, 1, D),
+                         lambda s, h, j, bt_ref: (bt_ref[s, j], 0, h, 0)),
+            pl.BlockSpec((1, bs, 1, D),
+                         lambda s, h, j, bt_ref: (bt_ref[s, j], 0, h, 0)),
+            pl.BlockSpec((1, bs), lambda s, h, j, bt_ref: (s, j)),
+            pl.BlockSpec((1, 1), lambda s, h, j, bt_ref: (s, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, 1, G, D),
+                         lambda s, h, j, bt_ref: (s, h, j, 0, 0)),
+            pl.BlockSpec((1, 1, 1, G),
+                         lambda s, h, j, bt_ref: (s, h, j, 0)),
+        ),
+    )
+    o_p, l_p = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((S, Hk, bps, G, D), acc_dt),
+            jax.ShapeDtypeStruct((S, Hk, bps, G), acc_dt),
+        ),
+        interpret=_interpret(),
+    )(jnp.asarray(block_tables, jnp.int32), q4, kp, vp, valid, vis2)
+
+    # same logaddexp merge as the slot path (see above)
+    m = jnp.max(l_p, axis=2, keepdims=True)          # (S, Hk, 1, G)
+    w = jnp.exp(l_p - jnp.maximum(m, NEG_INF))       # (S, Hk, bps, G)
+    denom = jnp.maximum(jnp.sum(w, axis=2), 1e-30)   # (S, Hk, G)
+    out = jnp.einsum("shkg,shkgd->shgd", w, o_p) / denom[..., None]
+    return out.reshape(S, H, D).astype(q.dtype)
+
+
+register_helper("decode_attention_paged",
+                default_on=True)(flash_decode_attention_paged)

@@ -1,8 +1,12 @@
-"""Autoregressive serving: slot-based KV cache, cached single-query decode,
-continuous-batching engine, sampling. See serving/engine.py for the design
-overview; `ParallelInference(inference_mode=InferenceMode.GENERATE)` exposes
-the engine behind the existing inference API."""
+"""Autoregressive serving: paged (block-table) KV cache with copy-on-write
+prefix sharing, cached single-query decode, continuous-batching engine,
+sampling. See serving/engine.py for the design overview;
+`ParallelInference(inference_mode=InferenceMode.GENERATE)` exposes the
+engine behind the existing inference API."""
+from deeplearning4j_tpu.serving.block_table import (BlockAllocator,
+                                                    PrefixRegistry)
 from deeplearning4j_tpu.serving.decode import (StackDecoder, decode_attention,
+                                               decode_attention_paged,
                                                one_hot_embedder)
 from deeplearning4j_tpu.serving.engine import (GenerationResult, Request,
                                                ServingEngine)
@@ -10,7 +14,8 @@ from deeplearning4j_tpu.serving.kv_cache import KVCache, init_cache_state
 from deeplearning4j_tpu.serving.sampler import Sampler, sample_tokens
 
 __all__ = [
-    "KVCache", "init_cache_state", "StackDecoder", "decode_attention",
+    "KVCache", "init_cache_state", "BlockAllocator", "PrefixRegistry",
+    "StackDecoder", "decode_attention", "decode_attention_paged",
     "one_hot_embedder", "ServingEngine", "Request", "GenerationResult",
     "Sampler", "sample_tokens",
 ]

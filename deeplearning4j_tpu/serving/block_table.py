@@ -1,0 +1,195 @@
+"""Host-side paged-KV bookkeeping: block allocator + prefix registry.
+
+Beyond-reference (PagedAttention, Kwon et al. SOSP 2023; PAPERS.md). The
+paged KV cache (serving/kv_cache.py) carves the preallocated k/v buffers
+into fixed-size physical BLOCKS of `block_size` positions and gives every
+slot a device block table mapping logical block index -> physical block.
+This module is the host half of that design — pure Python bookkeeping that
+runs between decode iterations (iteration-level scheduling), never on the
+hot path and never touching the device:
+
+- `BlockAllocator`: a refcounted free list over physical block ids. The
+  free list is a `heapq` (lowest id first, like the slot free list), so
+  alloc/free are O(log n) — with hundreds of blocks per cache the old
+  slot-list idiom (`pop(0)` + per-free `sort()`) would actually show up.
+  Refcounts exist for copy-on-write prefix sharing: a block mapped by R
+  slots has refcount R and only returns to the free list when the last
+  mapping drops.
+
+- `PrefixRegistry`: a content-addressed index of RESIDENT prompt blocks.
+  Keys are chain hashes — the digest of block i covers prompt tokens
+  [0, (i+1)*block_size), so a hit guarantees the whole prefix matches,
+  not just one block. Full prompt blocks are registered under their chain
+  digest; a prompt that ends mid-block additionally registers its partial
+  tail under an exact-prompt digest, so two identical prompts share right
+  up to the last token (the tail block is then copy-on-write, never
+  mapped shared — the new request's own writes land in it). Entries are
+  valid exactly while the backing block is resident: the cache calls
+  `forget(block)` the moment a block's refcount reaches zero.
+
+Safety argument for sharing (why a shared block is never wrong): a block
+is only registered for prompt positions its owner's prefill (or COW copy
++ suffix prefill) actually wrote, the KV projection of a token sequence
+is deterministic in the model params, and fully-shared blocks are never
+written by any sharer — a request writes only positions >= its shared
+prefix length, and admission maps the block containing the first such
+write as a fresh COPY (copy-on-write), never as a shared mapping.
+"""
+from __future__ import annotations
+
+import hashlib
+import heapq
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class BlockAllocator:
+    """Refcounted heapq free list over physical block ids [0, num_blocks)."""
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"need at least one block, got {num_blocks}")
+        self.num_blocks = int(num_blocks)
+        # list(range(n)) is already a valid min-heap — no heapify needed
+        self._free: List[int] = list(range(self.num_blocks))
+        self._ref: List[int] = [0] * self.num_blocks
+        self._n_shared = 0          # blocks with refcount >= 2
+
+    # ------------------------------------------------------------ alloc
+    def alloc(self) -> Optional[int]:
+        """Claim one free block (lowest id first, refcount 1) or None."""
+        if not self._free:
+            return None
+        b = heapq.heappop(self._free)
+        self._ref[b] = 1
+        return b
+
+    def alloc_many(self, n: int) -> Optional[List[int]]:
+        """Claim `n` blocks all-or-nothing (admission never half-succeeds);
+        returns None without side effects when fewer than `n` are free."""
+        if n < 0:
+            raise ValueError(f"negative block count {n}")
+        if len(self._free) < n:
+            return None
+        return [self.alloc() for _ in range(n)]
+
+    # ---------------------------------------------------------- refcount
+    def incref(self, block: int) -> None:
+        """One more mapping of an already-resident block (prefix sharing)."""
+        if self._ref[block] < 1:
+            raise ValueError(f"incref on free block {block}")
+        self._ref[block] += 1
+        if self._ref[block] == 2:
+            self._n_shared += 1
+
+    def decref(self, block: int) -> bool:
+        """Drop one mapping; returns True when the block just became free
+        (the caller must then invalidate any registry entries it backs)."""
+        if self._ref[block] < 1:
+            raise ValueError(f"double free of block {block}")
+        self._ref[block] -= 1
+        if self._ref[block] == 1:
+            self._n_shared -= 1
+        if self._ref[block] == 0:
+            heapq.heappush(self._free, block)
+            return True
+        return False
+
+    def refcount(self, block: int) -> int:
+        return self._ref[block]
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def n_shared(self) -> int:
+        """Blocks currently mapped by 2+ slots (the sharing win gauge)."""
+        return self._n_shared
+
+
+def _block_digest(prev: Optional["hashlib._Hash"], tokens: Sequence[int],
+                  tail: bool = False) -> "hashlib._Hash":
+    """Extend a chain hash by one block of prompt tokens. The digest of
+    block i commits to every token in [0, (i+1)*block_size) — a registry
+    hit therefore certifies the WHOLE prefix. Tail digests get a distinct
+    domain tag so a partial block can never collide with a full one."""
+    h = prev.copy() if prev is not None else hashlib.sha1(b"kvprefix:")
+    h.update(b"t:" if tail else b"b:")
+    h.update(",".join(str(int(t)) for t in tokens).encode())
+    h.update(b";")
+    return h
+
+
+class PrefixRegistry:
+    """Content-addressed index of resident prompt KV blocks.
+
+    match() walks a prompt block by block down the chain-hash index and
+    returns the longest registered prefix plus the physical blocks holding
+    it; register() files a freshly prefilled prompt's blocks; forget()
+    removes every claim backed by a block the allocator just freed."""
+
+    def __init__(self, block_size: int):
+        self.block_size = int(block_size)
+        self._full: Dict[bytes, int] = {}    # chain digest -> physical block
+        self._tail: Dict[bytes, int] = {}    # exact-prompt digest -> block
+        self._claims: Dict[int, List[Tuple[str, bytes]]] = {}  # invalidation
+
+    def match(self, tokens: Sequence[int]) -> Tuple[int, List[int]]:
+        """(matched_len, physical blocks covering it) for the longest
+        registered prefix of `tokens` — full blocks first, then (only when
+        every full block matched) the exact-prompt partial tail."""
+        bs = self.block_size
+        n_full = len(tokens) // bs
+        blocks: List[int] = []
+        h = None
+        for i in range(n_full):
+            h = _block_digest(h, tokens[i * bs:(i + 1) * bs])
+            b = self._full.get(h.digest())
+            if b is None:
+                return i * bs, blocks
+            blocks.append(b)
+        tail = tokens[n_full * bs:]
+        if tail:
+            b = self._tail.get(_block_digest(h, tail, tail=True).digest())
+            if b is not None:
+                blocks.append(b)
+                return len(tokens), blocks
+        return n_full * bs, blocks
+
+    def register(self, tokens: Sequence[int], phys_blocks: Sequence[int]
+                 ) -> None:
+        """File every prompt block of a just-prefilled request.
+        `phys_blocks` is the slot's logical->physical row (it may extend
+        past the prompt into decode reservation — only prompt blocks are
+        read). First registration wins: an already-claimed digest keeps
+        its existing block (the new copy holds identical content)."""
+        bs = self.block_size
+        n_full = len(tokens) // bs
+        h = None
+        for i in range(n_full):
+            h = _block_digest(h, tokens[i * bs:(i + 1) * bs])
+            self._claim("full", h.digest(), phys_blocks[i])
+        tail = tokens[n_full * bs:]
+        if tail:
+            d = _block_digest(h, tail, tail=True).digest()
+            self._claim("tail", d, phys_blocks[n_full])
+
+    def _claim(self, kind: str, digest: bytes, block: int) -> None:
+        index = self._full if kind == "full" else self._tail
+        if digest in index:
+            return                      # first registration wins
+        index[digest] = block
+        self._claims.setdefault(block, []).append((kind, digest))
+
+    def forget(self, block: int) -> None:
+        """Invalidate every claim backed by `block` (called the moment the
+        allocator frees it — a freed block's content is about to be
+        overwritten by an unrelated request)."""
+        for kind, digest in self._claims.pop(block, ()):
+            index = self._full if kind == "full" else self._tail
+            if index.get(digest) == block:
+                del index[digest]
+
+    @property
+    def n_entries(self) -> int:
+        return len(self._full) + len(self._tail)

@@ -28,6 +28,19 @@ Two pieces:
   activations) with FIXED shapes — the serving engine jits them ONCE and
   never retraces per token (prompt lengths are bucketed to powers of two;
   padded tail writes are harmless, see kv_cache.py's visibility invariant).
+
+The cache is PAGED (ISSUE 7, serving/kv_cache.py): every slot resolves
+logical positions through its device block-table row, so the decode step
+attends via `decode_attention_paged` (block-table-aware split-K kernel,
+ops/decode_attention.py) and prefill scatters whole blocks through the
+table. Prompt buckets are rounded up to whole blocks so prefill writes
+block-granular; padding past a slot's reservation trash-routes (see
+kv_cache.py's trash invariant). Prefix sharing adds a third pure step,
+`_prefill_shared_fn`: when admission mapped a request's leading prompt
+blocks onto resident shared KV, only the SUFFIX is embedded and computed —
+suffix queries attend the slot's full gathered prefix (shared blocks
+included), skipping the shared positions' projection and score math
+entirely. That is the prefill-FLOPs saving the bench measures.
 """
 from __future__ import annotations
 
@@ -43,7 +56,8 @@ from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
 from deeplearning4j_tpu.nn.conf.layers.feedforward import (
     ActivationLayer, DropoutLayer, LossLayer)
 from deeplearning4j_tpu.nn.conf.layers.recurrent import RnnOutputLayer
-from deeplearning4j_tpu.ops.decode_attention import decode_attention_dense
+from deeplearning4j_tpu.ops.decode_attention import (
+    decode_attention_dense, decode_attention_dense_paged)
 from deeplearning4j_tpu.ops.helpers import helper_for
 from deeplearning4j_tpu.serving import kv_cache
 
@@ -70,6 +84,20 @@ def decode_attention(q, kc, vc, visible, scale, window: int = 0):
     (ops/decode_attention.decode_attention_dense)."""
     fn = helper_for("decode_attention", decode_attention_dense)
     return fn(q, kc, vc, visible, scale, window)
+
+
+def decode_attention_paged(q, kp, vp, block_tables, visible, scale,
+                           window: int = 0):
+    """Single-query attention against the PAGED cache: same contract as
+    `decode_attention`, but kc/vc are the (num_blocks + 1, block_size, Hk,
+    D) physical blocks and each slot's positions resolve through its
+    (blocks_per_seq,) block-table row. Resolved through the helper seam:
+    the block-table-aware split-K kernel
+    (ops/decode_attention.flash_decode_attention_paged, default-on for
+    TPU — the gather stays INSIDE the kernel via scalar prefetch) when
+    enabled, else the dense paged oracle (gather + the dense einsum)."""
+    fn = helper_for("decode_attention_paged", decode_attention_dense_paged)
+    return fn(q, kp, vp, block_tables, visible, scale, window)
 
 
 def _attn_heads(layer: SelfAttentionLayer, params, xt):
@@ -119,7 +147,9 @@ class StackDecoder:
     MultiLayerNetwork or a linear-chain ComputationGraph."""
 
     def __init__(self, net, max_seqs: int, max_len: int,
-                 dtype=None):
+                 dtype=None, block_size: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 prefix_share: Optional[bool] = None):
         layers, params = _extract_stack(net)
         self.layers = layers
         self.dtype = jnp.dtype(dtype) if dtype is not None else net.dtype
@@ -154,8 +184,12 @@ class StackDecoder:
         self.n_in = layers[0].n_in if hasattr(layers[0], "n_in") else None
         self.cache = kv_cache.KVCache(len(self.attn_idx), max_seqs, max_len,
                                       self.n_kv_heads, self.head_dim,
-                                      self.dtype)
+                                      self.dtype, block_size=block_size,
+                                      num_blocks=num_blocks,
+                                      prefix_share=prefix_share)
         self._prefill_jit = jax.jit(self._prefill_fn)
+        self._prefill_shared_jit = jax.jit(self._prefill_shared_fn,
+                                           static_argnames=("kv_blocks",))
         self._decode_jit = jax.jit(self._decode_fn)
         self._profiled_buckets: set = set()   # prefill cost-registry dedup
         self.metrics = None    # engine installs its child registry here so
@@ -216,6 +250,62 @@ class StackDecoder:
                                               keepdims=False)
         return cache_state, self._head_logprobs(h_last[None])[0]
 
+    def _prefill_shared_fn(self, params, cache_state, x, slot, plen,
+                           shared_len, *, kv_blocks):
+        """Shared-prefix prompt pass: x (n_in, Ts_pad) features of the
+        SUFFIX only (logical positions [shared_len, plen)) — the prefix KV
+        is already resident in blocks admission mapped shared. Scatters the
+        suffix k/v through the block table, then attends each suffix query
+        against the slot's first `kv_blocks` blocks gathered back through
+        the table (shared prefix included). Padding rows (position >= plen)
+        trash-route their writes and their outputs are discarded.
+        `kv_blocks` is static (engine-bucketed) so the gathered length is
+        ~plen, not max_len — the compute skipped for the shared positions
+        is the whole point."""
+        xt = jnp.swapaxes(x, 0, 1).astype(self.dtype)       # (Ts_pad, n_in)
+        Ts = xt.shape[0]
+        bs = self.cache.block_size
+        qpos = jnp.asarray(shared_len, jnp.int32) + jnp.arange(Ts,
+                                                               dtype=jnp.int32)
+        valid = qpos < plen
+        L = kv_blocks * bs
+        j = jnp.arange(L, dtype=jnp.int32)[None, :]          # (1, L)
+        li = 0
+        for i, layer in enumerate(self.layers[:-1]):
+            p = params[i]
+            if isinstance(layer, SelfAttentionLayer):
+                q, k, v = _attn_heads(layer, p, xt)
+                cache_state = kv_cache.write_positions(
+                    cache_state, li, slot, qpos, valid, k, v)
+                row = cache_state["block_tables"][
+                    jnp.asarray(slot, jnp.int32)][:kv_blocks]
+                kl = cache_state["k"][li, row].reshape(
+                    L, self.n_kv_heads, self.head_dim)
+                vl = cache_state["v"][li, row].reshape(
+                    L, self.n_kv_heads, self.head_dim)
+                li += 1
+                H, Dh = layer.n_heads, self.head_dim
+                G = H // self.n_kv_heads
+                acc = jnp.promote_types(q.dtype, jnp.float32)
+                q4 = q.reshape(Ts, self.n_kv_heads, G, Dh)
+                s = jnp.einsum("thgd,lhd->thgl", q4.astype(acc),
+                               kl.astype(acc)) / np.sqrt(Dh)
+                causal = j <= qpos[:, None]                  # (Ts, L)
+                if layer.attention_window:
+                    causal = causal & (qpos[:, None] - j
+                                       < layer.attention_window)
+                s = jnp.where(causal[:, None, None, :], s, NEG_INF)
+                pattn = jax.nn.softmax(s, axis=-1)
+                out = jnp.einsum("thgl,lhd->thgd", pattn, vl.astype(acc))
+                out = out.reshape(Ts, layer.n_out).astype(self.dtype)
+                xt = layer._act(out @ p["w_o"] + p["b"])
+            else:
+                xt = self._positionwise(layer, p, xt)
+        cache_state = kv_cache.set_length(cache_state, slot, plen)
+        h_last = jax.lax.dynamic_index_in_dim(
+            xt, plen - 1 - shared_len, axis=0, keepdims=False)
+        return cache_state, self._head_logprobs(h_last[None])[0]
+
     def _decode_fn(self, params, cache_state, x, active):
         """One decode iteration for ALL slots: x (S, n_in) current-token
         features, active (S,) bool. Appends each attention layer's k/v at
@@ -229,9 +319,11 @@ class StackDecoder:
             p = params[i]
             if isinstance(layer, SelfAttentionLayer):
                 q, k_t, v_t = _attn_heads(layer, p, h)      # (S, H/Hk, Dh)
-                cache_state = kv_cache.append_token(cache_state, li, k_t, v_t)
-                out = decode_attention(
+                cache_state = kv_cache.append_token(cache_state, li, k_t,
+                                                    v_t, active)
+                out = decode_attention_paged(
                     q, cache_state["k"][li], cache_state["v"][li],
+                    cache_state["block_tables"],
                     pos + 1, 1.0 / np.sqrt(self.head_dim),
                     layer.attention_window)
                 li += 1
@@ -252,7 +344,7 @@ class StackDecoder:
         T = x.shape[1]
         if T < 1 or T >= self.cache.max_len:
             raise ValueError(f"prompt length {T} outside [1, max_len)")
-        Tp = min(self.cache.max_len, 1 << max(0, (T - 1)).bit_length())
+        Tp = self.prefill_bucket(T)
         if Tp != T:
             x = jnp.pad(x, ((0, 0), (0, Tp - T)))
         slot_a = jnp.asarray(slot, jnp.int32)
@@ -273,6 +365,66 @@ class StackDecoder:
                 pass
         self.cache.state, logprobs = self._prefill_jit(
             self.params, self.cache.state, x, slot_a, plen_a)
+        return logprobs
+
+    def prefill_bucket(self, plen: int) -> int:
+        """Padded prompt length for an unshared prefill: next power of two,
+        rounded UP to KV-block granularity (paged prefill scatters WHOLE
+        blocks; writes past the slot's reservation trash-route), capped at
+        max_len. The engine uses this as the compile-miss key — it must
+        match the shape `prefill` actually compiles."""
+        Tp = min(self.cache.max_len, 1 << max(0, (plen - 1)).bit_length())
+        bs = self.cache.block_size
+        return min(self.cache.max_len, -(-Tp // bs) * bs)
+
+    def shared_buckets(self, plen: int, shared_len: int):
+        """(suffix bucket Ts_pad, static gathered-block count) for a
+        shared-prefix prefill — the engine uses this pair as the compile
+        key for jit-compile-miss attribution. Both dimensions bucket to
+        powers of two (capped at max_len / blocks_per_seq) so ragged
+        suffixes hit a bounded set of compiled shapes."""
+        Ts = plen - shared_len
+        Tsp = min(self.cache.max_len, 1 << max(0, (Ts - 1)).bit_length())
+        nb = -(-plen // self.cache.block_size)       # blocks holding prompt
+        kvb = min(self.cache.blocks_per_seq,
+                  1 << max(0, (nb - 1)).bit_length())
+        return Tsp, kvb
+
+    def prefill_shared(self, slot: int, x, plen: int,
+                       shared_len: int) -> jnp.ndarray:
+        """Write a prompt whose first `shared_len` positions are already
+        resident (admission mapped them shared); x: (n_in, Ts) features of
+        the SUFFIX tokens only, Ts = plen - shared_len. Returns the
+        (vocab,) next-token logprobs — identical to what prefill() would
+        return for the full prompt, minus the shared positions' compute."""
+        x = jnp.asarray(x, self.dtype)
+        Ts = x.shape[1]
+        if Ts != plen - shared_len or Ts < 1 or shared_len < 1:
+            raise ValueError(f"bad shared prefill: plen={plen}, "
+                             f"shared_len={shared_len}, suffix={Ts}")
+        Tsp, kvb = self.shared_buckets(plen, shared_len)
+        if Tsp != Ts:
+            x = jnp.pad(x, ((0, 0), (0, Tsp - Ts)))
+        slot_a = jnp.asarray(slot, jnp.int32)
+        plen_a = jnp.asarray(plen, jnp.int32)
+        shared_a = jnp.asarray(shared_len, jnp.int32)
+        from deeplearning4j_tpu.telemetry import profiler
+        key = ("shared", Tsp, kvb)
+        if profiler.enabled() and key not in self._profiled_buckets:
+            self._profiled_buckets.add(key)
+            try:
+                profiler.register(
+                    f"prefill_shared_b{Tsp}k{kvb}", self._prefill_shared_jit,
+                    (self.params, self.cache.state, x, slot_a, plen_a,
+                     shared_a),
+                    kwargs={"kv_blocks": kvb},
+                    meta={"bucket": Tsp, "kv_blocks": kvb},
+                    registry=self.metrics)
+            except Exception:
+                pass
+        self.cache.state, logprobs = self._prefill_shared_jit(
+            self.params, self.cache.state, x, slot_a, plen_a, shared_a,
+            kv_blocks=kvb)
         return logprobs
 
     def decode_step(self, x, active) -> jnp.ndarray:

@@ -1,11 +1,17 @@
 """Continuous-batching autoregressive serving engine.
 
-Orca-style ITERATION-LEVEL scheduling over the slot-based KV cache
+Orca-style ITERATION-LEVEL scheduling over the PAGED KV cache
 (serving/kv_cache.py): the unit of scheduling is one decode iteration, not a
 static batch. Between iterations the engine (host side) admits queued
-requests into free slots, retires finished ones, and frees their slots — so
-a long generation never holds short requests hostage and new arrivals start
-decoding on the very next scheduling opportunity.
+requests, retires finished ones, and frees their reservations — so a long
+generation never holds short requests hostage and new arrivals start
+decoding on the very next scheduling opportunity. Admission is BLOCK
+allocation (PagedAttention-style, ISSUE 7): a request reserves
+ceil((prompt + max_new_tokens) / block_size) fixed-size KV blocks instead
+of a whole max_len row, and prefix sharing maps leading prompt blocks onto
+already-resident KV (copy-on-write), skipping the shared positions' KV
+bytes and prefill compute. Constructor knobs `kv_block` / `kv_blocks` /
+`prefix_share` (env: DL4J_TPU_KV_BLOCK, DL4J_TPU_PREFIX_SHARE).
 
 Hot-loop design (why this never retraces and rarely syncs):
 - ONE jitted step function over fixed shapes (S slots, vocab V): embeds each
@@ -218,8 +224,14 @@ class ServingEngine:
                  embed: Optional[Callable] = None,
                  capture_logprobs: bool = False,
                  decode_chunk: Optional[int] = None,
-                 overlap: bool = True):
-        self.decoder = StackDecoder(net, max_seqs, max_len, dtype=dtype)
+                 overlap: bool = True,
+                 kv_block: Optional[int] = None,
+                 kv_blocks: Optional[int] = None,
+                 prefix_share: Optional[bool] = None):
+        self.decoder = StackDecoder(net, max_seqs, max_len, dtype=dtype,
+                                    block_size=kv_block,
+                                    num_blocks=kv_blocks,
+                                    prefix_share=prefix_share)
         if embed is None:
             if self.decoder.n_in is None:
                 raise ValueError("stack has no n_in; pass embed=")
@@ -285,6 +297,12 @@ class ServingEngine:
         self._c_compiles = self.metrics.counter(
             "serving.jit_compiles", "first-use compiled shapes (prefill "
             "buckets + chunk scan lengths)")
+        self._c_prefix_hits = self.metrics.counter(
+            "serving.prefix_hits", "admissions that mapped shared prefix "
+            "KV blocks (paged cache, ISSUE 7)")
+        self._c_prefix_tokens = self.metrics.counter(
+            "serving.prefix_shared_tokens", "prompt positions whose KV "
+            "bytes AND prefill compute were skipped via prefix sharing")
         self._h_ttft = self.metrics.histogram(
             "serving.ttft_s", "submit -> first token (s)",
             buckets=telemetry.DEFAULT_S_BUCKETS)
@@ -310,14 +328,23 @@ class ServingEngine:
         cache = self.decoder.cache
         self.decoder.metrics = self.metrics   # prefill cost gauges land on
         # the same child registry as the engine's observe() gauges
-        self._kv_bytes_per_pos = cache.bytes() // (cache.max_seqs
-                                                   * cache.max_len)
+        self._kv_bytes_per_pos = cache.bytes_per_position
         self._g_kv_total = self.metrics.gauge(
             "serving.kv_cache_bytes", "preallocated KV cache footprint")
         self._g_kv_total.set(cache.bytes())
         self._g_kv_res = self.metrics.gauge(
             "serving.kv_bytes_resident", "KV bytes holding live "
             "prompt+generated positions across active slots")
+        self._g_kv_waste = self.metrics.gauge(
+            "serving.kv_bytes_waste", "reserved-but-unused KV bytes "
+            "(block-granular reservations minus live positions)")
+        self._g_blocks_free = self.metrics.gauge(
+            "serving.kv_blocks_free", "physical KV blocks on the free list")
+        self._g_blocks_free.set(cache.blocks_free)
+        self._g_blocks_shared = self.metrics.gauge(
+            "serving.kv_blocks_shared", "physical KV blocks mapped by 2+ "
+            "slots (prefix sharing)")
+        self._resident_seqs_max = 0   # high-water mark of concurrent slots
         self._g_params = self.metrics.gauge(
             "serving.param_bytes", "decoder parameter bytes")
         self._g_params.set(_tmemory.param_bytes(self.decoder.params))
@@ -347,13 +374,20 @@ class ServingEngine:
         (bench.py publishes the ratio as host_syncs_per_token)."""
         with self._lock:
             syncs, toks = self._c_syncs.value, self._c_tokens.value
+            cache = self.decoder.cache
             return {"host_syncs": syncs, "tokens_out": toks,
                     "decode_chunk": self.decode_chunk,
                     "host_syncs_per_token": syncs / max(1, toks),
                     "nonfinite_chunks": self._c_nonfinite.value,
                     "queue_depth": len(self._queue),
-                    "free_slots": self.decoder.cache.n_free,
-                    "active_slots": len(self._by_slot)}
+                    "free_slots": cache.n_free,
+                    "active_slots": len(self._by_slot),
+                    "kv_blocks_free": cache.blocks_free,
+                    "kv_blocks_shared": cache.blocks_shared,
+                    "kv_bytes_waste": self._g_kv_waste.value,
+                    "prefix_hits": self._c_prefix_hits.value,
+                    "prefix_shared_tokens": self._c_prefix_tokens.value,
+                    "resident_seqs_max": self._resident_seqs_max}
 
     def export_trace(self, path: str) -> str:
         """Write the global tracer's Chrome-trace JSON (prefill / decode
@@ -387,35 +421,70 @@ class ServingEngine:
 
     # ---------------------------------------------------------- iteration
     def _admit(self) -> None:
-        """Move queued requests into free cache slots (prefill + first
-        token). Called with the lock held."""
+        """Move queued requests into cache slots (prefill + first token).
+        Admission is BLOCK allocation (paged cache, ISSUE 7): the head
+        request needs ceil((prompt + max_new) / block_size) blocks, with
+        leading prompt blocks mapped onto already-resident shared-prefix KV
+        when the registry matches — those positions skip prefill compute
+        entirely (prefill_shared embeds and computes only the suffix). The
+        head request is PEEKED, not popped, until its plan succeeds: when
+        blocks run short we keep FIFO order and retry next iteration (a
+        retirement frees blocks). Called with the lock held."""
         cache = self.decoder.cache
-        while self._queue and cache.n_free > 0:
-            act = self._queue.pop(0)
+        while self._queue:
+            act = self._queue[0]
             if act.deadline is not None and time.monotonic() > act.deadline:
+                self._queue.pop(0)
                 act.fut._set(GenerationResult([], "timeout",
                                               len(act.req.tokens)))
                 continue
-            slot = cache.allocate(act)
-            act.slot = slot
             req = act.req
-            toks = np.asarray(req.tokens, np.int32)  # sync-ok: host list
-            # sync-ok: admission prefill input prep (scheduling event)
-            feats = np.asarray(self.embed(jnp.asarray(toks))).T  # (n_in, T)
-            # compile attribution: the prefill jit retraces once per
-            # power-of-two length bucket — first sighting is a cache miss
             plen = len(req.tokens)
-            bucket = min(cache.max_len, 1 << max(0, (plen - 1)).bit_length())
-            miss = ("prefill", bucket) not in self._seen_shapes
+            plan = cache.admit(act, n_positions=plen + req.max_new_tokens,
+                               prompt=req.tokens)
+            if plan is None:           # no slot / not enough blocks: wait
+                break
+            self._queue.pop(0)
+            slot = plan.slot
+            act.slot = slot
+            toks = np.asarray(req.tokens, np.int32)  # sync-ok: host list
+            shared = plan.shared_len
+            # compile attribution: each prefill jit retraces once per
+            # power-of-two bucket — first sighting is a cache miss. The
+            # shared path buckets on (suffix length, gathered blocks).
+            if shared:
+                skey = self.decoder.shared_buckets(plen, shared)
+                bucket = skey[0]
+                miss = ("prefill_shared", skey) not in self._seen_shapes
+                if miss:
+                    self._seen_shapes.add(("prefill_shared", skey))
+            else:
+                bucket = self.decoder.prefill_bucket(plen)
+                miss = ("prefill", bucket) not in self._seen_shapes
+                if miss:
+                    self._seen_shapes.add(("prefill", bucket))
             if miss:
-                self._seen_shapes.add(("prefill", bucket))
                 self._c_compiles.inc()
             cm = telemetry.span("jit_compile", kind="prefill",
                                 bucket=bucket) if miss else telemetry.NULL_SPAN
             t_pf = time.perf_counter()
             with cm, telemetry.span("prefill", slot=slot, plen=plen,
-                                    bucket=bucket):
-                lp = self.decoder.prefill(slot, feats)
+                                    bucket=bucket, shared=shared):
+                if shared:
+                    # suffix tokens only: the shared prefix's embedding +
+                    # projection + score math never runs
+                    # sync-ok: admission prefill input prep (scheduling event)
+                    feats = np.asarray(
+                        self.embed(jnp.asarray(toks[shared:]))).T
+                    lp = self.decoder.prefill_shared(slot, feats, plen,
+                                                     shared)
+                    self._c_prefix_hits.inc()
+                    self._c_prefix_tokens.inc(shared)
+                else:
+                    # sync-ok: admission prefill input prep (scheduling event)
+                    feats = np.asarray(self.embed(jnp.asarray(toks))).T
+                    lp = self.decoder.prefill(slot, feats)
+            cache.register_prefix(slot, req.tokens)
             t0 = sample_tokens(self.sampler.next_key(), lp[None],
                                jnp.full((1,), req.temperature, jnp.float32),
                                self.sampler.top_k)[0]
@@ -433,6 +502,8 @@ class ServingEngine:
             if self._dev_active is not None:
                 self._dev_active = self._dev_active.at[slot].set(True)
             self._by_slot[slot] = act
+            self._resident_seqs_max = max(self._resident_seqs_max,
+                                          len(self._by_slot))
             with telemetry.span("host_sync", what="first_token", slot=slot):
                 first = int(t0)        # admission readback (scheduling event)
             self._c_syncs.inc()
@@ -443,8 +514,9 @@ class ServingEngine:
                 # the admission's device work (prefill dispatch + first
                 # sample + the counted readback), from the host wall the
                 # scheduler already measures — no added sync
-                _profiler.observe(f"prefill_b{bucket}",
-                                  (time.perf_counter() - t_pf) * 1e3,
+                name = f"prefill_shared_b{skey[0]}k{skey[1]}" if shared \
+                    else f"prefill_b{bucket}"
+                _profiler.observe(name, (time.perf_counter() - t_pf) * 1e3,
                                   registry=self.metrics)
             self._update_kv_resident()
             telemetry.instant("admit", slot=slot, plen=plen,
@@ -501,9 +573,15 @@ class ServingEngine:
         """Publish resident KV bytes: cache positions actually holding a
         live prompt+generated token across active slots, from the host's
         own bookkeeping (no device read). Lock held."""
+        cache = self.decoder.cache
         pos = sum(len(a.req.tokens) + a.n_generated
                   for a in self._by_slot.values())
         self._g_kv_res.set(pos * self._kv_bytes_per_pos)
+        reserved = sum(cache.reserved_positions(a.slot)
+                       for a in self._by_slot.values())
+        self._g_kv_waste.set(max(0, reserved - pos) * self._kv_bytes_per_pos)
+        self._g_blocks_free.set(cache.blocks_free)
+        self._g_blocks_shared.set(cache.blocks_shared)
 
     def _register_chunk_costs(self, k: int, active) -> None:
         """File the decode-chunk jit's XLA cost_analysis under

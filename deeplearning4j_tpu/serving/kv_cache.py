@@ -1,89 +1,173 @@
-"""Slot-based preallocated KV cache for autoregressive decode.
+"""Paged preallocated KV cache for autoregressive decode.
 
-Beyond-reference (the 2017 reference has no incremental-decode path at all;
-the attention stack recomputes all T x T scores per generated token). This is
-the vLLM/Orca-shaped cache the serving engine (serving/engine.py) schedules
-over: ONE preallocated pair of buffers
+Beyond-reference (PagedAttention, Kwon et al. SOSP 2023; the 2017 reference
+has no incremental-decode path at all). The serving engine
+(serving/engine.py) schedules over ONE preallocated pair of buffers carved
+into fixed-size physical blocks of `block_size` positions:
 
-    k, v: (n_layers, max_seqs, max_len, n_kv_heads, head_dim)
+    k, v: (n_layers, num_blocks + 1, block_size, n_kv_heads, head_dim)
 
-plus a per-slot `lengths` vector. Every request lives in one SLOT for its
-whole lifetime (prefill writes positions [0, prompt_len); decode appends one
-position per iteration), so admission/eviction never reshapes device memory —
-the jitted prefill/decode steps see fixed shapes and NEVER retrace as
-requests come and go (the whole point: per-token XLA retracing costs more
-than the decode math).
+plus a per-slot `lengths` vector and a fixed-shape device BLOCK TABLE
 
-Device-side mutation is functional and jit-friendly:
-- prefill: `lax.dynamic_update_slice` of a (T_pad, Hk, D) block at
-  (layer, slot, 0) — slot is a TRACED index, so one compiled prefill serves
-  every slot;
-- decode append: a batched scatter `k.at[layer, arange(S), pos].set(k_t)` —
-  each slot writes at its OWN position (ragged lengths), one op for the
-  whole batch.
+    block_tables: (max_seqs, max_len // block_size) int32
 
-Safety invariant (why padded/stale writes are harmless): a position p of
-slot s is VISIBLE to attention iff p < lengths[s], and lengths[s] only ever
-reaches p+1 in the same decode step that wrote fresh k/v at p. Prefill may
-therefore write its whole padded block and a freed slot needs no zeroing on
-reuse — stale garbage beyond `lengths` is never attended to.
+mapping each slot's logical block index to a physical block. Admission is
+block allocation: a request reserves ceil((prompt + max_new) / block_size)
+blocks instead of a whole max_len row, so short requests stop paying the
+max_len reservation and resident concurrency is bounded by TOTAL BLOCKS,
+not slot count (`num_blocks` defaults to max_seqs * max_len / block_size —
+the same HBM as the old slot cache — but can be set independently).
+Copy-on-write prefix sharing (serving/block_table.py) maps a new request's
+leading blocks onto already-resident ones with refcounts, skipping both
+the KV bytes and the prefill compute for the shared prefix; the block
+containing the first divergent write is copied at admission.
 
-The same invariant is what licenses the engine's CHUNKED and OVERLAPPED
-scheduling (engine decode_chunk / overlap): a slot that finishes mid-chunk
-keeps appending for the rest of the chunk — and, under overlap, for up to
-one more whole chunk, because the host scheduler runs on a one-chunk-stale
-active mask — but every one of those appends is MASKED (`advance_lengths`
-only advances active slots), so the write lands at a position `lengths`
-never reaches and is invisible forever. Freeing and reusing the slot resets
-`lengths` to 0 and the new occupant's prefill overwrites from position 0
-up; no readback barrier between chunks is ever needed for correctness.
+Env knobs: `DL4J_TPU_KV_BLOCK` (block size in positions, default 16),
+`DL4J_TPU_PREFIX_SHARE` (0 disables sharing; default on).
 
-Host-side slot management (free list, eviction) lives in `KVCache`; the
-device arrays are a plain dict pytree (`state`) threaded through the jitted
-steps, so the engine can donate the buffers and update in place.
+Device-side mutation stays functional and jit-friendly — every write
+resolves logical positions through the block table INSIDE the traced fn,
+so one compiled prefill/decode serves every slot and every block mapping:
 
-KV-cache HBM footprint = n_layers * max_seqs * max_len * n_kv_heads *
-head_dim * 2 (k+v) * itemsize — with grouped-query attention (n_kv_heads <
-n_heads) the cache shrinks by the group factor, which is why the decode path
-is GQA-aware end to end (PERF.md note).
+- prefill: the padded prompt reshapes to whole blocks and scatters to the
+  slot's mapped physical blocks (`write_prefill`); shared-prefix suffixes
+  scatter per position (`write_positions`);
+- decode append: a batched scatter at each slot's `lengths` position,
+  gathered through the block table (`append_token`), one op per batch.
+
+Safety invariants:
+- VISIBILITY (unchanged from the slot cache): position p of slot s is
+  visible to attention iff p < lengths[s], and lengths[s] only reaches
+  p+1 in the decode step that wrote fresh k/v at p. Padded prefill tails
+  and post-EOS masked appends are therefore harmless.
+- TRASH ROUTING (new under paging): with block indirection a stale write
+  through a freed slot's table row could land in a block already REUSED
+  by another request — physical confinement no longer comes free. Every
+  write therefore routes inactive slots (and out-of-range positions) to a
+  dedicated TRASH block (physical index num_blocks, outside the allocator
+  pool), so a masked append can never corrupt live data no matter how the
+  block was re-mapped. Freed slots also get their device row reset to
+  trash. This is what keeps the engine's CHUNKED and OVERLAPPED
+  scheduling (finished slots ride out up to one extra chunk on a stale
+  active mask) exactly as safe as it was under slot granularity.
+- SHARED BLOCKS ARE READ-ONLY: a request writes only positions >= its
+  shared prefix length; admission maps the block containing the first
+  such write as a fresh copy (COW), so refcount >= 2 implies no writer.
+
+Host-side management (slot free list, block allocator, prefix registry,
+eviction) lives in `KVCache`; the device arrays are a plain dict pytree
+(`state`) threaded through the jitted steps. Both free lists are heapqs —
+O(log n) alloc/free where the old `pop(0)` + per-free `sort()` idiom
+would cost O(n log n) on the much larger block list.
+
+KV-cache HBM footprint = 2 (k+v) * n_layers * (num_blocks + 1 trash) *
+block_size * n_kv_heads * head_dim * itemsize; `bytes_per_position` =
+2 * n_layers * n_kv_heads * head_dim * itemsize is the per-token cost the
+engine's residency/waste gauges use (PERF.md's paged cost model).
 """
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+import heapq
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.serving.block_table import (BlockAllocator,
+                                                    PrefixRegistry)
+
+DEFAULT_BLOCK = 16
+
+
+def resolve_block_size(block_size: Optional[int], max_len: int) -> int:
+    """Effective block size: the env/default request clamped to the largest
+    divisor of max_len not exceeding it (block tables must tile max_len
+    exactly — the table shape is fixed at max_len // block_size)."""
+    if block_size is None:
+        block_size = int(os.environ.get("DL4J_TPU_KV_BLOCK",
+                                        str(DEFAULT_BLOCK)))
+    bs = max(1, min(int(block_size), int(max_len)))
+    while max_len % bs:
+        bs -= 1
+    return bs
 
 
 def init_cache_state(n_layers: int, max_seqs: int, max_len: int,
-                     n_kv_heads: int, head_dim: int,
-                     dtype=jnp.bfloat16) -> Dict[str, jnp.ndarray]:
-    """Allocate the device-side cache pytree (all-zero, all slots free)."""
-    shape = (n_layers, max_seqs, max_len, n_kv_heads, head_dim)
+                     n_kv_heads: int, head_dim: int, dtype=jnp.bfloat16,
+                     block_size: Optional[int] = None,
+                     num_blocks: Optional[int] = None
+                     ) -> Dict[str, jnp.ndarray]:
+    """Allocate the device-side paged cache pytree (all-zero, all slots
+    free, every table entry pointing at the trash block)."""
+    bs = resolve_block_size(block_size, max_len)
+    bps = max_len // bs
+    nb = int(num_blocks) if num_blocks is not None else max_seqs * bps
+    shape = (n_layers, nb + 1, bs, n_kv_heads, head_dim)   # +1: trash block
     return {
         "k": jnp.zeros(shape, dtype),
         "v": jnp.zeros(shape, dtype),
         # number of CACHED positions per slot; position p is visible iff
         # p < lengths[slot]
         "lengths": jnp.zeros((max_seqs,), jnp.int32),
+        # logical block -> physical block per slot; trash (= nb) everywhere
+        # a slot has no reservation
+        "block_tables": jnp.full((max_seqs, bps), nb, jnp.int32),
     }
+
+
+def _dims(state):
+    n_phys, bs = state["k"].shape[1], state["k"].shape[2]
+    return bs, state["block_tables"].shape[1], n_phys - 1   # bs, bps, trash
 
 
 def write_prefill(state: Dict[str, jnp.ndarray], layer: int, slot,
                   k_block: jnp.ndarray, v_block: jnp.ndarray
                   ) -> Dict[str, jnp.ndarray]:
     """Write one layer's prompt k/v block (T_pad, Hk, D) into `slot` at
-    positions [0, T_pad). `slot` may be a traced scalar — one compiled
-    prefill serves every slot. Padded tail positions are fine to write (see
-    module invariant); the caller sets `lengths` to the TRUE prompt length
-    via set_length()."""
-    blk = lambda b: b[None, None].astype(state["k"].dtype)
-    start = (jnp.asarray(layer, jnp.int32), jnp.asarray(slot, jnp.int32),
-             jnp.asarray(0, jnp.int32), jnp.asarray(0, jnp.int32),
-             jnp.asarray(0, jnp.int32))
+    logical positions [0, T_pad). T_pad must be a multiple of block_size;
+    the block reshapes to whole blocks and scatters to the slot's mapped
+    physical blocks. `slot` may be a traced scalar — one compiled prefill
+    serves every slot and every block mapping. Padding blocks beyond the
+    slot's reservation hit table entries that still point at trash (see
+    module invariants); the caller sets `lengths` to the TRUE prompt
+    length via set_length()."""
+    bs, _, _ = _dims(state)
+    T = k_block.shape[0]
+    if T % bs:
+        raise ValueError(f"prefill block length {T} not a multiple of "
+                         f"block_size {bs}")
+    nb = T // bs
+    phys = state["block_tables"][jnp.asarray(slot, jnp.int32)][:nb]  # (nb,)
+    kb = k_block.reshape((nb, bs) + k_block.shape[1:])
+    vb = v_block.reshape((nb, bs) + v_block.shape[1:])
     return {**state,
-            "k": jax.lax.dynamic_update_slice(state["k"], blk(k_block), start),
-            "v": jax.lax.dynamic_update_slice(state["v"], blk(v_block), start)}
+            "k": state["k"].at[layer, phys].set(kb.astype(state["k"].dtype)),
+            "v": state["v"].at[layer, phys].set(vb.astype(state["v"].dtype))}
+
+
+def write_positions(state: Dict[str, jnp.ndarray], layer: int, slot,
+                    positions: jnp.ndarray, valid: jnp.ndarray,
+                    k_seq: jnp.ndarray, v_seq: jnp.ndarray
+                    ) -> Dict[str, jnp.ndarray]:
+    """Scatter k/v (T, Hk, D) to arbitrary logical `positions` (T,) of
+    `slot`, resolved through its block table. Rows with valid=False (the
+    padded tail of a shared-prefix suffix prefill) route to the trash
+    block — they must NEVER alias a real (block, offset) pair, because a
+    duplicate scatter index has an unspecified winner and a garbage
+    padding row could otherwise clobber a just-written real position."""
+    bs, bps, trash = _dims(state)
+    row = state["block_tables"][jnp.asarray(slot, jnp.int32)]     # (bps,)
+    bidx = jnp.clip(positions // bs, 0, bps - 1)
+    phys = jnp.where(valid, row[bidx], trash)
+    off = positions % bs
+    return {**state,
+            "k": state["k"].at[layer, phys, off].set(
+                k_seq.astype(state["k"].dtype)),
+            "v": state["v"].at[layer, phys, off].set(
+                v_seq.astype(state["v"].dtype))}
 
 
 def set_length(state: Dict[str, jnp.ndarray], slot, length
@@ -93,37 +177,80 @@ def set_length(state: Dict[str, jnp.ndarray], slot, length
 
 
 def append_token(state: Dict[str, jnp.ndarray], layer: int,
-                 k_t: jnp.ndarray, v_t: jnp.ndarray
+                 k_t: jnp.ndarray, v_t: jnp.ndarray, active: jnp.ndarray
                  ) -> Dict[str, jnp.ndarray]:
-    """Batched one-position append for ALL slots: k_t/v_t (S, Hk, D) land at
-    each slot's current `lengths` position (ragged scatter). Does NOT bump
-    `lengths` — the decode step advances lengths ONCE after all layers wrote
-    (see advance_lengths), so every layer of one iteration writes at the
-    same position."""
-    s = jnp.arange(state["k"].shape[1])
-    pos = state["lengths"]
+    """Batched one-position append for ALL slots: k_t/v_t (S, Hk, D) land
+    at each slot's current `lengths` position, gathered through its block
+    table (ragged scatter). INACTIVE slots route to the trash block — a
+    freed slot's stale table row may point at blocks already reused by
+    another request, so the mask is load-bearing here, not an
+    optimization. Does NOT bump `lengths` — the decode step advances
+    lengths ONCE after all layers wrote (advance_lengths), so every layer
+    of one iteration writes at the same position."""
+    bs, bps, trash = _dims(state)
+    pos = state["lengths"]                                    # (S,)
+    bidx = jnp.clip(pos // bs, 0, bps - 1)
+    phys = jnp.take_along_axis(state["block_tables"], bidx[:, None],
+                               axis=1)[:, 0]
+    phys = jnp.where(active, phys, trash)
+    off = pos % bs
     return {**state,
-            "k": state["k"].at[layer, s, pos].set(k_t.astype(state["k"].dtype)),
-            "v": state["v"].at[layer, s, pos].set(v_t.astype(state["v"].dtype))}
+            "k": state["k"].at[layer, phys, off].set(
+                k_t.astype(state["k"].dtype)),
+            "v": state["v"].at[layer, phys, off].set(
+                v_t.astype(state["v"].dtype))}
 
 
 def advance_lengths(state: Dict[str, jnp.ndarray], active: jnp.ndarray
                     ) -> Dict[str, jnp.ndarray]:
-    """lengths += 1 on active slots only (inactive slots may have received
-    harmless scatter writes at their stale position — never visible)."""
+    """lengths += 1 on active slots only (inactive slots' appends were
+    trash-routed and their lengths never move — invisible forever)."""
     return {**state, "lengths": state["lengths"] + active.astype(jnp.int32)}
 
 
+def set_block_table(state: Dict[str, jnp.ndarray], slot: int,
+                    row: np.ndarray) -> Dict[str, jnp.ndarray]:
+    """Install a slot's logical->physical row (host-built, admission/free
+    time — a scheduling event, not the hot path)."""
+    return {**state, "block_tables": state["block_tables"].at[slot].set(
+        jnp.asarray(row, jnp.int32))}
+
+
+def copy_block(state: Dict[str, jnp.ndarray], src: int, dst: int
+               ) -> Dict[str, jnp.ndarray]:
+    """Copy one physical block across ALL layers (the COW copy a shared
+    tail block pays at admission — one device op, no readback)."""
+    return {**state,
+            "k": state["k"].at[:, dst].set(state["k"][:, src]),
+            "v": state["v"].at[:, dst].set(state["v"][:, src])}
+
+
+@dataclass
+class AdmissionPlan:
+    """What `KVCache.admit` decided for one request: where it lives, how
+    much of its prompt KV (and prefill compute) sharing already covers,
+    and whether a COW copy was issued."""
+    slot: int
+    n_blocks: int               # blocks mapped (shared + owned)
+    shared_len: int             # prompt positions covered by shared KV
+    n_shared_blocks: int        # fully-shared (refcounted, read-only) blocks
+    cow: bool                   # a divergent-write block copy was issued
+
+
 class KVCache:
-    """Host-side slot allocator around the device `state` pytree.
+    """Host-side slot + block allocator around the device `state` pytree.
 
     The engine owns one KVCache; the jitted steps consume/return
-    `cache.state`. Allocation and eviction are host decisions made BETWEEN
-    decode iterations (iteration-level scheduling), so they need no device
-    sync: freeing is just host bookkeeping plus a lengths[slot]=0 write."""
+    `cache.state`. Admission, eviction, and prefix matching are host
+    decisions made BETWEEN decode iterations (iteration-level scheduling),
+    so they need no device sync: freeing is host bookkeeping plus a
+    lengths[slot]=0 / table-row-reset write."""
 
     def __init__(self, n_layers: int, max_seqs: int, max_len: int,
-                 n_kv_heads: int, head_dim: int, dtype=jnp.bfloat16):
+                 n_kv_heads: int, head_dim: int, dtype=jnp.bfloat16,
+                 block_size: Optional[int] = None,
+                 num_blocks: Optional[int] = None,
+                 prefix_share: Optional[bool] = None):
         if max_seqs < 1 or max_len < 1:
             raise ValueError(f"bad cache shape: max_seqs={max_seqs}, "
                              f"max_len={max_len}")
@@ -133,46 +260,158 @@ class KVCache:
         self.n_kv_heads = int(n_kv_heads)
         self.head_dim = int(head_dim)
         self.dtype = jnp.dtype(dtype)
+        self.block_size = resolve_block_size(block_size, self.max_len)
+        self.blocks_per_seq = self.max_len // self.block_size
+        self.num_blocks = int(num_blocks) if num_blocks is not None \
+            else self.max_seqs * self.blocks_per_seq
+        if self.num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {self.num_blocks}")
+        self.trash_block = self.num_blocks        # extra block past the pool
+        if prefix_share is None:
+            prefix_share = os.environ.get("DL4J_TPU_PREFIX_SHARE", "1") != "0"
+        self.prefix_share = bool(prefix_share)
         self.state = init_cache_state(n_layers, max_seqs, max_len,
-                                      n_kv_heads, head_dim, dtype)
-        self._free: List[int] = list(range(max_seqs))
+                                      n_kv_heads, head_dim, dtype,
+                                      block_size=self.block_size,
+                                      num_blocks=self.num_blocks)
+        # list(range(n)) is already a valid min-heap
+        self._free_slots: List[int] = list(range(max_seqs))
+        self.allocator = BlockAllocator(self.num_blocks)
+        self.registry = PrefixRegistry(self.block_size)
         self._owner: Dict[int, object] = {}   # slot -> opaque request handle
+        self._slot_blocks: Dict[int, List[int]] = {}   # slot -> mapped blocks
+        # lifetime counters (bench/stats: the sharing win, observable)
+        self.shared_blocks_total = 0    # shared mappings ever granted
+        self.shared_tokens_total = 0    # prompt positions served from shares
+        self.cow_copies_total = 0       # divergent-write block copies issued
 
-    # ---------------- slot management ----------------
-    def allocate(self, owner=None) -> Optional[int]:
-        """Claim a free slot (lowest id first) or None when full."""
-        if not self._free:
+    # ---------------- admission (slot + block allocation) ----------------
+    def allocate(self, owner=None, n_positions: Optional[int] = None,
+                 prompt: Optional[Sequence[int]] = None) -> Optional[int]:
+        """Claim a slot with enough blocks for `n_positions` (default: a
+        full max_len reservation — the slot-cache-compatible call) or None
+        when slots or blocks run out. See admit() for the full plan."""
+        plan = self.admit(owner, n_positions=n_positions, prompt=prompt)
+        return None if plan is None else plan.slot
+
+    def admit(self, owner=None, n_positions: Optional[int] = None,
+              prompt: Optional[Sequence[int]] = None
+              ) -> Optional[AdmissionPlan]:
+        """Admission = block allocation: reserve ceil(n_positions /
+        block_size) blocks for a slot, mapping leading blocks onto
+        already-resident shared-prefix blocks when `prompt` matches the
+        registry (refcounted, read-only), COW-copying the block that holds
+        the first divergent write. All-or-nothing: returns None (no side
+        effects) when the slot or the non-shared blocks aren't available —
+        the engine requeues and retries next iteration."""
+        if not self._free_slots:
             return None
-        slot = self._free.pop(0)
+        bs = self.block_size
+        if n_positions is None:
+            n_positions = self.max_len
+        n_positions = max(1, min(int(n_positions), self.max_len))
+        need = -(-n_positions // bs)                  # ceil
+        shared_len, shared_blocks, cow_src = 0, [], None
+        if self.prefix_share and prompt is not None and len(prompt) > 1:
+            matched, mblocks = self.registry.match(prompt)
+            # always recompute at least the LAST prompt position — prefill
+            # must produce the first-token logprobs from a live activation
+            shared_len = min(matched, len(prompt) - 1)
+            if shared_len >= 1:
+                n_full = shared_len // bs
+                shared_blocks = mblocks[:n_full]
+                if matched > n_full * bs:
+                    # the block holding position shared_len is resident but
+                    # about to diverge (this request writes it) -> COW
+                    cow_src = mblocks[n_full]
+            else:
+                shared_len = 0
+        fresh = self.allocator.alloc_many(need - len(shared_blocks))
+        if fresh is None:
+            return None
+        slot = heapq.heappop(self._free_slots)
+        for b in shared_blocks:
+            self.allocator.incref(b)
+        row_blocks = list(shared_blocks) + fresh
+        if cow_src is not None:
+            self.state = copy_block(self.state, cow_src, fresh[0])
+            self.cow_copies_total += 1
+        row = np.full((self.blocks_per_seq,), self.trash_block, np.int32)
+        row[:len(row_blocks)] = row_blocks
+        self.state = set_block_table(self.state, slot, row)
         self._owner[slot] = owner
-        return slot
+        self._slot_blocks[slot] = row_blocks
+        self.shared_blocks_total += len(shared_blocks)
+        self.shared_tokens_total += shared_len
+        return AdmissionPlan(slot=slot, n_blocks=len(row_blocks),
+                             shared_len=shared_len,
+                             n_shared_blocks=len(shared_blocks),
+                             cow=cow_src is not None)
+
+    def register_prefix(self, slot: int, prompt: Sequence[int]) -> None:
+        """File the slot's prompt blocks in the prefix registry (call AFTER
+        dispatching the prefill — by the time any sharer's device reads
+        run, the writes are ordered ahead of them)."""
+        if self.prefix_share and len(prompt) >= 2:
+            self.registry.register(prompt, self._slot_blocks[slot])
 
     def free(self, slot: int) -> None:
-        """Return a slot to the free list and hide its contents
-        (lengths[slot]=0 — the buffers themselves need no zeroing, see the
-        module invariant)."""
-        if slot in self._free:
+        """Return a slot and its block reservations. Shared blocks only
+        reach the free list when their LAST mapping drops (refcounts); a
+        block that does free drops its registry claims — its content is
+        about to be overwritten by an unrelated request. The device row is
+        reset to trash and lengths[slot]=0 (the buffers themselves need no
+        zeroing: stale writes are trash-routed and stale content is
+        invisible, see the module invariants)."""
+        if slot not in self._slot_blocks:
             raise ValueError(f"slot {slot} already free")
+        for b in self._slot_blocks.pop(slot):
+            if self.allocator.decref(b):
+                self.registry.forget(b)
         self._owner.pop(slot, None)
         self.state = set_length(self.state, slot, 0)
-        self._free.append(slot)
-        self._free.sort()
+        self.state = set_block_table(
+            self.state, slot,
+            np.full((self.blocks_per_seq,), self.trash_block, np.int32))
+        heapq.heappush(self._free_slots, slot)
 
     def owner(self, slot: int):
         return self._owner.get(slot)
 
+    # ------------------------------------------------------------- stats
     @property
     def n_free(self) -> int:
-        return len(self._free)
+        return len(self._free_slots)
 
     @property
     def n_active(self) -> int:
-        return self.max_seqs - len(self._free)
+        return self.max_seqs - len(self._free_slots)
+
+    @property
+    def blocks_free(self) -> int:
+        return self.allocator.n_free
+
+    @property
+    def blocks_shared(self) -> int:
+        return self.allocator.n_shared
 
     def active_slots(self) -> List[int]:
         return sorted(self._owner)
 
+    def reserved_positions(self, slot: int) -> int:
+        """Positions this slot's block reservation holds (block
+        granularity — the engine's kv_bytes_waste gauge subtracts the live
+        prompt+generated count from this)."""
+        return len(self._slot_blocks.get(slot, ())) * self.block_size
+
+    @property
+    def bytes_per_position(self) -> int:
+        """Per-token KV cost (k+v, all layers) — the PERF.md unit."""
+        return 2 * self.n_layers * self.n_kv_heads * self.head_dim * \
+            self.dtype.itemsize
+
     def bytes(self) -> int:
-        """Device HBM held by the k/v buffers (the PERF.md formula)."""
-        return 2 * self.n_layers * self.max_seqs * self.max_len * \
-            self.n_kv_heads * self.head_dim * self.dtype.itemsize
+        """Device HBM held by the k/v buffers (num_blocks + the trash
+        block) — the PERF.md paged footprint formula."""
+        return (self.num_blocks + 1) * self.block_size * \
+            self.bytes_per_position

@@ -6,7 +6,10 @@ published numbers. `validate_artifact` checks the structural contract —
 and the ISSUE 6 additions: every measured entry carries a `platform`
 label, `decode_serving`/`decode_serving_k1` are ALWAYS present (skipped
 runs say so via `skipped_reason` instead of vanishing), and the
-auto-generated `roofline_table` rows are well-formed. bench.py calls
+auto-generated `roofline_table` rows are well-formed. ISSUE 7 adds
+`decode_prefix_share` (the shared-prefix A/B — CPU-runnable, so it is
+always present and, when measured, must carry the savings fields the
+docs render). bench.py calls
 `assert_valid` on the dict it is about to print, and
 tests/test_bench_schema.py re-validates the committed artifact, so the
 contract holds at write time and at review time.
@@ -65,6 +68,31 @@ def validate_artifact(art: dict) -> List[str]:
         if "decode_tokens_per_sec" not in d and "skipped_reason" not in d:
             errs.append(f"extra['{key}'] has neither decode_tokens_per_sec "
                         "nor skipped_reason")
+
+    # shared-prefix A/B (ISSUE 7): CPU-runnable, so it must always exist;
+    # when measured it must carry the savings fields the docs render plus
+    # the admission-capacity probe
+    ps = e.get("decode_prefix_share")
+    if not isinstance(ps, dict):
+        errs.append("extra['decode_prefix_share'] missing or not a dict "
+                    "(the A/B runs on any platform — emit error/skipped "
+                    "entries rather than dropping it)")
+    elif "error" not in ps and "skipped_reason" not in ps:
+        if "platform" not in ps:
+            errs.append("extra['decode_prefix_share'] has no 'platform' "
+                        "label")
+        for k in ("prefill_positions_saved", "prefill_flops_saved_per_sharer",
+                  "kv_bytes_saved", "ttft_sharer_delta_ms"):
+            if not _is_num(ps.get(k)):
+                errs.append(f"extra['decode_prefix_share'].{k} missing or "
+                            "not a number")
+        cap = ps.get("admission_capacity")
+        if not isinstance(cap, dict) or not all(
+                _is_num(cap.get(k)) for k in ("resident_seqs_max",
+                                              "slot_equivalent_ceiling")):
+            errs.append("extra['decode_prefix_share'].admission_capacity "
+                        "must carry numeric resident_seqs_max and "
+                        "slot_equivalent_ceiling")
 
     # every measurement dict carries a platform label
     for name, entry in e.items():

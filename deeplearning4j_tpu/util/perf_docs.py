@@ -198,6 +198,27 @@ def render_block(art: dict) -> str:
         lines.append(
             f"- Autoregressive serving bench: {dec['skipped_reason']} "
             f"(platform: {dec.get('platform', '?')}).")
+    ps = e.get("decode_prefix_share", {})
+    if ps.get("prefill_positions_saved") is not None:
+        line = (
+            f"- Paged KV + copy-on-write prefix sharing (ISSUE 7 A/B, "
+            f"{ps.get('platform', '?')}): {ps['requests']} — sharing ON "
+            f"skips {ps['prefill_positions_saved']} prefill positions and "
+            f"{ps.get('prefill_flops_saved_frac', 0) * 100:.0f}% of each "
+            f"sharer's prefill FLOPs (XLA cost model: "
+            f"{ps.get('prefill_flops_saved_per_sharer', 0) / 1e6:.1f}M of "
+            f"{ps.get('prefill_flops_full', 0) / 1e6:.1f}M), dedups "
+            f"{ps.get('kv_bytes_saved', 0) / 1e3:.0f} kB of KV, and moves "
+            f"sharer TTFT by {ps.get('ttft_sharer_delta_ms', 0):+.1f} ms "
+            f"(decoded tokens identical on/off).")
+        cap = ps.get("admission_capacity") or {}
+        if cap.get("resident_seqs_max") is not None:
+            line += (
+                f" Admission is block-granular: a {cap.get('kv_blocks', '?')}"
+                f"-block pool held {cap['resident_seqs_max']} concurrent "
+                f"short sequences vs a slot-equivalent ceiling of "
+                f"{cap.get('slot_equivalent_ceiling', '?')}.")
+        lines.append(line)
     lines.extend(_roofline_table_lines(e.get("roofline_table")))
     lines.append(
         f"- ParallelWrapper ResNet50: {pw['images_per_sec']:,.0f} img/s — "

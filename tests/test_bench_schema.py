@@ -25,6 +25,12 @@ def _minimal_art():
                                "skipped_reason": "no TPU"},
             "decode_serving_k1": {"platform": "cpu", "skipped": True,
                                   "skipped_reason": "no TPU"},
+            "decode_prefix_share": {
+                "platform": "cpu", "prefill_positions_saved": 144,
+                "prefill_flops_saved_per_sharer": 4.5e6,
+                "kv_bytes_saved": 73728, "ttft_sharer_delta_ms": 0.1,
+                "admission_capacity": {"resident_seqs_max": 4,
+                                       "slot_equivalent_ceiling": 2}},
             "roofline_table": [
                 {"function": "train_step", "platform": "tpu",
                  "flops": 1e12, "bytes_accessed": 1e9,
@@ -63,6 +69,27 @@ def test_decode_serving_needs_reason_or_throughput():
     assert validate_artifact(art) == []
     # an errored entry is exempt (the error IS the record)
     art["extra"]["decode_serving"] = {"error": "ValueError: boom"}
+    assert validate_artifact(art) == []
+
+
+def test_prefix_share_ab_rules():
+    """ISSUE 7: the shared-prefix A/B must always exist; a measured entry
+    needs the savings fields + the admission-capacity probe; skipped and
+    errored entries are exempt."""
+    art = _minimal_art()
+    del art["extra"]["decode_prefix_share"]
+    assert any("decode_prefix_share" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["decode_prefix_share"]["kv_bytes_saved"]
+    assert any("kv_bytes_saved" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["decode_prefix_share"]["admission_capacity"] = {}
+    assert any("admission_capacity" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["decode_prefix_share"] = {"error": "ValueError: boom"}
+    assert validate_artifact(art) == []
+    art["extra"]["decode_prefix_share"] = {"platform": "cpu",
+                                           "skipped_reason": "why not"}
     assert validate_artifact(art) == []
 
 

@@ -1,0 +1,151 @@
+"""Paged-KV host bookkeeping tests (ISSUE 7 satellite): block allocator
+refcounts, prefix-registry chain hashing, and a randomized interleaved
+alloc/free/fork stress asserting the invariants the device side relies on —
+refcount conservation, no double-free, and no block aliasing across
+unrelated requests. (Device-side value parity for the shared/paged paths
+lives in tests/test_serving.py's fp64 oracle tests.)"""
+import random
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.serving.block_table import (BlockAllocator,
+                                                    PrefixRegistry)
+from deeplearning4j_tpu.serving.kv_cache import KVCache
+
+
+# ---------------------------------------------------------------- allocator
+def test_allocator_alloc_free_refcount():
+    a = BlockAllocator(4)
+    assert [a.alloc() for _ in range(4)] == [0, 1, 2, 3]   # lowest id first
+    assert a.alloc() is None and a.n_free == 0
+    a.incref(2)
+    assert a.n_shared == 1 and a.refcount(2) == 2
+    assert a.decref(2) is False and a.n_shared == 0        # still mapped
+    assert a.decref(2) is True and a.n_free == 1           # now free
+    with pytest.raises(ValueError):
+        a.decref(2)                                        # double free
+    with pytest.raises(ValueError):
+        a.incref(2)                                        # incref on free
+    assert a.alloc() == 2                                  # heap reuse
+
+
+def test_allocator_alloc_many_all_or_nothing():
+    a = BlockAllocator(3)
+    assert a.alloc_many(2) == [0, 1]
+    assert a.alloc_many(2) is None and a.n_free == 1       # no side effects
+    assert a.alloc_many(0) == []
+    assert a.alloc_many(1) == [2]
+
+
+# ----------------------------------------------------------------- registry
+def test_registry_chain_match_and_forget():
+    r = PrefixRegistry(block_size=4)
+    r.register([1, 2, 3, 4, 5, 6, 7, 8, 9, 10], [10, 11, 12])
+    # full-chain hit, tail hit, and divergence at each depth
+    assert r.match([1, 2, 3, 4, 5, 6, 7, 8, 9, 10]) == (10, [10, 11, 12])
+    assert r.match([1, 2, 3, 4, 5, 6, 7, 8, 42]) == (8, [10, 11])
+    assert r.match([1, 2, 3, 4, 42, 6, 7, 8]) == (4, [10])
+    assert r.match([42, 2, 3, 4]) == (0, [])
+    # the chain property: matching block 1 REQUIRES block 0's tokens too
+    r2 = PrefixRegistry(block_size=4)
+    r2.register([9, 9, 9, 9, 5, 6, 7, 8], [20, 21])
+    assert r2.match([1, 2, 3, 4, 5, 6, 7, 8]) == (0, [])
+    # forget() invalidates exactly the freed block's claims
+    r.forget(11)
+    assert r.match([1, 2, 3, 4, 5, 6, 7, 8]) == (4, [10])
+    assert r.match([1, 2, 3, 4, 5, 6, 7, 8, 9, 10]) == (4, [10])
+
+
+def test_registry_tail_never_collides_with_full_block():
+    # a prompt ending mid-block registers under a DOMAIN-TAGGED tail digest:
+    # a longer prompt whose next full block starts with those tokens must
+    # not tail-match, and vice versa
+    r = PrefixRegistry(block_size=4)
+    r.register([1, 2, 3, 4, 5, 6], [0, 1])        # tail [5, 6] on block 1
+    assert r.match([1, 2, 3, 4, 5, 6]) == (6, [0, 1])
+    assert r.match([1, 2, 3, 4, 5, 6, 7, 8]) == (4, [0])   # full != tail
+    assert r.match([1, 2, 3, 4, 5, 7]) == (4, [0])         # tail diverges
+
+
+def test_registry_first_registration_wins():
+    r = PrefixRegistry(block_size=2)
+    r.register([1, 2, 3, 4], [5, 6])
+    r.register([1, 2, 9, 9], [7, 8])              # block 0 digest collides
+    assert r.match([1, 2]) == (2, [5])            # original claim kept
+    assert r.match([1, 2, 9, 9]) == (4, [5, 8])
+
+
+# ------------------------------------------------------------------ stress
+def test_randomized_alloc_free_fork_stress():
+    """Interleaved admit/free over forking prompt families. After EVERY
+    operation: each block's refcount equals the number of slot mappings,
+    the free pool and the mapped set partition the pool exactly, the trash
+    block is never mapped, and any block mapped by 2+ slots is at the SAME
+    logical index with the owners' prompts identical over the positions it
+    covers (no aliasing across unrelated requests)."""
+    rng = random.Random(1234)
+    bs = 4
+    c = KVCache(n_layers=1, max_seqs=8, max_len=64, n_kv_heads=1,
+                head_dim=2, dtype=jnp.float32, block_size=bs,
+                num_blocks=40, prefix_share=True)
+    families = [[rng.randrange(50) for _ in range(14)] for _ in range(3)]
+    live = {}                                     # slot -> prompt tokens
+
+    def check_invariants():
+        alloc = c.allocator
+        free_set = set(alloc._free)
+        assert len(free_set) == len(alloc._free)  # heap holds no duplicates
+        counts = Counter(b for blocks in c._slot_blocks.values()
+                         for b in blocks)
+        assert c.trash_block not in counts
+        n_shared = 0
+        for b in range(c.num_blocks):
+            assert alloc.refcount(b) == counts.get(b, 0)   # conservation
+            assert (b in free_set) == (counts.get(b, 0) == 0)
+            n_shared += counts.get(b, 0) >= 2
+        assert alloc.n_shared == n_shared == c.blocks_shared
+        for slot, blocks in c._slot_blocks.items():
+            assert len(set(blocks)) == len(blocks)  # no intra-row aliasing
+        for b, cnt in counts.items():
+            if cnt < 2:
+                continue
+            users = [(s, c._slot_blocks[s].index(b))
+                     for s, blocks in c._slot_blocks.items() if b in blocks]
+            idxs = {i for _, i in users}
+            assert len(idxs) == 1                 # same logical index
+            i = idxs.pop()
+            prefixes = [tuple(live[s][:(i + 1) * bs]) for s, _ in users]
+            assert all(len(p) == (i + 1) * bs for p in prefixes)
+            assert len(set(prefixes)) == 1        # identical covered tokens
+        for b in c.registry._claims:              # claims back live blocks
+            assert c.allocator.refcount(b) >= 1
+
+    for _ in range(400):
+        if rng.random() < 0.6 or not live:
+            fam = rng.choice(families)
+            cut = rng.randrange(4, len(fam) + 1)
+            tokens = fam[:cut] + [rng.randrange(50)
+                                  for _ in range(rng.randrange(0, 3))]
+            n_pos = min(c.max_len, len(tokens) + rng.randrange(1, 9))
+            plan = c.admit("o", n_positions=n_pos, prompt=tokens)
+            if plan is not None:
+                c.register_prefix(plan.slot, tokens)
+                live[plan.slot] = tokens
+        else:
+            slot = rng.choice(sorted(live))
+            del live[slot]
+            c.free(slot)
+        check_invariants()
+
+    for slot in sorted(live):                     # drain: full recovery
+        c.free(slot)
+    assert c.blocks_free == c.num_blocks and c.n_free == c.max_seqs
+    assert c.registry.n_entries == 0 and c.blocks_shared == 0
+    with pytest.raises(ValueError):
+        c.free(0)
+    # the run must actually have exercised sharing and COW
+    assert c.shared_blocks_total > 0 and c.cow_copies_total > 0

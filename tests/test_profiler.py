@@ -217,8 +217,10 @@ def test_serving_publishes_prefill_and_decode_chunk_gauges():
     eng.generate([Request([1, 2, 3], max_new_tokens=8)])
     text = eng.metrics.prometheus_text()
     # the ISSUE 6 acceptance gauges: prefill bucket + decode chunk rooflines
-    assert "profiler_fn_prefill_b4_flops" in text
-    assert "profiler_fn_prefill_b4_measured_ms" in text
+    # (prefill buckets are pow2 rounded UP to KV-block granularity — ISSUE 7)
+    b = eng.decoder.prefill_bucket(3)
+    assert f"profiler_fn_prefill_b{b}_flops" in text
+    assert f"profiler_fn_prefill_b{b}_measured_ms" in text
     assert "profiler_fn_decode_chunk_k4_flops" in text
     assert "profiler_fn_decode_chunk_k4_measured_ms" in text
     assert "profiler_fn_decode_chunk_k4_mfu" in text
@@ -263,8 +265,7 @@ def test_kv_bytes_resident_tracks_scheduler_state():
     assert g.value == 0.0
     fut = eng.submit(Request([1, 2, 3], max_new_tokens=6))
     eng.step()
-    per_pos = eng.decoder.cache.bytes() // (
-        eng.decoder.cache.max_seqs * eng.decoder.cache.max_len)
+    per_pos = eng.decoder.cache.bytes_per_position
     assert g.value > 0 and g.value % per_pos == 0
     eng.drain()
     fut.get(timeout=0)

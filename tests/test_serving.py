@@ -69,24 +69,38 @@ def test_kv_cache_slot_lifecycle():
     with pytest.raises(ValueError):
         c.free(s1)
         c.free(s1)
-    # HBM formula: n_layers * max_seqs * max_len * Hk * D * 2 * itemsize
-    assert c.bytes() == 2 * 3 * 8 * 2 * 4 * 2 * 4
+    # paged HBM formula: (num_blocks + 1 trash) * block_size * bytes/position
+    assert c.bytes_per_position == 2 * 2 * 2 * 4 * 4
+    assert c.bytes() == (c.num_blocks + 1) * c.block_size \
+        * c.bytes_per_position
+    # default geometry reserves the same positions as the old slot cache
+    assert c.num_blocks * c.block_size == 3 * 8
 
 
 def test_kv_cache_append_respects_per_slot_lengths():
     c = KVCache(n_layers=1, max_seqs=2, max_len=8, n_kv_heads=1, head_dim=2,
-                dtype=jnp.float64)
-    st = c.state
-    st = {**st, "lengths": jnp.asarray([2, 0], jnp.int32)}
+                dtype=jnp.float64, block_size=4)
+    assert c.allocate("a") == 0 and c.allocate("b") == 1
+    st = {**c.state, "lengths": jnp.asarray([2, 0], jnp.int32)}
     k_t = jnp.arange(4, dtype=jnp.float64).reshape(2, 1, 2) + 1
     from deeplearning4j_tpu.serving.kv_cache import (advance_lengths,
                                                      append_token)
-    st = advance_lengths(append_token(st, 0, k_t, k_t),
-                         jnp.asarray([True, True]))
-    # slot 0 wrote at its position 2, slot 1 at its position 0
-    np.testing.assert_allclose(np.asarray(st["k"][0, 0, 2, 0]), [1, 2])
-    np.testing.assert_allclose(np.asarray(st["k"][0, 1, 0, 0]), [3, 4])
+    both = jnp.asarray([True, True])
+    st = advance_lengths(append_token(st, 0, k_t, k_t, both), both)
+    bt = np.asarray(st["block_tables"])
+    # slot 0 wrote at its logical position 2 (block bt[0,0] offset 2),
+    # slot 1 at its logical position 0 — resolved through the block table
+    np.testing.assert_allclose(np.asarray(st["k"][0, bt[0, 0], 2, 0]), [1, 2])
+    np.testing.assert_allclose(np.asarray(st["k"][0, bt[1, 0], 0, 0]), [3, 4])
     assert st["lengths"].tolist() == [3, 1]
+    # an INACTIVE slot's append trash-routes: its mapped block stays clean
+    # and the write lands in the dedicated trash block (stale block-table
+    # rows must never corrupt reallocated blocks)
+    st2 = append_token(st, 0, k_t * 10, k_t * 10,
+                       jnp.asarray([True, False]))
+    np.testing.assert_allclose(np.asarray(st2["k"][0, bt[1, 0], 1, 0]), 0.0)
+    np.testing.assert_allclose(np.asarray(st2["k"][0, c.trash_block, 1, 0]),
+                               [30, 40])
 
 
 # ----------------------------------------------------------------- parity
@@ -383,3 +397,99 @@ def test_overlapped_drain_matches_sync_and_amortizes_syncs():
     # 1/K amortization: syncs/token = 1/8 plus the 3 admission events
     assert so["host_syncs"] <= s1["host_syncs"] / 2
     assert so["host_syncs_per_token"] <= 1.0 / 8 + 3.0 / 48 + 1e-9
+
+
+# ------------------------------------------- paged cache + prefix sharing
+def _run_shared(net, prompts, share, chunk=1, block=4, seed=3, max_seqs=4,
+                **kw):
+    eng = ServingEngine(net, max_seqs=max_seqs, max_len=64, seed=seed,
+                        decode_chunk=chunk, overlap=False,
+                        capture_logprobs=True, kv_block=block,
+                        prefix_share=share)
+    return eng.generate([Request(list(p), **kw) for p in prompts]), eng
+
+
+@pytest.mark.parametrize("chunk", [1, 8])
+def test_prefix_share_token_and_sync_parity(chunk):
+    """The ISSUE 7 acceptance bar: with paging AND prefix sharing on,
+    decode is token-identical to sharing off for K in {1, 8}, every
+    request stays on the fp64 oracle, and host_syncs_per_token is
+    UNCHANGED (admission through shared blocks adds zero syncs)."""
+    net = _build_net(n_kv=2)
+    common = [5, 6, 7, 8, 9, 10, 11, 12]           # two full 4-pos blocks
+    prompts = [common + [1, 2], common + [1, 2], common + [3]]
+    on, e_on = _run_shared(net, prompts, True, chunk=chunk,
+                           max_new_tokens=7)
+    off, e_off = _run_shared(net, prompts, False, chunk=chunk,
+                             max_new_tokens=7)
+    for a, b, p in zip(on, off, prompts):
+        assert a.tokens == b.tokens
+        _assert_parity(net, a, p)
+    s_on, s_off = e_on.stats(), e_off.stats()
+    assert s_on["host_syncs"] == s_off["host_syncs"]
+    assert s_on["host_syncs_per_token"] == s_off["host_syncs_per_token"]
+    assert s_on["prefix_hits"] == 2 and s_off["prefix_hits"] == 0
+    # request 2 shares the full 10-token prompt minus the recomputed last
+    # position; request 3 shares the two full common blocks
+    assert s_on["prefix_shared_tokens"] == 9 + 8
+
+
+def test_prefix_share_mid_stream_and_sliding_window_parity():
+    """Sharing under the hard configs: a sliding-window stack, with the
+    sharer admitted MID-STREAM while the donor is still decoding (the COW
+    block copy races the donor's appends — functional ordering makes it
+    safe). Both requests stay on the full-recompute oracle."""
+    net = _build_net(n_kv=2, window=3)
+    eng = ServingEngine(net, max_seqs=2, max_len=64, seed=7,
+                        capture_logprobs=True, kv_block=4,
+                        prefix_share=True, decode_chunk=1)
+    p1 = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3]
+    p2 = p1[:8] + [11, 12, 1]           # shares p1's two full blocks
+    f1 = eng.submit(Request(p1, max_new_tokens=10))
+    for _ in range(4):
+        eng.step()
+    f2 = eng.submit(Request(p2, max_new_tokens=6))
+    eng.drain()
+    r1, r2 = f1.get(timeout=0), f2.get(timeout=0)
+    _assert_parity(net, r1, p1)
+    _assert_parity(net, r2, p2)
+    assert eng.stats()["prefix_hits"] == 1
+    assert eng.stats()["prefix_shared_tokens"] == 8
+
+
+def test_paged_admission_exceeds_slot_equivalent_ceiling():
+    """The capacity win: with the block pool sized to TWO full-length
+    slot-cache rows, four short requests are resident CONCURRENTLY —
+    admission is bounded by blocks, not slots."""
+    net = _build_net()
+    # kv_block=8, kv_blocks=16: a full max_len=64 reservation is 8 blocks,
+    # so the same HBM as a 2-slot slot cache; short requests (4 prompt + 4
+    # generated <= 8 positions) take ONE block each
+    eng = ServingEngine(net, max_seqs=4, max_len=64, seed=0, kv_block=8,
+                        kv_blocks=16, prefix_share=False)
+    slot_equivalent = 16 // eng.decoder.cache.blocks_per_seq
+    assert slot_equivalent == 2
+    res = eng.generate([Request([i + 1, i + 2, i + 3, i + 4],
+                                max_new_tokens=4) for i in range(4)])
+    assert all(len(r.tokens) == 4 for r in res)
+    assert eng.stats()["resident_seqs_max"] == 4 > slot_equivalent
+
+
+def test_block_exhaustion_queues_fifo_and_recovers():
+    """When the pool cannot cover the head request, admission WAITS (FIFO
+    preserved, no starvation) and retries after a retirement frees blocks;
+    the queued request still decodes its exact solo stream."""
+    net = _build_net()
+    solo, _ = _run_chunked(net, [[7, 8, 9]], chunk=1, seed=0, max_seqs=1,
+                           max_new_tokens=5)
+    eng = ServingEngine(net, max_seqs=2, max_len=64, seed=0, kv_block=8,
+                        kv_blocks=2, prefix_share=False)
+    f1 = eng.submit(Request([1, 2, 3], max_new_tokens=13))   # 16 pos = 2 blk
+    f2 = eng.submit(Request([7, 8, 9], max_new_tokens=5))
+    eng.step()
+    assert len(eng._by_slot) == 1 and eng.stats()["queue_depth"] == 1
+    eng.drain()
+    assert len(f1.get(timeout=0).tokens) == 13
+    assert f2.get(timeout=0).tokens == solo[0].tokens
+    assert eng.stats()["resident_seqs_max"] == 1
+    assert eng.decoder.cache.blocks_free == 2

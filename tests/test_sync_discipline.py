@@ -25,7 +25,12 @@ PKG = REPO / "deeplearning4j_tpu"
 HOT_PATH_MODULES = sorted(
     [PKG / "optimize" / "listeners.py",
      PKG / "ui" / "stats.py",
-     PKG / "serving" / "engine.py"]
+     PKG / "serving" / "engine.py",
+     # paged KV cache (ISSUE 7): admission/free/sharing bookkeeping runs
+     # between every decode iteration — a hidden readback there would tax
+     # every scheduling opportunity
+     PKG / "serving" / "kv_cache.py",
+     PKG / "serving" / "block_table.py"]
     + list((PKG / "telemetry").glob("*.py")))
 
 ANNOTATION = "sync-ok:"
@@ -89,9 +94,11 @@ def test_all_hot_path_modules_exist():
     names = {p.name for p in HOT_PATH_MODULES}
     # the telemetry glob must keep covering these specific modules — the
     # ISSUE 6 profiler/memory accounting promise the same zero-added-syncs
-    # contract as the ISSUE 4/5 modules
+    # contract as the ISSUE 4/5 modules; ISSUE 7 adds the paged-KV
+    # scheduling modules under the same promise
     assert {"health.py", "profiler.py", "memory.py", "tracing.py",
-            "registry.py", "training.py"} <= names
+            "registry.py", "training.py", "kv_cache.py",
+            "block_table.py"} <= names
 
 
 # ------------------------------------------------ scanner self-tests
