@@ -11,6 +11,10 @@ from deeplearning4j_tpu.serving.decode import (StackDecoder, decode_attention,
 from deeplearning4j_tpu.serving.engine import (GenerationResult, Request,
                                                ServingEngine)
 from deeplearning4j_tpu.serving.kv_cache import KVCache, init_cache_state
+from deeplearning4j_tpu.serving.loadgen import (LoadResult, LoadSpec,
+                                                RequestOutcome,
+                                                ScheduledRequest,
+                                                build_schedule, run_spec)
 from deeplearning4j_tpu.serving.sampler import Sampler, sample_tokens
 
 __all__ = [
@@ -18,4 +22,6 @@ __all__ = [
     "StackDecoder", "decode_attention", "decode_attention_paged",
     "one_hot_embedder", "ServingEngine", "Request", "GenerationResult",
     "Sampler", "sample_tokens",
+    "LoadSpec", "LoadResult", "RequestOutcome", "ScheduledRequest",
+    "build_schedule", "run_spec",
 ]

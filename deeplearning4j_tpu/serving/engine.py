@@ -101,6 +101,29 @@ class GenerationResult:
     # token, and generated tokens (after the first) / decode span
     ttft_s: Optional[float] = None
     tokens_per_sec: Optional[float] = None
+    # lifecycle observability (ISSUE 8): engine-assigned request id (the
+    # same id the Tracer spans carry as `req=`), submit -> admission-start
+    # queue wait (separated from TTFT, which also spans prefill), and the
+    # number of admission attempts that failed for lack of a slot / KV
+    # blocks before this request got in
+    req_id: int = -1
+    queue_wait_s: Optional[float] = None
+    admission_retries: int = 0
+    # per-request lifecycle timeline: ordered event dicts {"phase", "t0",
+    # "t1", ...extras} on the host monotonic clock ("queue" -> "admission"
+    # -> "prefill" -> one "decode_chunk" per scheduler iteration the slot
+    # entered -> "retire"). Built from timestamps the scheduler already
+    # takes — recording it adds zero device syncs. flight_recorder.py
+    # turns retained timelines into a Perfetto trace.
+    timeline: List[dict] = field(default_factory=list)
+
+    def timeline_phases(self) -> Dict[str, float]:
+        """Total seconds per phase (post-hoc latency decomposition)."""
+        out: Dict[str, float] = {}
+        for ev in self.timeline:
+            out[ev["phase"]] = out.get(ev["phase"], 0.0) + \
+                (ev["t1"] - ev["t0"])
+        return out
 
 
 class _Future:
@@ -139,6 +162,10 @@ class _Active:
     logprobs: Optional[List[np.ndarray]] = None
     t_submit: float = 0.0
     t_first: float = 0.0              # first token materialized (admission)
+    req_id: int = -1                  # engine-assigned lifecycle id (ISSUE 8)
+    retries: int = 0                  # failed block-reservation attempts
+    t_admit: float = 0.0              # admission (block plan) succeeded
+    timeline: List[dict] = field(default_factory=list)
 
 
 def _build_step(decoder: StackDecoder, embed: Callable, top_k: int,
@@ -227,7 +254,8 @@ class ServingEngine:
                  overlap: bool = True,
                  kv_block: Optional[int] = None,
                  kv_blocks: Optional[int] = None,
-                 prefix_share: Optional[bool] = None):
+                 prefix_share: Optional[bool] = None,
+                 flight_recorder=None):
         self.decoder = StackDecoder(net, max_seqs, max_len, dtype=dtype,
                                     block_size=kv_block,
                                     num_blocks=kv_blocks,
@@ -306,6 +334,13 @@ class ServingEngine:
         self._h_ttft = self.metrics.histogram(
             "serving.ttft_s", "submit -> first token (s)",
             buckets=telemetry.DEFAULT_S_BUCKETS)
+        self._h_queue_wait = self.metrics.histogram(
+            "serving.queue_wait_s", "submit -> admission start (s): the "
+            "queueing component that TTFT conflates with prefill (ISSUE 8)",
+            buckets=telemetry.DEFAULT_S_BUCKETS)
+        self._c_adm_retries = self.metrics.counter(
+            "serving.admission_retries", "scheduler iterations the head-of-"
+            "queue request waited because its block reservation failed")
         self._h_tps = self.metrics.histogram(
             "serving.tokens_per_sec", "per-request decode throughput",
             buckets=(1, 5, 10, 25, 50, 100, 250, 500, 1000, 2500, 5000,
@@ -348,6 +383,19 @@ class ServingEngine:
         self._g_params = self.metrics.gauge(
             "serving.param_bytes", "decoder parameter bytes")
         self._g_params.set(_tmemory.param_bytes(self.decoder.params))
+        # lifecycle ids + tail-latency flight recorder (ISSUE 8): the
+        # recorder retains full timelines for SLO-violating / worst-TTFT
+        # requests only, fed at retirement from host bookkeeping the
+        # scheduler already holds — zero added device syncs (parity-tested).
+        # Enable by passing flight_recorder= or via DL4J_TPU_FLIGHT_RECORDER.
+        self._next_req_id = 0
+        if flight_recorder is None:
+            fr = os.environ.get("DL4J_TPU_FLIGHT_RECORDER", "")
+            if fr and fr != "0":
+                from deeplearning4j_tpu.telemetry.flight_recorder import \
+                    FlightRecorder
+                flight_recorder = FlightRecorder()
+        self.flight_recorder = flight_recorder
         _tmemory.poll("serving.engine_init", registry=self.metrics)
 
     # host_syncs / tokens_out live on the registry (ISSUE 4 satellite) but
@@ -387,6 +435,7 @@ class ServingEngine:
                     "kv_bytes_waste": self._g_kv_waste.value,
                     "prefix_hits": self._c_prefix_hits.value,
                     "prefix_shared_tokens": self._c_prefix_tokens.value,
+                    "admission_retries": self._c_adm_retries.value,
                     "resident_seqs_max": self._resident_seqs_max}
 
     def export_trace(self, path: str) -> str:
@@ -414,8 +463,13 @@ class ServingEngine:
         with self._work:
             if self._stop.is_set():
                 raise RuntimeError("engine is shut down")
-            self._queue.append(_Active(req, fut, -1, 0, deadline,
-                                       t_submit=time.monotonic()))
+            self._next_req_id += 1
+            act = _Active(req, fut, -1, 0, deadline,
+                          t_submit=time.monotonic(),
+                          req_id=self._next_req_id)
+            self._queue.append(act)
+            telemetry.instant("submit", req=act.req_id, plen=plen,
+                              queued=len(self._queue))
             self._work.notify()
         return fut
 
@@ -435,18 +489,36 @@ class ServingEngine:
             act = self._queue[0]
             if act.deadline is not None and time.monotonic() > act.deadline:
                 self._queue.pop(0)
-                act.fut._set(GenerationResult([], "timeout",
-                                              len(act.req.tokens)))
+                now = time.monotonic()
+                act.timeline.append({"phase": "queue", "t0": act.t_submit,
+                                     "t1": now, "retries": act.retries})
+                act.timeline.append({"phase": "retire", "t0": now, "t1": now,
+                                     "reason": "timeout", "tokens": 0})
+                res = GenerationResult([], "timeout", len(act.req.tokens),
+                                       req_id=act.req_id,
+                                       admission_retries=act.retries,
+                                       timeline=act.timeline)
+                act.fut._set(res)
+                self._record_flight(res)
                 continue
             req = act.req
             plen = len(req.tokens)
+            t_adm0 = time.monotonic()
             plan = cache.admit(act, n_positions=plen + req.max_new_tokens,
                                prompt=req.tokens)
             if plan is None:           # no slot / not enough blocks: wait
+                # one retry per scheduler iteration the head request spends
+                # blocked on its block reservation (ISSUE 8 satellite)
+                act.retries += 1
+                self._c_adm_retries.inc()
                 break
             self._queue.pop(0)
             slot = plan.slot
             act.slot = slot
+            act.t_admit = t_adm0
+            self._h_queue_wait.observe(t_adm0 - act.t_submit)
+            act.timeline.append({"phase": "queue", "t0": act.t_submit,
+                                 "t1": t_adm0, "retries": act.retries})
             toks = np.asarray(req.tokens, np.int32)  # sync-ok: host list
             shared = plan.shared_len
             # compile attribution: each prefill jit retraces once per
@@ -468,8 +540,12 @@ class ServingEngine:
             cm = telemetry.span("jit_compile", kind="prefill",
                                 bucket=bucket) if miss else telemetry.NULL_SPAN
             t_pf = time.perf_counter()
-            with cm, telemetry.span("prefill", slot=slot, plen=plen,
-                                    bucket=bucket, shared=shared):
+            t_pf_mono = time.monotonic()
+            act.timeline.append({"phase": "admission", "t0": t_adm0,
+                                 "t1": t_pf_mono, "slot": slot,
+                                 "blocks": plan.n_blocks, "shared": shared})
+            with cm, telemetry.span("prefill", req=act.req_id, slot=slot,
+                                    plen=plen, bucket=bucket, shared=shared):
                 if shared:
                     # suffix tokens only: the shared prefix's embedding +
                     # projection + score math never runs
@@ -510,6 +586,9 @@ class ServingEngine:
             self._c_tokens.inc()
             self._c_admits.inc()
             act.t_first = time.monotonic()
+            act.timeline.append({"phase": "prefill", "t0": t_pf_mono,
+                                 "t1": act.t_first, "plen": plen,
+                                 "bucket": bucket, "shared": shared})
             if _profiler.enabled():
                 # the admission's device work (prefill dispatch + first
                 # sample + the counted readback), from the host wall the
@@ -519,8 +598,8 @@ class ServingEngine:
                 _profiler.observe(name, (time.perf_counter() - t_pf) * 1e3,
                                   registry=self.metrics)
             self._update_kv_resident()
-            telemetry.instant("admit", slot=slot, plen=plen,
-                              queued=len(self._queue))
+            telemetry.instant("admit", req=act.req_id, slot=slot, plen=plen,
+                              retries=act.retries, queued=len(self._queue))
             self._h_ttft.observe(act.t_first - act.t_submit)
             # single-token request: finished at admission
             if req.max_new_tokens == 1 or (req.eos_id is not None
@@ -536,6 +615,7 @@ class ServingEngine:
         finished slot's row from the chunk that finished it, so the read
         does not block on the chunk already in flight)."""
         act = self._by_slot.pop(slot)
+        t_ret0 = time.monotonic()
         n = act.n_generated
         src = self._hist if hist is None else hist
         row = np.asarray(src[slot])[:n].tolist()  # sync-ok: retirement readback
@@ -561,13 +641,31 @@ class ServingEngine:
             tps = n / total
         else:
             tps = None
-        act.fut._set(GenerationResult(row, reason, len(req.tokens), lps,
-                                      ttft_s=ttft, tokens_per_sec=tps))
+        # a span, not an instant: covers the history-row readback + block
+        # free, so timeline coverage stays gap-free through retirement
+        act.timeline.append({"phase": "retire", "t0": t_ret0, "t1": now,
+                             "reason": reason, "tokens": n})
+        qw = act.t_admit - act.t_submit if act.t_admit else None
+        res = GenerationResult(row, reason, len(req.tokens), lps,
+                               ttft_s=ttft, tokens_per_sec=tps,
+                               req_id=act.req_id, queue_wait_s=qw,
+                               admission_retries=act.retries,
+                               timeline=act.timeline)
+        act.fut._set(res)
         self._c_retires.inc()
         if tps is not None:
             self._h_tps.observe(tps)
         self._update_kv_resident()
-        telemetry.instant("retire", slot=slot, reason=reason, tokens=n)
+        telemetry.instant("retire", req=act.req_id, slot=slot, reason=reason,
+                          tokens=n)
+        self._record_flight(res)
+
+    def _record_flight(self, result: GenerationResult) -> None:
+        """Offer a finished request to the flight recorder (host-side list
+        bookkeeping only — the timeline was built from timestamps the
+        scheduler already took, so recording adds zero device syncs)."""
+        if self.flight_recorder is not None:
+            self.flight_recorder.record(result)
 
     def _update_kv_resident(self) -> None:
         """Publish resident KV bytes: cache positions actually holding a
@@ -636,13 +734,19 @@ class ServingEngine:
         return k
 
     def _finish_steps(self, snapshot: Dict[int, _Active], entry_np, new_np,
-                      lp_np, hist=None) -> None:
+                      lp_np, hist=None, span=None) -> None:
         """Host bookkeeping after a chunk's masks materialize: credit each
         slot one token per micro-step it entered active, retire slots whose
         final mask dropped. `snapshot` is the slot->request map AT DISPATCH
         — the overlapped pipeline may have retired/reassigned a slot since,
         and a stale mask must never touch the new occupant (identity
-        check). Lock held."""
+        check). `span` = (t0, k): iteration start on the monotonic clock +
+        chunk size, appended to each participating request's timeline as
+        its "decode_chunk" event with t1 stamped HERE, per slot — the
+        iteration span rather than pure device wall, and late enough that
+        another slot's slow retirement readback earlier in this loop stays
+        inside the remaining slots' coverage (no timeline gaps). Lock
+        held."""
         K = entry_np.shape[0]
         for slot, act in snapshot.items():
             if self._by_slot.get(slot) is not act \
@@ -651,6 +755,10 @@ class ServingEngine:
             n_new = int(entry_np[:, slot].sum())
             act.n_generated += n_new
             self._c_tokens.inc(n_new)
+            if span is not None:
+                act.timeline.append({"phase": "decode_chunk", "t0": span[0],
+                                     "t1": time.monotonic(), "k": span[1],
+                                     "tokens": n_new})
             if lp_np is not None and act.logprobs is not None:
                 act.logprobs.extend(lp_np[i, slot] for i in range(K)
                                     if entry_np[i, slot])
@@ -666,6 +774,7 @@ class ServingEngine:
         queued. Synchronous: cross-K token parity is exact (peeked keys,
         effective-step commit)."""
         with self._lock:
+            t_iter0 = time.monotonic()   # iteration start: timeline anchor
             self._admit()
             if not self._by_slot:
                 return bool(self._queue)
@@ -728,7 +837,8 @@ class ServingEngine:
                                   registry=self.metrics)
             # sync-ok: capture_logprobs mode only
             lp_np = np.asarray(lps) if self.capture_logprobs else None
-            self._finish_steps(snapshot, entry_np, new_np, lp_np)
+            self._finish_steps(snapshot, entry_np, new_np, lp_np,
+                               span=(t_iter0, k_eff))
             return bool(self._by_slot or self._queue)
 
     # ------------------------------------------------- overlapped pipeline
@@ -747,6 +857,8 @@ class ServingEngine:
         try:
             while True:
                 with self._lock:
+                    t_iter0 = time.monotonic()   # timeline anchor: covers
+                    # this iteration's admissions + the dispatch it issues
                     self._admit()
                     self._expire_timeouts()
                     dispatched = None
@@ -780,12 +892,12 @@ class ServingEngine:
                                 keys, jnp.asarray(self._temps))
                         dispatched = (snapshot, entries, self._dev_active,
                                       self._hist, nf, time.perf_counter(),
-                                      k_eff)
+                                      k_eff, t_iter0)
                     # chunk i+1 is enqueued; materializing chunk i's masks
                     # now overlaps host bookkeeping with device compute
                     if pending is not None:
                         (snapshot, entries, final, hist, nf, t_disp,
-                         k_prev) = pending
+                         k_prev, t_disp_mono) = pending
                         with telemetry.span("host_sync", what="chunk_masks",
                                             overlap=True):
                             # sync-ok: the counted per-chunk readback
@@ -805,8 +917,13 @@ class ServingEngine:
                             _profiler.observe(f"decode_chunk_k{k_prev}",
                                               chunk_ms,
                                               registry=self.metrics)
+                        # the timeline event spans dispatch -> readback of
+                        # the SAME chunk; chunk i+1 was dispatched before
+                        # this readback, so consecutive events overlap —
+                        # resident requests keep gap-free coverage
                         self._finish_steps(snapshot, entry_np, new_np, None,
-                                           hist=hist)
+                                           hist=hist,
+                                           span=(t_disp_mono, k_prev))
                     pending = dispatched
                     if pending is None and not (self._by_slot or self._queue):
                         return
@@ -877,8 +994,14 @@ class ServingEngine:
                     self._active_mask[slot] = False
                     self._retire(slot, "shutdown")
                 for act in self._queue:
-                    act.fut._set(GenerationResult([], "shutdown",
-                                                  len(act.req.tokens)))
+                    now = time.monotonic()
+                    act.timeline.append({"phase": "queue",
+                                         "t0": act.t_submit, "t1": now,
+                                         "retries": act.retries})
+                    act.fut._set(GenerationResult(
+                        [], "shutdown", len(act.req.tokens),
+                        req_id=act.req_id, admission_retries=act.retries,
+                        timeline=act.timeline))
                 self._queue.clear()
             elif self._by_slot or self._queue:
                 self.drain()

@@ -1,0 +1,239 @@
+"""Deterministic open-loop load generator for the serving engine (ISSUE 8).
+
+OPEN-LOOP means arrivals are scheduled by an external clock and keep
+coming at the offered rate whether or not the engine keeps up — the only
+protocol under which queueing actually bites and a TTFT/goodput claim
+means anything. (A closed-loop client waits for its previous request
+before issuing the next, so offered load self-throttles to whatever the
+engine can do and tail latency looks flat right up to collapse; PERF.md
+"Goodput & SLO methodology".)
+
+Two layers, split so the schedule is reproducible independent of the run:
+
+- `build_schedule(spec)` — a PURE function of `LoadSpec` + seed (argument,
+  else $DL4J_TPU_LOADGEN_SEED, else 0) producing the full arrival list:
+  Poisson (exponential gaps) or bursty ON-OFF arrivals (exponential gaps
+  at `rate / duty` inside ON windows, silence in OFF windows — same mean
+  rate, much nastier queueing), prompt/output length mixes, and
+  shared-prefix cohorts whose members draw a common prompt prefix so the
+  paged cache's COW sharing (PR 7) is exercised under load. Identical
+  spec + seed => identical schedule, byte for byte (regression-tested).
+- `run(engine, schedule)` — submits each request when the wall clock
+  passes its arrival time while driving `engine.step()` between
+  submissions, then collects per-request `RequestOutcome`s from the
+  engine's own lifecycle timestamps (queue_wait_s, ttft_s, timeline).
+  Single-threaded and chunk-paced: a request arriving mid-chunk is
+  submitted as soon as that chunk's sync returns, and the induced skew is
+  recorded per request (`lateness_s`) instead of silently shifting the
+  schedule.
+
+The loadgen reads only host-side values (futures, host timestamps) — it
+adds zero device syncs of its own (tests/test_sync_discipline.py scans
+this module).
+"""
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.engine import Request
+
+#: (value, weight) pairs; weights are normalized at draw time
+LengthMix = Sequence[Tuple[int, float]]
+
+
+@dataclass(frozen=True)
+class LoadSpec:
+    """Workload description: everything `build_schedule` needs."""
+    rate: float                          # mean offered rate, req/s
+    n_requests: int
+    process: str = "poisson"             # "poisson" | "bursty"
+    seed: Optional[int] = None           # None -> $DL4J_TPU_LOADGEN_SEED
+    vocab: int = 32                      # prompt token ids in [0, vocab)
+    prompt_len_mix: LengthMix = ((8, 1.0),)
+    max_new_tokens_mix: LengthMix = ((8, 1.0),)
+    temperature: float = 0.0
+    # shared-prefix cohorts: this fraction of requests draw a common
+    # prompt prefix from one of n_cohorts fixed templates (COW sharing)
+    shared_frac: float = 0.0
+    shared_prefix_len: int = 0
+    n_cohorts: int = 1
+    # ON-OFF burst shape (process="bursty"); duty = on / (on + off)
+    burst_on_s: float = 1.0
+    burst_off_s: float = 1.0
+    timeout_s: Optional[float] = None    # per-request wall deadline
+
+
+@dataclass(frozen=True)
+class ScheduledRequest:
+    t_arrival: float                     # seconds from schedule start
+    tokens: Tuple[int, ...]
+    max_new_tokens: int
+    cohort: Optional[int] = None         # shared-prefix cohort index
+    temperature: float = 0.0
+    timeout_s: Optional[float] = None
+
+
+@dataclass
+class RequestOutcome:
+    """One request's open-loop result, on the duck type slo.py evaluates
+    (finish_reason / ttft_s / latency_s / n_tokens / queue_wait_s)."""
+    req_id: int
+    t_offered: float                     # scheduled arrival (schedule clock)
+    t_submit: float                      # actual submit (s since run start)
+    lateness_s: float                    # t_submit - t_offered (chunk skew)
+    finish_reason: str = "shutdown"
+    n_tokens: int = 0
+    ttft_s: Optional[float] = None
+    queue_wait_s: Optional[float] = None
+    admission_retries: int = 0
+    latency_s: Optional[float] = None    # submit -> retire (engine stamps)
+    tokens_per_sec: Optional[float] = None
+    cohort: Optional[int] = None
+    timeline: Optional[List[dict]] = None
+
+
+@dataclass
+class LoadResult:
+    outcomes: List[RequestOutcome]
+    offered_rate: float                  # empirical: n / last arrival
+    achieved_rate: float                 # completed requests / wall
+    wall_s: float                        # first submit -> all retired
+    lateness_p99_s: float
+
+
+def resolve_seed(seed: Optional[int]) -> int:
+    if seed is not None:
+        return int(seed)
+    return int(os.environ.get("DL4J_TPU_LOADGEN_SEED", "0"))
+
+
+def _draw(rng: np.random.RandomState, mix: LengthMix) -> int:
+    vals = [int(v) for v, _ in mix]
+    # sync-ok: mix weights are python floats from the spec literal
+    w = np.asarray([float(w) for _, w in mix], np.float64)
+    return int(vals[rng.choice(len(vals), p=w / w.sum())])
+
+
+def _arrivals(rng: np.random.RandomState, spec: LoadSpec) -> np.ndarray:
+    if spec.rate <= 0 or spec.n_requests < 1:
+        raise ValueError("rate > 0 and n_requests >= 1 required")
+    if spec.process == "poisson":
+        return np.cumsum(rng.exponential(1.0 / spec.rate,
+                                         size=spec.n_requests))
+    if spec.process == "bursty":
+        duty = spec.burst_on_s / (spec.burst_on_s + spec.burst_off_s)
+        rate_on = spec.rate / duty       # long-run mean stays spec.rate
+        out: List[float] = []
+        t = 0.0
+        while len(out) < spec.n_requests:
+            on_end = t + spec.burst_on_s
+            while len(out) < spec.n_requests:
+                # sync-ok: host RNG draw, never a device buffer
+                t += float(rng.exponential(1.0 / rate_on))
+                if t >= on_end:
+                    break
+                out.append(t)
+            t = on_end + spec.burst_off_s
+        return np.asarray(out)  # sync-ok: host-built arrival list
+    raise ValueError(f"unknown arrival process {spec.process!r}")
+
+
+def build_schedule(spec: LoadSpec) -> List[ScheduledRequest]:
+    """The full arrival schedule as a pure function of (spec, seed): all
+    randomness flows through one seeded RandomState in a fixed draw order,
+    so the same spec + seed reproduces the same schedule exactly."""
+    rng = np.random.RandomState(resolve_seed(spec.seed))
+    arrivals = _arrivals(rng, spec)
+    cohorts: List[Tuple[int, ...]] = []
+    if spec.shared_frac > 0 and spec.shared_prefix_len > 0:
+        cohorts = [tuple(rng.randint(0, spec.vocab,
+                                     size=spec.shared_prefix_len).tolist())
+                   for _ in range(max(1, spec.n_cohorts))]
+    out: List[ScheduledRequest] = []
+    for i in range(spec.n_requests):
+        plen = _draw(rng, spec.prompt_len_mix)
+        mnew = _draw(rng, spec.max_new_tokens_mix)
+        cohort = None
+        # sync-ok: host RNG draw
+        if cohorts and float(rng.uniform()) < spec.shared_frac:
+            cohort = int(rng.randint(len(cohorts)))
+            # fixed prefix + >=1 fresh suffix token: cohort members share
+            # their leading blocks exactly (what COW admission matches on)
+            suffix = rng.randint(0, spec.vocab,
+                                 size=max(1, plen - spec.shared_prefix_len))
+            toks = cohorts[cohort] + tuple(suffix.tolist())
+        else:
+            toks = tuple(rng.randint(0, spec.vocab, size=plen).tolist())
+        # sync-ok: arrivals is a host numpy array built above
+        out.append(ScheduledRequest(float(arrivals[i]), toks, mnew,
+                                    cohort=cohort,
+                                    temperature=spec.temperature,
+                                    timeout_s=spec.timeout_s))
+    return out
+
+
+def run(engine, schedule: Sequence[ScheduledRequest]) -> LoadResult:
+    """Open-loop run: submit each scheduled request once the wall clock
+    passes its arrival time, drive `engine.step()` in between, and return
+    per-request outcomes built from the engine's lifecycle timestamps."""
+    n = len(schedule)
+    outs: List[Optional[RequestOutcome]] = [None] * n
+    futs: List[Optional[object]] = [None] * n
+    t0 = time.monotonic()
+    i = 0
+    busy = True
+    while i < n or busy:
+        now = time.monotonic() - t0
+        while i < n and schedule[i].t_arrival <= now:
+            sr = schedule[i]
+            t_sub = time.monotonic() - t0
+            futs[i] = engine.submit(Request(
+                list(sr.tokens), max_new_tokens=sr.max_new_tokens,
+                temperature=sr.temperature, timeout_s=sr.timeout_s))
+            outs[i] = RequestOutcome(
+                req_id=-1, t_offered=sr.t_arrival, t_submit=t_sub,
+                lateness_s=t_sub - sr.t_arrival, cohort=sr.cohort)
+            i += 1
+            now = time.monotonic() - t0
+        busy = engine.step()
+        if not busy and i < n:
+            wait = schedule[i].t_arrival - (time.monotonic() - t0)
+            if wait > 0:                 # idle engine: nap until the next
+                time.sleep(min(wait, 0.002))   # arrival, in small slices
+    wall_s = time.monotonic() - t0
+    n_done = 0
+    lateness: List[float] = []
+    for k, fut in enumerate(futs):
+        res = fut.get(timeout=0)         # engine idle => all resolved
+        o = outs[k]
+        o.req_id = res.req_id
+        o.finish_reason = res.finish_reason
+        o.n_tokens = len(res.tokens)
+        o.ttft_s = res.ttft_s
+        o.queue_wait_s = res.queue_wait_s
+        o.admission_retries = res.admission_retries
+        o.tokens_per_sec = res.tokens_per_sec
+        o.timeline = res.timeline
+        if res.timeline:
+            o.latency_s = (max(e["t1"] for e in res.timeline)
+                           - min(e["t0"] for e in res.timeline))
+        if res.finish_reason in ("eos", "length"):
+            n_done += 1
+        lateness.append(o.lateness_s)
+    offered = n / max(schedule[-1].t_arrival, 1e-9) if n else 0.0
+    # sync-ok: lateness is a host list of wall-clock deltas
+    p99 = float(np.percentile(np.asarray(lateness), 99)) if lateness else 0.0
+    return LoadResult(outcomes=[o for o in outs if o is not None],
+                      offered_rate=offered,
+                      achieved_rate=n_done / max(wall_s, 1e-9),
+                      wall_s=wall_s, lateness_p99_s=p99)
+
+
+def run_spec(engine, spec: LoadSpec) -> LoadResult:
+    """Convenience: build the schedule and run it."""
+    return run(engine, build_schedule(spec))

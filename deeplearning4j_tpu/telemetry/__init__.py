@@ -30,6 +30,13 @@ Env toggles:
   `profiler.maybe_capture()` regions write a device trace there and merge
   it with this tracer's timeline into one Perfetto view. Unset/0 keeps the
   profiling call sites inert (default).
+- DL4J_TPU_FLIGHT_RECORDER=1 attaches a default flight recorder
+  (flight_recorder.py, ISSUE 8) to every new ServingEngine: it retains
+  lifecycle timelines for the worst-TTFT / SLO-violating requests and
+  dumps them as Perfetto JSON on demand. Off by default.
+- DL4J_TPU_LOADGEN_SEED seeds serving/loadgen.py arrival schedules when
+  no explicit seed is passed (default 0 — schedules are deterministic
+  either way).
 """
 from __future__ import annotations
 
@@ -48,7 +55,7 @@ __all__ = [
     "DEFAULT_MS_BUCKETS", "DEFAULT_S_BUCKETS", "registry", "tracer", "span",
     "instant", "enabled", "configure", "maybe_export_trace", "metrics_route",
     "PROMETHEUS_CONTENT_TYPE", "sanitize_component", "health", "profiler",
-    "memory",
+    "memory", "slo", "flight_recorder",
 ]
 
 from deeplearning4j_tpu.telemetry.registry import sanitize_component  # noqa: E402,F401
@@ -57,8 +64,10 @@ from deeplearning4j_tpu.telemetry.registry import sanitize_component  # noqa: E4
 def __getattr__(name):
     # health (ISSUE 5) / profiler / memory (ISSUE 6) import jax (lazily in
     # the ISSUE 6 pair's case, but profiler also pulls util.costs) — loaded
-    # on first attribute access so registry/tracing users stay jax-free
-    if name in ("health", "profiler", "memory"):
+    # on first attribute access so registry/tracing users stay jax-free.
+    # slo / flight_recorder (ISSUE 8) are jax-free but rarely needed, so
+    # they load lazily too
+    if name in ("health", "profiler", "memory", "slo", "flight_recorder"):
         import importlib
         return importlib.import_module(
             f"deeplearning4j_tpu.telemetry.{name}")

@@ -1,0 +1,200 @@
+"""Tail-latency flight recorder (ISSUE 8).
+
+p99 regressions are useless without the offending requests' own story:
+by the time a dashboard shows the tail moved, the requests that moved it
+are gone. The flight recorder keeps exactly those post-mortems: a BOUNDED
+buffer retaining full lifecycle timelines (GenerationResult.timeline —
+queue -> admission -> prefill -> per-chunk decode -> retire, built by
+serving/engine.py from timestamps the scheduler already takes) ONLY for
+
+- requests that VIOLATED the configured SLO (telemetry/slo.py), kept in a
+  FIFO ring of `capacity`, and
+- the `worst_k` worst-TTFT requests seen so far regardless of verdict
+  (so a recorder with no SLO, or a run where nothing violates, still
+  explains its own tail),
+
+and dumps them as a Perfetto/Chrome-trace JSON (`dump()` / `perfetto()`),
+one track per request, on demand. Recording happens at retirement and is
+pure host list bookkeeping — zero added device syncs, bit-parity-tested
+against recorder-off in tests/test_flight_recorder.py.
+
+Enable on an engine via `ServingEngine(..., flight_recorder=FlightRecorder(...))`
+or `DL4J_TPU_FLIGHT_RECORDER=1` (default-config recorder).
+
+stdlib-only on purpose: importable (like registry/tracing) without jax.
+"""
+from __future__ import annotations
+
+import heapq
+import json
+import math
+from collections import deque
+from types import SimpleNamespace
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.telemetry.slo import SLO, request_attains
+
+
+# --------------------------------------------------------------- timelines
+def coverage(timeline: Sequence[dict]) -> Optional[Tuple[float, float]]:
+    """(earliest t0, latest t1) across the timeline's events."""
+    if not timeline:
+        return None
+    return (min(ev["t0"] for ev in timeline),
+            max(ev["t1"] for ev in timeline))
+
+
+def max_gap_s(timeline: Sequence[dict]) -> float:
+    """Largest uncovered hole between a timeline's merged event intervals
+    (0.0 for gap-free coverage). The engine's acceptance bar: no gap may
+    exceed the chunk period — a bigger hole means some phase of the
+    request's life went unrecorded."""
+    if len(timeline) < 2:
+        return 0.0
+    ivs = sorted((ev["t0"], ev["t1"]) for ev in timeline)
+    worst, end = 0.0, ivs[0][1]
+    for t0, t1 in ivs[1:]:
+        if t0 > end:
+            worst = max(worst, t0 - end)
+        end = max(end, t1)
+    return worst
+
+
+def _outcome_view(result) -> SimpleNamespace:
+    """Adapt a GenerationResult-shaped object to the slo.py outcome duck
+    type (latency/n_tokens derived from the timeline/token list)."""
+    cov = coverage(getattr(result, "timeline", ()) or ())
+    toks = getattr(result, "tokens", None) or []
+    return SimpleNamespace(
+        finish_reason=getattr(result, "finish_reason", None),
+        ttft_s=getattr(result, "ttft_s", None),
+        latency_s=(cov[1] - cov[0]) if cov else None,
+        n_tokens=len(toks),
+        queue_wait_s=getattr(result, "queue_wait_s", None))
+
+
+class FlightRecorder:
+    """Bounded retention of worst-case request timelines + Perfetto dump.
+
+    capacity: ring size for SLO-violating requests (FIFO eviction).
+    worst_k:  how many worst-TTFT requests to retain regardless of SLO.
+    slo:      optional telemetry.slo.SLO; None disables the violation ring
+              (worst-TTFT retention still runs).
+    """
+
+    def __init__(self, capacity: int = 64, worst_k: int = 8,
+                 slo: Optional[SLO] = None):
+        if capacity < 1 or worst_k < 0:
+            raise ValueError("capacity >= 1 and worst_k >= 0 required")
+        self.capacity = int(capacity)
+        self.worst_k = int(worst_k)
+        self.slo = slo
+        self._violators: deque = deque(maxlen=self.capacity)
+        # min-heap of (ttft_key, tiebreak, record): the root is the LEAST
+        # bad retained request, evicted when a worse one arrives
+        self._worst: List[tuple] = []
+        self._seq = 0
+        self.n_seen = 0
+        self.n_violations = 0
+
+    # ----------------------------------------------------------- recording
+    def record(self, result) -> bool:
+        """Offer one finished request (GenerationResult-shaped). Returns
+        True iff its timeline was retained."""
+        self.n_seen += 1
+        self._seq += 1
+        ttft = getattr(result, "ttft_s", None)
+        # never-admitted requests (queue timeout/shutdown) have no TTFT —
+        # for tail ranking they are worse than any finite TTFT
+        # sync-ok: ttft_s is a host wall-clock delta on the result
+        key = math.inf if ttft is None else float(ttft)
+        rec = {"req_id": getattr(result, "req_id", -1),
+               "ttft_s": ttft,
+               "queue_wait_s": getattr(result, "queue_wait_s", None),
+               "admission_retries": getattr(result, "admission_retries", 0),
+               "finish_reason": getattr(result, "finish_reason", None),
+               "n_tokens": len(getattr(result, "tokens", None) or []),
+               "timeline": list(getattr(result, "timeline", ()) or ())}
+        kept = False
+        if self.slo is not None and \
+                not request_attains(_outcome_view(result), self.slo):
+            self.n_violations += 1
+            self._violators.append(rec)
+            kept = True
+        if self.worst_k:
+            item = (key, self._seq, rec)
+            if len(self._worst) < self.worst_k:
+                heapq.heappush(self._worst, item)
+                kept = True
+            elif item[:2] > self._worst[0][:2]:
+                heapq.heappushpop(self._worst, item)
+                kept = True
+        return kept
+
+    # ------------------------------------------------------------- queries
+    def records(self) -> List[dict]:
+        """Retained records, deduplicated (a request can be both a violator
+        and a worst-TTFT holder), worst TTFT first."""
+        by_id: Dict[int, dict] = {}
+        for rec in list(self._violators) + [it[2] for it in self._worst]:
+            by_id[rec["req_id"]] = rec
+        inf = math.inf
+        return sorted(by_id.values(),
+                      key=lambda r: (-(inf if r["ttft_s"] is None
+                                       else r["ttft_s"]), r["req_id"]))
+
+    def worst(self, n: int = 1) -> List[dict]:
+        """The n worst-TTFT retained records."""
+        return self.records()[:n]
+
+    # ------------------------------------------------------------- perfetto
+    def perfetto(self) -> Dict[str, object]:
+        """Chrome-trace/Perfetto JSON object: one pid for the recorder, one
+        tid (track) per retained request, "X" complete events per lifecycle
+        phase (ts/dur in µs, re-based to the earliest retained timestamp)
+        and an "i" instant for retirement."""
+        recs = self.records()
+        t0s = [cov[0] for rec in recs
+               for cov in (coverage(rec["timeline"]),) if cov]
+        epoch = min(t0s) if t0s else 0.0
+        ev: List[dict] = [{"ph": "M", "pid": 1, "name": "process_name",
+                           "args": {"name": "serving flight recorder"}}]
+        for rec in recs:
+            rid = rec["req_id"]
+            ttft = rec["ttft_s"]
+            label = (f"req {rid} ({rec['finish_reason']}, ttft "
+                     + (f"{ttft * 1e3:.1f}ms" if ttft is not None else "n/a")
+                     + ")")
+            ev.append({"ph": "M", "pid": 1, "tid": rid,
+                       "name": "thread_name", "args": {"name": label}})
+            for e in rec["timeline"]:
+                args = {k: v for k, v in e.items()
+                        if k not in ("phase", "t0", "t1")}
+                args["req"] = rid
+                base = {"pid": 1, "tid": rid, "name": e["phase"],
+                        "cat": "request",
+                        "ts": round((e["t0"] - epoch) * 1e6, 3)}
+                dur = e["t1"] - e["t0"]
+                if dur <= 0:             # zero-width (e.g. queue-timeout
+                    ev.append({**base, "ph": "i", "s": "t",  # retirement)
+                               "args": args})
+                else:
+                    ev.append({**base, "ph": "X",
+                               "dur": round(dur * 1e6, 3), "args": args})
+        return {"traceEvents": ev, "displayTimeUnit": "ms",
+                "otherData": {"n_seen": self.n_seen,
+                              "n_violations": self.n_violations,
+                              "slo": None if self.slo is None
+                              else {"ttft_s": self.slo.ttft_s,
+                                    "tpot_s": self.slo.tpot_s}}}
+
+    def dump(self, path: str) -> str:
+        """Write the Perfetto JSON to `path`; returns the path."""
+        with open(path, "w") as f:
+            json.dump(self.perfetto(), f)
+        return path
+
+    def clear(self) -> None:
+        self._violators.clear()
+        self._worst.clear()
+        self.n_seen = self.n_violations = 0

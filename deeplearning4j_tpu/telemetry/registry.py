@@ -213,7 +213,20 @@ class MetricsRegistry:
     def prometheus_text(self) -> str:
         """Prometheus text exposition (version 0.0.4) over this registry and
         its live children. Same-named counters and histogram buckets sum
-        across registries; gauges take the last value seen."""
+        across registries; gauges take the last value seen.
+
+        Format audit (ISSUE 8 satellite, round-trip-tested against a
+        reference parse in tests/test_telemetry.py): histogram `_bucket`
+        lines are CUMULATIVE counts with a terminal `+Inf` bucket whose
+        value equals `_count`, `_sum` is the raw observation sum, HELP text
+        escapes `\\` and newlines per the format spec, and a name that
+        collides across registries with DIFFERENT metric types exposes only
+        the instances matching the first-seen type (a mixed family would be
+        unparseable). A same-name histogram whose bucket BOUNDS differ from
+        the first-seen instance is likewise excluded from the family's
+        buckets, `_sum` AND `_count` (partial aggregation would desync
+        `+Inf` from `_count`); callers should register shared-named
+        histograms with identical bounds."""
         families: Dict[str, List[object]] = {}
         for reg in self._all_registries():
             for name, m in list(reg._metrics.items()):
@@ -223,19 +236,16 @@ class MetricsRegistry:
             ms = families[name]
             pname = _sanitize(name)
             first = ms[0]
+            ms = [m for m in ms if type(m) is type(first)]
+            if first.help:
+                lines.append(f"# HELP {pname} {_escape_help(first.help)}")
             if isinstance(first, Counter):
-                if first.help:
-                    lines.append(f"# HELP {pname} {first.help}")
                 lines.append(f"# TYPE {pname} counter")
                 lines.append(f"{pname} {sum(m.value for m in ms)}")
             elif isinstance(first, Gauge):
-                if first.help:
-                    lines.append(f"# HELP {pname} {first.help}")
                 lines.append(f"# TYPE {pname} gauge")
                 lines.append(f"{pname} {_fmt(ms[-1].value)}")
             elif isinstance(first, Histogram):
-                if first.help:
-                    lines.append(f"# HELP {pname} {first.help}")
                 lines.append(f"# TYPE {pname} histogram")
                 bounds = first.bounds
                 totals = np.zeros(len(bounds) + 1, np.int64)
@@ -282,6 +292,13 @@ def _sanitize(name: str) -> str:
     if out and out[0].isdigit():
         out = "_" + out
     return out
+
+
+def _escape_help(text: str) -> str:
+    """HELP-line escaping per the text exposition format: backslash and
+    line feed only (label-value escaping additionally covers quotes, but
+    HELP text is unquoted)."""
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
 
 
 def _fmt(v: float) -> str:

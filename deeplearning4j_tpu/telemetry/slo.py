@@ -1,0 +1,157 @@
+"""Goodput-under-SLO evaluation for serving (ISSUE 8).
+
+Raw tokens/sec is the wrong headline for a serving system: a saturated
+engine can post high throughput while every request blows its latency
+budget. The honest metric is GOODPUT — completed requests per second that
+MEET the SLO — measured under OPEN-LOOP load (arrivals keep coming at the
+offered rate whether or not the engine keeps up; see serving/loadgen.py),
+because closed-loop clients self-throttle and hide queueing collapse.
+
+An `SLO` is a per-request budget with two components:
+- `ttft_s`: submit -> first token must not exceed this (the p99 of TTFT
+  over a run is gated against the same number, hence "TTFT-p99 budget");
+- `tpot_s`: time-per-output-token over the decode span (total latency
+  minus TTFT, divided by tokens after the first) must not exceed this.
+
+`evaluate()` turns a list of per-request outcomes + the observation wall
+into one report; `attainment_curve()` sweeps offered rates; and
+`max_sustainable_rate()` bisects for the highest offered rate whose
+attained fraction still clears a target — the capacity number a deploy
+should be sized against.
+
+Everything here is post-hoc host arithmetic over timestamps the engine
+already took: stdlib + numpy only, no jax import, zero device syncs.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class SLO:
+    """Per-request latency budget. A request ATTAINS the SLO iff it
+    completed normally (eos/length), its TTFT is within `ttft_s`, and its
+    decode time-per-output-token is within `tpot_s`."""
+    ttft_s: float
+    tpot_s: float
+
+    def describe(self) -> str:
+        return f"ttft<={self.ttft_s:.3g}s, tpot<={self.tpot_s:.3g}s"
+
+
+#: finish reasons that count as a completed (servable) request
+_OK_REASONS = ("eos", "length")
+
+
+def request_tpot_s(outcome) -> Optional[float]:
+    """Decode time-per-output-token: (latency - ttft) / (n_tokens - 1).
+    None when the request produced <= 1 token (no decode span) — such
+    requests are judged on TTFT alone."""
+    n = getattr(outcome, "n_tokens", None)
+    lat = getattr(outcome, "latency_s", None)
+    ttft = getattr(outcome, "ttft_s", None)
+    if n is None or lat is None or ttft is None or n <= 1:
+        return None
+    return max(0.0, lat - ttft) / (n - 1)
+
+
+def request_attains(outcome, slo: SLO) -> bool:
+    """SLO verdict for one outcome (duck-typed: needs .finish_reason,
+    .ttft_s, .latency_s, .n_tokens)."""
+    if getattr(outcome, "finish_reason", None) not in _OK_REASONS:
+        return False
+    ttft = getattr(outcome, "ttft_s", None)
+    if ttft is None or ttft > slo.ttft_s:
+        return False
+    tpot = request_tpot_s(outcome)
+    return tpot is None or tpot <= slo.tpot_s
+
+
+def _pct(vals: List[float], q: float) -> Optional[float]:
+    if not vals:
+        return None
+    # sync-ok: vals are host floats pulled off finished outcomes
+    return float(np.percentile(np.asarray(vals, np.float64), q))
+
+
+def evaluate(outcomes: Sequence, slo: SLO, wall_s: float,
+             offered_rate: Optional[float] = None) -> Dict[str, object]:
+    """One SLO report over a run's per-request outcomes.
+
+    `wall_s` is the observation window (first submit -> last retire) the
+    rates are normalized by; `offered_rate` (req/s) is echoed through when
+    the caller knows it (open-loop runs do).
+    """
+    wall_s = max(float(wall_s), 1e-9)  # sync-ok: host wall-clock value
+    n = len(outcomes)
+    ok = [o for o in outcomes
+          if getattr(o, "finish_reason", None) in _OK_REASONS]
+    attained = [o for o in outcomes if request_attains(o, slo)]
+    ttfts = [o.ttft_s for o in ok if getattr(o, "ttft_s", None) is not None]
+    tpots = [t for t in (request_tpot_s(o) for o in ok) if t is not None]
+    qwaits = [o.queue_wait_s for o in ok
+              if getattr(o, "queue_wait_s", None) is not None]
+    return {
+        "n_requests": n,
+        "n_completed": len(ok),
+        "n_attained": len(attained),
+        "wall_s": wall_s,
+        "offered_rate": offered_rate,
+        "throughput": len(ok) / wall_s,        # completed req/s, SLO-blind
+        "goodput": len(attained) / wall_s,     # req/s MEETING the SLO
+        "slo_attained_frac": len(attained) / n if n else 0.0,
+        "ttft_p50_s": _pct(ttfts, 50), "ttft_p99_s": _pct(ttfts, 99),
+        "tpot_p50_s": _pct(tpots, 50), "tpot_p99_s": _pct(tpots, 99),
+        "queue_wait_p99_s": _pct(qwaits, 99),
+        "slo": {"ttft_s": slo.ttft_s, "tpot_s": slo.tpot_s},
+    }
+
+
+RunFn = Callable[[float], Tuple[Sequence, float]]
+#: run_at_rate(offered_rate) -> (outcomes, wall_s): execute one open-loop
+#: run at the offered rate and return its outcomes + observation wall
+
+
+def attainment_curve(run_at_rate: RunFn, rates: Sequence[float],
+                     slo: SLO) -> List[Dict[str, object]]:
+    """Goodput/attainment vs offered load: one `evaluate()` report per
+    offered rate, in the given order (ascending rates read best)."""
+    curve = []
+    for rate in rates:
+        outcomes, wall_s = run_at_rate(rate)
+        curve.append(evaluate(outcomes, slo, wall_s, offered_rate=rate))
+    return curve
+
+
+def max_sustainable_rate(run_at_rate: RunFn, slo: SLO, *,
+                         lo: float, hi: float, target_frac: float = 0.9,
+                         iters: int = 4) -> Dict[str, object]:
+    """Bisect for the highest offered rate whose attained fraction still
+    reaches `target_frac`. `lo` should be a rate known (or expected) to
+    attain; `hi` one expected to violate — the bracket is probed first and
+    widened conclusions are NOT drawn beyond it. Each probe is one full
+    open-loop run, so keep `iters` small; the answer is the last attaining
+    rate with resolution (hi-lo)/2^iters."""
+    reports: List[Dict[str, object]] = []
+
+    def probe(rate: float) -> bool:
+        outcomes, wall_s = run_at_rate(rate)
+        rep = evaluate(outcomes, slo, wall_s, offered_rate=rate)
+        reports.append(rep)
+        return rep["slo_attained_frac"] >= target_frac
+
+    best = lo if probe(lo) else None
+    if best is not None and probe(hi):
+        best = hi                       # whole bracket attains
+    elif best is not None:
+        for _ in range(iters):
+            mid = (lo + hi) / 2.0
+            if probe(mid):
+                best, lo = mid, mid
+            else:
+                hi = mid
+    return {"max_sustainable_rate": best, "target_frac": target_frac,
+            "probes": reports}

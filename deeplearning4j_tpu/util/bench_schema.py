@@ -9,7 +9,10 @@ runs say so via `skipped_reason` instead of vanishing), and the
 auto-generated `roofline_table` rows are well-formed. ISSUE 7 adds
 `decode_prefix_share` (the shared-prefix A/B — CPU-runnable, so it is
 always present and, when measured, must carry the savings fields the
-docs render). bench.py calls
+docs render). ISSUE 8 adds `serving_slo` (the open-loop goodput/SLO
+observatory — also CPU-runnable and always present; measured entries
+must carry offered_rate/goodput/ttft_p99_s/slo_attained_frac/seed/
+platform plus a well-formed attainment curve). bench.py calls
 `assert_valid` on the dict it is about to print, and
 tests/test_bench_schema.py re-validates the committed artifact, so the
 contract holds at write time and at review time.
@@ -25,7 +28,7 @@ TOP_KEYS = ("metric", "value", "unit", "vs_baseline", "extra")
 # Any dict entry holding one of these keys counts as a measurement.
 _MEASUREMENT_KEYS = ("images_per_sec", "tokens_per_sec", "samples_per_sec",
                      "ms_per_iter", "decode_tokens_per_sec",
-                     "ms_per_iter_health_on")
+                     "ms_per_iter_health_on", "goodput")
 
 _ROOFLINE_ROW_REQ = ("function", "platform", "flops", "mxu_floor_ms",
                      "measured_ms", "calls")
@@ -93,6 +96,40 @@ def validate_artifact(art: dict) -> List[str]:
             errs.append("extra['decode_prefix_share'].admission_capacity "
                         "must carry numeric resident_seqs_max and "
                         "slot_equivalent_ceiling")
+
+    # serving SLO observatory (ISSUE 8): the reduced-config open-loop run
+    # is CPU-runnable, so the entry must always exist; when measured it
+    # must carry the headline goodput fields plus an attainment curve of
+    # well-formed rate points (the docs render both)
+    ss = e.get("serving_slo")
+    if not isinstance(ss, dict):
+        errs.append("extra['serving_slo'] missing or not a dict (the "
+                    "open-loop SLO bench runs on any platform — emit "
+                    "error/skipped entries rather than dropping it)")
+    elif "error" not in ss and "skipped_reason" not in ss:
+        for k in ("offered_rate", "goodput", "ttft_p99_s",
+                  "slo_attained_frac", "seed"):
+            if not _is_num(ss.get(k)):
+                errs.append(f"extra['serving_slo'].{k} missing or not a "
+                            "number")
+        if not isinstance(ss.get("platform"), str):
+            errs.append("extra['serving_slo'] has no 'platform' label")
+        frac = ss.get("slo_attained_frac")
+        if _is_num(frac) and not 0 <= frac <= 1:
+            errs.append(f"extra['serving_slo'].slo_attained_frac {frac!r} "
+                        "outside [0, 1]")
+        curve = ss.get("attainment")
+        if not isinstance(curve, list) or not curve:
+            errs.append("extra['serving_slo'].attainment missing or empty "
+                        "(goodput-vs-offered-load curve)")
+        else:
+            for i, row in enumerate(curve):
+                if not isinstance(row, dict) or not all(
+                        _is_num(row.get(k)) for k in
+                        ("offered_rate", "goodput", "slo_attained_frac")):
+                    errs.append(f"serving_slo.attainment[{i}] must carry "
+                                "numeric offered_rate/goodput/"
+                                "slo_attained_frac")
 
     # every measurement dict carries a platform label
     for name, entry in e.items():

@@ -69,6 +69,57 @@ def _roofline_table_lines(table) -> list:
     return lines
 
 
+def _serving_slo_lines(ss) -> list:
+    """Goodput/attainment section from extra['serving_slo'] (ISSUE 8): the
+    open-loop SLO observatory's headline plus the goodput-vs-offered-load
+    table, generated — like every other number here — from the artifact."""
+    if not isinstance(ss, dict) or ss.get("goodput") is None:
+        if isinstance(ss, dict) and ss.get("skipped_reason"):
+            return [f"- Serving SLO observatory: {ss['skipped_reason']} "
+                    f"(platform: {ss.get('platform', '?')})."]
+        return []
+    slo = ss.get("slo") or {}
+    lines = [
+        f"- Serving SLO observatory (ISSUE 8, open-loop, "
+        f"{ss.get('platform', '?')}): best goodput "
+        f"**{ss['goodput']:,.1f} req/s meeting SLO** at "
+        f"{ss['offered_rate']:,.1f} req/s offered "
+        f"(attained {ss['slo_attained_frac']:.0%}, TTFT p99 "
+        f"{ss['ttft_p99_s'] * 1e3:.1f} ms); bisected max sustainable rate "
+        + (f"{ss['max_sustainable_rate']:,.1f} req/s"
+           if ss.get("max_sustainable_rate") is not None else "n/a")
+        + f" at >={ss.get('msr_target_frac', 0.9):.0%} attainment. "
+        f"Budgets TTFT<={slo.get('ttft_s', 0) * 1e3:.1f} ms, "
+        f"TPOT<={slo.get('tpot_s', 0) * 1e3:.1f} ms "
+        f"({slo.get('calibration', 'calibrated')}); seeded Poisson "
+        f"arrivals (seed={ss.get('seed')}), open-loop — see PERF.md "
+        f"\"Goodput & SLO methodology\".",
+        "",
+        "| offered req/s | throughput | goodput | SLO attained "
+        "| TTFT p99 ms | queue p99 ms |",
+        "|---:|---:|---:|---:|---:|---:|",
+    ]
+    for row in ss.get("attainment") or []:
+        q = row.get("queue_wait_p99_s")
+        lines.append(
+            f"| {row['offered_rate']:,.1f} | {row.get('throughput', 0):,.1f} "
+            f"| {row['goodput']:,.1f} | {row['slo_attained_frac']:.0%} "
+            f"| {row.get('ttft_p99_s', 0) * 1e3:.1f} "
+            f"| {'n/a' if q is None else f'{q * 1e3:.1f}'} |")
+    fr = ss.get("flight_recorder") or {}
+    if fr.get("retained"):
+        lines.append(
+            f"\n  Flight recorder: {fr['retained']} worst/violating "
+            f"timelines retained of {fr.get('n_seen', '?')} seen "
+            f"({fr.get('n_violations', 0)} SLO violations); worst TTFT "
+            + (f"{fr['worst_ttft_s'] * 1e3:.1f} ms"
+               if fr.get("worst_ttft_s") is not None else "n/a")
+            + f", lifecycle coverage gap max {fr.get('max_gap_ms', 0):.2f} ms"
+            f" (chunk period {fr.get('chunk_period_ms', 0):.1f} ms) — "
+            f"Perfetto dump validated in-bench.")
+    return lines
+
+
 def render_block(art: dict) -> str:
     """Markdown bullet block rendered VERBATIM into README.md and PERF.md."""
     e = art["extra"]
@@ -219,6 +270,7 @@ def render_block(art: dict) -> str:
                 f"short sequences vs a slot-equivalent ceiling of "
                 f"{cap.get('slot_equivalent_ceiling', '?')}.")
         lines.append(line)
+    lines.extend(_serving_slo_lines(e.get("serving_slo")))
     lines.extend(_roofline_table_lines(e.get("roofline_table")))
     lines.append(
         f"- ParallelWrapper ResNet50: {pw['images_per_sec']:,.0f} img/s — "

@@ -31,6 +31,17 @@ def _minimal_art():
                 "kv_bytes_saved": 73728, "ttft_sharer_delta_ms": 0.1,
                 "admission_capacity": {"resident_seqs_max": 4,
                                        "slot_equivalent_ceiling": 2}},
+            "serving_slo": {
+                "platform": "cpu", "seed": 0, "offered_rate": 200.0,
+                "goodput": 100.0, "ttft_p99_s": 0.05,
+                "slo_attained_frac": 0.8,
+                "attainment": [
+                    {"offered_rate": 50.0, "goodput": 50.0,
+                     "slo_attained_frac": 1.0},
+                    {"offered_rate": 100.0, "goodput": 95.0,
+                     "slo_attained_frac": 0.95},
+                    {"offered_rate": 200.0, "goodput": 100.0,
+                     "slo_attained_frac": 0.8}]},
             "roofline_table": [
                 {"function": "train_step", "platform": "tpu",
                  "flops": 1e12, "bytes_accessed": 1e9,
@@ -93,6 +104,45 @@ def test_prefix_share_ab_rules():
     assert validate_artifact(art) == []
 
 
+def test_serving_slo_rules():
+    """ISSUE 8: the open-loop SLO entry must always exist; a measured entry
+    needs the headline goodput fields, a platform label, a sane attained
+    fraction, and a non-empty well-formed attainment curve."""
+    art = _minimal_art()
+    del art["extra"]["serving_slo"]
+    assert any("serving_slo" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["serving_slo"]["goodput"]
+    assert any("goodput" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["serving_slo"]["platform"]
+    assert any("serving_slo" in e and "platform" in e
+               for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["serving_slo"]["slo_attained_frac"] = 1.4
+    assert any("outside [0, 1]" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["serving_slo"]["attainment"] = []
+    assert any("attainment" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["serving_slo"]["attainment"][1] = {"offered_rate": 1.0}
+    assert any("attainment[1]" in e for e in validate_artifact(art))
+    # skipped / errored entries are exempt from the measured-field rules
+    art = _minimal_art()
+    art["extra"]["serving_slo"] = {"platform": "cpu",
+                                   "skipped_reason": "why not"}
+    assert validate_artifact(art) == []
+    art["extra"]["serving_slo"] = {"error": "ValueError: boom"}
+    assert validate_artifact(art) == []
+
+
+def test_goodput_dict_is_a_measurement_needing_platform():
+    art = _minimal_art()
+    art["extra"]["some_slo_thing"] = {"goodput": 5.0}
+    assert any("some_slo_thing" in e and "platform" in e
+               for e in validate_artifact(art))
+
+
 def test_measurement_dict_requires_platform_label():
     art = _minimal_art()
     del art["extra"]["resnet50_bf16"]["platform"]
@@ -146,3 +196,14 @@ def test_committed_artifact_passes_schema():
     assert any(f.startswith("train_step") for f in fns)
     assert any(f.startswith("prefill_b") for f in fns)
     assert any(f.startswith("decode_chunk_k") for f in fns)
+    # ISSUE 8: the committed artifact carries a measured serving_slo entry
+    # with an attainment curve of >= 3 offered-rate points and a validated
+    # flight-recorder summary
+    ss = e["serving_slo"]
+    assert "error" not in ss and "skipped_reason" not in ss
+    assert len(ss["attainment"]) >= 3
+    rates = [row["offered_rate"] for row in ss["attainment"]]
+    assert rates == sorted(rates) and rates[0] < rates[-1]
+    assert ss["flight_recorder"]["perfetto_valid"] is True
+    assert ss["full_sweep"].get("skipped_reason") or \
+        ss["full_sweep"].get("goodput") is not None

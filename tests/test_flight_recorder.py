@@ -1,0 +1,247 @@
+"""Tail-latency flight recorder + lifecycle timeline tests (ISSUE 8).
+
+The recorder layer (retention policy, Perfetto dump, coverage/gap math)
+is tested on synthetic results; the engine layer verifies the lifecycle
+timeline every GenerationResult now carries (queue -> admission ->
+prefill -> decode chunks -> retire, gap-free), the queue_wait_s /
+admission_retries satellite fields, and the hard invariant: a recorder
+adds ZERO host syncs (bit-parity on host_syncs_per_token recorder-on vs
+recorder-off).
+"""
+import json
+
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.serving import Request, ServingEngine
+from deeplearning4j_tpu.serving.engine import GenerationResult
+from deeplearning4j_tpu.telemetry.flight_recorder import (FlightRecorder,
+                                                          coverage,
+                                                          max_gap_s)
+from deeplearning4j_tpu.telemetry.slo import SLO
+from tests.test_telemetry import _build_net
+
+
+def _result(req_id, ttft=0.01, reason="eos", n=4, t0=0.0):
+    tl = [{"phase": "queue", "t0": t0, "t1": t0 + 0.001},
+          {"phase": "admission", "t0": t0 + 0.001, "t1": t0 + 0.002},
+          {"phase": "prefill", "t0": t0 + 0.002, "t1": t0 + ttft},
+          {"phase": "decode_chunk", "t0": t0 + ttft, "t1": t0 + ttft + 0.02,
+           "k": 4, "tokens": n},
+          {"phase": "retire", "t0": t0 + ttft + 0.02,
+           "t1": t0 + ttft + 0.021, "reason": reason, "tokens": n}]
+    return GenerationResult(tokens=list(range(n)), logprobs=None,
+                            prompt_len=3,
+                            finish_reason=reason, ttft_s=ttft,
+                            req_id=req_id, queue_wait_s=0.001, timeline=tl)
+
+
+# ----------------------------------------------------------- timeline math
+def test_coverage_and_max_gap():
+    tl = _result(0).timeline
+    lo, hi = coverage(tl)
+    assert lo == 0.0 and hi == pytest.approx(0.031)
+    assert max_gap_s(tl) == 0.0                   # contiguous
+    assert coverage([]) is None and max_gap_s([]) == 0.0
+    # punch a hole: drop prefill -> gap = admission end .. decode start
+    holey = [e for e in tl if e["phase"] != "prefill"]
+    assert max_gap_s(holey) == pytest.approx(0.008)
+    # overlapping events never count as gaps
+    over = [{"phase": "a", "t0": 0.0, "t1": 0.5},
+            {"phase": "b", "t0": 0.2, "t1": 0.4},
+            {"phase": "c", "t0": 0.45, "t1": 0.6}]
+    assert max_gap_s(over) == 0.0
+
+
+# ------------------------------------------------------------- retention
+def test_worst_k_retention_without_slo():
+    fr = FlightRecorder(capacity=4, worst_k=2, slo=None)
+    for i, ttft in enumerate([0.01, 0.05, 0.02, 0.09, 0.001]):
+        fr.record(_result(i, ttft=ttft))
+    assert fr.n_seen == 5 and fr.n_violations == 0
+    recs = fr.records()
+    assert [r["req_id"] for r in recs] == [3, 1]  # two worst TTFTs, desc
+    assert fr.worst(1)[0]["ttft_s"] == 0.09
+
+
+def test_violation_ring_evicts_fifo():
+    slo = SLO(ttft_s=0.02, tpot_s=10.0)
+    fr = FlightRecorder(capacity=2, worst_k=0, slo=slo)
+    for i, ttft in enumerate([0.01, 0.05, 0.06, 0.07]):
+        fr.record(_result(i, ttft=ttft))
+    assert fr.n_violations == 3
+    # ring of 2 keeps the two NEWEST violators (req 1 evicted)
+    assert {r["req_id"] for r in fr.records()} == {2, 3}
+
+
+def test_none_ttft_ranks_worst_and_dedup():
+    slo = SLO(ttft_s=0.02, tpot_s=10.0)
+    fr = FlightRecorder(capacity=8, worst_k=8, slo=slo)
+    fr.record(_result(0, ttft=0.5))               # violator AND worst-TTFT
+    never = GenerationResult(tokens=[], prompt_len=3,
+                             finish_reason="timeout",
+                             req_id=1,
+                             timeline=[{"phase": "queue", "t0": 0.0,
+                                        "t1": 1.0},
+                                       {"phase": "retire", "t0": 1.0,
+                                        "t1": 1.0, "reason": "timeout"}])
+    fr.record(never)
+    recs = fr.records()
+    assert [r["req_id"] for r in recs] == [1, 0]  # None-TTFT first (worst)
+    assert len(recs) == 2                         # req 0 not double-counted
+
+
+def test_recorder_rejects_bad_config():
+    with pytest.raises(ValueError):
+        FlightRecorder(capacity=0)
+    with pytest.raises(ValueError):
+        FlightRecorder(worst_k=-1)
+
+
+def test_clear_resets_everything():
+    fr = FlightRecorder(capacity=2, worst_k=2)
+    fr.record(_result(0))
+    fr.clear()
+    assert fr.n_seen == 0 and fr.records() == []
+
+
+# --------------------------------------------------------------- perfetto
+def test_perfetto_dump_schema(tmp_path):
+    slo = SLO(ttft_s=0.02, tpot_s=10.0)
+    fr = FlightRecorder(capacity=4, worst_k=2, slo=slo)
+    fr.record(_result(0, ttft=0.05))
+    fr.record(_result(1, ttft=0.01, t0=1.0))
+    path = fr.dump(str(tmp_path / "flight.json"))
+    trace = json.load(open(path))
+    ev = trace["traceEvents"]
+    assert trace["displayTimeUnit"] == "ms"
+    assert trace["otherData"]["n_seen"] == 2
+    assert trace["otherData"]["slo"] == {"ttft_s": 0.02, "tpot_s": 10.0}
+    # metadata: one process_name + one thread_name per retained request
+    metas = [e for e in ev if e["ph"] == "M"]
+    assert any(e["name"] == "process_name" for e in metas)
+    tracks = {e["tid"] for e in metas if e["name"] == "thread_name"}
+    assert tracks == {0, 1}
+    xs = [e for e in ev if e["ph"] == "X"]
+    assert xs, "no complete events"
+    for e in xs:
+        assert e["dur"] > 0 and e["ts"] >= 0      # rebased to earliest t0
+        assert e["name"] in {"queue", "admission", "prefill",
+                             "decode_chunk", "retire"}
+        assert e["args"]["req"] == e["tid"]
+    # earliest retained event sits at ts=0 after rebasing
+    assert min(e["ts"] for e in xs) == 0.0
+
+
+def test_perfetto_zero_duration_events_are_instants():
+    fr = FlightRecorder(worst_k=1)
+    fr.record(GenerationResult(tokens=[], prompt_len=3,
+                               finish_reason="timeout", req_id=5,
+                               timeline=[{"phase": "retire", "t0": 2.0,
+                                          "t1": 2.0, "reason": "timeout"}]))
+    ev = fr.perfetto()["traceEvents"]
+    inst = [e for e in ev if e["ph"] == "i"]
+    assert len(inst) == 1 and inst[0]["name"] == "retire"
+
+
+# -------------------------------------------------------- engine timelines
+def _engine(fr=None, **kw):
+    cfg = dict(max_seqs=2, max_len=64, seed=0, decode_chunk=4,
+               overlap=False, flight_recorder=fr)
+    cfg.update(kw)
+    return ServingEngine(_build_net(), **cfg)
+
+
+def test_engine_timeline_covers_lifecycle_gap_free():
+    eng = _engine()
+    res = eng.generate([Request([1, 2, 3], max_new_tokens=6),
+                        Request([4, 5, 6, 7], max_new_tokens=6)])
+    for r in res:
+        phases = [e["phase"] for e in r.timeline]
+        assert phases[0] == "queue" and phases[-1] == "retire"
+        assert {"admission", "prefill", "decode_chunk"} <= set(phases)
+        # chunked decode: 6 tokens at K=4 -> at least 2 chunk events
+        assert sum(p == "decode_chunk" for p in phases) >= 2
+        chunk_period = max(e["t1"] - e["t0"] for e in r.timeline
+                           if e["phase"] == "decode_chunk")
+        assert max_gap_s(r.timeline) <= chunk_period
+        lo, hi = coverage(r.timeline)
+        assert r.queue_wait_s is not None and r.queue_wait_s >= 0
+        assert r.req_id >= 0
+        assert hi - lo > 0
+        assert r.timeline_phases()["prefill"] > 0
+    eng.shutdown()
+
+
+def test_engine_timeline_gap_free_in_overlap_mode():
+    eng = _engine(overlap=True)
+    res = eng.generate([Request([1, 2, 3], max_new_tokens=8)])
+    tl = res[0].timeline
+    chunk_period = max(e["t1"] - e["t0"] for e in tl
+                       if e["phase"] == "decode_chunk")
+    assert max_gap_s(tl) <= chunk_period
+    eng.shutdown()
+
+
+def test_admission_retries_surface_under_contention():
+    # 1 slot, 3 requests: the queued ones see >= 1 failed admission attempt
+    eng = _engine(max_seqs=1)
+    res = eng.generate([Request([1, 2, 3], max_new_tokens=4)
+                        for _ in range(3)])
+    assert sum(r.admission_retries for r in res) >= 1
+    assert eng.stats()["admission_retries"] >= 1
+    # queue_wait histogram observed every admitted request
+    snap = eng.metrics.snapshot()
+    assert snap["serving.queue_wait_s"]["count"] == 3
+    eng.shutdown()
+
+
+def test_engine_records_into_flight_recorder():
+    fr = FlightRecorder(capacity=8, worst_k=8)
+    eng = _engine(fr=fr)
+    eng.generate([Request([1, 2, 3], max_new_tokens=4) for _ in range(3)])
+    assert fr.n_seen == 3
+    worst = fr.worst(1)[0]
+    assert worst["timeline"][0]["phase"] == "queue"
+    assert worst["timeline"][-1]["phase"] == "retire"
+    eng.shutdown()
+
+
+def test_flight_recorder_env_knob(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FLIGHT_RECORDER", "1")
+    eng = _engine()
+    assert isinstance(eng.flight_recorder, FlightRecorder)
+    eng.shutdown()
+    monkeypatch.setenv("DL4J_TPU_FLIGHT_RECORDER", "0")
+    eng = _engine()
+    assert eng.flight_recorder is None
+    eng.shutdown()
+    # an explicit recorder wins over the env default
+    fr = FlightRecorder(capacity=2)
+    monkeypatch.setenv("DL4J_TPU_FLIGHT_RECORDER", "1")
+    eng = _engine(fr=fr)
+    assert eng.flight_recorder is fr
+    eng.shutdown()
+
+
+def test_host_syncs_bit_parity_recorder_on_vs_off():
+    """ISSUE 8 satellite: the flight recorder (and the timeline plumbing
+    feeding it) adds ZERO host syncs and changes no tokens."""
+    prompts = [[1, 2, 3, 4, 5], [6, 7, 8]]
+
+    def serve(recorder):
+        telemetry.tracer().clear()
+        eng = ServingEngine(_build_net(), max_seqs=2, max_len=64, seed=4,
+                            decode_chunk=4, overlap=False,
+                            flight_recorder=recorder)
+        res = eng.generate([Request(list(p), max_new_tokens=10)
+                            for p in prompts])
+        eng.shutdown()
+        return [r.tokens for r in res], eng.stats()
+
+    toks_on, st_on = serve(FlightRecorder(capacity=8, worst_k=8,
+                                          slo=SLO(1e-9, 1e-9)))
+    toks_off, st_off = serve(None)
+    assert toks_on == toks_off
+    assert st_on["host_syncs"] == st_off["host_syncs"]
+    assert st_on["host_syncs_per_token"] == st_off["host_syncs_per_token"]

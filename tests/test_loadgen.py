@@ -1,0 +1,239 @@
+"""Open-loop load generator + SLO evaluator tests (ISSUE 8).
+
+Covers the pure layer exhaustively (schedule determinism incl. the env
+seed, arrival-process structure, cohort prefix sharing, evaluate /
+attainment_curve / max_sustainable_rate on synthetic outcomes) plus one
+CPU smoke run of the full loadgen -> engine -> SLO report path (tier-1:
+deliberately NOT marked slow).
+"""
+import math
+from types import SimpleNamespace
+
+import pytest
+
+from deeplearning4j_tpu.serving import (LoadSpec, ServingEngine,
+                                        build_schedule, run_spec)
+from deeplearning4j_tpu.serving import loadgen
+from deeplearning4j_tpu.telemetry import slo as slo_mod
+from deeplearning4j_tpu.telemetry.slo import (SLO, evaluate,
+                                              max_sustainable_rate,
+                                              request_attains,
+                                              request_tpot_s)
+from tests.test_telemetry import V, _build_net
+
+
+def _spec(**kw):
+    base = dict(rate=50.0, n_requests=24, seed=7, vocab=V,
+                prompt_len_mix=((4, 0.5), (8, 0.5)),
+                max_new_tokens_mix=((2, 0.5), (4, 0.5)),
+                shared_frac=0.5, shared_prefix_len=3, n_cohorts=2)
+    base.update(kw)
+    return LoadSpec(**base)
+
+
+# ------------------------------------------------------------- schedule
+def test_schedule_deterministic_for_same_spec_and_seed():
+    s1 = build_schedule(_spec())
+    s2 = build_schedule(_spec())
+    assert s1 == s2                       # byte-for-byte (frozen dataclasses)
+    s3 = build_schedule(_spec(seed=8))
+    assert s1 != s3
+
+
+def test_env_seed_is_the_default(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_LOADGEN_SEED", "7")
+    from_env = build_schedule(_spec(seed=None))
+    assert from_env == build_schedule(_spec(seed=7))
+    monkeypatch.delenv("DL4J_TPU_LOADGEN_SEED")
+    assert loadgen.resolve_seed(None) == 0
+    assert loadgen.resolve_seed(3) == 3
+
+
+def test_poisson_arrivals_monotone_and_near_rate():
+    sched = build_schedule(_spec(rate=100.0, n_requests=400, seed=0,
+                                 shared_frac=0.0))
+    ts = [r.t_arrival for r in sched]
+    assert ts == sorted(ts) and ts[0] > 0
+    # mean gap ~ 1/rate (400 samples: within 20%)
+    assert ts[-1] / len(ts) == pytest.approx(1 / 100.0, rel=0.2)
+
+
+def test_bursty_arrivals_have_silent_off_windows():
+    sched = build_schedule(_spec(process="bursty", rate=50.0, n_requests=120,
+                                 seed=1, shared_frac=0.0,
+                                 burst_on_s=0.5, burst_off_s=0.5))
+    ts = [r.t_arrival for r in sched]
+    assert ts == sorted(ts)
+    gaps = [b - a for a, b in zip(ts, ts[1:])]
+    # ON-window gaps are exponential at rate/duty = 100/s; OFF windows
+    # insert >= 0.5 s holes — both shapes must be present
+    assert max(gaps) >= 0.5
+    assert min(gaps) < 0.05
+    # arrivals only inside ON windows of the 1 s period
+    assert all(t % 1.0 <= 0.5 + 1e-9 for t in ts)
+
+
+def test_bursty_mean_rate_matches_spec():
+    sched = build_schedule(_spec(process="bursty", rate=80.0, n_requests=600,
+                                 seed=2, shared_frac=0.0))
+    assert len(sched) / sched[-1].t_arrival == pytest.approx(80.0, rel=0.25)
+
+
+def test_unknown_process_and_bad_rate_raise():
+    with pytest.raises(ValueError):
+        build_schedule(_spec(process="weird"))
+    with pytest.raises(ValueError):
+        build_schedule(_spec(rate=0.0))
+
+
+def test_cohort_members_share_exact_prefix():
+    sched = build_schedule(_spec(n_requests=60))
+    by_cohort = {}
+    for r in sched:
+        if r.cohort is not None:
+            by_cohort.setdefault(r.cohort, []).append(r)
+    assert by_cohort, "shared_frac=0.5 over 60 requests produced no cohorts"
+    for members in by_cohort.values():
+        prefixes = {m.tokens[:3] for m in members}
+        assert len(prefixes) == 1         # identical leading tokens (COW key)
+        for m in members:
+            assert len(m.tokens) > 3      # >= 1 fresh suffix token
+    # non-cohort requests draw their lengths straight from the mix
+    solo = [r for r in sched if r.cohort is None]
+    assert {len(r.tokens) for r in solo} <= {4, 8}
+
+
+def test_length_mixes_are_respected():
+    sched = build_schedule(_spec(shared_frac=0.0, n_requests=200, seed=3))
+    assert {len(r.tokens) for r in sched} == {4, 8}
+    assert {r.max_new_tokens for r in sched} == {2, 4}
+
+
+# ------------------------------------------------------------ slo layer
+def _outcome(reason="eos", ttft=0.01, lat=0.05, n=5, qw=0.001):
+    return SimpleNamespace(finish_reason=reason, ttft_s=ttft, latency_s=lat,
+                           n_tokens=n, queue_wait_s=qw)
+
+
+def test_request_tpot_and_attains():
+    o = _outcome(ttft=0.01, lat=0.05, n=5)
+    assert request_tpot_s(o) == pytest.approx(0.04 / 4)
+    assert request_tpot_s(_outcome(n=1)) is None     # no decode span
+    slo = SLO(ttft_s=0.02, tpot_s=0.02)
+    assert request_attains(o, slo)
+    assert not request_attains(_outcome(ttft=0.03), slo)      # TTFT blown
+    assert not request_attains(_outcome(lat=0.5), slo)        # TPOT blown
+    assert not request_attains(_outcome(reason="timeout"), slo)
+    assert not request_attains(_outcome(ttft=None), slo)
+    # single-token request is judged on TTFT alone
+    assert request_attains(_outcome(n=1, lat=None), slo)
+
+
+def test_evaluate_goodput_vs_throughput():
+    slo = SLO(ttft_s=0.02, tpot_s=0.02)
+    outcomes = [_outcome() for _ in range(8)] + \
+        [_outcome(ttft=0.5) for _ in range(2)]       # violators, completed
+    rep = evaluate(outcomes, slo, wall_s=2.0, offered_rate=5.0)
+    assert rep["n_requests"] == 10 and rep["n_completed"] == 10
+    assert rep["n_attained"] == 8
+    assert rep["throughput"] == pytest.approx(5.0)
+    assert rep["goodput"] == pytest.approx(4.0)      # goodput < throughput
+    assert rep["slo_attained_frac"] == pytest.approx(0.8)
+    assert rep["offered_rate"] == 5.0
+    assert rep["ttft_p99_s"] > rep["ttft_p50_s"]
+    assert rep["slo"] == {"ttft_s": 0.02, "tpot_s": 0.02}
+
+
+def test_evaluate_empty_and_failed_runs():
+    rep = evaluate([], SLO(1, 1), wall_s=1.0)
+    assert rep["goodput"] == 0.0 and rep["slo_attained_frac"] == 0.0
+    assert rep["ttft_p99_s"] is None
+    rep = evaluate([_outcome(reason="timeout")], SLO(1, 1), wall_s=1.0)
+    assert rep["n_completed"] == 0 and rep["goodput"] == 0.0
+
+
+def _synthetic_server(capacity):
+    """run_at_rate stub: attains fully below capacity, degrades above
+    (the canonical open-loop attainment shape)."""
+    def run_at_rate(rate):
+        frac = min(1.0, capacity / rate)
+        n = 20
+        n_ok = round(frac * n)
+        outcomes = [_outcome() for _ in range(n_ok)] + \
+            [_outcome(ttft=9.9) for _ in range(n - n_ok)]
+        return outcomes, n / rate
+    return run_at_rate
+
+
+def test_attainment_curve_degrades_past_capacity():
+    slo = SLO(ttft_s=0.02, tpot_s=0.02)
+    curve = slo_mod.attainment_curve(_synthetic_server(100.0),
+                                     [50.0, 100.0, 200.0], slo)
+    fracs = [r["slo_attained_frac"] for r in curve]
+    assert fracs[0] == 1.0 and fracs[1] == 1.0 and fracs[2] == 0.5
+    assert [r["offered_rate"] for r in curve] == [50.0, 100.0, 200.0]
+
+
+def test_max_sustainable_rate_bisects_to_capacity():
+    slo = SLO(ttft_s=0.02, tpot_s=0.02)
+    res = max_sustainable_rate(_synthetic_server(100.0), slo,
+                               lo=25.0, hi=400.0, target_frac=0.9, iters=6)
+    # capacity 100 => attainment >= 0.9 up to ~111 req/s
+    assert 90.0 <= res["max_sustainable_rate"] <= 115.0
+    assert len(res["probes"]) == 2 + 6
+
+
+def test_max_sustainable_rate_degenerate_brackets():
+    slo = SLO(ttft_s=0.02, tpot_s=0.02)
+    # lo already violates -> None, one probe, no bisection
+    res = max_sustainable_rate(_synthetic_server(1.0), slo,
+                               lo=50.0, hi=100.0, iters=3)
+    assert res["max_sustainable_rate"] is None
+    assert len(res["probes"]) == 1
+    # whole bracket attains -> hi, two probes
+    res = max_sustainable_rate(_synthetic_server(1e9), slo,
+                               lo=50.0, hi=100.0, iters=3)
+    assert res["max_sustainable_rate"] == 100.0
+    assert len(res["probes"]) == 2
+
+
+# ----------------------------------------------------------- engine run
+def test_open_loop_run_against_engine_cpu_smoke():
+    """Tier-1 smoke: a seeded open-loop run drives the real engine and the
+    outcomes carry the engine's lifecycle data end to end."""
+    net = _build_net()
+    eng = ServingEngine(net, max_seqs=2, max_len=32, seed=0,
+                        max_new_tokens_cap=4, overlap=False)
+    spec = _spec(rate=200.0, n_requests=6, n_cohorts=1,
+                 max_new_tokens_mix=((2, 1.0),))
+    res = run_spec(eng, spec)
+    assert len(res.outcomes) == 6
+    assert res.wall_s > 0 and res.achieved_rate > 0
+    for o in res.outcomes:
+        assert o.finish_reason == "length"
+        assert o.n_tokens == 2
+        assert o.req_id >= 0
+        assert o.ttft_s is not None and o.queue_wait_s is not None
+        assert o.latency_s is not None and o.latency_s >= o.ttft_s * 0.5
+        assert o.lateness_s >= 0
+        phases = {e["phase"] for e in o.timeline}
+        assert {"queue", "admission", "prefill", "retire"} <= phases
+    # the whole run evaluates cleanly under a generous budget
+    rep = evaluate(res.outcomes, SLO(ttft_s=60.0, tpot_s=60.0), res.wall_s)
+    assert rep["slo_attained_frac"] == 1.0
+    assert rep["goodput"] == pytest.approx(res.achieved_rate, rel=1e-6)
+    eng.shutdown()
+
+
+def test_open_loop_lateness_is_bounded_by_chunk_pacing():
+    """A request arriving mid-chunk is submitted when the chunk returns —
+    lateness is recorded, not silently folded into the schedule."""
+    net = _build_net()
+    eng = ServingEngine(net, max_seqs=2, max_len=32, seed=0,
+                        max_new_tokens_cap=4, overlap=False)
+    res = run_spec(eng, _spec(rate=500.0, n_requests=8, shared_frac=0.0,
+                              max_new_tokens_mix=((2, 1.0),)))
+    assert all(o.lateness_s >= 0 for o in res.outcomes)
+    assert res.lateness_p99_s < 30.0      # sane even on a cold CPU
+    assert math.isfinite(res.lateness_p99_s)
+    eng.shutdown()

@@ -30,7 +30,11 @@ HOT_PATH_MODULES = sorted(
      # between every decode iteration — a hidden readback there would tax
      # every scheduling opportunity
      PKG / "serving" / "kv_cache.py",
-     PKG / "serving" / "block_table.py"]
+     PKG / "serving" / "block_table.py",
+     # open-loop load generator (ISSUE 8): its submit/step/collect loop IS
+     # the measurement harness — a stray readback there would show up as
+     # fake queueing in every goodput number
+     PKG / "serving" / "loadgen.py"]
     + list((PKG / "telemetry").glob("*.py")))
 
 ANNOTATION = "sync-ok:"
@@ -95,10 +99,12 @@ def test_all_hot_path_modules_exist():
     # the telemetry glob must keep covering these specific modules — the
     # ISSUE 6 profiler/memory accounting promise the same zero-added-syncs
     # contract as the ISSUE 4/5 modules; ISSUE 7 adds the paged-KV
-    # scheduling modules under the same promise
+    # scheduling modules, ISSUE 8 the SLO evaluator / flight recorder /
+    # load generator, all under the same promise
     assert {"health.py", "profiler.py", "memory.py", "tracing.py",
             "registry.py", "training.py", "kv_cache.py",
-            "block_table.py"} <= names
+            "block_table.py", "slo.py", "flight_recorder.py",
+            "loadgen.py"} <= names
 
 
 # ------------------------------------------------ scanner self-tests

@@ -145,6 +145,81 @@ def test_child_registry_aggregates_into_parent_exposition():
     assert parent.snapshot()["x.n"] == 1
 
 
+def _parse_prometheus(text):
+    """Reference parse of the v0.0.4 text format: returns
+    (samples {name_or_name{labels}: float}, types {name: type},
+    helps {name: raw help text})."""
+    samples, types, helps = {}, {}, {}
+    for line in text.strip().split("\n"):
+        if line.startswith("# TYPE "):
+            _, _, name, typ = line.split(" ", 3)
+            types[name] = typ
+        elif line.startswith("# HELP "):
+            _, _, name, h = line.split(" ", 3)
+            helps[name] = h.replace("\\n", "\n").replace("\\\\", "\\")
+        elif line.startswith("#"):
+            continue
+        else:
+            key, val = line.rsplit(" ", 1)
+            assert key not in samples, f"duplicate sample {key}"
+            samples[key] = float(val)
+    return samples, types, helps
+
+
+def test_prometheus_round_trip_against_reference_parse():
+    """ISSUE 8 satellite: audit the exposition against an independent parse
+    — cumulative monotone buckets, `+Inf` == `_count`, `_sum` == raw sum,
+    HELP escaping survives the round trip."""
+    reg = MetricsRegistry()
+    reg.counter("rt.count", help="lines with \\ and\nnewline").inc(7)
+    h = reg.histogram("rt.lat", help="latency", buckets=(1, 5, 25))
+    obs = (0.2, 0.7, 3, 3, 17, 90, 120)
+    for v in obs:
+        h.observe(v)
+    samples, types, helps = _parse_prometheus(reg.prometheus_text())
+    assert types == {"rt_count": "counter", "rt_lat": "histogram"}
+    # HELP escaping round-trips to the original text
+    assert helps["rt_count"] == "lines with \\ and\nnewline"
+    assert samples["rt_count"] == 7
+    # buckets are CUMULATIVE and monotone non-decreasing
+    buckets = [samples['rt_lat_bucket{le="1"}'],
+               samples['rt_lat_bucket{le="5"}'],
+               samples['rt_lat_bucket{le="25"}'],
+               samples['rt_lat_bucket{le="+Inf"}']]
+    assert buckets == [2, 4, 5, 7]
+    assert buckets == sorted(buckets)
+    # +Inf bucket equals _count; _sum is the raw observation sum
+    assert samples['rt_lat_bucket{le="+Inf"}'] == samples["rt_lat_count"]
+    assert samples["rt_lat_sum"] == pytest.approx(sum(obs))
+
+
+def test_prometheus_mixed_type_name_collision_is_single_typed():
+    """A name registered as different TYPES across child registries must
+    expose only the first-seen type — a mixed family is unparseable (and
+    used to crash the exposition)."""
+    parent = MetricsRegistry()
+    parent.counter("clash.m").inc(3)
+    child = MetricsRegistry(parent=parent)
+    child.histogram("clash.m", buckets=(1,)).observe(0.5)
+    samples, types, _ = _parse_prometheus(parent.prometheus_text())
+    assert types["clash_m"] == "counter"
+    assert samples["clash_m"] == 3          # histogram instance not summed in
+    assert not any(k.startswith("clash_m_bucket") for k in samples)
+
+
+def test_prometheus_mismatched_histogram_bounds_excluded_whole():
+    """Same-name histograms with DIFFERENT bucket bounds: only the
+    first-seen bounds aggregate, and the excluded instance is left out of
+    buckets, _sum AND _count (else +Inf desyncs from _count)."""
+    parent = MetricsRegistry()
+    parent.histogram("mm.h", buckets=(1, 10)).observe(0.5)
+    child = MetricsRegistry(parent=parent)
+    child.histogram("mm.h", buckets=(2, 20)).observe(0.5)
+    samples, _, _ = _parse_prometheus(parent.prometheus_text())
+    assert samples['mm_h_bucket{le="+Inf"}'] == samples["mm_h_count"] == 1
+    assert samples["mm_h_sum"] == pytest.approx(0.5)
+
+
 # -------------------------------------------------------------- tracing
 def test_chrome_trace_schema_and_nesting():
     tr = Tracer()
