@@ -1020,7 +1020,8 @@ def bench_serving_slo(vocab=32, d_model=64, heads=2, kv_heads=1,
                       prompt_len_mix=((6, 0.7), (10, 0.3)),
                       new_tokens_mix=((4, 0.5), (8, 0.5)),
                       shared_frac=0.4, shared_prefix_len=4,
-                      rate_factors=(0.5, 1.0, 2.5)):
+                      rate_factors=(0.5, 1.0, 2.5),
+                      prefill_chunk=None, calibration=None):
     """Open-loop goodput-under-SLO observatory (ISSUE 8): a seeded
     Poisson arrival stream (serving/loadgen.py) against the
     continuous-batching engine, judged by telemetry/slo.py — goodput
@@ -1034,7 +1035,13 @@ def bench_serving_slo(vocab=32, d_model=64, heads=2, kv_heads=1,
     from a warm closed-loop pass on the same host (x8 min TTFT, x5 median
     TPOT; a first pass eats the compiles), so attainment degrades with
     offered load for real queueing reasons rather than absolute-wall
-    reasons, on any platform."""
+    reasons, on any platform.
+
+    ISSUE 9 knobs: `prefill_chunk` is passed through to the engine (0 =
+    monolithic prefill, None = env/default); `calibration`
+    ({ttft_s, tpot_s, r_cap}) pins the SLO budgets AND the offered-rate
+    grid to a prior run's, so the chunked-prefill A/B judges ON and OFF
+    against identical budgets at identical rates."""
     import json as _json
     import os as _os
     import tempfile as _tempfile
@@ -1067,7 +1074,8 @@ def bench_serving_slo(vocab=32, d_model=64, heads=2, kv_heads=1,
     # at every rate point); runs are sequential and fully drained, so rate
     # points never share device state — only the warm compile cache
     eng = ServingEngine(net, max_seqs=max_seqs, max_len=max_len, seed=0,
-                        max_new_tokens_cap=max_new, overlap=False)
+                        max_new_tokens_cap=max_new, overlap=False,
+                        prefill_chunk=prefill_chunk)
 
     def spec_at(rate):
         return LoadSpec(rate=rate, n_requests=n_requests, seed=seed,
@@ -1090,6 +1098,14 @@ def bench_serving_slo(vocab=32, d_model=64, heads=2, kv_heads=1,
     base_tpot = float(np.median(tpots))
     slo = _slo.SLO(ttft_s=8 * base_ttft, tpot_s=5 * base_tpot)
     r_cap = warm.achieved_rate                 # closed-loop completions/s
+    if calibration:             # pinned budgets + rate grid (chunked A/B)
+        slo = _slo.SLO(ttft_s=calibration["ttft_s"],
+                       tpot_s=calibration["tpot_s"])
+        r_cap = calibration["r_cap"]
+    # sweep-only telemetry: the decode-stall histogram (and the retry /
+    # prefix counters stats() reports) should describe the rate sweep,
+    # not the closed-loop compile/calibration bursts
+    eng.metrics.reset()
 
     fr = _fr.FlightRecorder(capacity=32, worst_k=8, slo=slo)
     eng.flight_recorder = fr
@@ -1136,11 +1152,26 @@ def bench_serving_slo(vocab=32, d_model=64, heads=2, kv_heads=1,
     # capacity number: raw throughput past that point serves SLO misses)
     head = max(curve, key=lambda r: r["goodput"])
     st = eng.stats()
+    # ISSUE 9 tail diagnostics: decode-stall p99 (ms a decode iteration
+    # waited behind a prefill dispatch — whole-prompt when monolithic, one
+    # chunk when chunked) and the share of first-token latency that is
+    # queue wait rather than compute, both at the headline rate point
+    stall_h = eng.metrics.get("serving.decode_stall_ms")
+    stall_p99 = (round(float(stall_h.quantile(0.99)), 3)
+                 if stall_h is not None and stall_h.count else None)
+    qw, tf = head.get("queue_wait_p99_s"), head.get("ttft_p99_s")
     return {
         "seed": seed,
         "offered_rate": round(float(head["offered_rate"]), 5),
         "goodput": round(float(head["goodput"]), 5),
         "ttft_p99_s": round(float(head["ttft_p99_s"]), 5),
+        "tpot_p99_s": None if head.get("tpot_p99_s") is None
+        else round(float(head["tpot_p99_s"]), 6),
+        "decode_stall_p99_ms": stall_p99,
+        "queue_wait_share": None if not qw or not tf
+        else round(float(qw) / float(tf), 4),
+        "prefill_chunk": eng.prefill_chunk,
+        "prefill_chunks": st["prefill_chunks"],
         "slo_attained_frac": round(float(head["slo_attained_frac"]), 5),
         "attainment": [_pt(r) for r in curve],
         "max_sustainable_rate": None if msr["max_sustainable_rate"] is None
@@ -1148,9 +1179,12 @@ def bench_serving_slo(vocab=32, d_model=64, heads=2, kv_heads=1,
         "msr_target_frac": msr["target_frac"],
         "slo": {"ttft_s": round(slo.ttft_s, 6),
                 "tpot_s": round(slo.tpot_s, 6),
-                "calibration": "8x min warm closed-loop TTFT, 5x median "
-                               "warm closed-loop TPOT (same host, same "
-                               "engine, compile pass excluded)"},
+                "calibration": ("pinned to the paired baseline run's "
+                                "budgets (chunked-prefill A/B)")
+                if calibration else
+                "8x min warm closed-loop TTFT, 5x median "
+                "warm closed-loop TPOT (same host, same "
+                "engine, compile pass excluded)"},
         "closed_loop_rate_cap": round(float(r_cap), 5),
         "admission_retries": st["admission_retries"],
         "flight_recorder": {
@@ -1169,12 +1203,98 @@ def bench_serving_slo(vocab=32, d_model=64, heads=2, kv_heads=1,
                    "new_tokens_mix": [list(p) for p in new_tokens_mix],
                    "shared_frac": shared_frac,
                    "shared_prefix_len": shared_prefix_len,
+                   "prefill_chunk": eng.prefill_chunk,
+                   "calibrated_from": "pinned" if calibration else "self",
                    "process": "poisson"},
         "note": ("open-loop protocol: arrivals are clock-scheduled and do "
                  "not wait for completions, so queueing shows up in TTFT "
                  "p99 / goodput — closed-loop numbers are NOT comparable "
                  "(PERF.md, 'Goodput & SLO methodology'); reduced "
                  "CPU-runnable config with host-calibrated budgets")}
+
+
+def bench_chunked_prefill_ab(chunk=128, vocab=32, d_model=128, heads=2,
+                             kv_heads=1, max_seqs=4, n_requests=16,
+                             seed=0):
+    """Chunked-prefill A/B (ISSUE 9): the open-loop SLO observatory run
+    twice on a LONG-PROMPT-HEAVY mix — prefill chunking OFF (monolithic,
+    the baseline that stalls resident decodes for a whole prompt) then ON
+    at a ~1-KV-block token budget — with the ON run judged against the
+    OFF run's calibrated SLO budgets at the OFF run's offered-rate grid,
+    so every delta is same-budget, same-rates, same-seed. Reports the
+    TTFT/TPOT p99, decode-stall p99, queue-wait-share and
+    max-sustainable-rate deltas the chunking is supposed to move. Sized
+    for CPU: deltas demonstrate the scheduling mechanism (bounded stalls),
+    not TPU-scale wall-clock wins."""
+    mix = dict(vocab=vocab, d_model=d_model, heads=heads, kv_heads=kv_heads,
+               max_seqs=max_seqs, n_requests=n_requests, seed=seed,
+               prompt_len_mix=((256, 0.6), (48, 0.4)),
+               new_tokens_mix=((8, 0.5), (16, 0.5)),
+               # no prefix sharing here: shared_len depends on donor
+               # residency TIMING, so shared chunk-start buckets would
+               # compile (or not) nondeterministically mid-sweep and a
+               # 100ms-scale compile would masquerade as a decode stall;
+               # the chunking x sharing interaction is unit-tested
+               # (tests/test_chunked_prefill.py), this A/B isolates the
+               # scheduling deltas
+               shared_frac=0.0, shared_prefix_len=16,
+               rate_factors=(0.5, 1.0, 2.0))
+    off = bench_serving_slo(prefill_chunk=0, **mix)
+    cal = {"ttft_s": off["slo"]["ttft_s"], "tpot_s": off["slo"]["tpot_s"],
+           "r_cap": off["closed_loop_rate_cap"]}
+    on = bench_serving_slo(prefill_chunk=chunk, calibration=cal, **mix)
+
+    def _slim(e):
+        keep = ("offered_rate", "goodput", "slo_attained_frac", "ttft_p99_s",
+                "tpot_p99_s", "decode_stall_p99_ms", "queue_wait_share",
+                "max_sustainable_rate", "prefill_chunk", "prefill_chunks")
+        return {k: e.get(k) for k in keep} | {
+            "overload": e["attainment"][-1]}
+
+    def _d(a, b, scale=1.0, nd=3):
+        if a is None or b is None:
+            return None
+        r = round((float(a) - float(b)) * scale, nd)
+        return 0.0 if r == 0 else r      # never publish -0.0
+
+    # latency/stall/queue deltas at the TOP (most overloaded) rate point —
+    # identical offered rate on both sides thanks to the pinned grid;
+    # positive = chunking improved it
+    o_top, n_top = off["attainment"][-1], on["attainment"][-1]
+
+    def _share(pt):
+        q, t = pt.get("queue_wait_p99_s"), pt.get("ttft_p99_s")
+        return None if not q or not t else q / t
+
+    deltas = {
+        "ttft_p99_delta_ms": _d(o_top["ttft_p99_s"], n_top["ttft_p99_s"],
+                                1e3),
+        "tpot_p99_delta_ms": _d(o_top["tpot_p99_s"], n_top["tpot_p99_s"],
+                                1e3),
+        "decode_stall_p99_delta_ms": _d(off["decode_stall_p99_ms"],
+                                        on["decode_stall_p99_ms"]),
+        "queue_wait_share_delta": _d(_share(o_top), _share(n_top), nd=4),
+        # positive = chunking sustains a HIGHER rate at the same budgets;
+        # both sides bisect over the SAME pinned rate grid, so real
+        # differences are grid-sized — 2-decimal rounding kills the
+        # rounding jitter of two independently-rounded equal rates
+        "max_sustainable_rate_delta": _d(on["max_sustainable_rate"],
+                                         off["max_sustainable_rate"],
+                                         nd=2),
+    }
+    return {
+        "chunk_budget": on["prefill_chunk"],
+        "off": _slim(off), "on": _slim(on), "deltas": deltas,
+        "slo": off["slo"],
+        "config": {k: ([list(x) if isinstance(x, tuple) else x for x in v]
+                       if isinstance(v, tuple) else v)
+                   for k, v in mix.items()},
+        "note": ("open-loop A/B, same seed/budgets/rates both sides; "
+                 "latency deltas taken at the top (overloaded) rate point "
+                 "where monolithic prefills stall resident decodes the "
+                 "most; positive deltas = chunking ON is better; "
+                 "reduced CPU-runnable config — the mechanism "
+                 "(bounded decode stalls), not TPU-scale wall wins")}
 
 
 def _row_from_roofline(function, roof, plat):
@@ -1364,6 +1484,10 @@ def main():
                                    "this host)")}
     except Exception as e:
         slo_obs = {"error": f"{type(e).__name__}: {e}"}
+    try:  # chunked-prefill A/B (ISSUE 9, any platform): stall/tail deltas
+        chunked_ab = bench_chunked_prefill_ab()
+    except Exception as e:
+        chunked_ab = {"error": f"{type(e).__name__}: {e}"}
     # headline takes the better of helpers on/off — both honest fit_on_device
     # protocol; entry names record which path won
     if resnet_helpers.get("images_per_sec", 0) > resnet_bf16["images_per_sec"]:
@@ -1419,6 +1543,8 @@ def main():
             # pre-rounded inside bench_serving_slo (_r's 2-decimal policy
             # would flatten ms-scale TTFT/TPOT budgets to 0.0)
             "serving_slo": slo_obs,
+            # pre-rounded for the same reason (ms-scale stall/TTFT deltas)
+            "serving_chunked_prefill": chunked_ab,
             "decode_tokens_per_sec": round(
                 decode.get("decode_tokens_per_sec", 0.0), 1),
             "serving_profile": serving_profile,

@@ -50,6 +50,13 @@ same skip logic, bkv = block_size); `decode_attention_dense_paged`
 extends the fp64 oracle to resolve block tables (gather + reshape, then
 the unchanged dense math) so the parity harness covers the paged path
 end to end. Falls back to the dense-paged path when block_size < 8.
+
+Chunked prefill (ISSUE 9) adds no kernel variant: a prefill chunk
+attends its predecessor blocks through the SAME block-table gather
+semantics the shared-prefix suffix pass uses (serving/decode.py
+`_prefill_shared_fn`), and decode iterations interleaved between chunks
+hit this kernel unchanged — a partially-prefilled slot is invisible to
+it because its `lengths` entry only covers completed chunks.
 """
 from __future__ import annotations
 

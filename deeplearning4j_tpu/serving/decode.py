@@ -41,6 +41,14 @@ blocks onto resident shared KV, only the SUFFIX is embedded and computed —
 suffix queries attend the slot's full gathered prefix (shared blocks
 included), skipping the shared positions' projection and score math
 entirely. That is the prefill-FLOPs saving the bench measures.
+
+CHUNKED prefill (ISSUE 9, Sarathi-style) is the same pure function under a
+second stateful entry point, `prefill_chunk`: a prompt split into
+fixed-budget chunks runs chunk i as a "suffix" whose already-resident
+prefix is chunks 0..i-1 — `start` plays shared_len, `end` plays plen, the
+chunk's k/v scatter through the block table and its queries attend the
+slot's first gathered blocks (earlier chunks included), causal within the
+chunk. One jit, one compile cache, for both features.
 """
 from __future__ import annotations
 
@@ -261,7 +269,13 @@ class StackDecoder:
         trash-route their writes and their outputs are discarded.
         `kv_blocks` is static (engine-bucketed) so the gathered length is
         ~plen, not max_len — the compute skipped for the shared positions
-        is the whole point."""
+        is the whole point.
+
+        Chunked prefill (ISSUE 9) reuses this pass verbatim with the chunk
+        START in the shared_len seat and the chunk END in the plen seat:
+        chunk i's queries attend the slot's earlier chunks through the same
+        block-table gather, causal within the chunk, and set_length(end)
+        makes the chunk visible to subsequent decode/chunk iterations."""
         xt = jnp.swapaxes(x, 0, 1).astype(self.dtype)       # (Ts_pad, n_in)
         Ts = xt.shape[0]
         bs = self.cache.block_size
@@ -424,6 +438,51 @@ class StackDecoder:
                 pass
         self.cache.state, logprobs = self._prefill_shared_jit(
             self.params, self.cache.state, x, slot_a, plen_a, shared_a,
+            kv_blocks=kvb)
+        return logprobs
+
+    def prefill_chunk(self, slot: int, x, start: int,
+                      end: int) -> jnp.ndarray:
+        """One chunk of an incremental prefill: x (n_in, Tc) features of
+        prompt positions [start, end), Tc = end - start. Writes the chunk's
+        k/v through the block table, attends each chunk query against the
+        slot's earlier resident positions (prior chunks and any shared
+        prefix) plus the causal part of the chunk itself, and advances
+        lengths[slot] to `end`. Returns the (vocab,) logprobs at position
+        end-1 — meaningful only on the final chunk (end == plen), where it
+        equals what a monolithic prefill() would have returned.
+
+        This is `_prefill_shared_fn` with (start, end) in the
+        (shared_len, plen) seats — same jit, same compile cache as
+        prefix-shared prefill."""
+        x = jnp.asarray(x, self.dtype)
+        Tc = x.shape[1]
+        if Tc != end - start or Tc < 1 or start < 0 \
+                or end > self.cache.max_len:
+            raise ValueError(f"bad prefill chunk: start={start}, "
+                             f"end={end}, chunk={Tc}")
+        Tsp, kvb = self.shared_buckets(end, start)
+        if Tsp != Tc:
+            x = jnp.pad(x, ((0, 0), (0, Tsp - Tc)))
+        slot_a = jnp.asarray(slot, jnp.int32)
+        end_a = jnp.asarray(end, jnp.int32)
+        start_a = jnp.asarray(start, jnp.int32)
+        from deeplearning4j_tpu.telemetry import profiler
+        key = ("shared", Tsp, kvb)                  # same compiled shape
+        if profiler.enabled() and key not in self._profiled_buckets:
+            self._profiled_buckets.add(key)
+            try:
+                profiler.register(
+                    f"prefill_shared_b{Tsp}k{kvb}", self._prefill_shared_jit,
+                    (self.params, self.cache.state, x, slot_a, end_a,
+                     start_a),
+                    kwargs={"kv_blocks": kvb},
+                    meta={"bucket": Tsp, "kv_blocks": kvb},
+                    registry=self.metrics)
+            except Exception:
+                pass
+        self.cache.state, logprobs = self._prefill_shared_jit(
+            self.params, self.cache.state, x, slot_a, end_a, start_a,
             kv_blocks=kvb)
         return logprobs
 

@@ -49,6 +49,24 @@ Hot-loop design (why this never retraces and rarely syncs):
   token-level capture is off.
 - Prefill runs per admission via StackDecoder.prefill (power-of-two length
   buckets -> bounded trace count).
+- CHUNKED prefill (Sarathi-Serve-style, ISSUE 9): a long prompt no longer
+  runs its whole prefill in one dispatch — that stalls every resident
+  decode stream for the duration, the main TPOT-tail pathology the
+  adaptive K->1 policy does not cover. When a prompt's unshared suffix
+  exceeds `prefill_chunk` tokens (env `DL4J_TPU_PREFILL_CHUNK`, default
+  256, 0 disables; rounded to KV-block granularity), admission only
+  reserves its blocks; the prefill itself becomes a queue of fixed-budget
+  chunks, AT MOST ONE of which runs per scheduler iteration, interleaved
+  with the resident slots' decode chunks. A partially-prefilled sequence
+  holds its reservation, writes each chunk's K/V through the block table,
+  and later chunks attend its own earlier blocks via the same gather as
+  prefix-shared prefill (`_prefill_shared_fn` with chunk start/end in the
+  shared_len/plen seats — one jit, one compile cache, pow2/block-granular
+  buckets). Prefix-shared admissions chunk only their unshared suffix.
+  The first token samples after the final chunk, so chunking consumes the
+  admission PRNG key later in the chain than monolithic prefill would —
+  greedy decoding is token-identical either way (the parity tests), and
+  steady-state counted host syncs are bit-identical chunked on or off.
 
 Per-request controls: max_new_tokens, temperature (0 = greedy), eos_id,
 timeout_s (wall-clock, checked between iterations). Results carry cheap
@@ -77,6 +95,10 @@ from deeplearning4j_tpu.telemetry import memory as _tmemory
 from deeplearning4j_tpu.telemetry import profiler as _profiler
 from deeplearning4j_tpu.serving.decode import StackDecoder, one_hot_embedder
 from deeplearning4j_tpu.serving.sampler import Sampler, sample_tokens
+
+# per-iteration prefill token budget (chunked prefill, ISSUE 9); env
+# DL4J_TPU_PREFILL_CHUNK overrides, 0 disables chunking entirely
+DEFAULT_PREFILL_CHUNK = 256
 
 
 @dataclass
@@ -111,10 +133,12 @@ class GenerationResult:
     admission_retries: int = 0
     # per-request lifecycle timeline: ordered event dicts {"phase", "t0",
     # "t1", ...extras} on the host monotonic clock ("queue" -> "admission"
-    # -> "prefill" -> one "decode_chunk" per scheduler iteration the slot
-    # entered -> "retire"). Built from timestamps the scheduler already
-    # takes — recording it adds zero device syncs. flight_recorder.py
-    # turns retained timelines into a Perfetto trace.
+    # -> zero or more "prefill_chunk" spans when chunked prefill split the
+    # prompt (chunk index, tokens, shared-skip) -> "prefill" -> one
+    # "decode_chunk" per scheduler iteration the slot entered -> "retire").
+    # Built from timestamps the scheduler already takes — recording it
+    # adds zero device syncs. flight_recorder.py turns retained timelines
+    # into a Perfetto trace.
     timeline: List[dict] = field(default_factory=list)
 
     def timeline_phases(self) -> Dict[str, float]:
@@ -166,6 +190,12 @@ class _Active:
     retries: int = 0                  # failed block-reservation attempts
     t_admit: float = 0.0              # admission (block plan) succeeded
     timeline: List[dict] = field(default_factory=list)
+    # chunked prefill (ISSUE 9): prompt positions [0, prefilled) are
+    # KV-resident (== shared_len right after admission, == plen once the
+    # prefill — monolithic or final chunk — completes)
+    prefilled: int = 0
+    shared_len: int = 0
+    n_chunks: int = 0                 # prefill chunks executed so far
 
 
 def _build_step(decoder: StackDecoder, embed: Callable, top_k: int,
@@ -243,7 +273,15 @@ class ServingEngine:
     1/K, with K adapting to 1 whenever requests are queued. `overlap`
     (default True) lets `drain`/`generate` dispatch the next chunk before
     reading the previous chunk's mask, hiding host scheduling under device
-    compute (disabled automatically under capture_logprobs)."""
+    compute (disabled automatically under capture_logprobs).
+
+    `prefill_chunk` (default 256; env `DL4J_TPU_PREFILL_CHUNK`; 0 disables)
+    is the per-iteration prefill token budget: an admitted prompt whose
+    unshared suffix exceeds it is prefilled one bounded chunk per scheduler
+    iteration, interleaved with resident decode, instead of in one
+    decode-stalling dispatch (Sarathi-style; see the module docstring).
+    The budget rounds to KV-block granularity so chunk shapes bucket to
+    the same bounded compile-key set as prefix-shared prefill."""
 
     def __init__(self, net, max_seqs: int, max_len: int, *, dtype=None,
                  seed: int = 0, top_k: int = 0,
@@ -252,6 +290,7 @@ class ServingEngine:
                  capture_logprobs: bool = False,
                  decode_chunk: Optional[int] = None,
                  overlap: bool = True,
+                 prefill_chunk: Optional[int] = None,
                  kv_block: Optional[int] = None,
                  kv_blocks: Optional[int] = None,
                  prefix_share: Optional[bool] = None,
@@ -274,6 +313,20 @@ class ServingEngine:
             raise ValueError(f"decode_chunk must be >= 1, got {decode_chunk}")
         self.decode_chunk = int(decode_chunk)
         self.overlap = bool(overlap)
+        if prefill_chunk is None:
+            prefill_chunk = int(os.environ.get(
+                "DL4J_TPU_PREFILL_CHUNK", str(DEFAULT_PREFILL_CHUNK)))
+        if prefill_chunk < 0:
+            raise ValueError(
+                f"prefill_chunk must be >= 0 (0 disables), got "
+                f"{prefill_chunk}")
+        bs_kv = self.decoder.cache.block_size
+        if prefill_chunk:
+            # block-granular budget: chunk boundaries land on block edges
+            # (aside from a shared-prefix offset), so chunk shapes bucket
+            # to the same pow2 set as prefix-shared suffixes
+            prefill_chunk = max(bs_kv, (prefill_chunk // bs_kv) * bs_kv)
+        self.prefill_chunk = int(prefill_chunk)
         S = self.decoder.cache.max_seqs
         self._step_jit = _build_step(self.decoder, embed, self.sampler.top_k,
                                      self._cap)
@@ -293,6 +346,10 @@ class ServingEngine:
         self._temps = np.zeros((S,), np.float32)
         self._by_slot: Dict[int, _Active] = {}
         self._queue: List[_Active] = []
+        # admitted-but-partially-prefilled requests, FIFO; the head gets at
+        # most one chunk per scheduler iteration (also in _by_slot, with
+        # _active_mask False until the final chunk samples the first token)
+        self._prefilling: List[_Active] = []
         self._lock = threading.RLock()
         self._work = threading.Condition(self._lock)
         self._stop = threading.Event()
@@ -350,6 +407,17 @@ class ServingEngine:
             buckets=(1, 2, 4, 8, 16, 32, 64))
         self._h_chunk_ms = self.metrics.histogram(
             "serving.decode_chunk_ms", "dispatch+readback wall per chunk")
+        self._c_pf_chunks = self.metrics.counter(
+            "serving.prefill_chunks", "interleaved prefill chunks executed "
+            "(chunked prefill, ISSUE 9; monolithic prefills count zero)")
+        self._h_pf_chunk_tokens = self.metrics.histogram(
+            "serving.prefill_chunk_tokens", "prompt tokens per interleaved "
+            "prefill chunk",
+            buckets=(16, 32, 64, 128, 256, 512, 1024, 2048))
+        self._h_stall = self.metrics.histogram(
+            "serving.decode_stall_ms", "prefill wall (whole prompt, or one "
+            "chunk under chunked prefill) spent while decode-active slots "
+            "sat waiting — the stall chunking bounds")
         self._g_queue = self.metrics.gauge(
             "serving.queue_depth", "requests waiting for a slot")
         self._g_occ = self.metrics.gauge(
@@ -425,6 +493,8 @@ class ServingEngine:
             cache = self.decoder.cache
             return {"host_syncs": syncs, "tokens_out": toks,
                     "decode_chunk": self.decode_chunk,
+                    "prefill_chunk": self.prefill_chunk,
+                    "prefill_chunks": self._c_pf_chunks.value,
                     "host_syncs_per_token": syncs / max(1, toks),
                     "nonfinite_chunks": self._c_nonfinite.value,
                     "queue_depth": len(self._queue),
@@ -519,8 +589,38 @@ class ServingEngine:
             self._h_queue_wait.observe(t_adm0 - act.t_submit)
             act.timeline.append({"phase": "queue", "t0": act.t_submit,
                                  "t1": t_adm0, "retries": act.retries})
-            toks = np.asarray(req.tokens, np.int32)  # sync-ok: host list
             shared = plan.shared_len
+            act.prefilled = act.shared_len = shared
+            if shared:
+                self._c_prefix_hits.inc()
+                self._c_prefix_tokens.inc(shared)
+            # decode-side slot state is prefill-order independent — install
+            # it at admission for both the monolithic and chunked paths
+            # (the slot stays decode-inactive until the first token exists)
+            self._plens = self._plens.at[slot].set(plen)
+            self._eos = self._eos.at[slot].set(
+                -1 if req.eos_id is None else int(req.eos_id))
+            self._maxgen = self._maxgen.at[slot].set(int(req.max_new_tokens))
+            self._temps[slot] = req.temperature
+            self._by_slot[slot] = act
+            self._resident_seqs_max = max(self._resident_seqs_max,
+                                          len(self._by_slot))
+            self._c_admits.inc()
+            telemetry.instant("admit", req=act.req_id, slot=slot, plen=plen,
+                              retries=act.retries, queued=len(self._queue))
+            if self.prefill_chunk and plen - shared > self.prefill_chunk:
+                # chunked prefill (ISSUE 9): the reservation is held but
+                # the prompt pass is deferred — one bounded chunk per
+                # scheduler iteration (_prefill_step) interleaved with
+                # resident decode, instead of one decode-stalling dispatch
+                act.timeline.append(
+                    {"phase": "admission", "t0": t_adm0,
+                     "t1": time.monotonic(), "slot": slot,
+                     "blocks": plan.n_blocks, "shared": shared})
+                self._prefilling.append(act)
+                self._update_kv_resident()
+                continue
+            toks = np.asarray(req.tokens, np.int32)  # sync-ok: host list
             # compile attribution: each prefill jit retraces once per
             # power-of-two bucket — first sighting is a cache miss. The
             # shared path buckets on (suffix length, gathered blocks).
@@ -544,6 +644,7 @@ class ServingEngine:
             act.timeline.append({"phase": "admission", "t0": t_adm0,
                                  "t1": t_pf_mono, "slot": slot,
                                  "blocks": plan.n_blocks, "shared": shared})
+            had_active = bool(self._active_mask.any())
             with cm, telemetry.span("prefill", req=act.req_id, slot=slot,
                                     plen=plen, bucket=bucket, shared=shared):
                 if shared:
@@ -554,60 +655,128 @@ class ServingEngine:
                         self.embed(jnp.asarray(toks[shared:]))).T
                     lp = self.decoder.prefill_shared(slot, feats, plen,
                                                      shared)
-                    self._c_prefix_hits.inc()
-                    self._c_prefix_tokens.inc(shared)
                 else:
                     # sync-ok: admission prefill input prep (scheduling event)
                     feats = np.asarray(self.embed(jnp.asarray(toks))).T
                     lp = self.decoder.prefill(slot, feats)
-            cache.register_prefix(slot, req.tokens)
-            t0 = sample_tokens(self.sampler.next_key(), lp[None],
-                               jnp.full((1,), req.temperature, jnp.float32),
-                               self.sampler.top_k)[0]
-            act.n_generated = 1
-            if self.capture_logprobs:
-                act.logprobs = [np.asarray(lp)]  # sync-ok: capture_logprobs mode
-            self._hist = self._hist.at[slot, 0].set(t0)
-            self._last = self._last.at[slot].set(t0)
-            self._plens = self._plens.at[slot].set(len(req.tokens))
-            self._eos = self._eos.at[slot].set(
-                -1 if req.eos_id is None else int(req.eos_id))
-            self._maxgen = self._maxgen.at[slot].set(int(req.max_new_tokens))
-            self._temps[slot] = req.temperature
-            self._active_mask[slot] = True
+            if had_active:
+                # a monolithic prefill ran while decode-active slots sat
+                # waiting — the full-prompt stall chunked prefill bounds
+                self._h_stall.observe((time.perf_counter() - t_pf) * 1e3)
+            name = f"prefill_shared_b{skey[0]}k{skey[1]}" if shared \
+                else f"prefill_b{bucket}"
+            self._finish_first_token(
+                act, lp, t_pf, t_pf_mono,
+                {"plen": plen, "bucket": bucket, "shared": shared},
+                prof_name=name)
+
+    def _finish_first_token(self, act: _Active, lp, t_pf: float,
+                            t_pf_mono: float, extras: dict,
+                            prof_name: Optional[str] = None) -> None:
+        """Prefill completed for `act` (monolithic, or the final chunk):
+        register the now-resident prompt with the prefix registry, sample
+        the first token, activate the slot's decode state, and stamp the
+        "prefill" timeline event [t_pf_mono, first-token readback]. The
+        single counted admission readback (first token) lives here. Lock
+        held."""
+        req, slot = act.req, act.slot
+        self.decoder.cache.register_prefix(slot, req.tokens)
+        t0 = sample_tokens(self.sampler.next_key(), lp[None],
+                           jnp.full((1,), req.temperature, jnp.float32),
+                           self.sampler.top_k)[0]
+        act.n_generated = 1
+        act.prefilled = len(req.tokens)
+        if self.capture_logprobs:
+            act.logprobs = [np.asarray(lp)]  # sync-ok: capture_logprobs mode
+        self._hist = self._hist.at[slot, 0].set(t0)
+        self._last = self._last.at[slot].set(t0)
+        self._active_mask[slot] = True
+        if self._dev_active is not None:
+            self._dev_active = self._dev_active.at[slot].set(True)
+        with telemetry.span("host_sync", what="first_token", slot=slot):
+            first = int(t0)        # admission readback (scheduling event)
+        self._c_syncs.inc()
+        self._c_tokens.inc()
+        act.t_first = time.monotonic()
+        act.timeline.append({"phase": "prefill", "t0": t_pf_mono,
+                             "t1": act.t_first, **extras})
+        if prof_name is not None and _profiler.enabled():
+            # the admission's device work (prefill dispatch + first
+            # sample + the counted readback), from the host wall the
+            # scheduler already measures — no added sync
+            _profiler.observe(prof_name, (time.perf_counter() - t_pf) * 1e3,
+                              registry=self.metrics)
+        self._update_kv_resident()
+        self._h_ttft.observe(act.t_first - act.t_submit)
+        # single-token request: finished at first token
+        if req.max_new_tokens == 1 or (req.eos_id is not None
+                                       and first == req.eos_id):
+            self._active_mask[slot] = False
             if self._dev_active is not None:
-                self._dev_active = self._dev_active.at[slot].set(True)
-            self._by_slot[slot] = act
-            self._resident_seqs_max = max(self._resident_seqs_max,
-                                          len(self._by_slot))
-            with telemetry.span("host_sync", what="first_token", slot=slot):
-                first = int(t0)        # admission readback (scheduling event)
-            self._c_syncs.inc()
-            self._c_tokens.inc()
-            self._c_admits.inc()
-            act.t_first = time.monotonic()
-            act.timeline.append({"phase": "prefill", "t0": t_pf_mono,
-                                 "t1": act.t_first, "plen": plen,
-                                 "bucket": bucket, "shared": shared})
-            if _profiler.enabled():
-                # the admission's device work (prefill dispatch + first
-                # sample + the counted readback), from the host wall the
-                # scheduler already measures — no added sync
-                name = f"prefill_shared_b{skey[0]}k{skey[1]}" if shared \
-                    else f"prefill_b{bucket}"
-                _profiler.observe(name, (time.perf_counter() - t_pf) * 1e3,
-                                  registry=self.metrics)
-            self._update_kv_resident()
-            telemetry.instant("admit", req=act.req_id, slot=slot, plen=plen,
-                              retries=act.retries, queued=len(self._queue))
-            self._h_ttft.observe(act.t_first - act.t_submit)
-            # single-token request: finished at admission
-            if req.max_new_tokens == 1 or (req.eos_id is not None
-                                           and first == req.eos_id):
-                self._active_mask[slot] = False
-                if self._dev_active is not None:
-                    self._dev_active = self._dev_active.at[slot].set(False)
-                self._retire(slot, "shutdown")  # reason fixed inside
+                self._dev_active = self._dev_active.at[slot].set(False)
+            self._retire(slot, "shutdown")  # reason fixed inside
+
+    def _prefill_step(self) -> None:
+        """Run AT MOST ONE prefill chunk per scheduler iteration (the head
+        of the partially-prefilled FIFO): embed prompt positions
+        [prefilled, prefilled + budget), run the shared-prefix pass with
+        chunk start/end in the shared_len/plen seats — the chunk scatters
+        its K/V through the block table and attends the slot's own earlier
+        blocks via the same gather as prefix-shared prefill — then advance
+        the resident mark. The final chunk samples the first token and
+        activates the slot for decode. The chunk's timeline event tiles
+        from the request's previous event, so partially-prefilled requests
+        keep gap-free coverage while they wait their turn behind other
+        prefills. Lock held."""
+        if not self._prefilling:
+            return
+        act = self._prefilling[0]
+        req, slot = act.req, act.slot
+        plen = len(req.tokens)
+        start = act.prefilled
+        end = min(plen, start + self.prefill_chunk)
+        skey = self.decoder.shared_buckets(end, start)
+        miss = ("prefill_shared", skey) not in self._seen_shapes
+        if miss:
+            self._seen_shapes.add(("prefill_shared", skey))
+            self._c_compiles.inc()
+        cm = telemetry.span("jit_compile", kind="prefill",
+                            bucket=skey[0]) if miss else telemetry.NULL_SPAN
+        had_active = bool(self._active_mask.any())
+        t0_mono = act.timeline[-1]["t1"]   # tile: gap-free while waiting
+        t_pf = time.perf_counter()
+        toks = np.asarray(req.tokens[start:end], np.int32)  # sync-ok: host list
+        with cm, telemetry.span("prefill_chunk", req=act.req_id, slot=slot,
+                                chunk=act.n_chunks, start=start,
+                                tokens=end - start):
+            # sync-ok: prefill-chunk input prep (scheduling event)
+            feats = np.asarray(self.embed(jnp.asarray(toks))).T
+            lp = self.decoder.prefill_chunk(slot, feats, start, end)
+        wall_ms = (time.perf_counter() - t_pf) * 1e3
+        if had_active:
+            # decode-active slots waited on this chunk's dispatch — the
+            # bounded stall that replaces the whole-prompt one
+            self._h_stall.observe(wall_ms)
+        now = time.monotonic()
+        act.timeline.append({"phase": "prefill_chunk", "t0": t0_mono,
+                             "t1": now, "chunk": act.n_chunks,
+                             "tokens": end - start,
+                             "shared": act.shared_len if act.n_chunks == 0
+                             else 0})
+        act.n_chunks += 1
+        act.prefilled = end
+        self._c_pf_chunks.inc()
+        self._h_pf_chunk_tokens.observe(end - start)
+        if _profiler.enabled():
+            _profiler.observe(f"prefill_shared_b{skey[0]}k{skey[1]}",
+                              wall_ms, registry=self.metrics)
+        if end >= plen:
+            self._prefilling.pop(0)
+            self._finish_first_token(
+                act, lp, t_pf, now,
+                {"plen": plen, "chunks": act.n_chunks,
+                 "shared": act.shared_len, "bucket": skey[0]})
+        self._update_kv_resident()
 
     def _retire(self, slot: int, default_reason: str, hist=None) -> None:
         """Resolve the request in `slot` and free it. Lock held. `hist`
@@ -615,6 +784,8 @@ class ServingEngine:
         finished slot's row from the chunk that finished it, so the read
         does not block on the chunk already in flight)."""
         act = self._by_slot.pop(slot)
+        if act in self._prefilling:    # timeout/shutdown mid-prefill
+            self._prefilling.remove(act)
         t_ret0 = time.monotonic()
         n = act.n_generated
         src = self._hist if hist is None else hist
@@ -672,7 +843,7 @@ class ServingEngine:
         live prompt+generated token across active slots, from the host's
         own bookkeeping (no device read). Lock held."""
         cache = self.decoder.cache
-        pos = sum(len(a.req.tokens) + a.n_generated
+        pos = sum(a.prefilled + a.n_generated
                   for a in self._by_slot.values())
         self._g_kv_res.set(pos * self._kv_bytes_per_pos)
         reserved = sum(cache.reserved_positions(a.slot)
@@ -719,16 +890,20 @@ class ServingEngine:
 
     def _chunk_size(self) -> int:
         """Adaptive K: 1 while the admission queue is non-empty (a freed
-        slot is detected within one token — bounded time-to-first-token),
-        else decode_chunk capped at the largest remaining token budget,
-        rounded down to a power of two (bounded set of compiled scan
-        lengths, no over-run waste at the tail)."""
-        if self._queue or self.decode_chunk <= 1:
+        slot is detected within one token — bounded time-to-first-token)
+        or a prefill is mid-chunking (prefill chunks interleave at
+        per-iteration granularity, the Sarathi property), else decode_chunk
+        capped at the largest remaining token budget, rounded down to a
+        power of two (bounded set of compiled scan lengths, no over-run
+        waste at the tail)."""
+        if self._queue or self._prefilling or self.decode_chunk <= 1:
             return 1
-        rem = max(act.req.max_new_tokens - act.n_generated
-                  for slot, act in self._by_slot.items()
-                  if self._active_mask[slot])
-        k = min(self.decode_chunk, max(1, rem))
+        rems = [act.req.max_new_tokens - act.n_generated
+                for slot, act in self._by_slot.items()
+                if self._active_mask[slot]]
+        if not rems:
+            return 1
+        k = min(self.decode_chunk, max(1, max(rems)))
         if k < self.decode_chunk:
             k = 1 << (k.bit_length() - 1)
         return k
@@ -768,20 +943,29 @@ class ServingEngine:
         self._update_kv_resident()
 
     def step(self) -> bool:
-        """One scheduler iteration: admit, decode ONE CHUNK (adaptive K
-        micro-steps, one host sync) for every active slot, retire
-        completions/timeouts. Returns True while any request is active or
-        queued. Synchronous: cross-K token parity is exact (peeked keys,
-        effective-step commit)."""
+        """One scheduler iteration: admit, run at most one prefill chunk
+        for the head partially-prefilled request, decode ONE CHUNK
+        (adaptive K micro-steps, one host sync) for every active slot,
+        retire completions/timeouts. Returns True while any request is
+        active or queued. Synchronous: cross-K token parity is exact
+        (peeked keys, effective-step commit)."""
         with self._lock:
             t_iter0 = time.monotonic()   # iteration start: timeline anchor
             self._admit()
             if not self._by_slot:
                 return bool(self._queue)
             self._expire_timeouts()
-            if not self._by_slot:
-                return bool(self._queue)
-            snapshot = dict(self._by_slot)
+            self._prefill_step()
+            if not self._active_mask.any():
+                # nothing decode-active: every resident slot is mid-prefill
+                # (or the final chunk's 1-token request just retired)
+                return bool(self._by_slot or self._queue)
+            # decode-active slots only: a partially-prefilled slot must not
+            # be judged by a chunk dispatched while it was still inactive
+            # (its all-False mask would retire it the moment the final
+            # prefill chunk activates it)
+            snapshot = {s: a for s, a in self._by_slot.items()
+                        if self._active_mask[s]}
             active = jnp.asarray(self._active_mask)
             k_eff = self._chunk_size()
             t_chunk = time.perf_counter()
@@ -861,6 +1045,10 @@ class ServingEngine:
                     # this iteration's admissions + the dispatch it issues
                     self._admit()
                     self._expire_timeouts()
+                    # at most one prefill chunk per iteration: the chunk's
+                    # dispatch threads cache_state, so it serializes with
+                    # the decode chunks on device without blocking the host
+                    self._prefill_step()
                     dispatched = None
                     if self._active_mask.any():
                         k_eff = self._chunk_size()
@@ -879,7 +1067,12 @@ class ServingEngine:
                             k=k_eff) if miss else telemetry.NULL_SPAN
                         keys = self.sampler.peek_keys(k_eff)
                         self.sampler.advance(k_eff)
-                        snapshot = dict(self._by_slot)
+                        # decode-active slots only (see step()): a slot whose
+                        # final prefill chunk lands between this dispatch and
+                        # its mask readback must not be retired by the stale
+                        # all-False mask it never participated in
+                        snapshot = {s: a for s, a in self._by_slot.items()
+                                    if self._active_mask[s]}
                         with cm, telemetry.span(
                                 "decode_chunk", k=k_eff, overlap=True,
                                 active=int(self._active_mask.sum())):

@@ -54,6 +54,15 @@ Safety invariants:
   shared prefix length; admission maps the block containing the first
   such write as a fresh copy (COW), so refcount >= 2 implies no writer.
 
+CHUNKED prefill (ISSUE 9) leans on the exact same primitives: each chunk
+scatters through `write_positions` (start..end of the slot's mapped
+blocks) and advances `lengths` to the chunk end via `set_length`, so a
+partially-prefilled slot is just a resident slot whose visible length
+lags its reservation — decode iterations running between chunks can
+never see (VISIBILITY) or clobber (TRASH ROUTING) its pending tail.
+`register_prefix` is only called once the FULL prompt is resident, so a
+half-prefilled sequence is never offered as a sharing donor.
+
 Host-side management (slot free list, block allocator, prefix registry,
 eviction) lives in `KVCache`; the device arrays are a plain dict pytree
 (`state`) threaded through the jitted steps. Both free lists are heapqs —

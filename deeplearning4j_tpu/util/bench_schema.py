@@ -12,7 +12,11 @@ always present and, when measured, must carry the savings fields the
 docs render). ISSUE 8 adds `serving_slo` (the open-loop goodput/SLO
 observatory — also CPU-runnable and always present; measured entries
 must carry offered_rate/goodput/ttft_p99_s/slo_attained_frac/seed/
-platform plus a well-formed attainment curve). bench.py calls
+platform plus a well-formed attainment curve). ISSUE 9 adds
+`serving_chunked_prefill` (the chunked-prefill A/B — CPU-runnable and
+always present; measured entries must carry a numeric chunk_budget,
+off/on sides with the tail stats the docs render, and the delta
+fields). bench.py calls
 `assert_valid` on the dict it is about to print, and
 tests/test_bench_schema.py re-validates the committed artifact, so the
 contract holds at write time and at review time.
@@ -130,6 +134,51 @@ def validate_artifact(art: dict) -> List[str]:
                     errs.append(f"serving_slo.attainment[{i}] must carry "
                                 "numeric offered_rate/goodput/"
                                 "slo_attained_frac")
+
+    # chunked-prefill A/B (ISSUE 9): CPU-runnable and always present;
+    # when measured it must carry the chunk budget, both sides of the
+    # A/B with the tail stats the docs render, and the delta fields
+    cp = e.get("serving_chunked_prefill")
+    if not isinstance(cp, dict):
+        errs.append("extra['serving_chunked_prefill'] missing or not a "
+                    "dict (the A/B runs on any platform — emit error/"
+                    "skipped entries rather than dropping it)")
+    elif "error" not in cp and "skipped_reason" not in cp:
+        if not isinstance(cp.get("platform"), str):
+            errs.append("extra['serving_chunked_prefill'] has no "
+                        "'platform' label")
+        if not _is_num(cp.get("chunk_budget")) or cp.get("chunk_budget", 0) \
+                <= 0:
+            errs.append("extra['serving_chunked_prefill'].chunk_budget "
+                        "missing or not a positive number")
+        for side in ("off", "on"):
+            s = cp.get(side)
+            if not isinstance(s, dict) or not all(
+                    _is_num(s.get(k)) for k in
+                    ("goodput", "ttft_p99_s", "slo_attained_frac")):
+                errs.append(f"serving_chunked_prefill.{side} must carry "
+                            "numeric goodput/ttft_p99_s/slo_attained_frac")
+        on = cp.get("on")
+        if isinstance(on, dict) and on.get("prefill_chunks", 1) == 0:
+            errs.append("serving_chunked_prefill.on ran zero prefill "
+                        "chunks — the ON side never actually chunked")
+        d = cp.get("deltas")
+        if not isinstance(d, dict):
+            errs.append("extra['serving_chunked_prefill'].deltas missing "
+                        "or not a dict")
+        else:
+            for k in ("ttft_p99_delta_ms", "tpot_p99_delta_ms",
+                      "decode_stall_p99_delta_ms"):
+                if not _is_num(d.get(k)):
+                    errs.append(f"serving_chunked_prefill.deltas.{k} "
+                                "missing or not a number")
+            # msr comes from a coarse bisection and may legitimately be
+            # None (never sustained at any probed rate on either side)
+            msr = d.get("max_sustainable_rate_delta")
+            if msr is not None and not _is_num(msr):
+                errs.append("serving_chunked_prefill.deltas."
+                            "max_sustainable_rate_delta must be numeric "
+                            "or null")
 
     # every measurement dict carries a platform label
     for name, entry in e.items():

@@ -120,6 +120,41 @@ def _serving_slo_lines(ss) -> list:
     return lines
 
 
+def _chunked_prefill_lines(cp) -> list:
+    """Chunked-prefill A/B section from extra['serving_chunked_prefill']
+    (ISSUE 9): same-budget same-rate open-loop deltas, chunking ON vs
+    OFF, on a long-prompt-heavy mix."""
+    if not isinstance(cp, dict) or not isinstance(cp.get("deltas"), dict):
+        if isinstance(cp, dict) and cp.get("skipped_reason"):
+            return [f"- Chunked-prefill A/B: {cp['skipped_reason']} "
+                    f"(platform: {cp.get('platform', '?')})."]
+        return []
+    d = cp["deltas"]
+    on, off = cp.get("on") or {}, cp.get("off") or {}
+
+    def _ms(v):
+        return "n/a" if v is None else f"{v:+.2f} ms"
+
+    line = (
+        f"- Chunked prefill (ISSUE 9 A/B, {cp.get('platform', '?')}, "
+        f"budget {cp.get('chunk_budget', '?')} tokens/chunk): on a "
+        f"long-prompt-heavy open-loop mix at identical budgets/rates/seed, "
+        f"chunking ON moves the overloaded-point tails by TTFT p99 "
+        f"{_ms(d.get('ttft_p99_delta_ms'))}, TPOT p99 "
+        f"{_ms(d.get('tpot_p99_delta_ms'))}, and bounds decode stalls: "
+        f"stall p99 {_ms(d.get('decode_stall_p99_delta_ms'))} "
+        f"(ON {on.get('decode_stall_p99_ms', '?')} ms vs OFF "
+        f"{off.get('decode_stall_p99_ms', '?')} ms; positive = ON better)")
+    msr = d.get("max_sustainable_rate_delta")
+    if msr is not None:
+        line += (f"; max sustainable rate {msr:+.2f} req/s vs chunking "
+                 f"off")
+    line += (f". ON ran {on.get('prefill_chunks', '?')} prefill chunks "
+             f"(OFF: monolithic). `DL4J_TPU_PREFILL_CHUNK` — see PERF.md "
+             f"\"Chunked prefill\".")
+    return [line]
+
+
 def render_block(art: dict) -> str:
     """Markdown bullet block rendered VERBATIM into README.md and PERF.md."""
     e = art["extra"]
@@ -271,6 +306,7 @@ def render_block(art: dict) -> str:
                 f"{cap.get('slot_equivalent_ceiling', '?')}.")
         lines.append(line)
     lines.extend(_serving_slo_lines(e.get("serving_slo")))
+    lines.extend(_chunked_prefill_lines(e.get("serving_chunked_prefill")))
     lines.extend(_roofline_table_lines(e.get("roofline_table")))
     lines.append(
         f"- ParallelWrapper ResNet50: {pw['images_per_sec']:,.0f} img/s — "

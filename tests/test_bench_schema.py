@@ -42,6 +42,17 @@ def _minimal_art():
                      "slo_attained_frac": 0.95},
                     {"offered_rate": 200.0, "goodput": 100.0,
                      "slo_attained_frac": 0.8}]},
+            "serving_chunked_prefill": {
+                "platform": "cpu", "chunk_budget": 128,
+                "off": {"goodput": 50.0, "ttft_p99_s": 0.05,
+                        "slo_attained_frac": 1.0, "prefill_chunks": 0},
+                "on": {"goodput": 55.0, "ttft_p99_s": 0.04,
+                       "slo_attained_frac": 1.0, "prefill_chunks": 64},
+                "deltas": {"ttft_p99_delta_ms": 10.0,
+                           "tpot_p99_delta_ms": 1.0,
+                           "decode_stall_p99_delta_ms": 2.0,
+                           "queue_wait_share_delta": 0.05,
+                           "max_sustainable_rate_delta": 0.0}},
             "roofline_table": [
                 {"function": "train_step", "platform": "tpu",
                  "flops": 1e12, "bytes_accessed": 1e9,
@@ -136,6 +147,50 @@ def test_serving_slo_rules():
     assert validate_artifact(art) == []
 
 
+def test_chunked_prefill_ab_rules():
+    """ISSUE 9: the chunked-prefill A/B must always exist; a measured
+    entry needs a positive chunk budget, both A/B sides with the tail
+    stats, the delta fields, and an ON side that actually chunked;
+    skipped and errored entries are exempt."""
+    art = _minimal_art()
+    del art["extra"]["serving_chunked_prefill"]
+    assert any("serving_chunked_prefill" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["serving_chunked_prefill"]["chunk_budget"] = 0
+    assert any("chunk_budget" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["serving_chunked_prefill"]["off"]["goodput"]
+    assert any("serving_chunked_prefill.off" in e
+               for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["serving_chunked_prefill"]["on"]["prefill_chunks"] = 0
+    assert any("never actually chunked" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["serving_chunked_prefill"]["deltas"]
+    assert any("deltas" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["serving_chunked_prefill"]["deltas"][
+        "decode_stall_p99_delta_ms"]
+    assert any("decode_stall_p99_delta_ms" in e
+               for e in validate_artifact(art))
+    # a null msr delta is legal (bisection may not sustain at any rate)
+    art = _minimal_art()
+    art["extra"]["serving_chunked_prefill"]["deltas"][
+        "max_sustainable_rate_delta"] = None
+    assert validate_artifact(art) == []
+    art["extra"]["serving_chunked_prefill"]["deltas"][
+        "max_sustainable_rate_delta"] = "oops"
+    assert any("max_sustainable_rate_delta" in e
+               for e in validate_artifact(art))
+    # skipped / errored entries are exempt from the measured-field rules
+    art = _minimal_art()
+    art["extra"]["serving_chunked_prefill"] = {"error": "ValueError: boom"}
+    assert validate_artifact(art) == []
+    art["extra"]["serving_chunked_prefill"] = {"platform": "cpu",
+                                               "skipped_reason": "why not"}
+    assert validate_artifact(art) == []
+
+
 def test_goodput_dict_is_a_measurement_needing_platform():
     art = _minimal_art()
     art["extra"]["some_slo_thing"] = {"goodput": 5.0}
@@ -207,3 +262,14 @@ def test_committed_artifact_passes_schema():
     assert ss["flight_recorder"]["perfetto_valid"] is True
     assert ss["full_sweep"].get("skipped_reason") or \
         ss["full_sweep"].get("goodput") is not None
+    # ISSUE 9 acceptance: the committed chunked-prefill A/B shows a
+    # decode-stall / TPOT-tail improvement with max sustainable rate no
+    # worse than chunking off, and the ON side really chunked
+    cp = e["serving_chunked_prefill"]
+    assert "error" not in cp and "skipped_reason" not in cp
+    assert cp["on"]["prefill_chunks"] > 0
+    d = cp["deltas"]
+    assert d["decode_stall_p99_delta_ms"] > 0
+    assert d["tpot_p99_delta_ms"] > 0
+    if d["max_sustainable_rate_delta"] is not None:
+        assert d["max_sustainable_rate_delta"] >= 0

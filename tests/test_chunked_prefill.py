@@ -1,0 +1,277 @@
+"""Chunked prefill tests (ISSUE 9, Sarathi-style).
+
+The load-bearing guarantee: splitting a prompt's prefill into bounded
+chunks interleaved with resident decode is TOKEN-IDENTICAL to monolithic
+prefill — every captured logprob row still matches the fp64 full-recompute
+oracle at every position (chunk i attends chunks 0..i-1 through the same
+block-table gather as prefix-shared prefill), for MLN and ComputationGraph
+stacks, across chunk budgets {block, 2x block, >= prompt}, with prefix
+sharing on/off, mid-stream admission, and sliding-window attention. The
+scheduling discipline is also pinned: at the same single-request schedule,
+chunked prefill adds ZERO counted host syncs versus chunking off
+(bit-parity, greedy — chunking defers the admission PRNG key, so only
+temperature-0 streams are schedule-independent).
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import (Activation, InputType,
+                                NeuralNetConfiguration, RnnOutputLayer)
+from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
+from deeplearning4j_tpu.nn.graph.computation_graph import ComputationGraph
+from deeplearning4j_tpu.serving import Request, ServingEngine
+from deeplearning4j_tpu.telemetry.flight_recorder import max_gap_s
+from tests.test_serving import V, _assert_parity, _build_net
+
+PROMPT = [1, 5, 2, 9, 3, 7, 4, 8, 6, 1, 2, 3, 11]      # ragged: plen 13
+
+
+def _engine(net, *, prefill_chunk, **kw):
+    cfg = dict(max_seqs=2, max_len=64, seed=0, capture_logprobs=True,
+               overlap=False, kv_block=4, prefill_chunk=prefill_chunk)
+    cfg.update(kw)
+    return ServingEngine(net, **cfg)
+
+
+# ------------------------------------------------------------ oracle parity
+@pytest.mark.parametrize("budget", [4, 8, 64])   # block, 2x block, >= prompt
+def test_chunked_prefill_oracle_parity_across_budgets(budget):
+    """Chunked prefill equals the fp64 oracle AND the monolithic engine's
+    token stream at every tested budget (>= prompt falls back to the
+    monolithic path — same tokens by construction)."""
+    net = _build_net()
+    eng = _engine(net, prefill_chunk=budget)
+    res = eng.generate([Request(PROMPT, max_new_tokens=6)])[0]
+    assert res.finish_reason == "length" and len(res.tokens) == 6
+    _assert_parity(net, res, PROMPT)
+    off = _engine(net, prefill_chunk=0).generate(
+        [Request(PROMPT, max_new_tokens=6)])[0]
+    assert res.tokens == off.tokens
+    st = eng.stats()
+    expect_chunks = -(-len(PROMPT) // budget) if budget < len(PROMPT) else 0
+    assert st["prefill_chunks"] == expect_chunks
+
+
+@pytest.mark.parametrize("n_kv", [2, 1])
+def test_chunked_prefill_gqa_parity(n_kv):
+    """GQA and MQA heads through the chunk pass stay on the oracle."""
+    net = _build_net(n_kv=n_kv)
+    res = _engine(net, prefill_chunk=4).generate(
+        [Request(PROMPT, max_new_tokens=5)])[0]
+    _assert_parity(net, res, PROMPT)
+
+
+def test_chunked_prefill_sliding_window_parity():
+    """The chunk's window mask applies against absolute cache positions:
+    a chunk whose window reaches back into EARLIER chunks' blocks still
+    matches the dense-recompute oracle."""
+    net = _build_net(window=3)
+    eng = _engine(net, prefill_chunk=4, max_seqs=1)
+    res = eng.generate([Request(PROMPT, max_new_tokens=5)])[0]
+    _assert_parity(net, res, PROMPT)
+    assert eng.stats()["prefill_chunks"] == 4
+
+
+def test_chunked_prefill_computation_graph_parity():
+    """Linear-chain ComputationGraph prompts chunk identically to MLN."""
+    conf = (NeuralNetConfiguration.Builder().seed(5).dtype("float64")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("attn", SelfAttentionLayer(n_out=8, n_heads=2,
+                                                  causal=True, block_size=0),
+                       "in")
+            .add_layer("out", RnnOutputLayer(n_out=V,
+                                             activation=Activation.SOFTMAX),
+                       "attn")
+            .set_outputs("out")
+            .set_input_types(InputType.recurrent(V)).build())
+    net = ComputationGraph(conf).init()
+    eng = _engine(net, prefill_chunk=4)
+    res = eng.generate([Request(PROMPT, max_new_tokens=5)])[0]
+    _assert_parity(net, res, PROMPT)
+    off = _engine(net, prefill_chunk=0).generate(
+        [Request(PROMPT, max_new_tokens=5)])[0]
+    assert res.tokens == off.tokens
+
+
+def test_chunked_prefill_with_prefix_sharing():
+    """A prefix-shared admission chunks only its UNSHARED suffix: the
+    resident prefix is skipped entirely, later chunks attend shared blocks
+    + earlier chunks through one gather, and the tokens match both the
+    oracle and the sharing-on/chunking-off engine. The sharer arrives
+    MID-STREAM while the donor decodes (blocks must be resident to
+    share)."""
+    net = _build_net()
+    shared_head = [1, 5, 2, 9, 3, 7, 4, 8]        # two full kv_block=4 blocks
+    p1 = shared_head + [3]
+    p2 = shared_head + [7, 4, 8, 6, 1, 2, 3, 11, 5, 9, 2]
+
+    def serve(prefill_chunk):
+        # decode_chunk=1 keeps the donor resident while the sharer arrives
+        eng = _engine(net, prefill_chunk=prefill_chunk, prefix_share=True,
+                      decode_chunk=1)
+        f1 = eng.submit(Request(p1, max_new_tokens=10))
+        for _ in range(6):             # donor fully prefilled + decoding
+            eng.step()
+        f2 = eng.submit(Request(p2, max_new_tokens=5))
+        eng.drain()
+        return eng, f1.get(timeout=0), f2.get(timeout=0)
+
+    eng_on, d_on, r_on = serve(4)
+    st = eng_on.stats()
+    assert st["prefix_hits"] == 1 and st["prefix_shared_tokens"] == 8
+    # the sharer's 11-token unshared suffix chunked at the budget (the
+    # 9-token donor chunked too)
+    assert st["prefill_chunks"] >= 5
+    _assert_parity(net, d_on, p1)
+    _assert_parity(net, r_on, p2)
+    _, d_off, r_off = serve(0)
+    assert r_on.tokens == r_off.tokens and d_on.tokens == d_off.tokens
+    # chunk 0 carries the shared-skip annotation; later chunks don't
+    chunks = [e for e in r_on.timeline if e["phase"] == "prefill_chunk"]
+    assert chunks[0]["shared"] == 8
+    assert all(c["shared"] == 0 for c in chunks[1:])
+    assert sum(c["tokens"] for c in chunks) == len(p2) - 8
+
+
+def test_chunked_prefill_mid_stream_admission():
+    """The Sarathi scenario: a long prompt admitted WHILE another slot
+    decodes prefills one chunk per iteration instead of stalling the
+    resident stream — and neither request's tokens move."""
+    net = _build_net(n_kv=2)
+    eng = _engine(net, prefill_chunk=4, seed=7)
+    p1 = [1, 2, 3, 4, 5, 6, 7]
+    f1 = eng.submit(Request(p1, max_new_tokens=10))
+    for _ in range(4):                 # first request decodes alone...
+        eng.step()
+    f2 = eng.submit(Request(PROMPT, max_new_tokens=6))  # ...long one arrives
+    eng.drain()
+    r1, r2 = f1.get(timeout=0), f2.get(timeout=0)
+    assert len(r1.tokens) == 10 and len(r2.tokens) == 6
+    _assert_parity(net, r1, p1)
+    _assert_parity(net, r2, PROMPT)
+    # p1 (7 tokens -> 2 chunks) + PROMPT (13 tokens -> 4 chunks)
+    assert eng.stats()["prefill_chunks"] == 6
+    # determinism: the resident request alone produces the same tokens
+    alone = _engine(net, prefill_chunk=4, seed=0).generate(
+        [Request(p1, max_new_tokens=10)])[0]
+    assert alone.tokens == r1.tokens
+
+
+# --------------------------------------------------------- sync discipline
+def test_chunked_prefill_host_sync_bit_parity():
+    """At the same schedule (single request, sequential), chunked prefill
+    adds ZERO counted host syncs: chunk dispatches are input prep +
+    device work, and the only admission readback is still the one first
+    token. Bit-parity on host_syncs AND tokens, chunking on vs off."""
+    net = _build_net()
+
+    def serve(prefill_chunk):
+        eng = ServingEngine(net, max_seqs=1, max_len=64, seed=4,
+                            decode_chunk=4, overlap=False, kv_block=4,
+                            prefill_chunk=prefill_chunk)
+        res = eng.generate([Request(PROMPT, max_new_tokens=10)])
+        st = eng.stats()
+        eng.shutdown()
+        return [r.tokens for r in res], st
+
+    toks_on, st_on = serve(4)
+    toks_off, st_off = serve(0)
+    assert toks_on == toks_off
+    assert st_on["prefill_chunks"] == 4 and st_off["prefill_chunks"] == 0
+    assert st_on["host_syncs"] == st_off["host_syncs"]
+    assert st_on["host_syncs_per_token"] == st_off["host_syncs_per_token"]
+
+
+def test_chunked_prefill_overlap_mode_matches_sync():
+    """The overlapped drain pipeline interleaves chunks the same way the
+    synchronous scheduler does (greedy tokens identical), and resident
+    timelines stay gap-free through mixed iterations."""
+    net = _build_net()
+
+    def serve(overlap):
+        eng = ServingEngine(net, max_seqs=2, max_len=64, seed=0,
+                            decode_chunk=4, overlap=overlap, kv_block=4,
+                            prefill_chunk=4)
+        res = eng.generate([Request(PROMPT, max_new_tokens=8),
+                            Request([8, 9, 10], max_new_tokens=6)])
+        st = eng.stats()
+        eng.shutdown()
+        return res, st
+
+    res_ov, st_ov = serve(True)
+    res_sync, st_sync = serve(False)
+    assert [r.tokens for r in res_ov] == [r.tokens for r in res_sync]
+    assert st_ov["prefill_chunks"] == st_sync["prefill_chunks"] >= 1
+    for r in res_ov + res_sync:
+        period = max(e["t1"] - e["t0"] for e in r.timeline)
+        assert max_gap_s(r.timeline) <= period
+
+
+# ------------------------------------------------------- timeline structure
+def test_chunked_prefill_timeline_structure():
+    """prefill_chunk spans carry (chunk index, tokens, shared-skip), tile
+    gap-free between admission and the final prefill span, and their token
+    counts sum to the unshared prompt length."""
+    net = _build_net()
+    eng = _engine(net, prefill_chunk=4, max_seqs=1)
+    res = eng.generate([Request(PROMPT, max_new_tokens=4)])[0]
+    phases = [e["phase"] for e in res.timeline]
+    assert phases[0] == "queue" and phases[-1] == "retire"
+    chunks = [e for e in res.timeline if e["phase"] == "prefill_chunk"]
+    assert [c["chunk"] for c in chunks] == list(range(4))
+    assert [c["tokens"] for c in chunks] == [4, 4, 4, 1]
+    assert sum(c["tokens"] for c in chunks) == len(PROMPT)
+    # chunk phases sit between admission and the first-token prefill span
+    assert phases.index("admission") < phases.index("prefill_chunk") \
+        < phases.index("prefill")
+    # chunk/prefill spans tile exactly; decode iterations may leave
+    # sub-iteration scheduling gaps (the existing gap-free bar)
+    period = max(e["t1"] - e["t0"] for e in res.timeline)
+    assert max_gap_s(res.timeline) <= max(period, 1e-3)
+    assert res.timeline_phases()["prefill_chunk"] > 0
+    # the "prefill" span under chunking covers final-chunk-end -> first
+    # token; the chunks carry the prompt pass itself
+    pf = next(e for e in res.timeline if e["phase"] == "prefill")
+    assert pf["chunks"] == 4 and pf["plen"] == len(PROMPT)
+
+
+# ----------------------------------------------------------- knob plumbing
+def test_prefill_chunk_env_knob_and_validation(monkeypatch):
+    net = _build_net()
+    monkeypatch.setenv("DL4J_TPU_PREFILL_CHUNK", "8")
+    eng = ServingEngine(net, max_seqs=1, max_len=32, kv_block=4)
+    assert eng.prefill_chunk == 8 and eng.stats()["prefill_chunk"] == 8
+    monkeypatch.setenv("DL4J_TPU_PREFILL_CHUNK", "0")
+    eng = ServingEngine(net, max_seqs=1, max_len=32, kv_block=4)
+    assert eng.prefill_chunk == 0
+    monkeypatch.delenv("DL4J_TPU_PREFILL_CHUNK")
+    # explicit argument wins over env; budget rounds DOWN to block
+    # granularity (floor one block) so chunk edges land on block edges
+    eng = ServingEngine(net, max_seqs=1, max_len=32, kv_block=4,
+                        prefill_chunk=10)
+    assert eng.prefill_chunk == 8
+    eng = ServingEngine(net, max_seqs=1, max_len=32, kv_block=4,
+                        prefill_chunk=3)
+    assert eng.prefill_chunk == 4
+    with pytest.raises(ValueError):
+        ServingEngine(net, max_seqs=1, max_len=32, prefill_chunk=-1)
+
+
+def test_chunked_prefill_timeout_mid_prefill_frees_blocks():
+    """A request that expires between chunks retires cleanly: reservation
+    freed, no tokens, and the engine keeps serving."""
+    net = _build_net()
+    eng = _engine(net, prefill_chunk=4, max_seqs=1)
+    f = eng.submit(Request(PROMPT, max_new_tokens=4, timeout_s=1e9))
+    eng.step()                          # admit + first chunk only
+    act = eng._by_slot[0]
+    assert 0 < act.prefilled < len(PROMPT)
+    act.deadline = -1.0                 # force expiry before the next chunk
+    eng.drain()
+    res = f.get(timeout=1)
+    assert res.finish_reason == "timeout" and res.tokens == []
+    assert eng.decoder.cache.n_free == 1
+    assert eng.stats()["kv_blocks_free"] == eng.decoder.cache.num_blocks
+    follow = eng.generate([Request([1, 2, 3], max_new_tokens=3)])[0]
+    assert len(follow.tokens) == 3

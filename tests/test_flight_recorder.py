@@ -183,6 +183,30 @@ def test_engine_timeline_gap_free_in_overlap_mode():
     eng.shutdown()
 
 
+@pytest.mark.parametrize("overlap", [False, True])
+def test_chunked_prefill_timeline_gap_free_and_recorded(overlap):
+    """ISSUE 9 satellite: chunked-prefill lifecycles keep the gap-free
+    coverage invariant in sync AND overlapped modes, and the retained
+    flight-recorder timeline carries the prefill_chunk spans."""
+    fr = FlightRecorder(capacity=8, worst_k=8)
+    eng = ServingEngine(_build_net(), max_seqs=2, max_len=64, seed=0,
+                        decode_chunk=4, overlap=overlap, kv_block=4,
+                        prefill_chunk=4, flight_recorder=fr)
+    long_prompt = [1, 5, 2, 9, 3, 7, 4, 8, 6, 1, 2, 3, 11]
+    res = eng.generate([Request(long_prompt, max_new_tokens=8),
+                        Request([4, 5, 6], max_new_tokens=6)])
+    for r in res:
+        period = max(e["t1"] - e["t0"] for e in r.timeline)
+        assert max_gap_s(r.timeline) <= period
+    phases = [e["phase"] for e in res[0].timeline]
+    assert phases[0] == "queue" and phases[-1] == "retire"
+    assert sum(p == "prefill_chunk" for p in phases) == 4
+    worst = {w["req_id"]: w for w in fr.worst(8)}
+    retained = worst[res[0].req_id]["timeline"]
+    assert any(e["phase"] == "prefill_chunk" for e in retained)
+    eng.shutdown()
+
+
 def test_admission_retries_surface_under_contention():
     # 1 slot, 3 requests: the queued ones see >= 1 failed admission attempt
     eng = _engine(max_seqs=1)

@@ -334,6 +334,57 @@ def test_engine_metrics_and_stats_snapshot():
     assert eng.stats()["host_syncs"] == 0
 
 
+def test_chunked_prefill_metrics_and_exposition():
+    """ISSUE 9 satellite: serving.prefill_chunks / prefill_chunk_tokens /
+    decode_stall_ms are wired into stats(), the registry snapshot, and the
+    global /metrics exposition — fed from host values the scheduler
+    already holds (zero added syncs, same discipline as every other
+    serving metric)."""
+    net = _build_net()
+    eng = ServingEngine(net, max_seqs=2, max_len=64, seed=0, decode_chunk=1,
+                        overlap=False, kv_block=4, prefill_chunk=4)
+    # a resident decoder first, so the long admission's chunks stall it
+    f1 = eng.submit(Request([1, 2, 3], max_new_tokens=10))
+    for _ in range(3):
+        eng.step()
+    f2 = eng.submit(Request([1, 5, 2, 9, 3, 7, 4, 8, 6, 1, 2, 3, 11],
+                            max_new_tokens=4))
+    eng.drain()
+    assert len(f1.get(timeout=0).tokens) == 10
+    assert len(f2.get(timeout=0).tokens) == 4
+    st = eng.stats()
+    assert st["prefill_chunk"] == 4 and st["prefill_chunks"] == 4
+    snap = eng.metrics.snapshot()
+    assert snap["serving.prefill_chunks"] == 4
+    assert snap["serving.prefill_chunk_tokens"]["count"] == 4
+    assert snap["serving.prefill_chunk_tokens"]["sum"] == 13
+    # every chunk ran while f1's slot was decode-active -> each one is a
+    # bounded decode stall observation
+    assert snap["serving.decode_stall_ms"]["count"] == 4
+    text = telemetry.registry().prometheus_text()
+    assert "serving_prefill_chunks" in text
+    assert "serving_prefill_chunk_tokens_bucket" in text
+    assert "serving_decode_stall_ms_bucket" in text
+
+
+def test_monolithic_prefill_records_decode_stall():
+    """With chunking off, a mid-stream admission's WHOLE prompt pass is
+    one decode_stall_ms observation — the unbounded stall the A/B bench
+    measures against."""
+    net = _build_net()
+    eng = ServingEngine(net, max_seqs=2, max_len=64, seed=0, decode_chunk=1,
+                        overlap=False, kv_block=4, prefill_chunk=0)
+    f1 = eng.submit(Request([1, 2, 3], max_new_tokens=8))
+    for _ in range(3):
+        eng.step()
+    eng.submit(Request([1, 5, 2, 9, 3, 7, 4, 8, 6], max_new_tokens=2))
+    eng.drain()
+    snap = eng.metrics.snapshot()
+    assert snap["serving.prefill_chunks"] == 0
+    assert snap["serving.decode_stall_ms"]["count"] == 1
+    assert len(f1.get(timeout=0).tokens) == 8
+
+
 def test_tokens_per_sec_not_none_for_single_token():
     net = _build_net()
     eng = ServingEngine(net, max_seqs=1, max_len=32, seed=0)
