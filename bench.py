@@ -1297,6 +1297,192 @@ def bench_chunked_prefill_ab(chunk=128, vocab=32, d_model=128, heads=2,
                  "(bounded decode stalls), not TPU-scale wall wins")}
 
 
+def bench_sharded_serving(vocab=32, d_model=64, heads=4, kv_heads=2,
+                          tp=2, max_seqs=4, n_requests=24, seed=0,
+                          overload_factor=10.0, repeats=3,
+                          prompt_len_mix=((4, 1.0),),
+                          new_tokens_mix=((8, 1.0),)):
+    """Multi-chip sharded serving (ISSUE 10): two measurements on the
+    forced-host device mesh, both CPU-runnable.
+
+    1. TENSOR-PARALLEL parity + bytes: the TP=2 engine must produce
+       bit-identical greedy tokens to the single-chip engine on the same
+       prompts, with the SAME host-sync count (sharding adds zero
+       syncs/token) and the head-sharded KV pool holding 1/TP of every
+       position's bytes per device.
+    2. DATA-PARALLEL goodput A/B: the open-loop load generator drives a
+       1-replica and a 2-replica ShardedServingGroup at the SAME offered
+       rate (an overload of the single replica, budgets calibrated from
+       its own warm closed-loop pass) — the 2-replica fleet's goodput
+       must exceed the single replica's, since admission routing spreads
+       the queue over both engines.
+
+    Needs >= 2*tp forced host devices
+    (XLA_FLAGS=--xla_force_host_platform_device_count=8); emits a
+    skipped entry otherwise so the artifact never silently drops it."""
+    import jax
+
+    from deeplearning4j_tpu import (
+        Activation, InputType, NeuralNetConfiguration, RnnOutputLayer,
+        Sgd, WeightInit)
+    from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import LoadSpec, ServingEngine
+    from deeplearning4j_tpu.serving import loadgen as _loadgen
+    from deeplearning4j_tpu.serving.sharding import (ShardedServingEngine,
+                                                     ShardedServingGroup)
+    from deeplearning4j_tpu.telemetry import slo as _slo
+
+    n_dev = len(jax.devices())
+    if n_dev < 2 * tp:
+        return {"skipped": True, "devices": n_dev,
+                "skipped_reason": (
+                    f"sharded serving bench needs >= {2 * tp} devices for "
+                    f"TP={tp} parity + the 2-replica goodput A/B, have "
+                    f"{n_dev} — run under XLA_FLAGS="
+                    "--xla_force_host_platform_device_count=8 (CPU) or on "
+                    "a multi-chip TPU slice")}
+
+    b = (NeuralNetConfiguration.Builder().seed(42)
+         .weight_init(WeightInit.XAVIER)
+         .updater(Sgd(learning_rate=1e-3)).list())
+    for _ in range(2):
+        b.layer(SelfAttentionLayer(n_out=d_model, n_heads=heads,
+                                   n_kv_heads=kv_heads, causal=True,
+                                   block_size=0))
+    b.layer(RnnOutputLayer(n_out=vocab, activation=Activation.SOFTMAX))
+    net = MultiLayerNetwork(
+        b.set_input_type(InputType.recurrent(vocab)).build()).init()
+    max_new = max(v for v, _ in new_tokens_mix)
+    max_p = max(v for v, _ in prompt_len_mix)
+    max_len = 1 << (max_p + max_new - 1).bit_length()
+
+    # --- 1. TP parity + per-chip KV bytes --------------------------------
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, vocab, size=rng.randint(3, max_p + 1)).tolist()
+               for _ in range(6)]
+    base = ServingEngine(net, max_seqs=max_seqs, max_len=max_len, seed=0,
+                         overlap=False)
+    ref = base.generate(prompts, max_new_tokens=max_new)
+    eng = ShardedServingEngine(net, max_seqs=max_seqs, max_len=max_len,
+                               seed=0, overlap=False, tp=tp)
+    got = eng.generate(prompts, max_new_tokens=max_new)
+    sb, st = base.stats(), eng.stats()
+    kv_shard = eng.decoder.cache.state["k"].addressable_data(0).shape
+    tp_parity = {
+        "tp": tp,
+        "tokens_match": [r.tokens for r in got] == [r.tokens for r in ref],
+        "host_syncs_single": sb["host_syncs"],
+        "host_syncs_tp": st["host_syncs"],
+        "added_syncs_per_token": round(
+            st["host_syncs"] / max(st["tokens_out"], 1)
+            - sb["host_syncs"] / max(sb["tokens_out"], 1), 6),
+        "kv_heads_logical": int(eng.decoder.cache.state["k"].shape[3]),
+        "kv_heads_per_chip": int(kv_shard[3]),
+        "kv_bytes_per_pos_per_chip_ratio": round(
+            eng._kv_bytes_per_pos / base._kv_bytes_per_pos, 4),
+    }
+
+    # --- 2. replica goodput A/B at one offered rate ----------------------
+    def spec_at(rate):
+        return LoadSpec(rate=rate, n_requests=n_requests, seed=seed,
+                        vocab=vocab, prompt_len_mix=prompt_len_mix,
+                        max_new_tokens_mix=new_tokens_mix)
+
+    def group(replicas):
+        # decode_chunk=2: a generation is several dispatches, so "one
+        # service wave" is a multi-dispatch quantum and the admission-
+        # capacity difference the A/B measures is wider than host jitter
+        return ShardedServingGroup(net, max_seqs, max_len, replicas=replicas,
+                                   tp=1, seed=0, overlap=False,
+                                   decode_chunk=2)
+
+    g1 = group(1)
+    _loadgen.run_spec(g1, spec_at(1000.0))          # compile pass
+    warm = _loadgen.run_spec(g1, spec_at(1000.0))   # calibration pass
+    ok = [o for o in warm.outcomes if o.finish_reason in ("eos", "length")]
+    tpots = [t for t in (_slo.request_tpot_s(o) for o in ok)
+             if t is not None]
+    # TTFT budget = 1.5 single-replica service quanta (a quantum = the
+    # time one batch-of-max_seqs wave takes, slots/closed-loop-rate): a
+    # request ADMITTED on arrival attains comfortably, a request that
+    # waited a full wave behind a busy batch does not. That pins the SLO
+    # to the quantity the A/B varies — admission capacity — with a half-
+    # quantum noise margin on either side, instead of leaving the budget
+    # boundary wherever host jitter dropped it.
+    quantum = max_seqs / warm.achieved_rate
+    slo = _slo.SLO(ttft_s=1.5 * quantum,
+                   tpot_s=5 * float(np.median(tpots)))
+    rate = overload_factor * warm.achieved_rate     # overload ONE replica
+
+    def run_group(g):
+        res = _loadgen.run_spec(g, spec_at(rate))
+        rep = _slo.evaluate(res.outcomes, slo, wall_s=res.wall_s,
+                            offered_rate=res.offered_rate)
+        return {k: (None if rep.get(k) is None
+                    else round(float(rep[k]), 5))
+                for k in ("offered_rate", "goodput", "throughput",
+                          "slo_attained_frac", "ttft_p99_s",
+                          "queue_wait_p99_s")}
+
+    g2 = group(2)
+    # two compile passes, same as the 1-replica side got: each replica has
+    # its OWN jit closures, and the router must see every prefill bucket
+    # land on both engines before the measured runs
+    _loadgen.run_spec(g2, spec_at(1000.0))
+    _loadgen.run_spec(g2, spec_at(1000.0))
+    # median-of-N pairs (all gains disclosed): single-run goodput on a
+    # shared, jittery host moves with wall-clock luck; the median pair is
+    # the representative one
+    pairs = [(run_group(g1), run_group(g2)) for _ in range(repeats)]
+
+    def _gain(pair):
+        o, t = pair
+        return (t["goodput"] / o["goodput"]) if o["goodput"] else 0.0
+
+    pairs.sort(key=_gain)
+    one, two = pairs[len(pairs) // 2]
+    st2 = g2.stats()
+    replica_ab = {
+        "offered_rate": one["offered_rate"],
+        "one_replica": one, "two_replicas": two,
+        "goodput_gain": None if not one["goodput"] else round(
+            two["goodput"] / one["goodput"], 3),
+        "repeat_gains_sorted": [round(_gain(p), 3) for p in pairs],
+        "router": {"requests": st2["router_requests"],
+                   "per_replica_tokens": [s["tokens_out"]
+                                          for s in st2["per_replica"]]},
+        "slo": {"ttft_s": round(slo.ttft_s, 6), "tpot_s": round(slo.tpot_s, 6),
+                "calibration": ("TTFT <= 1.5 single-replica service quanta "
+                                "(admitted-on-arrival attains, waiting a "
+                                "wave does not), TPOT 5x median warm TPOT; "
+                                "calibrated on the 1-replica group's warm "
+                                "closed-loop pass and shared by both "
+                                "sides")}}
+
+    return {
+        "seed": seed, "devices": n_dev,
+        "goodput": two["goodput"],                  # headline: the fleet
+        "tp_parity": tp_parity,
+        "replica_ab": replica_ab,
+        "config": {"d_model": d_model, "heads": heads, "kv_heads": kv_heads,
+                   "max_seqs": max_seqs, "n_requests": n_requests,
+                   "overload_factor": overload_factor, "repeats": repeats,
+                   "decode_chunk": 2,
+                   "prompt_len_mix": [list(p) for p in prompt_len_mix],
+                   "new_tokens_mix": [list(p) for p in new_tokens_mix]},
+        "note": ("TP parity is exact (bit-identical greedy tokens, zero "
+                 "added host syncs). The replica A/B holds offered rate "
+                 "(a burst overload) and SLO budgets fixed and varies only "
+                 "the fleet size; on this host the forced devices share "
+                 "the CPU, so aggregate service rate cannot scale — the "
+                 "measured gain is the fleet's doubled admission capacity "
+                 "(slots + KV pools) cutting queue wait at equal service "
+                 "rate, which is exactly what the TTFT-quantum SLO "
+                 "counts. On real multi-chip hardware the concurrent "
+                 "per-replica stepping adds compute scaling on top.")}
+
+
 def _row_from_roofline(function, roof, plat):
     """Roofline-table row from a bench *_roofline entry (exact XLA flops)."""
     if not isinstance(roof, dict) or not roof.get("measured_ms"):
@@ -1488,6 +1674,28 @@ def main():
         chunked_ab = bench_chunked_prefill_ab()
     except Exception as e:
         chunked_ab = {"error": f"{type(e).__name__}: {e}"}
+    try:  # multi-chip sharded serving (ISSUE 10): TP parity + replica A/B
+        sharded = bench_sharded_serving()
+        if "skipped" not in sharded:
+            if plat == "tpu":
+                try:  # TPU-sized sweep: real chips, bigger model, TP=4
+                    sharded["full_sweep"] = bench_sharded_serving(
+                        d_model=512, heads=8, kv_heads=4, tp=4,
+                        max_seqs=16, n_requests=96,
+                        prompt_len_mix=((64, 0.6), (192, 0.4)),
+                        new_tokens_mix=((32, 0.5), (96, 0.5)))
+                except Exception as e:
+                    sharded["full_sweep"] = {
+                        "platform": plat, "error": f"{type(e).__name__}: {e}"}
+            else:
+                sharded["full_sweep"] = {
+                    "platform": plat, "skipped": True,
+                    "skipped_reason": (
+                        f"TPU-sized sharded sweep skipped on '{plat}' — the "
+                        "reduced run above is the honest forced-host-device "
+                        "number (mechanism, not multi-chip bandwidth)")}
+    except Exception as e:
+        sharded = {"error": f"{type(e).__name__}: {e}"}
     # headline takes the better of helpers on/off — both honest fit_on_device
     # protocol; entry names record which path won
     if resnet_helpers.get("images_per_sec", 0) > resnet_bf16["images_per_sec"]:
@@ -1545,6 +1753,9 @@ def main():
             "serving_slo": slo_obs,
             # pre-rounded for the same reason (ms-scale stall/TTFT deltas)
             "serving_chunked_prefill": chunked_ab,
+            # pre-rounded (goodput/TTFT at ms scale); always present —
+            # skipped runs carry skipped_reason (ISSUE 10)
+            "serving_sharded": sharded,
             "decode_tokens_per_sec": round(
                 decode.get("decode_tokens_per_sec", 0.0), 1),
             "serving_profile": serving_profile,

@@ -16,6 +16,8 @@ import functools
 from typing import Callable, Iterable, List, Optional
 
 import jax
+
+from deeplearning4j_tpu.parallel.mesh import compat_shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -66,9 +68,9 @@ class DistributedWord2Vec(Word2Vec):
 
         rep = P()
         shard = P("data")
-        fn = jax.shard_map(per_shard, mesh=mesh,
+        fn = compat_shard_map(per_shard, mesh=mesh,
                            in_specs=(rep, rep, shard, shard, shard, rep),
-                           out_specs=(rep, rep, rep), check_vma=False)
+                           out_specs=(rep, rep, rep))
         self._sharded_step = jax.jit(fn, donate_argnums=(0, 1))
 
     def _train_batch(self, batch, alpha: float, probs):

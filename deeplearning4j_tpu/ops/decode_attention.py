@@ -333,3 +333,26 @@ def flash_decode_attention_paged(q, kp, vp, block_tables, visible, scale,
 
 register_helper("decode_attention_paged",
                 default_on=True)(flash_decode_attention_paged)
+
+
+def paged_decode_specs(tensor_axis: str = "tensor"):
+    """shard_map partition specs for the paged decode attention call
+    (ISSUE 10): `(in_specs, out_specs)` for the array operands
+    `(q, kp, vp, block_tables, visible)` -> out, sharding the HEAD axes
+    over `tensor_axis` — q/out over H (axis 1), the physical k/v pools
+    over Hk (axis 2), block tables and visible lengths replicated.
+
+    Head-local attention is what makes the kernel TP-viable unchanged:
+    with whole (grouped) heads per shard, every softmax/score/value
+    reduction runs over the L axis WITHIN one shard, so the shard_map body
+    needs NO collective — the Pallas split-K kernel (or the dense paged
+    fallback) executes per shard exactly as on one chip. The only
+    cross-shard communication in a TP decode step is outside this call,
+    in the row-parallel output projection (see PERF.md's cost model).
+    Contiguous head splits preserve GQA grouping (head h reads kv head
+    h // G) whenever the TP degree divides n_kv_heads."""
+    from jax.sharding import PartitionSpec as P
+    heads_q = P(None, tensor_axis, None)            # q/out: (S, H, D)
+    heads_kv = P(None, None, tensor_axis, None)     # kp/vp: (nb+1, bs, Hk, D)
+    in_specs = (heads_q, heads_kv, heads_kv, P(None, None), P(None))
+    return in_specs, heads_q

@@ -21,6 +21,8 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+
+from deeplearning4j_tpu.parallel.mesh import compat_shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -131,12 +133,12 @@ class ExpertParallelMoE:
             return (jax.tree_util.tree_map(lambda w, d: w - self.lr * d, p, g),
                     loss)
 
-        self._step = jax.jit(jax.shard_map(
+        self._step = jax.jit(compat_shard_map(
             local_step, mesh=self.mesh, in_specs=(pspec, P(), P()),
-            out_specs=(pspec, P()), check_vma=False), donate_argnums=(0,))
-        self._fwd = jax.jit(jax.shard_map(
+            out_specs=(pspec, P())), donate_argnums=(0,))
+        self._fwd = jax.jit(compat_shard_map(
             lambda p, x: self._local_forward(p, x), mesh=self.mesh,
-            in_specs=(pspec, P()), out_specs=P(), check_vma=False))
+            in_specs=(pspec, P()), out_specs=P()))
 
     # --------------- public API ---------------
     def forward(self, x):

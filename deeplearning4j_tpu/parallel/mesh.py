@@ -33,6 +33,37 @@ def make_mesh(num_devices: Optional[int] = None,
     return Mesh(devs.reshape(shape), axes)
 
 
+def compat_shard_map(fn, mesh: Mesh, in_specs, out_specs):
+    """shard_map across jax versions: `jax.shard_map(..., check_vma=False)`
+    where it exists (jax >= 0.6), else `jax.experimental.shard_map` with
+    the older `check_rep=False` spelling of the same knob. Replication
+    checking stays off either way (the repo idiom — the bodies use
+    collectives whose replication the checker can't always prove)."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
+                             out_specs=out_specs, check_vma=False)
+    from jax.experimental.shard_map import shard_map as _shard_map
+    return _shard_map(fn, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, check_rep=False)
+
+
+def replica_submeshes(mesh: Mesh, inner_axis: Optional[str] = None
+                      ) -> list:
+    """Split a 2-axis mesh into one single-axis Mesh per leading-axis row.
+
+    The serving replica groups (serving/sharding.py, ISSUE 10) build one
+    `(replica, tensor)` mesh for the whole fleet and hand each data-parallel
+    engine replica its own row as an independent `(tensor,)` mesh: the
+    replicas never communicate (each owns its params, KV pool, and
+    scheduler), so a shared mesh axis would only couple their dispatches.
+    `inner_axis` defaults to the mesh's second axis name."""
+    if len(mesh.axis_names) != 2:
+        raise ValueError(f"expected a 2-axis mesh, got {mesh.axis_names}")
+    if inner_axis is None:
+        inner_axis = mesh.axis_names[1]
+    return [Mesh(row, (inner_axis,)) for row in mesh.devices]
+
+
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 

@@ -82,13 +82,27 @@ class ParallelInference:
         if inference_mode == InferenceMode.GENERATE:
             # generate_kwargs pass straight through to ServingEngine —
             # including decode_chunk (micro-steps per host sync) and
-            # overlap; results carry ttft_s / tokens_per_sec
+            # overlap; results carry ttft_s / tokens_per_sec.
+            # Multi-chip (ISSUE 10): tp= / replicas= kwargs (or the
+            # DL4J_TPU_TP / DL4J_TPU_REPLICAS env knobs) route through a
+            # ShardedServingGroup — same submit()/stats()/shutdown()
+            # surface, tensor-parallel decode per replica, prefix-affine
+            # routing across replicas.
             from deeplearning4j_tpu.serving.engine import ServingEngine
+            from deeplearning4j_tpu.serving.sharding import (
+                ShardedServingGroup, resolve_replicas, resolve_tp)
             gkw = dict(generate_kwargs or {})
             max_seqs = gkw.pop("max_seqs", self.batch_limit)
             max_len = gkw.pop("max_len", 2048)
-            self._engine = ServingEngine(model, max_seqs, max_len,
-                                         **gkw).start()
+            tp = resolve_tp(gkw.pop("tp", None))
+            replicas = resolve_replicas(gkw.pop("replicas", None))
+            if tp > 1 or replicas > 1:
+                self._engine = ShardedServingGroup(
+                    model, max_seqs, max_len, replicas=replicas, tp=tp,
+                    **gkw).start()
+            else:
+                self._engine = ServingEngine(model, max_seqs, max_len,
+                                             **gkw).start()
         elif inference_mode == InferenceMode.BATCHED:
             self._worker = threading.Thread(target=self._batch_loop, daemon=True)
             self._worker.start()

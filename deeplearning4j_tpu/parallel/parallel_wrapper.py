@@ -43,7 +43,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from deeplearning4j_tpu.nn.multilayer import (
     _apply_updates, _compute_updates, _normalize_gradients)
 from deeplearning4j_tpu.parallel.accumulation import threshold_encode
-from deeplearning4j_tpu.parallel.mesh import make_mesh
+from deeplearning4j_tpu.parallel.mesh import compat_shard_map, make_mesh
 
 
 class TrainingMode:
@@ -200,15 +200,14 @@ class ParallelWrapper:
             return out
 
         repl_spec = P("data")
-        shmapped = jax.shard_map(
+        shmapped = compat_shard_map(
             per_replica_step, mesh=mesh,
             in_specs=(repl_spec, repl_spec, repl_spec,
                       repl_spec if mode == TrainingMode.SHARED_GRADIENTS else None,
                       P(), P(), P("data"), P("data"), P("data"), P("data")),
             out_specs=((repl_spec, repl_spec, repl_spec),
                        repl_spec if mode == TrainingMode.SHARED_GRADIENTS else None,
-                       P()),
-            check_vma=False)
+                       P()))
 
         def step_fn(carry, rng, bx, by, bfm, blm):
             params_repl, opt_repl, states_repl, residual, step = carry
@@ -261,12 +260,11 @@ class ParallelWrapper:
                     mean_loss)
 
         repl_spec = P("data")
-        grads_shmapped = jax.shard_map(
+        grads_shmapped = compat_shard_map(
             per_replica_grads, mesh=mesh,
             in_specs=(repl_spec, repl_spec, repl_spec, None, P(), P(),
                       P("data"), P("data"), P("data"), P("data")),
-            out_specs=(repl_spec, repl_spec, P()),
-            check_vma=False)
+            out_specs=(repl_spec, repl_spec, P()))
 
         def apply_agg(params_repl, opt_repl, agg_flat, step):
             """Apply one aggregated flat gradient through the updater on replica-0

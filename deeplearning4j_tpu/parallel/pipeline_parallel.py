@@ -22,6 +22,8 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+
+from deeplearning4j_tpu.parallel.mesh import compat_shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -137,12 +139,12 @@ class PipelineParallelMLP:
             return (jax.tree_util.tree_map(lambda w, d: w - self.lr * d, p, g),
                     loss)
 
-        self._step = jax.jit(jax.shard_map(
+        self._step = jax.jit(compat_shard_map(
             local_step, mesh=self.mesh, in_specs=(pspec, P(), P()),
-            out_specs=(pspec, P()), check_vma=False), donate_argnums=(0,))
-        self._fwd = jax.jit(jax.shard_map(
+            out_specs=(pspec, P())), donate_argnums=(0,))
+        self._fwd = jax.jit(compat_shard_map(
             self._local_forward, mesh=self.mesh, in_specs=(pspec, P()),
-            out_specs=P(), check_vma=False))
+            out_specs=P()))
 
     # ---------------- public API ----------------
     def fit_batch(self, x, y) -> float:

@@ -35,6 +35,8 @@ import functools
 from typing import Any, Dict, List, Optional
 
 import jax
+
+from deeplearning4j_tpu.parallel.mesh import compat_shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -303,10 +305,9 @@ class PipelinedTrainer:
             gh = jax.tree_util.tree_map(lambda a: lax.psum(a, axis) / S, gh)
             return (gh, gs, gt), loss
 
-        shmapped = jax.shard_map(
+        shmapped = compat_shard_map(
             local_grads, mesh=self.mesh,
-            in_specs=(pspec, rep, rep), out_specs=(pspec, rep),
-            check_vma=False)
+            in_specs=(pspec, rep, rep), out_specs=(pspec, rep))
 
         updaters = net._updaters
         layers = net.layers

@@ -26,6 +26,8 @@ import functools
 from typing import Optional
 
 import jax
+
+from deeplearning4j_tpu.parallel.mesh import compat_shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -335,14 +337,14 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = "seq",
     impl = local_flash if use_flash else local
     spec = P(batch_axis, None, axis, None)
     if has_mask:
-        shmapped = jax.shard_map(
+        shmapped = compat_shard_map(
             impl, mesh=mesh,
             in_specs=(spec, spec, spec, P(batch_axis, axis)),
-            out_specs=spec, check_vma=False)
+            out_specs=spec)
         return shmapped(q, k, v, mask)
-    shmapped = jax.shard_map(
+    shmapped = compat_shard_map(
         lambda qb, kb, vb: impl(qb, kb, vb, None), mesh=mesh,
-        in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+        in_specs=(spec, spec, spec), out_specs=spec)
     return shmapped(q, k, v)
 
 

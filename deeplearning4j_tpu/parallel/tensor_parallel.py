@@ -18,6 +18,8 @@ from __future__ import annotations
 from typing import Optional
 
 import jax
+
+from deeplearning4j_tpu.parallel.mesh import compat_shard_map
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
@@ -105,10 +107,9 @@ class TensorParallelMLP:
                 lambda w, g: w - self.lr * g, p, grads)
             return new_p, loss
 
-        shmapped = jax.shard_map(
+        shmapped = compat_shard_map(
             local_step, mesh=self.mesh,
-            in_specs=(pspec, P(), P()), out_specs=(pspec, P()),
-            check_vma=False)
+            in_specs=(pspec, P(), P()), out_specs=(pspec, P()))
         return jax.jit(shmapped, donate_argnums=(0,))
 
     def _build_forward(self):
@@ -118,9 +119,8 @@ class TensorParallelMLP:
             h = jnp.tanh(column_parallel_dense(x, p["W1"], p["b1"]))
             return row_parallel_dense(h, p["W2"], axis=self.axis) + p["b2"]
 
-        return jax.jit(jax.shard_map(local_fwd, mesh=self.mesh,
-                                     in_specs=(pspec, P()), out_specs=P(),
-                                     check_vma=False))
+        return jax.jit(compat_shard_map(local_fwd, mesh=self.mesh,
+                                     in_specs=(pspec, P()), out_specs=P()))
 
     # ------------- public API -------------
     def fit_batch(self, x, y) -> float:

@@ -16,6 +16,15 @@ from deeplearning4j_tpu.serving.loadgen import (LoadResult, LoadSpec,
                                                 ScheduledRequest,
                                                 build_schedule, run_spec)
 from deeplearning4j_tpu.serving.sampler import Sampler, sample_tokens
+from deeplearning4j_tpu.serving.sharding import (ShardedServingEngine,
+                                                 ShardedServingGroup,
+                                                 build_serving_mesh,
+                                                 cache_partition_specs,
+                                                 head_sharded_paged_attention,
+                                                 make_shard_and_gather_fns,
+                                                 match_partition_rules,
+                                                 resolve_replicas, resolve_tp,
+                                                 serving_partition_rules)
 
 __all__ = [
     "KVCache", "init_cache_state", "BlockAllocator", "PrefixRegistry",
@@ -24,4 +33,8 @@ __all__ = [
     "Sampler", "sample_tokens",
     "LoadSpec", "LoadResult", "RequestOutcome", "ScheduledRequest",
     "build_schedule", "run_spec",
+    "ShardedServingEngine", "ShardedServingGroup", "build_serving_mesh",
+    "cache_partition_specs", "head_sharded_paged_attention",
+    "make_shard_and_gather_fns", "match_partition_rules",
+    "resolve_replicas", "resolve_tp", "serving_partition_rules",
 ]

@@ -39,6 +39,7 @@ from __future__ import annotations
 
 import hashlib
 import heapq
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 
@@ -126,13 +127,36 @@ class PrefixRegistry:
     match() walks a prompt block by block down the chain-hash index and
     returns the longest registered prefix plus the physical blocks holding
     it; register() files a freshly prefilled prompt's blocks; forget()
-    removes every claim backed by a block the allocator just freed."""
+    removes every claim backed by a block the allocator just freed.
+
+    A registry may be constructed by its KV cache (the default) or handed
+    in from outside (`KVCache(prefix_registry=...)`, ISSUE 10) so routers
+    can run read-only `match()` affinity queries against it. Physical
+    block ids are meaningful only within the ONE pool that allocated them,
+    so every cache claims its registry via `bind_pool` — sharing one
+    registry between two pools would hand pool B garbage block ids from
+    pool A, and is rejected."""
 
     def __init__(self, block_size: int):
         self.block_size = int(block_size)
         self._full: Dict[bytes, int] = {}    # chain digest -> physical block
         self._tail: Dict[bytes, int] = {}    # exact-prompt digest -> block
         self._claims: Dict[int, List[Tuple[str, bytes]]] = {}  # invalidation
+        self._pool: Optional[weakref.ref] = None
+
+    def bind_pool(self, pool: object) -> "PrefixRegistry":
+        """Claim this registry for one block pool (idempotent per pool).
+        Raises if a DIFFERENT live pool already owns it — block ids do not
+        transfer between pools, so cross-pool sharing is always a bug."""
+        if self._pool is not None:
+            owner = self._pool()
+            if owner is not None and owner is not pool:
+                raise ValueError(
+                    "PrefixRegistry is already bound to another KV pool; "
+                    "physical block ids are pool-scoped, so one registry "
+                    "cannot serve two pools (give each replica its own)")
+        self._pool = weakref.ref(pool)
+        return self
 
     def match(self, tokens: Sequence[int]) -> Tuple[int, List[int]]:
         """(matched_len, physical blocks covering it) for the longest

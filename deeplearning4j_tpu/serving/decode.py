@@ -157,7 +157,8 @@ class StackDecoder:
     def __init__(self, net, max_seqs: int, max_len: int,
                  dtype=None, block_size: Optional[int] = None,
                  num_blocks: Optional[int] = None,
-                 prefix_share: Optional[bool] = None):
+                 prefix_share: Optional[bool] = None,
+                 prefix_registry=None, paged_attention=None):
         layers, params = _extract_stack(net)
         self.layers = layers
         self.dtype = jnp.dtype(dtype) if dtype is not None else net.dtype
@@ -194,7 +195,13 @@ class StackDecoder:
                                       self.n_kv_heads, self.head_dim,
                                       self.dtype, block_size=block_size,
                                       num_blocks=num_blocks,
-                                      prefix_share=prefix_share)
+                                      prefix_share=prefix_share,
+                                      prefix_registry=prefix_registry)
+        # Attention seam (ISSUE 10): the sharded engine swaps in a
+        # shard_map-wrapped kernel with the same signature as
+        # decode_attention_paged; the default is the single-mesh helper.
+        self._paged_attention = (paged_attention if paged_attention
+                                 is not None else decode_attention_paged)
         self._prefill_jit = jax.jit(self._prefill_fn)
         self._prefill_shared_jit = jax.jit(self._prefill_shared_fn,
                                            static_argnames=("kv_blocks",))
@@ -335,7 +342,7 @@ class StackDecoder:
                 q, k_t, v_t = _attn_heads(layer, p, h)      # (S, H/Hk, Dh)
                 cache_state = kv_cache.append_token(cache_state, li, k_t,
                                                     v_t, active)
-                out = decode_attention_paged(
+                out = self._paged_attention(
                     q, cache_state["k"][li], cache_state["v"][li],
                     cache_state["block_tables"],
                     pos + 1, 1.0 / np.sqrt(self.head_dim),

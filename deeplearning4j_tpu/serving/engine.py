@@ -200,8 +200,10 @@ class _Active:
 
 def _build_step(decoder: StackDecoder, embed: Callable, top_k: int,
                 cap: int):
-    """The single jitted decode iteration (the K=1 path — kept verbatim so
-    decode_chunk=1 preserves the pre-chunking behavior bit-for-bit)."""
+    """The single decode iteration (the K=1 path — kept verbatim so
+    decode_chunk=1 preserves the pre-chunking behavior bit-for-bit).
+    Returns the RAW pure function; the engine jits it via `_jit_decode`
+    (the seam where the sharded engine pins pjit in/out shardings)."""
 
     def step(params, cache_state, hist, last, plens, eos, maxgen, active,
              key, temps):
@@ -219,7 +221,7 @@ def _build_step(decoder: StackDecoder, embed: Callable, top_k: int,
         nf = jnp.any(active & jnp.any(~jnp.isfinite(lp), axis=-1))
         return cache_state, hist, last, new_active, lp, nf
 
-    return jax.jit(step)
+    return step
 
 
 def _build_chunk(decoder: StackDecoder, embed: Callable, top_k: int,
@@ -258,7 +260,7 @@ def _build_chunk(decoder: StackDecoder, embed: Callable, top_k: int,
             keys)
         return cache_state, hist, last, active, entries, lps, nf
 
-    return jax.jit(chunk)
+    return chunk
 
 
 class ServingEngine:
@@ -294,11 +296,15 @@ class ServingEngine:
                  kv_block: Optional[int] = None,
                  kv_blocks: Optional[int] = None,
                  prefix_share: Optional[bool] = None,
-                 flight_recorder=None):
-        self.decoder = StackDecoder(net, max_seqs, max_len, dtype=dtype,
-                                    block_size=kv_block,
-                                    num_blocks=kv_blocks,
-                                    prefix_share=prefix_share)
+                 flight_recorder=None,
+                 prefix_registry=None,
+                 metrics_parent=None):
+        self.decoder = self._build_decoder(net, max_seqs, max_len,
+                                           dtype=dtype,
+                                           block_size=kv_block,
+                                           num_blocks=kv_blocks,
+                                           prefix_share=prefix_share,
+                                           prefix_registry=prefix_registry)
         if embed is None:
             if self.decoder.n_in is None:
                 raise ValueError("stack has no n_in; pass embed=")
@@ -328,10 +334,12 @@ class ServingEngine:
             prefill_chunk = max(bs_kv, (prefill_chunk // bs_kv) * bs_kv)
         self.prefill_chunk = int(prefill_chunk)
         S = self.decoder.cache.max_seqs
-        self._step_jit = _build_step(self.decoder, embed, self.sampler.top_k,
-                                     self._cap)
-        self._chunk_jit = _build_chunk(self.decoder, embed,
-                                       self.sampler.top_k, self._cap)
+        self._step_jit = self._jit_decode(
+            _build_step(self.decoder, embed, self.sampler.top_k, self._cap),
+            "step")
+        self._chunk_jit = self._jit_decode(
+            _build_chunk(self.decoder, embed, self.sampler.top_k, self._cap),
+            "chunk")
         # device-side per-slot state (fixed shapes, threaded through the jit)
         self._hist = jnp.zeros((S, self._cap), jnp.int32)
         self._last = jnp.zeros((S,), jnp.int32)
@@ -363,7 +371,9 @@ class ServingEngine:
         # sync counters themselves live here too: every materialization of
         # device data in the serve loop counts as one sync — per-chunk mask
         # reads AND per-admission first-token reads (scheduling events).
-        self.metrics = telemetry.MetricsRegistry(parent=telemetry.registry())
+        self.metrics = telemetry.MetricsRegistry(
+            parent=metrics_parent if metrics_parent is not None
+            else telemetry.registry())
         self._c_syncs = self.metrics.counter(
             "serving.host_syncs", "device->host materializations in the "
             "serve loop")
@@ -465,6 +475,19 @@ class ServingEngine:
                 flight_recorder = FlightRecorder()
         self.flight_recorder = flight_recorder
         _tmemory.poll("serving.engine_init", registry=self.metrics)
+
+    # ----------------------------------------------- sharding seams (ISSUE 10)
+    def _build_decoder(self, net, max_seqs, max_len, **kw) -> StackDecoder:
+        """Decoder construction seam: ShardedServingEngine
+        (serving/sharding.py) overrides this to swap in head-sharded paged
+        attention and place params/cache on its tensor-parallel mesh."""
+        return StackDecoder(net, max_seqs, max_len, **kw)
+
+    def _jit_decode(self, fn, kind: str):
+        """Jit seam for the decode step ("step") / chunk ("chunk") pure
+        functions: the sharded engine pins pjit in/out shardings here so
+        the cache pytree stays head-sharded across dispatches."""
+        return jax.jit(fn)
 
     # host_syncs / tokens_out live on the registry (ISSUE 4 satellite) but
     # stay assignable attributes for callers that reset them (bench.py)

@@ -259,7 +259,8 @@ class KVCache:
                  n_kv_heads: int, head_dim: int, dtype=jnp.bfloat16,
                  block_size: Optional[int] = None,
                  num_blocks: Optional[int] = None,
-                 prefix_share: Optional[bool] = None):
+                 prefix_share: Optional[bool] = None,
+                 prefix_registry: Optional[PrefixRegistry] = None):
         if max_seqs < 1 or max_len < 1:
             raise ValueError(f"bad cache shape: max_seqs={max_seqs}, "
                              f"max_len={max_len}")
@@ -286,7 +287,20 @@ class KVCache:
         # list(range(n)) is already a valid min-heap
         self._free_slots: List[int] = list(range(max_seqs))
         self.allocator = BlockAllocator(self.num_blocks)
-        self.registry = PrefixRegistry(self.block_size)
+        # ISSUE 10: the registry handle is injectable so routers (replica
+        # groups) can run read-only match() affinity queries against it;
+        # bind_pool rejects handing one registry to a second pool (block
+        # ids are pool-scoped).
+        if prefix_registry is not None:
+            if prefix_registry.block_size != self.block_size:
+                raise ValueError(
+                    f"injected PrefixRegistry block_size "
+                    f"{prefix_registry.block_size} != cache block_size "
+                    f"{self.block_size}")
+            self.registry = prefix_registry
+        else:
+            self.registry = PrefixRegistry(self.block_size)
+        self.registry.bind_pool(self)
         self._owner: Dict[int, object] = {}   # slot -> opaque request handle
         self._slot_blocks: Dict[int, List[int]] = {}   # slot -> mapped blocks
         # lifetime counters (bench/stats: the sharing win, observable)

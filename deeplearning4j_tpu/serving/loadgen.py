@@ -180,7 +180,13 @@ def build_schedule(spec: LoadSpec) -> List[ScheduledRequest]:
 def run(engine, schedule: Sequence[ScheduledRequest]) -> LoadResult:
     """Open-loop run: submit each scheduled request once the wall clock
     passes its arrival time, drive `engine.step()` in between, and return
-    per-request outcomes built from the engine's lifecycle timestamps."""
+    per-request outcomes built from the engine's lifecycle timestamps.
+
+    `engine` is duck-typed on submit(Request) -> future and step() ->
+    bool, so a serving.sharding.ShardedServingGroup (ISSUE 10) plugs in
+    unchanged: submits route across replicas, step() advances every
+    replica one scheduler iteration, and the outcomes — hence the SLO
+    evaluation built on them — span the whole fleet."""
     n = len(schedule)
     outs: List[Optional[RequestOutcome]] = [None] * n
     futs: List[Optional[object]] = [None] * n

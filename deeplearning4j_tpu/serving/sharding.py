@@ -1,0 +1,530 @@
+"""Multi-chip sharded serving: tensor-parallel decode + replica groups.
+
+ISSUE 10 (beyond-reference; Orca OSDI '22 replica scheduling +
+PagedAttention SOSP '23 KV framing; fmengine-style partition rules from
+SNIPPETS.md). Two orthogonal axes on one `(replica, tensor)` device mesh:
+
+- TENSOR parallelism (`ShardedServingEngine`): one engine whose params
+  and paged KV pool are head-sharded over the `tensor` axis. Attention is
+  head-local — q/k/v projections are column-parallel (whole heads per
+  shard, GQA grouping preserved by contiguous splits whenever the TP
+  degree divides n_kv_heads), the paged decode kernel runs unchanged per
+  shard under shard_map (ops/decode_attention.paged_decode_specs), and
+  the only cross-chip collective per decode step is the all-reduce GSPMD
+  inserts for the row-parallel output projection. Block tables, lengths,
+  and every scheduler-visible array stay replicated, so the host
+  scheduler is UNTOUCHED: same admission, same chunking, same sync
+  count per token (tests assert host-sync bit-parity).
+
+- DATA parallelism (`ShardedServingGroup`): N independent engine
+  replicas, each on its own row of the mesh (parallel/mesh.py
+  `replica_submeshes`), behind one submit()/step()/stats() facade that
+  ParallelInference drives exactly like a single engine. Routing is
+  prefix-affinity first (read-only PrefixRegistry.match against each
+  replica's registry, so identical prompts land where their KV already
+  lives), then cohort affinity for not-yet-resident prompts, then
+  least-loaded with a round-robin tie-break over existing stats()
+  snapshots. Each replica gets a child telemetry registry parented to
+  the group's (the parent/child adoption in telemetry/registry.py was
+  built for this), so per-replica metrics stay isolated while the
+  process-wide /metrics exposition aggregates all of them.
+
+Env knobs: `DL4J_TPU_TP` (tensor-parallel degree) and
+`DL4J_TPU_REPLICAS` (engine replicas); both default 1 and multiply to
+the device requirement. All shapes are CPU-testable via
+`XLA_FLAGS=--xla_force_host_platform_device_count=8`.
+"""
+from __future__ import annotations
+
+import os
+import re
+import threading
+from collections import OrderedDict
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.ops.decode_attention import paged_decode_specs
+from deeplearning4j_tpu.parallel.mesh import (compat_shard_map, make_mesh,
+                                              replica_submeshes)
+from deeplearning4j_tpu.serving.block_table import PrefixRegistry
+from deeplearning4j_tpu.serving.decode import (StackDecoder,
+                                               decode_attention_paged)
+from deeplearning4j_tpu.serving.engine import Request, ServingEngine
+from deeplearning4j_tpu.serving.kv_cache import resolve_block_size
+
+__all__ = [
+    "match_partition_rules", "make_shard_and_gather_fns", "named_tree_map",
+    "serving_partition_rules", "cache_partition_specs",
+    "resolve_tp", "resolve_replicas", "build_serving_mesh",
+    "head_sharded_paged_attention", "ShardedServingEngine",
+    "ShardedServingGroup",
+]
+
+
+def _is_spec(x) -> bool:
+    return isinstance(x, P)
+
+
+# --------------------------------------------------------- partition rules
+def _path_name(path) -> str:
+    """'/'-joined name for a pytree key path ("0/w_q" for params[0]["w_q"])."""
+    parts = []
+    for k in path:
+        if isinstance(k, jax.tree_util.SequenceKey):
+            parts.append(str(k.idx))
+        elif isinstance(k, jax.tree_util.DictKey):
+            parts.append(str(k.key))
+        elif isinstance(k, jax.tree_util.GetAttrKey):
+            parts.append(k.name)
+        else:
+            parts.append(str(k))
+    return "/".join(parts)
+
+
+def named_tree_map(fn, tree, is_leaf=None):
+    """tree_map where `fn(name, leaf)` sees the '/'-joined key path — the
+    addressing scheme the regex partition rules match against."""
+    return jax.tree_util.tree_map_with_path(
+        lambda path, x: fn(_path_name(path), x), tree, is_leaf=is_leaf)
+
+
+def match_partition_rules(rules: Sequence[Tuple[str, P]], params):
+    """Map every param leaf to a PartitionSpec by regex over its path name
+    (the fmengine pattern, SNIPPETS.md): scalars and single-element leaves
+    are always replicated, otherwise the FIRST rule whose pattern
+    re.search-matches the '/'-joined path wins, and an unmatched leaf is a
+    hard error — silent replication of a tensor someone meant to shard is
+    how HBM budgets quietly blow up."""
+    def match(name, leaf):
+        if getattr(leaf, "ndim", 0) == 0 or int(np.prod(np.shape(leaf))) == 1:
+            return P()
+        for pattern, spec in rules:
+            if re.search(pattern, name) is not None:
+                return spec
+        raise ValueError(f"no partition rule matched param {name!r} "
+                         f"(shape {np.shape(leaf)}); add a rule or an "
+                         "explicit catch-all")
+    return named_tree_map(match, params)
+
+
+def make_shard_and_gather_fns(partition_specs, mesh: Mesh):
+    """Per-leaf `(shard_fns, gather_fns)` trees for a spec tree: shard_fns
+    device_put leaves onto `mesh` under their spec; gather_fns pull a
+    sharded leaf back to a single host ndarray (checkpoint/debug path —
+    NEVER the decode hot loop)."""
+    def make_shard(spec):
+        sharding = NamedSharding(mesh, spec)
+
+        def shard_fn(x):
+            return jax.device_put(x, sharding)
+        return shard_fn
+
+    def make_gather(spec):
+        del spec    # gather is always to fully-replicated host memory
+
+        def gather_fn(x):
+            # sync-ok: explicit gather-to-host entry point (checkpointing)
+            return np.asarray(jax.device_put(x, NamedSharding(mesh, P())))
+        return gather_fn
+
+    shard_fns = jax.tree_util.tree_map(make_shard, partition_specs,
+                                       is_leaf=_is_spec)
+    gather_fns = jax.tree_util.tree_map(make_gather, partition_specs,
+                                        is_leaf=_is_spec)
+    return shard_fns, gather_fns
+
+
+def serving_partition_rules(tensor_axis: str = "tensor"):
+    """Partition rules for a StackDecoder param stack (list-of-dicts,
+    paths like "0/w_q"). Megatron-style within each attention layer:
+    q/k/v projections column-parallel (the head dim is the contiguous
+    column tail, so a contiguous split is a whole-heads split), the
+    output projection row-parallel (its all-reduce is THE per-step
+    collective), biases and every position-wise layer replicated."""
+    col = P(None, tensor_axis)
+    row = P(tensor_axis, None)
+    return [
+        (r"w_q$", col),
+        (r"w_k$", col),
+        (r"w_v$", col),
+        (r"w_o$", row),
+        # everything else (attention bias, output head W/b, position-wise
+        # layers) is small relative to the KV pool: replicate
+        (r".", P()),
+    ]
+
+
+def cache_partition_specs(tensor_axis: str = "tensor") -> Dict[str, P]:
+    """Specs for the paged cache pytree (kv_cache.init_cache_state):
+    k/v pools `(n_layers, num_blocks+1, block_size, Hk, D)` sharded on the
+    kv-head axis, lengths and block tables replicated (the host scheduler
+    reads and writes them; they are bytes-trivial)."""
+    heads = P(None, None, None, tensor_axis, None)
+    return {"k": heads, "v": heads, "lengths": P(), "block_tables": P()}
+
+
+# ------------------------------------------------------------- env knobs
+def _resolve_degree(explicit, env: str) -> int:
+    v = int(explicit) if explicit is not None \
+        else int(os.environ.get(env, "1"))
+    if v < 1:
+        raise ValueError(f"{env} must be >= 1, got {v}")
+    return v
+
+
+def resolve_tp(explicit: Optional[int] = None) -> int:
+    """Tensor-parallel degree: explicit arg, else $DL4J_TPU_TP, else 1."""
+    return _resolve_degree(explicit, "DL4J_TPU_TP")
+
+
+def resolve_replicas(explicit: Optional[int] = None) -> int:
+    """Engine replica count: explicit arg, else $DL4J_TPU_REPLICAS, else 1."""
+    return _resolve_degree(explicit, "DL4J_TPU_REPLICAS")
+
+
+def build_serving_mesh(replicas: int, tp: int,
+                       replica_axis: str = "replica",
+                       tensor_axis: str = "tensor") -> Mesh:
+    """The `(replica, tensor)` serving mesh: row r = replica r's TP group."""
+    return make_mesh(replicas * tp, axes=(replica_axis, tensor_axis),
+                     shape=(replicas, tp))
+
+
+# ------------------------------------------------- head-sharded attention
+def head_sharded_paged_attention(mesh: Mesh, tensor_axis: str = "tensor"):
+    """A drop-in for serving.decode.decode_attention_paged that runs the
+    SAME kernel (Pallas split-K on TPU, dense paged fallback elsewhere)
+    per head-shard under shard_map. Head-local attention needs no
+    collective in the body (see paged_decode_specs), so TP changes only
+    WHERE heads run, not what they compute."""
+    in_specs, out_spec = paged_decode_specs(tensor_axis)
+
+    def attention(q, kp, vp, block_tables, visible, scale, window: int = 0):
+        def local(qs, kps, vps, bt, vis):
+            return decode_attention_paged(qs, kps, vps, bt, vis, scale,
+                                          window)
+        sharded = compat_shard_map(local, mesh, in_specs, out_spec)
+        return sharded(q, kp, vp, block_tables, visible)
+
+    return attention
+
+
+# ------------------------------------------------------ tensor-parallel TP
+class ShardedServingEngine(ServingEngine):
+    """A ServingEngine whose decoder params and paged KV pool live
+    head-sharded on a single-axis tensor mesh.
+
+    Same host scheduler, same API, same token stream (greedy decode is
+    bit-identical to the single-chip engine; fp64 oracle parity holds to
+    1e-9): the only differences are WHERE tensors live and the per-chip
+    byte accounting — `serving.kv_bytes_resident` / `kv_cache_bytes`
+    report PER-DEVICE bytes (1/TP of the logical pool), which is the
+    number capacity planning actually needs."""
+
+    def __init__(self, net, max_seqs: int, max_len: int, *,
+                 tp: Optional[int] = None, mesh: Optional[Mesh] = None,
+                 tensor_axis: str = "tensor", **kw):
+        # mesh/tp must exist before super().__init__ runs _build_decoder
+        self.tensor_axis = tensor_axis
+        if mesh is not None:
+            if mesh.axis_names != (tensor_axis,):
+                raise ValueError(f"expected a 1-axis ({tensor_axis!r},) "
+                                 f"mesh, got axes {mesh.axis_names}")
+            self.mesh = mesh
+            self.tp = int(mesh.devices.size)
+        else:
+            self.tp = resolve_tp(tp)
+            self.mesh = make_mesh(self.tp, axes=(tensor_axis,))
+        super().__init__(net, max_seqs, max_len, **kw)
+        cache = self.decoder.cache
+        # per-DEVICE byte semantics: the pool is head-sharded, so each chip
+        # holds 1/TP of every position's KV bytes (Hk % tp == 0 makes the
+        # division exact)
+        self._kv_bytes_per_pos = cache.bytes_per_position // self.tp
+        self._g_kv_total.set(cache.bytes() // self.tp)
+        self._g_params.set(self._sharded_param_bytes())
+        self._g_tp = self.metrics.gauge(
+            "serving.tensor_parallel", "tensor-parallel degree (heads are "
+            "sharded over this many chips)")
+        self._g_tp.set(self.tp)
+        # pin the per-slot device state to the mesh (replicated) so eager
+        # slot updates between iterations stay on the engine's devices
+        rep = NamedSharding(self.mesh, P())
+        self._hist = jax.device_put(self._hist, rep)
+        self._last = jax.device_put(self._last, rep)
+        self._plens = jax.device_put(self._plens, rep)
+        self._eos = jax.device_put(self._eos, rep)
+        self._maxgen = jax.device_put(self._maxgen, rep)
+
+    # ------------------------------------------------------------- seams
+    def _build_decoder(self, net, max_seqs, max_len, **kw) -> StackDecoder:
+        dec = StackDecoder(
+            net, max_seqs, max_len,
+            paged_attention=head_sharded_paged_attention(self.mesh,
+                                                         self.tensor_axis),
+            **kw)
+        tp = self.tp
+        if dec.n_kv_heads % tp:
+            raise ValueError(
+                f"tensor-parallel degree {tp} does not divide n_kv_heads "
+                f"{dec.n_kv_heads} — GQA head sharding needs whole kv "
+                "heads per chip (lower DL4J_TPU_TP or widen the model)")
+        for i in dec.attn_idx:
+            layer = dec.layers[i]
+            if layer.n_heads % tp:
+                raise ValueError(
+                    f"tensor-parallel degree {tp} does not divide layer "
+                    f"{i}'s n_heads {layer.n_heads}")
+        self._param_specs = match_partition_rules(
+            serving_partition_rules(self.tensor_axis), dec.params)
+        self._cache_specs = cache_partition_specs(self.tensor_axis)
+        to_sharding = lambda spec: NamedSharding(self.mesh, spec)
+        self._param_shardings = jax.tree_util.tree_map(
+            to_sharding, self._param_specs, is_leaf=_is_spec)
+        self._cache_shardings = {k: to_sharding(s)
+                                 for k, s in self._cache_specs.items()}
+        shard_fns, self._gather_fns = make_shard_and_gather_fns(
+            self._param_specs, self.mesh)
+        dec.params = jax.tree_util.tree_map(lambda f, x: f(x), shard_fns,
+                                            dec.params)
+        dec.cache.state = jax.device_put(dec.cache.state,
+                                         self._cache_shardings)
+        # pin pjit shardings on the decoder's own entry points so the
+        # prefill and suffix/chunk passes are tensor-parallel end to end
+        # (the scatter into the head-sharded pool partitions on Hk; the
+        # dense prompt attention replicates — prompt activations are tiny
+        # next to the pool)
+        ps, cs = self._param_shardings, self._cache_shardings
+        rep = NamedSharding(self.mesh, P())
+        dec._prefill_jit = jax.jit(
+            dec._prefill_fn,
+            in_shardings=(ps, cs, rep, rep, rep),
+            out_shardings=(cs, rep))
+        # older pjit rejects kwargs alongside in_shardings, and the decoder
+        # calls the shared prefill with kv_blocks=...: route the keyword
+        # through a positional static arg
+        _shared_positional = jax.jit(
+            lambda p, c, x, s, pl, sh, kvb: dec._prefill_shared_fn(
+                p, c, x, s, pl, sh, kv_blocks=kvb),
+            static_argnums=(6,),
+            in_shardings=(ps, cs, rep, rep, rep, rep),
+            out_shardings=(cs, rep))
+
+        def _shared_jit(p, c, x, s, pl, sh, *, kv_blocks):
+            return _shared_positional(p, c, x, s, pl, sh, kv_blocks)
+
+        _shared_jit.lower = (  # profiler.register lowers for cost analysis
+            lambda p, c, x, s, pl, sh, *, kv_blocks:
+            _shared_positional.lower(p, c, x, s, pl, sh, kv_blocks))
+        dec._prefill_shared_jit = _shared_jit
+        dec._decode_jit = jax.jit(
+            dec._decode_fn,
+            in_shardings=(ps, cs, rep, rep),
+            out_shardings=(cs, rep))
+        return dec
+
+    def _jit_decode(self, fn, kind: str):
+        """Pin the engine step/chunk pjit shardings: cache pytree keeps its
+        head-sharded placement across dispatches (no resharding between
+        iterations), every scheduler array replicated."""
+        rep = NamedSharding(self.mesh, P())
+        n_out = 6 if kind == "step" else 7
+        in_s = (self._param_shardings, self._cache_shardings) + (rep,) * 8
+        out_s = (self._cache_shardings,) + (rep,) * (n_out - 1)
+        return jax.jit(fn, in_shardings=in_s, out_shardings=out_s)
+
+    def _sharded_param_bytes(self) -> int:
+        """Per-device param bytes: tensor-sharded leaves count 1/TP."""
+        total = 0
+        leaves = jax.tree_util.tree_leaves(self.decoder.params)
+        specs = jax.tree_util.tree_leaves(self._param_specs,
+                                          is_leaf=_is_spec)
+        for leaf, spec in zip(leaves, specs):
+            nb = leaf.size * leaf.dtype.itemsize
+            total += nb // self.tp if self.tensor_axis in spec else nb
+        return total
+
+    def stats(self) -> Dict[str, float]:
+        s = super().stats()
+        s["tp"] = self.tp
+        return s
+
+
+# --------------------------------------------------- data-parallel group
+class ShardedServingGroup:
+    """N independent (optionally tensor-parallel) engine replicas behind
+    one engine-shaped facade: submit/step/drain/generate/start/shutdown/
+    stats match ServingEngine, so ParallelInference and loadgen.run drive
+    a group unchanged.
+
+    Replicas share NOTHING on device — each owns a mesh row, its params,
+    its KV pool, and its scheduler. What spans replicas is host-side:
+    the admission router and the group telemetry registry (each engine's
+    child registry is parented here, so the process /metrics exposition
+    aggregates the fleet while per-replica stats stay isolated).
+
+    Routing order (under the group lock, host-only — zero device syncs):
+    1. prefix affinity — the replica whose PrefixRegistry already holds
+       the longest matching resident prefix (read-only match(); COW
+       prefix hits then happen inside that replica's own pool);
+    2. cohort affinity — prompts whose leading KV block matches a prompt
+       routed earlier follow it, so a cohort's FIRST prompt seeds the
+       registry the rest will hit (without this, upfront submissions of
+       identical prompts would scatter and forfeit sharing);
+    3. least-loaded (queue_depth + active_slots from stats()) with a
+       rotating round-robin tie-break."""
+
+    _COHORT_CAP = 4096      # FIFO bound on the cohort-affinity map
+
+    def __init__(self, net, max_seqs: int, max_len: int, *,
+                 replicas: Optional[int] = None, tp: Optional[int] = None,
+                 seed: int = 0, replica_axis: str = "replica",
+                 tensor_axis: str = "tensor", metrics_parent=None,
+                 **engine_kw):
+        self.replicas = resolve_replicas(replicas)
+        self.tp = resolve_tp(tp)
+        self.mesh = build_serving_mesh(self.replicas, self.tp,
+                                       replica_axis, tensor_axis)
+        self.metrics = telemetry.MetricsRegistry(
+            parent=metrics_parent if metrics_parent is not None
+            else telemetry.registry())
+        self._g_replicas = self.metrics.gauge(
+            "serving.replicas", "data-parallel engine replicas in the group")
+        self._g_replicas.set(self.replicas)
+        self._c_routed = self.metrics.counter(
+            "serving.router_requests", "requests routed by the group")
+        self._c_affinity = self.metrics.counter(
+            "serving.router_prefix_affinity", "requests routed to a replica "
+            "because its registry already held a matching resident prefix")
+        block_size = resolve_block_size(engine_kw.get("kv_block"), max_len)
+        # per-replica registry handles: owned (bound) by each replica's KV
+        # pool, read by the router for affinity — block ids never cross
+        # replicas (see block_table.PrefixRegistry.bind_pool)
+        self.registries = [PrefixRegistry(block_size)
+                           for _ in range(self.replicas)]
+        self.engines: List[ShardedServingEngine] = []
+        for r, submesh in enumerate(replica_submeshes(self.mesh,
+                                                      tensor_axis)):
+            self.engines.append(ShardedServingEngine(
+                net, max_seqs, max_len, mesh=submesh,
+                tensor_axis=tensor_axis, seed=seed + r,
+                metrics_parent=self.metrics,
+                prefix_registry=self.registries[r], **engine_kw))
+        self._lock = threading.Lock()
+        self._rr = 0
+        self._cohorts: "OrderedDict[tuple, int]" = OrderedDict()
+        # replicas are independent chips: drive them CONCURRENTLY per
+        # step() so one replica's chunk dispatch never serializes behind
+        # another's (each engine is only ever stepped by one worker at a
+        # time — step() joins before returning). On a single-core host the
+        # threads would only time-slice one processor and the contention
+        # is pure loss, so the fan-out is capped at the core count.
+        workers = min(self.replicas, os.cpu_count() or 1)
+        self._pool = (ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="dl4j-replica")
+            if workers > 1 else None)
+
+    # ------------------------------------------------------------ routing
+    def _route(self, req: Request) -> int:
+        tokens = list(req.tokens)
+        best, best_len = -1, 0
+        for r, reg in enumerate(self.registries):
+            matched = reg.match(tokens)[0]
+            if matched > best_len:
+                best, best_len = r, matched
+        if best >= 0:
+            self._c_affinity.inc()
+            return best
+        block_size = self.registries[0].block_size
+        cohort = tuple(tokens[:block_size]) if len(tokens) > block_size \
+            else None
+        if cohort is not None and cohort in self._cohorts:
+            self._cohorts.move_to_end(cohort)
+            return self._cohorts[cohort]
+        order = [(self._rr + i) % self.replicas
+                 for i in range(self.replicas)]
+        self._rr = (self._rr + 1) % self.replicas
+        chosen, chosen_load = order[0], None
+        for r in order:
+            snap = self.engines[r].stats()
+            load = snap["queue_depth"] + snap["active_slots"]
+            if chosen_load is None or load < chosen_load:
+                chosen, chosen_load = r, load
+        if cohort is not None:
+            self._cohorts[cohort] = chosen
+            while len(self._cohorts) > self._COHORT_CAP:
+                self._cohorts.popitem(last=False)
+        return chosen
+
+    # --------------------------------------------------- engine-shaped API
+    def submit(self, request):
+        """Route to a replica and queue there; returns that engine's
+        future."""
+        req = request if isinstance(request, Request) else Request(request)
+        with self._lock:
+            replica = self._route(req)
+            self._c_routed.inc()
+        return self.engines[replica].submit(req)
+
+    def step(self) -> bool:
+        """One scheduler iteration on EVERY replica, concurrently (one
+        worker per replica, joined before returning — the engines' own
+        device streams already run independently; this keeps their HOST
+        scheduling from serializing too). Returns True while any replica
+        has active or queued work."""
+        busy = False
+        if self._pool is None:
+            for engine in self.engines:
+                busy = engine.step() or busy
+            return busy
+        for done in [self._pool.submit(e.step) for e in self.engines]:
+            busy = done.result() or busy
+        return busy
+
+    def drain(self) -> None:
+        while self.step():
+            pass
+
+    def generate(self, prompts, **kw):
+        futs = [self.submit(p if isinstance(p, Request) else Request(p, **kw))
+                for p in prompts]
+        self.drain()
+        return [f.get(timeout=0) for f in futs]
+
+    def start(self) -> "ShardedServingGroup":
+        for engine in self.engines:
+            engine.start()
+        return self
+
+    def shutdown(self, wait: bool = True) -> None:
+        for engine in self.engines:
+            engine.shutdown(wait=wait)
+        if self._pool is not None:
+            self._pool.shutdown(wait=wait)
+
+    def stats(self) -> Dict[str, object]:
+        """Fleet view: lifetime counters summed across replicas, plus the
+        per-replica snapshots (each taken under its engine's lock)."""
+        per = [engine.stats() for engine in self.engines]
+        agg: Dict[str, object] = {
+            "replicas": self.replicas, "tp": self.tp,
+            "router_requests": self._c_routed.value,
+            "router_prefix_affinity": self._c_affinity.value,
+            "per_replica": per,
+        }
+        for key in ("host_syncs", "tokens_out", "queue_depth",
+                    "active_slots", "free_slots", "kv_blocks_free",
+                    "prefix_hits", "prefix_shared_tokens", "prefill_chunks",
+                    "nonfinite_chunks"):
+            agg[key] = sum(s.get(key, 0) for s in per)
+        agg["host_syncs_per_token"] = \
+            agg["host_syncs"] / max(1, agg["tokens_out"])
+        agg["resident_seqs_max"] = max(
+            (s.get("resident_seqs_max", 0) for s in per), default=0)
+        return agg

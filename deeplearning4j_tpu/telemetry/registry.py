@@ -145,9 +145,9 @@ class MetricsRegistry:
     """Get-or-create home for named metrics. Metric names use dotted paths
     ("serving.host_syncs"); Prometheus exposition sanitizes them to
     underscores. A child registry (parent=...) keeps its own storage but is
-    included in the parent's `prometheus_text()` — same-named counters and
-    histogram buckets aggregate across children (the process-level view),
-    gauges take the last registry's value."""
+    included in the parent's `prometheus_text()`, transitively — same-named
+    counters and histogram buckets aggregate across all live descendants
+    (the process-level view), gauges take the last registry's value."""
 
     def __init__(self, parent: Optional["MetricsRegistry"] = None):
         self._metrics: Dict[str, object] = {}
@@ -204,15 +204,30 @@ class MetricsRegistry:
 
     # ------------------------------------------------------- exposition
     def _all_registries(self) -> List["MetricsRegistry"]:
-        regs = [self]
-        with self._lock:
-            children = [r() for r in self._children]
-        regs.extend(c for c in children if c is not None)
+        """This registry plus every live DESCENDANT, breadth-first.
+
+        Recursive (not one level) since ISSUE 10: a ShardedServingGroup
+        parents its per-replica engine registries to its own group registry,
+        which is itself a child of the process-global registry — the
+        grandchild engine metrics must still aggregate into the process-wide
+        /metrics exposition. A `seen` id-set guards against adoption cycles."""
+        regs: List["MetricsRegistry"] = []
+        seen = set()
+        queue = [self]
+        while queue:
+            reg = queue.pop(0)
+            if id(reg) in seen:
+                continue
+            seen.add(id(reg))
+            regs.append(reg)
+            with reg._lock:
+                children = [r() for r in reg._children]
+            queue.extend(c for c in children if c is not None)
         return regs
 
     def prometheus_text(self) -> str:
         """Prometheus text exposition (version 0.0.4) over this registry and
-        its live children. Same-named counters and histogram buckets sum
+        its live descendants. Same-named counters and histogram buckets sum
         across registries; gauges take the last value seen.
 
         Format audit (ISSUE 8 satellite, round-trip-tested against a

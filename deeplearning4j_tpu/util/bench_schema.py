@@ -16,7 +16,10 @@ platform plus a well-formed attainment curve). ISSUE 9 adds
 `serving_chunked_prefill` (the chunked-prefill A/B — CPU-runnable and
 always present; measured entries must carry a numeric chunk_budget,
 off/on sides with the tail stats the docs render, and the delta
-fields). bench.py calls
+fields). ISSUE 10 adds `serving_sharded` (the multi-chip TP parity +
+replica goodput A/B — always present; measured entries must carry the
+fleet `goodput`, a `tp_parity` block whose tokens_match is True, and a
+`replica_ab` block with both sides' goodput). bench.py calls
 `assert_valid` on the dict it is about to print, and
 tests/test_bench_schema.py re-validates the committed artifact, so the
 contract holds at write time and at review time.
@@ -179,6 +182,39 @@ def validate_artifact(art: dict) -> List[str]:
                 errs.append("serving_chunked_prefill.deltas."
                             "max_sustainable_rate_delta must be numeric "
                             "or null")
+
+    # multi-chip sharded serving (ISSUE 10): runs on forced host devices,
+    # so the entry must always exist; when measured the TP side must have
+    # actually matched tokens (a sharded engine that drifts is a bug, not
+    # a data point) and both replica-A/B sides must carry goodput
+    sh = e.get("serving_sharded")
+    if not isinstance(sh, dict):
+        errs.append("extra['serving_sharded'] missing or not a dict (the "
+                    "sharded bench runs on forced host devices — emit "
+                    "error/skipped entries rather than dropping it)")
+    elif "error" not in sh and "skipped_reason" not in sh:
+        if not isinstance(sh.get("platform"), str):
+            errs.append("extra['serving_sharded'] has no 'platform' label")
+        if not _is_num(sh.get("goodput")):
+            errs.append("extra['serving_sharded'].goodput missing or not "
+                        "a number")
+        tpp = sh.get("tp_parity")
+        if not isinstance(tpp, dict) or tpp.get("tokens_match") is not True:
+            errs.append("serving_sharded.tp_parity.tokens_match must be "
+                        "True — the TP engine drifted from the single-chip "
+                        "token stream")
+        elif not _is_num(tpp.get("kv_bytes_per_pos_per_chip_ratio")):
+            errs.append("serving_sharded.tp_parity."
+                        "kv_bytes_per_pos_per_chip_ratio missing or not a "
+                        "number")
+        ab = sh.get("replica_ab")
+        if not isinstance(ab, dict) or not all(
+                isinstance(ab.get(s), dict)
+                and _is_num(ab[s].get("goodput"))
+                for s in ("one_replica", "two_replicas")):
+            errs.append("serving_sharded.replica_ab must carry "
+                        "one_replica/two_replicas dicts with numeric "
+                        "goodput")
 
     # every measurement dict carries a platform label
     for name, entry in e.items():

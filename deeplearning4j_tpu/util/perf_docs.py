@@ -155,6 +155,46 @@ def _chunked_prefill_lines(cp) -> list:
     return [line]
 
 
+def _sharded_serving_lines(sh) -> list:
+    """Multi-chip sharded serving section from extra['serving_sharded']
+    (ISSUE 10): the TP parity/bytes facts plus the fixed-rate replica
+    goodput A/B."""
+    if not isinstance(sh, dict) or not isinstance(sh.get("tp_parity"), dict):
+        if isinstance(sh, dict) and sh.get("skipped_reason"):
+            return [f"- Multi-chip sharded serving: {sh['skipped_reason']} "
+                    f"(platform: {sh.get('platform', '?')})."]
+        return []
+    tpp = sh["tp_parity"]
+    ab = sh.get("replica_ab") or {}
+    one, two = ab.get("one_replica") or {}, ab.get("two_replicas") or {}
+    lines = [
+        f"- Multi-chip sharded serving (ISSUE 10, {sh.get('platform', '?')}, "
+        f"{sh.get('devices', '?')} devices): TP={tpp.get('tp', '?')} decode "
+        f"is **{'bit-identical' if tpp.get('tokens_match') else 'DRIFTED'}**"
+        f" to single-chip ({tpp.get('added_syncs_per_token', '?')} added "
+        f"host syncs/token) with the paged KV pool head-sharded — "
+        f"{tpp.get('kv_heads_per_chip', '?')}/"
+        f"{tpp.get('kv_heads_logical', '?')} KV heads and "
+        f"{_pct(tpp.get('kv_bytes_per_pos_per_chip_ratio'))} of each "
+        f"position's bytes per chip."]
+    if one.get("goodput") is not None and two.get("goodput") is not None:
+        gain = ab.get("goodput_gain")
+        lines.append(
+            f"  Replica A/B at the same offered rate "
+            f"({ab.get('offered_rate', 0):,.1f} req/s, an overload of one "
+            f"replica; same calibrated budgets): goodput "
+            f"{one['goodput']:,.1f} -> {two['goodput']:,.1f} req/s with 2 "
+            f"replicas"
+            + (f" ({gain:.2f}x)" if gain else "")
+            + f", SLO attainment {one.get('slo_attained_frac', 0):.0%} -> "
+            f"{two.get('slo_attained_frac', 0):.0%}, TTFT p99 "
+            f"{(one.get('ttft_p99_s') or 0) * 1e3:.1f} -> "
+            f"{(two.get('ttft_p99_s') or 0) * 1e3:.1f} ms. "
+            f"`DL4J_TPU_TP` / `DL4J_TPU_REPLICAS` — see README "
+            f"\"Multi-chip serving\".")
+    return lines
+
+
 def render_block(art: dict) -> str:
     """Markdown bullet block rendered VERBATIM into README.md and PERF.md."""
     e = art["extra"]
@@ -307,6 +347,7 @@ def render_block(art: dict) -> str:
         lines.append(line)
     lines.extend(_serving_slo_lines(e.get("serving_slo")))
     lines.extend(_chunked_prefill_lines(e.get("serving_chunked_prefill")))
+    lines.extend(_sharded_serving_lines(e.get("serving_sharded")))
     lines.extend(_roofline_table_lines(e.get("roofline_table")))
     lines.append(
         f"- ParallelWrapper ResNet50: {pw['images_per_sec']:,.0f} img/s — "

@@ -11,9 +11,27 @@ if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = flags + " --xla_force_host_platform_device_count=8"
 
 import jax  # noqa: E402
+import pytest  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+@pytest.fixture
+def forced_host_devices():
+    """Devices for the multi-device sharding tests (ISSUE 10), tier-1-safe.
+
+    The device count is fixed when the XLA backend initializes (the
+    module-level XLA_FLAGS above, applied only when the caller didn't force
+    a count themselves), so this fixture cannot — and does not — mutate
+    any global state that could leak into other tests: it merely VERIFIES
+    that enough virtual devices exist and skips the test otherwise (e.g.
+    when an outer harness pinned a smaller count)."""
+    devices = jax.devices()
+    if len(devices) < 8:
+        pytest.skip(f"sharded-serving tests need 8 forced host devices, "
+                    f"have {len(devices)}")
+    return devices
 
 
 def pytest_configure(config):

@@ -53,6 +53,12 @@ def _minimal_art():
                            "decode_stall_p99_delta_ms": 2.0,
                            "queue_wait_share_delta": 0.05,
                            "max_sustainable_rate_delta": 0.0}},
+            "serving_sharded": {
+                "platform": "cpu", "seed": 0, "goodput": 18.0,
+                "tp_parity": {"tokens_match": True,
+                              "kv_bytes_per_pos_per_chip_ratio": 0.5},
+                "replica_ab": {"one_replica": {"goodput": 18.0},
+                               "two_replicas": {"goodput": 19.0}}},
             "roofline_table": [
                 {"function": "train_step", "platform": "tpu",
                  "flops": 1e12, "bytes_accessed": 1e9,
@@ -188,6 +194,47 @@ def test_chunked_prefill_ab_rules():
     assert validate_artifact(art) == []
     art["extra"]["serving_chunked_prefill"] = {"platform": "cpu",
                                                "skipped_reason": "why not"}
+    assert validate_artifact(art) == []
+
+
+def test_sharded_serving_rules():
+    """ISSUE 10: the multi-chip entry must always exist; a measured entry
+    needs the fleet goodput, a TP parity block whose tokens_match is True
+    (a drifted TP engine must fail the gate, not publish), the per-chip
+    KV bytes ratio, and both replica A/B sides; skipped/errored exempt."""
+    art = _minimal_art()
+    del art["extra"]["serving_sharded"]
+    assert any("serving_sharded" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["serving_sharded"]["platform"]
+    assert any("serving_sharded" in e and "platform" in e
+               for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["serving_sharded"]["goodput"]
+    assert any("serving_sharded'].goodput" in e
+               for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["serving_sharded"]["tp_parity"]["tokens_match"] = False
+    assert any("tokens_match" in e and "drifted" in e
+               for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["serving_sharded"]["tp_parity"][
+        "kv_bytes_per_pos_per_chip_ratio"]
+    assert any("kv_bytes_per_pos_per_chip_ratio" in e
+               for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["serving_sharded"]["replica_ab"]["two_replicas"]
+    assert any("replica_ab" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["serving_sharded"]["replica_ab"]["one_replica"][
+        "goodput"] = "fast"
+    assert any("replica_ab" in e for e in validate_artifact(art))
+    # skipped / errored entries are exempt from the measured-field rules
+    art = _minimal_art()
+    art["extra"]["serving_sharded"] = {"error": "RuntimeError: boom"}
+    assert validate_artifact(art) == []
+    art["extra"]["serving_sharded"] = {
+        "platform": "cpu", "skipped_reason": "needs >= 2*tp devices"}
     assert validate_artifact(art) == []
 
 

@@ -1,0 +1,328 @@
+"""Multi-chip sharded serving tests (ISSUE 10).
+
+The load-bearing guarantees, all CPU-checkable on the conftest's 8 forced
+host devices (the `forced_host_devices` fixture verifies the count and
+skips when an outer harness pinned fewer):
+
+- TOKEN PARITY: the tensor-parallel engine (TP in {1, 2}) and the replica
+  group (replicas in {1, 2}) produce bit-identical greedy tokens to the
+  single-chip engine on the same seeded schedule — head-local attention
+  computes each head exactly as one chip would, and the only collective
+  (the w_o row-parallel all-reduce) perturbs fp64 logits at ~1e-15, far
+  inside the argmax margin.
+- ORACLE PARITY: captured decode logprobs still match the fp64
+  full-recompute forward to 1e-9 under TP.
+- SYNC BIT-PARITY: sharding adds ZERO host syncs per token — the host
+  scheduler is untouched, so `host_syncs` matches the single-chip engine
+  exactly on the same schedule.
+- BYTES: the KV pool is head-sharded, so each device holds 1/TP of every
+  position's bytes; `serving.kv_bytes_resident` reports per-device bytes.
+- ROUTING: data-parallel replicas with identical prompts still get COW
+  prefix hits (cohort + prefix-affinity routing), at single-engine parity.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.parallel import InferenceMode, ParallelInference
+from deeplearning4j_tpu.serving import (KVCache, PrefixRegistry, Request,
+                                        ServingEngine)
+from deeplearning4j_tpu.serving.sharding import (ShardedServingEngine,
+                                                 ShardedServingGroup,
+                                                 cache_partition_specs,
+                                                 match_partition_rules,
+                                                 resolve_replicas, resolve_tp,
+                                                 serving_partition_rules)
+
+from tests.test_serving import V, _assert_parity, _build_net
+
+PROMPTS = [[1, 2, 3, 4, 5], [5, 4, 3], [2, 2, 7, 1], [9, 8, 7, 6, 5, 4]]
+
+
+def _tokens(results):
+    return [r.tokens for r in results]
+
+
+# --------------------------------------------------------- partition rules
+def test_match_partition_rules_first_match_and_scalars():
+    params = [{"w_q": np.zeros((8, 8)), "b": np.zeros((8,)),
+               "scale": np.float64(2.0)}]
+    rules = [(r"w_q$", P(None, "tensor")), (r"b$", P())]
+    specs = match_partition_rules(rules, params)
+    assert specs[0]["w_q"] == P(None, "tensor")
+    assert specs[0]["b"] == P()
+    assert specs[0]["scale"] == P()          # scalar: always replicated
+
+
+def test_match_partition_rules_unmatched_raises():
+    with pytest.raises(ValueError, match="no partition rule"):
+        match_partition_rules([(r"w_q$", P())],
+                              [{"w_unknown": np.zeros((4, 4))}])
+
+
+def test_serving_rules_cover_attention_stack():
+    net = _build_net(n_kv=2)
+    eng = ServingEngine(net, max_seqs=2, max_len=32, dtype="float64")
+    specs = match_partition_rules(serving_partition_rules("tensor"),
+                                  eng.decoder.params)
+    for i in (0, 1):                          # the two attention layers
+        assert specs[i]["w_q"] == P(None, "tensor")
+        assert specs[i]["w_k"] == P(None, "tensor")
+        assert specs[i]["w_v"] == P(None, "tensor")
+        assert specs[i]["w_o"] == P("tensor", None)
+        assert specs[i]["b"] == P()
+    # output head replicated (its matmul follows the all-reduced residual)
+    assert all(s == P() for s in
+               jax.tree_util.tree_leaves(specs[2],
+                                         is_leaf=lambda x: isinstance(x, P)))
+    cs = cache_partition_specs("tensor")
+    assert cs["k"] == P(None, None, None, "tensor", None)
+    assert cs["block_tables"] == P()
+
+
+# ------------------------------------------------------------ env knobs
+def test_resolve_degrees_env(monkeypatch):
+    assert resolve_tp(None) == 1 and resolve_replicas(None) == 1
+    monkeypatch.setenv("DL4J_TPU_TP", "2")
+    monkeypatch.setenv("DL4J_TPU_REPLICAS", "4")
+    assert resolve_tp(None) == 2 and resolve_replicas(None) == 4
+    assert resolve_tp(3) == 3                 # explicit beats env
+    with pytest.raises(ValueError):
+        resolve_tp(0)
+
+
+# ----------------------------------------------------- tensor parallelism
+@pytest.mark.parametrize("tp", [1, 2])
+def test_tp_token_and_oracle_parity(forced_host_devices, tp):
+    net = _build_net(n_kv=2)
+    base = ServingEngine(net, max_seqs=4, max_len=64, dtype="float64",
+                         capture_logprobs=True)
+    ref = base.generate(PROMPTS, max_new_tokens=8)
+    eng = ShardedServingEngine(net, max_seqs=4, max_len=64, dtype="float64",
+                               capture_logprobs=True, tp=tp)
+    got = eng.generate(PROMPTS, max_new_tokens=8)
+    assert _tokens(got) == _tokens(ref)       # bit-identical greedy stream
+    for prompt, res in zip(PROMPTS, got):
+        _assert_parity(net, res, prompt)      # fp64 oracle, atol 1e-9
+
+
+def test_tp_kv_pool_is_head_sharded_and_bytes_halve(forced_host_devices):
+    net = _build_net(n_kv=2)
+    base = ServingEngine(net, max_seqs=4, max_len=64, dtype="float64")
+    eng = ShardedServingEngine(net, max_seqs=4, max_len=64,
+                               dtype="float64", tp=2)
+    k = eng.decoder.cache.state["k"]
+    assert k.shape[3] == 2                    # logical: both kv heads
+    assert k.addressable_data(0).shape[3] == 1   # per device: Hk / tp
+    assert eng._kv_bytes_per_pos * 2 == base._kv_bytes_per_pos
+    # resident-bytes gauge is per-device: same schedule -> exactly half
+    base.generate(PROMPTS[:1], max_new_tokens=4)
+    eng.generate(PROMPTS[:1], max_new_tokens=4)
+    g = "serving.kv_bytes_resident"
+    hw_base = base.metrics.get(g)
+    hw_eng = eng.metrics.get(g)
+    assert hw_base is not None and hw_eng is not None
+    # both drained -> residency returned to 0; compare the preallocated
+    # pool gauge instead (stable, geometry-only)
+    assert eng.metrics.get("serving.kv_cache_bytes").value * 2 \
+        == base.metrics.get("serving.kv_cache_bytes").value
+    assert eng.stats()["tp"] == 2
+
+
+def test_tp_kv_resident_gauge_is_per_device_mid_flight(forced_host_devices):
+    net = _build_net(n_kv=2)
+    vals = {}
+    for name, eng in (("base", ServingEngine(net, 4, 64, dtype="float64")),
+                      ("tp2", ShardedServingEngine(net, 4, 64,
+                                                   dtype="float64", tp=2))):
+        eng.submit(Request([1, 2, 3, 4, 5], max_new_tokens=8))
+        eng.step()                            # admit + first chunk
+        vals[name] = eng.metrics.get("serving.kv_bytes_resident").value
+        eng.drain()
+    assert vals["base"] > 0
+    assert vals["tp2"] * 2 == vals["base"]
+
+
+def test_tp_host_sync_bit_parity(forced_host_devices):
+    net = _build_net(n_kv=2)
+    base = ServingEngine(net, max_seqs=4, max_len=64, dtype="float64")
+    base.generate(PROMPTS, max_new_tokens=8)
+    eng = ShardedServingEngine(net, max_seqs=4, max_len=64,
+                               dtype="float64", tp=2)
+    eng.generate(PROMPTS, max_new_tokens=8)
+    sb, se = base.stats(), eng.stats()
+    assert se["tokens_out"] == sb["tokens_out"]
+    assert se["host_syncs"] == sb["host_syncs"]   # sharding adds ZERO syncs
+
+
+def test_tp_midstream_admission_parity(forced_host_devices):
+    net = _build_net(n_kv=2)
+
+    def drive(eng):
+        f0 = eng.submit(Request([1, 2, 3, 4, 5, 6, 7], max_new_tokens=12))
+        for _ in range(3):                    # decode is mid-stream...
+            eng.step()
+        f1 = eng.submit(Request([3, 1, 4, 1, 5], max_new_tokens=6))
+        eng.drain()
+        return [f0.get(timeout=0).tokens, f1.get(timeout=0).tokens]
+
+    ref = drive(ServingEngine(net, max_seqs=4, max_len=64, dtype="float64",
+                              overlap=False))
+    got = drive(ShardedServingEngine(net, max_seqs=4, max_len=64,
+                                     dtype="float64", tp=2, overlap=False))
+    assert got == ref
+
+
+def test_tp_must_divide_heads(forced_host_devices):
+    net = _build_net(n_kv=2)                  # Hk=2, H=4
+    with pytest.raises(ValueError, match="n_kv_heads"):
+        ShardedServingEngine(net, 2, 32, dtype="float64", tp=4)
+    net_mha = _build_net(n_kv=0)              # Hk=H=4: heads must divide too
+    with pytest.raises(ValueError):
+        ShardedServingEngine(net_mha, 2, 32, dtype="float64", tp=3)
+
+
+# ----------------------------------------------------- replica groups (DP)
+@pytest.mark.parametrize("replicas,tp", [(1, 2), (2, 1), (2, 2)])
+def test_group_token_parity(forced_host_devices, replicas, tp):
+    net = _build_net(n_kv=2)
+    ref = ServingEngine(net, max_seqs=4, max_len=64,
+                        dtype="float64").generate(PROMPTS, max_new_tokens=8)
+    grp = ShardedServingGroup(net, 4, 64, dtype="float64",
+                              replicas=replicas, tp=tp)
+    got = grp.generate(PROMPTS, max_new_tokens=8)
+    assert _tokens(got) == _tokens(ref)
+    st = grp.stats()
+    assert st["replicas"] == replicas and st["tp"] == tp
+    assert st["tokens_out"] == sum(len(t) for t in _tokens(ref))
+
+
+def test_group_prefix_hit_rate_parity(forced_host_devices):
+    """Identical prompts submitted upfront to a 2-replica group land on
+    ONE replica (cohort routing seeds the registry the rest hit), so the
+    fleet's COW prefix hits match the single engine's on the same
+    multiset of prompts."""
+    # two cohorts of identical prompts, longer than one (kv_block=4) block
+    a = [1, 2, 3, 4, 5, 6]
+    b = [7, 8, 9, 1, 2, 3]
+    prompts = [a, b, list(a), list(b)]
+    kw = dict(dtype="float64", kv_block=4, prefix_share=True)
+    single = ServingEngine(_build_net(n_kv=2), 4, 64, **kw)
+    single.generate(prompts, max_new_tokens=4)
+    want = single.stats()["prefix_hits"]
+    assert want == 2                          # one hit per repeated prompt
+
+    grp = ShardedServingGroup(_build_net(n_kv=2), 4, 64, replicas=2, tp=1,
+                              **kw)
+    grp.generate(prompts, max_new_tokens=4)
+    st = grp.stats()
+    assert st["prefix_hits"] == want          # hit-rate parity
+    assert st["prefix_shared_tokens"] \
+        == single.stats()["prefix_shared_tokens"]
+    # and the two cohorts actually spread over both replicas (least-loaded
+    # took the second cohort to the idle replica)
+    per = [s["prefix_hits"] for s in st["per_replica"]]
+    assert sorted(per) == [1, 1]
+
+
+def test_group_resident_prefix_affinity_routing(forced_host_devices):
+    """A prompt whose prefix is currently RESIDENT on a replica routes
+    there (registry entries live exactly as long as the blocks do, so this
+    is a mid-flight property — a retired request's entries are gone)."""
+    a = [1, 2, 3, 4, 5, 6]
+    grp = ShardedServingGroup(_build_net(n_kv=2), 4, 64, replicas=2, tp=1,
+                              dtype="float64", kv_block=4,
+                              prefix_share=True, overlap=False)
+    f0 = grp.submit(Request(a, max_new_tokens=24))
+    for _ in range(40):                       # step until a's prompt blocks
+        grp.step()                            # are prefillied + registered
+        if any(r.n_entries for r in grp.registries):
+            break
+    owners = [i for i, r in enumerate(grp.registries) if r.n_entries]
+    assert len(owners) == 1
+    before = grp.stats()["router_prefix_affinity"]
+    f1 = grp.submit(Request(list(a), max_new_tokens=4))
+    grp.drain()
+    f0.get(timeout=0), f1.get(timeout=0)
+    st = grp.stats()
+    assert st["router_prefix_affinity"] == before + 1
+    assert st["per_replica"][owners[0]]["prefix_hits"] == 1
+
+
+def test_group_spans_loadgen_and_slo(forced_host_devices):
+    from deeplearning4j_tpu.serving import LoadSpec, build_schedule
+    from deeplearning4j_tpu.serving.loadgen import run
+    from deeplearning4j_tpu.telemetry import slo as slo_mod
+    grp = ShardedServingGroup(_build_net(n_kv=2), 4, 64, replicas=2, tp=1,
+                              dtype="float64")
+    spec = LoadSpec(rate=200.0, n_requests=8, vocab=V,
+                    prompt_len_mix=((4, 1.0),),
+                    max_new_tokens_mix=((4, 1.0),), seed=3)
+    res = run(grp, build_schedule(spec))
+    assert len(res.outcomes) == 8
+    assert all(o.finish_reason == "length" for o in res.outcomes)
+    report = slo_mod.evaluate(res.outcomes,
+                              slo_mod.SLO(ttft_s=60.0, tpot_s=60.0),
+                              wall_s=res.wall_s,
+                              offered_rate=res.offered_rate)
+    assert report["n_completed"] == 8
+    assert report["slo_attained_frac"] == 1.0
+    # both replicas actually served (8 upfront-queued requests, 4 slots
+    # per replica, least-loaded routing)
+    toks = [s["tokens_out"] for s in grp.stats()["per_replica"]]
+    assert all(t > 0 for t in toks)
+
+
+def test_parallel_inference_generate_env_knobs(forced_host_devices,
+                                               monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_REPLICAS", "2")
+    monkeypatch.setenv("DL4J_TPU_TP", "1")
+    net = _build_net(n_kv=2)
+    pi = ParallelInference(net, inference_mode=InferenceMode.GENERATE,
+                           generate_kwargs={"max_seqs": 4, "max_len": 64,
+                                            "dtype": "float64"})
+    try:
+        assert isinstance(pi._engine, ShardedServingGroup)
+        out = pi.output(Request([1, 2, 3], max_new_tokens=4))
+        assert len(out.tokens) == 4
+        st = pi.generation_stats()
+        assert st["replicas"] == 2 and st["tokens_out"] == 4
+    finally:
+        pi.shutdown()
+
+
+# ------------------------------------------------- registry handle safety
+def test_prefix_registry_rejects_cross_pool_sharing():
+    reg = PrefixRegistry(4)
+    # keep the first pool alive: the bind is a weakref, so a dead owner
+    # (e.g. a torn-down replica) legitimately frees the handle for reuse
+    pool = KVCache(n_layers=1, max_seqs=2, max_len=16, n_kv_heads=1,
+                   head_dim=2, block_size=4, prefix_registry=reg)
+    assert reg is pool.registry
+    with pytest.raises(ValueError, match="pool"):
+        KVCache(n_layers=1, max_seqs=2, max_len=16, n_kv_heads=1,
+                head_dim=2, block_size=4, prefix_registry=reg)
+
+
+def test_prefix_registry_block_size_must_match():
+    with pytest.raises(ValueError, match="block_size"):
+        KVCache(n_layers=1, max_seqs=2, max_len=16, n_kv_heads=1,
+                head_dim=2, block_size=8, prefix_registry=PrefixRegistry(4))
+
+
+# ------------------------------------------- telemetry: recursive adoption
+def test_metrics_aggregation_is_recursive():
+    from deeplearning4j_tpu.telemetry import MetricsRegistry
+    root = MetricsRegistry()
+    group = MetricsRegistry(parent=root)
+    child_a = MetricsRegistry(parent=group)
+    child_b = MetricsRegistry(parent=group)
+    child_a.counter("serving.tokens_out").inc(3)
+    child_b.counter("serving.tokens_out").inc(4)
+    text = root.prometheus_text()
+    assert "serving_tokens_out 7" in text     # grandchildren aggregate

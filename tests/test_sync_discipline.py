@@ -34,7 +34,12 @@ HOT_PATH_MODULES = sorted(
      # open-loop load generator (ISSUE 8): its submit/step/collect loop IS
      # the measurement harness — a stray readback there would show up as
      # fake queueing in every goodput number
-     PKG / "serving" / "loadgen.py"]
+     PKG / "serving" / "loadgen.py",
+     # multi-chip sharding (ISSUE 10): the head-sharded attention wrapper
+     # runs inside every decode dispatch and the replica router runs at
+     # every admission — a hidden readback in either would multiply by
+     # TP degree and replica count
+     PKG / "serving" / "sharding.py"]
     + list((PKG / "telemetry").glob("*.py")))
 
 ANNOTATION = "sync-ok:"
@@ -104,7 +109,7 @@ def test_all_hot_path_modules_exist():
     assert {"health.py", "profiler.py", "memory.py", "tracing.py",
             "registry.py", "training.py", "kv_cache.py",
             "block_table.py", "slo.py", "flight_recorder.py",
-            "loadgen.py"} <= names
+            "loadgen.py", "sharding.py"} <= names
 
 
 # ------------------------------------------------ scanner self-tests
