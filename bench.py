@@ -1297,6 +1297,93 @@ def bench_chunked_prefill_ab(chunk=128, vocab=32, d_model=128, heads=2,
                  "(bounded decode stalls), not TPU-scale wall wins")}
 
 
+def bench_spec_decode_ab(vocab=32, d_model=128, heads=2, kv_heads=1,
+                         n_requests=4, prompt_len=64, new_tokens=48,
+                         spec_draft=4, rounds=3, seed=0):
+    """Speculative-decode A/B (ISSUE 11): the same repetitive-text
+    workload (prompts that quote themselves — the self-similar regime
+    prompt-lookup drafting targets: code, RAG, summarization) served
+    greedy through the SAME model spec ON vs OFF at identical seeds, K=1
+    both sides so the A/B isolates speculation from chunking. Token
+    parity between the two modes is ASSERTED, not reported — greedy spec
+    decode is bit-identical by construction, so the bench measures pure
+    throughput: accept rate, tokens/sec both sides, and host syncs/token
+    (the spec win on the tunneled dev chip is sync amortization: every
+    accepted draft token rides an iteration's existing readback). Sized
+    for CPU so every artifact carries the A/B."""
+    import time as _time
+
+    from deeplearning4j_tpu import (
+        Activation, InputType, NeuralNetConfiguration, RnnOutputLayer,
+        Sgd, WeightInit)
+    from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import Request, ServingEngine
+
+    b = (NeuralNetConfiguration.Builder().seed(42)
+         .weight_init(WeightInit.XAVIER)
+         .updater(Sgd(learning_rate=1e-3)).list())
+    for _ in range(2):
+        b.layer(SelfAttentionLayer(n_out=d_model, n_heads=heads,
+                                   n_kv_heads=kv_heads, causal=True,
+                                   block_size=0))
+    b.layer(RnnOutputLayer(n_out=vocab, activation=Activation.SOFTMAX))
+    net = MultiLayerNetwork(
+        b.set_input_type(InputType.recurrent(vocab)).build()).init()
+    rng = np.random.RandomState(seed)
+    # a short random motif tiled to prompt_len: the generation keeps
+    # quoting the motif, so the n-gram index gets real matches
+    prompts = [(rng.randint(0, vocab, 6).tolist() * prompt_len)[:prompt_len]
+               for _ in range(n_requests)]
+    max_len = 1 << (prompt_len + new_tokens - 1).bit_length()
+
+    def serve(spec):
+        eng = ServingEngine(net, max_seqs=n_requests, max_len=max_len,
+                            seed=0, decode_chunk=1, overlap=False,
+                            spec_decode=spec, spec_draft=spec_draft)
+        mk = lambda p: Request(list(p), max_new_tokens=new_tokens)
+        eng.generate([mk(p) for p in prompts])      # warmup: compile
+        eng.metrics.reset()
+        t0 = _time.perf_counter()
+        rounds_res = [eng.generate([mk(p) for p in prompts])
+                      for _ in range(rounds)]
+        wall = _time.perf_counter() - t0
+        return {"tokens": [[r.tokens for r in rr] for rr in rounds_res],
+                "wall_s": wall, "stats": eng.stats()}
+
+    on, off = serve(True), serve(False)
+    assert on["tokens"] == off["tokens"], \
+        "speculative decode changed greedy tokens — parity violation"
+    s_on, s_off = on["stats"], off["stats"]
+    tps_on = s_on["tokens_out"] / on["wall_s"]
+    tps_off = s_off["tokens_out"] / off["wall_s"]
+    return {
+        "workload": f"{n_requests} requests x {prompt_len}-token "
+                    f"repetitive prompts (6-token motif tiled) x "
+                    f"{new_tokens} greedy tokens, {rounds} timed rounds",
+        "spec_draft": spec_draft,
+        "tokens_identical": True,
+        "accept_rate": round(float(s_on["spec_accept_rate"]), 4),
+        "spec_tokens_accepted": s_on["spec_tokens_accepted"],
+        "spec_tokens_rejected": s_on["spec_tokens_rejected"],
+        "tokens_per_sec_on": round(tps_on, 1),
+        "tokens_per_sec_off": round(tps_off, 1),
+        "tokens_per_sec_delta_frac": round(tps_on / tps_off - 1, 4),
+        "host_syncs_per_token_on": round(
+            float(s_on["host_syncs_per_token"]), 4),
+        "host_syncs_per_token_off": round(
+            float(s_off["host_syncs_per_token"]), 4),
+        "note": ("same seed/model/schedule both sides, K=1 (per-iteration "
+                 "sync) so the delta isolates speculation; greedy token "
+                 "parity asserted — throughput moved, distribution did "
+                 "not; repetitive motif workload is the FAVORABLE case "
+                 "for n-gram drafting (PERF.md speculation cost model "
+                 "covers when plain K-chunking wins instead); reduced "
+                 "CPU-runnable config — the mechanism (accepted drafts "
+                 "amortizing the per-iteration sync), not TPU-scale "
+                 "wall wins")}
+
+
 def bench_sharded_serving(vocab=32, d_model=64, heads=4, kv_heads=2,
                           tp=2, max_seqs=4, n_requests=24, seed=0,
                           overload_factor=10.0, repeats=3,
@@ -1674,6 +1761,10 @@ def main():
         chunked_ab = bench_chunked_prefill_ab()
     except Exception as e:
         chunked_ab = {"error": f"{type(e).__name__}: {e}"}
+    try:  # speculative-decode A/B (ISSUE 11): accept rate + tokens/sec
+        spec_ab = bench_spec_decode_ab()
+    except Exception as e:
+        spec_ab = {"error": f"{type(e).__name__}: {e}"}
     try:  # multi-chip sharded serving (ISSUE 10): TP parity + replica A/B
         sharded = bench_sharded_serving()
         if "skipped" not in sharded:
@@ -1756,6 +1847,9 @@ def main():
             # pre-rounded (goodput/TTFT at ms scale); always present —
             # skipped runs carry skipped_reason (ISSUE 10)
             "serving_sharded": sharded,
+            # pre-rounded (accept_rate/syncs-per-token at 4 decimals);
+            # always present — CPU-runnable A/B (ISSUE 11)
+            "serving_spec_decode": spec_ab,
             "decode_tokens_per_sec": round(
                 decode.get("decode_tokens_per_sec", 0.0), 1),
             "serving_profile": serving_profile,

@@ -335,6 +335,175 @@ register_helper("decode_attention_paged",
                 default_on=True)(flash_decode_attention_paged)
 
 
+# ------------------------------------------------- speculative (multi-query)
+def decode_attention_dense_spec_paged(q, kp, vp, block_tables, visible,
+                                      scale, window: int = 0):
+    """Dense paged oracle for SPECULATIVE verification (ISSUE 11): score Q
+    consecutive query positions per slot in one call. q: (S, Q, H, D) where
+    query i of slot s sits at logical position visible[s] - 1 + i (query 0
+    is the ordinary next-token query; queries 1..Q-1 are draft tokens whose
+    KV was provisionally appended). Query i therefore sees j < visible + i.
+
+    Implemented as Q calls of the single-query dense paged oracle — the
+    per-position math (shapes, einsum order, masking) is IDENTICAL to the
+    plain decode path, so a spec step's row i is bit-identical to what the
+    sequential decode step would have computed at that position given the
+    same cache. That is what makes this both the fp64 oracle AND the
+    bit-identical fallback for the multi-query kernel."""
+    S, Q = q.shape[0], q.shape[1]
+    visible = jnp.asarray(visible, jnp.int32)
+    outs = [decode_attention_dense_paged(q[:, i], kp, vp, block_tables,
+                                         visible + i, scale, window)
+            for i in range(Q)]
+    return jnp.stack(outs, axis=1)                   # (S, Q, H, D)
+
+
+def _spec_decode_kernel(q_ref, k_ref, v_ref, m_ref, vis_ref, o_ref, l_ref, *,
+                        nq, bkv, window, scale, acc_dt):
+    """Multi-query generalization of `_decode_kernel`: one grid cell =
+    (slot, kv head, length partition), scoring all Q query positions of the
+    slot against this partition's bkv cache positions. The FlashAttention-2
+    online-softmax algebra is unchanged — the query tile just grows from
+    (G, D) to (Q*G, D), with the per-QUERY visibility mask (query i sees
+    j < vis + i) applied per (query, position) from the precomputed
+    (S, Q, L) mask stripe. Partitions no query can see emit (0, NEG_INF)."""
+    from jax.experimental import pallas as pl
+    j = pl.program_id(2)
+    vis = vis_ref[0, 0]                              # query 0's visible length
+    lo = j * bkv
+    run = lo < vis + nq - 1                          # any query sees any pos?
+    if window:
+        run = run & (lo + bkv > vis - window)        # union over queries
+
+    @pl.when(run)
+    def _():
+        nG, D = q_ref.shape[3], q_ref.shape[4]
+        q = q_ref[0, 0].reshape(nq * nG, D).astype(acc_dt)
+        k = k_ref[0, :, 0, :].astype(acc_dt)         # (bkv, D)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=acc_dt) * scale
+        s = s.reshape(nq, nG, bkv)
+        valid = m_ref[0, :, :] > 0                   # (Q, bkv)
+        s = jnp.where(valid[:, None, :], s, NEG_INF)
+        m = jnp.max(s, axis=2)                       # (Q, G)
+        p = jnp.exp(s - m[:, :, None])
+        p = jnp.where(valid[:, None, :], p, 0.0)
+        l = jnp.sum(p, axis=2)                       # (Q, G)
+        o = jax.lax.dot_general(p.reshape(nq * nG, bkv),
+                                v_ref[0, :, 0, :].astype(acc_dt),
+                                (((1,), (0,)), ((), ())),
+                                preferred_element_type=acc_dt)
+        o = o.reshape(nq, nG, D)
+        o_ref[0, 0, 0] = (o / jnp.maximum(l, 1e-30)[:, :, None]).astype(
+            o_ref.dtype)
+        l_ref[0, 0, 0] = jnp.where(
+            l > 0, m + jnp.log(jnp.maximum(l, 1e-30)), NEG_INF)
+
+    @pl.when(jnp.logical_not(run))
+    def _():
+        o_ref[0, 0, 0] = jnp.zeros_like(o_ref[0, 0, 0])
+        l_ref[0, 0, 0] = jnp.full_like(l_ref[0, 0, 0], NEG_INF)
+
+
+def flash_decode_attention_spec_paged(q, kp, vp, block_tables, visible,
+                                      scale, window: int = 0):
+    """Block-table-aware split-K flash-decode over Q query positions per
+    slot (speculative verification): same contract as
+    `decode_attention_dense_spec_paged`, same grid as the single-query paged
+    kernel — one cell per (slot, kv head, logical block), block table
+    scalar-prefetched into the k/v index_maps — with the query tile widened
+    to (Q, G, D) so all draft positions are scored in ONE dispatch at
+    unchanged k/v bytes moved (the whole point: decode is HBM-bound on the
+    cache stream, so Q-for-1 amortizes the stream). Falls back to the dense
+    spec oracle when block_size < 8 — value-identical either way."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+    S, Q, H, D = q.shape
+    bs, Hk = kp.shape[1], kp.shape[2]
+    bps = block_tables.shape[1]
+    if H % Hk != 0:
+        raise ValueError(f"n_heads {H} % n_kv_heads {Hk} != 0")
+    if bs < 8:
+        return decode_attention_dense_spec_paged(q, kp, vp, block_tables,
+                                                 visible, scale, window)
+    G = H // Hk
+    L = bps * bs
+    acc_dt = jnp.promote_types(q.dtype, jnp.float32)
+    q5 = q.reshape(S, Q, Hk, G, D).transpose(0, 2, 1, 3, 4)  # (S,Hk,Q,G,D)
+    visible = jnp.asarray(visible, jnp.int32)
+    # per-(query, position) visibility over the logical length axis: query i
+    # sits at position visible - 1 + i, so it sees j < visible + i and (with
+    # a sliding window) j within window of its own position
+    j = jnp.arange(L)[None, None, :]                 # (1, 1, L)
+    i = jnp.arange(Q)[None, :, None]                 # (1, Q, 1)
+    vis3 = visible[:, None, None]                    # (S, 1, 1)
+    valid = j < vis3 + i
+    if window:
+        valid = valid & (vis3 + i - 1 - j < window)
+    valid = valid.astype(jnp.int32)                  # (S, Q, L)
+    vis2 = visible[:, None]                          # (S, 1) SMEM scalar feed
+
+    def kern(bt_ref, *refs):
+        _spec_decode_kernel(*refs, nq=Q, bkv=bs, window=window,
+                            scale=float(scale), acc_dt=acc_dt)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(S, Hk, bps),
+        in_specs=[
+            pl.BlockSpec((1, 1, Q, G, D),
+                         lambda s, h, j, bt_ref: (s, h, 0, 0, 0)),
+            pl.BlockSpec((1, bs, 1, D),
+                         lambda s, h, j, bt_ref: (bt_ref[s, j], 0, h, 0)),
+            pl.BlockSpec((1, bs, 1, D),
+                         lambda s, h, j, bt_ref: (bt_ref[s, j], 0, h, 0)),
+            pl.BlockSpec((1, Q, bs), lambda s, h, j, bt_ref: (s, 0, j)),
+            pl.BlockSpec((1, 1), lambda s, h, j, bt_ref: (s, 0),
+                         memory_space=pltpu.SMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((1, 1, 1, Q, G, D),
+                         lambda s, h, j, bt_ref: (s, h, j, 0, 0, 0)),
+            pl.BlockSpec((1, 1, 1, Q, G),
+                         lambda s, h, j, bt_ref: (s, h, j, 0, 0)),
+        ),
+    )
+    o_p, l_p = pl.pallas_call(
+        kern,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((S, Hk, bps, Q, G, D), acc_dt),
+            jax.ShapeDtypeStruct((S, Hk, bps, Q, G), acc_dt),
+        ),
+        interpret=_interpret(),
+    )(jnp.asarray(block_tables, jnp.int32), q5, kp, vp, valid, vis2)
+
+    # same logaddexp merge, with the extra Q axis riding along
+    m = jnp.max(l_p, axis=2, keepdims=True)          # (S, Hk, 1, Q, G)
+    w = jnp.exp(l_p - jnp.maximum(m, NEG_INF))       # (S, Hk, bps, Q, G)
+    denom = jnp.maximum(jnp.sum(w, axis=2), 1e-30)   # (S, Hk, Q, G)
+    out = jnp.einsum("shkqg,shkqgd->shqgd", w, o_p) / denom[..., None]
+    out = out.transpose(0, 2, 1, 3, 4).reshape(S, Q, H, D)
+    return out.astype(q.dtype)
+
+
+register_helper("decode_attention_spec_paged",
+                default_on=True)(flash_decode_attention_spec_paged)
+
+
+def paged_spec_decode_specs(tensor_axis: str = "tensor"):
+    """shard_map partition specs for the SPECULATIVE paged attention call:
+    `(in_specs, out_specs)` for `(q, kp, vp, block_tables, visible)` -> out
+    with q/out shaped (S, Q, H, D). Identical head-locality argument to
+    `paged_decode_specs` — the Q axis is per-slot and replicates with S, so
+    the multi-query kernel stays collective-free under TP: every softmax
+    reduction runs over L within one head shard."""
+    from jax.sharding import PartitionSpec as P
+    heads_q = P(None, None, tensor_axis, None)      # q/out: (S, Q, H, D)
+    heads_kv = P(None, None, tensor_axis, None)     # kp/vp: (nb+1, bs, Hk, D)
+    in_specs = (heads_q, heads_kv, heads_kv, P(None, None), P(None))
+    return in_specs, heads_q
+
+
 def paged_decode_specs(tensor_axis: str = "tensor"):
     """shard_map partition specs for the paged decode attention call
     (ISSUE 10): `(in_specs, out_specs)` for the array operands

@@ -7,6 +7,7 @@ from deeplearning4j_tpu.serving.block_table import (BlockAllocator,
                                                     PrefixRegistry)
 from deeplearning4j_tpu.serving.decode import (StackDecoder, decode_attention,
                                                decode_attention_paged,
+                                               decode_attention_spec_paged,
                                                one_hot_embedder)
 from deeplearning4j_tpu.serving.engine import (GenerationResult, Request,
                                                ServingEngine)
@@ -15,26 +16,34 @@ from deeplearning4j_tpu.serving.loadgen import (LoadResult, LoadSpec,
                                                 RequestOutcome,
                                                 ScheduledRequest,
                                                 build_schedule, run_spec)
-from deeplearning4j_tpu.serving.sampler import Sampler, sample_tokens
+from deeplearning4j_tpu.serving.sampler import (Sampler, sample_tokens,
+                                                spec_accept_tokens)
 from deeplearning4j_tpu.serving.sharding import (ShardedServingEngine,
                                                  ShardedServingGroup,
                                                  build_serving_mesh,
                                                  cache_partition_specs,
                                                  head_sharded_paged_attention,
+                                                 head_sharded_spec_attention,
                                                  make_shard_and_gather_fns,
                                                  match_partition_rules,
                                                  resolve_replicas, resolve_tp,
                                                  serving_partition_rules)
+from deeplearning4j_tpu.serving.spec import (NgramDraftIndex,
+                                             resolve_spec_decode,
+                                             resolve_spec_draft)
 
 __all__ = [
     "KVCache", "init_cache_state", "BlockAllocator", "PrefixRegistry",
     "StackDecoder", "decode_attention", "decode_attention_paged",
+    "decode_attention_spec_paged",
     "one_hot_embedder", "ServingEngine", "Request", "GenerationResult",
-    "Sampler", "sample_tokens",
+    "Sampler", "sample_tokens", "spec_accept_tokens",
+    "NgramDraftIndex", "resolve_spec_decode", "resolve_spec_draft",
     "LoadSpec", "LoadResult", "RequestOutcome", "ScheduledRequest",
     "build_schedule", "run_spec",
     "ShardedServingEngine", "ShardedServingGroup", "build_serving_mesh",
     "cache_partition_specs", "head_sharded_paged_attention",
+    "head_sharded_spec_attention",
     "make_shard_and_gather_fns", "match_partition_rules",
     "resolve_replicas", "resolve_tp", "serving_partition_rules",
 ]

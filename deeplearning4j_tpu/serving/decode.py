@@ -65,7 +65,8 @@ from deeplearning4j_tpu.nn.conf.layers.feedforward import (
     ActivationLayer, DropoutLayer, LossLayer)
 from deeplearning4j_tpu.nn.conf.layers.recurrent import RnnOutputLayer
 from deeplearning4j_tpu.ops.decode_attention import (
-    decode_attention_dense, decode_attention_dense_paged)
+    decode_attention_dense, decode_attention_dense_paged,
+    decode_attention_dense_spec_paged)
 from deeplearning4j_tpu.ops.helpers import helper_for
 from deeplearning4j_tpu.serving import kv_cache
 
@@ -105,6 +106,20 @@ def decode_attention_paged(q, kp, vp, block_tables, visible, scale,
     TPU — the gather stays INSIDE the kernel via scalar prefetch) when
     enabled, else the dense paged oracle (gather + the dense einsum)."""
     fn = helper_for("decode_attention_paged", decode_attention_dense_paged)
+    return fn(q, kp, vp, block_tables, visible, scale, window)
+
+
+def decode_attention_spec_paged(q, kp, vp, block_tables, visible, scale,
+                                window: int = 0):
+    """Multi-query (speculative verification) attention against the PAGED
+    cache: q (S, Q, H, D) — query i of slot s sits at logical position
+    visible[s] - 1 + i and sees j < visible + i. Resolved through the
+    helper seam: the multi-query split-K kernel
+    (ops/decode_attention.flash_decode_attention_spec_paged, default-on for
+    TPU) when enabled, else the dense spec paged oracle, whose per-position
+    math is bit-identical to the single-query dense path."""
+    fn = helper_for("decode_attention_spec_paged",
+                    decode_attention_dense_spec_paged)
     return fn(q, kp, vp, block_tables, visible, scale, window)
 
 
@@ -158,7 +173,8 @@ class StackDecoder:
                  dtype=None, block_size: Optional[int] = None,
                  num_blocks: Optional[int] = None,
                  prefix_share: Optional[bool] = None,
-                 prefix_registry=None, paged_attention=None):
+                 prefix_registry=None, paged_attention=None,
+                 paged_spec_attention=None):
         layers, params = _extract_stack(net)
         self.layers = layers
         self.dtype = jnp.dtype(dtype) if dtype is not None else net.dtype
@@ -202,6 +218,9 @@ class StackDecoder:
         # decode_attention_paged; the default is the single-mesh helper.
         self._paged_attention = (paged_attention if paged_attention
                                  is not None else decode_attention_paged)
+        self._paged_spec_attention = (
+            paged_spec_attention if paged_spec_attention is not None
+            else decode_attention_spec_paged)
         self._prefill_jit = jax.jit(self._prefill_fn)
         self._prefill_shared_jit = jax.jit(self._prefill_shared_fn,
                                            static_argnames=("kv_blocks",))
@@ -354,6 +373,48 @@ class StackDecoder:
                 h = self._positionwise(layer, p, h)
         cache_state = kv_cache.advance_lengths(cache_state, active)
         return cache_state, self._head_logprobs(h)
+
+    def _spec_decode_fn(self, params, cache_state, x, active, draft_len):
+        """One SPECULATIVE decode iteration (ISSUE 11) for all slots:
+        x (S, Q, n_in) features of [last committed token, draft 0, ...,
+        draft Q-2], active (S,) bool, draft_len (S,) int32 in [0, Q-1].
+        Row i's k/v land at logical position lengths + i (trash-routed for
+        inactive slots and rows past the slot's draft length — a short
+        draft's padding can never dirty live blocks), and all Q queries are
+        verified against the paged cache in ONE multi-query attention
+        dispatch per layer. Returns (new_cache_state, (S, Q, vocab)
+        logprobs); row i is the target distribution for the token AFTER
+        position lengths + i - 1. Does NOT move `lengths` — the engine
+        commits the accepted count afterwards (set-length semantics), which
+        is the whole rollback story: rejected rows simply stay invisible.
+        draft_len == 0 everywhere degenerates to `_decode_fn` semantics
+        with Q - 1 dead verify lanes."""
+        S, Q = x.shape[0], x.shape[1]
+        h = x.astype(self.dtype)                            # (S, Q, n_in)
+        pos = cache_state["lengths"]                        # pre-commit
+        i = jnp.arange(Q, dtype=jnp.int32)[None, :]
+        positions = pos[:, None] + i                        # (S, Q)
+        valid = active[:, None] & (i <= draft_len[:, None])
+        li = 0
+        for idx, layer in enumerate(self.layers[:-1]):
+            p = params[idx]
+            if isinstance(layer, SelfAttentionLayer):
+                q, k_t, v_t = _attn_heads(layer, p, h)      # (S, Q, ., Dh)
+                cache_state = kv_cache.append_tokens(
+                    cache_state, li, k_t, v_t, positions, valid)
+                out = self._paged_spec_attention(
+                    q, cache_state["k"][li], cache_state["v"][li],
+                    cache_state["block_tables"],
+                    pos + 1, 1.0 / np.sqrt(self.head_dim),
+                    layer.attention_window)
+                li += 1
+                out = out.reshape(S, Q, layer.n_out)
+                h = layer._act(out @ p["w_o"] + p["b"])
+            else:
+                h = self._positionwise(
+                    layer, p, h.reshape(S * Q, -1)).reshape(S, Q, -1)
+        lp = self._head_logprobs(h.reshape(S * Q, -1))
+        return cache_state, lp.reshape(S, Q, -1)
 
     # ------------------------------------------------------- stateful API
     def prefill(self, slot: int, x) -> jnp.ndarray:

@@ -68,6 +68,21 @@ Hot-loop design (why this never retraces and rarely syncs):
   greedy decoding is token-identical either way (the parity tests), and
   steady-state counted host syncs are bit-identical chunked on or off.
 
+- SPECULATIVE decoding (ISSUE 11, `spec_decode=True` / env
+  DL4J_TPU_SPEC_DECODE=1): draft-model-free prompt-lookup speculation.
+  A host-side per-slot n-gram index (serving/spec.py) proposes up to
+  `spec_draft` continuation tokens from the request's own prompt +
+  generated history; ONE widened decode dispatch verifies all of them
+  (multi-query paged flash attention), and the accepted prefix commits via
+  a single lengths move — rejected KV stays invisible under the
+  lengths-visibility invariant (block-granular rollback, copy-on-reject
+  for COW-shared tail blocks). Greedy spec output is token-identical to
+  plain decode (the point-mass accept rule samples each row from the
+  TARGET distribution), still one counted sync per iteration, and 1..K+1
+  tokens committed per sync. Spec replaces chunking and forces
+  synchronous stepping (the draft index needs the committed token values
+  the readback already carries).
+
 Per-request controls: max_new_tokens, temperature (0 = greedy), eos_id,
 timeout_s (wall-clock, checked between iterations). Results carry cheap
 host-timestamp stats (ttft_s, tokens_per_sec) and are delivered through the
@@ -93,8 +108,10 @@ import numpy as np
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.telemetry import memory as _tmemory
 from deeplearning4j_tpu.telemetry import profiler as _profiler
+from deeplearning4j_tpu.serving import spec as spec_mod
 from deeplearning4j_tpu.serving.decode import StackDecoder, one_hot_embedder
-from deeplearning4j_tpu.serving.sampler import Sampler, sample_tokens
+from deeplearning4j_tpu.serving.sampler import (Sampler, sample_tokens,
+                                                spec_accept_tokens)
 
 # per-iteration prefill token budget (chunked prefill, ISSUE 9); env
 # DL4J_TPU_PREFILL_CHUNK overrides, 0 disables chunking entirely
@@ -263,6 +280,72 @@ def _build_chunk(decoder: StackDecoder, embed: Callable, top_k: int,
     return chunk
 
 
+def _build_spec_step(decoder: StackDecoder, embed: Callable, top_k: int,
+                     cap: int):
+    """One SPECULATIVE decode iteration (ISSUE 11) as a single dispatch:
+    verify [last, draft_0..draft_{Q-2}] at Q consecutive positions per slot
+    (multi-query paged attention, StackDecoder._spec_decode_fn), accept via
+    the point-mass rejection-sampling collapse (sampler.spec_accept_tokens
+    — committed tokens are bit-identical to plain K=1 stepping on the same
+    key chain), then COMMIT exactly the accepted prefix. The lengths update
+    below is the WHOLE rollback story: rejected rows' KV sits at positions
+    >= the new `lengths` and is invisible forever under the
+    lengths-visibility invariant (the next iteration simply overwrites
+    those offsets). `keys` is (Q, ...) PEEKED chain subkeys (key i = chain
+    position i); `draft` (S, Q-1) proposed token ids; `draft_len` (S,) how
+    many leading draft rows are real per slot — 0 degrades the slot to a
+    plain decode step with Q-1 dead verify lanes, committing exactly one
+    token."""
+
+    def spec_step(params, cache_state, hist, last, plens, eos, maxgen,
+                  active, keys, temps, draft, draft_len):
+        S, Dm = draft.shape
+        Q = Dm + 1
+        toks_in = jnp.concatenate([last[:, None], draft], axis=1)  # (S, Q)
+        x = jax.vmap(embed, in_axes=1, out_axes=1)(toks_in)  # (S, Q, n_in)
+        pos = cache_state["lengths"]                         # pre-commit
+        cache_state, lp = decoder._spec_decode_fn(params, cache_state, x,
+                                                  active, draft_len)
+        toks, n_accept, n_commit = spec_accept_tokens(
+            keys, lp, draft, draft_len, temps, top_k)        # (S,Q),(S,),(S,)
+        i = jnp.arange(Q, dtype=jnp.int32)[None, :]
+        gen0 = pos - plens + 1      # generation index of the row-0 token
+        # EOS inside the accepted prefix truncates the commit to include it
+        com = i < n_commit[:, None]
+        eos_hit = com & (toks == eos[:, None])
+        has_eos = jnp.any(eos_hit, axis=1)
+        first_eos = jnp.argmax(eos_hit, axis=1).astype(jnp.int32)
+        c_eff = jnp.where(has_eos, first_eos + 1, n_commit)
+        # never commit past max_new_tokens (the host caps draft_len to the
+        # remaining budget, so this is a backstop), and inactive slots
+        # commit nothing at all
+        c_eff = jnp.minimum(c_eff, jnp.maximum(maxgen - gen0, 1))
+        c_eff = jnp.where(active, c_eff, 0).astype(jnp.int32)
+        # the ONLY lengths move — spec rollback is this set-length commit
+        cache_state = {**cache_state,
+                       "lengths": (pos + c_eff).astype(jnp.int32)}
+        # history: committed offset j lands at column gen0 + j (mask-based
+        # update, not a scatter — dead lanes can't clobber a kept column)
+        col = jnp.arange(hist.shape[1], dtype=jnp.int32)[None, :]
+        j = col - gen0[:, None]                              # (S, cap)
+        sel = active[:, None] & (j >= 0) & (j < c_eff[:, None])
+        vals = jnp.take_along_axis(toks, jnp.clip(j, 0, Q - 1), axis=1)
+        hist = jnp.where(sel, vals, hist)
+        last_c = jnp.take_along_axis(
+            toks, jnp.clip(c_eff - 1, 0, Q - 1)[:, None], axis=1)[:, 0]
+        last = jnp.where(active, last_c, last)
+        new_active = active & (last_c != eos) & (gen0 + c_eff < maxgen)
+        # nonfinite-logits sentinel (ISSUE 5): only rows that fed the
+        # accept/commit decision count (lanes past draft_len are dead)
+        row_ok = i <= draft_len[:, None]
+        nf = jnp.any(active[:, None] & row_ok
+                     & jnp.any(~jnp.isfinite(lp), axis=-1))
+        return (cache_state, hist, last, new_active, toks, c_eff,
+                n_accept, lp, nf)
+
+    return spec_step
+
+
 class ServingEngine:
     """Continuous-batching generation over a StackDecoder.
 
@@ -298,7 +381,9 @@ class ServingEngine:
                  prefix_share: Optional[bool] = None,
                  flight_recorder=None,
                  prefix_registry=None,
-                 metrics_parent=None):
+                 metrics_parent=None,
+                 spec_decode: Optional[bool] = None,
+                 spec_draft: Optional[int] = None):
         self.decoder = self._build_decoder(net, max_seqs, max_len,
                                            dtype=dtype,
                                            block_size=kv_block,
@@ -340,6 +425,21 @@ class ServingEngine:
         self._chunk_jit = self._jit_decode(
             _build_chunk(self.decoder, embed, self.sampler.top_k, self._cap),
             "chunk")
+        # speculative decoding (ISSUE 11): draft-free n-gram drafts verified
+        # in one widened decode dispatch. Spec mode replaces chunking (a
+        # spec step IS one scheduling opportunity committing 1..Q tokens)
+        # and forces synchronous stepping — the accept decision needs the
+        # committed token VALUES host-side anyway (they feed the draft
+        # index), riding the per-iteration readback at zero extra syncs.
+        self.spec_decode = spec_mod.resolve_spec_decode(spec_decode)
+        self.spec_draft = spec_mod.resolve_spec_draft(spec_draft)
+        self._spec_index = (spec_mod.NgramDraftIndex()
+                            if self.spec_decode else None)
+        if self.spec_decode:
+            self._spec_jit = self._jit_decode(
+                _build_spec_step(self.decoder, embed, self.sampler.top_k,
+                                 self._cap),
+                "spec")
         # device-side per-slot state (fixed shapes, threaded through the jit)
         self._hist = jnp.zeros((S, self._cap), jnp.int32)
         self._last = jnp.zeros((S,), jnp.int32)
@@ -428,6 +528,20 @@ class ServingEngine:
             "serving.decode_stall_ms", "prefill wall (whole prompt, or one "
             "chunk under chunked prefill) spent while decode-active slots "
             "sat waiting — the stall chunking bounds")
+        self._c_spec_acc = self.metrics.counter(
+            "serving.spec_tokens_accepted", "draft tokens accepted by "
+            "speculative verification (ISSUE 11)")
+        self._c_spec_rej = self.metrics.counter(
+            "serving.spec_tokens_rejected", "draft tokens rejected by "
+            "speculative verification")
+        self._h_spec_accept = self.metrics.histogram(
+            "serving.spec_accept_rate", "per-slot accepted/drafted ratio "
+            "per spec step (steps that proposed at least one draft)",
+            buckets=(0.01, 0.125, 0.25, 0.5, 0.75, 0.9, 1.0))
+        self._h_spec_draft = self.metrics.histogram(
+            "serving.spec_draft_len", "draft tokens proposed per slot per "
+            "spec step (zero-draft slots run as plain decode rows)",
+            buckets=(1, 2, 4, 8, 16))
         self._g_queue = self.metrics.gauge(
             "serving.queue_depth", "requests waiting for a slot")
         self._g_occ = self.metrics.gauge(
@@ -529,7 +643,13 @@ class ServingEngine:
                     "prefix_hits": self._c_prefix_hits.value,
                     "prefix_shared_tokens": self._c_prefix_tokens.value,
                     "admission_retries": self._c_adm_retries.value,
-                    "resident_seqs_max": self._resident_seqs_max}
+                    "resident_seqs_max": self._resident_seqs_max,
+                    "spec_decode": int(self.spec_decode),
+                    "spec_draft": self.spec_draft,
+                    "spec_tokens_accepted": self._c_spec_acc.value,
+                    "spec_tokens_rejected": self._c_spec_rej.value,
+                    "spec_accept_rate": self._c_spec_acc.value / max(
+                        1, self._c_spec_acc.value + self._c_spec_rej.value)}
 
     def export_trace(self, path: str) -> str:
         """Write the global tracer's Chrome-trace JSON (prefill / decode
@@ -720,6 +840,11 @@ class ServingEngine:
             first = int(t0)        # admission readback (scheduling event)
         self._c_syncs.inc()
         self._c_tokens.inc()
+        if self._spec_index is not None:
+            # seed the draft index: prompt + the first token are both
+            # host-visible right here — no added device reads
+            self._spec_index.reset(slot, req.tokens)
+            self._spec_index.extend(slot, [first])
         act.t_first = time.monotonic()
         act.timeline.append({"phase": "prefill", "t0": t_pf_mono,
                              "t1": act.t_first, **extras})
@@ -809,6 +934,8 @@ class ServingEngine:
         act = self._by_slot.pop(slot)
         if act in self._prefilling:    # timeout/shutdown mid-prefill
             self._prefilling.remove(act)
+        if self._spec_index is not None:
+            self._spec_index.drop(slot)
         t_ret0 = time.monotonic()
         n = act.n_generated
         src = self._hist if hist is None else hist
@@ -990,6 +1117,8 @@ class ServingEngine:
             snapshot = {s: a for s, a in self._by_slot.items()
                         if self._active_mask[s]}
             active = jnp.asarray(self._active_mask)
+            if self.spec_decode:
+                return self._spec_step(snapshot, active, t_iter0)
             k_eff = self._chunk_size()
             t_chunk = time.perf_counter()
             self._h_chunk_k.observe(k_eff)
@@ -1047,6 +1176,114 @@ class ServingEngine:
             self._finish_steps(snapshot, entry_np, new_np, lp_np,
                                span=(t_iter0, k_eff))
             return bool(self._by_slot or self._queue)
+
+    def _spec_step(self, snapshot: Dict[int, _Active], active,
+                   t_iter0: float) -> bool:
+        """One SPECULATIVE scheduler iteration (ISSUE 11), replacing the
+        chunked decode dispatch: propose per-slot n-gram drafts host-side
+        (zero device reads — the index only ever sees tokens the scheduler
+        already read back), verify all of them plus the mandatory bonus
+        token in ONE widened decode dispatch, and commit the accepted
+        prefix. Still exactly ONE counted host sync per iteration — the
+        committed-token readback replaces the chunk-mask readback, so spec
+        with zero n-gram matches is sync-for-sync identical to K=1
+        stepping while every accepted draft amortizes further. Lock
+        held."""
+        cache = self.decoder.cache
+        S = cache.max_seqs
+        drafts: Dict[int, List[int]] = {}
+        d_max = 0
+        for s, a in snapshot.items():
+            rem = a.req.max_new_tokens - a.n_generated
+            cap_s = min(self.spec_draft, rem - 1)
+            prop = self._spec_index.propose(s, cap_s) if cap_s > 0 else []
+            drafts[s] = prop
+            d_max = max(d_max, len(prop))
+        # bucket the draft width to a power of two (bounded compile-key
+        # set, like prefill buckets / chunk scan lengths): Q in {2,3,5,9}
+        d_bucket = 1
+        while d_bucket < d_max:
+            d_bucket *= 2
+        q_eff = d_bucket + 1
+        draft_np = np.zeros((S, d_bucket), np.int32)
+        dl_np = np.zeros((S,), np.int32)
+        for s, prop in drafts.items():
+            draft_np[s, :len(prop)] = prop
+            dl_np[s] = len(prop)
+            if prop:
+                # copy-on-reject guard: the verify rows [pos, pos+d] must
+                # not land in COW-shared blocks (possible when a shared
+                # prefix ends past the prompt). Host-side refcount check,
+                # block copies only in the rare shared-tail case.
+                act = snapshot[s]
+                pos = act.prefilled + act.n_generated - 1
+                cache.ensure_writable(s, pos, pos + len(prop) + 1)
+        t_chunk = time.perf_counter()
+        self._h_chunk_k.observe(q_eff)
+        self._g_queue.set(len(self._queue))
+        self._g_occ.set(len(self._by_slot))
+        miss = ("spec", q_eff) not in self._seen_shapes
+        if miss:
+            self._seen_shapes.add(("spec", q_eff))
+            self._c_compiles.inc()
+        cm = telemetry.span("jit_compile", kind="spec",
+                            q=q_eff) if miss else telemetry.NULL_SPAN
+        keys = self.sampler.peek_keys(q_eff)
+        with cm, telemetry.span("spec_step", q=q_eff,
+                                active=int(self._active_mask.sum())):
+            (self.decoder.cache.state, self._hist, self._last, new_active,
+             toks, c_eff, n_accept, lps, nf) = self._spec_jit(
+                self.decoder.params, self.decoder.cache.state, self._hist,
+                self._last, self._plens, self._eos, self._maxgen, active,
+                keys, jnp.asarray(self._temps), jnp.asarray(draft_np),
+                jnp.asarray(dl_np))
+        with telemetry.span("host_sync", what="spec_commit", q=q_eff):
+            # sync-ok: the counted per-iteration sync — one dispatch's
+            # outputs; token VALUES ride along to feed the draft index
+            toks_np = np.asarray(toks)        # sync-ok: the counted sync
+            c_np = np.asarray(c_eff)          # sync-ok: same dispatch
+            acc_np = np.asarray(n_accept)     # sync-ok: same dispatch
+            new_np = np.asarray(new_active)   # sync-ok: same dispatch
+            if bool(nf):
+                self._c_nonfinite.inc()
+        self._c_syncs.inc()
+        # chain keys consumed = deepest commit across slots (chunk
+        # semantics: shared per-offset keys, effective-depth advance)
+        self.sampler.advance(int(c_np.max()))
+        chunk_ms = (time.perf_counter() - t_chunk) * 1e3
+        self._h_chunk_ms.observe(chunk_ms)
+        if _profiler.enabled():
+            _profiler.observe(f"spec_step_q{q_eff}", chunk_ms,
+                              registry=self.metrics)
+        # sync-ok: capture_logprobs mode only
+        lp_np = np.asarray(lps) if self.capture_logprobs else None
+        for slot, act in snapshot.items():
+            if self._by_slot.get(slot) is not act \
+                    or not self._active_mask[slot]:
+                continue
+            n_new = int(c_np[slot])
+            d_s = int(dl_np[slot])
+            acc = int(acc_np[slot])
+            act.n_generated += n_new
+            self._c_tokens.inc(n_new)
+            self._spec_index.extend(slot, toks_np[slot, :n_new])
+            if d_s > 0:
+                self._c_spec_acc.inc(acc)
+                self._c_spec_rej.inc(d_s - acc)
+                self._h_spec_accept.observe(acc / d_s)
+                self._h_spec_draft.observe(d_s)
+            # tiles from iteration start like "decode_chunk" — resident
+            # requests keep gap-free timeline coverage under spec
+            act.timeline.append({"phase": "spec_step", "t0": t_iter0,
+                                 "t1": time.monotonic(), "draft": d_s,
+                                 "accepted": acc, "tokens": n_new})
+            if lp_np is not None and act.logprobs is not None:
+                act.logprobs.extend(lp_np[slot, j] for j in range(n_new))
+            if not new_np[slot]:
+                self._active_mask[slot] = False
+                self._retire(slot, "length")
+        self._update_kv_resident()
+        return bool(self._by_slot or self._queue)
 
     # ------------------------------------------------- overlapped pipeline
     def _drain_overlapped(self) -> None:
@@ -1152,7 +1389,7 @@ class ServingEngine:
         overlapped pipeline when enabled (and token-level logprob capture is
         off — capture needs the synchronous per-chunk readback)."""
         if self.overlap and self.decode_chunk > 1 \
-                and not self.capture_logprobs:
+                and not self.capture_logprobs and not self.spec_decode:
             self._drain_overlapped()
         else:
             while self.step():

@@ -210,6 +210,37 @@ def append_token(state: Dict[str, jnp.ndarray], layer: int,
                 v_t.astype(state["v"].dtype))}
 
 
+def append_tokens(state: Dict[str, jnp.ndarray], layer: int,
+                  k_t: jnp.ndarray, v_t: jnp.ndarray,
+                  positions: jnp.ndarray, valid: jnp.ndarray
+                  ) -> Dict[str, jnp.ndarray]:
+    """Batched MULTI-position append for all slots (speculative decode,
+    ISSUE 11): k_t/v_t (S, Q, Hk, D) land at logical `positions` (S, Q) of
+    each slot, gathered through its block table. Rows with valid=False
+    (inactive slots, and query rows beyond a slot's draft length) route to
+    the trash block — same load-bearing mask as `append_token`, extended
+    per query row so a short draft's padding writes can never land in live
+    blocks. Valid rows of one slot are distinct consecutive positions and
+    slots own disjoint blocks, so no two valid rows alias one
+    (block, offset) pair; invalid rows may collide inside trash, where the
+    unspecified scatter winner is harmless by construction. Does NOT move
+    `lengths` — rollback after verification is pure `set_length` (rejected
+    positions stay invisible forever under the visibility invariant)."""
+    bs, bps, trash = _dims(state)
+    S, Q = positions.shape
+    bidx = jnp.clip(positions // bs, 0, bps - 1)              # (S, Q)
+    phys = jnp.take_along_axis(state["block_tables"], bidx, axis=1)
+    phys = jnp.where(valid, phys, trash).reshape(S * Q)
+    off = (positions % bs).reshape(S * Q)
+    kf = k_t.reshape((S * Q,) + k_t.shape[2:])
+    vf = v_t.reshape((S * Q,) + v_t.shape[2:])
+    return {**state,
+            "k": state["k"].at[layer, phys, off].set(
+                kf.astype(state["k"].dtype)),
+            "v": state["v"].at[layer, phys, off].set(
+                vf.astype(state["v"].dtype))}
+
+
 def advance_lengths(state: Dict[str, jnp.ndarray], active: jnp.ndarray
                     ) -> Dict[str, jnp.ndarray]:
     """lengths += 1 on active slots only (inactive slots' appends were
@@ -370,6 +401,52 @@ class KVCache:
                              shared_len=shared_len,
                              n_shared_blocks=len(shared_blocks),
                              cow=cow_src is not None)
+
+    def ensure_writable(self, slot: int, start: int, end: int) -> int:
+        """Copy-on-reject guard (ISSUE 11): make every block of `slot`
+        covering logical positions [start, end) PRIVATE before a
+        speculative write lands there. A block with refcount >= 2 is mapped
+        by other slots too (a COW-shared prefix); writing draft KV into it
+        — even KV that later gets rolled back by `set_length` — would
+        corrupt the donors, because rollback makes rejected positions
+        INVISIBLE, not unwritten. Each such block is replaced by a fresh
+        copy in the slot's table (device `copy_block`, one op per block,
+        no readback) and the shared original is decref'd, never mutated.
+
+        Under the engine's admission semantics a slot's write range starts
+        at its own prompt tail, past every shared block, so this guard is
+        expected to copy nothing — it exists to make the invariant
+        STRUCTURAL rather than an accident of current admission behavior,
+        and is stress-tested directly in tests/test_block_table.py.
+        Returns the number of blocks copied. Raises when the pool cannot
+        supply a replacement block (the caller reserved these positions at
+        admission, so this indicates allocator corruption, not load)."""
+        if end <= start:
+            return 0
+        bs = self.block_size
+        row_blocks = self._slot_blocks.get(slot)
+        if row_blocks is None:
+            raise ValueError(f"slot {slot} is not resident")
+        copied = 0
+        for li in range(max(0, start // bs),
+                        min(len(row_blocks), -(-end // bs))):
+            old = row_blocks[li]
+            if self.allocator.refcount(old) < 2:
+                continue
+            fresh = self.allocator.alloc_many(1)
+            if fresh is None:
+                raise RuntimeError(
+                    f"copy-on-reject for slot {slot} block {li}: no free "
+                    "block despite an admission-time reservation")
+            self.state = copy_block(self.state, old, fresh[0])
+            row_blocks[li] = fresh[0]
+            row = np.full((self.blocks_per_seq,), self.trash_block, np.int32)
+            row[:len(row_blocks)] = row_blocks
+            self.state = set_block_table(self.state, slot, row)
+            self.allocator.decref(old)     # refcount >= 2: never frees here
+            self.cow_copies_total += 1
+            copied += 1
+        return copied
 
     def register_prefix(self, slot: int, prompt: Sequence[int]) -> None:
         """File the slot's prompt blocks in the prefix registry (call AFTER

@@ -43,6 +43,44 @@ def sample_tokens(key, logprobs, temperature, top_k: int = 0):
     return jnp.where(temperature > 0, drawn, greedy)
 
 
+def spec_accept_tokens(keys, logprobs, draft, draft_len, temperature,
+                       top_k: int = 0):
+    """Speculative accept/resample (ISSUE 11) for DETERMINISTIC (n-gram)
+    drafts, jittable, batched.
+
+    keys: (Q, ...) stacked PRNG keys — key i is chain position i, exactly
+    what the i-th sequential decode step would consume (Sampler.peek_keys);
+    logprobs: (S, Q, V) verified target rows — row i is conditioned on the
+    last committed token plus drafts 0..i-1; draft: (S, Q-1) proposed
+    tokens; draft_len: (S,) how many leading draft rows are real (0 = plain
+    decode step); temperature/top_k as in `sample_tokens`.
+
+    Standard speculative sampling accepts draft d_i with probability
+    p_i(d_i) and samples the residual on reject. With a POINT-MASS draft
+    distribution both halves collapse into one categorical draw from the
+    target row: t_i = sample(key_i, p_i) accepts (t_i == d_i) with exactly
+    p_i(d_i), and conditioned on mismatch t_i IS the normalized residual.
+    So the commit is simply the sampled tokens up to and including the
+    first mismatch — distribution-exact by the Leviathan et al. argument,
+    and stronger: because every row uses its sequential chain key and, on
+    the accepted prefix, identical conditioning, the committed tokens are
+    BIT-IDENTICAL to plain decode on the same key chain (greedy is the
+    temperature == 0 special case — key-free argmax comparison).
+
+    Returns (tokens (S, Q) — row j is the committed token at generation
+    offset j for j < n_commit, rows past that are dead; n_accept (S,) —
+    drafts accepted; n_commit (S,) = n_accept + 1 — tokens to commit, the
+    amount the caller must Sampler.advance() by for live slots)."""
+    S, Q, V = logprobs.shape
+    toks = jax.vmap(
+        lambda k, lp: sample_tokens(k, lp, temperature, top_k),
+        in_axes=(0, 1), out_axes=1)(keys, logprobs)             # (S, Q)
+    i = jnp.arange(Q - 1)[None, :]
+    ok = (toks[:, :-1] == draft) & (i < draft_len[:, None])     # (S, Q-1)
+    n_accept = jnp.sum(jnp.cumprod(ok.astype(jnp.int32), axis=1), axis=1)
+    return toks, n_accept, n_accept + 1
+
+
 class Sampler:
     """Holds the sampling config and threads the PRNG key across steps.
 

@@ -49,12 +49,14 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu import telemetry
-from deeplearning4j_tpu.ops.decode_attention import paged_decode_specs
+from deeplearning4j_tpu.ops.decode_attention import (paged_decode_specs,
+                                                     paged_spec_decode_specs)
 from deeplearning4j_tpu.parallel.mesh import (compat_shard_map, make_mesh,
                                               replica_submeshes)
 from deeplearning4j_tpu.serving.block_table import PrefixRegistry
 from deeplearning4j_tpu.serving.decode import (StackDecoder,
-                                               decode_attention_paged)
+                                               decode_attention_paged,
+                                               decode_attention_spec_paged)
 from deeplearning4j_tpu.serving.engine import Request, ServingEngine
 from deeplearning4j_tpu.serving.kv_cache import resolve_block_size
 
@@ -62,8 +64,8 @@ __all__ = [
     "match_partition_rules", "make_shard_and_gather_fns", "named_tree_map",
     "serving_partition_rules", "cache_partition_specs",
     "resolve_tp", "resolve_replicas", "build_serving_mesh",
-    "head_sharded_paged_attention", "ShardedServingEngine",
-    "ShardedServingGroup",
+    "head_sharded_paged_attention", "head_sharded_spec_attention",
+    "ShardedServingEngine", "ShardedServingGroup",
 ]
 
 
@@ -215,6 +217,24 @@ def head_sharded_paged_attention(mesh: Mesh, tensor_axis: str = "tensor"):
     return attention
 
 
+def head_sharded_spec_attention(mesh: Mesh, tensor_axis: str = "tensor"):
+    """Head-sharded multi-query VERIFY attention for speculative decoding
+    (ISSUE 11): the widened query tile (S, Q, H, D) splits on the head
+    axis exactly like single-query decode, so the spec kernel runs
+    head-local under shard_map with ZERO new collectives — verification
+    costs the same communication as one plain decode step."""
+    in_specs, out_spec = paged_spec_decode_specs(tensor_axis)
+
+    def attention(q, kp, vp, block_tables, visible, scale, window: int = 0):
+        def local(qs, kps, vps, bt, vis):
+            return decode_attention_spec_paged(qs, kps, vps, bt, vis, scale,
+                                               window)
+        sharded = compat_shard_map(local, mesh, in_specs, out_spec)
+        return sharded(q, kp, vp, block_tables, visible)
+
+    return attention
+
+
 # ------------------------------------------------------ tensor-parallel TP
 class ShardedServingEngine(ServingEngine):
     """A ServingEngine whose decoder params and paged KV pool live
@@ -268,6 +288,8 @@ class ShardedServingEngine(ServingEngine):
             net, max_seqs, max_len,
             paged_attention=head_sharded_paged_attention(self.mesh,
                                                          self.tensor_axis),
+            paged_spec_attention=head_sharded_spec_attention(
+                self.mesh, self.tensor_axis),
             **kw)
         tp = self.tp
         if dec.n_kv_heads % tp:
@@ -334,8 +356,12 @@ class ShardedServingEngine(ServingEngine):
         head-sharded placement across dispatches (no resharding between
         iterations), every scheduler array replicated."""
         rep = NamedSharding(self.mesh, P())
-        n_out = 6 if kind == "step" else 7
-        in_s = (self._param_shardings, self._cache_shardings) + (rep,) * 8
+        # spec (ISSUE 11) takes two extra replicated inputs (draft ids +
+        # per-slot draft lengths) and returns the commit bundle
+        n_out = {"step": 6, "chunk": 7, "spec": 9}[kind]
+        n_in = 10 if kind == "spec" else 8
+        in_s = (self._param_shardings, self._cache_shardings) + \
+            (rep,) * n_in
         out_s = (self._cache_shardings,) + (rep,) * (n_out - 1)
         return jax.jit(fn, in_shardings=in_s, out_shardings=out_s)
 

@@ -1,0 +1,131 @@
+"""Draft-model-free speculative drafting: per-slot n-gram suffix lookup.
+
+ISSUE 11 (prompt lookup / n-gram drafting, Saxena 2023; speculative
+decoding, Leviathan et al. 2023 — see PAPERS.md). Decode is
+memory-bandwidth-bound: every token re-reads the slot's whole resident KV
+(PERF.md cost model), so verifying k drafted tokens in ONE kernel pass
+buys up to ~(k+1)x tokens/step at essentially unchanged bytes moved. The
+cheapest useful draft source needs no model at all: natural-language and
+code generations repeat their own prompt and history (quotes, identifiers,
+boilerplate), so the continuation of the current suffix n-gram's most
+recent earlier occurrence is a strong proposal on repetitive text and a
+harmless one elsewhere (a wrong draft costs only the wasted verify lanes —
+rollback is `set_length`, see serving/kv_cache.py).
+
+`NgramDraftIndex` holds, per slot, the token history (prompt + committed
+tokens — both already host-visible at the scheduling boundary, so drafting
+adds ZERO device syncs) and a bounded map from recent n-grams to their
+occurrence positions. `propose(slot, k)` matches the longest suffix gram
+(n = max_ngram..min_ngram) that recurs earlier WITH a continuation and
+returns up to k continuation tokens. Pure host-side dict/list work, O(1)
+per committed token; the per-gram position list is capped so adversarially
+repetitive histories cannot grow the index superlinearly.
+
+Env knobs (read by the engine):
+- `DL4J_TPU_SPEC_DECODE=1` enables speculative decode (default off);
+- `DL4J_TPU_SPEC_DRAFT`    max draft tokens per step (default 4);
+- `DL4J_TPU_SPEC_NGRAM`    longest suffix gram to match (default 3).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+DEFAULT_DRAFT = 4
+DEFAULT_NGRAM = 3
+
+
+def resolve_spec_decode(spec_decode: Optional[bool] = None) -> bool:
+    """Engine-level enable: explicit argument wins, else the env knob."""
+    if spec_decode is not None:
+        return bool(spec_decode)
+    return os.environ.get("DL4J_TPU_SPEC_DECODE", "0") == "1"
+
+
+def resolve_spec_draft(spec_draft: Optional[int] = None) -> int:
+    """Max draft tokens proposed per spec step (>= 1)."""
+    if spec_draft is None:
+        spec_draft = int(os.environ.get("DL4J_TPU_SPEC_DRAFT",
+                                        str(DEFAULT_DRAFT)))
+    return max(1, int(spec_draft))
+
+
+class NgramDraftIndex:
+    """Per-slot suffix-match index over host-visible token history.
+
+    max_ngram/min_ngram: the suffix gram lengths tried, longest first
+    (longer matches are more specific, so their continuations accept
+    more often). positions_per_gram: retention cap per gram — proposal
+    wants the MOST RECENT occurrence that still has a continuation, so a
+    short most-recent-first list suffices and bounds memory."""
+
+    def __init__(self, max_ngram: Optional[int] = None, min_ngram: int = 1,
+                 positions_per_gram: int = 4):
+        if max_ngram is None:
+            max_ngram = int(os.environ.get("DL4J_TPU_SPEC_NGRAM",
+                                           str(DEFAULT_NGRAM)))
+        self.max_ngram = max(1, int(max_ngram))
+        self.min_ngram = max(1, min(int(min_ngram), self.max_ngram))
+        self.positions_per_gram = max(1, int(positions_per_gram))
+        self._tokens: Dict[int, List[int]] = {}
+        # slot -> gram tuple -> start positions, most recent first
+        self._grams: Dict[int, Dict[Tuple[int, ...], List[int]]] = {}
+
+    # ------------------------------------------------------------ lifecycle
+    def reset(self, slot: int, tokens: Sequence[int]) -> None:
+        """(Re)build the slot's index from its prompt (admission time)."""
+        self._tokens[slot] = []
+        self._grams[slot] = {}
+        self.extend(slot, tokens)
+
+    def drop(self, slot: int) -> None:
+        """Forget a retired slot's history."""
+        self._tokens.pop(slot, None)
+        self._grams.pop(slot, None)
+
+    def extend(self, slot: int, tokens: Sequence[int]) -> None:
+        """Append committed tokens (prompt at reset, then each spec/decode
+        readback), indexing every gram ending at each new position. Token
+        values arrive from the per-iteration scheduler readback the engine
+        already pays for — the index itself never touches the device."""
+        if slot not in self._tokens:
+            self._tokens[slot] = []
+            self._grams[slot] = {}
+        hist = self._tokens[slot]
+        grams = self._grams[slot]
+        for t in tokens:
+            # sync-ok: host-side draft index — `t` is a host int from the
+            # scheduler's existing per-iteration readback, not a device sync
+            hist.append(int(t))
+            p_end = len(hist)
+            for n in range(self.min_ngram, self.max_ngram + 1):
+                if p_end < n:
+                    break
+                g = tuple(hist[p_end - n:p_end])
+                lst = grams.setdefault(g, [])
+                lst.insert(0, p_end - n)
+                del lst[self.positions_per_gram:]
+
+    # ------------------------------------------------------------- proposal
+    def history_len(self, slot: int) -> int:
+        return len(self._tokens.get(slot, ()))
+
+    def propose(self, slot: int, max_tokens: int) -> List[int]:
+        """Draft up to `max_tokens` continuation tokens for the slot's
+        current suffix: longest gram first, most recent occurrence that is
+        NOT the suffix itself (it must have at least one following token).
+        Returns [] when nothing matches — the engine then runs the slot as
+        a plain decode row (draft_len 0) at zero extra cost."""
+        hist = self._tokens.get(slot)
+        if not hist or max_tokens < 1:
+            return []
+        T = len(hist)
+        grams = self._grams[slot]
+        for n in range(min(self.max_ngram, T), self.min_ngram - 1, -1):
+            suffix = tuple(hist[T - n:T])
+            for start in grams.get(suffix, ()):
+                cont = start + n
+                if cont >= T:
+                    continue            # the suffix occurrence itself
+                return hist[cont:cont + max_tokens]
+        return []

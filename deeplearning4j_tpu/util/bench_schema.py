@@ -19,7 +19,11 @@ off/on sides with the tail stats the docs render, and the delta
 fields). ISSUE 10 adds `serving_sharded` (the multi-chip TP parity +
 replica goodput A/B — always present; measured entries must carry the
 fleet `goodput`, a `tp_parity` block whose tokens_match is True, and a
-`replica_ab` block with both sides' goodput). bench.py calls
+`replica_ab` block with both sides' goodput). ISSUE 11 adds
+`serving_spec_decode` (the speculative-decoding A/B — CPU-runnable and
+always present; measured entries must carry tokens_identical=True, an
+accept_rate in [0, 1], and both sides' tokens/sec and syncs/token).
+bench.py calls
 `assert_valid` on the dict it is about to print, and
 tests/test_bench_schema.py re-validates the committed artifact, so the
 contract holds at write time and at review time.
@@ -215,6 +219,33 @@ def validate_artifact(art: dict) -> List[str]:
             errs.append("serving_sharded.replica_ab must carry "
                         "one_replica/two_replicas dicts with numeric "
                         "goodput")
+
+    # speculative-decode A/B (ISSUE 11): CPU-runnable, so always present;
+    # when measured the greedy token streams MUST have matched (a
+    # faster-but-different decode is a bug, not a win) and the accept
+    # rate must be a sane fraction
+    sp = e.get("serving_spec_decode")
+    if not isinstance(sp, dict):
+        errs.append("extra['serving_spec_decode'] missing or not a dict "
+                    "(the spec-decode A/B is CPU-runnable — emit "
+                    "error/skipped entries rather than dropping it)")
+    elif "error" not in sp and "skipped_reason" not in sp:
+        if not isinstance(sp.get("platform"), str):
+            errs.append("extra['serving_spec_decode'] has no 'platform' "
+                        "label")
+        if sp.get("tokens_identical") is not True:
+            errs.append("serving_spec_decode.tokens_identical must be True "
+                        "— speculative decode drifted from the plain "
+                        "greedy token stream")
+        ar = sp.get("accept_rate")
+        if not _is_num(ar) or not 0.0 <= ar <= 1.0:
+            errs.append("serving_spec_decode.accept_rate missing or "
+                        "outside [0, 1]")
+        for k in ("tokens_per_sec_on", "tokens_per_sec_off",
+                  "host_syncs_per_token_on", "host_syncs_per_token_off"):
+            if not _is_num(sp.get(k)):
+                errs.append(f"serving_spec_decode.{k} missing or not a "
+                            "number")
 
     # every measurement dict carries a platform label
     for name, entry in e.items():

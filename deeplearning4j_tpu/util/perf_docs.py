@@ -195,6 +195,33 @@ def _sharded_serving_lines(sh) -> list:
     return lines
 
 
+def _spec_decode_lines(sp) -> list:
+    """Speculative-decoding A/B section from extra['serving_spec_decode']
+    (ISSUE 11): accept rate, tokens/sec and syncs/token spec ON vs OFF —
+    greedy token parity is asserted inside the bench itself."""
+    if not isinstance(sp, dict) or sp.get("tokens_identical") is not True:
+        if isinstance(sp, dict) and sp.get("skipped_reason"):
+            return [f"- Speculative decoding A/B: {sp['skipped_reason']} "
+                    f"(platform: {sp.get('platform', '?')})."]
+        return []
+    d = sp.get("tokens_per_sec_delta_frac")
+    line = (
+        f"- Speculative decoding (ISSUE 11 A/B, {sp.get('platform', '?')}, "
+        f"draft {sp.get('spec_draft', '?')}, K=1 both sides): draft-free "
+        f"n-gram drafts on repetitive text hit an accept rate of "
+        f"{_pct(sp.get('accept_rate'))}, moving tokens/sec "
+        f"{sp.get('tokens_per_sec_off', 0):,.1f} -> "
+        f"{sp.get('tokens_per_sec_on', 0):,.1f}"
+        + (f" ({d:+.1%})" if d is not None else "")
+        + f" and host syncs/token "
+        f"{sp.get('host_syncs_per_token_off', 0):.3f} -> "
+        f"{sp.get('host_syncs_per_token_on', 0):.3f}, with the greedy "
+        f"token stream **bit-identical** spec on/off (asserted in the "
+        f"bench). `DL4J_TPU_SPEC_DECODE` — see PERF.md \"Speculative "
+        f"decoding cost model\".")
+    return [line]
+
+
 def render_block(art: dict) -> str:
     """Markdown bullet block rendered VERBATIM into README.md and PERF.md."""
     e = art["extra"]
@@ -348,6 +375,7 @@ def render_block(art: dict) -> str:
     lines.extend(_serving_slo_lines(e.get("serving_slo")))
     lines.extend(_chunked_prefill_lines(e.get("serving_chunked_prefill")))
     lines.extend(_sharded_serving_lines(e.get("serving_sharded")))
+    lines.extend(_spec_decode_lines(e.get("serving_spec_decode")))
     lines.extend(_roofline_table_lines(e.get("roofline_table")))
     lines.append(
         f"- ParallelWrapper ResNet50: {pw['images_per_sec']:,.0f} img/s — "

@@ -59,6 +59,13 @@ def _minimal_art():
                               "kv_bytes_per_pos_per_chip_ratio": 0.5},
                 "replica_ab": {"one_replica": {"goodput": 18.0},
                                "two_replicas": {"goodput": 19.0}}},
+            "serving_spec_decode": {
+                "platform": "cpu", "spec_draft": 4,
+                "tokens_identical": True, "accept_rate": 0.62,
+                "tokens_per_sec_on": 120.0, "tokens_per_sec_off": 80.0,
+                "tokens_per_sec_delta_frac": 0.5,
+                "host_syncs_per_token_on": 0.55,
+                "host_syncs_per_token_off": 1.02},
             "roofline_table": [
                 {"function": "train_step", "platform": "tpu",
                  "flops": 1e12, "bytes_accessed": 1e9,
@@ -238,6 +245,41 @@ def test_sharded_serving_rules():
     assert validate_artifact(art) == []
 
 
+def test_spec_decode_ab_rules():
+    """ISSUE 11: the speculative-decoding A/B must always exist; a measured
+    entry needs tokens_identical=True (a spec engine that drifts from the
+    plain greedy stream must fail the gate, not publish a 'speedup'), an
+    accept rate inside [0, 1], and both sides' tokens/sec + syncs/token;
+    skipped/errored entries are exempt."""
+    art = _minimal_art()
+    del art["extra"]["serving_spec_decode"]
+    assert any("serving_spec_decode" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["serving_spec_decode"]["platform"]
+    assert any("serving_spec_decode" in e and "platform" in e
+               for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["serving_spec_decode"]["tokens_identical"] = False
+    assert any("tokens_identical" in e and "drifted" in e
+               for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["serving_spec_decode"]["accept_rate"] = 1.5
+    assert any("accept_rate" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["serving_spec_decode"]["tokens_per_sec_off"]
+    assert any("tokens_per_sec_off" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["serving_spec_decode"]["host_syncs_per_token_on"] = "few"
+    assert any("host_syncs_per_token_on" in e
+               for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["serving_spec_decode"] = {"error": "ValueError: boom"}
+    assert validate_artifact(art) == []
+    art["extra"]["serving_spec_decode"] = {"platform": "cpu",
+                                           "skipped_reason": "why not"}
+    assert validate_artifact(art) == []
+
+
 def test_goodput_dict_is_a_measurement_needing_platform():
     art = _minimal_art()
     art["extra"]["some_slo_thing"] = {"goodput": 5.0}
@@ -320,3 +362,11 @@ def test_committed_artifact_passes_schema():
     assert d["tpot_p99_delta_ms"] > 0
     if d["max_sustainable_rate_delta"] is not None:
         assert d["max_sustainable_rate_delta"] >= 0
+    # ISSUE 11 acceptance: the committed spec-decode A/B carries a
+    # measured accept rate on the repetitive workload (the drafts really
+    # fired) with exact greedy token parity
+    sp = e["serving_spec_decode"]
+    assert "error" not in sp and "skipped_reason" not in sp
+    assert sp["tokens_identical"] is True
+    assert 0.0 < sp["accept_rate"] <= 1.0
+    assert sp["spec_tokens_accepted"] > 0

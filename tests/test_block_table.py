@@ -14,6 +14,7 @@ import jax.numpy as jnp
 
 from deeplearning4j_tpu.serving.block_table import (BlockAllocator,
                                                     PrefixRegistry)
+from deeplearning4j_tpu.serving import kv_cache
 from deeplearning4j_tpu.serving.kv_cache import KVCache
 
 
@@ -149,3 +150,99 @@ def test_randomized_alloc_free_fork_stress():
         c.free(0)
     # the run must actually have exercised sharing and COW
     assert c.shared_blocks_total > 0 and c.cow_copies_total > 0
+
+
+def test_copy_on_reject_never_mutates_shared_blocks():
+    """Speculative-decode rollback safety (ISSUE 11): a draft write landing
+    inside a COW-shared block must COPY-ON-REJECT — replace the shared
+    block in the writer's table with a private copy — never mutate the
+    donor's bytes. Rollback (`set_length`) makes rejected positions
+    invisible, not unwritten, so a shared block dirtied by one slot's
+    rejected draft would silently corrupt every other mapper. Randomized
+    admit/ensure_writable/draft-write/free stress asserting refcount
+    conservation after every operation and the donor's cached KV bit-intact
+    after every acceptor's draft write."""
+    rng = random.Random(99)
+    bs, S, plen = 4, 6, 12
+    c = KVCache(n_layers=1, max_seqs=S, max_len=32, n_kv_heads=1,
+                head_dim=2, dtype=jnp.float32, block_size=bs,
+                num_blocks=56, prefix_share=True)
+    prompt = [rng.randrange(50) for _ in range(plen)]
+    k_pat = np.arange(plen * 2, dtype=np.float32).reshape(plen, 1, 2)
+    v_pat = k_pat + 100.0
+    donor = c.admit("donor", n_positions=plen + 4, prompt=prompt)
+    d = donor.slot
+    c.state = kv_cache.write_prefill(c.state, 0, d, jnp.asarray(k_pat),
+                                     jnp.asarray(v_pat))
+    c.state = kv_cache.set_length(c.state, d, plen)
+    c.register_prefix(d, prompt)
+    donor_blocks = list(c._slot_blocks[d])
+
+    def check_refcounts():
+        counts = Counter(b for blocks in c._slot_blocks.values()
+                         for b in blocks)
+        assert c.trash_block not in counts
+        for b in range(c.num_blocks):
+            assert c.allocator.refcount(b) == counts.get(b, 0)
+
+    def check_donor_intact():
+        k = np.asarray(c.state["k"][0])
+        v = np.asarray(c.state["v"][0])
+        for li, b in enumerate(donor_blocks):
+            lo = li * bs
+            span = min(bs, plen - lo)
+            if span <= 0:
+                break
+            np.testing.assert_array_equal(k[b, :span], k_pat[lo:lo + span])
+            np.testing.assert_array_equal(v[b, :span], v_pat[lo:lo + span])
+
+    live = {}                      # acceptor slot -> garbage write counter
+    copied_total = 0
+    for it in range(200):
+        r = rng.random()
+        if (r < 0.4 and c.n_free) or not live:
+            plan = c.admit("acc", n_positions=plen + 8, prompt=prompt)
+            if plan is not None:
+                assert plan.n_shared_blocks >= 1   # sharing actually engaged
+                live[plan.slot] = 0
+        elif r < 0.8:
+            slot = rng.choice(sorted(live))
+            # a rejection-prone draft landing anywhere in the prompt range,
+            # INCLUDING the COW-shared leading blocks (structurally illegal
+            # for today's engine, which only writes past the prompt tail —
+            # exactly what the guard must survive)
+            start = rng.randrange(0, plen + 2)
+            q = rng.randrange(1, 5)
+            n_copied = c.ensure_writable(slot, start, start + q)
+            copied_total += n_copied
+            # idempotent: the range is now private, nothing left to copy
+            assert c.ensure_writable(slot, start, start + q) == 0
+            for li in range(start // bs, -(-(start + q) // bs)):
+                blk = c._slot_blocks[slot][li]
+                assert c.allocator.refcount(blk) == 1
+                assert blk not in donor_blocks
+            # the draft write itself: distinct garbage per iteration, only
+            # this slot's rows valid (everyone else trash-routes)
+            live[slot] += 1
+            pos = np.zeros((S, q), np.int32)
+            pos[slot] = np.arange(start, start + q)
+            valid = np.zeros((S, q), bool)
+            valid[slot] = True
+            junk = np.full((S, q, 1, 2), -1000.0 - it, np.float32)
+            c.state = kv_cache.append_tokens(
+                c.state, 0, jnp.asarray(junk), jnp.asarray(junk),
+                jnp.asarray(pos), jnp.asarray(valid))
+        else:
+            slot = rng.choice(sorted(live))
+            del live[slot]
+            c.free(slot)
+        check_refcounts()
+        check_donor_intact()
+    # the stress must actually have exercised the copy-on-reject path (the
+    # cache-lifetime COW counter also includes admission-time tail copies)
+    assert copied_total > 0
+    assert c.cow_copies_total >= copied_total
+    for slot in sorted(live):
+        c.free(slot)
+    c.free(d)
+    assert c.blocks_free == c.num_blocks

@@ -1,0 +1,307 @@
+"""Speculative decoding tests (ISSUE 11).
+
+The load-bearing guarantees:
+
+- EXACTNESS: greedy speculative decode is token-for-token BIT-IDENTICAL to
+  plain decode (MLN and ComputationGraph, prefix sharing on and off, TP in
+  {1, 2}), and single-request temperature>0 decode is bit-identical too —
+  the point-mass accept rule samples every committed token from the TARGET
+  row under the same chain key the sequential step would have used, so
+  speculation changes THROUGHPUT, never the distribution.
+- ORACLE PARITY: captured logprob rows under spec still match the fp64
+  full-recompute forward to 1e-9 (the multi-query verify path computes
+  exactly the layer's math at every draft offset).
+- KERNELS: the multi-position flash verify kernel matches the dense fp64
+  spec oracle to 1e-12 across GQA/MQA/window shapes, and the dense spec
+  oracle's rows are bit-identical to the single-query paged oracle.
+- SYNC DISCIPLINE: spec adds ZERO host syncs — with no n-gram matches the
+  counted sync stream is bit-identical to K=1 stepping; with matches the
+  syncs-per-token ratio only improves.
+- ROLLBACK lives in tests/test_block_table.py (copy-on-reject stress).
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.decode_attention import (
+    decode_attention_dense_paged, decode_attention_dense_spec_paged,
+    flash_decode_attention_spec_paged)
+from deeplearning4j_tpu.serving import (NgramDraftIndex, Request,
+                                        ServingEngine, resolve_spec_decode,
+                                        resolve_spec_draft)
+from deeplearning4j_tpu.serving.sharding import ShardedServingEngine
+from deeplearning4j_tpu.telemetry.flight_recorder import (FlightRecorder,
+                                                          max_gap_s)
+
+from tests.test_serving import V, _assert_parity, _build_net
+
+# generations over a repetitive prompt re-emit prompt n-grams, so the
+# draft index gets real matches (the workload speculation is built for)
+REPETITIVE = [1, 2, 3, 4, 1, 2, 3, 4, 1, 2, 3, 4, 1, 2]
+PROMPTS = [REPETITIVE, [5, 4, 3], [2, 2, 7, 1, 2, 2, 7, 1, 2, 2]]
+
+
+def _tokens(results):
+    return [r.tokens for r in results]
+
+
+# ------------------------------------------------------------ draft index
+def test_ngram_index_longest_gram_most_recent_continuation():
+    idx = NgramDraftIndex(max_ngram=3)
+    idx.reset(0, [1, 2, 3, 9, 1, 2, 3, 7, 1, 2, 3])
+    # suffix (1,2,3) recurs at 0 and 4; position 8 IS the suffix (no
+    # continuation) so the most recent *usable* occurrence is 4 -> 7, 1...
+    assert idx.propose(0, 2) == [7, 1]
+    assert idx.propose(0, 8) == [7, 1, 2, 3]      # capped by history end
+    assert idx.propose(0, 0) == []
+
+
+def test_ngram_index_extend_and_fallback_to_shorter_grams():
+    idx = NgramDraftIndex(max_ngram=3)
+    idx.reset(1, [5, 6, 7])
+    assert idx.propose(1, 4) == []                # every gram is the suffix
+    idx.extend(1, [5])                            # history: 5 6 7 5
+    assert idx.propose(1, 3) == [6, 7, 5]         # 1-gram (5,) at pos 0
+    idx.drop(1)
+    assert idx.propose(1, 4) == []
+    assert idx.history_len(1) == 0
+
+
+def test_ngram_index_position_list_is_bounded():
+    idx = NgramDraftIndex(max_ngram=2, positions_per_gram=3)
+    idx.reset(0, [9] * 50)
+    assert all(len(v) <= 3 for v in idx._grams[0].values())
+    # retained positions are the MOST RECENT — the usable one sits right
+    # before the suffix, leaving a single continuation token
+    assert idx.propose(0, 4) == [9]
+
+
+def test_spec_env_resolvers(monkeypatch):
+    assert resolve_spec_decode() is False
+    monkeypatch.setenv("DL4J_TPU_SPEC_DECODE", "1")
+    assert resolve_spec_decode() is True
+    assert resolve_spec_decode(False) is False    # explicit beats env
+    assert resolve_spec_draft() == 4
+    monkeypatch.setenv("DL4J_TPU_SPEC_DRAFT", "7")
+    assert resolve_spec_draft() == 7
+    assert resolve_spec_draft(0) == 1             # clamped
+
+
+# ---------------------------------------------------------------- kernels
+def _spec_case(S, Q, H, Hk, D, bs, bps, window, seed=0):
+    nb = S * bps + 1
+    rng = np.random.RandomState(seed + 3)
+    kp = jnp.asarray(rng.randn(nb, bs, Hk, D))
+    vp = jnp.asarray(rng.randn(nb, bs, Hk, D))
+    bt = jnp.asarray(rng.permutation(nb - 1)[:S * bps].reshape(S, bps),
+                     jnp.int32)
+    q = jnp.asarray(rng.randn(S, Q, H, D))
+    L = bps * bs
+    vis = np.asarray([(7 * (i + 1)) % (L - Q) + 1 for i in range(S)])
+    vis[0], vis[-1] = 1, L - Q + 1
+    return q, kp, vp, bt, jnp.asarray(vis, jnp.int32), 1.0 / np.sqrt(D), \
+        window
+
+
+SPEC_SWEEP = [
+    # (S, Q, H, Hk, D, bs, bps, window)
+    (3, 1, 4, 4, 16, 16, 4, 0),     # Q=1 degeneracy, MHA
+    (3, 3, 4, 2, 16, 16, 4, 0),     # GQA group 2
+    (2, 5, 4, 1, 8, 8, 4, 0),       # MQA, minimum kernel block
+    (3, 2, 4, 2, 16, 16, 4, 5),     # GQA + sliding window
+    (2, 4, 2, 2, 16, 32, 3, 3),     # MHA + window, odd block count
+]
+
+
+@pytest.mark.parametrize("S,Q,H,Hk,D,bs,bps,window", SPEC_SWEEP)
+def test_spec_kernel_matches_dense_spec_oracle(S, Q, H, Hk, D, bs, bps,
+                                               window):
+    q, kp, vp, bt, vis, scale, w = _spec_case(S, Q, H, Hk, D, bs, bps,
+                                              window)
+    ref = decode_attention_dense_spec_paged(q, kp, vp, bt, vis, scale, w)
+    out = flash_decode_attention_spec_paged(q, kp, vp, bt, vis, scale, w)
+    assert out.shape == (S, Q, H, D)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-12, rtol=1e-12)
+
+
+def test_spec_oracle_rows_bit_identical_to_plain_paged_oracle():
+    """Row i of the spec oracle IS the single-query paged oracle at
+    visibility vis+i — the property the greedy parity guarantee leans on
+    (row 0 of a draft_len=0 spec step == the plain decode step)."""
+    q, kp, vp, bt, vis, scale, w = _spec_case(3, 3, 4, 2, 16, 16, 4, 5)
+    out = decode_attention_dense_spec_paged(q, kp, vp, bt, vis, scale, w)
+    for i in range(3):
+        ref = decode_attention_dense_paged(q[:, i], kp, vp, bt, vis + i,
+                                           scale, w)
+        np.testing.assert_array_equal(np.asarray(out[:, i]),
+                                      np.asarray(ref))
+
+
+def test_spec_kernel_small_block_fallback_is_the_oracle():
+    """block_size < 8 can't tile the kernel — the helper must return the
+    dense oracle BIT-identically (fallback, not an approximation)."""
+    q, kp, vp, bt, vis, scale, w = _spec_case(2, 3, 4, 2, 8, 4, 6, 0)
+    ref = decode_attention_dense_spec_paged(q, kp, vp, bt, vis, scale, w)
+    out = flash_decode_attention_spec_paged(q, kp, vp, bt, vis, scale, w)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+
+
+# ------------------------------------------------------------ engine parity
+def _run(net, prompts, spec, share=True, seed=3, capture=False, temp=0.0,
+         max_new=12, **kw):
+    eng = ServingEngine(net, max_seqs=4, max_len=96, seed=seed,
+                        decode_chunk=1, overlap=False, prefix_share=share,
+                        capture_logprobs=capture, spec_decode=spec, **kw)
+    res = eng.generate([Request(list(p), max_new_tokens=max_new,
+                                temperature=temp) for p in prompts])
+    return res, eng
+
+
+@pytest.mark.parametrize("n_kv", [0, 2])
+@pytest.mark.parametrize("share", [True, False])
+def test_spec_greedy_token_and_oracle_parity_mln(n_kv, share):
+    net = _build_net(n_kv=n_kv)
+    ref, _ = _run(net, PROMPTS, spec=False, share=share)
+    got, eng = _run(net, PROMPTS, spec=True, share=share, capture=True)
+    assert _tokens(got) == _tokens(ref)
+    for prompt, res in zip(PROMPTS, got):
+        _assert_parity(net, res, prompt)          # fp64 oracle, atol 1e-9
+    s = eng.stats()
+    assert s["spec_decode"] == 1
+    # the repetitive prompt must actually have exercised acceptance
+    assert s["spec_tokens_accepted"] > 0
+    assert 0.0 < s["spec_accept_rate"] <= 1.0
+
+
+def test_spec_greedy_token_parity_computation_graph():
+    from deeplearning4j_tpu import (Activation, InputType,
+                                    NeuralNetConfiguration, RnnOutputLayer,
+                                    Sgd, WeightInit)
+    from deeplearning4j_tpu.nn.conf.layers.attention import \
+        SelfAttentionLayer
+    from deeplearning4j_tpu.nn.graph.computation_graph import \
+        ComputationGraph
+    conf = (NeuralNetConfiguration.Builder().seed(5)
+            .weight_init(WeightInit.XAVIER)
+            .updater(Sgd(learning_rate=0.05)).dtype("float64")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("attn", SelfAttentionLayer(n_out=8, n_heads=2,
+                                                  causal=True, block_size=0),
+                       "in")
+            .add_layer("out", RnnOutputLayer(n_out=V,
+                                             activation=Activation.SOFTMAX),
+                       "attn")
+            .set_outputs("out")
+            .set_input_types(InputType.recurrent(V))
+            .build())
+    g = ComputationGraph(conf).init()
+    ref, _ = _run(g, [REPETITIVE], spec=False)
+    got, eng = _run(g, [REPETITIVE], spec=True)
+    assert _tokens(got) == _tokens(ref)
+    assert eng.stats()["spec_tokens_accepted"] > 0
+
+
+def test_spec_temperature_token_parity_single_request():
+    """temperature>0, single request: committed tokens are BIT-IDENTICAL
+    to plain sampling — the point-mass collapse draws every committed
+    token from the target row under the sequential chain key (stronger
+    than the usual distribution-level speculative guarantee)."""
+    net = _build_net(n_kv=2)
+    for temp in (0.7, 1.3):
+        ref, _ = _run(net, [REPETITIVE], spec=False, temp=temp, seed=11,
+                      max_new=20)
+        got, _ = _run(net, [REPETITIVE], spec=True, temp=temp, seed=11,
+                      max_new=20)
+        assert _tokens(got) == _tokens(ref)
+
+
+def test_spec_eos_and_maxgen_parity():
+    net = _build_net()
+    base, _ = _run(net, [REPETITIVE], spec=False, max_new=16)
+    eos = base[0].tokens[3]
+    for kw in ({"eos_id": eos}, {"eos_id": eos, "max_new_tokens": 2},
+               {"max_new_tokens": 1}):
+        def gen(spec):
+            eng = ServingEngine(_build_net(), max_seqs=2, max_len=96,
+                                seed=3, decode_chunk=1, overlap=False,
+                                spec_decode=spec)
+            return eng.generate([Request(REPETITIVE, **kw)])[0]
+        r0, r1 = gen(False), gen(True)
+        assert r1.tokens == r0.tokens
+        assert r1.finish_reason == r0.finish_reason
+
+
+def test_spec_no_match_host_sync_bit_parity():
+    """With zero n-gram matches every spec step degrades to a plain decode
+    row — the counted host-sync stream must be BIT-identical to K=1
+    stepping on the same schedule (speculation never adds syncs)."""
+    net = _build_net(n_kv=2)
+    ref, eng_off = _run(net, PROMPTS, spec=False)
+    eng2 = ServingEngine(net, max_seqs=4, max_len=96, seed=3,
+                         decode_chunk=1, overlap=False, spec_decode=True)
+    eng2._spec_index.propose = lambda slot, k: []      # no drafts, ever
+    res2 = eng2.generate([Request(list(p), max_new_tokens=12)
+                          for p in PROMPTS])
+    assert _tokens(res2) == _tokens(ref)
+    s_off, s2 = eng_off.stats(), eng2.stats()
+    assert s2["host_syncs"] == s_off["host_syncs"]
+    assert s2["tokens_out"] == s_off["tokens_out"]
+    assert s2["host_syncs_per_token"] == s_off["host_syncs_per_token"]
+    assert s2["spec_tokens_accepted"] == s2["spec_tokens_rejected"] == 0
+
+
+def test_spec_fewer_syncs_on_repetitive_text():
+    """The whole point: on a repetitive stream accepted drafts amortize the
+    per-iteration sync, so syncs-per-token strictly improves (single
+    request so the batch's slowest slot can't mask the win)."""
+    net = _build_net(n_kv=2)
+    ref, eng_off = _run(net, [REPETITIVE], spec=False, max_new=20)
+    got, eng_on = _run(net, [REPETITIVE], spec=True, max_new=20)
+    assert _tokens(got) == _tokens(ref)
+    s_off, s_on = eng_off.stats(), eng_on.stats()
+    assert s_on["tokens_out"] == s_off["tokens_out"]
+    assert s_on["host_syncs"] < s_off["host_syncs"]
+    assert s_on["host_syncs_per_token"] < s_off["host_syncs_per_token"]
+    assert s_on["spec_tokens_accepted"] > 0
+    assert s_on["spec_accept_rate"] > 0.0
+
+
+def test_spec_timeline_spans_gap_free_and_flight_recorded():
+    net = _build_net()
+    fr = FlightRecorder(capacity=8)
+    eng = ServingEngine(net, max_seqs=2, max_len=96, seed=3,
+                        decode_chunk=1, overlap=False, spec_decode=True,
+                        flight_recorder=fr)
+    res = eng.generate([Request(REPETITIVE, max_new_tokens=12)])[0]
+    spans = [ev for ev in res.timeline if ev["phase"] == "spec_step"]
+    assert spans, [ev["phase"] for ev in res.timeline]
+    for ev in spans:
+        assert {"draft", "accepted", "tokens"} <= set(ev)
+        assert 0 <= ev["accepted"] <= ev["draft"]
+        assert 1 <= ev["tokens"]
+    assert sum(ev["tokens"] for ev in spans) == len(res.tokens) - 1
+    # spec spans keep the lifecycle gap-free under the flight-recorder bar:
+    # no hole wider than the longest recorded span (same bar the chunked
+    # decode timelines are held to in tests/test_flight_recorder.py)
+    period = max(ev["t1"] - ev["t0"] for ev in res.timeline)
+    assert max_gap_s(res.timeline) <= period
+    assert any(any(ev.get("phase") == "spec_step" for ev in rec["timeline"])
+               for rec in fr.records())
+
+
+# ----------------------------------------------------- tensor parallelism
+@pytest.mark.parametrize("tp", [1, 2])
+def test_spec_tp_token_parity(forced_host_devices, tp):
+    net = _build_net(n_kv=2)
+    base = ServingEngine(net, max_seqs=4, max_len=64, dtype="float64",
+                         decode_chunk=1, overlap=False)
+    ref = base.generate(PROMPTS, max_new_tokens=8)
+    eng = ShardedServingEngine(net, max_seqs=4, max_len=64,
+                               dtype="float64", tp=tp, decode_chunk=1,
+                               overlap=False, spec_decode=True)
+    got = eng.generate(PROMPTS, max_new_tokens=8)
+    assert _tokens(got) == _tokens(ref)       # bit-identical greedy stream
+    assert eng.stats()["spec_tokens_accepted"] > 0

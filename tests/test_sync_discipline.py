@@ -39,7 +39,11 @@ HOT_PATH_MODULES = sorted(
      # runs inside every decode dispatch and the replica router runs at
      # every admission — a hidden readback in either would multiply by
      # TP degree and replica count
-     PKG / "serving" / "sharding.py"]
+     PKG / "serving" / "sharding.py",
+     # speculative drafting (ISSUE 11): the n-gram index runs per scheduler
+     # iteration; its whole value proposition is ZERO device reads — it may
+     # only ever consume token ints the readback already materialized
+     PKG / "serving" / "spec.py"]
     + list((PKG / "telemetry").glob("*.py")))
 
 ANNOTATION = "sync-ok:"
@@ -109,7 +113,7 @@ def test_all_hot_path_modules_exist():
     assert {"health.py", "profiler.py", "memory.py", "tracing.py",
             "registry.py", "training.py", "kv_cache.py",
             "block_table.py", "slo.py", "flight_recorder.py",
-            "loadgen.py", "sharding.py"} <= names
+            "loadgen.py", "sharding.py", "spec.py"} <= names
 
 
 # ------------------------------------------------ scanner self-tests
