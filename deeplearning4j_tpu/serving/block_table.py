@@ -16,6 +16,15 @@ hot path and never touching the device:
   slots has refcount R and only returns to the free list when the last
   mapping drops.
 
+  For the KV observatory (ISSUE 12) the allocator also keeps per-block
+  HEAT metadata: a monotonically increasing iteration `clock` (advanced
+  by the engine once per scheduler iteration via `tick()`), a
+  `last_touch` stamp (updated at alloc/incref and whenever the engine
+  credits a write into the block via `touch()`), and an `alloc_epoch`
+  (the clock value when the current residency began). All of it is plain
+  host integers riding bookkeeping the scheduler already does — no
+  device reads, so the host-sync bit-parity guarantee is untouched.
+
 - `PrefixRegistry`: a content-addressed index of RESIDENT prompt blocks.
   Keys are chain hashes — the digest of block i covers prompt tokens
   [0, (i+1)*block_size), so a hit guarantees the whole prefix matches,
@@ -54,6 +63,32 @@ class BlockAllocator:
         self._free: List[int] = list(range(self.num_blocks))
         self._ref: List[int] = [0] * self.num_blocks
         self._n_shared = 0          # blocks with refcount >= 2
+        # heat metadata (ISSUE 12): iteration clock + per-block stamps.
+        # Stamps are only meaningful while a block is mapped (refcount>=1).
+        self.clock = 0
+        self._last_touch: List[int] = [0] * self.num_blocks
+        self._alloc_epoch: List[int] = [0] * self.num_blocks
+
+    # ------------------------------------------------------------- heat
+    def tick(self) -> int:
+        """Advance the iteration clock (one scheduler iteration). Pure
+        host arithmetic — the clock is the unit of every heat stamp."""
+        self.clock += 1
+        return self.clock
+
+    def touch(self, block: int) -> None:
+        """Stamp `block` as touched at the current clock. Called by the
+        cache when the engine credits a write (prefill chunk, decode
+        append, spec commit) into a position the block covers."""
+        if self._ref[block] < 1:
+            raise ValueError(f"touch on free block {block}")
+        self._last_touch[block] = self.clock
+
+    def last_touch(self, block: int) -> int:
+        return self._last_touch[block]
+
+    def alloc_epoch(self, block: int) -> int:
+        return self._alloc_epoch[block]
 
     # ------------------------------------------------------------ alloc
     def alloc(self) -> Optional[int]:
@@ -62,6 +97,7 @@ class BlockAllocator:
             return None
         b = heapq.heappop(self._free)
         self._ref[b] = 1
+        self._alloc_epoch[b] = self._last_touch[b] = self.clock
         return b
 
     def alloc_many(self, n: int) -> Optional[List[int]]:
@@ -81,6 +117,7 @@ class BlockAllocator:
         self._ref[block] += 1
         if self._ref[block] == 2:
             self._n_shared += 1
+        self._last_touch[block] = self.clock   # a new mapping is a touch
 
     def decref(self, block: int) -> bool:
         """Drop one mapping; returns True when the block just became free
@@ -213,6 +250,17 @@ class PrefixRegistry:
             index = self._full if kind == "full" else self._tail
             if index.get(digest) == block:
                 del index[digest]
+
+    def lineage(self, block: int) -> Optional[str]:
+        """Hex digest of the prefix chain `block` serves (its FIRST claim
+        — chain digests commit to the whole prefix, so the first claim is
+        the canonical identity of the content the block holds), or None
+        when the block backs no registry entry. Observability accessor
+        (ISSUE 12): which sharing lineage a shared block belongs to."""
+        claims = self._claims.get(block)
+        if not claims:
+            return None
+        return claims[0][1].hex()
 
     @property
     def n_entries(self) -> int:

@@ -157,6 +157,15 @@ class GenerationResult:
     # adds zero device syncs. flight_recorder.py turns retained timelines
     # into a Perfetto trace.
     timeline: List[dict] = field(default_factory=list)
+    # per-request KV-byte attribution (ISSUE 12), stamped at retirement
+    # from block-table bookkeeping (no device reads): the block-granular
+    # reservation held, the positions actually written, and the shared-
+    # prefix positions served from another request's resident blocks.
+    # Under tensor parallelism these are per-device bytes, matching the
+    # serving.kv_bytes_* gauges.
+    kv_bytes_reserved: int = 0
+    kv_bytes_live: int = 0
+    kv_bytes_shared_prefix: int = 0
 
     def timeline_phases(self) -> Dict[str, float]:
         """Total seconds per phase (post-hoc latency decomposition)."""
@@ -383,7 +392,8 @@ class ServingEngine:
                  prefix_registry=None,
                  metrics_parent=None,
                  spec_decode: Optional[bool] = None,
-                 spec_draft: Optional[int] = None):
+                 spec_draft: Optional[int] = None,
+                 kv_observatory=None):
         self.decoder = self._build_decoder(net, max_seqs, max_len,
                                            dtype=dtype,
                                            block_size=kv_block,
@@ -588,6 +598,29 @@ class ServingEngine:
                     FlightRecorder
                 flight_recorder = FlightRecorder()
         self.flight_recorder = flight_recorder
+        # KV-pressure observatory (ISSUE 12): serving.kv.* heat/attribution
+        # gauges, admission-rejection forensics, eviction dry-run scoring.
+        # Pass kv_observatory=True (or a KVObservatory instance) or set
+        # DL4J_TPU_KV_OBS=1. Host-side only — it consumes pool snapshots
+        # and the scheduler's own live-position bookkeeping, so enabling
+        # it cannot change the counted sync sequence (bit-parity-tested).
+        if kv_observatory is None:
+            kv_observatory = os.environ.get("DL4J_TPU_KV_OBS", "") \
+                not in ("", "0")
+        if isinstance(kv_observatory, bool):
+            obs = None
+            if kv_observatory:
+                from deeplearning4j_tpu.telemetry.kv_observatory import \
+                    KVObservatory
+                # recompute cost unit for the dry-run scorer: ~2*params
+                # FLOPs per token (param counts are host shape metadata)
+                n_params = sum(int(np.size(x)) for x in
+                               jax.tree_util.tree_leaves(self.decoder.params))
+                obs = KVObservatory(self.metrics,
+                                    flops_per_token=2.0 * n_params)
+        else:
+            obs = kv_observatory
+        self.kv_observatory = obs
         _tmemory.poll("serving.engine_init", registry=self.metrics)
 
     # ----------------------------------------------- sharding seams (ISSUE 10)
@@ -627,7 +660,10 @@ class ServingEngine:
         (bench.py publishes the ratio as host_syncs_per_token)."""
         with self._lock:
             syncs, toks = self._c_syncs.value, self._c_tokens.value
-            cache = self.decoder.cache
+            # one atomic pool snapshot (ISSUE 12 satellite) — the free /
+            # shared / slot totals all describe the same instant, where
+            # separate property reads could straddle an admission
+            snap = self.decoder.cache.pool_snapshot(include_blocks=False)
             return {"host_syncs": syncs, "tokens_out": toks,
                     "decode_chunk": self.decode_chunk,
                     "prefill_chunk": self.prefill_chunk,
@@ -635,10 +671,14 @@ class ServingEngine:
                     "host_syncs_per_token": syncs / max(1, toks),
                     "nonfinite_chunks": self._c_nonfinite.value,
                     "queue_depth": len(self._queue),
-                    "free_slots": cache.n_free,
+                    "free_slots": snap["slots_free"],
                     "active_slots": len(self._by_slot),
-                    "kv_blocks_free": cache.blocks_free,
-                    "kv_blocks_shared": cache.blocks_shared,
+                    "kv_blocks_free": snap["blocks_free"],
+                    "kv_blocks_shared": snap["blocks_shared"],
+                    "kv_clock": snap["clock"],
+                    "kv_rejections": (self.kv_observatory.n_rejections
+                                      if self.kv_observatory is not None
+                                      else 0),
                     "kv_bytes_waste": self._g_kv_waste.value,
                     "prefix_hits": self._c_prefix_hits.value,
                     "prefix_shared_tokens": self._c_prefix_tokens.value,
@@ -650,6 +690,17 @@ class ServingEngine:
                     "spec_tokens_rejected": self._c_spec_rej.value,
                     "spec_accept_rate": self._c_spec_acc.value / max(
                         1, self._c_spec_acc.value + self._c_spec_rej.value)}
+
+    def kv_pool_snapshot(self, include_blocks: bool = True
+                         ) -> Dict[str, object]:
+        """Atomic KV pool snapshot (under the scheduler lock) with the
+        per-slot LIVE write positions filled in, so callers can feed it
+        straight to telemetry.kv_observatory.attribute_pool / dry_run.
+        Host-side bookkeeping only — zero device reads."""
+        with self._lock:
+            return self.decoder.cache.pool_snapshot(
+                live_positions=self._live_kv_positions(),
+                include_blocks=include_blocks)
 
     def export_trace(self, path: str) -> str:
         """Write the global tracer's Chrome-trace JSON (prefill / decode
@@ -724,6 +775,20 @@ class ServingEngine:
                 # blocked on its block reservation (ISSUE 8 satellite)
                 act.retries += 1
                 self._c_adm_retries.inc()
+                if self.kv_observatory is not None and act.retries == 1:
+                    # rejection forensics (ISSUE 12), first rejection per
+                    # request only (a head-of-queue request blocked for N
+                    # iterations is one record, not N). blocks_needed is
+                    # the full reservation — the upper bound admission
+                    # would shrink via prefix sharing.
+                    bs = cache.block_size
+                    self.kv_observatory.on_rejection(
+                        cache.pool_snapshot(
+                            live_positions=self._live_kv_positions()),
+                        req_id=act.req_id, prompt_len=plen,
+                        max_new_tokens=req.max_new_tokens,
+                        blocks_needed=-(-(plen + req.max_new_tokens) // bs),
+                        queue_depth=len(self._queue), retries=act.retries)
                 break
             self._queue.pop(0)
             slot = plan.slot
@@ -806,6 +871,9 @@ class ServingEngine:
                 # a monolithic prefill ran while decode-active slots sat
                 # waiting — the full-prompt stall chunked prefill bounds
                 self._h_stall.observe((time.perf_counter() - t_pf) * 1e3)
+            # heat stamp the positions this dispatch wrote (shared-prefix
+            # blocks were stamped by their incref at admission)
+            cache.touch_blocks(slot, shared, plen)
             name = f"prefill_shared_b{skey[0]}k{skey[1]}" if shared \
                 else f"prefill_b{bucket}"
             self._finish_first_token(
@@ -913,6 +981,10 @@ class ServingEngine:
                              else 0})
         act.n_chunks += 1
         act.prefilled = end
+        # heat stamp exactly this chunk's positions — earlier chunks were
+        # stamped in their own iterations, so block heat tracks when each
+        # block was actually written, not when the prefill finished
+        self.decoder.cache.touch_blocks(slot, start, end)
         self._c_pf_chunks.inc()
         self._h_pf_chunk_tokens.observe(end - start)
         if _profiler.enabled():
@@ -948,6 +1020,14 @@ class ServingEngine:
         else:
             reason = default_reason
         lps = act.logprobs[:n] if act.logprobs is not None else None
+        # KV-byte attribution (ISSUE 12), taken BEFORE the free while the
+        # reservation still exists: reserved = block-granular hold, live =
+        # positions actually written (device lengths), shared = prefix
+        # positions served from another request's blocks
+        kv_reserved = self.decoder.cache.reserved_positions(slot) * \
+            self._kv_bytes_per_pos
+        kv_live = (act.prefilled + max(0, n - 1)) * self._kv_bytes_per_pos
+        kv_shared = act.shared_len * self._kv_bytes_per_pos
         self.decoder.cache.free(slot)
         now = time.monotonic()
         ttft = act.t_first - act.t_submit if act.t_first else None
@@ -965,13 +1045,19 @@ class ServingEngine:
         # a span, not an instant: covers the history-row readback + block
         # free, so timeline coverage stays gap-free through retirement
         act.timeline.append({"phase": "retire", "t0": t_ret0, "t1": now,
-                             "reason": reason, "tokens": n})
+                             "reason": reason, "tokens": n,
+                             "kv_bytes_reserved": kv_reserved,
+                             "kv_bytes_live": kv_live,
+                             "kv_bytes_shared": kv_shared})
         qw = act.t_admit - act.t_submit if act.t_admit else None
         res = GenerationResult(row, reason, len(req.tokens), lps,
                                ttft_s=ttft, tokens_per_sec=tps,
                                req_id=act.req_id, queue_wait_s=qw,
                                admission_retries=act.retries,
-                               timeline=act.timeline)
+                               timeline=act.timeline,
+                               kv_bytes_reserved=kv_reserved,
+                               kv_bytes_live=kv_live,
+                               kv_bytes_shared_prefix=kv_shared)
         act.fut._set(res)
         self._c_retires.inc()
         if tps is not None:
@@ -988,19 +1074,36 @@ class ServingEngine:
         if self.flight_recorder is not None:
             self.flight_recorder.record(result)
 
+    def _live_kv_positions(self) -> Dict[int, int]:
+        """Per-slot KV positions actually WRITTEN, matching the device's
+        `lengths` (prefilled + n_generated - 1 once decode starts — the
+        last sampled token's KV lands next iteration; a mid-prefill slot
+        holds exactly its prefilled positions). Host bookkeeping only;
+        this is the live-vs-waste split the observatory attributes."""
+        return {a.slot: a.prefilled + max(0, a.n_generated - 1)
+                for a in self._by_slot.values()}
+
     def _update_kv_resident(self) -> None:
         """Publish resident KV bytes: cache positions actually holding a
         live prompt+generated token across active slots, from the host's
-        own bookkeeping (no device read). Lock held."""
+        own bookkeeping (no device read). Lock held. The free/shared
+        block gauges come from ONE pool snapshot (ISSUE 12 satellite:
+        no torn free-vs-shared pairs); the same snapshot feeds the KV
+        observatory when enabled."""
         cache = self.decoder.cache
+        obs = self.kv_observatory
+        snap = cache.pool_snapshot(live_positions=self._live_kv_positions(),
+                                   include_blocks=obs is not None)
         pos = sum(a.prefilled + a.n_generated
                   for a in self._by_slot.values())
         self._g_kv_res.set(pos * self._kv_bytes_per_pos)
-        reserved = sum(cache.reserved_positions(a.slot)
-                       for a in self._by_slot.values())
+        reserved = sum(info["reserved_positions"]
+                       for info in snap["slots"].values())
         self._g_kv_waste.set(max(0, reserved - pos) * self._kv_bytes_per_pos)
-        self._g_blocks_free.set(cache.blocks_free)
-        self._g_blocks_shared.set(cache.blocks_shared)
+        self._g_blocks_free.set(snap["blocks_free"])
+        self._g_blocks_shared.set(snap["blocks_shared"])
+        if obs is not None:
+            obs.observe(snap)
 
     def _register_chunk_costs(self, k: int, active) -> None:
         """File the decode-chunk jit's XLA cost_analysis under
@@ -1079,6 +1182,12 @@ class ServingEngine:
                 continue
             n_new = int(entry_np[:, slot].sum())
             act.n_generated += n_new
+            # the chunk appended KV at [lengths_before, lengths_after) =
+            # the n_new positions ending at prefilled + n_generated - 1
+            # (the last sampled token's KV is written NEXT iteration) —
+            # heat stamps ride this host arithmetic, zero added syncs
+            p_end = act.prefilled + act.n_generated - 1
+            self.decoder.cache.touch_blocks(slot, p_end - n_new, p_end)
             self._c_tokens.inc(n_new)
             if span is not None:
                 act.timeline.append({"phase": "decode_chunk", "t0": span[0],
@@ -1101,6 +1210,9 @@ class ServingEngine:
         (peeked keys, effective-step commit)."""
         with self._lock:
             t_iter0 = time.monotonic()   # iteration start: timeline anchor
+            # heat clock: one tick per scheduler iteration (a host int —
+            # the unit every block heat stamp is expressed in)
+            self.decoder.cache.allocator.tick()
             self._admit()
             if not self._by_slot:
                 return bool(self._queue)
@@ -1265,6 +1377,10 @@ class ServingEngine:
             d_s = int(dl_np[slot])
             acc = int(acc_np[slot])
             act.n_generated += n_new
+            # committed spec rows span [pos, pos + n_new); rejected rows
+            # past the commit are invisible and deliberately NOT stamped
+            p_end = act.prefilled + act.n_generated - 1
+            cache.touch_blocks(slot, p_end - n_new, p_end)
             self._c_tokens.inc(n_new)
             self._spec_index.extend(slot, toks_np[slot, :n_new])
             if d_s > 0:
@@ -1303,6 +1419,7 @@ class ServingEngine:
                 with self._lock:
                     t_iter0 = time.monotonic()   # timeline anchor: covers
                     # this iteration's admissions + the dispatch it issues
+                    self.decoder.cache.allocator.tick()   # heat clock
                     self._admit()
                     self._expire_timeouts()
                     # at most one prefill chunk per iteration: the chunk's

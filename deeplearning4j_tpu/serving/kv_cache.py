@@ -334,6 +334,10 @@ class KVCache:
         self.registry.bind_pool(self)
         self._owner: Dict[int, object] = {}   # slot -> opaque request handle
         self._slot_blocks: Dict[int, List[int]] = {}   # slot -> mapped blocks
+        # reverse index for attribution (ISSUE 12): block -> slots mapping
+        # it. Invariant (stress-tested): len(_block_sharers[b]) ==
+        # allocator.refcount(b) for every mapped block b.
+        self._block_sharers: Dict[int, set] = {}
         # lifetime counters (bench/stats: the sharing win, observable)
         self.shared_blocks_total = 0    # shared mappings ever granted
         self.shared_tokens_total = 0    # prompt positions served from shares
@@ -395,6 +399,8 @@ class KVCache:
         self.state = set_block_table(self.state, slot, row)
         self._owner[slot] = owner
         self._slot_blocks[slot] = row_blocks
+        for b in row_blocks:
+            self._block_sharers.setdefault(b, set()).add(slot)
         self.shared_blocks_total += len(shared_blocks)
         self.shared_tokens_total += shared_len
         return AdmissionPlan(slot=slot, n_blocks=len(row_blocks),
@@ -443,6 +449,8 @@ class KVCache:
             row = np.full((self.blocks_per_seq,), self.trash_block, np.int32)
             row[:len(row_blocks)] = row_blocks
             self.state = set_block_table(self.state, slot, row)
+            self._block_sharers[old].discard(slot)
+            self._block_sharers.setdefault(fresh[0], set()).add(slot)
             self.allocator.decref(old)     # refcount >= 2: never frees here
             self.cow_copies_total += 1
             copied += 1
@@ -466,6 +474,11 @@ class KVCache:
         if slot not in self._slot_blocks:
             raise ValueError(f"slot {slot} already free")
         for b in self._slot_blocks.pop(slot):
+            sharers = self._block_sharers.get(b)
+            if sharers is not None:
+                sharers.discard(slot)
+                if not sharers:
+                    del self._block_sharers[b]
             if self.allocator.decref(b):
                 self.registry.forget(b)
         self._owner.pop(slot, None)
@@ -477,6 +490,86 @@ class KVCache:
 
     def owner(self, slot: int):
         return self._owner.get(slot)
+
+    # ------------------------------------------- heat / attribution (12)
+    def touch_blocks(self, slot: int, start: int, end: int) -> None:
+        """Stamp every block of `slot` covering logical positions
+        [start, end) as touched at the allocator's current clock. Called
+        by the engine when it CREDITS writes (prefill chunk, decode
+        append, spec commit) — the host already knows these ranges from
+        its counted readbacks, so the stamp adds zero device syncs."""
+        if end <= start:
+            return
+        bs = self.block_size
+        row_blocks = self._slot_blocks.get(slot)
+        if not row_blocks:
+            return
+        for li in range(max(0, start // bs),
+                        min(len(row_blocks), -(-end // bs))):
+            self.allocator.touch(row_blocks[li])
+
+    def sharers(self, block: int) -> frozenset:
+        """Slots currently mapping `block` (empty when free)."""
+        return frozenset(self._block_sharers.get(block, ()))
+
+    def pool_snapshot(self, live_positions: Optional[Dict[int, int]] = None,
+                      include_blocks: bool = True) -> Dict[str, object]:
+        """ONE consistent host-side view of the whole pool (ISSUE 12).
+
+        Callers previously read `blocks_free` / `blocks_shared` (and
+        per-slot reservations) as separate probes; between two such reads
+        the scheduler can admit or retire a request, so the pair could
+        describe no state the pool was ever actually in. This method
+        builds everything in one pass with no device reads and no yields
+        — under the engine lock it is atomic by construction.
+
+        `live_positions` (slot -> KV positions actually written, host
+        bookkeeping the engine owns) is threaded through verbatim so the
+        observatory can split reservation bytes into live vs waste.
+        `include_blocks=False` skips the per-block table for cheap gauge
+        refreshes (totals + slots only)."""
+        alloc = self.allocator
+        slots: Dict[int, Dict[str, object]] = {}
+        for slot in sorted(self._slot_blocks):
+            owner = self._owner.get(slot)
+            req_id = getattr(owner, "req_id", None)
+            if req_id is None and isinstance(owner, (int, str)):
+                req_id = owner
+            blocks = self._slot_blocks[slot]
+            slots[slot] = {
+                "req_id": req_id,
+                "blocks": list(blocks),
+                "reserved_positions": len(blocks) * self.block_size,
+                "live_positions": None if live_positions is None
+                else int(live_positions.get(slot, 0)),
+                # lifecycle stamps (PR 8) when the owner is an engine
+                # request record — the SLO-aware eviction scorer's signal
+                "deadline": getattr(owner, "deadline", None),
+                "t_submit": getattr(owner, "t_submit", None),
+            }
+        snap: Dict[str, object] = {
+            "clock": alloc.clock,
+            "num_blocks": self.num_blocks,
+            "block_size": self.block_size,
+            "bytes_per_position": self.bytes_per_position,
+            "blocks_free": alloc.n_free,
+            "blocks_shared": alloc.n_shared,
+            "slots_free": len(self._free_slots),
+            "slots_active": self.max_seqs - len(self._free_slots),
+            "slots": slots,
+        }
+        if include_blocks:
+            snap["blocks"] = {
+                b: {
+                    "refcount": alloc.refcount(b),
+                    "last_touch": alloc.last_touch(b),
+                    "alloc_epoch": alloc.alloc_epoch(b),
+                    "sharers": sorted(sharers),
+                    "lineage": self.registry.lineage(b),
+                }
+                for b, sharers in sorted(self._block_sharers.items())
+            }
+        return snap
 
     # ------------------------------------------------------------- stats
     @property

@@ -65,12 +65,27 @@ __all__ = [
     "serving_partition_rules", "cache_partition_specs",
     "resolve_tp", "resolve_replicas", "build_serving_mesh",
     "head_sharded_paged_attention", "head_sharded_spec_attention",
-    "ShardedServingEngine", "ShardedServingGroup",
+    "ShardedServingEngine", "ShardedServingGroup", "GROUP_SUMMED_KEYS",
 ]
 
 
 def _is_spec(x) -> bool:
     return isinstance(x, P)
+
+
+# Engine-lifetime counters and point-in-time gauges that
+# ShardedServingGroup.stats() sums replica-wise into the fleet view.
+# Pinned by tests/test_sharded_serving.py: every key must exist in
+# ServingEngine.stats(), and new fleet-meaningful counters belong HERE —
+# PR 11's spec-decode counters were silently dropped from the aggregate
+# exactly because this list was inlined and easy to forget.
+GROUP_SUMMED_KEYS: Tuple[str, ...] = (
+    "host_syncs", "tokens_out", "queue_depth", "active_slots",
+    "free_slots", "kv_blocks_free", "kv_blocks_shared", "kv_rejections",
+    "prefix_hits", "prefix_shared_tokens", "prefill_chunks",
+    "nonfinite_chunks", "admission_retries",
+    "spec_tokens_accepted", "spec_tokens_rejected",
+)
 
 
 # --------------------------------------------------------- partition rules
@@ -428,6 +443,24 @@ class ShardedServingGroup:
         self._c_affinity = self.metrics.counter(
             "serving.router_prefix_affinity", "requests routed to a replica "
             "because its registry already held a matching resident prefix")
+        # fleet KV gauges (ISSUE 12): group-level names are disjoint from
+        # the per-engine serving.kv.* observatory gauges, so the parented
+        # prometheus exposition shows both layers without double counting
+        self._g_fleet_free = self.metrics.gauge(
+            "serving.kv.fleet_bytes_free", "free KV bytes summed across "
+            "every replica's pool")
+        self._g_fleet_shared = self.metrics.gauge(
+            "serving.kv.fleet_bytes_shared", "prefix-shared KV bytes "
+            "(each shared block counted once) summed across replicas")
+        self._g_fleet_live = self.metrics.gauge(
+            "serving.kv.fleet_bytes_private_live", "privately owned live "
+            "KV bytes summed across replicas")
+        self._g_fleet_waste = self.metrics.gauge(
+            "serving.kv.fleet_bytes_waste", "tail + reserved-but-unwritten "
+            "KV bytes summed across replicas")
+        self._g_fleet_imbal = self.metrics.gauge(
+            "serving.kv.fleet_imbalance", "max-min spread of per-replica "
+            "used-block fraction (0 = perfectly balanced fleet)")
         block_size = resolve_block_size(engine_kw.get("kv_block"), max_len)
         # per-replica registry handles: owned (bound) by each replica's KV
         # pool, read by the router for affinity — block ids never cross
@@ -535,8 +568,11 @@ class ShardedServingGroup:
             self._pool.shutdown(wait=wait)
 
     def stats(self) -> Dict[str, object]:
-        """Fleet view: lifetime counters summed across replicas, plus the
-        per-replica snapshots (each taken under its engine's lock)."""
+        """Fleet view: lifetime counters summed across replicas
+        (GROUP_SUMMED_KEYS), group-wide derived ratios recomputed from the
+        sums (a mean of per-replica ratios would weight an idle replica
+        like a saturated one), plus the per-replica snapshots (each taken
+        under its engine's lock)."""
         per = [engine.stats() for engine in self.engines]
         agg: Dict[str, object] = {
             "replicas": self.replicas, "tp": self.tp,
@@ -544,13 +580,58 @@ class ShardedServingGroup:
             "router_prefix_affinity": self._c_affinity.value,
             "per_replica": per,
         }
-        for key in ("host_syncs", "tokens_out", "queue_depth",
-                    "active_slots", "free_slots", "kv_blocks_free",
-                    "prefix_hits", "prefix_shared_tokens", "prefill_chunks",
-                    "nonfinite_chunks"):
+        for key in GROUP_SUMMED_KEYS:
             agg[key] = sum(s.get(key, 0) for s in per)
         agg["host_syncs_per_token"] = \
             agg["host_syncs"] / max(1, agg["tokens_out"])
+        agg["spec_accept_rate"] = agg["spec_tokens_accepted"] / max(
+            1, agg["spec_tokens_accepted"] + agg["spec_tokens_rejected"])
         agg["resident_seqs_max"] = max(
             (s.get("resident_seqs_max", 0) for s in per), default=0)
+        # used-block imbalance straight from the per-replica snapshots —
+        # num_blocks is a host attribute, so this stays sync-free
+        fracs = [(e.decoder.cache.num_blocks - s["kv_blocks_free"])
+                 / max(1, e.decoder.cache.num_blocks)
+                 for e, s in zip(self.engines, per)]
+        agg["kv_used_imbalance"] = \
+            (max(fracs) - min(fracs)) if fracs else 0.0
         return agg
+
+    def kv_fleet_snapshot(self) -> Dict[str, object]:
+        """Fleet-wide KV memory attribution (ISSUE 12): one atomic pool
+        snapshot per replica (each under its engine's scheduler lock),
+        attributed via telemetry.kv_observatory.attribute_pool, summed
+        into the group's serving.kv.fleet_* gauges. Per-replica entries
+        keep their own attribution so a hot replica is visible next to an
+        idle one; `imbalance` is the max-min spread of used-block
+        fraction. Host-side bookkeeping only — zero device reads."""
+        from deeplearning4j_tpu.telemetry.kv_observatory import \
+            attribute_pool
+        fleet = {"pool_bytes": 0, "free_bytes": 0, "shared_bytes": 0,
+                 "private_live_bytes": 0, "waste_tail_bytes": 0,
+                 "waste_reserved_bytes": 0}
+        per: List[Dict[str, object]] = []
+        fracs: List[float] = []
+        for r, engine in enumerate(self.engines):
+            snap = engine.kv_pool_snapshot()
+            att = attribute_pool(snap)
+            for key in fleet:
+                fleet[key] += att[key]
+            n = snap["num_blocks"]
+            used = n - snap["blocks_free"]
+            fracs.append(used / max(1, n))
+            per.append({"replica": r, "blocks_used": used,
+                        "blocks_free": snap["blocks_free"],
+                        "blocks_shared": snap["blocks_shared"],
+                        "clock": snap["clock"],
+                        "attribution": att})
+        imbalance = (max(fracs) - min(fracs)) if fracs else 0.0
+        self._g_fleet_free.set(fleet["free_bytes"])
+        self._g_fleet_shared.set(fleet["shared_bytes"])
+        self._g_fleet_live.set(fleet["private_live_bytes"])
+        self._g_fleet_waste.set(fleet["waste_tail_bytes"]
+                                + fleet["waste_reserved_bytes"])
+        self._g_fleet_imbal.set(imbalance)
+        return {**fleet, "imbalance": imbalance, "per_replica": per,
+                "conserved": all(p["attribution"]["conserved"]
+                                 for p in per)}

@@ -37,6 +37,10 @@ Env toggles:
 - DL4J_TPU_LOADGEN_SEED seeds serving/loadgen.py arrival schedules when
   no explicit seed is passed (default 0 — schedules are deterministic
   either way).
+- DL4J_TPU_KV_OBS=1 attaches a KV-pressure observatory (kv_observatory.py,
+  ISSUE 12) to every new ServingEngine: serving.kv.* heat/attribution
+  gauges, admission-rejection forensics, and the eviction dry-run scorer.
+  Off by default.
 """
 from __future__ import annotations
 
@@ -55,7 +59,7 @@ __all__ = [
     "DEFAULT_MS_BUCKETS", "DEFAULT_S_BUCKETS", "registry", "tracer", "span",
     "instant", "enabled", "configure", "maybe_export_trace", "metrics_route",
     "PROMETHEUS_CONTENT_TYPE", "sanitize_component", "health", "profiler",
-    "memory", "slo", "flight_recorder",
+    "memory", "slo", "flight_recorder", "kv_observatory",
 ]
 
 from deeplearning4j_tpu.telemetry.registry import sanitize_component  # noqa: E402,F401
@@ -67,7 +71,8 @@ def __getattr__(name):
     # on first attribute access so registry/tracing users stay jax-free.
     # slo / flight_recorder (ISSUE 8) are jax-free but rarely needed, so
     # they load lazily too
-    if name in ("health", "profiler", "memory", "slo", "flight_recorder"):
+    if name in ("health", "profiler", "memory", "slo", "flight_recorder",
+                "kv_observatory"):
         import importlib
         return importlib.import_module(
             f"deeplearning4j_tpu.telemetry.{name}")

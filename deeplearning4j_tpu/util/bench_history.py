@@ -1,0 +1,236 @@
+"""Cross-round perf-trend accounting over committed bench artifacts (ISSUE 12).
+
+Every build round the driver commits a `BENCH_r0N.json` wrapper at the repo
+root — `{"n": round, "cmd": ..., "rc": exit_code, "tail": last-bytes-of-
+stdout}` — and the most recent full run lands in `BENCH_LATEST.json`.  Until
+now those rounds were write-only: nothing read them back, so a regression
+between rounds was invisible unless a human diffed JSON by hand.
+
+This module is the reader:
+
+* `load_rounds()` parses each wrapper's `tail` for the single JSON artifact
+  line bench.py prints (it starts with `{"metric"`).  Rounds whose tail was
+  truncated before the artifact line (r04) or whose run crashed (`rc != 0`,
+  r05) parse to `parsed=None` — they still appear in the table with their
+  failure cause, because silently dropping a crashed round would make the
+  trend look cleaner than the history actually was.
+* `history_table_lines()` renders the round-over-round trend (headline
+  img/s, decode tokens/s, goodput, max sustainable rate) as markdown;
+  `perf_docs` injects it between `<!-- benchhistory:begin/end -->` markers
+  in PERF.md so the table regenerates from the artifacts, never hand-edited.
+* `check_latest_regression()` is the gate: BENCH_LATEST's headline metrics
+  must not regress more than ``DEFAULT_TOLERANCE`` (25%, disclosed in the
+  rendered table) against the most recent *parsable* prior round.  Metrics
+  the prior round didn't record (older artifacts predate the serving keys)
+  or recorded as 0/None are not comparable and are skipped, not failed.
+
+Early-round artifacts are headline-only (no `extra`), so comparability is
+per-metric, not per-round.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional
+
+# Disclosed regression tolerance: LATEST may be up to this fraction BELOW
+# the prior parsable round before the gate fails. Benches on shared CPU
+# runners jitter hard (the committed rounds swing ~2x between rounds); the
+# gate exists to catch collapses, not noise.
+DEFAULT_TOLERANCE = 0.25
+
+# (key, label, how-to-extract). Headline `value` lives at the top level;
+# the serving metrics live under extra.* and are absent from early rounds.
+HEADLINE_METRICS = (
+    ("value", "headline img/s"),
+    ("decode_tokens_per_sec", "decode tok/s"),
+    ("goodput", "goodput req/s"),
+    ("max_sustainable_rate", "max sustainable req/s"),
+)
+
+_ARTIFACT_LINE = re.compile(r'^\{"metric"')
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def parse_artifact_from_tail(tail: str) -> Optional[dict]:
+    """Extract the bench artifact from a round wrapper's captured stdout.
+
+    bench.py prints exactly one line starting `{"metric"`; a truncated tail
+    (the driver keeps only the last N bytes) may have cut it off entirely,
+    in which case there is nothing to parse."""
+    for line in tail.splitlines():
+        line = line.strip()
+        if _ARTIFACT_LINE.match(line):
+            try:
+                return json.loads(line)
+            except ValueError:
+                return None      # artifact line itself truncated mid-JSON
+    return None
+
+
+def extract_headline(art: Optional[dict]) -> Dict[str, Optional[float]]:
+    """The four trend metrics from one artifact; None = not recorded.
+
+    0.0 is mapped to None too: the committed artifacts use 0.0 for
+    "bench section didn't run on this platform", which must read as
+    not-comparable rather than as a 100% regression."""
+    out: Dict[str, Optional[float]] = {k: None for k, _ in HEADLINE_METRICS}
+    if not isinstance(art, dict):
+        return out
+    extra = art.get("extra") or {}
+    dec = extra.get("decode_serving") or {}
+    slo = extra.get("serving_slo") or {}
+    raw = {
+        "value": art.get("value"),
+        "decode_tokens_per_sec": dec.get("decode_tokens_per_sec"),
+        "goodput": slo.get("goodput"),
+        "max_sustainable_rate": slo.get("max_sustainable_rate"),
+    }
+    for k, v in raw.items():
+        if isinstance(v, (int, float)) and v > 0:
+            out[k] = float(v)
+    return out
+
+
+def load_rounds(root: Optional[str] = None) -> List[dict]:
+    """All committed rounds, sorted by round number, plus failure causes."""
+    root = root or repo_root()
+    rounds = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        try:
+            wrapper = json.load(open(path))
+        except ValueError:
+            wrapper = None
+        name = os.path.basename(path)
+        if not isinstance(wrapper, dict):
+            rounds.append({"name": name, "n": None, "parsed": None,
+                           "cause": "wrapper unreadable"})
+            continue
+        rc = wrapper.get("rc")
+        art = parse_artifact_from_tail(wrapper.get("tail") or "")
+        cause = None
+        if rc not in (0, None):
+            cause = f"bench crashed (rc={rc})"
+        elif art is None:
+            cause = "artifact line truncated out of tail"
+        rounds.append({"name": name, "n": wrapper.get("n"),
+                       "parsed": art, "cause": cause,
+                       "headline": extract_headline(art)})
+    rounds.sort(key=lambda r: (r["n"] is None, r["n"], r["name"]))
+    return rounds
+
+
+def load_latest(root: Optional[str] = None) -> dict:
+    root = root or repo_root()
+    return json.load(open(os.path.join(root, "BENCH_LATEST.json")))
+
+
+def _fmt(v: Optional[float]) -> str:
+    return "n/a" if v is None else f"{v:,.1f}"
+
+
+def history_table_lines(root: Optional[str] = None) -> List[str]:
+    """Markdown trend table: one row per committed round + LATEST."""
+    root = root or repo_root()
+    rounds = load_rounds(root)
+    latest = extract_headline(load_latest(root))
+    lines = [
+        "Perf trend across committed bench rounds (generated by "
+        "`deeplearning4j_tpu/util/bench_history.py` from the `BENCH_r0*.json`"
+        " wrappers — rounds whose artifact didn't survive the run are shown "
+        "with their failure cause, not dropped):",
+        "",
+        "| round | " + " | ".join(lbl for _, lbl in HEADLINE_METRICS)
+        + " | note |",
+        "|---|" + "---:|" * len(HEADLINE_METRICS) + "---|",
+    ]
+    for r in rounds:
+        h = r.get("headline") or {k: None for k, _ in HEADLINE_METRICS}
+        cells = " | ".join(_fmt(h[k]) for k, _ in HEADLINE_METRICS)
+        note = r["cause"] or ("headline-only artifact"
+                              if h["decode_tokens_per_sec"] is None
+                              and h["goodput"] is None
+                              and h["value"] is not None else "")
+        lines.append(f"| {r['name'].replace('BENCH_', '').replace('.json', '')}"
+                     f" | {cells} | {note} |")
+    cells = " | ".join(_fmt(latest[k]) for k, _ in HEADLINE_METRICS)
+    lines.append(f"| **LATEST** | {cells} |  |")
+    lines.append("")
+    lines.append(
+        f"Regression gate: each LATEST metric must be within "
+        f"{DEFAULT_TOLERANCE:.0%} of the most recent prior round that "
+        f"recorded it (checked by `python -m "
+        f"deeplearning4j_tpu.util.bench_history --check` and "
+        f"tests/test_bench_history.py).")
+    return lines
+
+
+def check_latest_regression(root: Optional[str] = None,
+                            tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    """Gate LATEST against the most recent parsable prior round, per metric.
+
+    Returns {"ok": bool, "comparisons": [...], "skipped": [...]} — a metric
+    is compared against the LAST prior round that recorded it (not merely
+    the last round overall), so a truncated or crashed round in between
+    cannot hide a regression."""
+    root = root or repo_root()
+    rounds = load_rounds(root)
+    latest = extract_headline(load_latest(root))
+    comparisons, skipped = [], []
+    for key, label in HEADLINE_METRICS:
+        prior_val, prior_name = None, None
+        for r in reversed(rounds):
+            h = r.get("headline") or {}
+            if h.get(key) is not None:
+                prior_val, prior_name = h[key], r["name"]
+                break
+        if prior_val is None:
+            skipped.append({"metric": key, "reason": "no prior round "
+                            "recorded this metric"})
+            continue
+        if latest[key] is None:
+            skipped.append({"metric": key, "reason":
+                            f"LATEST does not record it (prior: "
+                            f"{prior_name}={prior_val:,.1f})"})
+            continue
+        floor = prior_val * (1.0 - tolerance)
+        comparisons.append({
+            "metric": key, "label": label, "prior_round": prior_name,
+            "prior": prior_val, "latest": latest[key], "floor": floor,
+            "ok": latest[key] >= floor,
+            "delta_frac": latest[key] / prior_val - 1.0,
+        })
+    return {"ok": all(c["ok"] for c in comparisons),
+            "tolerance": tolerance,
+            "comparisons": comparisons, "skipped": skipped}
+
+
+def main(argv: List[str]) -> int:
+    check = "--check" in argv
+    print("\n".join(history_table_lines()))
+    if check:
+        res = check_latest_regression()
+        print()
+        for c in res["comparisons"]:
+            print(f"{'OK  ' if c['ok'] else 'FAIL'} {c['label']}: "
+                  f"{c['prior']:,.1f} ({c['prior_round']}) -> "
+                  f"{c['latest']:,.1f} ({c['delta_frac']:+.1%}; floor "
+                  f"{c['floor']:,.1f})")
+        for s in res["skipped"]:
+            print(f"skip {s['metric']}: {s['reason']}")
+        if not res["ok"]:
+            print(f"LATEST regressed beyond the disclosed "
+                  f"{res['tolerance']:.0%} tolerance")
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -23,6 +23,12 @@ fleet `goodput`, a `tp_parity` block whose tokens_match is True, and a
 `serving_spec_decode` (the speculative-decoding A/B — CPU-runnable and
 always present; measured entries must carry tokens_identical=True, an
 accept_rate in [0, 1], and both sides' tokens/sec and syncs/token).
+ISSUE 12 adds `kv_observatory` (the forced-exhaustion pressure run —
+CPU-runnable and always present; measured entries must prove both
+in-bench assertions held: conserved_every_step=True and
+sync_parity=True, carry >= 1 recorded rejection with its
+requested-vs-free-vs-reclaimable forensics, and a well-formed dry-run
+row per eviction policy).
 bench.py calls
 `assert_valid` on the dict it is about to print, and
 tests/test_bench_schema.py re-validates the committed artifact, so the
@@ -246,6 +252,52 @@ def validate_artifact(art: dict) -> List[str]:
             if not _is_num(sp.get(k)):
                 errs.append(f"serving_spec_decode.{k} missing or not a "
                             "number")
+
+    # KV-pressure observatory (ISSUE 12): CPU-runnable forced-exhaustion
+    # run, so always present; when measured the two in-bench assertions
+    # must have held (conservation every iteration, sync bit-parity
+    # on-vs-off), at least one rejection must have been recorded (else
+    # the forensics path never executed), and every dry-run policy row
+    # must be well-formed — the docs render the ranked victims
+    ko = e.get("kv_observatory")
+    if not isinstance(ko, dict):
+        errs.append("extra['kv_observatory'] missing or not a dict (the "
+                    "forced-exhaustion run is CPU-runnable — emit error/"
+                    "skipped entries rather than dropping it)")
+    elif "error" not in ko and "skipped_reason" not in ko:
+        if not isinstance(ko.get("platform"), str):
+            errs.append("extra['kv_observatory'] has no 'platform' label")
+        if ko.get("conserved_every_step") is not True:
+            errs.append("kv_observatory.conserved_every_step must be True "
+                        "— the byte partition drifted from the pool size")
+        if ko.get("sync_parity") is not True:
+            errs.append("kv_observatory.sync_parity must be True — the "
+                        "observatory added device syncs")
+        if not _is_num(ko.get("rejections")) or ko.get("rejections", 0) < 1:
+            errs.append("kv_observatory.rejections missing or < 1 — the "
+                        "forced-exhaustion workload never exercised the "
+                        "forensics path")
+        ex = ko.get("example_rejection")
+        if not isinstance(ex, dict) or not all(
+                _is_num(ex.get(k)) for k in
+                ("blocks_needed", "blocks_free", "blocks_reclaimable",
+                 "shortfall_blocks")):
+            errs.append("kv_observatory.example_rejection must carry "
+                        "numeric blocks_needed/blocks_free/"
+                        "blocks_reclaimable/shortfall_blocks")
+        dr = ko.get("dry_run")
+        if not isinstance(dr, list) or not dr:
+            errs.append("kv_observatory.dry_run missing or empty (one row "
+                        "per eviction policy)")
+        else:
+            for i, row in enumerate(dr):
+                if not isinstance(row, dict) \
+                        or not isinstance(row.get("policy"), str) \
+                        or not _is_num(row.get("blocks_freed")) \
+                        or not isinstance(row.get("satisfies"), bool):
+                    errs.append(f"kv_observatory.dry_run[{i}] must carry "
+                                "policy (str), blocks_freed (num), "
+                                "satisfies (bool)")
 
     # every measurement dict carries a platform label
     for name, entry in e.items():

@@ -17,6 +17,11 @@ import sys
 
 BEGIN = "<!-- benchgen:begin -->"
 END = "<!-- benchgen:end -->"
+# Cross-round perf trend (ISSUE 12): rendered from the committed
+# BENCH_r0*.json wrappers by bench_history, injected only into docs that
+# carry these markers (PERF.md).
+HIST_BEGIN = "<!-- benchhistory:begin -->"
+HIST_END = "<!-- benchhistory:end -->"
 DOCS = ("README.md", "PERF.md")
 
 
@@ -222,6 +227,55 @@ def _spec_decode_lines(sp) -> list:
     return [line]
 
 
+def _kv_observatory_lines(ko) -> list:
+    """KV-pressure observatory section from extra['kv_observatory']
+    (ISSUE 12): the forced-exhaustion pressure run — rejection forensics
+    plus what each eviction policy WOULD have reclaimed, with
+    recompute-vs-swap costs. Conservation and on/off sync parity are
+    asserted inside the bench itself."""
+    if not isinstance(ko, dict) or not isinstance(
+            ko.get("example_rejection"), dict):
+        if isinstance(ko, dict) and (ko.get("skipped_reason")
+                                     or ko.get("error")):
+            return [f"- KV-pressure observatory: "
+                    f"{ko.get('skipped_reason') or ko.get('error')} "
+                    f"(platform: {ko.get('platform', '?')})."]
+        return []
+    rej = ko["example_rejection"]
+    line = (
+        f"- KV-pressure observatory (ISSUE 12, {ko.get('platform', '?')}, "
+        f"{ko.get('kv_blocks', '?')}-block pool, forced exhaustion): "
+        f"{ko.get('rejections', 0)} admission rejections recorded with full "
+        f"forensics — e.g. req {rej.get('req_id', '?')} needed "
+        f"{rej.get('blocks_needed', '?')} blocks against "
+        f"{rej.get('blocks_free', '?')} free / "
+        f"{rej.get('blocks_reclaimable', '?')} reclaimable-if-evicted "
+        f"(shortfall {rej.get('shortfall_blocks', '?')}). Pool attribution "
+        f"conserved after EVERY scheduler iteration and the token stream + "
+        f"host-sync count **bit-identical** observatory on/off (both "
+        f"asserted in-bench; {ko.get('host_syncs_per_token', 0):.3f} "
+        f"syncs/token).")
+    lines = [line]
+    dr = ko.get("dry_run") or []
+    if dr:
+        lines.append(
+            "\n  Eviction dry-run at the rejection (nothing actually "
+            "evicted; costs from the PERF.md recompute-vs-swap model):\n")
+        lines.append("  | policy | first victim | blocks freed | satisfies "
+                     "| cheaper | swap bytes | recompute FLOPs |")
+        lines.append("  |---|---|---:|---|---|---:|---:|")
+        for row in dr:
+            lines.append(
+                f"  | {row.get('policy', '?')} "
+                f"| req {row.get('first_victim_req_id', '?')} "
+                f"| {row.get('blocks_freed', '?')} "
+                f"| {'yes' if row.get('satisfies') else 'no'} "
+                f"| {row.get('first_victim_cheaper', '?')} "
+                f"| {row.get('swap_bytes_total', 0):,} "
+                f"| {row.get('recompute_flops_total', 0):,.0f} |")
+    return lines
+
+
 def render_block(art: dict) -> str:
     """Markdown bullet block rendered VERBATIM into README.md and PERF.md."""
     e = art["extra"]
@@ -376,6 +430,7 @@ def render_block(art: dict) -> str:
     lines.extend(_chunked_prefill_lines(e.get("serving_chunked_prefill")))
     lines.extend(_sharded_serving_lines(e.get("serving_sharded")))
     lines.extend(_spec_decode_lines(e.get("serving_spec_decode")))
+    lines.extend(_kv_observatory_lines(e.get("kv_observatory")))
     lines.extend(_roofline_table_lines(e.get("roofline_table")))
     lines.append(
         f"- ParallelWrapper ResNet50: {pw['images_per_sec']:,.0f} img/s — "
@@ -394,15 +449,40 @@ def inject(text: str, block: str) -> str:
     return pat.sub(lambda _: block, text)
 
 
+def render_history_block(root: str | None = None) -> str:
+    """Markdown perf-trend block rendered between the benchhistory markers
+    in PERF.md (ISSUE 12) — generated from the committed BENCH_r0*.json
+    round wrappers by bench_history, never hand-edited."""
+    from deeplearning4j_tpu.util import bench_history
+    lines = [HIST_BEGIN,
+             "<!-- generated from BENCH_r0*.json + BENCH_LATEST.json by "
+             "deeplearning4j_tpu/util/bench_history.py — do not edit by "
+             "hand -->"]
+    lines.extend(bench_history.history_table_lines(root))
+    lines.append(HIST_END)
+    return "\n".join(lines)
+
+
+def inject_history(text: str, block: str) -> str:
+    """Replace the benchhistory block if the doc carries the markers;
+    docs without them (README.md) pass through untouched."""
+    pat = re.compile(re.escape(HIST_BEGIN) + ".*?" + re.escape(HIST_END),
+                     re.DOTALL)
+    if not pat.search(text):
+        return text
+    return pat.sub(lambda _: block, text)
+
+
 def update_docs(root: str | None = None, write: bool = True) -> bool:
     """Regenerate the blocks. Returns True if anything changed."""
     root = root or repo_root()
     block = render_block(load_artifact(root))
+    hist_block = render_history_block(root)
     changed = False
     for doc in DOCS:
         path = os.path.join(root, doc)
         text = open(path).read()
-        new = inject(text, block)
+        new = inject_history(inject(text, block), hist_block)
         if new != text:
             changed = True
             if write:

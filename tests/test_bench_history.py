@@ -1,0 +1,158 @@
+"""Cross-round perf-trend gate (ISSUE 12 satellite).
+
+The committed `BENCH_r0*.json` wrappers are the only round-over-round
+record the repo keeps; `bench_history` parses them and gates
+BENCH_LATEST.json against the most recent *parsable* prior round. These
+tests pin three things: the parser survives every wrapper shape the
+committed history actually contains (truncated tails, crashed runs),
+the regression gate passes on the repo as committed (so a regression
+beyond the disclosed tolerance fails the suite, not a human diff), and
+the PERF.md trend table regenerates from the artifacts.
+"""
+import json
+
+import pytest
+
+from deeplearning4j_tpu.util import perf_docs
+from deeplearning4j_tpu.util.bench_history import (
+    DEFAULT_TOLERANCE, check_latest_regression, extract_headline,
+    history_table_lines, load_rounds, parse_artifact_from_tail, repo_root)
+
+
+# ------------------------------------------------------- parser robustness
+def test_parse_artifact_from_tail_shapes():
+    art = {"metric": "m", "value": 1.0, "unit": "u"}
+    line = json.dumps(art)
+    # artifact line buried in bench chatter
+    assert parse_artifact_from_tail(f"noise\n{line}\nmore") == art
+    # truncated tail: the artifact line never made it
+    assert parse_artifact_from_tail("noise only\n{\"met") is None
+    # artifact line itself cut mid-JSON — parse failure, not a crash
+    assert parse_artifact_from_tail(line[: len(line) // 2]) is None
+    assert parse_artifact_from_tail("") is None
+
+
+def test_extract_headline_treats_zero_and_missing_as_not_comparable():
+    h = extract_headline({"metric": "m", "value": 100.0, "extra": {
+        "decode_serving": {"decode_tokens_per_sec": 0.0},
+        "serving_slo": {"goodput": 50.0}}})
+    assert h["value"] == 100.0
+    assert h["decode_tokens_per_sec"] is None       # 0.0 = didn't run
+    assert h["goodput"] == 50.0
+    assert h["max_sustainable_rate"] is None        # absent
+    assert extract_headline(None) == {k: None for k in h}
+
+
+def test_load_rounds_covers_every_committed_wrapper():
+    """Every BENCH_r0*.json at the repo root shows up exactly once, with
+    unparsable rounds carrying a cause instead of vanishing — the
+    committed history contains both failure shapes (truncated tail,
+    rc!=0), so this exercises them for real."""
+    rounds = load_rounds()
+    assert len(rounds) >= 5
+    names = [r["name"] for r in rounds]
+    assert names == sorted(names)
+    for r in rounds:
+        if r["parsed"] is None:
+            assert r["cause"], f"{r['name']} unparsable but no cause"
+        else:
+            assert r["headline"]["value"] is not None
+    # the history is not allowed to be all-unparsable: the gate needs at
+    # least one prior round to compare against
+    assert any(r["parsed"] is not None for r in rounds)
+
+
+# ------------------------------------------------------- regression gate
+def test_latest_does_not_regress_beyond_disclosed_tolerance():
+    """THE gate: BENCH_LATEST's headline metrics vs the last prior round
+    that recorded each, within the tolerance PERF.md discloses."""
+    res = check_latest_regression()
+    detail = "; ".join(
+        f"{c['label']}: {c['prior']:,.1f} ({c['prior_round']}) -> "
+        f"{c['latest']:,.1f} (floor {c['floor']:,.1f})"
+        for c in res["comparisons"] if not c["ok"])
+    assert res["ok"], (
+        f"BENCH_LATEST regressed beyond the disclosed "
+        f"{res['tolerance']:.0%} tolerance vs the prior round: {detail}")
+    assert res["comparisons"], (
+        "gate compared nothing — every metric skipped, so the check is "
+        "vacuous; at least the headline img/s must be comparable")
+
+
+def test_gate_catches_a_planted_regression(tmp_path):
+    """Synthetic history: prior round at 100, LATEST below the floor."""
+    prior = {"metric": "m", "value": 100.0, "unit": "u"}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "cmd": "x", "rc": 0, "tail": json.dumps(prior)}))
+    bad = dict(prior, value=100.0 * (1 - DEFAULT_TOLERANCE) - 1)
+    (tmp_path / "BENCH_LATEST.json").write_text(json.dumps(bad))
+    res = check_latest_regression(str(tmp_path))
+    assert not res["ok"]
+    [c] = res["comparisons"]
+    assert c["metric"] == "value" and c["latest"] < c["floor"]
+    # exactly at the floor passes — the tolerance is inclusive
+    ok = dict(prior, value=100.0 * (1 - DEFAULT_TOLERANCE))
+    (tmp_path / "BENCH_LATEST.json").write_text(json.dumps(ok))
+    assert check_latest_regression(str(tmp_path))["ok"]
+
+
+def test_gate_compares_against_last_round_that_recorded_the_metric(tmp_path):
+    """A truncated/crashed round between LATEST and the last good round
+    must not hide a regression: the per-metric prior skips it."""
+    good = {"metric": "m", "value": 100.0, "unit": "u"}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "cmd": "x", "rc": 0, "tail": json.dumps(good)}))
+    (tmp_path / "BENCH_r02.json").write_text(json.dumps(
+        {"n": 2, "cmd": "x", "rc": 1, "tail": "Traceback ..."}))
+    (tmp_path / "BENCH_LATEST.json").write_text(json.dumps(
+        dict(good, value=10.0)))
+    res = check_latest_regression(str(tmp_path))
+    assert not res["ok"]
+    assert res["comparisons"][0]["prior_round"] == "BENCH_r01.json"
+
+
+def test_gate_skips_metrics_latest_stopped_recording(tmp_path):
+    """LATEST dropping a metric a prior round had is a skip (recorded with
+    the prior value in the reason), not a crash and not a silent pass."""
+    prior = {"metric": "m", "value": 100.0, "unit": "u",
+             "extra": {"serving_slo": {"goodput": 50.0}}}
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"n": 1, "cmd": "x", "rc": 0, "tail": json.dumps(prior)}))
+    (tmp_path / "BENCH_LATEST.json").write_text(json.dumps(
+        {"metric": "m", "value": 100.0, "unit": "u"}))
+    res = check_latest_regression(str(tmp_path))
+    assert res["ok"]
+    assert any(s["metric"] == "goodput" and "does not record" in s["reason"]
+               for s in res["skipped"])
+
+
+# ------------------------------------------------------- PERF.md rendering
+def test_history_block_in_perf_md_matches_artifacts():
+    """PERF.md's benchhistory block is generated, never hand-edited —
+    update_docs(write=False) returning False pins both the benchgen and
+    benchhistory blocks; here we additionally pin that PERF.md actually
+    carries the markers and the rendered rows."""
+    import os
+    text = open(os.path.join(repo_root(), "PERF.md")).read()
+    assert perf_docs.HIST_BEGIN in text and perf_docs.HIST_END in text
+    block = perf_docs.render_history_block()
+    assert block in text, (
+        "PERF.md benchhistory block drifted from the committed "
+        "BENCH_r0*.json artifacts — regenerate with: python -m "
+        "deeplearning4j_tpu.util.perf_docs --write")
+    # every committed round appears as a table row
+    for r in load_rounds():
+        tag = r["name"].replace("BENCH_", "").replace(".json", "")
+        assert f"| {tag} |" in block
+    assert "| **LATEST** |" in block
+    # the tolerance the gate enforces is the one the table discloses
+    assert f"{DEFAULT_TOLERANCE:.0%}" in block
+
+
+def test_readme_has_no_history_markers():
+    """The trend table lives in PERF.md only; inject_history must be a
+    no-op on marker-free docs (README)."""
+    import os
+    text = open(os.path.join(repo_root(), "README.md")).read()
+    assert perf_docs.HIST_BEGIN not in text
+    assert perf_docs.inject_history(text, "BLOCK") == text

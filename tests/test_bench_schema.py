@@ -66,6 +66,14 @@ def _minimal_art():
                 "tokens_per_sec_delta_frac": 0.5,
                 "host_syncs_per_token_on": 0.55,
                 "host_syncs_per_token_off": 1.02},
+            "kv_observatory": {
+                "platform": "cpu", "conserved_every_step": True,
+                "sync_parity": True, "rejections": 2,
+                "example_rejection": {"blocks_needed": 5, "blocks_free": 2,
+                                      "blocks_reclaimable": 8,
+                                      "shortfall_blocks": 3},
+                "dry_run": [{"policy": "lru", "blocks_freed": 3,
+                             "satisfies": True}]},
             "roofline_table": [
                 {"function": "train_step", "platform": "tpu",
                  "flops": 1e12, "bytes_accessed": 1e9,
@@ -277,6 +285,41 @@ def test_spec_decode_ab_rules():
     assert validate_artifact(art) == []
     art["extra"]["serving_spec_decode"] = {"platform": "cpu",
                                            "skipped_reason": "why not"}
+    assert validate_artifact(art) == []
+
+
+def test_kv_observatory_rules():
+    """ISSUE 12: the forced-exhaustion pressure run must always exist; a
+    measured entry must prove the two in-bench assertions held
+    (conserved_every_step, sync_parity), record >= 1 rejection with its
+    requested-vs-free-vs-reclaimable forensics, and carry a well-formed
+    dry-run row per policy; errored/skipped entries are exempt."""
+    art = _minimal_art()
+    del art["extra"]["kv_observatory"]
+    assert any("kv_observatory" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_observatory"]["conserved_every_step"] = False
+    assert any("conserved_every_step" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_observatory"]["sync_parity"] = False
+    assert any("sync_parity" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_observatory"]["rejections"] = 0
+    assert any("rejections" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["kv_observatory"]["example_rejection"]["shortfall_blocks"]
+    assert any("example_rejection" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_observatory"]["dry_run"] = []
+    assert any("dry_run" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_observatory"]["dry_run"][0]["satisfies"] = "yes"
+    assert any("dry_run[0]" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_observatory"] = {"error": "ValueError: boom"}
+    assert validate_artifact(art) == []
+    art["extra"]["kv_observatory"] = {"platform": "cpu",
+                                      "skipped_reason": "why not"}
     assert validate_artifact(art) == []
 
 

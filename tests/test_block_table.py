@@ -13,7 +13,9 @@ import pytest
 import jax.numpy as jnp
 
 from deeplearning4j_tpu.serving.block_table import (BlockAllocator,
-                                                    PrefixRegistry)
+                                                    PrefixRegistry,
+                                                    _block_digest)
+from deeplearning4j_tpu.telemetry.kv_observatory import attribute_pool
 from deeplearning4j_tpu.serving import kv_cache
 from deeplearning4j_tpu.serving.kv_cache import KVCache
 
@@ -150,6 +152,152 @@ def test_randomized_alloc_free_fork_stress():
         c.free(0)
     # the run must actually have exercised sharing and COW
     assert c.shared_blocks_total > 0 and c.cow_copies_total > 0
+
+
+def test_heat_attribution_reference_simulator_stress():
+    """KV observatory bookkeeping vs a pure-Python reference simulator
+    (ISSUE 12 satellite). Interleaved tick/admit/touch/ensure_writable/
+    free ops; after EVERY op the cache's heat stamps (last_touch,
+    alloc_epoch), owner attribution (sharer sets), and sharing lineage
+    (first-claim chain digests) must match the simulator EXACTLY, and the
+    byte partition from attribute_pool must conserve the pool. The
+    simulator derives expected stamps from structural diffs of the
+    slot->blocks mapping: a newly resident block gets alloc_epoch =
+    last_touch = clock, a new mapping of a resident block (prefix-share
+    incref) refreshes last_touch only, an explicit touch refreshes
+    last_touch on exactly the covered blocks, and anything else leaves
+    stamps frozen — so a COW swap restamps only the private copy and a
+    trash-routed write (no mapping change, no touch) changes nothing."""
+    rng = random.Random(4321)
+    bs = 4
+    c = KVCache(n_layers=1, max_seqs=8, max_len=64, n_kv_heads=1,
+                head_dim=2, dtype=jnp.float32, block_size=bs,
+                num_blocks=40, prefix_share=True)
+    families = [[rng.randrange(50) for _ in range(14)] for _ in range(3)]
+    live = {}                        # slot -> prompt tokens
+    reserved = {}                    # slot -> reserved positions
+    sim_touch, sim_epoch = {}, {}    # block -> expected stamp
+    sim_index = {}                   # digest bytes -> claiming block
+    sim_claims = {}                  # block -> [digest, ...] (first = lineage)
+    prev_counts = Counter()
+
+    def sim_register(tokens, row):
+        h = None
+        n_full = len(tokens) // bs
+        for i in range(n_full):
+            h = _block_digest(h, tokens[i * bs:(i + 1) * bs])
+            d = h.digest()
+            if d not in sim_index:                 # first registration wins
+                sim_index[d] = row[i]
+                sim_claims.setdefault(row[i], []).append(d)
+        tail = tokens[n_full * bs:]
+        if tail:
+            d = _block_digest(h, tail, tail=True).digest()
+            if d not in sim_index:
+                sim_index[d] = row[n_full]
+                sim_claims.setdefault(row[n_full], []).append(d)
+
+    def after_op(touched=()):
+        clock = c.allocator.clock
+        rows = {s: list(b) for s, b in c._slot_blocks.items()}
+        counts = Counter(b for r in rows.values() for b in r)
+        for b in set(counts) | set(prev_counts):
+            was, now = prev_counts.get(b, 0), counts.get(b, 0)
+            if was == 0 and now > 0:               # fresh residency
+                sim_epoch[b] = sim_touch[b] = clock
+            elif now > was:                        # extra mapping = incref
+                sim_touch[b] = clock
+            elif now == 0 and was > 0:             # freed -> stamps void
+                sim_touch.pop(b, None)
+                sim_epoch.pop(b, None)
+                for d in sim_claims.pop(b, ()):    # registry forget
+                    if sim_index.get(d) == b:
+                        del sim_index[d]
+        for b in touched:
+            sim_touch[b] = clock
+        prev_counts.clear()
+        prev_counts.update(counts)
+        # --- the cache must agree with the simulator, block by block
+        for b, cnt in counts.items():
+            assert c.allocator.last_touch(b) == sim_touch[b]
+            assert c.allocator.alloc_epoch(b) == sim_epoch[b]
+            owners = {s for s, r in rows.items() if b in r}
+            assert c.sharers(b) == owners
+            assert c.allocator.refcount(b) == cnt == len(owners)
+            assert c.registry.lineage(b) == (
+                sim_claims[b][0].hex() if b in sim_claims else None)
+        assert set(c._block_sharers) == set(counts)
+        # --- and the byte partition must conserve the pool
+        lp = {s: rng.randrange(0, reserved[s] + 1) for s in rows}
+        att = attribute_pool(c.pool_snapshot(live_positions=lp))
+        assert att["conserved"], att
+
+    for _ in range(400):
+        c.allocator.tick()
+        r = rng.random()
+        if r < 0.45 or not live:
+            fam = rng.choice(families)
+            cut = rng.randrange(4, len(fam) + 1)
+            tokens = fam[:cut] + [rng.randrange(50)
+                                  for _ in range(rng.randrange(0, 3))]
+            n_pos = min(c.max_len, len(tokens) + rng.randrange(1, 9))
+            plan = c.admit("o", n_positions=n_pos, prompt=tokens)
+            if plan is None:
+                after_op()
+                continue
+            c.register_prefix(plan.slot, tokens)
+            sim_register(tokens, c._slot_blocks[plan.slot])
+            live[plan.slot] = tokens
+            reserved[plan.slot] = n_pos
+            after_op()
+        elif r < 0.65:
+            slot = rng.choice(sorted(live))
+            start = rng.randrange(0, reserved[slot])
+            end = min(reserved[slot], start + rng.randrange(1, 2 * bs))
+            c.touch_blocks(slot, start, end)
+            row = c._slot_blocks[slot]
+            after_op(touched=[row[li] for li in
+                              range(start // bs,
+                                    min(len(row), -(-end // bs)))])
+        elif r < 0.8:
+            slot = rng.choice(sorted(live))
+            start = rng.randrange(0, len(live[slot]) + 1)
+            c.ensure_writable(slot, start, start + rng.randrange(1, 4))
+            after_op()
+        else:
+            slot = rng.choice(sorted(live))
+            del live[slot], reserved[slot]
+            c.free(slot)
+            after_op()
+
+    assert c.allocator.clock == 400                # one tick per iteration
+    assert c.shared_blocks_total > 0 and c.cow_copies_total > 0
+    for slot in sorted(live):
+        c.free(slot)
+        after_op()
+    assert not c._block_sharers and not sim_index and not sim_claims
+    assert c.blocks_free == c.num_blocks
+
+
+def test_allocator_heat_stamps_unit():
+    """tick/touch/alloc/incref stamp semantics on the bare allocator."""
+    a = BlockAllocator(4)
+    assert a.tick() == 1 and a.tick() == 2
+    b = a.alloc()
+    assert a.alloc_epoch(b) == a.last_touch(b) == 2
+    a.tick()
+    a.incref(b)                                    # new mapping = a touch
+    assert a.last_touch(b) == 3 and a.alloc_epoch(b) == 2
+    a.tick()
+    a.touch(b)
+    assert a.last_touch(b) == 4
+    a.decref(b)
+    a.decref(b)
+    with pytest.raises(ValueError):
+        a.touch(b)                                 # stamps need residency
+    a.tick()
+    b2 = a.alloc()                                 # heap reuse restamps
+    assert b2 == b and a.alloc_epoch(b2) == a.last_touch(b2) == 5
 
 
 def test_copy_on_reject_never_mutates_shared_blocks():

@@ -1,0 +1,322 @@
+"""KV-pressure observatory tests (ISSUE 12).
+
+The load-bearing guarantees:
+
+- SYNC DISCIPLINE: enabling the observatory changes NOTHING the device
+  sees — tokens and the counted host-sync stream are bit-identical
+  observatory-on vs observatory-off at K in {1, 8} (the module consumes
+  only host bookkeeping; the source scan in test_sync_discipline.py pins
+  the same promise statically).
+- CONSERVATION: free + shared + private-live + waste(tail) +
+  waste(reserved) == pool bytes after EVERY scheduler step, under
+  chunked prefill + prefix sharing + COW and under speculative decode
+  with rollback (the randomized cache-level version lives in
+  test_block_table.py's reference-simulator stress).
+- DRY-RUN SCORER: every policy emits ranked candidates with marginal
+  (refcount-simulated) reclaim and recompute-vs-swap costs; rankings
+  follow the policy's score.
+- FORENSICS: an admission rejection is recorded once per request with
+  requested vs free vs reclaimable-if-evicted and the dry-run verdicts.
+"""
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.serving.engine import Request, ServingEngine
+from deeplearning4j_tpu.serving.kv_cache import KVCache
+from deeplearning4j_tpu.serving.sharding import GROUP_SUMMED_KEYS
+from deeplearning4j_tpu.telemetry import MetricsRegistry
+from deeplearning4j_tpu.telemetry.kv_observatory import (
+    DEFAULT_POLICIES, KVObservatory, attribute_pool, candidate_costs,
+    dry_run, eviction_candidates)
+
+from tests.test_serving import _build_net
+
+COMMON = [5, 6, 7, 8, 9, 10, 11, 12]        # two full 4-position blocks
+PROMPTS = [COMMON + [1, 2], COMMON + [1, 2], COMMON + [3], [4, 3, 2, 1]]
+REPETITIVE = [1, 2, 3, 4, 1, 2, 3, 4, 1, 2, 3, 4, 1, 2]
+
+
+def _run(net, prompts, obs, chunk=1, **kw):
+    eng = ServingEngine(net, max_seqs=4, max_len=64, seed=3,
+                        decode_chunk=chunk, overlap=False, kv_block=4,
+                        prefix_share=True, kv_observatory=obs, **kw)
+    res = eng.generate([Request(list(p), max_new_tokens=7)
+                        for p in prompts])
+    return res, eng
+
+
+# ------------------------------------------------------ sync bit-parity
+@pytest.mark.parametrize("chunk", [1, 8])
+def test_host_sync_bit_parity_observatory_on_off(chunk):
+    """The acceptance bar: the observatory adds ZERO device syncs — same
+    tokens, same host_syncs, same ratio, at K in {1, 8}, over a workload
+    that exercises prefix sharing and COW."""
+    net = _build_net(n_kv=2)
+    off, e_off = _run(net, PROMPTS, obs=False, chunk=chunk)
+    on, e_on = _run(net, PROMPTS, obs=True, chunk=chunk)
+    assert [r.tokens for r in on] == [r.tokens for r in off]
+    s_on, s_off = e_on.stats(), e_off.stats()
+    assert s_on["host_syncs"] == s_off["host_syncs"]
+    assert s_on["tokens_out"] == s_off["tokens_out"]
+    assert s_on["host_syncs_per_token"] == s_off["host_syncs_per_token"]
+    # and the observatory actually ran: gauges were published
+    txt = e_on.metrics.prometheus_text()
+    assert "serving_kv_bytes_free" in txt
+    assert "serving_kv_heat_decile_9" in txt
+    assert "serving_kv_block_age_iters" in txt
+
+
+# -------------------------------------------------------- conservation
+def _assert_conserved(eng):
+    att = attribute_pool(eng.kv_pool_snapshot())
+    assert att["conserved"], att
+    return att
+
+
+def test_conservation_every_step_chunked_prefill_shared():
+    """The byte partition holds after EVERY scheduler iteration while
+    chunked prefill interleaves with decode, sharers are admitted
+    mid-stream (COW fork), and retirements free blocks."""
+    net = _build_net(n_kv=2)
+    eng = ServingEngine(net, max_seqs=4, max_len=64, seed=3,
+                        decode_chunk=1, overlap=False, kv_block=4,
+                        prefix_share=True, prefill_chunk=4,
+                        kv_observatory=True)
+    long = list(range(1, 14))
+    futs = [eng.submit(Request(long, max_new_tokens=6))]
+    saw_shared = False
+    for i in range(40):
+        busy = eng.step()
+        att = _assert_conserved(eng)
+        saw_shared = saw_shared or att["shared_bytes"] > 0
+        if i == 4:       # donor's 4 prefill chunks are done and registered;
+            # mid-stream sharers COW-fork its tail block while it decodes
+            futs.append(eng.submit(Request(long[:8] + [7], max_new_tokens=6)))
+            futs.append(eng.submit(Request(list(long), max_new_tokens=6)))
+        if not busy and i > 3:
+            break
+    eng.drain()
+    _assert_conserved(eng)
+    assert saw_shared
+    for f in futs:
+        assert f.get(timeout=0).finish_reason == "length"
+    # attribution on the results: reservation >= live >= 0
+    for f in futs:
+        r = f.get(timeout=0)
+        assert r.kv_bytes_reserved >= r.kv_bytes_live > 0
+    # drained pool: everything is free again
+    att = _assert_conserved(eng)
+    assert att["free_bytes"] == att["pool_bytes"]
+
+
+def test_conservation_every_step_spec_decode():
+    """Same invariant under speculative decode: accepted drafts commit
+    multi-token touches, rejected drafts roll back through copy-on-reject
+    — the partition must never drift."""
+    net = _build_net(n_kv=2)
+    eng = ServingEngine(net, max_seqs=2, max_len=96, seed=3,
+                        decode_chunk=1, overlap=False, spec_decode=True,
+                        prefix_share=True, kv_block=4,
+                        kv_observatory=True)
+    fut = eng.submit(Request(REPETITIVE, max_new_tokens=16))
+    while eng.step():
+        _assert_conserved(eng)
+    assert fut.get(timeout=0).finish_reason == "length"
+    assert eng.stats()["spec_tokens_accepted"] > 0
+    att = _assert_conserved(eng)
+    assert att["free_bytes"] == att["pool_bytes"]
+
+
+# ------------------------------------------------------ dry-run scorer
+def _pressure_cache():
+    """A cache with three residents: a cold private one, a hot private
+    one, and a sharer pair over a common prefix — enough structure for
+    the three policies to disagree."""
+    c = KVCache(n_layers=1, max_seqs=4, max_len=32, n_kv_heads=1,
+                head_dim=2, dtype=jnp.float32, block_size=4,
+                num_blocks=16, prefix_share=True)
+    common = list(range(100, 108))               # two full blocks
+
+    class Owner:
+        def __init__(self, req_id, deadline=None, t_submit=0.0):
+            self.req_id, self.deadline, self.t_submit = \
+                req_id, deadline, t_submit
+
+    c.allocator.tick()
+    cold = c.admit(Owner(0, deadline=9e9), n_positions=12,
+                   prompt=[1, 2, 3, 4, 5])
+    donor = c.admit(Owner(1, deadline=5.0), n_positions=12, prompt=common)
+    c.register_prefix(donor.slot, common)
+    sharer = c.admit(Owner(2), n_positions=12, prompt=common)
+    assert sharer.n_shared_blocks >= 1
+    for _ in range(5):
+        c.allocator.tick()
+    c.touch_blocks(donor.slot, 8, 12)            # donor is the hottest
+    live = {cold.slot: 5, donor.slot: 10, sharer.slot: 9}
+    return c, c.pool_snapshot(live_positions=live), cold, donor, sharer
+
+
+def test_dry_run_ranked_candidates_and_marginal_reclaim():
+    c, snap, cold, donor, sharer = _pressure_cache()
+    results = dry_run(snap, needed_blocks=3, now=100.0,
+                      flops_per_token=1e6)
+    assert {r["policy"] for r in results} == set(DEFAULT_POLICIES)
+    for r in results:
+        assert r["satisfies"] and r["blocks_freed"] >= 3
+        assert r["evicted"], r
+        scores = [e["score"] for e in r["evicted"]]
+        assert scores == sorted(scores, reverse=True)   # ranked
+        for e in r["evicted"]:
+            assert e["swap_bytes"] == e["live_positions"] * 2 * 1 * 2 * 4
+            assert e["recompute_flops"] == e["live_positions"] * 1e6
+            assert e["cheaper"] in ("recompute", "swap")
+            assert e["swap_est_s"] > 0 and e["recompute_est_s"] > 0
+        assert r["bytes_freed"] == r["blocks_freed"] * 4 * 16
+    lru = next(r for r in results if r["policy"] == "lru")
+    # the cold request (stamped at clock 1, never touched since) must be
+    # the first LRU victim; the donor (touched at clock 6) the last
+    assert lru["evicted"][0]["slot"] == cold.slot
+    slo = next(r for r in results if r["policy"] == "slo_deadline")
+    # no-deadline sharer is the safest victim, tight-deadline donor last
+    assert slo["evicted"][0]["slot"] == sharer.slot
+
+
+def test_dry_run_shared_blocks_free_only_with_last_sharer():
+    """Marginal-reclaim accounting: evicting ONE sharer of a 2-way shared
+    prefix frees only its private blocks; the shared blocks count when
+    the second sharer goes. The static per-candidate `blocks_freed`
+    (refcount-1 blocks) underestimates exactly this."""
+    c, snap, cold, donor, sharer = _pressure_cache()
+    static = {cand["slot"]: cand["blocks_freed"]
+              for cand in eviction_candidates(snap)}
+    n_mapped = 16 - int(snap["blocks_free"])
+    n_shared = n_mapped - sum(static.values())   # refcount>=2 blocks
+    assert n_shared >= 1
+    # evict-everything run: total reclaim must cover the shared blocks too
+    results = dry_run(snap, needed_blocks=10 ** 6)
+    r = results[0]
+    assert not r["satisfies"]
+    assert r["blocks_freed"] == n_mapped
+    by_slot = {e["slot"]: e for e in r["evicted"]}
+    order = [e["slot"] for e in r["evicted"]]
+    d_i, s_i = order.index(donor.slot), order.index(sharer.slot)
+    later = by_slot[order[max(d_i, s_i)]]
+    earlier = by_slot[order[min(d_i, s_i)]]
+    # the LATER of the pair reclaims its static count PLUS the shared
+    # prefix blocks; the earlier one reclaims only its static count
+    assert earlier["blocks_freed"] == static[earlier["slot"]]
+    assert later["blocks_freed"] == static[later["slot"]] + n_shared
+
+
+def test_candidate_costs_crossover():
+    cand = {"swap_bytes": 1000, "recompute_tokens": 10, "live_positions": 10}
+    cheap_compute = candidate_costs(cand, flops_per_token=1.0,
+                                    swap_bytes_per_sec=1.0,
+                                    flops_per_sec=1e12)
+    assert cheap_compute["cheaper"] == "recompute"
+    cheap_swap = candidate_costs(cand, flops_per_token=1e12,
+                                 swap_bytes_per_sec=1e12, flops_per_sec=1.0)
+    assert cheap_swap["cheaper"] == "swap"
+
+
+# -------------------------------------------------- rejection forensics
+def test_rejection_forensics_on_tiny_pool():
+    """Overload a tiny pool: the first admission failure per request is
+    recorded with requested vs free vs reclaimable-if-evicted and the
+    dry-run verdicts; every request still completes once blocks free."""
+    net = _build_net(n_kv=2)
+    eng = ServingEngine(net, max_seqs=4, max_len=64, seed=3,
+                        decode_chunk=1, overlap=False, kv_block=4,
+                        kv_blocks=8, prefix_share=False,
+                        kv_observatory=True)
+    prompts = [[11, 12, 13, 14, 15, 16, 17, 18, 19, 21],
+               [21, 22, 23, 24, 25, 26, 27, 28, 29, 31],
+               [31, 32, 33, 34, 35, 36, 37, 38, 39, 41]]
+    res = eng.generate([Request(p, max_new_tokens=6) for p in prompts])
+    assert all(r.finish_reason == "length" for r in res)
+    obs = eng.kv_observatory
+    recs = obs.rejections()
+    assert recs and obs.n_rejections == len(recs)
+    assert eng.stats()["kv_rejections"] == len(recs)
+    assert sum(r.admission_retries > 0 for r in res) >= len(recs)
+    for rec in recs:
+        assert rec["retries"] == 1               # first rejection only
+        assert rec["blocks_needed"] > rec["blocks_free"]
+        assert rec["shortfall_blocks"] > 0
+        assert rec["blocks_reclaimable"] + rec["blocks_free"] == 8
+        assert rec["bytes_needed"] == rec["blocks_needed"] * 4 * \
+            eng._kv_bytes_per_pos
+        verdicts = rec["dry_run"]
+        assert {v["policy"] for v in verdicts} == set(DEFAULT_POLICIES)
+        for v in verdicts:
+            assert v["needed_blocks"] == rec["shortfall_blocks"]
+            assert v["satisfies"] and v["evicted"]
+            assert v["blocks_freed"] >= v["needed_blocks"]
+    assert "serving_kv_rejections" in eng.metrics.prometheus_text()
+
+
+def test_forensics_ring_is_bounded():
+    obs = KVObservatory(MetricsRegistry(), capacity=3)
+    c = KVCache(n_layers=1, max_seqs=2, max_len=16, n_kv_heads=1,
+                head_dim=2, dtype=jnp.float32, block_size=4, num_blocks=4)
+    c.admit("o", n_positions=8, prompt=[1, 2, 3])
+    snap = c.pool_snapshot()
+    for i in range(7):
+        obs.on_rejection(snap, req_id=i, prompt_len=9, max_new_tokens=4,
+                         blocks_needed=4, queue_depth=1, retries=1)
+    recs = obs.rejections()
+    assert len(recs) == 3 and obs.n_rejections == 7     # ring bounded
+    assert [r["req_id"] for r in recs] == [4, 5, 6]     # oldest dropped
+
+
+# ------------------------------------------------------- heat metrics
+def test_observe_heat_deciles_partition_mapped_blocks():
+    c, snap, cold, donor, sharer = _pressure_cache()
+    m = MetricsRegistry()
+    obs = KVObservatory(m)
+    att = obs.observe(snap)
+    assert att["conserved"]
+    n_mapped = 16 - int(snap["blocks_free"])
+    deciles = [m.gauge(f"serving.kv.heat_decile_{d}").value
+               for d in range(10)]
+    assert sum(deciles) == n_mapped              # every mapped block binned
+    assert deciles[9] > 0 and deciles[0] > 0     # hot and cold both present
+    # shared lineage gauge: the donor/sharer pair backs >= 1 chain
+    assert m.gauge("serving.kv.shared_lineages").value >= 1
+    assert att["shared_by_lineage"]
+    assert all(not k.startswith("<") for k in att["shared_by_lineage"])
+
+
+def test_attribution_per_slot_and_lineage_keys():
+    c, snap, cold, donor, sharer = _pressure_cache()
+    att = attribute_pool(snap)
+    assert att["conserved"]
+    per = att["per_slot"]
+    assert per[cold.slot]["req_id"] == 0
+    assert per[donor.slot]["req_id"] == 1
+    # live=5 of a 12-position reservation: 3 blocks -> 5 live positions,
+    # 3 tail-waste in block 1, 1 whole reserved block
+    assert per[cold.slot]["private_live_bytes"] == 5 * 16
+    assert per[cold.slot]["waste_bytes"] == 3 * 16 + 4 * 16
+    # the sharer maps the donor's FIRST common block shared; the block
+    # holding the resume position (shared_len - 1) is a COW copy, so
+    # exactly one block stays refcount-2
+    assert per[donor.slot]["shared_bytes"] == \
+        per[sharer.slot]["shared_bytes"] == 1 * 4 * 16
+    assert att["shared_bytes"] == 1 * 4 * 16     # counted ONCE pool-wide
+
+
+# ----------------------------------------------- fleet aggregation keys
+def test_group_summed_keys_all_exist_in_engine_stats():
+    """Regression for the PR 11 gap: the group aggregation list must
+    carry the spec-decode counters, and every key it names must exist in
+    a single engine's stats() so the fleet sums are never silently 0."""
+    assert {"spec_tokens_accepted", "spec_tokens_rejected",
+            "kv_blocks_shared", "kv_rejections",
+            "admission_retries"} <= set(GROUP_SUMMED_KEYS)
+    net = _build_net()
+    s = ServingEngine(net, max_seqs=2, max_len=32).stats()
+    missing = [k for k in GROUP_SUMMED_KEYS if k not in s]
+    assert not missing, missing

@@ -202,6 +202,35 @@ def test_group_token_parity(forced_host_devices, replicas, tp):
     assert st["tokens_out"] == sum(len(t) for t in _tokens(ref))
 
 
+def test_group_stats_aggregates_pinned_keys(forced_host_devices):
+    """Regression for the PR 11 spec-counter gap: the fleet view must sum
+    every GROUP_SUMMED_KEYS entry — in particular the spec-decode
+    counters — and recompute the derived ratios from the SUMS. The fleet
+    KV snapshot's byte partition must conserve every replica's pool."""
+    from deeplearning4j_tpu.serving.sharding import GROUP_SUMMED_KEYS
+    net = _build_net(n_kv=2)
+    grp = ShardedServingGroup(net, 4, 64, dtype="float64",
+                              replicas=2, tp=1, spec_decode=True)
+    rep = [1, 2, 3, 4, 1, 2, 3, 4, 1, 2, 3, 4, 1, 2]
+    grp.generate([rep, [5, 4, 3], list(rep)], max_new_tokens=10)
+    st = grp.stats()
+    missing = [k for k in GROUP_SUMMED_KEYS if k not in st]
+    assert not missing, missing
+    per = st["per_replica"]
+    for key in GROUP_SUMMED_KEYS:
+        assert st[key] == sum(s[key] for s in per), key
+    assert st["spec_tokens_accepted"] > 0     # spec actually engaged
+    acc, rej = st["spec_tokens_accepted"], st["spec_tokens_rejected"]
+    assert st["spec_accept_rate"] == acc / max(1, acc + rej)
+    fleet = grp.kv_fleet_snapshot()
+    assert fleet["conserved"]
+    assert len(fleet["per_replica"]) == 2
+    assert fleet["pool_bytes"] == fleet["free_bytes"]   # all retired
+    assert 0.0 <= st["kv_used_imbalance"] <= 1.0
+    assert 0.0 <= fleet["imbalance"] <= 1.0
+    assert "serving_kv_fleet_bytes_free" in grp.metrics.prometheus_text()
+
+
 def test_group_prefix_hit_rate_parity(forced_host_devices):
     """Identical prompts submitted upfront to a 2-replica group land on
     ONE replica (cohort routing seeds the registry the rest hit), so the

@@ -113,7 +113,8 @@ def test_all_hot_path_modules_exist():
     assert {"health.py", "profiler.py", "memory.py", "tracing.py",
             "registry.py", "training.py", "kv_cache.py",
             "block_table.py", "slo.py", "flight_recorder.py",
-            "loadgen.py", "sharding.py", "spec.py"} <= names
+            "loadgen.py", "sharding.py", "spec.py",
+            "kv_observatory.py"} <= names
 
 
 # ------------------------------------------------ scanner self-tests
