@@ -12,6 +12,11 @@ from deeplearning4j_tpu.serving.decode import (StackDecoder, decode_attention,
 from deeplearning4j_tpu.serving.engine import (GenerationResult, Request,
                                                ServingEngine)
 from deeplearning4j_tpu.serving.kv_cache import KVCache, init_cache_state
+from deeplearning4j_tpu.serving.lifecycle import (HostBlockPool,
+                                                  KVLifecycleManager,
+                                                  PersistentPrefixStore,
+                                                  resolve_lifecycle,
+                                                  resolve_prefix_store)
 from deeplearning4j_tpu.serving.loadgen import (LoadResult, LoadSpec,
                                                 RequestOutcome,
                                                 ScheduledRequest,
@@ -34,6 +39,8 @@ from deeplearning4j_tpu.serving.spec import (NgramDraftIndex,
 
 __all__ = [
     "KVCache", "init_cache_state", "BlockAllocator", "PrefixRegistry",
+    "HostBlockPool", "KVLifecycleManager", "PersistentPrefixStore",
+    "resolve_lifecycle", "resolve_prefix_store",
     "StackDecoder", "decode_attention", "decode_attention_paged",
     "decode_attention_spec_paged",
     "one_hot_embedder", "ServingEngine", "Request", "GenerationResult",

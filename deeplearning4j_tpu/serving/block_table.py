@@ -158,6 +158,23 @@ def _block_digest(prev: Optional["hashlib._Hash"], tokens: Sequence[int],
     return h
 
 
+def chain_digests(tokens: Sequence[int], block_size: int) -> List[bytes]:
+    """Chain digests of every FULL block of `tokens` — digest i commits
+    to tokens [0, (i+1)*block_size), exactly the keys `PrefixRegistry`
+    files full blocks under. The `PersistentPrefixStore`
+    (serving/lifecycle.py) uses these as content addresses for host-side
+    KV block bytes: a digest hit certifies the whole covered prefix, the
+    same safety certificate the resident registry gives, so restored
+    bytes can be mapped without re-running prefill."""
+    bs = int(block_size)
+    out: List[bytes] = []
+    h = None
+    for i in range(len(tokens) // bs):
+        h = _block_digest(h, tokens[i * bs:(i + 1) * bs])
+        out.append(h.digest())
+    return out
+
+
 class PrefixRegistry:
     """Content-addressed index of resident prompt KV blocks.
 

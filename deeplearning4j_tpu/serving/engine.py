@@ -108,8 +108,12 @@ import numpy as np
 from deeplearning4j_tpu import telemetry
 from deeplearning4j_tpu.telemetry import memory as _tmemory
 from deeplearning4j_tpu.telemetry import profiler as _profiler
+from deeplearning4j_tpu.serving import kv_cache as _kvc
 from deeplearning4j_tpu.serving import spec as spec_mod
+from deeplearning4j_tpu.serving.block_table import chain_digests
 from deeplearning4j_tpu.serving.decode import StackDecoder, one_hot_embedder
+from deeplearning4j_tpu.serving.lifecycle import (resolve_lifecycle,
+                                                  resolve_prefix_store)
 from deeplearning4j_tpu.serving.sampler import (Sampler, sample_tokens,
                                                 spec_accept_tokens)
 
@@ -222,6 +226,16 @@ class _Active:
     prefilled: int = 0
     shared_len: int = 0
     n_chunks: int = 0                 # prefill chunks executed so far
+    # KV lifecycle (ISSUE 13): set while the request sits requeued after
+    # a preemption — {"mode": "recompute"|"swap", "tokens": generated-so-
+    # far ids, "t_requeue": monotonic, and for swap the stashed block
+    # count/live length}. Cleared when the resume completes.
+    resume: Optional[dict] = None
+    preemptions: int = 0              # times this request was evicted
+    # first-rejection forensics held until the "queue" timeline event
+    # exists, so the Perfetto instant lands INSIDE the queue span and
+    # timeline[0] stays "queue"
+    kv_rejection: Optional[dict] = None
 
 
 def _build_step(decoder: StackDecoder, embed: Callable, top_k: int,
@@ -393,7 +407,11 @@ class ServingEngine:
                  metrics_parent=None,
                  spec_decode: Optional[bool] = None,
                  spec_draft: Optional[int] = None,
-                 kv_observatory=None):
+                 kv_observatory=None,
+                 kv_evict=None,
+                 kv_swap_bytes: Optional[int] = None,
+                 kv_evict_mode: str = "auto",
+                 prefix_store=None):
         self.decoder = self._build_decoder(net, max_seqs, max_len,
                                            dtype=dtype,
                                            block_size=kv_block,
@@ -607,20 +625,67 @@ class ServingEngine:
         if kv_observatory is None:
             kv_observatory = os.environ.get("DL4J_TPU_KV_OBS", "") \
                 not in ("", "0")
+        # recompute cost unit for the eviction scorers: ~2*params FLOPs
+        # per token (param counts are host shape metadata, no device read)
+        n_params = sum(int(np.size(x)) for x in
+                       jax.tree_util.tree_leaves(self.decoder.params))
         if isinstance(kv_observatory, bool):
             obs = None
             if kv_observatory:
                 from deeplearning4j_tpu.telemetry.kv_observatory import \
                     KVObservatory
-                # recompute cost unit for the dry-run scorer: ~2*params
-                # FLOPs per token (param counts are host shape metadata)
-                n_params = sum(int(np.size(x)) for x in
-                               jax.tree_util.tree_leaves(self.decoder.params))
                 obs = KVObservatory(self.metrics,
                                     flops_per_token=2.0 * n_params)
         else:
             obs = kv_observatory
         self.kv_observatory = obs
+        # KV lifecycle manager (ISSUE 13): REAL eviction/preemption when
+        # admission fails under block pressure, selecting victims with
+        # the same plan_eviction the observatory's dry-run forensics log.
+        # Disabled by default (kv_evict=None and no DL4J_TPU_KV_EVICT):
+        # disabled means NO manager and no code on any scheduler path, so
+        # the no-pressure sync sequence is bit-identical (parity-tested).
+        self.lifecycle = resolve_lifecycle(kv_evict, kv_swap_bytes,
+                                           kv_evict_mode,
+                                           flops_per_token=2.0 * n_params)
+        # persistent prefix store (ISSUE 13): content-addressed host KV
+        # block bytes keyed by the registry's chain digests — survives
+        # restarts (npz spill) and spans ShardedServingGroup replicas
+        # (one instance handed to every engine).
+        self.prefix_store = resolve_prefix_store(prefix_store)
+        if self.prefix_store is not None:
+            expect = (cache.n_layers, cache.block_size, cache.n_kv_heads,
+                      cache.head_dim)
+            if self.prefix_store.block_shape is None:
+                self.prefix_store.block_shape = expect
+            elif self.prefix_store.block_shape != expect:
+                # a spill file from another model geometry: ignore it
+                # rather than restore garbage bytes
+                self.prefix_store = None
+        self._c_evict_rec = self.metrics.counter(
+            "serving.kv.evictions_recompute", "preemptions reclaimed by "
+            "freeing blocks and replaying prefill at readmission")
+        self._c_evict_swap = self.metrics.counter(
+            "serving.kv.evictions_swap", "preemptions reclaimed by "
+            "migrating block bytes to the host pool")
+        self._c_preempt = self.metrics.counter(
+            "serving.kv.preemptions", "resident requests preempted for a "
+            "rejected admission (recompute + swap)")
+        self._c_swap_out = self.metrics.counter(
+            "serving.kv.swap_out_bytes", "KV bytes migrated device->host "
+            "at eviction")
+        self._c_swap_in = self.metrics.counter(
+            "serving.kv.swap_in_bytes", "KV bytes restored host->device "
+            "at reactivation")
+        self._g_host_pool = self.metrics.gauge(
+            "serving.kv.host_pool_bytes", "host-RAM bytes currently held "
+            "by swapped-out KV blocks")
+        self._c_pstore_hits = self.metrics.counter(
+            "serving.prefix_store_hits", "admissions that restored prefix "
+            "blocks from the persistent store past the resident registry")
+        self._c_pstore_tokens = self.metrics.counter(
+            "serving.prefix_store_tokens", "prompt positions restored from "
+            "the persistent prefix store (prefill compute skipped)")
         _tmemory.poll("serving.engine_init", registry=self.metrics)
 
     # ----------------------------------------------- sharding seams (ISSUE 10)
@@ -689,7 +754,17 @@ class ServingEngine:
                     "spec_tokens_accepted": self._c_spec_acc.value,
                     "spec_tokens_rejected": self._c_spec_rej.value,
                     "spec_accept_rate": self._c_spec_acc.value / max(
-                        1, self._c_spec_acc.value + self._c_spec_rej.value)}
+                        1, self._c_spec_acc.value + self._c_spec_rej.value),
+                    "kv_evictions_recompute": self._c_evict_rec.value,
+                    "kv_evictions_swap": self._c_evict_swap.value,
+                    "kv_preemptions": self._c_preempt.value,
+                    "kv_swap_out_bytes": self._c_swap_out.value,
+                    "kv_swap_in_bytes": self._c_swap_in.value,
+                    "kv_host_pool_bytes": (
+                        self.lifecycle.host_pool.bytes_used
+                        if self.lifecycle is not None else 0),
+                    "prefix_store_hits": self._c_pstore_hits.value,
+                    "prefix_store_tokens": self._c_pstore_tokens.value}
 
     def kv_pool_snapshot(self, include_blocks: bool = True
                          ) -> Dict[str, object]:
@@ -749,16 +824,26 @@ class ServingEngine:
         blocks run short we keep FIFO order and retry next iteration (a
         retirement frees blocks). Called with the lock held."""
         cache = self.decoder.cache
+        evicted_for: set = set()       # one eviction round per request/call
         while self._queue:
             act = self._queue[0]
             if act.deadline is not None and time.monotonic() > act.deadline:
                 self._queue.pop(0)
                 now = time.monotonic()
+                # a preempted request that times out while requeued still
+                # returns the tokens it had generated before eviction
+                toks_out = [int(t) for t in act.resume["tokens"]] \
+                    if act.resume is not None else []
                 act.timeline.append({"phase": "queue", "t0": act.t_submit,
                                      "t1": now, "retries": act.retries})
+                if act.kv_rejection is not None:
+                    act.timeline.append(act.kv_rejection)
+                    act.kv_rejection = None
                 act.timeline.append({"phase": "retire", "t0": now, "t1": now,
-                                     "reason": "timeout", "tokens": 0})
-                res = GenerationResult([], "timeout", len(act.req.tokens),
+                                     "reason": "timeout",
+                                     "tokens": len(toks_out)})
+                res = GenerationResult(toks_out, "timeout",
+                                       len(act.req.tokens),
                                        req_id=act.req_id,
                                        admission_retries=act.retries,
                                        timeline=act.timeline)
@@ -767,14 +852,32 @@ class ServingEngine:
                 continue
             req = act.req
             plen = len(req.tokens)
+            # admission/prefill sequence: the prompt, or — resuming a
+            # preempted request — prompt + generated history minus the
+            # last token (its KV is written by its own next decode step)
+            pseq = self._admission_sequence(act)
+            plen_eff = len(pseq)
             t_adm0 = time.monotonic()
             plan = cache.admit(act, n_positions=plen + req.max_new_tokens,
-                               prompt=req.tokens)
+                               prompt=pseq)
             if plan is None:           # no slot / not enough blocks: wait
                 # one retry per scheduler iteration the head request spends
                 # blocked on its block reservation (ISSUE 8 satellite)
                 act.retries += 1
                 self._c_adm_retries.inc()
+                if act.retries == 1:
+                    bs = cache.block_size
+                    needed = -(-(plen + req.max_new_tokens) // bs)
+                    # the rejection as a Perfetto instant on the request's
+                    # own track (ISSUE 13 satellite — the forensics ring
+                    # alone is a host-side list): dur-0 timeline events
+                    # render as "i" phases; held on the request until its
+                    # "queue" event exists so it lands inside that span
+                    act.kv_rejection = {
+                        "phase": "kv_rejection", "t0": t_adm0, "t1": t_adm0,
+                        "blocks_needed": needed,
+                        "blocks_free": cache.blocks_free,
+                        "shortfall": max(0, needed - cache.blocks_free)}
                 if self.kv_observatory is not None and act.retries == 1:
                     # rejection forensics (ISSUE 12), first rejection per
                     # request only (a head-of-queue request blocked for N
@@ -789,14 +892,31 @@ class ServingEngine:
                         max_new_tokens=req.max_new_tokens,
                         blocks_needed=-(-(plen + req.max_new_tokens) // bs),
                         queue_depth=len(self._queue), retries=act.retries)
+                # REAL eviction (ISSUE 13): when the lifecycle manager is
+                # on and the observatory's plan says preempting residents
+                # would cover this request, do it and retry immediately —
+                # at most one round per request per _admit call (victims
+                # requeue at the back, so the retried admission holds its
+                # reservation and the loop always terminates)
+                if self.lifecycle is not None \
+                        and act.req_id not in evicted_for \
+                        and self._make_room(act):
+                    evicted_for.add(act.req_id)
+                    continue
                 break
             self._queue.pop(0)
             slot = plan.slot
             act.slot = slot
+            if act.resume is None:
+                self._h_queue_wait.observe(t_adm0 - act.t_submit)
             act.t_admit = t_adm0
-            self._h_queue_wait.observe(t_adm0 - act.t_submit)
-            act.timeline.append({"phase": "queue", "t0": act.t_submit,
+            t_q0 = act.resume["t_requeue"] if act.resume is not None \
+                else act.t_submit
+            act.timeline.append({"phase": "queue", "t0": t_q0,
                                  "t1": t_adm0, "retries": act.retries})
+            if act.kv_rejection is not None:
+                act.timeline.append(act.kv_rejection)
+                act.kv_rejection = None
             shared = plan.shared_len
             act.prefilled = act.shared_len = shared
             if shared:
@@ -804,7 +924,10 @@ class ServingEngine:
                 self._c_prefix_tokens.inc(shared)
             # decode-side slot state is prefill-order independent — install
             # it at admission for both the monolithic and chunked paths
-            # (the slot stays decode-inactive until the first token exists)
+            # (the slot stays decode-inactive until the first token exists).
+            # _plens stays the ORIGINAL prompt length even on resume: the
+            # decode step derives history columns from lengths - plens, and
+            # a resumed slot's lengths already account the regenerated part
             self._plens = self._plens.at[slot].set(plen)
             self._eos = self._eos.at[slot].set(
                 -1 if req.eos_id is None else int(req.eos_id))
@@ -816,7 +939,16 @@ class ServingEngine:
             self._c_admits.inc()
             telemetry.instant("admit", req=act.req_id, slot=slot, plen=plen,
                               retries=act.retries, queued=len(self._queue))
-            if self.prefill_chunk and plen - shared > self.prefill_chunk:
+            if act.resume is not None and act.resume["mode"] == "swap":
+                # swap reactivation: restore block bytes, no prefill at all
+                self._resume_swap(act, plan, t_adm0)
+                continue
+            if self.prefix_store is not None and act.resume is None:
+                # persistent prefix store (ISSUE 13): restore stored blocks
+                # that extend the resident registry's coverage, so only the
+                # remaining suffix pays prefill compute
+                shared = self._restore_from_store(act, plan, shared)
+            if self.prefill_chunk and plen_eff - shared > self.prefill_chunk:
                 # chunked prefill (ISSUE 9): the reservation is held but
                 # the prompt pass is deferred — one bounded chunk per
                 # scheduler iteration (_prefill_step) interleaved with
@@ -828,18 +960,18 @@ class ServingEngine:
                 self._prefilling.append(act)
                 self._update_kv_resident()
                 continue
-            toks = np.asarray(req.tokens, np.int32)  # sync-ok: host list
+            toks = np.asarray(pseq, np.int32)  # sync-ok: host list
             # compile attribution: each prefill jit retraces once per
             # power-of-two bucket — first sighting is a cache miss. The
             # shared path buckets on (suffix length, gathered blocks).
             if shared:
-                skey = self.decoder.shared_buckets(plen, shared)
+                skey = self.decoder.shared_buckets(plen_eff, shared)
                 bucket = skey[0]
                 miss = ("prefill_shared", skey) not in self._seen_shapes
                 if miss:
                     self._seen_shapes.add(("prefill_shared", skey))
             else:
-                bucket = self.decoder.prefill_bucket(plen)
+                bucket = self.decoder.prefill_bucket(plen_eff)
                 miss = ("prefill", bucket) not in self._seen_shapes
                 if miss:
                     self._seen_shapes.add(("prefill", bucket))
@@ -854,14 +986,15 @@ class ServingEngine:
                                  "blocks": plan.n_blocks, "shared": shared})
             had_active = bool(self._active_mask.any())
             with cm, telemetry.span("prefill", req=act.req_id, slot=slot,
-                                    plen=plen, bucket=bucket, shared=shared):
+                                    plen=plen_eff, bucket=bucket,
+                                    shared=shared):
                 if shared:
                     # suffix tokens only: the shared prefix's embedding +
                     # projection + score math never runs
                     # sync-ok: admission prefill input prep (scheduling event)
                     feats = np.asarray(
                         self.embed(jnp.asarray(toks[shared:]))).T
-                    lp = self.decoder.prefill_shared(slot, feats, plen,
+                    lp = self.decoder.prefill_shared(slot, feats, plen_eff,
                                                      shared)
                 else:
                     # sync-ok: admission prefill input prep (scheduling event)
@@ -873,12 +1006,12 @@ class ServingEngine:
                 self._h_stall.observe((time.perf_counter() - t_pf) * 1e3)
             # heat stamp the positions this dispatch wrote (shared-prefix
             # blocks were stamped by their incref at admission)
-            cache.touch_blocks(slot, shared, plen)
+            cache.touch_blocks(slot, shared, plen_eff)
             name = f"prefill_shared_b{skey[0]}k{skey[1]}" if shared \
                 else f"prefill_b{bucket}"
             self._finish_first_token(
                 act, lp, t_pf, t_pf_mono,
-                {"plen": plen, "bucket": bucket, "shared": shared},
+                {"plen": plen_eff, "bucket": bucket, "shared": shared},
                 prof_name=name)
 
     def _finish_first_token(self, act: _Active, lp, t_pf: float,
@@ -888,10 +1021,19 @@ class ServingEngine:
         register the now-resident prompt with the prefix registry, sample
         the first token, activate the slot's decode state, and stamp the
         "prefill" timeline event [t_pf_mono, first-token readback]. The
-        single counted admission readback (first token) lives here. Lock
-        held."""
+        single counted admission readback (first token) lives here. A
+        recompute RESUME (preempted request re-prefilled over prompt +
+        generated history) instead restores the stashed decode state and
+        samples nothing — the resumed tokens were already sampled and
+        counted before preemption, so no sampler key and no counted sync
+        are consumed. Lock held."""
         req, slot = act.req, act.slot
-        self.decoder.cache.register_prefix(slot, req.tokens)
+        seq = self._admission_sequence(act)
+        self.decoder.cache.register_prefix(slot, seq)
+        self._offer_prefix_store(act, seq)
+        if act.resume is not None:
+            self._finish_resume(act, t_pf_mono, extras)
+            return
         t0 = sample_tokens(self.sampler.next_key(), lp[None],
                            jnp.full((1,), req.temperature, jnp.float32),
                            self.sampler.top_k)[0]
@@ -948,7 +1090,8 @@ class ServingEngine:
             return
         act = self._prefilling[0]
         req, slot = act.req, act.slot
-        plen = len(req.tokens)
+        seq = self._admission_sequence(act)   # prompt (+ resumed history)
+        plen = len(seq)
         start = act.prefilled
         end = min(plen, start + self.prefill_chunk)
         skey = self.decoder.shared_buckets(end, start)
@@ -961,7 +1104,7 @@ class ServingEngine:
         had_active = bool(self._active_mask.any())
         t0_mono = act.timeline[-1]["t1"]   # tile: gap-free while waiting
         t_pf = time.perf_counter()
-        toks = np.asarray(req.tokens[start:end], np.int32)  # sync-ok: host list
+        toks = np.asarray(seq[start:end], np.int32)  # sync-ok: host list
         with cm, telemetry.span("prefill_chunk", req=act.req_id, slot=slot,
                                 chunk=act.n_chunks, start=start,
                                 tokens=end - start):
@@ -1082,6 +1225,272 @@ class ServingEngine:
         this is the live-vs-waste split the observatory attributes."""
         return {a.slot: a.prefilled + max(0, a.n_generated - 1)
                 for a in self._by_slot.values()}
+
+    # ----------------------------------------------------- KV lifecycle
+    def _admission_sequence(self, act: _Active) -> List[int]:
+        """The token sequence admission and prefill run over: the raw
+        prompt, or — for a request resuming after preemption — prompt +
+        every generated token but the LAST. The last sampled token's KV
+        is written by its own next decode step (exactly as in the
+        original run), so re-prefilling over this sequence lands device
+        lengths at prefilled + n_generated - 1, the same place the
+        never-evicted run had them."""
+        if act.resume is None:
+            return list(act.req.tokens)
+        return list(act.req.tokens) + \
+            [int(t) for t in act.resume["tokens"][:-1]]
+
+    def _make_room(self, act: _Active) -> bool:
+        """Try to preempt resident requests so the head-of-queue
+        admission can succeed (lock held). Victim selection is the
+        observatory's `plan_eviction` — the exact scoring the dry-run
+        reports, now acting for real — restricted to DECODE-ACTIVE slots
+        (a mid-prefill slot holds no resumable decode state and is never
+        preempted). Returns True when at least one victim was preempted;
+        the caller retries admission immediately. Victims requeue at the
+        BACK of the queue, so the retried head holds its full reservation
+        and always progresses — no preemption livelock."""
+        cache = self.decoder.cache
+        req = act.req
+        bs = cache.block_size
+        need = -(-(len(req.tokens) + req.max_new_tokens) // bs)
+        shortfall = need - cache.blocks_free
+        if cache.n_free == 0:
+            # slot (not block) exhaustion: any one victim frees a slot
+            shortfall = max(shortfall, 1)
+        if shortfall <= 0:
+            return False
+        eligible = {s for s, a in self._by_slot.items()
+                    if self._active_mask[s] and a.n_generated >= 1}
+        if not eligible:
+            return False
+        snap = cache.pool_snapshot(live_positions=self._live_kv_positions())
+        plan = self.lifecycle.plan(snap, shortfall, eligible=eligible)
+        if not plan["evicted"] or not plan["satisfies"]:
+            return False
+        bpp = self._kv_bytes_per_pos
+        for victim in plan["evicted"]:
+            slot = victim["slot"]
+            a = self._by_slot.get(slot)
+            if a is None or not self._active_mask[slot]:
+                continue
+            nbytes = victim["blocks_total"] * bs * bpp
+            mode = self.lifecycle.choose_mode(victim, nbytes)
+            self._preempt(slot, mode, victim)
+        return True
+
+    def _preempt(self, slot: int, mode: str, victim: dict) -> None:
+        """Preempt the resident request in `slot` under the scheduler
+        lock: deactivate, stash its generated history (recompute) or its
+        block bytes (swap: async device gather into the host pool —
+        functional cache updates pin the gathered values at dispatch
+        order, so a chunk still in flight cannot corrupt them), free the
+        reservation, requeue at the back. Pending overlapped results for
+        this slot are discarded by _finish_steps' identity check; under
+        greedy sampling a token lost to a one-chunk-stale readback
+        regenerates bit-identically on resume."""
+        cache = self.decoder.cache
+        act = self._by_slot.pop(slot)
+        self._active_mask[slot] = False
+        if self._dev_active is not None:
+            self._dev_active = self._dev_active.at[slot].set(False)
+        if self._spec_index is not None:
+            self._spec_index.drop(slot)
+        n = act.n_generated
+        with telemetry.span("host_sync", what="preempt_hist", slot=slot):
+            # the no-pressure sync sequence never reaches here
+            # sync-ok: preemption history readback (pressure path only)
+            gen = np.asarray(self._hist[slot])[:n].tolist()
+        self._c_syncs.inc()
+        t_prev = act.timeline[-1]["t1"] if act.timeline else act.t_submit
+        nbytes = victim["blocks_total"] * cache.block_size * \
+            self._kv_bytes_per_pos
+        if mode == "swap":
+            # gather BEFORE free: the dispatch pins the blocks' bytes
+            # even though the ids return to the free list right after
+            blocks = list(cache._slot_blocks[slot])
+            k_blk, v_blk = _kvc.gather_blocks(cache.state, blocks)
+            self.lifecycle.swap_out(act.req_id, k_blk, v_blk, nbytes)
+            self._c_evict_swap.inc()
+            self._c_swap_out.inc(nbytes)
+        else:
+            self.lifecycle.evictions_recompute += 1
+            self._c_evict_rec.inc()
+        self._c_preempt.inc()
+        self._g_host_pool.set(self.lifecycle.host_pool.bytes_used)
+        cache.free(slot)
+        now = time.monotonic()
+        act.resume = {"mode": mode, "tokens": gen, "t_requeue": now,
+                      "nbytes": nbytes}
+        act.n_generated = 0
+        act.prefilled = 0
+        act.shared_len = 0
+        act.preemptions += 1
+        # a span tiling from the request's previous event; the requeued
+        # "queue" phase starts at this t1, keeping coverage gap-free
+        act.timeline.append({"phase": "preempt", "t0": t_prev, "t1": now,
+                             "mode": mode, "score": victim.get("score"),
+                             "blocks_freed": victim.get("blocks_freed"),
+                             "bytes": nbytes,
+                             "policy": self.lifecycle.policy})
+        telemetry.instant("preempt", req=act.req_id, slot=slot, mode=mode)
+        self._queue.append(act)
+        self._update_kv_resident()
+
+    def _resume_swap(self, act: _Active, plan, t_adm0: float) -> None:
+        """Reactivate a swap-preempted request with NO prefill: the
+        re-admitted row's private blocks get their bytes scattered back
+        from the host pool, device lengths jump straight to the
+        preemption point, and decode continues. Leading blocks the new
+        admission mapped SHARED (refcount >= 2) are skipped — the
+        registry certifies they already hold this exact prefix — as are
+        reservation blocks past the live length (nothing visible there;
+        the VISIBILITY invariant masks whatever they hold until this
+        request's own writes land). Bit-identity: gather/scatter of the
+        same dtype round-trips exactly. Lock held."""
+        cache = self.decoder.cache
+        req, slot = act.req, act.slot
+        plen = len(req.tokens)
+        gen = [int(t) for t in act.resume["tokens"]]
+        n = len(gen)
+        live = plen + n - 1
+        nbytes = act.resume["nbytes"]
+        with telemetry.span("host_sync", what="swap_in", slot=slot):
+            # sync-ok: swap-in materialization (pressure path only)
+            k_host, v_host = self.lifecycle.swap_in(act.req_id, nbytes)
+        self._c_syncs.inc()
+        self._c_swap_in.inc(nbytes)
+        self._g_host_pool.set(self.lifecycle.host_pool.bytes_used)
+        row = cache._slot_blocks[slot]
+        bs = cache.block_size
+        lis = [li for li in range(min(len(row), k_host.shape[1]))
+               if li * bs < live and cache.allocator.refcount(row[li]) == 1]
+        if lis:
+            cache.state = _kvc.restore_blocks(
+                cache.state, [row[li] for li in lis],
+                k_host[:, lis], v_host[:, lis])
+        cache.state = _kvc.set_length(cache.state, slot, live)
+        cache.touch_blocks(slot, 0, live)
+        cache.register_prefix(slot, self._admission_sequence(act))
+        act.resume = None
+        act.n_generated = n
+        act.prefilled = plen
+        self._hist = self._hist.at[slot, :n].set(
+            jnp.asarray(np.asarray(gen, np.int32)))  # sync-ok: host list
+        self._last = self._last.at[slot].set(int(gen[-1]))
+        self._active_mask[slot] = True
+        if self._dev_active is not None:
+            self._dev_active = self._dev_active.at[slot].set(True)
+        if self._spec_index is not None:
+            self._spec_index.reset(slot, req.tokens)
+            self._spec_index.extend(slot, gen)
+        now = time.monotonic()
+        act.timeline.append({"phase": "swap_in", "t0": t_adm0, "t1": now,
+                             "blocks": len(lis), "bytes": nbytes,
+                             "resumed_tokens": n})
+        self._update_kv_resident()
+
+    def _finish_resume(self, act: _Active, t_pf_mono: float,
+                       extras: dict) -> None:
+        """Recompute-resume epilogue: the re-prefill over prompt +
+        generated history just completed, so device lengths already sit
+        at the preemption point — restore the host-side decode state
+        (history row, last token, spec index) and reactivate. The
+        prefill's final logprob row predicts the already-known last
+        generated token and is discarded; nothing is sampled. Lock
+        held."""
+        req, slot = act.req, act.slot
+        gen = [int(t) for t in act.resume["tokens"]]
+        n = len(gen)
+        act.resume = None
+        act.n_generated = n
+        act.prefilled = len(req.tokens)
+        self._hist = self._hist.at[slot, :n].set(
+            jnp.asarray(np.asarray(gen, np.int32)))  # sync-ok: host list
+        self._last = self._last.at[slot].set(int(gen[-1]))
+        self._active_mask[slot] = True
+        if self._dev_active is not None:
+            self._dev_active = self._dev_active.at[slot].set(True)
+        if self._spec_index is not None:
+            self._spec_index.reset(slot, req.tokens)
+            self._spec_index.extend(slot, gen)
+        act.timeline.append({"phase": "prefill", "t0": t_pf_mono,
+                             "t1": time.monotonic(), "resume": True,
+                             "resumed_tokens": n, **extras})
+        self._update_kv_resident()
+        # backstop: a preempted slot was decode-active, so it normally
+        # still has tokens to generate — but retire cleanly if not
+        if n >= req.max_new_tokens or (req.eos_id is not None
+                                       and gen[-1] == req.eos_id):
+            self._active_mask[slot] = False
+            if self._dev_active is not None:
+                self._dev_active = self._dev_active.at[slot].set(False)
+            self._retire(slot, "length")
+
+    def _restore_from_store(self, act: _Active, plan, shared: int) -> int:
+        """Extend the resident registry's shared coverage with blocks
+        restored from the persistent prefix store (ISSUE 13). Only a
+        full-block, non-COW extension past the registry match is taken:
+        a COW admission already copied a divergent block, and the target
+        blocks must be this admission's FRESH private blocks (refcount
+        1) — restoring never touches shared content. Returns the new
+        shared length (prefill then runs only the remaining suffix).
+        Lock held."""
+        cache = self.decoder.cache
+        bs = cache.block_size
+        pseq = self._admission_sequence(act)
+        if plan.cow or shared % bs or len(pseq) <= bs:
+            return shared
+        digs = chain_digests(pseq, bs)
+        k_cov = self.prefix_store.covered(digs)
+        k_cov = min(k_cov, (len(pseq) - 1) // bs)  # prefill needs a suffix
+        n_sh = shared // bs
+        if k_cov <= n_sh:
+            return shared
+        lis = list(range(n_sh, k_cov))
+        row = cache._slot_blocks[act.slot]
+        if any(cache.allocator.refcount(row[li]) != 1 for li in lis):
+            return shared
+        with telemetry.span("host_sync", what="prefix_store_restore",
+                            slot=act.slot, blocks=len(lis)):
+            # sync-ok: prefix-store fetch materialization (restore path)
+            k_host, v_host = self.prefix_store.fetch(
+                [digs[i] for i in lis])
+        self._c_syncs.inc()
+        cache.state = _kvc.restore_blocks(
+            cache.state, [row[li] for li in lis], k_host, v_host)
+        new_shared = k_cov * bs
+        cache.touch_blocks(act.slot, shared, new_shared)
+        act.prefilled = act.shared_len = new_shared
+        self._c_pstore_hits.inc()
+        self._c_pstore_tokens.inc(new_shared - shared)
+        return new_shared
+
+    def _offer_prefix_store(self, act: _Active, seq: List[int]) -> None:
+        """File the just-prefilled sequence's full-block KV bytes in the
+        persistent store under their chain digests. The gathers are lazy
+        device slices — dispatches, not syncs; bytes cross to the host
+        only at store save()/fetch(). Safe to capture here: a request
+        writes only positions >= its prompt length, so full prompt
+        blocks are final the moment prefill completes, and functional
+        cache updates pin the gathered values. Lock held."""
+        store = self.prefix_store
+        cache = self.decoder.cache
+        bs = cache.block_size
+        if store is None or len(seq) < bs:
+            return
+        digs = chain_digests(seq, bs)
+        missing = store.missing(digs)
+        if not missing:
+            return
+        row = cache._slot_blocks[act.slot]
+        k_blk, v_blk = _kvc.gather_blocks(cache.state,
+                                          [row[i] for i in missing])
+        nb = bs * self._kv_bytes_per_pos
+        shape = (cache.n_layers, bs, cache.n_kv_heads, cache.head_dim)
+        for j, i in enumerate(missing):
+            store.put(digs[i], k_blk[:, j], v_blk[:, j], nb,
+                      block_shape=shape)
 
     def _update_kv_resident(self) -> None:
         """Publish resident KV bytes: cache positions actually holding a
@@ -1575,5 +1984,9 @@ class ServingEngine:
                 self._queue.clear()
             elif self._by_slot or self._queue:
                 self.drain()
+        if self.prefix_store is not None and self.prefix_store.path:
+            # spill the prefix store so prompts survive the restart
+            # (ISSUE 13) — shutdown is a phase boundary, syncs are fine
+            self.prefix_store.save()
 
     _drain_on_stop = True

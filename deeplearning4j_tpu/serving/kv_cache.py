@@ -265,6 +265,35 @@ def copy_block(state: Dict[str, jnp.ndarray], src: int, dst: int
             "v": state["v"].at[:, dst].set(state["v"][:, src])}
 
 
+def gather_blocks(state: Dict[str, jnp.ndarray], blocks: Sequence[int]
+                  ) -> tuple:
+    """Gather the k/v bytes of physical `blocks` across all layers — the
+    device half of a swap-out (serving/lifecycle.py). Returns
+    (k_blk, v_blk), each (n_layers, len(blocks), block_size, n_kv_heads,
+    head_dim). This DISPATCHES an async gather and returns device
+    arrays; the bytes only cross to the host when the caller
+    materializes them. Because every cache mutation is functional (no
+    donation, no in-place update), the gathered value is pinned at
+    dispatch order — writes issued after it, including a new owner
+    reusing these physical blocks, cannot retroactively corrupt it."""
+    idx = jnp.asarray(list(blocks), jnp.int32)
+    return state["k"][:, idx], state["v"][:, idx]
+
+
+def restore_blocks(state: Dict[str, jnp.ndarray], blocks: Sequence[int],
+                   k_blk, v_blk) -> Dict[str, jnp.ndarray]:
+    """Scatter previously gathered block bytes back into physical
+    `blocks` across all layers (swap-in / prefix-store restore): one
+    batched scatter per buffer, the exact inverse of `gather_blocks`, so
+    a swap round-trip is bit-identical by construction."""
+    idx = jnp.asarray(list(blocks), jnp.int32)
+    return {**state,
+            "k": state["k"].at[:, idx].set(
+                jnp.asarray(k_blk).astype(state["k"].dtype)),
+            "v": state["v"].at[:, idx].set(
+                jnp.asarray(v_blk).astype(state["v"].dtype))}
+
+
 @dataclass
 class AdmissionPlan:
     """What `KVCache.admit` decided for one request: where it lives, how

@@ -1,0 +1,370 @@
+"""KV lifecycle under memory pressure: real eviction/preemption, a
+host-RAM offload tier, and a persistent prefix store (ISSUE 13).
+
+The ROADMAP named KV lifecycle as the scaling ceiling: at 2-5x
+resident-block capacity the engine just queued rejected admissions
+forever. PR 12 built the measurement half — telemetry/kv_observatory.py
+ranks victims under lru / slo_deadline / refcount_weighted policies with
+marginal reclaim and per-candidate recompute-vs-swap costs — but
+`dry_run()` evicted nothing. This module makes it real, as a layer
+between admission and the block pool:
+
+- `KVLifecycleManager`: policy + cost-model state for REAL eviction.
+  When admission fails, the engine asks the manager for a victim plan;
+  the plan comes from the observatory's `plan_eviction` — the SAME
+  ranking + marginal-reclaim simulation the dry-run forensics record, so
+  what the rejection ring says would be evicted and what actually gets
+  preempted can never disagree. Per victim the manager picks RECOMPUTE
+  (free the blocks; the engine requeues the request with its generated
+  history and rebuilds KV via prefill — greedy token streams are
+  bit-identical to a never-evicted run because temperature-0 sampling is
+  key-free argmax) or SWAP (the victim's block bytes migrate to the
+  `HostBlockPool` and are restored on reactivation — bit-identical KV by
+  construction, gather/scatter round-trip). `mode="auto"` follows the
+  observatory cost model's per-candidate `cheaper` verdict, capped by
+  host-pool capacity.
+
+- `HostBlockPool`: a capacity-capped host-RAM tier for swapped-out KV
+  block bytes. `put()` accepts LAZY device arrays — the engine hands it
+  the output of `kv_cache.gather_blocks`, an async device gather whose
+  value is pinned at dispatch order because cache updates are functional
+  (no donation); the device->host copy happens only at `fetch()`, on the
+  swap-in path, where the manager times it (the measured host-link
+  bandwidth PERF.md reports). Shared COW blocks ride along with
+  refcounts intact: the gather snapshots their bytes read-only, and
+  `KVCache.free` only returns a block when its LAST sharer drops.
+
+- `PersistentPrefixStore`: a content-addressed host store of full
+  prefix-block KV bytes keyed by the registry's sha1 chain digests
+  (block_table.chain_digests — digest i certifies tokens
+  [0, (i+1)*block_size), the same safety certificate resident sharing
+  uses). Unlike the pool-scoped `PrefixRegistry`, entries carry BYTES,
+  not physical block ids, so one store can back every replica of a
+  `ShardedServingGroup` and survive engine restarts via
+  `save()`/`load()` (an npz spill file; env `DL4J_TPU_PREFIX_STORE`).
+  On admission the engine restores stored blocks that extend the
+  registry's resident coverage and prefills only the remaining suffix.
+
+Sync discipline: with the lifecycle disabled (the default) no code here
+runs — the no-pressure path is host-sync bit-identical to a build
+without it (parity-tested). Enabled, the only added materializations are
+on the PRESSURE paths (preemption history readback, swap-in fetch,
+prefix-store fetch), every one `# sync-ok`-annotated and counted.
+
+Env knobs: `DL4J_TPU_KV_EVICT` (policy name, empty/0/off disables),
+`DL4J_TPU_KV_SWAP_BYTES` (host-pool cap in bytes; 0 = recompute-only),
+`DL4J_TPU_PREFIX_STORE` (spill-file path, also enables the store).
+"""
+from __future__ import annotations
+
+import os
+import time
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.telemetry.kv_observatory import (
+    DEFAULT_FLOPS_PER_SEC, DEFAULT_POLICIES, DEFAULT_SWAP_BYTES_PER_SEC,
+    plan_eviction)
+
+
+class HostBlockPool:
+    """Capacity-capped host-RAM tier for swapped-out KV block bytes.
+
+    Entries are (k, v) per swap key — lazy device arrays from
+    `kv_cache.gather_blocks` (the swap-out dispatch) that only cross to
+    the host when `fetch()` materializes them on the swap-in path. Byte
+    accounting is nominal (the blocks' device size), charged at put()
+    so `can_fit` back-pressures admission-time swap decisions even
+    while the bytes are still in flight."""
+
+    def __init__(self, capacity_bytes: int = 0):
+        self.capacity_bytes = max(0, int(capacity_bytes))
+        self._entries: Dict[object, Tuple[object, object, int]] = {}
+        self.bytes_used = 0
+
+    def can_fit(self, nbytes: int) -> bool:
+        return (self.capacity_bytes > 0
+                and self.bytes_used + int(nbytes) <= self.capacity_bytes)
+
+    def put(self, key, k_blocks, v_blocks, nbytes: int) -> None:
+        if key in self._entries:
+            raise ValueError(f"swap key {key!r} already held")
+        self._entries[key] = (k_blocks, v_blocks, int(nbytes))
+        self.bytes_used += int(nbytes)
+
+    def fetch(self, key) -> Tuple[np.ndarray, np.ndarray]:
+        """Remove and MATERIALIZE one entry (the swap-in device->host
+        copy happens here; the caller times it and counts the sync)."""
+        k, v, n = self._entries.pop(key)
+        self.bytes_used -= n
+        # counted+timed by the engine via KVLifecycleManager.swap_in
+        # sync-ok: swap-in materialization (pressure path)
+        return np.asarray(k), np.asarray(v)
+
+    def drop(self, key) -> None:
+        ent = self._entries.pop(key, None)
+        if ent is not None:
+            self.bytes_used -= ent[2]
+
+    def __contains__(self, key) -> bool:
+        return key in self._entries
+
+    @property
+    def n_entries(self) -> int:
+        return len(self._entries)
+
+
+class PersistentPrefixStore:
+    """Content-addressed host store of full prefix-block KV bytes.
+
+    Keys are the registry's sha1 chain digests (`chain_digests`): entry
+    `d` holds one block's (k, v) bytes, shape (n_layers, block_size,
+    n_kv_heads, head_dim) each, valid for ANY pool whose geometry
+    matches — unlike physical block ids, bytes transfer across replicas
+    and restarts. LRU-capped; `save()`/`load()` spill to an npz file so
+    system prompts and multi-turn histories survive the process."""
+
+    def __init__(self, capacity_bytes: int = 256 << 20,
+                 path: Optional[str] = None):
+        self.capacity_bytes = max(0, int(capacity_bytes))
+        self.path = path
+        # digest -> (k_block, v_block, nbytes); k/v may be lazy device
+        # arrays until save()/fetch() materializes them
+        self._entries: "OrderedDict[bytes, Tuple[object, object, int]]" = \
+            OrderedDict()
+        self.bytes_used = 0
+        self.block_shape: Optional[tuple] = None
+
+    # ------------------------------------------------------------ lookup
+    def covered(self, digests: Sequence[bytes]) -> int:
+        """How many LEADING digests the store holds (chain property: a
+        usable restore is always a prefix of the chain). Touches the hit
+        entries' LRU position."""
+        n = 0
+        for d in digests:
+            if d not in self._entries:
+                break
+            self._entries.move_to_end(d)
+            n += 1
+        return n
+
+    def missing(self, digests: Sequence[bytes]) -> List[int]:
+        """Indices of `digests` not yet stored (the offer path gathers
+        bytes only for these)."""
+        return [i for i, d in enumerate(digests) if d not in self._entries]
+
+    # ------------------------------------------------------------- write
+    def put(self, digest: bytes, k_block, v_block, nbytes: int,
+            block_shape: Optional[tuple] = None) -> None:
+        """File one block's bytes under its chain digest (first write
+        wins — identical content by the chain-hash certificate). Evicts
+        LRU entries to stay under the byte cap."""
+        if digest in self._entries:
+            self._entries.move_to_end(digest)
+            return
+        if block_shape is not None:
+            if self.block_shape is None:
+                self.block_shape = tuple(block_shape)
+            elif tuple(block_shape) != self.block_shape:
+                raise ValueError(
+                    f"prefix-store block shape {tuple(block_shape)} != "
+                    f"established {self.block_shape}")
+        nbytes = int(nbytes)
+        if self.capacity_bytes and nbytes > self.capacity_bytes:
+            return
+        while self.capacity_bytes and self._entries \
+                and self.bytes_used + nbytes > self.capacity_bytes:
+            _, (_, _, old) = self._entries.popitem(last=False)
+            self.bytes_used -= old
+        self._entries[digest] = (k_block, v_block, nbytes)
+        self.bytes_used += nbytes
+
+    def fetch(self, digests: Sequence[bytes]
+              ) -> Tuple[np.ndarray, np.ndarray]:
+        """Materialized (k, v) stacks for `digests` (all must be held):
+        shape (n_layers, len(digests), block_size, n_kv_heads, head_dim)
+        — the layout `kv_cache.restore_blocks` scatters."""
+        ks, vs = [], []
+        for d in digests:
+            k, v, _ = self._entries[d]
+            # sync-ok: prefix-store restore (counted by the engine)
+            ks.append(np.asarray(k))
+            vs.append(np.asarray(v))  # sync-ok: prefix-store restore
+        return np.stack(ks, axis=1), np.stack(vs, axis=1)
+
+    # ----------------------------------------------------- persistence
+    def save(self, path: Optional[str] = None) -> Optional[str]:
+        """Spill every entry to an npz file (digests hex-encoded in the
+        array names). Materializes lazy device entries — a phase
+        boundary (shutdown), never the serve loop."""
+        path = path or self.path
+        if not path:
+            return None
+        arrays: Dict[str, np.ndarray] = {}
+        for d, (k, v, _) in self._entries.items():
+            # sync-ok: shutdown spill (phase boundary)
+            arrays[f"k_{d.hex()}"] = np.asarray(k)
+            arrays[f"v_{d.hex()}"] = np.asarray(v)  # sync-ok: shutdown spill
+        # write through a handle: np.savez(str) appends ".npz" to a bare
+        # path, which load() (os.path.exists on the SAME string) would miss
+        with open(path, "wb") as f:
+            np.savez(f, **arrays)
+        return path
+
+    def load(self, path: Optional[str] = None) -> int:
+        """Load entries from an npz spill file (missing file = empty
+        store, not an error). Returns the number of blocks loaded."""
+        path = path or self.path
+        if not path or not os.path.exists(path):
+            return 0
+        loaded = 0
+        with np.load(path) as z:
+            for name in z.files:
+                if not name.startswith("k_"):
+                    continue
+                hexd = name[2:]
+                vname = f"v_{hexd}"
+                if vname not in z.files:
+                    continue
+                k = z[name]
+                v = z[vname]
+                self.put(bytes.fromhex(hexd), k, v, k.nbytes + v.nbytes,
+                         block_shape=k.shape)
+                loaded += 1
+        return loaded
+
+    @property
+    def n_entries(self) -> int:
+        return len(self._entries)
+
+
+class KVLifecycleManager:
+    """Victim selection + recompute/swap execution state for one engine.
+
+    `plan()` delegates to the observatory's `plan_eviction` — the single
+    source of truth shared with the dry-run forensics. The manager owns
+    the `HostBlockPool` and the swap byte/wall accounting the bench
+    reads; the ENGINE owns the actual preemption (slots, masks, history)
+    because those live under its scheduler lock."""
+
+    MODES = ("auto", "recompute", "swap")
+
+    def __init__(self, policy: str = "lru", swap_bytes: int = 0,
+                 mode: str = "auto", *, flops_per_token: float = 0.0,
+                 swap_bytes_per_sec: float = DEFAULT_SWAP_BYTES_PER_SEC,
+                 flops_per_sec: float = DEFAULT_FLOPS_PER_SEC,
+                 score_fn: Optional[Callable] = None):
+        if score_fn is None:
+            if policy not in DEFAULT_POLICIES:
+                raise ValueError(
+                    f"unknown eviction policy {policy!r}; known: "
+                    f"{sorted(DEFAULT_POLICIES)}")
+            score_fn = DEFAULT_POLICIES[policy]
+        if mode not in self.MODES:
+            raise ValueError(f"kv_evict_mode {mode!r} not in {self.MODES}")
+        self.policy = policy
+        self.score_fn = score_fn
+        self.mode = mode
+        self.flops_per_token = float(flops_per_token)    # sync-ok: scalar
+        self.swap_bytes_per_sec = float(swap_bytes_per_sec)  # sync-ok: scalar
+        self.flops_per_sec = float(flops_per_sec)       # sync-ok: scalar
+        self.host_pool = HostBlockPool(swap_bytes)
+        # accounting the engine mirrors into serving.kv.* metrics
+        self.evictions_recompute = 0
+        self.evictions_swap = 0
+        self.swap_out_bytes = 0
+        self.swap_in_bytes = 0
+        self.swap_wall_s = 0.0      # measured swap-in materialization wall
+
+    # ------------------------------------------------------------- plan
+    def plan(self, snapshot: Dict[str, object], needed_blocks: int, *,
+             eligible: Optional[set] = None,
+             now: Optional[float] = None) -> dict:
+        """The victims this manager's policy would preempt to reclaim
+        `needed_blocks` — exactly what the dry-run ring would log."""
+        return plan_eviction(snapshot, needed_blocks, self.score_fn, now,
+                             flops_per_token=self.flops_per_token,
+                             swap_bytes_per_sec=self.swap_bytes_per_sec,
+                             flops_per_sec=self.flops_per_sec,
+                             eligible=eligible, policy=self.policy)
+
+    def choose_mode(self, victim: dict, nbytes: int) -> str:
+        """recompute vs swap for one plan entry: forced by `mode`, or
+        (auto) the cost model's `cheaper` verdict — either way swap is
+        only taken when the host pool can hold the bytes."""
+        if self.mode == "recompute":
+            return "recompute"
+        fits = self.host_pool.can_fit(nbytes)
+        if self.mode == "swap":
+            return "swap" if fits else "recompute"
+        return "swap" if (victim.get("cheaper") == "swap" and fits) \
+            else "recompute"
+
+    # ------------------------------------------------------------- swap
+    def swap_out(self, key, k_blocks, v_blocks, nbytes: int) -> None:
+        """File a victim's gathered block bytes (lazy device arrays) in
+        the host pool; bytes are charged now, copied at swap-in."""
+        self.host_pool.put(key, k_blocks, v_blocks, nbytes)
+        self.evictions_swap += 1
+        self.swap_out_bytes += int(nbytes)
+
+    def swap_in(self, key, nbytes: int) -> Tuple[np.ndarray, np.ndarray]:
+        """Materialize a swapped request's bytes for restore, timing the
+        device->host copy (the measured host-link bandwidth)."""
+        t0 = time.perf_counter()
+        k, v = self.host_pool.fetch(key)
+        self.swap_wall_s += time.perf_counter() - t0
+        self.swap_in_bytes += int(nbytes)
+        return k, v
+
+    def measured_swap_gbps(self) -> Optional[float]:
+        """Swap-in bytes / materialization wall, in GB/s — None until a
+        swap round-trip has actually run."""
+        if self.swap_in_bytes <= 0 or self.swap_wall_s <= 0:
+            return None
+        return self.swap_in_bytes / self.swap_wall_s / 1e9
+
+
+def resolve_lifecycle(kv_evict, kv_swap_bytes, kv_evict_mode: str = "auto",
+                      *, flops_per_token: float = 0.0
+                      ) -> Optional[KVLifecycleManager]:
+    """Engine-constructor resolution of the lifecycle knobs: `kv_evict`
+    is a policy name (or True for the default lru), None defers to
+    `DL4J_TPU_KV_EVICT`; empty/"0"/"off" disables — and disabled means
+    NO manager, no code on any path (the bit-parity guarantee)."""
+    if kv_evict is None:
+        kv_evict = os.environ.get("DL4J_TPU_KV_EVICT", "")
+    if isinstance(kv_evict, KVLifecycleManager):
+        return kv_evict
+    if isinstance(kv_evict, bool):
+        kv_evict = "lru" if kv_evict else ""
+    if not kv_evict or kv_evict in ("0", "off"):
+        return None
+    if kv_swap_bytes is None:
+        kv_swap_bytes = int(os.environ.get("DL4J_TPU_KV_SWAP_BYTES", "0"))
+    return KVLifecycleManager(policy=str(kv_evict),
+                              swap_bytes=int(kv_swap_bytes),
+                              mode=kv_evict_mode,
+                              flops_per_token=flops_per_token)
+
+
+def resolve_prefix_store(prefix_store) -> Optional[PersistentPrefixStore]:
+    """Engine-constructor resolution of the prefix-store knob: an
+    instance passes through (the ShardedServingGroup hands ONE store to
+    every replica), True builds a RAM-only store, a string is a spill
+    path; None defers to `DL4J_TPU_PREFIX_STORE` (path, empty = off).
+    A path-backed store auto-loads its spill file when it exists."""
+    if prefix_store is None:
+        path = os.environ.get("DL4J_TPU_PREFIX_STORE", "")
+        if not path or path == "0":
+            return None
+        prefix_store = path
+    if isinstance(prefix_store, PersistentPrefixStore):
+        return prefix_store
+    if prefix_store is True:
+        return PersistentPrefixStore()
+    store = PersistentPrefixStore(path=str(prefix_store))
+    store.load()
+    return store

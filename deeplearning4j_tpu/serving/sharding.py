@@ -59,6 +59,7 @@ from deeplearning4j_tpu.serving.decode import (StackDecoder,
                                                decode_attention_spec_paged)
 from deeplearning4j_tpu.serving.engine import Request, ServingEngine
 from deeplearning4j_tpu.serving.kv_cache import resolve_block_size
+from deeplearning4j_tpu.serving.lifecycle import resolve_prefix_store
 
 __all__ = [
     "match_partition_rules", "make_shard_and_gather_fns", "named_tree_map",
@@ -85,6 +86,9 @@ GROUP_SUMMED_KEYS: Tuple[str, ...] = (
     "prefix_hits", "prefix_shared_tokens", "prefill_chunks",
     "nonfinite_chunks", "admission_retries",
     "spec_tokens_accepted", "spec_tokens_rejected",
+    "kv_evictions_recompute", "kv_evictions_swap", "kv_preemptions",
+    "kv_swap_out_bytes", "kv_swap_in_bytes", "kv_host_pool_bytes",
+    "prefix_store_hits", "prefix_store_tokens",
 )
 
 
@@ -467,6 +471,14 @@ class ShardedServingGroup:
         # replicas (see block_table.PrefixRegistry.bind_pool)
         self.registries = [PrefixRegistry(block_size)
                            for _ in range(self.replicas)]
+        # ONE persistent prefix store for the whole group (ISSUE 13):
+        # unlike PrefixRegistry entries, store entries are content-keyed
+        # BYTES (no pool-scoped block ids), so a prompt prefilled on one
+        # replica is restorable on every other — resolved here so all
+        # replicas share the same instance instead of each resolving its
+        # own from the environment
+        self.prefix_store = resolve_prefix_store(
+            engine_kw.pop("prefix_store", None))
         self.engines: List[ShardedServingEngine] = []
         for r, submesh in enumerate(replica_submeshes(self.mesh,
                                                       tensor_axis)):
@@ -474,7 +486,8 @@ class ShardedServingGroup:
                 net, max_seqs, max_len, mesh=submesh,
                 tensor_axis=tensor_axis, seed=seed + r,
                 metrics_parent=self.metrics,
-                prefix_registry=self.registries[r], **engine_kw))
+                prefix_registry=self.registries[r],
+                prefix_store=self.prefix_store, **engine_kw))
         self._lock = threading.Lock()
         self._rr = 0
         self._cohorts: "OrderedDict[tuple, int]" = OrderedDict()

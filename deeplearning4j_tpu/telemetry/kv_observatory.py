@@ -22,13 +22,16 @@ attributed, heat-mapped resource, built ENTIRELY from host bookkeeping:
   bounded ring (the flight-recorder retention idiom), and runs the
   eviction DRY-RUN scorer at block-exhaustion events.
 
-- Dry-run scorer: pluggable policies (`lru`, `slo_deadline` using the
+- Eviction scorer: pluggable policies (`lru`, `slo_deadline` using the
   PR 8 lifecycle stamps, `refcount_weighted`) rank live requests as
-  eviction candidates and log what each policy WOULD evict, plus the
-  recompute-vs-swap cost per candidate (PERF.md cost model: swap moves
-  2x live KV bytes over the host link; recompute replays ~2*params FLOPs
-  per live token). Nothing is ever actually evicted — this PR measures
-  the policy space so the eviction PR ships as a drop-in.
+  eviction candidates with the recompute-vs-swap cost per candidate
+  (PERF.md cost model: swap moves 2x live KV bytes over the host link;
+  recompute replays ~2*params FLOPs per live token). `plan_eviction` is
+  the single source of truth for victim selection: `dry_run` logs what
+  each policy WOULD evict, and serving/lifecycle.py's KVLifecycleManager
+  executes the same plan for REAL when `ServingEngine(kv_evict=...)` is
+  enabled — so the forensics ring and actual preemptions can never
+  disagree on ranking or marginal reclaim.
 
 Sync discipline: everything here consumes `KVCache.pool_snapshot()` and
 engine-owned host integers. There is no jax import and no device access,
@@ -239,66 +242,91 @@ def candidate_costs(cand: dict, *, flops_per_token: float,
     }
 
 
+def plan_eviction(snapshot: Dict[str, object], needed_blocks: int,
+                  score_fn: Callable[[dict, Dict[str, object], float],
+                                     float],
+                  now: Optional[float] = None, *,
+                  flops_per_token: float = 0.0,
+                  swap_bytes_per_sec: float = DEFAULT_SWAP_BYTES_PER_SEC,
+                  flops_per_sec: float = DEFAULT_FLOPS_PER_SEC,
+                  eligible: Optional[set] = None,
+                  policy: str = "<custom>") -> dict:
+    """What ONE policy would evict to reclaim `needed_blocks` — the
+    single source of truth for victim selection, shared by the dry-run
+    scorer and the REAL eviction in serving/lifecycle.py.
+
+    Rank the candidates (highest score = first victim), then walk the
+    ranking simulating refcounts — a shared block frees only when its
+    LAST sharer is evicted, so cumulative reclaim is order-dependent and
+    the per-victim `blocks_freed` recorded here is the simulated
+    marginal reclaim, not the static private count. Stops as soon as
+    the shortfall is covered; `satisfies=False` means even evicting
+    everything would not cover it. `eligible`, when given, restricts the
+    candidate pool to those slots (the lifecycle manager passes slots
+    that are safely preemptible this iteration)."""
+    if now is None:
+        now = time.monotonic()
+    cands = eviction_candidates(snapshot)
+    if eligible is not None:
+        cands = [c for c in cands if c["slot"] in eligible]
+    blocks: Dict[int, dict] = snapshot["blocks"]  # type: ignore[assignment]
+    bs = int(snapshot["block_size"])
+    bpp = int(snapshot["bytes_per_position"])
+    ranked = sorted(cands, key=lambda c: score_fn(c, snapshot, now),
+                    reverse=True)
+    refs = {b: info["refcount"] for b, info in blocks.items()}
+    slot_map = {c["slot"]: snapshot["slots"][c["slot"]]["blocks"]
+                for c in cands}  # type: ignore[index]
+    evicted = []
+    freed = 0
+    for cand in ranked:
+        if freed >= needed_blocks:
+            break
+        marginal = 0
+        for b in slot_map[cand["slot"]]:
+            refs[b] -= 1
+            if refs[b] == 0:
+                marginal += 1
+        freed += marginal
+        entry = dict(cand)
+        entry["score"] = score_fn(cand, snapshot, now)
+        entry["blocks_freed"] = marginal
+        entry["bytes_freed"] = marginal * bs * bpp
+        entry.update(candidate_costs(
+            cand, flops_per_token=flops_per_token,
+            swap_bytes_per_sec=swap_bytes_per_sec,
+            flops_per_sec=flops_per_sec))
+        evicted.append(entry)
+    return {
+        "policy": policy,
+        "needed_blocks": int(needed_blocks),
+        "evicted": evicted,
+        "blocks_freed": freed,
+        "bytes_freed": freed * bs * bpp,
+        "swap_bytes_total": sum(e["swap_bytes"] for e in evicted),
+        "recompute_flops_total": sum(e["recompute_flops"]
+                                     for e in evicted),
+        "satisfies": freed >= needed_blocks,
+    }
+
+
 def dry_run(snapshot: Dict[str, object], needed_blocks: int,
             policies: Optional[Dict[str, Callable]] = None,
             now: Optional[float] = None, *, flops_per_token: float = 0.0,
             swap_bytes_per_sec: float = DEFAULT_SWAP_BYTES_PER_SEC,
             flops_per_sec: float = DEFAULT_FLOPS_PER_SEC) -> List[dict]:
-    """What each policy WOULD evict to reclaim `needed_blocks`.
-
-    For every policy: rank the candidates (highest score = first
-    victim), then walk the ranking simulating refcounts — a shared block
-    frees only when its LAST sharer is evicted, so cumulative reclaim is
-    order-dependent and the per-victim `blocks_freed` recorded here is
-    the simulated marginal reclaim, not the static private count. Stops
-    as soon as the shortfall is covered; `satisfies=False` means even
-    evicting everything would not cover it."""
+    """What each policy WOULD evict to reclaim `needed_blocks` — a thin
+    loop over `plan_eviction`, one row per policy, so the dry-run
+    verdicts and the real eviction in serving/lifecycle.py can never
+    disagree on victim selection."""
     if now is None:
         now = time.monotonic()
     policies = DEFAULT_POLICIES if policies is None else policies
-    cands = eviction_candidates(snapshot)
-    blocks: Dict[int, dict] = snapshot["blocks"]  # type: ignore[assignment]
-    bs = int(snapshot["block_size"])
-    bpp = int(snapshot["bytes_per_position"])
-    results = []
-    for name, score_fn in policies.items():
-        ranked = sorted(cands, key=lambda c: score_fn(c, snapshot, now),
-                        reverse=True)
-        refs = {b: info["refcount"] for b, info in blocks.items()}
-        slot_map = {c["slot"]: snapshot["slots"][c["slot"]]["blocks"]
-                    for c in cands}  # type: ignore[index]
-        evicted = []
-        freed = 0
-        for cand in ranked:
-            if freed >= needed_blocks:
-                break
-            marginal = 0
-            for b in slot_map[cand["slot"]]:
-                refs[b] -= 1
-                if refs[b] == 0:
-                    marginal += 1
-            freed += marginal
-            entry = dict(cand)
-            entry["score"] = score_fn(cand, snapshot, now)
-            entry["blocks_freed"] = marginal
-            entry["bytes_freed"] = marginal * bs * bpp
-            entry.update(candidate_costs(
-                cand, flops_per_token=flops_per_token,
-                swap_bytes_per_sec=swap_bytes_per_sec,
-                flops_per_sec=flops_per_sec))
-            evicted.append(entry)
-        results.append({
-            "policy": name,
-            "needed_blocks": int(needed_blocks),
-            "evicted": evicted,
-            "blocks_freed": freed,
-            "bytes_freed": freed * bs * bpp,
-            "swap_bytes_total": sum(e["swap_bytes"] for e in evicted),
-            "recompute_flops_total": sum(e["recompute_flops"]
-                                         for e in evicted),
-            "satisfies": freed >= needed_blocks,
-        })
-    return results
+    return [plan_eviction(snapshot, needed_blocks, score_fn, now,
+                          flops_per_token=flops_per_token,
+                          swap_bytes_per_sec=swap_bytes_per_sec,
+                          flops_per_sec=flops_per_sec, policy=name)
+            for name, score_fn in policies.items()]
 
 
 # ----------------------------------------------------- the observatory

@@ -28,7 +28,11 @@ CPU-runnable and always present; measured entries must prove both
 in-bench assertions held: conserved_every_step=True and
 sync_parity=True, carry >= 1 recorded rejection with its
 requested-vs-free-vs-reclaimable forensics, and a well-formed dry-run
-row per eviction policy).
+row per eviction policy). ISSUE 13 adds `kv_lifecycle` (the
+forced-exhaustion REAL-eviction run — CPU-runnable and always present;
+measured entries must prove token parity + completion + conservation
+for both preemption flavors, >= 1 actual preemption per flavor, no
+flavor leakage under forced modes, and a measured swap bandwidth).
 bench.py calls
 `assert_valid` on the dict it is about to print, and
 tests/test_bench_schema.py re-validates the committed artifact, so the
@@ -298,6 +302,52 @@ def validate_artifact(art: dict) -> List[str]:
                     errs.append(f"kv_observatory.dry_run[{i}] must carry "
                                 "policy (str), blocks_freed (num), "
                                 "satisfies (bool)")
+
+    # KV lifecycle manager (ISSUE 13): CPU-runnable forced-exhaustion
+    # eviction run, so always present; when measured BOTH preemption
+    # flavors must prove the in-bench assertions held (token parity vs
+    # the never-evicted reference, all requests completed, conservation
+    # every iteration), each flavor must have actually preempted, the
+    # counters must name the right flavor, and the swap side must carry
+    # the measured host round-trip bandwidth PERF.md's cost model cites
+    kl = e.get("kv_lifecycle")
+    if not isinstance(kl, dict):
+        errs.append("extra['kv_lifecycle'] missing or not a dict (the "
+                    "forced-exhaustion eviction run is CPU-runnable — "
+                    "emit error/skipped entries rather than dropping it)")
+    elif "error" not in kl and "skipped_reason" not in kl:
+        if not isinstance(kl.get("platform"), str):
+            errs.append("extra['kv_lifecycle'] has no 'platform' label")
+        if not _is_num(kl.get("overcommit")) or kl.get("overcommit", 0) < 2:
+            errs.append("kv_lifecycle.overcommit missing or < 2 — the "
+                        "workload never forced real pool exhaustion")
+        for mode in ("recompute", "swap"):
+            row = kl.get(mode)
+            if not isinstance(row, dict):
+                errs.append(f"kv_lifecycle.{mode} missing or not a dict")
+                continue
+            for flag in ("tokens_identical", "all_completed",
+                         "conserved_every_step"):
+                if row.get(flag) is not True:
+                    errs.append(f"kv_lifecycle.{mode}.{flag} must be True")
+            if not _is_num(row.get("preemptions")) \
+                    or row.get("preemptions", 0) < 1:
+                errs.append(f"kv_lifecycle.{mode}.preemptions missing or "
+                            "< 1 — no eviction actually happened")
+            wrong = ("evictions_swap" if mode == "recompute"
+                     else "evictions_recompute")
+            if row.get(wrong, 0) != 0:
+                errs.append(f"kv_lifecycle.{mode}.{wrong} must be 0 — the "
+                            "forced mode leaked the other flavor")
+        swap = kl.get("swap")
+        if isinstance(swap, dict) and "error" not in kl:
+            if not _is_num(swap.get("measured_swap_gbps")):
+                errs.append("kv_lifecycle.swap.measured_swap_gbps missing "
+                            "or not a number — no swap round-trip was "
+                            "timed")
+            if swap.get("host_pool_drained") is not True:
+                errs.append("kv_lifecycle.swap.host_pool_drained must be "
+                            "True — swapped blocks leaked in host RAM")
 
     # every measurement dict carries a platform label
     for name, entry in e.items():

@@ -74,6 +74,19 @@ def _minimal_art():
                                       "shortfall_blocks": 3},
                 "dry_run": [{"policy": "lru", "blocks_freed": 3,
                              "satisfies": True}]},
+            "kv_lifecycle": {
+                "platform": "cpu", "overcommit": 3.0, "kv_blocks": 10,
+                "recompute": {"tokens_identical": True,
+                              "all_completed": True,
+                              "conserved_every_step": True,
+                              "preemptions": 160,
+                              "evictions_recompute": 160,
+                              "evictions_swap": 0},
+                "swap": {"tokens_identical": True, "all_completed": True,
+                         "conserved_every_step": True, "preemptions": 160,
+                         "evictions_recompute": 0, "evictions_swap": 160,
+                         "measured_swap_gbps": 0.5,
+                         "host_pool_drained": True}},
             "roofline_table": [
                 {"function": "train_step", "platform": "tpu",
                  "flops": 1e12, "bytes_accessed": 1e9,
@@ -320,6 +333,44 @@ def test_kv_observatory_rules():
     assert validate_artifact(art) == []
     art["extra"]["kv_observatory"] = {"platform": "cpu",
                                       "skipped_reason": "why not"}
+    assert validate_artifact(art) == []
+
+
+def test_kv_lifecycle_rules():
+    """ISSUE 13: the forced-exhaustion REAL-eviction run must always
+    exist; a measured entry must prove parity/completion/conservation
+    for BOTH preemption flavors, >= 1 actual preemption per flavor, no
+    flavor leakage under the forced modes, and the swap side must carry
+    the measured bandwidth + a drained host pool; errored/skipped
+    entries are exempt."""
+    art = _minimal_art()
+    del art["extra"]["kv_lifecycle"]
+    assert any("kv_lifecycle" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_lifecycle"]["overcommit"] = 1.5
+    assert any("overcommit" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_lifecycle"]["recompute"]["tokens_identical"] = False
+    assert any("recompute.tokens_identical" in e
+               for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_lifecycle"]["swap"]["preemptions"] = 0
+    assert any("swap.preemptions" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_lifecycle"]["recompute"]["evictions_swap"] = 3
+    assert any("evictions_swap must be 0" in e
+               for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["kv_lifecycle"]["swap"]["measured_swap_gbps"]
+    assert any("measured_swap_gbps" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_lifecycle"]["swap"]["host_pool_drained"] = False
+    assert any("host_pool_drained" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_lifecycle"] = {"error": "ValueError: boom"}
+    assert validate_artifact(art) == []
+    art["extra"]["kv_lifecycle"] = {"platform": "cpu",
+                                    "skipped_reason": "why not"}
     assert validate_artifact(art) == []
 
 

@@ -18,6 +18,7 @@ from deeplearning4j_tpu.serving.block_table import (BlockAllocator,
 from deeplearning4j_tpu.telemetry.kv_observatory import attribute_pool
 from deeplearning4j_tpu.serving import kv_cache
 from deeplearning4j_tpu.serving.kv_cache import KVCache
+from deeplearning4j_tpu.serving.lifecycle import HostBlockPool
 
 
 # ---------------------------------------------------------------- allocator
@@ -152,6 +153,131 @@ def test_randomized_alloc_free_fork_stress():
         c.free(0)
     # the run must actually have exercised sharing and COW
     assert c.shared_blocks_total > 0 and c.cow_copies_total > 0
+
+
+def test_randomized_evict_swap_restore_stress():
+    """ISSUE 13: the alloc/free/fork stress extended with EVICT (free a
+    live slot's reservation), SWAP (gather its block bytes into a
+    HostBlockPool first), and RESTORE (re-admit the same prompt and
+    scatter the stashed bytes back into the fresh private blocks). After
+    every op: refcount conservation, pool-byte conservation
+    (attribute_pool), host-pool byte accounting exact, and every live
+    slot's prompt KV bit-equal to its token-determined pattern — a
+    swap round trip through the host pool must be bit-identical, and an
+    eviction must never corrupt the survivors (shared blocks move with
+    refcounts intact)."""
+    rng = random.Random(2024)
+    bs = 4
+    c = KVCache(n_layers=1, max_seqs=6, max_len=64, n_kv_heads=1,
+                head_dim=2, dtype=jnp.float32, block_size=bs,
+                num_blocks=28, prefix_share=True)
+    pool = HostBlockPool(capacity_bytes=1 << 24)
+    families = [[rng.randrange(50) for _ in range(14)] for _ in range(3)]
+    live, reserved = {}, {}          # slot -> tokens / reserved positions
+    key_seq = [0]
+
+    def pattern(tokens):
+        """KV bytes determined by (token, position) alone, so two slots
+        sharing a prefix block agree on its content — exactly the
+        property real prefill has."""
+        n = len(tokens)
+        base = np.asarray(tokens, np.float32)[:, None, None]
+        pos = np.arange(n, dtype=np.float32)[:, None, None] / 128.0
+        k = np.broadcast_to(base + pos, (n, 1, 2)).copy()
+        return k, k + 1000.0
+
+    def write_pattern(slot, tokens):
+        k_pat, v_pat = pattern(tokens)
+        pad = -len(tokens) % bs      # whole blocks, like real prefill
+        if pad:
+            k_pat = np.concatenate([k_pat, np.zeros((pad, 1, 2),
+                                                    np.float32)])
+            v_pat = np.concatenate([v_pat, np.zeros((pad, 1, 2),
+                                                    np.float32)])
+        c.state = kv_cache.write_prefill(c.state, 0, slot,
+                                         jnp.asarray(k_pat),
+                                         jnp.asarray(v_pat))
+        c.state = kv_cache.set_length(c.state, slot, len(tokens))
+
+    def check_all():
+        counts = Counter(b for blocks in c._slot_blocks.values()
+                         for b in blocks)
+        assert c.trash_block not in counts
+        for b in range(c.num_blocks):
+            assert c.allocator.refcount(b) == counts.get(b, 0)
+        att = attribute_pool(c.pool_snapshot(
+            live_positions={s: len(t) for s, t in live.items()}))
+        assert att["conserved"], att
+        assert pool.bytes_used == sum(n for _, _, n in
+                                      pool._entries.values())
+        k = np.asarray(c.state["k"][0])
+        v = np.asarray(c.state["v"][0])
+        for slot, tokens in live.items():
+            k_pat, v_pat = pattern(tokens)
+            row = c._slot_blocks[slot]
+            for li in range(-(-len(tokens) // bs)):
+                lo = li * bs
+                span = min(bs, len(tokens) - lo)
+                np.testing.assert_array_equal(k[row[li], :span],
+                                              k_pat[lo:lo + span])
+                np.testing.assert_array_equal(v[row[li], :span],
+                                              v_pat[lo:lo + span])
+
+    saw_restore = 0
+    for _ in range(200):
+        r = rng.random()
+        if r < 0.45 or not live:
+            fam = rng.choice(families)
+            cut = rng.randrange(4, len(fam) + 1)
+            tokens = fam[:cut] + [rng.randrange(50)
+                                  for _ in range(rng.randrange(0, 3))]
+            n_pos = min(c.max_len, len(tokens) + rng.randrange(1, 9))
+            plan = c.admit("o", n_positions=n_pos, prompt=tokens)
+            if plan is not None:
+                write_pattern(plan.slot, tokens)
+                c.register_prefix(plan.slot, tokens)
+                live[plan.slot] = tokens
+                reserved[plan.slot] = n_pos
+        elif r < 0.65:                               # recompute-evict
+            slot = rng.choice(sorted(live))
+            del live[slot], reserved[slot]
+            c.free(slot)
+        else:                                        # swap-evict + restore
+            slot = rng.choice(sorted(live))
+            tokens, n_pos = live.pop(slot), reserved.pop(slot)
+            row = list(c._slot_blocks[slot])
+            k_blk, v_blk = kv_cache.gather_blocks(c.state, row)
+            nbytes = int(np.asarray(k_blk).nbytes * 2)
+            key = key_seq[0] = key_seq[0] + 1
+            pool.put(key, k_blk, v_blk, nbytes)
+            c.free(slot)
+            check_all()                              # mid-swap invariants
+            plan = c.admit("o", n_positions=n_pos, prompt=tokens)
+            if plan is None:
+                pool.drop(key)                       # request abandoned
+            else:
+                k_host, v_host = pool.fetch(key)
+                new_row = c._slot_blocks[plan.slot]
+                lis = [li for li in range(len(new_row))
+                       if li * bs < len(tokens)
+                       and c.allocator.refcount(new_row[li]) == 1]
+                if lis:
+                    c.state = kv_cache.restore_blocks(
+                        c.state, [new_row[li] for li in lis],
+                        k_host[:, lis], v_host[:, lis])
+                c.state = kv_cache.set_length(c.state, plan.slot,
+                                              len(tokens))
+                c.register_prefix(plan.slot, tokens)
+                live[plan.slot] = tokens
+                reserved[plan.slot] = n_pos
+                saw_restore += 1
+        check_all()
+
+    assert saw_restore > 0                           # the path ran
+    for slot in sorted(live):
+        c.free(slot)
+    assert c.blocks_free == c.num_blocks
+    assert pool.bytes_used >= 0
 
 
 def test_heat_attribution_reference_simulator_stress():

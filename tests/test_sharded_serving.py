@@ -229,6 +229,14 @@ def test_group_stats_aggregates_pinned_keys(forced_host_devices):
     assert 0.0 <= st["kv_used_imbalance"] <= 1.0
     assert 0.0 <= fleet["imbalance"] <= 1.0
     assert "serving_kv_fleet_bytes_free" in grp.metrics.prometheus_text()
+    # ISSUE 13: the lifecycle counters are fleet-meaningful and must ride
+    # the same pinned list (the exact gap this test exists to prevent)
+    assert {"kv_evictions_recompute", "kv_evictions_swap",
+            "kv_preemptions", "kv_swap_out_bytes", "kv_swap_in_bytes",
+            "kv_host_pool_bytes", "prefix_store_hits",
+            "prefix_store_tokens"} <= set(GROUP_SUMMED_KEYS)
+    # lifecycle off in this group: every lifecycle counter sums to zero
+    assert st["kv_preemptions"] == 0 and st["kv_host_pool_bytes"] == 0
 
 
 def test_group_prefix_hit_rate_parity(forced_host_devices):

@@ -43,7 +43,12 @@ HOT_PATH_MODULES = sorted(
      # speculative drafting (ISSUE 11): the n-gram index runs per scheduler
      # iteration; its whole value proposition is ZERO device reads — it may
      # only ever consume token ints the readback already materialized
-     PKG / "serving" / "spec.py"]
+     PKG / "serving" / "spec.py",
+     # KV lifecycle (ISSUE 13): eviction planning runs inside _admit and
+     # swap gathers are dispatched on the hot path — every host
+     # materialization (preempt readback, swap-in, prefix-store fetch)
+     # must be an annotated, counted pressure-path sync
+     PKG / "serving" / "lifecycle.py"]
     + list((PKG / "telemetry").glob("*.py")))
 
 ANNOTATION = "sync-ok:"
@@ -114,7 +119,7 @@ def test_all_hot_path_modules_exist():
             "registry.py", "training.py", "kv_cache.py",
             "block_table.py", "slo.py", "flight_recorder.py",
             "loadgen.py", "sharding.py", "spec.py",
-            "kv_observatory.py"} <= names
+            "kv_observatory.py", "lifecycle.py"} <= names
 
 
 # ------------------------------------------------ scanner self-tests
