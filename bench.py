@@ -1614,6 +1614,122 @@ def bench_kv_lifecycle(vocab=32, d_model=64, heads=2, kv_heads=1,
     return out
 
 
+def bench_blame_attribution(vocab=32, d_model=64, heads=2, kv_heads=1,
+                            n_short=3, short_len=4, long_len=18,
+                            new_tokens=10, block_size=4, prefill_chunk=4,
+                            seed=0):
+    """Latency blame ledger under forced contention (ISSUE 14). The
+    workload manufactures the two pressures the ledger exists to explain:
+    long prompts chunk-prefilling (Sarathi chunks) while short requests
+    sit decode-resident — cross-request interference both ways — and a
+    KV pool too small for aggregate demand, so admission retries and
+    preempt/recompute spans appear in the timelines. The bench ASSERTS
+    (not reports) the invariants: every request's blame spans sum to its
+    submit->retire wall time exactly (the conservation rule PERF.md
+    documents, same spirit as the ISSUE 12 pool-byte partition), at
+    least one interference edge is found, and running the ledger + fleet
+    report is bit-parity with not running it — identical greedy tokens,
+    identical counted host syncs (the ledger is post-hoc host arithmetic
+    over timestamps the scheduler already took). The violators-vs-
+    attainers split joins the SLO evaluator at the measured median TTFT,
+    so the published top-blame table answers 'why was the slow half
+    slow' on THIS host. CPU-runnable; every artifact carries it."""
+    from deeplearning4j_tpu import (
+        Activation, InputType, NeuralNetConfiguration, RnnOutputLayer,
+        Sgd, WeightInit)
+    from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import Request, ServingEngine
+    from deeplearning4j_tpu.telemetry import blame
+    from deeplearning4j_tpu.telemetry.slo import SLO
+
+    b = (NeuralNetConfiguration.Builder().seed(42)
+         .weight_init(WeightInit.XAVIER)
+         .updater(Sgd(learning_rate=1e-3)).list())
+    for _ in range(2):
+        b.layer(SelfAttentionLayer(n_out=d_model, n_heads=heads,
+                                   n_kv_heads=kv_heads, causal=True,
+                                   block_size=0))
+    b.layer(RnnOutputLayer(n_out=vocab, activation=Activation.SOFTMAX))
+    net = MultiLayerNetwork(
+        b.set_input_type(InputType.recurrent(vocab)).build()).init()
+    rng = np.random.RandomState(seed)
+    prompts = [rng.randint(0, vocab, short_len).tolist()
+               for _ in range(n_short)]
+    prompts += [rng.randint(0, vocab, long_len).tolist() for _ in range(2)]
+    max_len = 1 << (long_len + new_tokens - 1).bit_length()
+    demand = sum(-(-(len(p) + new_tokens) // block_size) for p in prompts)
+    kv_blocks = max(-(-(long_len + new_tokens) // block_size) + 1,
+                    demand // 2)                       # ~2x overcommit
+
+    def serve(with_ledger):
+        eng = ServingEngine(net, max_seqs=4, max_len=max_len, seed=0,
+                            decode_chunk=1, overlap=False,
+                            kv_block=block_size, prefix_share=True,
+                            prefill_chunk=prefill_chunk,
+                            kv_blocks=kv_blocks, kv_evict="lru",
+                            kv_evict_mode="recompute")
+        res = eng.generate([Request(list(p), max_new_tokens=new_tokens)
+                            for p in prompts])
+        st = eng.stats()
+        report = None
+        if with_ledger:
+            led = blame.build_ledger(res)
+            for entry in led["requests"]:
+                blame.assert_conserved(entry)   # spans == latency, exactly
+            ttfts = sorted(r.ttft_s for r in res)
+            slo = SLO(ttft_s=ttfts[len(ttfts) // 2], tpot_s=3600.0)
+            report = blame.blame_report(res, slo=slo)
+        return [r.tokens for r in res], st, report
+
+    tok_on, st_on, report = serve(True)
+    tok_off, st_off, _ = serve(False)
+    assert tok_on == tok_off, \
+        "ledger on/off changed decoded tokens — parity violation"
+    assert st_on["host_syncs"] == st_off["host_syncs"], \
+        "ledger added host syncs — it must be post-hoc host arithmetic"
+    assert report["conserved"], "fleet blame failed conservation"
+    assert report["n_interference_edges"] >= 1, \
+        "forced contention produced no interference edges"
+
+    def _top(side):
+        return [[c, round(s, 6)] for c, s in report[side]["top"]]
+
+    return {
+        "workload": (f"{n_short} x {short_len}-token decoders resident "
+                     f"while 2 x {long_len}-token prompts chunk-prefill "
+                     f"({prefill_chunk}/chunk) into a {kv_blocks}-block "
+                     f"pool (~{demand / kv_blocks:.1f}x overcommit), "
+                     f"{new_tokens} greedy tokens each"),
+        "conserved": True,               # asserted per request above
+        "tokens_identical": True,        # asserted vs ledger-off run
+        "sync_parity": True,             # asserted vs ledger-off run
+        "host_syncs": st_on["host_syncs"],
+        "preemptions": st_on["kv_preemptions"],
+        "interference_edges": report["n_interference_edges"],
+        "cause_totals_s": {c: round(s, 6)
+                           for c, s in report["totals"].items()},
+        "slo_ttft_s": round(report["slo"]["ttft_s"], 6),
+        "p99_latency_s": round(report["p99_latency_s"], 6),
+        "violators": {"n": report["violators"]["n"],
+                      "top": _top("violators")},
+        "attainers": {"n": report["attainers"]["n"],
+                      "top": _top("attainers")},
+        "worst": {"req_id": report["worst"]["req_id"],
+                  "latency_s": round(report["worst"]["latency_s"], 6),
+                  "top": [[c, round(s, 6)]
+                          for c, s in report["worst"]["top"]]},
+        "note": ("per-request conservation, ledger-on/off token + "
+                 "host-sync bit-parity, and >=1 interference edge are "
+                 "ASSERTED; the SLO join uses the run's own median TTFT "
+                 "as the budget so violators-vs-attainers is meaningful "
+                 "on any host; causes are wall-clock seconds summed over "
+                 "the fleet (interference seconds are also inside the "
+                 "stalled request's own partition, charged to the "
+                 "interfering req_id in the edges)"),
+    }
+
+
 def bench_sharded_serving(vocab=32, d_model=64, heads=4, kv_heads=2,
                           tp=2, max_seqs=4, n_requests=24, seed=0,
                           overload_factor=10.0, repeats=3,
@@ -2003,6 +2119,10 @@ def main():
         kv_life = bench_kv_lifecycle()
     except Exception as e:
         kv_life = {"error": f"{type(e).__name__}: {e}"}
+    try:  # latency blame ledger under forced contention (ISSUE 14)
+        blame_attr = bench_blame_attribution()
+    except Exception as e:
+        blame_attr = {"error": f"{type(e).__name__}: {e}"}
     try:  # multi-chip sharded serving (ISSUE 10): TP parity + replica A/B
         sharded = bench_sharded_serving()
         if "skipped" not in sharded:
@@ -2094,6 +2214,9 @@ def main():
             # pre-rounded; always present — CPU-runnable forced-exhaustion
             # eviction/swap parity run (ISSUE 13)
             "kv_lifecycle": kv_life,
+            # pre-rounded; always present — CPU-runnable forced-contention
+            # blame ledger: conservation + parity asserted (ISSUE 14)
+            "blame_attribution": blame_attr,
             "decode_tokens_per_sec": round(
                 decode.get("decode_tokens_per_sec", 0.0), 1),
             "serving_profile": serving_profile,

@@ -95,6 +95,7 @@ host_syncs_per_token from their ratio.
 from __future__ import annotations
 
 import functools
+import itertools
 import os
 import threading
 import time
@@ -120,6 +121,12 @@ from deeplearning4j_tpu.serving.sampler import (Sampler, sample_tokens,
 # per-iteration prefill token budget (chunked prefill, ISSUE 9); env
 # DL4J_TPU_PREFILL_CHUNK overrides, 0 disables chunking entirely
 DEFAULT_PREFILL_CHUNK = 256
+
+#: Scheduler-iteration ids, unique ACROSS engines in the process: chunk
+#: timeline events carry one so the blame ledger (telemetry/blame.py)
+#: only pairs requests that truly shared an iteration — fleet-level
+#: ledgers never build interference edges across replicas.
+_ITER_IDS = itertools.count(1)
 
 
 @dataclass
@@ -411,7 +418,8 @@ class ServingEngine:
                  kv_evict=None,
                  kv_swap_bytes: Optional[int] = None,
                  kv_evict_mode: str = "auto",
-                 prefix_store=None):
+                 prefix_store=None,
+                 name: Optional[str] = None):
         self.decoder = self._build_decoder(net, max_seqs, max_len,
                                            dtype=dtype,
                                            block_size=kv_block,
@@ -609,6 +617,16 @@ class ServingEngine:
         # scheduler already holds — zero added device syncs (parity-tested).
         # Enable by passing flight_recorder= or via DL4J_TPU_FLIGHT_RECORDER.
         self._next_req_id = 0
+        # blame/observability identity (ISSUE 14): `name` labels flight-
+        # recorder records and tracer tracks (a ShardedServingGroup passes
+        # "replica<r>"); `_snapshot_seq` is a lock-held iteration counter
+        # exposed via stats() so scrapers can detect stale/torn snapshots;
+        # `_iter_id` stamps chunk events with a process-globally unique
+        # scheduler-iteration id for cross-request interference matching.
+        self.name = name
+        self.replica_id: Optional[int] = None
+        self._snapshot_seq = 0
+        self._iter_id = 0
         if flight_recorder is None:
             fr = os.environ.get("DL4J_TPU_FLIGHT_RECORDER", "")
             if fr and fr != "0":
@@ -730,6 +748,7 @@ class ServingEngine:
             # separate property reads could straddle an admission
             snap = self.decoder.cache.pool_snapshot(include_blocks=False)
             return {"host_syncs": syncs, "tokens_out": toks,
+                    "snapshot_seq": self._snapshot_seq,
                     "decode_chunk": self.decode_chunk,
                     "prefill_chunk": self.prefill_chunk,
                     "prefill_chunks": self._c_pf_chunks.value,
@@ -834,7 +853,12 @@ class ServingEngine:
                 # returns the tokens it had generated before eviction
                 toks_out = [int(t) for t in act.resume["tokens"]] \
                     if act.resume is not None else []
-                act.timeline.append({"phase": "queue", "t0": act.t_submit,
+                # a requeued request's pre-preemption life is already
+                # tiled up to t_requeue — starting this queue span at
+                # t_submit would overlap it (ISSUE 14 satellite)
+                t_q0 = act.resume["t_requeue"] if act.resume is not None \
+                    else act.t_submit
+                act.timeline.append({"phase": "queue", "t0": t_q0,
                                      "t1": now, "retries": act.retries})
                 if act.kv_rejection is not None:
                     act.timeline.append(act.kv_rejection)
@@ -956,7 +980,8 @@ class ServingEngine:
                 act.timeline.append(
                     {"phase": "admission", "t0": t_adm0,
                      "t1": time.monotonic(), "slot": slot,
-                     "blocks": plan.n_blocks, "shared": shared})
+                     "blocks": plan.n_blocks, "shared": shared,
+                     "iter": self._iter_id})
                 self._prefilling.append(act)
                 self._update_kv_resident()
                 continue
@@ -983,7 +1008,8 @@ class ServingEngine:
             t_pf_mono = time.monotonic()
             act.timeline.append({"phase": "admission", "t0": t_adm0,
                                  "t1": t_pf_mono, "slot": slot,
-                                 "blocks": plan.n_blocks, "shared": shared})
+                                 "blocks": plan.n_blocks, "shared": shared,
+                                 "iter": self._iter_id})
             had_active = bool(self._active_mask.any())
             with cm, telemetry.span("prefill", req=act.req_id, slot=slot,
                                     plen=plen_eff, bucket=bucket,
@@ -1009,10 +1035,12 @@ class ServingEngine:
             cache.touch_blocks(slot, shared, plen_eff)
             name = f"prefill_shared_b{skey[0]}k{skey[1]}" if shared \
                 else f"prefill_b{bucket}"
-            self._finish_first_token(
-                act, lp, t_pf, t_pf_mono,
-                {"plen": plen_eff, "bucket": bucket, "shared": shared},
-                prof_name=name)
+            extras = {"plen": plen_eff, "bucket": bucket, "shared": shared,
+                      "iter": self._iter_id}
+            if miss:
+                extras["compile"] = True   # blame: whole span is jit_compile
+            self._finish_first_token(act, lp, t_pf, t_pf_mono, extras,
+                                     prof_name=name)
 
     def _finish_first_token(self, act: _Active, lp, t_pf: float,
                             t_pf_mono: float, extras: dict,
@@ -1117,11 +1145,14 @@ class ServingEngine:
             # bounded stall that replaces the whole-prompt one
             self._h_stall.observe(wall_ms)
         now = time.monotonic()
-        act.timeline.append({"phase": "prefill_chunk", "t0": t0_mono,
-                             "t1": now, "chunk": act.n_chunks,
-                             "tokens": end - start,
-                             "shared": act.shared_len if act.n_chunks == 0
-                             else 0})
+        ev = {"phase": "prefill_chunk", "t0": t0_mono,
+              "t1": now, "chunk": act.n_chunks,
+              "tokens": end - start,
+              "shared": act.shared_len if act.n_chunks == 0 else 0,
+              "iter": self._iter_id, "wall_s": wall_ms / 1e3}
+        if miss:
+            ev["compile"] = True
+        act.timeline.append(ev)
         act.n_chunks += 1
         act.prefilled = end
         # heat stamp exactly this chunk's positions — earlier chunks were
@@ -1138,7 +1169,8 @@ class ServingEngine:
             self._finish_first_token(
                 act, lp, t_pf, now,
                 {"plen": plen, "chunks": act.n_chunks,
-                 "shared": act.shared_len, "bucket": skey[0]})
+                 "shared": act.shared_len, "bucket": skey[0],
+                 "iter": self._iter_id})
         self._update_kv_resident()
 
     def _retire(self, slot: int, default_reason: str, hist=None) -> None:
@@ -1215,7 +1247,7 @@ class ServingEngine:
         bookkeeping only — the timeline was built from timestamps the
         scheduler already took, so recording adds zero device syncs)."""
         if self.flight_recorder is not None:
-            self.flight_recorder.record(result)
+            self.flight_recorder.record(result, source=self.name)
 
     def _live_kv_positions(self) -> Dict[int, int]:
         """Per-slot KV positions actually WRITTEN, matching the device's
@@ -1577,9 +1609,12 @@ class ServingEngine:
         final mask dropped. `snapshot` is the slot->request map AT DISPATCH
         — the overlapped pipeline may have retired/reassigned a slot since,
         and a stale mask must never touch the new occupant (identity
-        check). `span` = (t0, k): iteration start on the monotonic clock +
-        chunk size, appended to each participating request's timeline as
-        its "decode_chunk" event with t1 stamped HERE, per slot — the
+        check). `span` = {"t0", "k", "wall_s", "iter", "compile"}:
+        iteration start on the monotonic clock, chunk size, the chunk's
+        measured dispatch wall, the scheduler-iteration id and cache-miss
+        flag (blame attribution, ISSUE 14) — appended to each
+        participating request's timeline as its "decode_chunk" event with
+        t1 stamped HERE, per slot — the
         iteration span rather than pure device wall, and late enough that
         another slot's slow retirement readback earlier in this loop stays
         inside the remaining slots' coverage (no timeline gaps). Lock
@@ -1599,9 +1634,13 @@ class ServingEngine:
             self.decoder.cache.touch_blocks(slot, p_end - n_new, p_end)
             self._c_tokens.inc(n_new)
             if span is not None:
-                act.timeline.append({"phase": "decode_chunk", "t0": span[0],
-                                     "t1": time.monotonic(), "k": span[1],
-                                     "tokens": n_new})
+                ev = {"phase": "decode_chunk", "t0": span["t0"],
+                      "t1": time.monotonic(), "k": span["k"],
+                      "tokens": n_new, "iter": span["iter"],
+                      "wall_s": span["wall_s"]}
+                if span.get("compile"):
+                    ev["compile"] = True
+                act.timeline.append(ev)
             if lp_np is not None and act.logprobs is not None:
                 act.logprobs.extend(lp_np[i, slot] for i in range(K)
                                     if entry_np[i, slot])
@@ -1619,6 +1658,11 @@ class ServingEngine:
         (peeked keys, effective-step commit)."""
         with self._lock:
             t_iter0 = time.monotonic()   # iteration start: timeline anchor
+            self._snapshot_seq += 1      # stats() torn-read detector
+            self._iter_id = next(_ITER_IDS)   # blame interference stamp
+            if self.name is not None:
+                telemetry.set_track(self.name, replica_id=self.replica_id,
+                                    engine=type(self).__name__)
             # heat clock: one tick per scheduler iteration (a host int —
             # the unit every block heat stamp is expressed in)
             self.decoder.cache.allocator.tick()
@@ -1695,7 +1739,10 @@ class ServingEngine:
             # sync-ok: capture_logprobs mode only
             lp_np = np.asarray(lps) if self.capture_logprobs else None
             self._finish_steps(snapshot, entry_np, new_np, lp_np,
-                               span=(t_iter0, k_eff))
+                               span={"t0": t_iter0, "k": k_eff,
+                                     "wall_s": chunk_ms / 1e3,
+                                     "iter": self._iter_id,
+                                     "compile": miss})
             return bool(self._by_slot or self._queue)
 
     def _spec_step(self, snapshot: Dict[int, _Active], active,
@@ -1799,9 +1846,13 @@ class ServingEngine:
                 self._h_spec_draft.observe(d_s)
             # tiles from iteration start like "decode_chunk" — resident
             # requests keep gap-free timeline coverage under spec
-            act.timeline.append({"phase": "spec_step", "t0": t_iter0,
-                                 "t1": time.monotonic(), "draft": d_s,
-                                 "accepted": acc, "tokens": n_new})
+            ev = {"phase": "spec_step", "t0": t_iter0,
+                  "t1": time.monotonic(), "draft": d_s,
+                  "accepted": acc, "tokens": n_new,
+                  "iter": self._iter_id, "wall_s": chunk_ms / 1e3}
+            if miss:
+                ev["compile"] = True
+            act.timeline.append(ev)
             if lp_np is not None and act.logprobs is not None:
                 act.logprobs.extend(lp_np[slot, j] for j in range(n_new))
             if not new_np[slot]:
@@ -1820,7 +1871,8 @@ class ServingEngine:
         the device mask before the next dispatch. Keys are consumed
         unconditionally here (throughput mode — the strict cross-K key
         schedule is a synchronous-step guarantee)."""
-        pending = None  # (snapshot, entries_dev, final_dev, hist_dev, nf, t0)
+        pending = None  # (snapshot, entries_dev, final_dev, hist_dev, nf,
+        #                  t_disp, k_eff, t_iter0, iter_id, compile_miss)
         with self._lock:
             self._dev_active = jnp.asarray(self._active_mask)
         try:
@@ -1828,6 +1880,12 @@ class ServingEngine:
                 with self._lock:
                     t_iter0 = time.monotonic()   # timeline anchor: covers
                     # this iteration's admissions + the dispatch it issues
+                    self._snapshot_seq += 1      # stats() torn-read detector
+                    self._iter_id = next(_ITER_IDS)  # blame stamp
+                    if self.name is not None:
+                        telemetry.set_track(self.name,
+                                            replica_id=self.replica_id,
+                                            engine=type(self).__name__)
                     self.decoder.cache.allocator.tick()   # heat clock
                     self._admit()
                     self._expire_timeouts()
@@ -1871,12 +1929,12 @@ class ServingEngine:
                                 keys, jnp.asarray(self._temps))
                         dispatched = (snapshot, entries, self._dev_active,
                                       self._hist, nf, time.perf_counter(),
-                                      k_eff, t_iter0)
+                                      k_eff, t_iter0, self._iter_id, miss)
                     # chunk i+1 is enqueued; materializing chunk i's masks
                     # now overlaps host bookkeeping with device compute
                     if pending is not None:
                         (snapshot, entries, final, hist, nf, t_disp,
-                         k_prev, t_disp_mono) = pending
+                         k_prev, t_disp_mono, it_prev, miss_prev) = pending
                         with telemetry.span("host_sync", what="chunk_masks",
                                             overlap=True):
                             # sync-ok: the counted per-chunk readback
@@ -1902,7 +1960,11 @@ class ServingEngine:
                         # resident requests keep gap-free coverage
                         self._finish_steps(snapshot, entry_np, new_np, None,
                                            hist=hist,
-                                           span=(t_disp_mono, k_prev))
+                                           span={"t0": t_disp_mono,
+                                                 "k": k_prev,
+                                                 "wall_s": chunk_ms / 1e3,
+                                                 "iter": it_prev,
+                                                 "compile": miss_prev})
                     pending = dispatched
                     if pending is None and not (self._by_slot or self._queue):
                         return
@@ -1974,8 +2036,12 @@ class ServingEngine:
                     self._retire(slot, "shutdown")
                 for act in self._queue:
                     now = time.monotonic()
+                    # requeued-after-preemption: tile from t_requeue, the
+                    # pre-preemption life is already covered (ISSUE 14)
+                    t_q0 = act.resume["t_requeue"] \
+                        if act.resume is not None else act.t_submit
                     act.timeline.append({"phase": "queue",
-                                         "t0": act.t_submit, "t1": now,
+                                         "t0": t_q0, "t1": now,
                                          "retries": act.retries})
                     act.fut._set(GenerationResult(
                         [], "shutdown", len(act.req.tokens),

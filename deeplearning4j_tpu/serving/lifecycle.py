@@ -51,6 +51,14 @@ without it (parity-tested). Enabled, the only added materializations are
 on the PRESSURE paths (preemption history readback, swap-in fetch,
 prefix-store fetch), every one `# sync-ok`-annotated and counted.
 
+Blame attribution (ISSUE 14): every lifecycle action leaves a timeline
+span the blame ledger (telemetry/blame.py) charges exactly — "preempt"
+spans and "swap_in" restores to `preempt_swap_io` (swap mode) or
+`preempt_recompute` (recompute mode), the resumed re-prefill
+(`resume: True`) to `preempt_recompute`, and the requeue wait between
+preemption and readmission tiles from `resume["t_requeue"]` so the
+partition of submit->retire stays exact under pressure.
+
 Env knobs: `DL4J_TPU_KV_EVICT` (policy name, empty/0/off disables),
 `DL4J_TPU_KV_SWAP_BYTES` (host-pool cap in bytes; 0 = recompute-only),
 `DL4J_TPU_PREFIX_STORE` (spill-file path, also enables the store).

@@ -89,6 +89,10 @@ GROUP_SUMMED_KEYS: Tuple[str, ...] = (
     "kv_evictions_recompute", "kv_evictions_swap", "kv_preemptions",
     "kv_swap_out_bytes", "kv_swap_in_bytes", "kv_host_pool_bytes",
     "prefix_store_hits", "prefix_store_tokens",
+    # ISSUE 14: group snapshot_seq = per-replica scheduler-iteration
+    # counters summed — still strictly monotonic while any replica steps,
+    # so scrapers can detect stale/torn fleet snapshots the same way
+    "snapshot_seq",
 )
 
 
@@ -480,14 +484,22 @@ class ShardedServingGroup:
         self.prefix_store = resolve_prefix_store(
             engine_kw.pop("prefix_store", None))
         self.engines: List[ShardedServingEngine] = []
+        base_name = engine_kw.pop("name", None) or "replica"
         for r, submesh in enumerate(replica_submeshes(self.mesh,
                                                       tensor_axis)):
-            self.engines.append(ShardedServingEngine(
+            eng = ShardedServingEngine(
                 net, max_seqs, max_len, mesh=submesh,
                 tensor_axis=tensor_axis, seed=seed + r,
                 metrics_parent=self.metrics,
                 prefix_registry=self.registries[r],
-                prefix_store=self.prefix_store, **engine_kw))
+                prefix_store=self.prefix_store,
+                name=f"{base_name}{r}",
+                **engine_kw)
+            # replica identity (ISSUE 14 satellite): labels the engine's
+            # tracer track and flight-recorder records so multi-replica
+            # Perfetto dumps are distinguishable
+            eng.replica_id = r
+            self.engines.append(eng)
         self._lock = threading.Lock()
         self._rr = 0
         self._cohorts: "OrderedDict[tuple, int]" = OrderedDict()
@@ -648,3 +660,21 @@ class ShardedServingGroup:
         return {**fleet, "imbalance": imbalance, "per_replica": per,
                 "conserved": all(p["attribution"]["conserved"]
                                  for p in per)}
+
+    def blame_report(self, results, slo=None, top: int = 3
+                     ) -> Dict[str, object]:
+        """Fleet blame report (ISSUE 14): run the blame ledger over the
+        given finished results/outcomes (from `generate`, a loadgen run,
+        or flight-recorder records), join the SLO evaluator's violator
+        set, and publish the violators-vs-attainers and per-cohort cause
+        breakdowns as serving.blame.* gauges on the group registry.
+
+        Iteration ids in the timelines are process-globally unique, so
+        interference edges never pair requests from different replicas
+        even though the ledger sees the whole fleet at once. Host-side
+        arithmetic over timestamps the engines already took — zero
+        device syncs."""
+        from deeplearning4j_tpu.telemetry import blame as _blame
+        report = _blame.blame_report(results, slo=slo, top=top)
+        _blame.publish(report, self.metrics)
+        return report

@@ -58,8 +58,9 @@ __all__ = [
     "Counter", "Gauge", "Histogram", "MetricsRegistry", "Tracer",
     "DEFAULT_MS_BUCKETS", "DEFAULT_S_BUCKETS", "registry", "tracer", "span",
     "instant", "enabled", "configure", "maybe_export_trace", "metrics_route",
-    "PROMETHEUS_CONTENT_TYPE", "sanitize_component", "health", "profiler",
-    "memory", "slo", "flight_recorder", "kv_observatory",
+    "PROMETHEUS_CONTENT_TYPE", "sanitize_component", "set_track", "health",
+    "profiler", "memory", "slo", "flight_recorder", "kv_observatory",
+    "blame",
 ]
 
 from deeplearning4j_tpu.telemetry.registry import sanitize_component  # noqa: E402,F401
@@ -69,10 +70,10 @@ def __getattr__(name):
     # health (ISSUE 5) / profiler / memory (ISSUE 6) import jax (lazily in
     # the ISSUE 6 pair's case, but profiler also pulls util.costs) — loaded
     # on first attribute access so registry/tracing users stay jax-free.
-    # slo / flight_recorder (ISSUE 8) are jax-free but rarely needed, so
-    # they load lazily too
+    # slo / flight_recorder (ISSUE 8) / blame (ISSUE 14) are jax-free but
+    # rarely needed, so they load lazily too
     if name in ("health", "profiler", "memory", "slo", "flight_recorder",
-                "kv_observatory"):
+                "kv_observatory", "blame"):
         import importlib
         return importlib.import_module(
             f"deeplearning4j_tpu.telemetry.{name}")
@@ -124,6 +125,14 @@ def instant(name: str, **args) -> None:
     disabled)."""
     if _ENABLED:
         _TRACER.instant(name, **args)
+
+
+def set_track(name: Optional[str], **meta) -> None:
+    """Route the calling thread's spans onto a named track in the global
+    tracer (replica engines label their scheduler threads, ISSUE 14
+    satellite). `meta` (e.g. replica_id) lands on the track's
+    thread_name metadata event in the Perfetto export."""
+    _TRACER.set_track(name, **meta)
 
 
 def maybe_export_trace(path: Optional[str] = None) -> Optional[str]:

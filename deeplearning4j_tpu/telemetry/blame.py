@@ -1,0 +1,483 @@
+"""Latency blame ledger (ISSUE 14): exact critical-path attribution.
+
+The observatory stack (flight recorder, KV observatory, lifecycle spans)
+records *what happened* to a request; this module answers *why it was
+slow*.  It consumes the gap-free ``GenerationResult.timeline`` every
+request already carries — queue waits, KV-rejection instants, admission,
+prefill (monolithic / chunked / resumed), decode chunks, spec steps,
+preempt + swap spans, the retire readback — and partitions each
+request's submit->retire wall time into a closed set of causes:
+
+======================================  =================================
+cause                                   charged for
+======================================  =================================
+``queue_wait``                          FIFO wait before first admission
+                                        attempt saw KV pressure
+``admission_retry_kv_pressure``         queue time after the first
+                                        KV-rejection instant
+``prefill_compute``                     prefill dispatch + first token
+``prefill_chunk_interference``          decode stalled behind another
+                                        request's prefill chunk, and
+                                        symmetrically prefill chunks
+                                        waiting behind resident decode
+``decode_compute``                      decode / spec-step chunks
+``host_sync``                           retire-time history readback
+``jit_compile``                         any chunk that triggered a fresh
+                                        XLA compile (``compile: True``)
+``preempt_recompute``                   recompute-mode preemption spans +
+                                        resumed re-prefill
+``preempt_swap_io``                     swap-mode preemption + swap-in
+``scheduler_other``                     admission bookkeeping and any
+                                        residual scheduler gap
+======================================  =================================
+
+Two invariants, both enforced the way the PR 12 pool-byte invariant is:
+
+* **Conservation** — the per-request cause durations are built by a
+  sweep that clips overlapping events into disjoint segments and fills
+  inter-event gaps with ``scheduler_other``, so they tile
+  ``[min t0, max t1]`` *exactly*.  ``assert_conserved`` raises when
+  ``fsum(causes) != latency`` beyond float rounding.
+* **Zero added syncs** — everything here is host-side arithmetic over
+  floats the engine already materialized; the ledger never touches a
+  device buffer (bit-parity ledger-on-vs-off is asserted in
+  ``bench_blame_attribution`` and tests/test_blame.py).
+
+Interference edges ("who stalled whom") are built from overlapping
+spans *within one scheduler iteration*: decode/prefill events carry the
+engine's globally unique ``iter`` stamp, so fleet-level ledgers never
+pair requests from different replicas.  The charged sub-interval is
+relabeled ``prefill_chunk_interference`` (union-merged across chargers,
+so conservation survives), and each edge records the stalled request,
+the interfering ``req_id``, the direction, and the seconds charged.
+"""
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.telemetry.slo import request_attains, split_attainment
+
+__all__ = [
+    "CAUSES", "EPS_S", "event_cause", "exec_interval", "partition",
+    "blame_timeline", "build_ledger", "assert_conserved", "top_causes",
+    "blame_report", "annotate_record", "publish",
+]
+
+#: Closed cause taxonomy (ISSUE 14 order).  bench_schema gates on this.
+CAUSES: Tuple[str, ...] = (
+    "queue_wait",
+    "admission_retry_kv_pressure",
+    "prefill_compute",
+    "prefill_chunk_interference",
+    "decode_compute",
+    "host_sync",
+    "jit_compile",
+    "preempt_recompute",
+    "preempt_swap_io",
+    "scheduler_other",
+)
+
+#: Absolute conservation tolerance (seconds).  Segments tile by
+#: construction, so the only slack needed is fsum-vs-subtraction ulps.
+EPS_S = 1e-9
+
+_DECODE_PHASES = ("decode_chunk", "spec_step")
+_PREFILL_PHASES = ("prefill", "prefill_chunk")
+
+
+def _get(rec, key, default=None):
+    """Duck-typed field access: GenerationResult / RequestOutcome attrs
+    or flight-recorder record dicts."""
+    if isinstance(rec, dict):
+        return rec.get(key, default)
+    return getattr(rec, key, default)
+
+
+def event_cause(ev: dict) -> str:
+    """Map one timeline event to its blame cause."""
+    ph = ev.get("phase")
+    if ev.get("compile") and (ph in _DECODE_PHASES or ph in _PREFILL_PHASES):
+        return "jit_compile"
+    if ph == "queue":
+        return "queue_wait"
+    if ph == "admission":
+        return "scheduler_other"
+    if ph == "prefill":
+        return "preempt_recompute" if ev.get("resume") else "prefill_compute"
+    if ph == "prefill_chunk":
+        return "prefill_compute"
+    if ph in _DECODE_PHASES:
+        return "decode_compute"
+    if ph == "preempt":
+        return "preempt_swap_io" if ev.get("mode") == "swap" \
+            else "preempt_recompute"
+    if ph == "swap_in":
+        return "preempt_swap_io"
+    if ph == "retire":
+        return "host_sync"
+    return "scheduler_other"
+
+
+def exec_interval(ev: dict) -> Tuple[float, float]:
+    """The sub-span an event actually occupied the device.
+
+    Chunk events carry ``wall_s`` (the dispatch+readback wall the engine
+    already measured); the remainder of the event span is scheduler wait
+    (chunk events tile from the previous event's t1).  Events without
+    ``wall_s`` (monolithic prefill, preempt, swap) are all-exec.
+    """
+    w = ev.get("wall_s")
+    if w is None:
+        return (ev["t0"], ev["t1"])
+    return (max(ev["t0"], ev["t1"] - w), ev["t1"])
+
+
+def partition(timeline: Sequence[dict]) -> List[dict]:
+    """Sweep-clip a (possibly overlapping) timeline into DISJOINT
+    segments exactly tiling ``[min t0, max t1]``.
+
+    Overlap policy: earlier-starting events win the overlap; later
+    events contribute only their uncovered suffix.  Holes between
+    events become ``scheduler_other`` segments, so the tiling — and
+    therefore conservation — holds even for timelines that are only
+    *boundedly* gap-free (overlapped drain intentionally overlaps
+    consecutive decode chunks).
+
+    Queue segments are split at the request's first KV-rejection
+    instant: wait before it is ``queue_wait``, wait after it is
+    ``admission_retry_kv_pressure``.
+    """
+    evs = [ev for ev in timeline
+           if ev.get("t1") is not None and ev["t1"] >= ev["t0"]]
+    if not evs:
+        return []
+    rejections = sorted(ev["t0"] for ev in evs
+                        if ev.get("phase") == "kv_rejection")
+    order = sorted(evs, key=lambda e: (e["t0"], e["t1"]))
+    segs: List[dict] = []
+
+    def emit(a: float, b: float, cause: str, phase: str,
+             exec_t0: Optional[float] = None) -> None:
+        if b > a:
+            segs.append({"t0": a, "t1": b, "cause": cause,
+                         "phase": phase, "exec_t0": exec_t0})
+
+    cursor = order[0]["t0"]
+    for ev in order:
+        a, b = max(ev["t0"], cursor), ev["t1"]
+        if b <= cursor:
+            continue                      # fully covered by earlier events
+        if a > cursor:
+            emit(cursor, a, "scheduler_other", "gap")
+        cause = event_cause(ev)
+        if cause == "queue_wait" and ev.get("retries"):
+            t_rej = next((t for t in rejections if a <= t <= b), None)
+            if t_rej is not None:
+                emit(a, t_rej, "queue_wait", "queue")
+                emit(t_rej, b, "admission_retry_kv_pressure", "queue")
+            else:
+                emit(a, b, "queue_wait", "queue")
+        else:
+            emit(a, b, cause, ev.get("phase", "?"), exec_interval(ev)[0])
+        cursor = b
+    return segs
+
+
+def _merge_intervals(ivs: List[Tuple[float, float]]
+                     ) -> List[Tuple[float, float]]:
+    out: List[List[float]] = []
+    for a, b in sorted(ivs):
+        if out and a <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], b)
+        else:
+            out.append([a, b])
+    return [(a, b) for a, b in out]
+
+
+def _entry(req_id, segs: List[dict], edges: List[dict]) -> dict:
+    buckets: Dict[str, List[float]] = {c: [] for c in CAUSES}
+    for s in segs:
+        buckets[s["cause"]].append(s["t1"] - s["t0"])
+    causes = {c: math.fsum(v) for c, v in buckets.items()}
+    if segs:
+        t0, t1 = segs[0]["t0"], segs[-1]["t1"]
+    else:
+        t0 = t1 = 0.0
+    latency = t1 - t0
+    total = math.fsum(s["t1"] - s["t0"] for s in segs)
+    conserved = abs(total - latency) <= EPS_S + 1e-9 * abs(latency)
+    return {"req_id": req_id, "t0": t0, "t1": t1, "latency_s": latency,
+            "causes": causes, "conserved": conserved,
+            "segments": [{"t0": s["t0"], "t1": s["t1"], "cause": s["cause"]}
+                         for s in segs],
+            "edges": edges}
+
+
+def blame_timeline(timeline: Sequence[dict], req_id=None) -> dict:
+    """Single-request blame entry (no cross-request interference)."""
+    return _entry(req_id, partition(timeline), [])
+
+
+def assert_conserved(entry: dict, tol: Optional[float] = None) -> None:
+    """Raise AssertionError unless the entry's cause durations sum to
+    its latency — the ledger analogue of the PR 12 pool-byte invariant."""
+    lat = entry["latency_s"]
+    got = math.fsum(entry["causes"].values())
+    if tol is None:
+        tol = EPS_S + 1e-9 * abs(lat)
+    if abs(got - lat) > tol:
+        raise AssertionError(
+            f"blame not conserved for req {entry['req_id']}: causes sum "
+            f"{got!r} != latency {lat!r} (|diff| {abs(got - lat):.3e} > "
+            f"{tol:.3e})")
+
+
+def _coresident(rx: dict, ry: dict) -> bool:
+    """May rx and ry interfere?  Yes iff they shared a scheduler
+    iteration (``iter`` stamps are process-globally unique, so requests
+    on different replicas never pair).  Hand-built timelines without
+    iter stamps fall back to time overlap only."""
+    if not rx["iters"] or not ry["iters"]:
+        return True
+    return bool(rx["iters"] & ry["iters"])
+
+
+def build_ledger(results: Iterable, interference: bool = True) -> dict:
+    """Blame every result and (optionally) attribute cross-request
+    interference.
+
+    Direction 1 — *prefill stalls decode*: the part of X's
+    ``decode_compute`` time that overlaps another resident Y's prefill
+    exec window is relabeled ``prefill_chunk_interference`` and charged
+    to Y's req_id.  Direction 2 — *decode delays prefill*: the waiting
+    prefix of Y's ``prefill_compute`` chunks (before the chunk's own
+    exec window) overlapping X's decode exec windows is relabeled the
+    same way, edge reversed.  Charger windows are union-merged per
+    segment before relabeling, so overlapping chargers never
+    double-subtract and conservation is preserved by construction.
+    """
+    reqs = []
+    for r in results:
+        tl = list(_get(r, "timeline", None) or ())
+        reqs.append({
+            "req_id": _get(r, "req_id", None),
+            "segs": partition(tl),
+            "iters": {ev.get("iter") for ev in tl
+                      if ev.get("iter") is not None},
+            "decode_exec": [exec_interval(ev) for ev in tl
+                            if ev.get("phase") in _DECODE_PHASES],
+            "prefill_exec": [exec_interval(ev) for ev in tl
+                             if ev.get("phase") in _PREFILL_PHASES
+                             and not ev.get("resume")],
+        })
+    raw_edges: List[dict] = []
+    if interference and len(reqs) > 1:
+        for rx in reqs:
+            new_segs: List[dict] = []
+            for seg in rx["segs"]:
+                if seg["cause"] == "decode_compute":
+                    # whole decode segment is chargeable: the stall sits
+                    # between the previous event's t1 and this chunk's
+                    # exec window
+                    lo_ok, hi_ok = seg["t0"], seg["t1"]
+                    chargers = [(ry, iv, "prefill_stalls_decode")
+                                for ry in reqs
+                                if ry is not rx and _coresident(rx, ry)
+                                for iv in ry["prefill_exec"]]
+                elif seg["cause"] == "prefill_compute" \
+                        and seg.get("exec_t0") is not None:
+                    # only the waiting prefix (before this chunk's own
+                    # dispatch) can be someone else's fault
+                    lo_ok = seg["t0"]
+                    hi_ok = min(seg["t1"], seg["exec_t0"])
+                    chargers = [(ry, iv, "decode_delays_prefill")
+                                for ry in reqs
+                                if ry is not rx and _coresident(rx, ry)
+                                for iv in ry["decode_exec"]]
+                else:
+                    new_segs.append(seg)
+                    continue
+                hits = []
+                for ry, (lo, hi), kind in chargers:
+                    a, b = max(lo_ok, lo), min(hi_ok, hi)
+                    if b > a:
+                        hits.append((a, b, ry["req_id"], kind))
+                if not hits:
+                    new_segs.append(seg)
+                    continue
+                for a, b, by, kind in hits:
+                    raw_edges.append({"stalled_req": rx["req_id"],
+                                      "by_req": by, "kind": kind,
+                                      "seconds": b - a})
+                cursor = seg["t0"]
+                for a, b in _merge_intervals([(a, b) for a, b, _, _
+                                              in hits]):
+                    if a > cursor:
+                        new_segs.append(dict(seg, t0=cursor, t1=a))
+                    new_segs.append({"t0": a, "t1": b,
+                                     "cause": "prefill_chunk_interference",
+                                     "phase": seg["phase"],
+                                     "exec_t0": seg.get("exec_t0")})
+                    cursor = b
+                if seg["t1"] > cursor:
+                    new_segs.append(dict(seg, t0=cursor, t1=seg["t1"]))
+            rx["segs"] = new_segs
+
+    # collapse edges per (stalled, by, direction)
+    agg: Dict[Tuple, float] = {}
+    for e in raw_edges:
+        k = (e["stalled_req"], e["by_req"], e["kind"])
+        agg[k] = agg.get(k, 0.0) + e["seconds"]
+    edges = [{"stalled_req": s, "by_req": b, "kind": k,
+              "seconds": v}
+             for (s, b, k), v in sorted(agg.items(),
+                                        key=lambda kv: -kv[1])]
+
+    entries = []
+    for rq in reqs:
+        mine = [e for e in edges if e["stalled_req"] == rq["req_id"]]
+        entries.append(_entry(rq["req_id"], rq["segs"], mine))
+    totals = {c: math.fsum(e["causes"][c] for e in entries)
+              for c in CAUSES}
+    return {"requests": entries, "edges": edges,
+            "n_interference_edges": len(edges), "totals": totals,
+            "conserved": all(e["conserved"] for e in entries),
+            "n_requests": len(entries)}
+
+
+def top_causes(causes: Dict[str, float], n: int = 3
+               ) -> List[Tuple[str, float]]:
+    """Largest-first (cause, seconds) pairs, zero causes dropped."""
+    ranked = sorted(((c, s) for c, s in causes.items() if s > 0),
+                    key=lambda kv: (-kv[1], kv[0]))
+    return ranked[:n]
+
+
+class _View:
+    """Outcome view over a result for slo.request_attains (duck-typed
+    on finish_reason / ttft_s / latency_s / n_tokens)."""
+
+    def __init__(self, rec):
+        self.finish_reason = _get(rec, "finish_reason", None)
+        self.ttft_s = _get(rec, "ttft_s", None)
+        self.queue_wait_s = _get(rec, "queue_wait_s", None)
+        lat = _get(rec, "latency_s", None)
+        tl = _get(rec, "timeline", None) or ()
+        if lat is None and tl:
+            lat = max(e["t1"] for e in tl) - min(e["t0"] for e in tl)
+        self.latency_s = lat
+        n = _get(rec, "n_tokens", None)
+        if n is None:
+            toks = _get(rec, "tokens", None)
+            n = len(toks) if toks is not None else 0
+        self.n_tokens = n
+
+
+def blame_report(results: Iterable, slo=None, top: int = 3) -> dict:
+    """Fleet blame report: ledger + violators-vs-attainers join.
+
+    ``results`` may be GenerationResults, loadgen RequestOutcomes, or
+    flight-recorder record dicts.  With an ``slo``, requests are split
+    by ``slo.request_attains`` and each side gets its own cause
+    breakdown; per-cohort breakdowns appear when outcomes carry a
+    ``cohort``.  ``worst`` is the p99-latency violator (max-latency
+    request when nobody violates) with its top causes — the row the
+    perf docs render.
+    """
+    results = list(results)
+    ledger = build_ledger(results)
+    entries = ledger["requests"]
+    views = [_View(r) for r in results]
+    if slo is not None:
+        att_idx, vio_idx = split_attainment(views, slo)
+    else:
+        att_idx, vio_idx = list(range(len(views))), []
+
+    def _side(idxs: List[int]) -> dict:
+        sub = [entries[i] for i in idxs]
+        causes = {c: math.fsum(e["causes"][c] for e in sub)
+                  for c in CAUSES}
+        return {"n": len(sub), "causes": causes,
+                "top": top_causes(causes, top)}
+
+    per_cohort: Dict[str, List[dict]] = {}
+    for i, r in enumerate(results):
+        c = _get(r, "cohort", None)
+        if c is not None:
+            per_cohort.setdefault(str(c), []).append(entries[i])
+    cohorts = {c: {"n": len(es),
+                   "causes": {k: math.fsum(e["causes"][k] for e in es)
+                              for k in CAUSES}}
+               for c, es in sorted(per_cohort.items())}
+
+    lats = sorted(e["latency_s"] for e in entries)
+    p99 = 0.0
+    if lats:
+        p99 = lats[min(len(lats) - 1,
+                       max(0, math.ceil(0.99 * len(lats)) - 1))]
+    pool = [entries[i] for i in vio_idx] or entries
+    worst = None
+    if pool:
+        w = max(pool, key=lambda e: e["latency_s"])
+        worst = {"req_id": w["req_id"], "latency_s": w["latency_s"],
+                 "conserved": w["conserved"],
+                 "top": top_causes(w["causes"], top)}
+
+    return {"n_requests": ledger["n_requests"],
+            "n_violators": len(vio_idx),
+            "conserved": ledger["conserved"],
+            "totals": ledger["totals"],
+            "violators": _side(vio_idx),
+            "attainers": _side(att_idx),
+            "per_cohort": cohorts,
+            "edges": ledger["edges"],
+            "n_interference_edges": ledger["n_interference_edges"],
+            "top_interference": ledger["edges"][:top],
+            "p99_latency_s": p99,
+            "worst": worst,
+            "slo": ({"ttft_s": slo.ttft_s, "tpot_s": slo.tpot_s}
+                    if slo is not None else None),
+            "requests": entries}
+
+
+def annotate_record(rec: dict) -> dict:
+    """Compact blame summary for ONE retained flight-recorder record —
+    embedded into its Perfetto thread metadata (no extra trace events,
+    so dumps stay schema-stable)."""
+    entry = blame_timeline(rec.get("timeline") or (),
+                           req_id=rec.get("req_id"))
+    nonzero = {c: round(s, 6) for c, s in entry["causes"].items() if s > 0}
+    tops = top_causes(entry["causes"], 1)
+    return {"causes": nonzero,
+            "top_cause": tops[0][0] if tops else None,
+            "conserved": entry["conserved"]}
+
+
+def publish(report: dict, metrics) -> None:
+    """Publish a blame report as ``serving.blame.*`` gauges on a
+    MetricsRegistry (idempotent: gauges dedupe by name)."""
+    from deeplearning4j_tpu.telemetry.registry import sanitize_component
+    g = metrics.gauge
+    g("serving.blame.conserved",
+      "1 when every request's blame spans sum to its latency").set(
+          1.0 if report["conserved"] else 0.0)
+    g("serving.blame.interference_edges",
+      "cross-request interference edges in the last blame report").set(
+          report["n_interference_edges"])
+    g("serving.blame.n_violators",
+      "SLO violators in the last blame report").set(report["n_violators"])
+    for side in ("violators", "attainers"):
+        g(f"serving.blame.{side}.n",
+          f"requests on the {side} side of the SLO join").set(
+              report[side]["n"])
+        for cause in CAUSES:
+            g(f"serving.blame.{side}.{cause}_s",
+              f"total {cause} seconds across {side}").set(
+                  report[side]["causes"].get(cause, 0.0))
+    for cohort, agg in report.get("per_cohort", {}).items():
+        comp = sanitize_component(str(cohort))
+        for cause, v in agg["causes"].items():
+            if v > 0:
+                g(f"serving.blame.cohort.{comp}.{cause}_s",
+                  f"total {cause} seconds in cohort {cohort}").set(v)

@@ -98,9 +98,11 @@ class FlightRecorder:
         self.n_violations = 0
 
     # ----------------------------------------------------------- recording
-    def record(self, result) -> bool:
-        """Offer one finished request (GenerationResult-shaped). Returns
-        True iff its timeline was retained."""
+    def record(self, result, source: Optional[str] = None) -> bool:
+        """Offer one finished request (GenerationResult-shaped). `source`
+        labels the recording engine (a ShardedServingGroup passes the
+        replica name) so multi-replica dumps stay distinguishable.
+        Returns True iff its timeline was retained."""
         self.n_seen += 1
         self._seq += 1
         ttft = getattr(result, "ttft_s", None)
@@ -114,6 +116,7 @@ class FlightRecorder:
                "admission_retries": getattr(result, "admission_retries", 0),
                "finish_reason": getattr(result, "finish_reason", None),
                "n_tokens": len(getattr(result, "tokens", None) or []),
+               "source": source,
                "timeline": list(getattr(result, "timeline", ()) or ())}
         kept = False
         if self.slo is not None and \
@@ -134,10 +137,13 @@ class FlightRecorder:
     # ------------------------------------------------------------- queries
     def records(self) -> List[dict]:
         """Retained records, deduplicated (a request can be both a violator
-        and a worst-TTFT holder), worst TTFT first."""
-        by_id: Dict[int, dict] = {}
+        and a worst-TTFT holder), worst TTFT first. req_ids are per-engine
+        counters, so a recorder shared across a replica fleet (ISSUE 14)
+        dedupes on (source, req_id) — same-id requests from different
+        replicas are distinct requests, not duplicates."""
+        by_id: Dict[tuple, dict] = {}
         for rec in list(self._violators) + [it[2] for it in self._worst]:
-            by_id[rec["req_id"]] = rec
+            by_id[(rec.get("source"), rec["req_id"])] = rec
         inf = math.inf
         return sorted(by_id.values(),
                       key=lambda r: (-(inf if r["ttft_s"] is None
@@ -149,29 +155,48 @@ class FlightRecorder:
 
     # ------------------------------------------------------------- perfetto
     def perfetto(self) -> Dict[str, object]:
-        """Chrome-trace/Perfetto JSON object: one pid for the recorder, one
-        tid (track) per retained request, "X" complete events per lifecycle
-        phase (ts/dur in µs, re-based to the earliest retained timestamp)
-        and an "i" instant for retirement."""
+        """Chrome-trace/Perfetto JSON object: one pid per recording
+        source (replica engines label records, unlabeled records keep
+        pid 1), one tid (track) per retained request, "X" complete
+        events per lifecycle phase (ts/dur in µs, re-based to the
+        earliest retained timestamp) and an "i" instant for retirement.
+        Each request's thread metadata carries its blame summary
+        (telemetry/blame.py) — annotation only, no extra trace events."""
+        from deeplearning4j_tpu.telemetry import blame as _blame
         recs = self.records()
         t0s = [cov[0] for rec in recs
                for cov in (coverage(rec["timeline"]),) if cov]
         epoch = min(t0s) if t0s else 0.0
-        ev: List[dict] = [{"ph": "M", "pid": 1, "name": "process_name",
-                           "args": {"name": "serving flight recorder"}}]
+        sources = sorted({rec.get("source") for rec in recs},
+                         key=lambda s: (s is not None, str(s)))
+        pid_of = {s: i + 1 for i, s in enumerate(sources)} or {None: 1}
+        ev: List[dict] = []
+        for s, pid in pid_of.items():
+            pname = "serving flight recorder" if s is None \
+                else f"serving flight recorder [{s}]"
+            pargs: Dict[str, object] = {"name": pname}
+            if s is not None:
+                pargs["replica"] = s
+            ev.append({"ph": "M", "pid": pid, "name": "process_name",
+                       "args": pargs})
         for rec in recs:
             rid = rec["req_id"]
+            pid = pid_of[rec.get("source")]
             ttft = rec["ttft_s"]
+            ann = _blame.annotate_record(rec)
             label = (f"req {rid} ({rec['finish_reason']}, ttft "
                      + (f"{ttft * 1e3:.1f}ms" if ttft is not None else "n/a")
+                     + (f", blame {ann['top_cause']}"
+                        if ann["top_cause"] else "")
                      + ")")
-            ev.append({"ph": "M", "pid": 1, "tid": rid,
-                       "name": "thread_name", "args": {"name": label}})
+            ev.append({"ph": "M", "pid": pid, "tid": rid,
+                       "name": "thread_name",
+                       "args": {"name": label, "blame": ann}})
             for e in rec["timeline"]:
                 args = {k: v for k, v in e.items()
                         if k not in ("phase", "t0", "t1")}
                 args["req"] = rid
-                base = {"pid": 1, "tid": rid, "name": e["phase"],
+                base = {"pid": pid, "tid": rid, "name": e["phase"],
                         "cat": "request",
                         "ts": round((e["t0"] - epoch) * 1e6, 3)}
                 dur = e["t1"] - e["t0"]

@@ -70,6 +70,19 @@ def request_attains(outcome, slo: SLO) -> bool:
     return tpot is None or tpot <= slo.tpot_s
 
 
+def split_attainment(outcomes: Sequence, slo: SLO
+                     ) -> Tuple[List[int], List[int]]:
+    """Indices of (attaining, violating) outcomes — the violator join
+    the blame ledger (telemetry/blame.py, ISSUE 14) aggregates by.
+    Index-based so callers can line the split up against parallel
+    per-request structures (blame entries, cohort labels)."""
+    attained: List[int] = []
+    violated: List[int] = []
+    for i, o in enumerate(outcomes):
+        (attained if request_attains(o, slo) else violated).append(i)
+    return attained, violated
+
+
 def _pct(vals: List[float], q: float) -> Optional[float]:
     if not vals:
         return None

@@ -27,6 +27,15 @@ from typing import Dict, List, Optional
 
 _US = 1e6
 
+#: Base for synthetic track tids handed out by `Tracer.set_track` —
+#: far below CPython thread idents (pointer-sized on Linux), so named
+#: tracks and raw-ident tracks never collide in one dump.
+_TRACK_TID0 = 10_001
+
+# thread-local current track: spans recorded by a thread that called
+# set_track() land on its named track instead of the raw thread ident
+_TRACK = threading.local()
+
 
 class _NullSpan:
     """No-op context manager returned when tracing is disabled — the hot
@@ -78,9 +87,34 @@ class Tracer:
         # exported JSON, i.e. precisely when the buffer was already full
         self._drop_counter = drop_counter
         self._epoch = time.perf_counter()
+        # named tracks (ISSUE 14 satellite): replica engines label their
+        # scheduler threads so multi-replica dumps are distinguishable
+        self._tracks: Dict[str, int] = {}
+        self._track_meta: Dict[str, dict] = {}
         self._lock = threading.Lock()   # append-side: list.append is atomic
         #                                 under the GIL; the lock guards only
         #                                 clear()/export() vs. appends
+
+    # ------------------------------------------------------------ tracks
+    def set_track(self, name: Optional[str], **meta) -> None:
+        """Route the CALLING thread's subsequent spans onto a named
+        track (stable synthetic tid + a thread_name metadata event in
+        the export, carrying `meta` — e.g. replica_id). `None` restores
+        the raw thread-ident track. Idempotent and cheap enough for a
+        scheduler loop to call every iteration."""
+        if name is None:
+            _TRACK.tid = None
+            return
+        tid = self._tracks.get(name)
+        if tid is None:
+            with self._lock:
+                tid = self._tracks.get(name)
+                if tid is None:
+                    tid = _TRACK_TID0 + len(self._tracks)
+                    self._tracks[name] = tid
+                    self._track_meta[name] = {k: v for k, v in meta.items()
+                                              if v is not None}
+        _TRACK.tid = tid
 
     # ------------------------------------------------------------ record
     def span(self, name: str, **args):
@@ -104,6 +138,9 @@ class Tracer:
             if self._drop_counter is not None:
                 self._drop_counter.inc()
             return
+        track = getattr(_TRACK, "tid", None)
+        if track is not None:
+            tid = track
         ev: Dict[str, object] = {
             "name": name, "ph": ph, "pid": 1, "tid": tid,
             "ts": round((t0 - self._epoch) * _US, 3),
@@ -132,7 +169,13 @@ class Tracer:
         with self._lock:
             events = list(self._events)
             dropped = self._dropped
-        doc = {"traceEvents": events, "displayTimeUnit": "ms",
+            tracks = dict(self._tracks)
+            tmeta = {k: dict(v) for k, v in self._track_meta.items()}
+        metas = [{"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                  "args": {"name": name, **tmeta.get(name, {})}}
+                 for name, tid in sorted(tracks.items(),
+                                         key=lambda kv: kv[1])]
+        doc = {"traceEvents": metas + events, "displayTimeUnit": "ms",
                "otherData": {"producer": "deeplearning4j_tpu.telemetry"}}
         if dropped:
             doc["otherData"]["dropped_events"] = dropped

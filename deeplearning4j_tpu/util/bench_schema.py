@@ -33,6 +33,12 @@ forced-exhaustion REAL-eviction run — CPU-runnable and always present;
 measured entries must prove token parity + completion + conservation
 for both preemption flavors, >= 1 actual preemption per flavor, no
 flavor leakage under forced modes, and a measured swap bandwidth).
+ISSUE 14 adds `blame_attribution` (the latency blame ledger under
+forced contention — CPU-runnable and always present; measured entries
+must prove the in-bench assertions held: conserved=True,
+tokens_identical=True and sync_parity=True for the ledger-on/off A/B,
+>= 1 interference edge, and cause_totals_s keyed by EXACTLY the closed
+cause taxonomy telemetry/blame.py defines).
 bench.py calls
 `assert_valid` on the dict it is about to print, and
 tests/test_bench_schema.py re-validates the committed artifact, so the
@@ -348,6 +354,56 @@ def validate_artifact(art: dict) -> List[str]:
             if swap.get("host_pool_drained") is not True:
                 errs.append("kv_lifecycle.swap.host_pool_drained must be "
                             "True — swapped blocks leaked in host RAM")
+
+    # Latency blame ledger (ISSUE 14): CPU-runnable forced-contention
+    # attribution run, so always present; when measured it must prove the
+    # in-bench assertions held (per-request conservation, ledger-on/off
+    # token + host-sync parity), have found real cross-request
+    # interference, and keep the cause taxonomy CLOSED — a new cause key
+    # must be added to telemetry/blame.py (and documented in PERF.md),
+    # never invented ad hoc in the bench output
+    ba = e.get("blame_attribution")
+    if not isinstance(ba, dict):
+        errs.append("extra['blame_attribution'] missing or not a dict "
+                    "(the forced-contention blame run is CPU-runnable — "
+                    "emit error/skipped entries rather than dropping it)")
+    elif "error" not in ba and "skipped_reason" not in ba:
+        from deeplearning4j_tpu.telemetry.blame import CAUSES
+        if not isinstance(ba.get("platform"), str):
+            errs.append("extra['blame_attribution'] has no 'platform' label")
+        for flag in ("conserved", "tokens_identical", "sync_parity"):
+            if ba.get(flag) is not True:
+                errs.append(f"blame_attribution.{flag} must be True — the "
+                            "in-bench invariant assertion did not hold")
+        if not _is_num(ba.get("interference_edges")) \
+                or ba.get("interference_edges", 0) < 1:
+            errs.append("blame_attribution.interference_edges missing or "
+                        "< 1 — forced contention found no cross-request "
+                        "interference")
+        totals = ba.get("cause_totals_s")
+        if not isinstance(totals, dict) or set(totals) != set(CAUSES):
+            errs.append("blame_attribution.cause_totals_s must be keyed by "
+                        "exactly the closed cause taxonomy "
+                        "(telemetry/blame.py CAUSES)")
+        elif any(not _is_num(v) or v < 0 for v in totals.values()):
+            errs.append("blame_attribution.cause_totals_s values must be "
+                        "non-negative seconds")
+        for side in ("violators", "attainers"):
+            row = ba.get(side)
+            if not isinstance(row, dict) or not _is_num(row.get("n")):
+                errs.append(f"blame_attribution.{side} missing numeric 'n'")
+                continue
+            tops = row.get("top")
+            if not isinstance(tops, list):
+                errs.append(f"blame_attribution.{side}.top missing — the "
+                            "docs render this table")
+                continue
+            for i, pair in enumerate(tops):
+                if not (isinstance(pair, (list, tuple)) and len(pair) == 2
+                        and pair[0] in CAUSES and _is_num(pair[1])
+                        and pair[1] >= 0):
+                    errs.append(f"blame_attribution.{side}.top[{i}] must be "
+                                "a [cause-from-taxonomy, seconds>=0] pair")
 
     # every measurement dict carries a platform label
     for name, entry in e.items():

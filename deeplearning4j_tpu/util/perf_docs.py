@@ -310,6 +310,54 @@ def _kv_lifecycle_lines(kl) -> list:
     return [line]
 
 
+def _blame_attribution_lines(ba) -> list:
+    """Latency blame section from extra['blame_attribution'] (ISSUE 14):
+    the forced-contention run where every request's submit->retire wall
+    time is exactly partitioned into causes (conservation + ledger-on/off
+    bit-parity asserted in-bench), rendered as the violators-vs-attainers
+    top-blame table — the generated answer to \"why were the slow
+    requests slow\" on the benched host."""
+    if not isinstance(ba, dict) or not isinstance(ba.get("violators"), dict):
+        if isinstance(ba, dict) and (ba.get("skipped_reason")
+                                     or ba.get("error")):
+            return [f"- Latency blame ledger: "
+                    f"{ba.get('skipped_reason') or ba.get('error')} "
+                    f"(platform: {ba.get('platform', '?')})."]
+        return []
+    vio, att = ba["violators"], ba.get("attainers", {})
+    lines = [
+        f"- Latency blame ledger (ISSUE 14, {ba.get('platform', '?')}): "
+        f"every request's submit->retire wall time exactly partitioned "
+        f"into causes — conservation per request, ledger-on/off token + "
+        f"host-sync bit-parity, and >=1 interference edge all asserted "
+        f"in-bench ({ba.get('interference_edges', 0)} edges found). "
+        f"Workload: {ba.get('workload', '?')}. SLO join at the run's "
+        f"median TTFT ({(ba.get('slo_ttft_s') or 0) * 1e3:.1f} ms); "
+        f"p99 latency {ba.get('p99_latency_s', 0):.2f} s. Top blame, "
+        f"seconds summed per side:",
+        "",
+        f"| rank | violators (n={vio.get('n', '?')}) | s "
+        f"| attainers (n={att.get('n', '?')}) | s |",
+        "|---:|---|---:|---|---:|",
+    ]
+    vt, at = vio.get("top") or [], att.get("top") or []
+    for i in range(max(len(vt), len(at))):
+        v = vt[i] if i < len(vt) else ("—", None)
+        a = at[i] if i < len(at) else ("—", None)
+        lines.append(
+            f"| {i + 1} | `{v[0]}` "
+            f"| {'' if v[1] is None else f'{v[1]:.2f}'} "
+            f"| `{a[0]}` | {'' if a[1] is None else f'{a[1]:.2f}'} |")
+    w = ba.get("worst") or {}
+    if w.get("top"):
+        causes = ", ".join(f"`{c}` {s:.2f} s" for c, s in w["top"])
+        lines.append(
+            f"\n  Worst violator (req {w.get('req_id', '?')}, "
+            f"{w.get('latency_s', 0):.2f} s): {causes} — methodology in "
+            "PERF.md \"Latency blame methodology\".")
+    return lines
+
+
 def render_block(art: dict) -> str:
     """Markdown bullet block rendered VERBATIM into README.md and PERF.md."""
     e = art["extra"]
@@ -466,6 +514,7 @@ def render_block(art: dict) -> str:
     lines.extend(_spec_decode_lines(e.get("serving_spec_decode")))
     lines.extend(_kv_observatory_lines(e.get("kv_observatory")))
     lines.extend(_kv_lifecycle_lines(e.get("kv_lifecycle")))
+    lines.extend(_blame_attribution_lines(e.get("blame_attribution")))
     lines.extend(_roofline_table_lines(e.get("roofline_table")))
     lines.append(
         f"- ParallelWrapper ResNet50: {pw['images_per_sec']:,.0f} img/s — "

@@ -9,6 +9,7 @@ import copy
 
 import pytest
 
+from deeplearning4j_tpu.telemetry.blame import CAUSES as _CAUSES
 from deeplearning4j_tpu.util.bench_schema import (assert_valid,
                                                   validate_artifact)
 from deeplearning4j_tpu.util.perf_docs import load_artifact
@@ -87,6 +88,16 @@ def _minimal_art():
                          "evictions_recompute": 0, "evictions_swap": 160,
                          "measured_swap_gbps": 0.5,
                          "host_pool_drained": True}},
+            "blame_attribution": {
+                "platform": "cpu", "conserved": True,
+                "tokens_identical": True, "sync_parity": True,
+                "interference_edges": 3,
+                "cause_totals_s": {c: 0.1 for c in _CAUSES},
+                "violators": {"n": 2,
+                              "top": [["queue_wait", 1.2],
+                                      ["jit_compile", 0.4]]},
+                "attainers": {"n": 3,
+                              "top": [["decode_compute", 0.3]]}},
             "roofline_table": [
                 {"function": "train_step", "platform": "tpu",
                  "flops": 1e12, "bytes_accessed": 1e9,
@@ -371,6 +382,50 @@ def test_kv_lifecycle_rules():
     assert validate_artifact(art) == []
     art["extra"]["kv_lifecycle"] = {"platform": "cpu",
                                     "skipped_reason": "why not"}
+    assert validate_artifact(art) == []
+
+
+def test_blame_attribution_rules():
+    """ISSUE 14: the forced-contention blame run must always exist; a
+    measured entry must prove the in-bench assertions held (conservation
+    + ledger-on/off token and host-sync parity), have found >= 1
+    interference edge, and keep the cause taxonomy closed — cause keys
+    come from telemetry/blame.py, never invented in bench output;
+    errored/skipped entries are exempt."""
+    art = _minimal_art()
+    del art["extra"]["blame_attribution"]
+    assert any("blame_attribution" in e for e in validate_artifact(art))
+    for flag in ("conserved", "tokens_identical", "sync_parity"):
+        art = _minimal_art()
+        art["extra"]["blame_attribution"][flag] = False
+        assert any(f"blame_attribution.{flag}" in e
+                   for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["blame_attribution"]["interference_edges"] = 0
+    assert any("interference_edges" in e for e in validate_artifact(art))
+    # closed taxonomy: a missing cause and an invented cause both fail
+    art = _minimal_art()
+    del art["extra"]["blame_attribution"]["cause_totals_s"]["queue_wait"]
+    assert any("closed cause taxonomy" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["blame_attribution"]["cause_totals_s"]["vibes"] = 1.0
+    assert any("closed cause taxonomy" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["blame_attribution"]["cause_totals_s"]["queue_wait"] = -1.0
+    assert any("non-negative" in e for e in validate_artifact(art))
+    # the rendered top tables must reference taxonomy causes only
+    art = _minimal_art()
+    art["extra"]["blame_attribution"]["violators"]["top"] = [["vibes", 1.0]]
+    assert any("violators.top[0]" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["blame_attribution"]["attainers"]
+    assert any("attainers" in e for e in validate_artifact(art))
+    # errored/skipped runs are exempt from the measured-entry rules
+    art = _minimal_art()
+    art["extra"]["blame_attribution"] = {"error": "ValueError: boom"}
+    assert validate_artifact(art) == []
+    art["extra"]["blame_attribution"] = {"platform": "cpu",
+                                         "skipped_reason": "why not"}
     assert validate_artifact(art) == []
 
 

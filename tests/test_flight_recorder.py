@@ -269,3 +269,16 @@ def test_host_syncs_bit_parity_recorder_on_vs_off():
     assert toks_on == toks_off
     assert st_on["host_syncs"] == st_off["host_syncs"]
     assert st_on["host_syncs_per_token"] == st_off["host_syncs_per_token"]
+
+
+def test_records_dedupe_per_source_not_per_req_id():
+    """req_ids are per-engine counters: a fleet-shared recorder (ISSUE 14)
+    must not collapse same-id requests from different replicas."""
+    fr = FlightRecorder(capacity=8, worst_k=8)
+    fr.record(_result(0), source="replica0")
+    fr.record(_result(0, t0=1.0), source="replica1")
+    assert len(fr.records()) == 2
+    # unlabeled records still dedupe violator/worst double-retention
+    fr2 = FlightRecorder(capacity=8, worst_k=8, slo=SLO(ttft_s=1e-9, tpot_s=1e-9))
+    fr2.record(_result(3))
+    assert len(fr2.records()) == 1

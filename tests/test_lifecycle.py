@@ -329,3 +329,47 @@ def test_prefix_store_disabled_is_bit_parity(monkeypatch):
     s = eng.stats()
     assert s["prefix_store_hits"] == 0 and s["prefix_store_tokens"] == 0
     eng.shutdown()
+
+
+def test_preempt_requeue_timeline_tiles_on_every_exit_path():
+    """ISSUE 14 satellite: a preempted request's requeue "queue" span must
+    start AT the preempt span's end on every exit path — including the
+    abandoning shutdown(wait=False), which used to reach back to t_submit
+    and overlap the pre-preemption life — and the blame partition over
+    preemption-bearing timelines must still conserve exactly."""
+    from deeplearning4j_tpu.telemetry import blame
+    from deeplearning4j_tpu.telemetry.flight_recorder import max_gap_s
+    net = _build_net(n_kv=2)
+    eng = _engine(net, kv_blocks=9, kv_evict="lru",
+                  kv_evict_mode="recompute", kv_swap_bytes=0)
+    futs = [eng.submit(Request(list(p), max_new_tokens=12))
+            for p in PROMPTS * 2]          # 2x overcommit keeps churn up
+    # step until a VICTIM sits requeued at a step boundary, then abandon
+    # the queue: shutdown(wait=False) writes that act's queue span — the
+    # exact path the old code mis-anchored at t_submit
+    for _ in range(400):
+        alive = eng.step()
+        if any(a.resume is not None for a in eng._queue):
+            break
+        if not alive:
+            pytest.fail("drained before a victim stayed requeued")
+    else:
+        pytest.fail("harness no longer forces a preemption")
+    eng.shutdown(wait=False)
+    results = [f.get(timeout=30) for f in futs]
+    shutdown_preempted = 0
+    for r in results:
+        # the repo-wide coverage bar: no hole wider than the longest span
+        period = max(e["t1"] - e["t0"] for e in r.timeline)
+        assert max_gap_s(r.timeline) <= max(period, 1e-3)
+        for prev, ev in zip(r.timeline, r.timeline[1:]):
+            if prev["phase"] == "preempt":
+                # the very next span is the requeue wait, tiled exactly
+                # from the preemption's end — never from t_submit
+                assert ev["phase"] == "queue"
+                assert ev["t0"] == prev["t1"]
+                if r.finish_reason == "shutdown":
+                    shutdown_preempted += 1
+        entry = blame.blame_timeline(r.timeline, req_id=r.req_id)
+        blame.assert_conserved(entry)
+    assert shutdown_preempted >= 1, "fixed shutdown path never exercised"

@@ -363,3 +363,49 @@ def test_metrics_aggregation_is_recursive():
     child_b.counter("serving.tokens_out").inc(4)
     text = root.prometheus_text()
     assert "serving_tokens_out 7" in text     # grandchildren aggregate
+
+
+# ------------------------------------------------------ blame ledger (ISSUE 14)
+def test_group_snapshot_seq_blame_report_and_replica_labels(
+        forced_host_devices):
+    """ISSUE 14: group stats carry a snapshot_seq equal to the sum of the
+    per-replica scheduler-iteration sequence numbers; blame_report joins
+    the SLO split, conserves fleet-wide, and publishes serving.blame.*
+    gauges on the group registry; a shared flight recorder and the
+    process tracer both label their Perfetto output per replica."""
+    from deeplearning4j_tpu import telemetry
+    from deeplearning4j_tpu.telemetry import blame
+    from deeplearning4j_tpu.telemetry.flight_recorder import FlightRecorder
+    from deeplearning4j_tpu.telemetry.slo import SLO
+    fr = FlightRecorder(capacity=16, worst_k=16)
+    grp = ShardedServingGroup(_build_net(n_kv=2), 4, 64, dtype="float64",
+                              replicas=2, tp=1, flight_recorder=fr)
+    res = grp.generate(PROMPTS, max_new_tokens=6)
+    st = grp.stats()
+    assert st["snapshot_seq"] > 0
+    assert st["snapshot_seq"] == sum(s["snapshot_seq"]
+                                     for s in st["per_replica"])
+    # fleet blame: everything attains a generous SLO, conservation holds,
+    # and no interference edge may pair requests from different replicas
+    report = grp.blame_report(res, slo=SLO(ttft_s=120.0, tpot_s=120.0))
+    assert report["conserved"] and report["n_violators"] == 0
+    assert report["attainers"]["n"] == len(res)
+    by_id = {}
+    for r in res:
+        iters = {e["iter"] for e in r.timeline if "iter" in e}
+        by_id[r.req_id] = iters
+    for e in report["edges"]:
+        assert by_id[e["stalled_req"]] & by_id[e["by_req"]]
+    txt = grp.metrics.prometheus_text()
+    assert "serving_blame_conserved 1" in txt
+    assert "serving_blame_attainers_decode_compute_s" in txt
+    # replica-labeled flight-recorder dump: one pid per recording engine
+    doc = fr.perfetto()
+    procs = {e["args"].get("replica") for e in doc["traceEvents"]
+             if e.get("ph") == "M" and e["name"] == "process_name"}
+    assert procs == {"replica0", "replica1"}
+    # replica-labeled tracer tracks (named while each engine stepped)
+    tracks = {e["args"]["name"] for e in
+              telemetry.tracer().chrome_trace()["traceEvents"]
+              if e.get("ph") == "M" and e.get("name") == "thread_name"}
+    assert {"replica0", "replica1"} <= tracks

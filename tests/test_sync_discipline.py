@@ -114,12 +114,14 @@ def test_all_hot_path_modules_exist():
     # ISSUE 6 profiler/memory accounting promise the same zero-added-syncs
     # contract as the ISSUE 4/5 modules; ISSUE 7 adds the paged-KV
     # scheduling modules, ISSUE 8 the SLO evaluator / flight recorder /
-    # load generator, all under the same promise
+    # load generator, all under the same promise; ISSUE 14 the blame
+    # ledger (post-hoc host arithmetic over recorded timelines — zero
+    # added syncs by construction, pinned here so it stays that way)
     assert {"health.py", "profiler.py", "memory.py", "tracing.py",
             "registry.py", "training.py", "kv_cache.py",
             "block_table.py", "slo.py", "flight_recorder.py",
             "loadgen.py", "sharding.py", "spec.py",
-            "kv_observatory.py", "lifecycle.py"} <= names
+            "kv_observatory.py", "lifecycle.py", "blame.py"} <= names
 
 
 # ------------------------------------------------ scanner self-tests
