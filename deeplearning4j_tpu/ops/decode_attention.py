@@ -110,13 +110,23 @@ def decode_attention_dense(q, kc, vc, visible, scale, window: int = 0):
 
 
 def _decode_kernel(q_ref, k_ref, v_ref, m_ref, vis_ref, o_ref, l_ref, *,
-                   bkv, window, scale, acc_dt):
+                   bkv, window, scale, acc_dt, ks_ref=None, vs_ref=None):
     """One grid cell = (slot, kv head, length partition): partial
     softmax-weighted sum o_p (G, D) and row logsumexp L_p (G,) over this
     partition's bkv cache positions. Partitions with no visible position
     (fully beyond the slot's length, or fully behind its sliding window)
     skip the score math and emit (0, NEG_INF) — the merge weighs them to
-    zero."""
+    zero.
+
+    Quantized pool (ISSUE 15): ks_ref/vs_ref, when given, are this cell's
+    per-head-per-block scales ((1, 1) SMEM tiles, routed through the same
+    block-table index_map as the k/v tiles), and the k/v streams are int8.
+    Dequantization is ONE scalar broadcast multiply per tile, applied to
+    the (bkv, D) tile right after the dtype widen — structurally the same
+    `payload * scale` the dense oracle applies per gathered block, so
+    kernel-vs-oracle parity carries over to the int8 path unchanged. The
+    pool bytes crossing HBM stay int8; nothing dequantized ever persists
+    beyond this cell's registers."""
     from jax.experimental import pallas as pl
     j = pl.program_id(2)
     vis = vis_ref[0, 0]                              # slot's visible length
@@ -129,6 +139,8 @@ def _decode_kernel(q_ref, k_ref, v_ref, m_ref, vis_ref, o_ref, l_ref, *,
     def _():
         q = q_ref[0, 0].astype(acc_dt)               # (G, D)
         k = k_ref[0, :, 0, :].astype(acc_dt)         # (bkv, D)
+        if ks_ref is not None:
+            k = k * ks_ref[0, 0].astype(acc_dt)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=acc_dt) * scale
         valid = m_ref[0, :] > 0                      # (bkv,) per-position
@@ -137,7 +149,10 @@ def _decode_kernel(q_ref, k_ref, v_ref, m_ref, vis_ref, o_ref, l_ref, *,
         p = jnp.exp(s - m[:, None])
         p = jnp.where(valid[None, :], p, 0.0)
         l = jnp.sum(p, axis=1)                       # (G,)
-        o = jax.lax.dot_general(p, v_ref[0, :, 0, :].astype(acc_dt),
+        v = v_ref[0, :, 0, :].astype(acc_dt)         # (bkv, D)
+        if vs_ref is not None:
+            v = v * vs_ref[0, 0].astype(acc_dt)
+        o = jax.lax.dot_general(p, v,
                                 (((1,), (0,)), ((), ())),
                                 preferred_element_type=acc_dt)
         o_ref[0, 0, 0] = (o / jnp.maximum(l, 1e-30)[:, None]).astype(
@@ -232,22 +247,40 @@ register_helper("decode_attention", default_on=True)(flash_decode_attention)
 
 # --------------------------------------------------------------- paged path
 def decode_attention_dense_paged(q, kp, vp, block_tables, visible, scale,
-                                 window: int = 0):
+                                 window: int = 0, k_scale=None,
+                                 v_scale=None):
     """Dense paged oracle: gather each slot's cache through its block table
     into the (S, L, Hk, D) layout, then run the UNCHANGED dense math — so
     paged parity reduces to the already-trusted oracle. q: (S, H, D);
     kp/vp: (num_blocks + 1, block_size, Hk, D) physical blocks (last block
-    is the trash block); block_tables: (S, blocks_per_seq) int32."""
+    is the trash block); block_tables: (S, blocks_per_seq) int32.
+
+    Quantized pool: k_scale/v_scale (num_blocks + 1, Hk) dequantize each
+    GATHERED block (`payload * scale[block, head]`) before the dense math
+    — the quantize -> dequantize reference the int8 kernel is tested
+    against. Only per-slot views are ever dequantized, never the pool."""
     S = q.shape[0]
     bs, Hk, D = kp.shape[1], kp.shape[2], kp.shape[3]
     bps = block_tables.shape[1]
-    kc = kp[block_tables].reshape(S, bps * bs, Hk, D)
-    vc = vp[block_tables].reshape(S, bps * bs, Hk, D)
+    acc = jnp.promote_types(q.dtype, jnp.float32)
+    if k_scale is not None:
+        ks = k_scale[block_tables]                   # (S, bps, Hk)
+        vs = v_scale[block_tables]
+        kc = (kp[block_tables].astype(acc)
+              * ks[:, :, None, :, None].astype(acc))
+        vc = (vp[block_tables].astype(acc)
+              * vs[:, :, None, :, None].astype(acc))
+        kc = kc.reshape(S, bps * bs, Hk, D)
+        vc = vc.reshape(S, bps * bs, Hk, D)
+    else:
+        kc = kp[block_tables].reshape(S, bps * bs, Hk, D)
+        vc = vp[block_tables].reshape(S, bps * bs, Hk, D)
     return decode_attention_dense(q, kc, vc, visible, scale, window)
 
 
 def flash_decode_attention_paged(q, kp, vp, block_tables, visible, scale,
-                                 window: int = 0):
+                                 window: int = 0, k_scale=None,
+                                 v_scale=None):
     """Block-table-aware split-K flash-decode: same contract as
     `decode_attention_dense_paged`, computed with one grid cell per
     (slot, kv head, LOGICAL block) and the logical -> physical lookup done
@@ -257,17 +290,27 @@ def flash_decode_attention_paged(q, kp, vp, block_tables, visible, scale,
     kernel body and the logaddexp merge are shared with the slot-path
     kernel. Falls back to the dense paged path when block_size < 8 (tile
     too small for the TPU layout) — fallback and kernel are value-identical
-    either way."""
+    either way.
+
+    Quantized pool (ISSUE 15): pass k_scale/v_scale (num_blocks + 1, Hk)
+    with int8 kp/vp. The scales ride as two extra (1, 1) SMEM operands
+    whose index_map is the SAME block-table lookup as the k/v tiles — each
+    grid cell receives exactly its block's per-head scale and dequantizes
+    its own int8 tile in-register (`_decode_kernel`). The pool streams at
+    the int8 byte count and is never materialized dequantized anywhere."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     S, H, D = q.shape
     bs, Hk = kp.shape[1], kp.shape[2]
     bps = block_tables.shape[1]
+    quantized = k_scale is not None
     if H % Hk != 0:
         raise ValueError(f"n_heads {H} % n_kv_heads {Hk} != 0")
     if bs < 8:
         return decode_attention_dense_paged(q, kp, vp, block_tables,
-                                            visible, scale, window)
+                                            visible, scale, window,
+                                            k_scale=k_scale,
+                                            v_scale=v_scale)
     G = H // Hk
     L = bps * bs
     acc_dt = jnp.promote_types(q.dtype, jnp.float32)
@@ -285,13 +328,30 @@ def flash_decode_attention_paged(q, kp, vp, block_tables, visible, scale,
     def kern(bt_ref, *refs):
         # the scalar-prefetch operand arrives as the leading kernel ref; the
         # body only needs it in the index_maps — drop it and run the SAME
-        # math as the slot-path kernel
-        _decode_kernel(*refs, bkv=bs, window=window, scale=float(scale),
-                       acc_dt=acc_dt)
+        # math as the slot-path kernel (with this cell's block scales when
+        # the pool is quantized)
+        if quantized:
+            (q_ref, k_ref, v_ref, ks_ref, vs_ref, m_ref, vis_ref,
+             o_ref, l_ref) = refs
+            _decode_kernel(q_ref, k_ref, v_ref, m_ref, vis_ref, o_ref,
+                           l_ref, bkv=bs, window=window,
+                           scale=float(scale), acc_dt=acc_dt,
+                           ks_ref=ks_ref, vs_ref=vs_ref)
+        else:
+            _decode_kernel(*refs, bkv=bs, window=window,
+                           scale=float(scale), acc_dt=acc_dt)
     # PrefetchScalarGridSpec: block_tables rides as the scalar-prefetch
     # operand and every index_map takes it as a trailing ref — the k/v maps
     # do the paging gather (logical block j of slot s lives at physical
     # block bt_ref[s, j]); q/mask/visible index on logical coordinates.
+    # The scale operands (quantized pool) use the same physical lookup so
+    # each cell's SMEM scalar is its own block's per-head scale.
+    scale_specs = [
+        pl.BlockSpec((1, 1), lambda s, h, j, bt_ref: (bt_ref[s, j], h),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1), lambda s, h, j, bt_ref: (bt_ref[s, j], h),
+                     memory_space=pltpu.SMEM),
+    ] if quantized else []
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(S, Hk, bps),
@@ -302,6 +362,7 @@ def flash_decode_attention_paged(q, kp, vp, block_tables, visible, scale,
                          lambda s, h, j, bt_ref: (bt_ref[s, j], 0, h, 0)),
             pl.BlockSpec((1, bs, 1, D),
                          lambda s, h, j, bt_ref: (bt_ref[s, j], 0, h, 0)),
+            *scale_specs,
             pl.BlockSpec((1, bs), lambda s, h, j, bt_ref: (s, j)),
             pl.BlockSpec((1, 1), lambda s, h, j, bt_ref: (s, 0),
                          memory_space=pltpu.SMEM),
@@ -313,6 +374,7 @@ def flash_decode_attention_paged(q, kp, vp, block_tables, visible, scale,
                          lambda s, h, j, bt_ref: (s, h, j, 0)),
         ),
     )
+    scale_ops = (k_scale, v_scale) if quantized else ()
     o_p, l_p = pl.pallas_call(
         kern,
         grid_spec=grid_spec,
@@ -321,7 +383,8 @@ def flash_decode_attention_paged(q, kp, vp, block_tables, visible, scale,
             jax.ShapeDtypeStruct((S, Hk, bps, G), acc_dt),
         ),
         interpret=_interpret(),
-    )(jnp.asarray(block_tables, jnp.int32), q4, kp, vp, valid, vis2)
+    )(jnp.asarray(block_tables, jnp.int32), q4, kp, vp, *scale_ops,
+      valid, vis2)
 
     # same logaddexp merge as the slot path (see above)
     m = jnp.max(l_p, axis=2, keepdims=True)          # (S, Hk, 1, G)
@@ -337,7 +400,8 @@ register_helper("decode_attention_paged",
 
 # ------------------------------------------------- speculative (multi-query)
 def decode_attention_dense_spec_paged(q, kp, vp, block_tables, visible,
-                                      scale, window: int = 0):
+                                      scale, window: int = 0,
+                                      k_scale=None, v_scale=None):
     """Dense paged oracle for SPECULATIVE verification (ISSUE 11): score Q
     consecutive query positions per slot in one call. q: (S, Q, H, D) where
     query i of slot s sits at logical position visible[s] - 1 + i (query 0
@@ -349,17 +413,21 @@ def decode_attention_dense_spec_paged(q, kp, vp, block_tables, visible,
     plain decode path, so a spec step's row i is bit-identical to what the
     sequential decode step would have computed at that position given the
     same cache. That is what makes this both the fp64 oracle AND the
-    bit-identical fallback for the multi-query kernel."""
+    bit-identical fallback for the multi-query kernel. A quantized pool
+    threads k_scale/v_scale straight into the single-query oracle — the
+    same quantize -> dequantize reference per gathered block."""
     S, Q = q.shape[0], q.shape[1]
     visible = jnp.asarray(visible, jnp.int32)
     outs = [decode_attention_dense_paged(q[:, i], kp, vp, block_tables,
-                                         visible + i, scale, window)
+                                         visible + i, scale, window,
+                                         k_scale=k_scale, v_scale=v_scale)
             for i in range(Q)]
     return jnp.stack(outs, axis=1)                   # (S, Q, H, D)
 
 
 def _spec_decode_kernel(q_ref, k_ref, v_ref, m_ref, vis_ref, o_ref, l_ref, *,
-                        nq, bkv, window, scale, acc_dt):
+                        nq, bkv, window, scale, acc_dt, ks_ref=None,
+                        vs_ref=None):
     """Multi-query generalization of `_decode_kernel`: one grid cell =
     (slot, kv head, length partition), scoring all Q query positions of the
     slot against this partition's bkv cache positions. The FlashAttention-2
@@ -380,6 +448,8 @@ def _spec_decode_kernel(q_ref, k_ref, v_ref, m_ref, vis_ref, o_ref, l_ref, *,
         nG, D = q_ref.shape[3], q_ref.shape[4]
         q = q_ref[0, 0].reshape(nq * nG, D).astype(acc_dt)
         k = k_ref[0, :, 0, :].astype(acc_dt)         # (bkv, D)
+        if ks_ref is not None:                       # int8 tile dequant
+            k = k * ks_ref[0, 0].astype(acc_dt)
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=acc_dt) * scale
         s = s.reshape(nq, nG, bkv)
@@ -389,8 +459,10 @@ def _spec_decode_kernel(q_ref, k_ref, v_ref, m_ref, vis_ref, o_ref, l_ref, *,
         p = jnp.exp(s - m[:, :, None])
         p = jnp.where(valid[:, None, :], p, 0.0)
         l = jnp.sum(p, axis=2)                       # (Q, G)
-        o = jax.lax.dot_general(p.reshape(nq * nG, bkv),
-                                v_ref[0, :, 0, :].astype(acc_dt),
+        v = v_ref[0, :, 0, :].astype(acc_dt)         # (bkv, D)
+        if vs_ref is not None:
+            v = v * vs_ref[0, 0].astype(acc_dt)
+        o = jax.lax.dot_general(p.reshape(nq * nG, bkv), v,
                                 (((1,), (0,)), ((), ())),
                                 preferred_element_type=acc_dt)
         o = o.reshape(nq, nG, D)
@@ -406,7 +478,8 @@ def _spec_decode_kernel(q_ref, k_ref, v_ref, m_ref, vis_ref, o_ref, l_ref, *,
 
 
 def flash_decode_attention_spec_paged(q, kp, vp, block_tables, visible,
-                                      scale, window: int = 0):
+                                      scale, window: int = 0,
+                                      k_scale=None, v_scale=None):
     """Block-table-aware split-K flash-decode over Q query positions per
     slot (speculative verification): same contract as
     `decode_attention_dense_spec_paged`, same grid as the single-query paged
@@ -415,17 +488,26 @@ def flash_decode_attention_spec_paged(q, kp, vp, block_tables, visible,
     to (Q, G, D) so all draft positions are scored in ONE dispatch at
     unchanged k/v bytes moved (the whole point: decode is HBM-bound on the
     cache stream, so Q-for-1 amortizes the stream). Falls back to the dense
-    spec oracle when block_size < 8 — value-identical either way."""
+    spec oracle when block_size < 8 — value-identical either way.
+
+    Quantized pool: identical scale plumbing to the single-query paged
+    kernel — two extra (1, 1) SMEM operands resolved through the block
+    table, tile dequant inside `_spec_decode_kernel`. Quantization
+    compounds with the Q-for-1 amortization: the int8 stream is the same
+    bytes whether one or Q queries consume it."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
     S, Q, H, D = q.shape
     bs, Hk = kp.shape[1], kp.shape[2]
     bps = block_tables.shape[1]
+    quantized = k_scale is not None
     if H % Hk != 0:
         raise ValueError(f"n_heads {H} % n_kv_heads {Hk} != 0")
     if bs < 8:
         return decode_attention_dense_spec_paged(q, kp, vp, block_tables,
-                                                 visible, scale, window)
+                                                 visible, scale, window,
+                                                 k_scale=k_scale,
+                                                 v_scale=v_scale)
     G = H // Hk
     L = bps * bs
     acc_dt = jnp.promote_types(q.dtype, jnp.float32)
@@ -444,8 +526,22 @@ def flash_decode_attention_spec_paged(q, kp, vp, block_tables, visible,
     vis2 = visible[:, None]                          # (S, 1) SMEM scalar feed
 
     def kern(bt_ref, *refs):
-        _spec_decode_kernel(*refs, nq=Q, bkv=bs, window=window,
-                            scale=float(scale), acc_dt=acc_dt)
+        if quantized:
+            (q_ref, k_ref, v_ref, ks_ref, vs_ref, m_ref, vis_ref,
+             o_ref, l_ref) = refs
+            _spec_decode_kernel(q_ref, k_ref, v_ref, m_ref, vis_ref,
+                                o_ref, l_ref, nq=Q, bkv=bs, window=window,
+                                scale=float(scale), acc_dt=acc_dt,
+                                ks_ref=ks_ref, vs_ref=vs_ref)
+        else:
+            _spec_decode_kernel(*refs, nq=Q, bkv=bs, window=window,
+                                scale=float(scale), acc_dt=acc_dt)
+    scale_specs = [
+        pl.BlockSpec((1, 1), lambda s, h, j, bt_ref: (bt_ref[s, j], h),
+                     memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, 1), lambda s, h, j, bt_ref: (bt_ref[s, j], h),
+                     memory_space=pltpu.SMEM),
+    ] if quantized else []
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(S, Hk, bps),
@@ -456,6 +552,7 @@ def flash_decode_attention_spec_paged(q, kp, vp, block_tables, visible,
                          lambda s, h, j, bt_ref: (bt_ref[s, j], 0, h, 0)),
             pl.BlockSpec((1, bs, 1, D),
                          lambda s, h, j, bt_ref: (bt_ref[s, j], 0, h, 0)),
+            *scale_specs,
             pl.BlockSpec((1, Q, bs), lambda s, h, j, bt_ref: (s, 0, j)),
             pl.BlockSpec((1, 1), lambda s, h, j, bt_ref: (s, 0),
                          memory_space=pltpu.SMEM),
@@ -467,6 +564,7 @@ def flash_decode_attention_spec_paged(q, kp, vp, block_tables, visible,
                          lambda s, h, j, bt_ref: (s, h, j, 0, 0)),
         ),
     )
+    scale_ops = (k_scale, v_scale) if quantized else ()
     o_p, l_p = pl.pallas_call(
         kern,
         grid_spec=grid_spec,
@@ -475,7 +573,8 @@ def flash_decode_attention_spec_paged(q, kp, vp, block_tables, visible,
             jax.ShapeDtypeStruct((S, Hk, bps, Q, G), acc_dt),
         ),
         interpret=_interpret(),
-    )(jnp.asarray(block_tables, jnp.int32), q5, kp, vp, valid, vis2)
+    )(jnp.asarray(block_tables, jnp.int32), q5, kp, vp, *scale_ops,
+      valid, vis2)
 
     # same logaddexp merge, with the extra Q axis riding along
     m = jnp.max(l_p, axis=2, keepdims=True)          # (S, Hk, 1, Q, G)
@@ -490,21 +589,27 @@ register_helper("decode_attention_spec_paged",
                 default_on=True)(flash_decode_attention_spec_paged)
 
 
-def paged_spec_decode_specs(tensor_axis: str = "tensor"):
+def paged_spec_decode_specs(tensor_axis: str = "tensor",
+                            quantized: bool = False):
     """shard_map partition specs for the SPECULATIVE paged attention call:
     `(in_specs, out_specs)` for `(q, kp, vp, block_tables, visible)` -> out
     with q/out shaped (S, Q, H, D). Identical head-locality argument to
     `paged_decode_specs` — the Q axis is per-slot and replicates with S, so
     the multi-query kernel stays collective-free under TP: every softmax
-    reduction runs over L within one head shard."""
+    reduction runs over L within one head shard. With `quantized`, two
+    trailing (num_blocks + 1, Hk) scale operands shard with their heads."""
     from jax.sharding import PartitionSpec as P
     heads_q = P(None, None, tensor_axis, None)      # q/out: (S, Q, H, D)
     heads_kv = P(None, None, tensor_axis, None)     # kp/vp: (nb+1, bs, Hk, D)
     in_specs = (heads_q, heads_kv, heads_kv, P(None, None), P(None))
+    if quantized:
+        scales = P(None, tensor_axis)               # (nb+1, Hk)
+        in_specs = in_specs + (scales, scales)
     return in_specs, heads_q
 
 
-def paged_decode_specs(tensor_axis: str = "tensor"):
+def paged_decode_specs(tensor_axis: str = "tensor",
+                       quantized: bool = False):
     """shard_map partition specs for the paged decode attention call
     (ISSUE 10): `(in_specs, out_specs)` for the array operands
     `(q, kp, vp, block_tables, visible)` -> out, sharding the HEAD axes
@@ -519,9 +624,17 @@ def paged_decode_specs(tensor_axis: str = "tensor"):
     cross-shard communication in a TP decode step is outside this call,
     in the row-parallel output projection (see PERF.md's cost model).
     Contiguous head splits preserve GQA grouping (head h reads kv head
-    h // G) whenever the TP degree divides n_kv_heads."""
+    h // G) whenever the TP degree divides n_kv_heads.
+
+    With `quantized`, two trailing scale operands (num_blocks + 1, Hk)
+    shard over their HEAD axis (axis 1) — a scale lives and dies with the
+    kv head it rescales, so TP sharding splits payload and scale along
+    the same boundary and the kernel stays collective-free."""
     from jax.sharding import PartitionSpec as P
     heads_q = P(None, tensor_axis, None)            # q/out: (S, H, D)
     heads_kv = P(None, None, tensor_axis, None)     # kp/vp: (nb+1, bs, Hk, D)
     in_specs = (heads_q, heads_kv, heads_kv, P(None, None), P(None))
+    if quantized:
+        scales = P(None, tensor_axis)               # (nb+1, Hk)
+        in_specs = in_specs + (scales, scales)
     return in_specs, heads_q

@@ -68,7 +68,7 @@ from deeplearning4j_tpu.ops.decode_attention import (
     decode_attention_dense, decode_attention_dense_paged,
     decode_attention_dense_spec_paged)
 from deeplearning4j_tpu.ops.helpers import helper_for
-from deeplearning4j_tpu.serving import kv_cache
+from deeplearning4j_tpu.serving import kv_cache, quant
 
 NEG_INF = -1e30
 
@@ -96,7 +96,7 @@ def decode_attention(q, kc, vc, visible, scale, window: int = 0):
 
 
 def decode_attention_paged(q, kp, vp, block_tables, visible, scale,
-                           window: int = 0):
+                           window: int = 0, k_scale=None, v_scale=None):
     """Single-query attention against the PAGED cache: same contract as
     `decode_attention`, but kc/vc are the (num_blocks + 1, block_size, Hk,
     D) physical blocks and each slot's positions resolve through its
@@ -104,37 +104,78 @@ def decode_attention_paged(q, kp, vp, block_tables, visible, scale,
     the block-table-aware split-K kernel
     (ops/decode_attention.flash_decode_attention_paged, default-on for
     TPU — the gather stays INSIDE the kernel via scalar prefetch) when
-    enabled, else the dense paged oracle (gather + the dense einsum)."""
+    enabled, else the dense paged oracle (gather + the dense einsum).
+    k_scale/v_scale (num_blocks + 1, Hk): per-head-per-block scales of an
+    int8 pool — both kernel and oracle dequantize per block, natively."""
     fn = helper_for("decode_attention_paged", decode_attention_dense_paged)
-    return fn(q, kp, vp, block_tables, visible, scale, window)
+    return fn(q, kp, vp, block_tables, visible, scale, window,
+              k_scale=k_scale, v_scale=v_scale)
 
 
 def decode_attention_spec_paged(q, kp, vp, block_tables, visible, scale,
-                                window: int = 0):
+                                window: int = 0, k_scale=None,
+                                v_scale=None):
     """Multi-query (speculative verification) attention against the PAGED
     cache: q (S, Q, H, D) — query i of slot s sits at logical position
     visible[s] - 1 + i and sees j < visible + i. Resolved through the
     helper seam: the multi-query split-K kernel
     (ops/decode_attention.flash_decode_attention_spec_paged, default-on for
     TPU) when enabled, else the dense spec paged oracle, whose per-position
-    math is bit-identical to the single-query dense path."""
+    math is bit-identical to the single-query dense path. k_scale/v_scale:
+    same int8-pool contract as `decode_attention_paged`."""
     fn = helper_for("decode_attention_spec_paged",
                     decode_attention_dense_spec_paged)
-    return fn(q, kp, vp, block_tables, visible, scale, window)
+    return fn(q, kp, vp, block_tables, visible, scale, window,
+              k_scale=k_scale, v_scale=v_scale)
 
 
 def _attn_heads(layer: SelfAttentionLayer, params, xt):
     """(.., n_in) -> q (.., H, Dh), k/v (.., Hk, Dh) with the layer's exact
-    projection math (SelfAttentionLayer.forward's `heads`)."""
+    projection math (SelfAttentionLayer.forward's `heads`). When the layer
+    dict carries `w_*_scale` leaves (weight-only int8, ISSUE 15) the
+    projection runs as (x @ w_int8) * scale — static key-presence
+    dispatch, resolved at trace time."""
     H = layer.n_heads
     Hk = getattr(layer, "n_kv_heads", 0) or H
     Dh = layer.n_out // H
 
-    def proj(w, h):
-        return jnp.reshape(xt @ w, xt.shape[:-1] + (h, Dh))
+    def proj(name, h):
+        w = params[name]
+        sc = params.get(name + "_scale")
+        y = xt @ w if sc is None else quant.int8_matmul(xt, w, sc)
+        return jnp.reshape(y, xt.shape[:-1] + (h, Dh))
 
-    return (proj(params["w_q"], H), proj(params["w_k"], Hk),
-            proj(params["w_v"], Hk))
+    return (proj("w_q", H), proj("w_k", Hk), proj("w_v", Hk))
+
+
+def _out_proj(params, out):
+    """The attention output projection out @ w_o + b, int8-aware the same
+    way as `_attn_heads`."""
+    sc = params.get("w_o_scale")
+    y = out @ params["w_o"] if sc is None \
+        else quant.int8_matmul(out, params["w_o"], sc)
+    return y + params["b"]
+
+
+def quantize_attention_weights(params, layers):
+    """Weight-only int8 for every SelfAttentionLayer's q/k/v/o projections
+    (per-output-channel scales, serving/quant.py): each weight leaf is
+    replaced by its int8 payload plus a `<name>_scale` sibling. The output
+    head (RnnOutputLayer W) deliberately stays float — logits are the
+    accuracy-critical surface and its matmul is one row per token, not a
+    bandwidth bottleneck. Returns a new params list; layer dicts are
+    copied, never mutated (the net still owns the float originals)."""
+    out = list(params)
+    for i, layer in enumerate(layers):
+        if not isinstance(layer, SelfAttentionLayer):
+            continue
+        p = dict(out[i])
+        for name in ("w_q", "w_k", "w_v", "w_o"):
+            wq, sc = quant.quantize_weight(p[name])
+            p[name] = wq
+            p[name + "_scale"] = sc
+        out[i] = p
+    return out
 
 
 def _dense_causal_attention(layer, q, k, v):
@@ -174,13 +215,17 @@ class StackDecoder:
                  num_blocks: Optional[int] = None,
                  prefix_share: Optional[bool] = None,
                  prefix_registry=None, paged_attention=None,
-                 paged_spec_attention=None):
+                 paged_spec_attention=None, kv_quant: Optional[bool] = None,
+                 quant_weights: Optional[bool] = None):
         layers, params = _extract_stack(net)
         self.layers = layers
         self.dtype = jnp.dtype(dtype) if dtype is not None else net.dtype
         from deeplearning4j_tpu.util.dtypes import cast_floats
         self.params = cast_floats(params, self.dtype) \
             if self.dtype != net.dtype else params
+        self.quant_weights = quant.resolve_quant_weights(quant_weights)
+        if self.quant_weights:
+            self.params = quantize_attention_weights(self.params, layers)
 
         self.attn_idx = [i for i, l in enumerate(layers)
                          if isinstance(l, SelfAttentionLayer)]
@@ -212,7 +257,8 @@ class StackDecoder:
                                       self.dtype, block_size=block_size,
                                       num_blocks=num_blocks,
                                       prefix_share=prefix_share,
-                                      prefix_registry=prefix_registry)
+                                      prefix_registry=prefix_registry,
+                                      kv_quant=kv_quant)
         # Attention seam (ISSUE 10): the sharded engine swaps in a
         # shard_map-wrapped kernel with the same signature as
         # decode_attention_paged; the default is the single-mesh helper.
@@ -275,7 +321,7 @@ class StackDecoder:
                 li += 1
                 out = _dense_causal_attention(layer, q, k, v)
                 out = out.reshape(xt.shape[0], layer.n_out)
-                out = layer._act(out @ p["w_o"] + p["b"])
+                out = layer._act(_out_proj(p, out))
                 xt = out
             else:
                 xt = self._positionwise(layer, p, xt)
@@ -319,10 +365,17 @@ class StackDecoder:
                     cache_state, li, slot, qpos, valid, k, v)
                 row = cache_state["block_tables"][
                     jnp.asarray(slot, jnp.int32)][:kv_blocks]
-                kl = cache_state["k"][li, row].reshape(
-                    L, self.n_kv_heads, self.head_dim)
-                vl = cache_state["v"][li, row].reshape(
-                    L, self.n_kv_heads, self.head_dim)
+                kb = cache_state["k"][li, row]       # (kvb, bs, Hk, D)
+                vb = cache_state["v"][li, row]
+                if kv_cache.is_quantized(cache_state):
+                    # dequantize per GATHERED block (slot view, never the
+                    # pool) — same reference math as the paged oracle
+                    kb = quant.kv_dequantize(
+                        kb, cache_state["k_scale"][li, row])
+                    vb = quant.kv_dequantize(
+                        vb, cache_state["v_scale"][li, row])
+                kl = kb.reshape(L, self.n_kv_heads, self.head_dim)
+                vl = vb.reshape(L, self.n_kv_heads, self.head_dim)
                 li += 1
                 H, Dh = layer.n_heads, self.head_dim
                 G = H // self.n_kv_heads
@@ -338,7 +391,7 @@ class StackDecoder:
                 pattn = jax.nn.softmax(s, axis=-1)
                 out = jnp.einsum("thgl,lhd->thgd", pattn, vl.astype(acc))
                 out = out.reshape(Ts, layer.n_out).astype(self.dtype)
-                xt = layer._act(out @ p["w_o"] + p["b"])
+                xt = layer._act(_out_proj(p, out))
             else:
                 xt = self._positionwise(layer, p, xt)
         cache_state = kv_cache.set_length(cache_state, slot, plen)
@@ -361,14 +414,17 @@ class StackDecoder:
                 q, k_t, v_t = _attn_heads(layer, p, h)      # (S, H/Hk, Dh)
                 cache_state = kv_cache.append_token(cache_state, li, k_t,
                                                     v_t, active)
+                qkw = {} if not kv_cache.is_quantized(cache_state) else {
+                    "k_scale": cache_state["k_scale"][li],
+                    "v_scale": cache_state["v_scale"][li]}
                 out = self._paged_attention(
                     q, cache_state["k"][li], cache_state["v"][li],
                     cache_state["block_tables"],
                     pos + 1, 1.0 / np.sqrt(self.head_dim),
-                    layer.attention_window)
+                    layer.attention_window, **qkw)
                 li += 1
                 out = out.reshape(h.shape[0], layer.n_out)
-                h = layer._act(out @ p["w_o"] + p["b"])
+                h = layer._act(_out_proj(p, out))
             else:
                 h = self._positionwise(layer, p, h)
         cache_state = kv_cache.advance_lengths(cache_state, active)
@@ -402,14 +458,17 @@ class StackDecoder:
                 q, k_t, v_t = _attn_heads(layer, p, h)      # (S, Q, ., Dh)
                 cache_state = kv_cache.append_tokens(
                     cache_state, li, k_t, v_t, positions, valid)
+                qkw = {} if not kv_cache.is_quantized(cache_state) else {
+                    "k_scale": cache_state["k_scale"][li],
+                    "v_scale": cache_state["v_scale"][li]}
                 out = self._paged_spec_attention(
                     q, cache_state["k"][li], cache_state["v"][li],
                     cache_state["block_tables"],
                     pos + 1, 1.0 / np.sqrt(self.head_dim),
-                    layer.attention_window)
+                    layer.attention_window, **qkw)
                 li += 1
                 out = out.reshape(S, Q, layer.n_out)
-                h = layer._act(out @ p["w_o"] + p["b"])
+                h = layer._act(_out_proj(p, out))
             else:
                 h = self._positionwise(
                     layer, p, h.reshape(S * Q, -1)).reshape(S, Q, -1)

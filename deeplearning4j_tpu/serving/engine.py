@@ -419,13 +419,17 @@ class ServingEngine:
                  kv_swap_bytes: Optional[int] = None,
                  kv_evict_mode: str = "auto",
                  prefix_store=None,
+                 kv_quant: Optional[bool] = None,
+                 quant_weights: Optional[bool] = None,
                  name: Optional[str] = None):
         self.decoder = self._build_decoder(net, max_seqs, max_len,
                                            dtype=dtype,
                                            block_size=kv_block,
                                            num_blocks=kv_blocks,
                                            prefix_share=prefix_share,
-                                           prefix_registry=prefix_registry)
+                                           prefix_registry=prefix_registry,
+                                           kv_quant=kv_quant,
+                                           quant_weights=quant_weights)
         if embed is None:
             if self.decoder.n_in is None:
                 raise ValueError("stack has no n_in; pass embed=")
@@ -592,6 +596,9 @@ class ServingEngine:
         self.decoder.metrics = self.metrics   # prefill cost gauges land on
         # the same child registry as the engine's observe() gauges
         self._kv_bytes_per_pos = cache.bytes_per_position
+        # quantized pool (ISSUE 15): fp32 scale bytes per block, counted
+        # in swap/prefix-store accounting next to the int8 payload
+        self._kv_block_overhead = cache.block_overhead_bytes
         self._g_kv_total = self.metrics.gauge(
             "serving.kv_cache_bytes", "preallocated KV cache footprint")
         self._g_kv_total.set(cache.bytes())
@@ -674,12 +681,21 @@ class ServingEngine:
         if self.prefix_store is not None:
             expect = (cache.n_layers, cache.block_size, cache.n_kv_heads,
                       cache.head_dim)
+            expect_dt = str(cache.state["k"].dtype)
             if self.prefix_store.block_shape is None:
                 self.prefix_store.block_shape = expect
             elif self.prefix_store.block_shape != expect:
                 # a spill file from another model geometry: ignore it
                 # rather than restore garbage bytes
                 self.prefix_store = None
+            if self.prefix_store is not None:
+                # payload dtype must match too (ISSUE 15): an int8
+                # quantized spill scattered into a float pool — or the
+                # reverse — would be garbage even at matching geometry
+                if self.prefix_store.block_dtype is None:
+                    self.prefix_store.block_dtype = expect_dt
+                elif self.prefix_store.block_dtype != expect_dt:
+                    self.prefix_store = None
         self._c_evict_rec = self.metrics.counter(
             "serving.kv.evictions_recompute", "preemptions reclaimed by "
             "freeing blocks and replaying prefill at readmission")
@@ -1335,14 +1351,21 @@ class ServingEngine:
             gen = np.asarray(self._hist[slot])[:n].tolist()
         self._c_syncs.inc()
         t_prev = act.timeline[-1]["t1"] if act.timeline else act.t_submit
-        nbytes = victim["blocks_total"] * cache.block_size * \
-            self._kv_bytes_per_pos
+        nbytes = victim["blocks_total"] * (
+            cache.block_size * self._kv_bytes_per_pos
+            + self._kv_block_overhead)
         if mode == "swap":
             # gather BEFORE free: the dispatch pins the blocks' bytes
             # even though the ids return to the free list right after
             blocks = list(cache._slot_blocks[slot])
-            k_blk, v_blk = _kvc.gather_blocks(cache.state, blocks)
-            self.lifecycle.swap_out(act.req_id, k_blk, v_blk, nbytes)
+            ks_blk = vs_blk = None
+            if _kvc.is_quantized(cache.state):
+                k_blk, v_blk, ks_blk, vs_blk = _kvc.gather_blocks(
+                    cache.state, blocks, with_scales=True)
+            else:
+                k_blk, v_blk = _kvc.gather_blocks(cache.state, blocks)
+            self.lifecycle.swap_out(act.req_id, k_blk, v_blk, nbytes,
+                                    k_scale=ks_blk, v_scale=vs_blk)
             self._c_evict_swap.inc()
             self._c_swap_out.inc(nbytes)
         else:
@@ -1388,6 +1411,9 @@ class ServingEngine:
         live = plen + n - 1
         nbytes = act.resume["nbytes"]
         with telemetry.span("host_sync", what="swap_in", slot=slot):
+            # scales peek BEFORE fetch pops them (quantized pool only;
+            # rides the same counted swap-in materialization)
+            scales = self.lifecycle.host_pool.fetch_scales(act.req_id)
             # sync-ok: swap-in materialization (pressure path only)
             k_host, v_host = self.lifecycle.swap_in(act.req_id, nbytes)
         self._c_syncs.inc()
@@ -1398,9 +1424,11 @@ class ServingEngine:
         lis = [li for li in range(min(len(row), k_host.shape[1]))
                if li * bs < live and cache.allocator.refcount(row[li]) == 1]
         if lis:
+            skw = {} if scales is None else {
+                "k_scale": scales[0][:, lis], "v_scale": scales[1][:, lis]}
             cache.state = _kvc.restore_blocks(
                 cache.state, [row[li] for li in lis],
-                k_host[:, lis], v_host[:, lis])
+                k_host[:, lis], v_host[:, lis], **skw)
         cache.state = _kvc.set_length(cache.state, slot, live)
         cache.touch_blocks(slot, 0, live)
         cache.register_prefix(slot, self._admission_sequence(act))
@@ -1483,14 +1511,24 @@ class ServingEngine:
         row = cache._slot_blocks[act.slot]
         if any(cache.allocator.refcount(row[li]) != 1 for li in lis):
             return shared
+        if _kvc.is_quantized(cache.state) and \
+                self.prefix_store.fetch_scales([digs[i] for i in lis]) \
+                is None:
+            # quantized pool but a scale-less (pre-quant) store entry:
+            # restoring the payload without its scales would rescale
+            # content — skip, prefill covers the suffix as usual
+            return shared
         with telemetry.span("host_sync", what="prefix_store_restore",
                             slot=act.slot, blocks=len(lis)):
             # sync-ok: prefix-store fetch materialization (restore path)
             k_host, v_host = self.prefix_store.fetch(
                 [digs[i] for i in lis])
+            sc = self.prefix_store.fetch_scales([digs[i] for i in lis]) \
+                if _kvc.is_quantized(cache.state) else None
         self._c_syncs.inc()
+        skw = {} if sc is None else {"k_scale": sc[0], "v_scale": sc[1]}
         cache.state = _kvc.restore_blocks(
-            cache.state, [row[li] for li in lis], k_host, v_host)
+            cache.state, [row[li] for li in lis], k_host, v_host, **skw)
         new_shared = k_cov * bs
         cache.touch_blocks(act.slot, shared, new_shared)
         act.prefilled = act.shared_len = new_shared
@@ -1516,13 +1554,20 @@ class ServingEngine:
         if not missing:
             return
         row = cache._slot_blocks[act.slot]
-        k_blk, v_blk = _kvc.gather_blocks(cache.state,
-                                          [row[i] for i in missing])
-        nb = bs * self._kv_bytes_per_pos
+        ks_blk = vs_blk = None
+        if _kvc.is_quantized(cache.state):
+            k_blk, v_blk, ks_blk, vs_blk = _kvc.gather_blocks(
+                cache.state, [row[i] for i in missing], with_scales=True)
+        else:
+            k_blk, v_blk = _kvc.gather_blocks(cache.state,
+                                              [row[i] for i in missing])
+        nb = bs * self._kv_bytes_per_pos + self._kv_block_overhead
         shape = (cache.n_layers, bs, cache.n_kv_heads, cache.head_dim)
         for j, i in enumerate(missing):
+            skw = {} if ks_blk is None else {
+                "k_scale": ks_blk[:, j], "v_scale": vs_blk[:, j]}
             store.put(digs[i], k_blk[:, j], v_blk[:, j], nb,
-                      block_shape=shape)
+                      block_shape=shape, **skw)
 
     def _update_kv_resident(self) -> None:
         """Publish resident KV bytes: cache positions actually holding a

@@ -23,7 +23,29 @@ the KV bytes and the prefill compute for the shared prefix; the block
 containing the first divergent write is copied at admission.
 
 Env knobs: `DL4J_TPU_KV_BLOCK` (block size in positions, default 16),
-`DL4J_TPU_PREFIX_SHARE` (0 disables sharing; default on).
+`DL4J_TPU_PREFIX_SHARE` (0 disables sharing; default on),
+`DL4J_TPU_KV_QUANT` (int8 pool, default off — see below).
+
+QUANTIZED POOL (ISSUE 15): with kv_quant on, k/v store int8 payloads and
+the state pytree gains per-head-per-block symmetric scales
+
+    k_scale, v_scale: (n_layers, num_blocks + 1, n_kv_heads) fp32
+
+quantized at WRITE time through serving/quant.py (one seam for prefill,
+positional scatter, decode append and speculative append) and dequantized
+at READ time inside the flash-decode kernel — a dequantized pool is never
+materialized. Presence of "k_scale" in the state dict is the static
+dispatch flag (a Python `in`, resolved at trace time — zero device cost).
+Sub-block writes become block-granular read-modify-writes: gather the
+affected blocks, dequantize, insert the new positions, requantize, and
+write back ONLY the touched blocks (`jnp.where(touched, new, old)` on
+payload AND scale). The untouched-block write-back path is bit-exact by
+construction and the touched-mask is load-bearing, not an optimization:
+requantizing an unchanged block would RESCALE it (new scale = old *
+max|q|/127 unless some |q| == 127), silently moving shared/COW bytes. The
+same trash-routing rules apply — invalid RMW lanes target the trash block
+(or a dummy gather row), where the unspecified scatter winner is garbage
+writing over garbage.
 
 Device-side mutation stays functional and jit-friendly — every write
 resolves logical positions through the block table INSIDE the traced fn,
@@ -85,10 +107,17 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.serving import quant
 from deeplearning4j_tpu.serving.block_table import (BlockAllocator,
                                                     PrefixRegistry)
 
 DEFAULT_BLOCK = 16
+
+
+def is_quantized(state: Dict[str, jnp.ndarray]) -> bool:
+    """Static (trace-time) dispatch: does this state carry an int8 pool
+    with per-head-per-block scales?"""
+    return "k_scale" in state
 
 
 def resolve_block_size(block_size: Optional[int], max_len: int) -> int:
@@ -107,17 +136,21 @@ def resolve_block_size(block_size: Optional[int], max_len: int) -> int:
 def init_cache_state(n_layers: int, max_seqs: int, max_len: int,
                      n_kv_heads: int, head_dim: int, dtype=jnp.bfloat16,
                      block_size: Optional[int] = None,
-                     num_blocks: Optional[int] = None
-                     ) -> Dict[str, jnp.ndarray]:
+                     num_blocks: Optional[int] = None,
+                     kv_quant: bool = False) -> Dict[str, jnp.ndarray]:
     """Allocate the device-side paged cache pytree (all-zero, all slots
-    free, every table entry pointing at the trash block)."""
+    free, every table entry pointing at the trash block). With kv_quant
+    the payload is int8 and the pytree gains k_scale/v_scale (scale 1.0
+    everywhere — payload 0 dequantizes to 0 either way, and quantizing an
+    all-zero block also yields scale 1.0, see serving/quant.py)."""
     bs = resolve_block_size(block_size, max_len)
     bps = max_len // bs
     nb = int(num_blocks) if num_blocks is not None else max_seqs * bps
     shape = (n_layers, nb + 1, bs, n_kv_heads, head_dim)   # +1: trash block
-    return {
-        "k": jnp.zeros(shape, dtype),
-        "v": jnp.zeros(shape, dtype),
+    pdt = quant.PAYLOAD_DTYPE if kv_quant else dtype
+    state = {
+        "k": jnp.zeros(shape, pdt),
+        "v": jnp.zeros(shape, pdt),
         # number of CACHED positions per slot; position p is visible iff
         # p < lengths[slot]
         "lengths": jnp.zeros((max_seqs,), jnp.int32),
@@ -125,6 +158,12 @@ def init_cache_state(n_layers: int, max_seqs: int, max_len: int,
         # a slot has no reservation
         "block_tables": jnp.full((max_seqs, bps), nb, jnp.int32),
     }
+    if kv_quant:
+        state["k_scale"] = jnp.ones((n_layers, nb + 1, n_kv_heads),
+                                    quant.SCALE_DTYPE)
+        state["v_scale"] = jnp.ones((n_layers, nb + 1, n_kv_heads),
+                                    quant.SCALE_DTYPE)
+    return state
 
 
 def _dims(state):
@@ -152,6 +191,19 @@ def write_prefill(state: Dict[str, jnp.ndarray], layer: int, slot,
     phys = state["block_tables"][jnp.asarray(slot, jnp.int32)][:nb]  # (nb,)
     kb = k_block.reshape((nb, bs) + k_block.shape[1:])
     vb = v_block.reshape((nb, bs) + v_block.shape[1:])
+    if is_quantized(state):
+        # Whole blocks: quantize per (block, head) and scatter payload +
+        # scale. Padding blocks beyond the reservation collapse onto the
+        # trash index — the payload/scale scatter winners there may come
+        # from DIFFERENT padding blocks, which is harmless: trash is never
+        # read visible and any scale dequantizes finite garbage.
+        kq, ks = quant.kv_quantize(kb)                    # int8, (nb, Hk)
+        vq, vs = quant.kv_quantize(vb)
+        return {**state,
+                "k": state["k"].at[layer, phys].set(kq),
+                "v": state["v"].at[layer, phys].set(vq),
+                "k_scale": state["k_scale"].at[layer, phys].set(ks),
+                "v_scale": state["v_scale"].at[layer, phys].set(vs)}
     return {**state,
             "k": state["k"].at[layer, phys].set(kb.astype(state["k"].dtype)),
             "v": state["v"].at[layer, phys].set(vb.astype(state["v"].dtype))}
@@ -166,12 +218,45 @@ def write_positions(state: Dict[str, jnp.ndarray], layer: int, slot,
     padded tail of a shared-prefix suffix prefill) route to the trash
     block — they must NEVER alias a real (block, offset) pair, because a
     duplicate scatter index has an unspecified winner and a garbage
-    padding row could otherwise clobber a just-written real position."""
+    padding row could otherwise clobber a just-written real position.
+
+    Quantized pool: a sub-block scatter becomes a block-granular RMW over
+    the slot's WHOLE row (this is a prefill-time call, not the per-token
+    path): gather the row's blocks, dequantize, insert the new positions
+    — invalid rows land in a dummy gather row, the RMW analog of trash
+    routing — requantize, and write back only the TOUCHED blocks, so
+    untouched (including shared read-only) blocks keep their exact
+    payload and scale bytes."""
     bs, bps, trash = _dims(state)
     row = state["block_tables"][jnp.asarray(slot, jnp.int32)]     # (bps,)
     bidx = jnp.clip(positions // bs, 0, bps - 1)
-    phys = jnp.where(valid, row[bidx], trash)
     off = positions % bs
+    if is_quantized(state):
+        kq = state["k"][layer, row]                       # (bps, bs, Hk, D)
+        vq = state["v"][layer, row]
+        ks = state["k_scale"][layer, row]                 # (bps, Hk)
+        vs = state["v_scale"][layer, row]
+        kf = quant.kv_dequantize(kq, ks)
+        vf = quant.kv_dequantize(vq, vs)
+        kf = jnp.concatenate([kf, jnp.zeros_like(kf[:1])], axis=0)
+        vf = jnp.concatenate([vf, jnp.zeros_like(vf[:1])], axis=0)
+        tgt = jnp.where(valid, bidx, bps)                 # bps = dummy row
+        kf = kf.at[tgt, off].set(k_seq.astype(kf.dtype))
+        vf = vf.at[tgt, off].set(v_seq.astype(vf.dtype))
+        kq2, ks2 = quant.kv_quantize(kf[:bps])
+        vq2, vs2 = quant.kv_quantize(vf[:bps])
+        touched = jnp.zeros((bps + 1,), jnp.int32).at[tgt].add(
+            valid.astype(jnp.int32))[:bps] > 0            # (bps,)
+        return {**state,
+                "k": state["k"].at[layer, row].set(
+                    jnp.where(touched[:, None, None, None], kq2, kq)),
+                "v": state["v"].at[layer, row].set(
+                    jnp.where(touched[:, None, None, None], vq2, vq)),
+                "k_scale": state["k_scale"].at[layer, row].set(
+                    jnp.where(touched[:, None], ks2, ks)),
+                "v_scale": state["v_scale"].at[layer, row].set(
+                    jnp.where(touched[:, None], vs2, vs))}
+    phys = jnp.where(valid, row[bidx], trash)
     return {**state,
             "k": state["k"].at[layer, phys, off].set(
                 k_seq.astype(state["k"].dtype)),
@@ -203,6 +288,36 @@ def append_token(state: Dict[str, jnp.ndarray], layer: int,
                                axis=1)[:, 0]
     phys = jnp.where(active, phys, trash)
     off = pos % bs
+    if is_quantized(state):
+        # Block-granular RMW of each slot's CURRENT block. Trash routing
+        # happens before the gather, so an inactive slot reads trash and
+        # writes trash back — it can never write back (even bit-identical)
+        # bytes of a block its stale table row points at, which matters
+        # because that block's new owner may be appending into it in this
+        # very scatter. Active slots' current blocks are private and
+        # distinct (shared blocks are read-only; admission COWs the first
+        # written block), so touched targets never collide.
+        S = pos.shape[0]
+        kq = state["k"][layer, phys]                      # (S, bs, Hk, D)
+        vq = state["v"][layer, phys]
+        ks = state["k_scale"][layer, phys]                # (S, Hk)
+        vs = state["v_scale"][layer, phys]
+        kf = quant.kv_dequantize(kq, ks).at[jnp.arange(S), off].set(
+            k_t.astype(quant.SCALE_DTYPE))
+        vf = quant.kv_dequantize(vq, vs).at[jnp.arange(S), off].set(
+            v_t.astype(quant.SCALE_DTYPE))
+        kq2, ks2 = quant.kv_quantize(kf)
+        vq2, vs2 = quant.kv_quantize(vf)
+        act = active.astype(bool)
+        return {**state,
+                "k": state["k"].at[layer, phys].set(
+                    jnp.where(act[:, None, None, None], kq2, kq)),
+                "v": state["v"].at[layer, phys].set(
+                    jnp.where(act[:, None, None, None], vq2, vq)),
+                "k_scale": state["k_scale"].at[layer, phys].set(
+                    jnp.where(act[:, None], ks2, ks)),
+                "v_scale": state["v_scale"].at[layer, phys].set(
+                    jnp.where(act[:, None], vs2, vs))}
     return {**state,
             "k": state["k"].at[layer, phys, off].set(
                 k_t.astype(state["k"].dtype)),
@@ -229,6 +344,52 @@ def append_tokens(state: Dict[str, jnp.ndarray], layer: int,
     bs, bps, trash = _dims(state)
     S, Q = positions.shape
     bidx = jnp.clip(positions // bs, 0, bps - 1)              # (S, Q)
+    if is_quantized(state):
+        # Block-granular RMW over a STATIC window of blocks per slot: Q
+        # consecutive positions starting at positions[:, 0] span at most
+        # (Q + bs - 2) // bs + 1 blocks, so the gather shape is fixed at
+        # trace time. Slots with no valid row (inactive) gather — and
+        # therefore write back — only trash: a stale table row's blocks
+        # may be owned by another slot appending in this same scatter, so
+        # even a bit-identical write-back through the stale row would race
+        # it (unspecified scatter winner). Window entries past the table
+        # edge also collapse to trash for the same reason.
+        nblk = min(bps, (Q + bs - 2) // bs + 1)
+        b0 = jnp.clip(positions[:, 0] // bs, 0, bps - 1)      # (S,)
+        lidx = b0[:, None] + jnp.arange(nblk)                 # (S, nblk)
+        in_range = lidx < bps
+        physw = jnp.take_along_axis(state["block_tables"],
+                                    jnp.clip(lidx, 0, bps - 1), axis=1)
+        live = jnp.any(valid, axis=1)                         # (S,)
+        physw = jnp.where(live[:, None] & in_range, physw, trash)
+        kq = state["k"][layer, physw]                     # (S,nblk,bs,Hk,D)
+        vq = state["v"][layer, physw]
+        ks = state["k_scale"][layer, physw]               # (S, nblk, Hk)
+        vs = state["v_scale"][layer, physw]
+        kf = quant.kv_dequantize(kq, ks)
+        vf = quant.kv_dequantize(vq, vs)
+        kf = jnp.concatenate([kf, jnp.zeros_like(kf[:, :1])], axis=1)
+        vf = jnp.concatenate([vf, jnp.zeros_like(vf[:, :1])], axis=1)
+        rel = bidx - b0[:, None]                              # (S, Q)
+        ok = valid & (rel >= 0) & (rel < nblk)
+        tgt = jnp.where(ok, rel, nblk)                    # nblk = dummy col
+        sidx = jnp.broadcast_to(jnp.arange(S)[:, None], (S, Q))
+        off = positions % bs
+        kf = kf.at[sidx, tgt, off].set(k_t.astype(kf.dtype))
+        vf = vf.at[sidx, tgt, off].set(v_t.astype(vf.dtype))
+        kq2, ks2 = quant.kv_quantize(kf[:, :nblk])
+        vq2, vs2 = quant.kv_quantize(vf[:, :nblk])
+        touched = jnp.zeros((S, nblk + 1), jnp.int32).at[sidx, tgt].add(
+            ok.astype(jnp.int32))[:, :nblk] > 0           # (S, nblk)
+        return {**state,
+                "k": state["k"].at[layer, physw].set(
+                    jnp.where(touched[..., None, None, None], kq2, kq)),
+                "v": state["v"].at[layer, physw].set(
+                    jnp.where(touched[..., None, None, None], vq2, vq)),
+                "k_scale": state["k_scale"].at[layer, physw].set(
+                    jnp.where(touched[..., None], ks2, ks)),
+                "v_scale": state["v_scale"].at[layer, physw].set(
+                    jnp.where(touched[..., None], vs2, vs))}
     phys = jnp.take_along_axis(state["block_tables"], bidx, axis=1)
     phys = jnp.where(valid, phys, trash).reshape(S * Q)
     off = (positions % bs).reshape(S * Q)
@@ -259,39 +420,66 @@ def set_block_table(state: Dict[str, jnp.ndarray], slot: int,
 def copy_block(state: Dict[str, jnp.ndarray], src: int, dst: int
                ) -> Dict[str, jnp.ndarray]:
     """Copy one physical block across ALL layers (the COW copy a shared
-    tail block pays at admission — one device op, no readback)."""
-    return {**state,
-            "k": state["k"].at[:, dst].set(state["k"][:, src]),
-            "v": state["v"].at[:, dst].set(state["v"][:, src])}
+    tail block pays at admission — one device op, no readback). A
+    quantized block's scales travel with its payload: the copy is
+    bit-exact, never a dequantize/requantize."""
+    out = {**state,
+           "k": state["k"].at[:, dst].set(state["k"][:, src]),
+           "v": state["v"].at[:, dst].set(state["v"][:, src])}
+    if is_quantized(state):
+        out["k_scale"] = state["k_scale"].at[:, dst].set(
+            state["k_scale"][:, src])
+        out["v_scale"] = state["v_scale"].at[:, dst].set(
+            state["v_scale"][:, src])
+    return out
 
 
-def gather_blocks(state: Dict[str, jnp.ndarray], blocks: Sequence[int]
-                  ) -> tuple:
+def gather_blocks(state: Dict[str, jnp.ndarray], blocks: Sequence[int],
+                  with_scales: bool = False) -> tuple:
     """Gather the k/v bytes of physical `blocks` across all layers — the
     device half of a swap-out (serving/lifecycle.py). Returns
     (k_blk, v_blk), each (n_layers, len(blocks), block_size, n_kv_heads,
-    head_dim). This DISPATCHES an async gather and returns device
+    head_dim) — plus (k_scale, v_scale), each (n_layers, len(blocks),
+    n_kv_heads), when `with_scales` is set on a quantized pool. This
+    DISPATCHES an async gather and returns device
     arrays; the bytes only cross to the host when the caller
     materializes them. Because every cache mutation is functional (no
     donation, no in-place update), the gathered value is pinned at
     dispatch order — writes issued after it, including a new owner
     reusing these physical blocks, cannot retroactively corrupt it."""
     idx = jnp.asarray(list(blocks), jnp.int32)
+    if with_scales and is_quantized(state):
+        return (state["k"][:, idx], state["v"][:, idx],
+                state["k_scale"][:, idx], state["v_scale"][:, idx])
     return state["k"][:, idx], state["v"][:, idx]
 
 
 def restore_blocks(state: Dict[str, jnp.ndarray], blocks: Sequence[int],
-                   k_blk, v_blk) -> Dict[str, jnp.ndarray]:
+                   k_blk, v_blk, k_scale=None, v_scale=None
+                   ) -> Dict[str, jnp.ndarray]:
     """Scatter previously gathered block bytes back into physical
     `blocks` across all layers (swap-in / prefix-store restore): one
     batched scatter per buffer, the exact inverse of `gather_blocks`, so
-    a swap round-trip is bit-identical by construction."""
+    a swap round-trip is bit-identical by construction. A quantized pool
+    requires the matching scales — int8 payload without its scale is not
+    restorable, and silently keeping stale scales would rescale the
+    content."""
     idx = jnp.asarray(list(blocks), jnp.int32)
-    return {**state,
-            "k": state["k"].at[:, idx].set(
-                jnp.asarray(k_blk).astype(state["k"].dtype)),
-            "v": state["v"].at[:, idx].set(
-                jnp.asarray(v_blk).astype(state["v"].dtype))}
+    out = {**state,
+           "k": state["k"].at[:, idx].set(
+               jnp.asarray(k_blk).astype(state["k"].dtype)),
+           "v": state["v"].at[:, idx].set(
+               jnp.asarray(v_blk).astype(state["v"].dtype))}
+    if is_quantized(state):
+        if k_scale is None or v_scale is None:
+            raise ValueError(
+                "restore_blocks on a quantized pool requires k_scale/"
+                "v_scale (gather with with_scales=True)")
+        out["k_scale"] = state["k_scale"].at[:, idx].set(
+            jnp.asarray(k_scale).astype(state["k_scale"].dtype))
+        out["v_scale"] = state["v_scale"].at[:, idx].set(
+            jnp.asarray(v_scale).astype(state["v_scale"].dtype))
+    return out
 
 
 @dataclass
@@ -320,7 +508,8 @@ class KVCache:
                  block_size: Optional[int] = None,
                  num_blocks: Optional[int] = None,
                  prefix_share: Optional[bool] = None,
-                 prefix_registry: Optional[PrefixRegistry] = None):
+                 prefix_registry: Optional[PrefixRegistry] = None,
+                 kv_quant: Optional[bool] = None):
         if max_seqs < 1 or max_len < 1:
             raise ValueError(f"bad cache shape: max_seqs={max_seqs}, "
                              f"max_len={max_len}")
@@ -340,10 +529,12 @@ class KVCache:
         if prefix_share is None:
             prefix_share = os.environ.get("DL4J_TPU_PREFIX_SHARE", "1") != "0"
         self.prefix_share = bool(prefix_share)
+        self.kv_quant = quant.resolve_kv_quant(kv_quant)
         self.state = init_cache_state(n_layers, max_seqs, max_len,
                                       n_kv_heads, head_dim, dtype,
                                       block_size=self.block_size,
-                                      num_blocks=self.num_blocks)
+                                      num_blocks=self.num_blocks,
+                                      kv_quant=self.kv_quant)
         # list(range(n)) is already a valid min-heap
         self._free_slots: List[int] = list(range(max_seqs))
         self.allocator = BlockAllocator(self.num_blocks)
@@ -581,6 +772,7 @@ class KVCache:
             "num_blocks": self.num_blocks,
             "block_size": self.block_size,
             "bytes_per_position": self.bytes_per_position,
+            "block_overhead_bytes": self.block_overhead_bytes,
             "blocks_free": alloc.n_free,
             "blocks_shared": alloc.n_shared,
             "slots_free": len(self._free_slots),
@@ -628,12 +820,31 @@ class KVCache:
 
     @property
     def bytes_per_position(self) -> int:
-        """Per-token KV cost (k+v, all layers) — the PERF.md unit."""
-        return 2 * self.n_layers * self.n_kv_heads * self.head_dim * \
-            self.dtype.itemsize
+        """Per-token KV PAYLOAD cost (k+v, all layers) — the PERF.md
+        unit. Derived from the ACTUAL pool array dtypes (int8 when
+        quantized, whatever the ctor got otherwise), not the ctor
+        `self.dtype` assumption — a non-bf16 pool used to misreport every
+        downstream byte gauge. Scale bytes are per-BLOCK, not
+        per-position (and fractional per position), so they live in
+        `block_overhead_bytes` — every byte consumer adds
+        blocks * block_overhead_bytes to keep accounting integral and
+        exactly conserved."""
+        return self.n_layers * self.n_kv_heads * self.head_dim * (
+            self.state["k"].dtype.itemsize + self.state["v"].dtype.itemsize)
+
+    @property
+    def block_overhead_bytes(self) -> int:
+        """Scale bytes carried per physical block (0 on an unquantized
+        pool): one fp32 per (layer, kv head) for each of k and v."""
+        if not is_quantized(self.state):
+            return 0
+        return self.n_layers * self.n_kv_heads * (
+            self.state["k_scale"].dtype.itemsize +
+            self.state["v_scale"].dtype.itemsize)
 
     def bytes(self) -> int:
         """Device HBM held by the k/v buffers (num_blocks + the trash
-        block) — the PERF.md paged footprint formula."""
-        return (self.num_blocks + 1) * self.block_size * \
-            self.bytes_per_position
+        block), scales included — the PERF.md paged footprint formula."""
+        return (self.num_blocks + 1) * (
+            self.block_size * self.bytes_per_position +
+            self.block_overhead_bytes)

@@ -90,22 +90,39 @@ class HostBlockPool:
     def __init__(self, capacity_bytes: int = 0):
         self.capacity_bytes = max(0, int(capacity_bytes))
         self._entries: Dict[object, Tuple[object, object, int]] = {}
+        # quantized pools (ISSUE 15) ride their per-head-per-block scales
+        # alongside the payload; a side dict keeps `_entries` 3-tuples
+        self._scales: Dict[object, Tuple[object, object]] = {}
         self.bytes_used = 0
 
     def can_fit(self, nbytes: int) -> bool:
         return (self.capacity_bytes > 0
                 and self.bytes_used + int(nbytes) <= self.capacity_bytes)
 
-    def put(self, key, k_blocks, v_blocks, nbytes: int) -> None:
+    def put(self, key, k_blocks, v_blocks, nbytes: int,
+            k_scale=None, v_scale=None) -> None:
         if key in self._entries:
             raise ValueError(f"swap key {key!r} already held")
         self._entries[key] = (k_blocks, v_blocks, int(nbytes))
+        if k_scale is not None:
+            self._scales[key] = (k_scale, v_scale)
         self.bytes_used += int(nbytes)
+
+    def fetch_scales(self, key) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Materialized (k_scale, v_scale) for a quantized entry, or None
+        for an unquantized one. Non-destructive peek — call before
+        `fetch()` (which drops the scales with the payload)."""
+        sc = self._scales.get(key)
+        if sc is None:
+            return None
+        # sync-ok: swap-in materialization (pressure path)
+        return np.asarray(sc[0]), np.asarray(sc[1])
 
     def fetch(self, key) -> Tuple[np.ndarray, np.ndarray]:
         """Remove and MATERIALIZE one entry (the swap-in device->host
         copy happens here; the caller times it and counts the sync)."""
         k, v, n = self._entries.pop(key)
+        self._scales.pop(key, None)
         self.bytes_used -= n
         # counted+timed by the engine via KVLifecycleManager.swap_in
         # sync-ok: swap-in materialization (pressure path)
@@ -113,6 +130,7 @@ class HostBlockPool:
 
     def drop(self, key) -> None:
         ent = self._entries.pop(key, None)
+        self._scales.pop(key, None)
         if ent is not None:
             self.bytes_used -= ent[2]
 
@@ -142,8 +160,15 @@ class PersistentPrefixStore:
         # arrays until save()/fetch() materializes them
         self._entries: "OrderedDict[bytes, Tuple[object, object, int]]" = \
             OrderedDict()
+        # quantized pools (ISSUE 15): per-entry (k_scale, v_scale) side
+        # dict, spilled as ks_<hex>/vs_<hex> npz arrays
+        self._scales: Dict[bytes, Tuple[object, object]] = {}
         self.bytes_used = 0
         self.block_shape: Optional[tuple] = None
+        # payload dtype string, established at first put — the engine's
+        # geometry guard also compares this so an int8 (quantized) spill
+        # is never restored into a float pool or vice versa
+        self.block_dtype: Optional[str] = None
 
     # ------------------------------------------------------------ lookup
     def covered(self, digests: Sequence[bytes]) -> int:
@@ -165,10 +190,13 @@ class PersistentPrefixStore:
 
     # ------------------------------------------------------------- write
     def put(self, digest: bytes, k_block, v_block, nbytes: int,
-            block_shape: Optional[tuple] = None) -> None:
+            block_shape: Optional[tuple] = None,
+            k_scale=None, v_scale=None) -> None:
         """File one block's bytes under its chain digest (first write
         wins — identical content by the chain-hash certificate). Evicts
-        LRU entries to stay under the byte cap."""
+        LRU entries to stay under the byte cap. Quantized pools pass the
+        block's (k_scale, v_scale) pair; int8 payload + fp32 scales
+        restore bit-exactly."""
         if digest in self._entries:
             self._entries.move_to_end(digest)
             return
@@ -179,14 +207,20 @@ class PersistentPrefixStore:
                 raise ValueError(
                     f"prefix-store block shape {tuple(block_shape)} != "
                     f"established {self.block_shape}")
+        if self.block_dtype is None:
+            dt = str(getattr(k_block, "dtype", "")) or None
+            self.block_dtype = dt
         nbytes = int(nbytes)
         if self.capacity_bytes and nbytes > self.capacity_bytes:
             return
         while self.capacity_bytes and self._entries \
                 and self.bytes_used + nbytes > self.capacity_bytes:
-            _, (_, _, old) = self._entries.popitem(last=False)
+            old_d, (_, _, old) = self._entries.popitem(last=False)
+            self._scales.pop(old_d, None)
             self.bytes_used -= old
         self._entries[digest] = (k_block, v_block, nbytes)
+        if k_scale is not None:
+            self._scales[digest] = (k_scale, v_scale)
         self.bytes_used += nbytes
 
     def fetch(self, digests: Sequence[bytes]
@@ -202,6 +236,19 @@ class PersistentPrefixStore:
             vs.append(np.asarray(v))  # sync-ok: prefix-store restore
         return np.stack(ks, axis=1), np.stack(vs, axis=1)
 
+    def fetch_scales(self, digests: Sequence[bytes]
+                     ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+        """Materialized (k_scale, v_scale) stacks, shape (n_layers,
+        len(digests), n_kv_heads) each, or None when any digest lacks
+        scales (unquantized entries). Non-destructive."""
+        if any(d not in self._scales for d in digests):
+            return None
+        # sync-ok: prefix-store restore (counted by the engine)
+        ks = [np.asarray(self._scales[d][0]) for d in digests]
+        vs = [np.asarray(self._scales[d][1])  # sync-ok: restore path
+              for d in digests]
+        return np.stack(ks, axis=1), np.stack(vs, axis=1)
+
     # ----------------------------------------------------- persistence
     def save(self, path: Optional[str] = None) -> Optional[str]:
         """Spill every entry to an npz file (digests hex-encoded in the
@@ -215,6 +262,11 @@ class PersistentPrefixStore:
             # sync-ok: shutdown spill (phase boundary)
             arrays[f"k_{d.hex()}"] = np.asarray(k)
             arrays[f"v_{d.hex()}"] = np.asarray(v)  # sync-ok: shutdown spill
+            sc = self._scales.get(d)
+            if sc is not None:
+                # sync-ok: shutdown spill (phase boundary)
+                arrays[f"ks_{d.hex()}"] = np.asarray(sc[0])
+                arrays[f"vs_{d.hex()}"] = np.asarray(sc[1])  # sync-ok: spill
         # write through a handle: np.savez(str) appends ".npz" to a bare
         # path, which load() (os.path.exists on the SAME string) would miss
         with open(path, "wb") as f:
@@ -238,8 +290,14 @@ class PersistentPrefixStore:
                     continue
                 k = z[name]
                 v = z[vname]
-                self.put(bytes.fromhex(hexd), k, v, k.nbytes + v.nbytes,
-                         block_shape=k.shape)
+                nbytes = k.nbytes + v.nbytes
+                kw = {}
+                ksn, vsn = f"ks_{hexd}", f"vs_{hexd}"
+                if ksn in z.files and vsn in z.files:
+                    kw = {"k_scale": z[ksn], "v_scale": z[vsn]}
+                    nbytes += z[ksn].nbytes + z[vsn].nbytes
+                self.put(bytes.fromhex(hexd), k, v, nbytes,
+                         block_shape=k.shape, **kw)
                 loaded += 1
         return loaded
 
@@ -311,10 +369,14 @@ class KVLifecycleManager:
             else "recompute"
 
     # ------------------------------------------------------------- swap
-    def swap_out(self, key, k_blocks, v_blocks, nbytes: int) -> None:
+    def swap_out(self, key, k_blocks, v_blocks, nbytes: int,
+                 k_scale=None, v_scale=None) -> None:
         """File a victim's gathered block bytes (lazy device arrays) in
-        the host pool; bytes are charged now, copied at swap-in."""
-        self.host_pool.put(key, k_blocks, v_blocks, nbytes)
+        the host pool; bytes are charged now, copied at swap-in. A
+        quantized pool (ISSUE 15) hands over per-head-per-block scales
+        with the int8 payload so the restore is bit-exact."""
+        self.host_pool.put(key, k_blocks, v_blocks, nbytes,
+                           k_scale=k_scale, v_scale=v_scale)
         self.evictions_swap += 1
         self.swap_out_bytes += int(nbytes)
 

@@ -1,0 +1,107 @@
+"""Int8 quantization seam for the serving hot path (ISSUE 15).
+
+Two independent knobs, both default-off:
+
+- KV-cache quantization (`DL4J_TPU_KV_QUANT` / `ServingEngine(kv_quant=)`):
+  the paged pool stores int8 payloads with PER-HEAD-PER-BLOCK symmetric
+  scales (`scale = amax / 127` over each block's (block_size, head_dim)
+  slice) kept in side arrays shaped (n_layers, num_blocks + 1, n_kv_heads)
+  alongside the pool. Quantization happens at WRITE time inside the jitted
+  cache mutations (serving/kv_cache.py routes every write — prefill,
+  positional scatter, decode append, speculative append — through the
+  helpers here); dequantization happens at READ time inside the paged
+  flash-decode kernel (ops/decode_attention.py) or per gathered block in
+  the dense oracles. A dequantized pool is never materialized.
+
+- Weight-only int8 (`DL4J_TPU_W8` / `ServingEngine(quant_weights=)`): the
+  decode-path attention projections (w_q/w_k/w_v/w_o) store int8 weights
+  with per-OUTPUT-CHANNEL scales; activations stay float and the matmul
+  dequantizes via one row-broadcast multiply on the (small) output —
+  `y = (x @ w_int8) * scale` — so the weight stream moves 1/2 (vs bf16)
+  to 1/8 (vs fp64) of the bytes at unchanged activation precision.
+
+Both paths are pure jnp device math with ZERO host syncs (this module is
+pinned in tests/test_sync_discipline.py). All quantize/dequantize
+arithmetic runs in fp32 regardless of the session dtype so the int8
+payload is platform- and x64-independent; the load-bearing bit-exactness
+property the read-modify-write cache mutations rely on is
+
+    round((q * s) / s) == q  for every int8 q and fp32 s > 0
+
+(|q * s / s - q| is a few ulps of q <= 127, far below 0.5), so a
+dequantize -> requantize round trip at an UNCHANGED scale reproduces the
+payload bit-exactly. Cost model and accuracy gates: PERF.md "Quantized
+KV cost model"; paper notes: PAPERS.md (KVQuant, AWQ).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import jax.numpy as jnp
+
+SCALE_DTYPE = jnp.float32
+PAYLOAD_DTYPE = jnp.int8
+QMAX = 127.0
+
+
+def resolve_kv_quant(kv_quant: Optional[bool]) -> bool:
+    """Effective KV-quantization flag: explicit ctor value beats the
+    `DL4J_TPU_KV_QUANT` env knob (default off)."""
+    if kv_quant is None:
+        return os.environ.get("DL4J_TPU_KV_QUANT", "0") \
+            not in ("", "0", "off")
+    return bool(kv_quant)
+
+
+def resolve_quant_weights(quant_weights: Optional[bool]) -> bool:
+    """Effective weight-only-int8 flag: explicit ctor value beats the
+    `DL4J_TPU_W8` env knob (default off)."""
+    if quant_weights is None:
+        return os.environ.get("DL4J_TPU_W8", "0") not in ("", "0", "off")
+    return bool(quant_weights)
+
+
+# ------------------------------------------------------------- KV payloads
+def kv_quantize(x):
+    """Quantize KV blocks x (..., block_size, Hk, D) to int8 with
+    per-head-per-block symmetric scales.
+
+    Returns (payload int8 same shape, scales (..., Hk) fp32). The scale is
+    amax / 127 over each block's (block_size, D) slice per kv head; an
+    all-zero slice gets scale 1.0 (payload 0 dequantizes to 0 either way,
+    and a nonzero scale keeps the requantize division well-defined)."""
+    xf = jnp.asarray(x, SCALE_DTYPE)
+    amax = jnp.max(jnp.abs(xf), axis=(-3, -1))            # (..., Hk)
+    scale = jnp.where(amax > 0, amax / QMAX, jnp.ones_like(amax))
+    q = jnp.clip(jnp.round(xf / scale[..., None, :, None]), -QMAX, QMAX)
+    return q.astype(PAYLOAD_DTYPE), scale
+
+
+def kv_dequantize(q, scale, dtype=None):
+    """Dequantize int8 KV blocks q (..., block_size, Hk, D) with scales
+    (..., Hk) back to float (fp32 unless `dtype` says otherwise)."""
+    out = q.astype(SCALE_DTYPE) * scale[..., None, :, None].astype(
+        SCALE_DTYPE)
+    return out if dtype is None else out.astype(dtype)
+
+
+# ------------------------------------------------------- weight-only int8
+def quantize_weight(w):
+    """Quantize a (n_in, n_out) projection weight to int8 with
+    per-output-channel symmetric scales: (w_int8, (n_out,) fp32 scales)."""
+    wf = jnp.asarray(w, SCALE_DTYPE)
+    amax = jnp.max(jnp.abs(wf), axis=0)                   # (n_out,)
+    scale = jnp.where(amax > 0, amax / QMAX, jnp.ones_like(amax))
+    q = jnp.clip(jnp.round(wf / scale[None, :]), -QMAX, QMAX)
+    return q.astype(PAYLOAD_DTYPE), scale
+
+
+def int8_matmul(x, w_q, scale):
+    """Weight-only int8 matmul: y = (x @ w_int8) * scale, the algebraic
+    equal of x @ dequant(w) with the per-channel dequant folded into one
+    broadcast multiply on the output. Activations and accumulation stay
+    float (>= fp32); returns x.dtype."""
+    acc = jnp.promote_types(x.dtype, jnp.float32)
+    y = jnp.matmul(x.astype(acc), w_q.astype(acc))
+    return (y * scale.astype(acc)).astype(x.dtype)

@@ -185,13 +185,21 @@ def serving_partition_rules(tensor_axis: str = "tensor"):
     ]
 
 
-def cache_partition_specs(tensor_axis: str = "tensor") -> Dict[str, P]:
+def cache_partition_specs(tensor_axis: str = "tensor",
+                          quantized: bool = False) -> Dict[str, P]:
     """Specs for the paged cache pytree (kv_cache.init_cache_state):
     k/v pools `(n_layers, num_blocks+1, block_size, Hk, D)` sharded on the
     kv-head axis, lengths and block tables replicated (the host scheduler
-    reads and writes them; they are bytes-trivial)."""
+    reads and writes them; they are bytes-trivial). A quantized pool
+    (ISSUE 15) adds per-head-per-block scale arrays
+    `(n_layers, num_blocks+1, Hk)` — scales shard WITH their heads, so
+    each chip dequantizes its own head slice with zero collectives."""
     heads = P(None, None, None, tensor_axis, None)
-    return {"k": heads, "v": heads, "lengths": P(), "block_tables": P()}
+    specs = {"k": heads, "v": heads, "lengths": P(), "block_tables": P()}
+    if quantized:
+        specs["k_scale"] = P(None, None, tensor_axis)
+        specs["v_scale"] = P(None, None, tensor_axis)
+    return specs
 
 
 # ------------------------------------------------------------- env knobs
@@ -227,15 +235,26 @@ def head_sharded_paged_attention(mesh: Mesh, tensor_axis: str = "tensor"):
     SAME kernel (Pallas split-K on TPU, dense paged fallback elsewhere)
     per head-shard under shard_map. Head-local attention needs no
     collective in the body (see paged_decode_specs), so TP changes only
-    WHERE heads run, not what they compute."""
+    WHERE heads run, not what they compute. A quantized pool (ISSUE 15)
+    passes k_scale/v_scale — the scale arrays split on THEIR head axis
+    alongside the pool, so dequant stays chip-local too."""
     in_specs, out_spec = paged_decode_specs(tensor_axis)
+    in_specs_q, _ = paged_decode_specs(tensor_axis, quantized=True)
 
-    def attention(q, kp, vp, block_tables, visible, scale, window: int = 0):
-        def local(qs, kps, vps, bt, vis):
+    def attention(q, kp, vp, block_tables, visible, scale, window: int = 0,
+                  k_scale=None, v_scale=None):
+        if k_scale is None:
+            def local(qs, kps, vps, bt, vis):
+                return decode_attention_paged(qs, kps, vps, bt, vis, scale,
+                                              window)
+            sharded = compat_shard_map(local, mesh, in_specs, out_spec)
+            return sharded(q, kp, vp, block_tables, visible)
+
+        def local_q(qs, kps, vps, bt, vis, ks, vs):
             return decode_attention_paged(qs, kps, vps, bt, vis, scale,
-                                          window)
-        sharded = compat_shard_map(local, mesh, in_specs, out_spec)
-        return sharded(q, kp, vp, block_tables, visible)
+                                          window, k_scale=ks, v_scale=vs)
+        sharded = compat_shard_map(local_q, mesh, in_specs_q, out_spec)
+        return sharded(q, kp, vp, block_tables, visible, k_scale, v_scale)
 
     return attention
 
@@ -245,15 +264,26 @@ def head_sharded_spec_attention(mesh: Mesh, tensor_axis: str = "tensor"):
     (ISSUE 11): the widened query tile (S, Q, H, D) splits on the head
     axis exactly like single-query decode, so the spec kernel runs
     head-local under shard_map with ZERO new collectives — verification
-    costs the same communication as one plain decode step."""
+    costs the same communication as one plain decode step. Quantized
+    pools (ISSUE 15) ride k_scale/v_scale head-sharded the same way."""
     in_specs, out_spec = paged_spec_decode_specs(tensor_axis)
+    in_specs_q, _ = paged_spec_decode_specs(tensor_axis, quantized=True)
 
-    def attention(q, kp, vp, block_tables, visible, scale, window: int = 0):
-        def local(qs, kps, vps, bt, vis):
+    def attention(q, kp, vp, block_tables, visible, scale, window: int = 0,
+                  k_scale=None, v_scale=None):
+        if k_scale is None:
+            def local(qs, kps, vps, bt, vis):
+                return decode_attention_spec_paged(qs, kps, vps, bt, vis,
+                                                   scale, window)
+            sharded = compat_shard_map(local, mesh, in_specs, out_spec)
+            return sharded(q, kp, vp, block_tables, visible)
+
+        def local_q(qs, kps, vps, bt, vis, ks, vs):
             return decode_attention_spec_paged(qs, kps, vps, bt, vis, scale,
-                                               window)
-        sharded = compat_shard_map(local, mesh, in_specs, out_spec)
-        return sharded(q, kp, vp, block_tables, visible)
+                                               window, k_scale=ks,
+                                               v_scale=vs)
+        sharded = compat_shard_map(local_q, mesh, in_specs_q, out_spec)
+        return sharded(q, kp, vp, block_tables, visible, k_scale, v_scale)
 
     return attention
 
@@ -290,6 +320,8 @@ class ShardedServingEngine(ServingEngine):
         # holds 1/TP of every position's KV bytes (Hk % tp == 0 makes the
         # division exact)
         self._kv_bytes_per_pos = cache.bytes_per_position // self.tp
+        # quantized-pool scale bytes split with their heads (Hk % tp == 0)
+        self._kv_block_overhead = cache.block_overhead_bytes // self.tp
         self._g_kv_total.set(cache.bytes() // self.tp)
         self._g_params.set(self._sharded_param_bytes())
         self._g_tp = self.metrics.gauge(
@@ -328,7 +360,8 @@ class ShardedServingEngine(ServingEngine):
                     f"{i}'s n_heads {layer.n_heads}")
         self._param_specs = match_partition_rules(
             serving_partition_rules(self.tensor_axis), dec.params)
-        self._cache_specs = cache_partition_specs(self.tensor_axis)
+        self._cache_specs = cache_partition_specs(
+            self.tensor_axis, quantized=dec.cache.kv_quant)
         to_sharding = lambda spec: NamedSharding(self.mesh, spec)
         self._param_shardings = jax.tree_util.tree_map(
             to_sharding, self._param_specs, is_leaf=_is_spec)

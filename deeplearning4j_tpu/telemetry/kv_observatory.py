@@ -86,10 +86,18 @@ def attribute_pool(snapshot: Dict[str, object]) -> Dict[str, object]:
     private blocks count as fully live and contribute no waste. The five
     terms always sum to `pool_bytes` because every mapped block is
     either shared or mapped by exactly one slot — the conservation
-    invariant the randomized stress test pins."""
+    invariant the randomized stress test pins.
+
+    A quantized pool (ISSUE 15) carries a per-block scale overhead
+    (`block_overhead_bytes`, fp32 scales per layer x kv head) on top of
+    the positional payload: each block's bytes become
+    `bs * bpp + overhead`, and a partially-live private block's overhead
+    is attributed to the LIVE side (the scales exist because the block
+    holds live content), keeping the conservation sum exact."""
     bs = int(snapshot["block_size"])
     bpp = int(snapshot["bytes_per_position"])
-    block_bytes = bs * bpp
+    ovh = int(snapshot.get("block_overhead_bytes", 0))
+    block_bytes = bs * bpp + ovh
     blocks: Dict[int, dict] = snapshot["blocks"]  # type: ignore[assignment]
     pool_bytes = int(snapshot["num_blocks"]) * block_bytes
     free_bytes = int(snapshot["blocks_free"]) * block_bytes
@@ -117,7 +125,7 @@ def attribute_pool(snapshot: Dict[str, object]) -> Dict[str, object]:
                 covered = bs
             else:
                 covered = max(0, min(bs, int(live) - li * bs))
-            slot_live += covered * bpp
+            slot_live += covered * bpp + (ovh if covered > 0 else 0)
             if covered == 0:
                 waste_reserved += block_bytes
                 slot_waste += block_bytes
@@ -153,6 +161,7 @@ def eviction_candidates(snapshot: Dict[str, object]) -> List[dict]:
     whose last other sharer was itself evicted."""
     bs = int(snapshot["block_size"])
     bpp = int(snapshot["bytes_per_position"])
+    ovh = int(snapshot.get("block_overhead_bytes", 0))
     blocks: Dict[int, dict] = snapshot["blocks"]  # type: ignore[assignment]
     out = []
     for slot, info in snapshot["slots"].items():  # type: ignore[union-attr]
@@ -161,14 +170,15 @@ def eviction_candidates(snapshot: Dict[str, object]) -> List[dict]:
             live = info["reserved_positions"]
         slot_blocks = info["blocks"]
         private = [b for b in slot_blocks if blocks[b]["refcount"] == 1]
+        live_blocks = min(len(slot_blocks), -(-int(live) // bs))
         out.append({
             "slot": slot,
             "req_id": info["req_id"],
             "blocks_total": len(slot_blocks),
             "blocks_freed": len(private),
-            "bytes_freed": len(private) * bs * bpp,
+            "bytes_freed": len(private) * (bs * bpp + ovh),
             "live_positions": int(live),
-            "swap_bytes": int(live) * bpp,
+            "swap_bytes": int(live) * bpp + live_blocks * ovh,
             "recompute_tokens": int(live),
             "last_touch": max((blocks[b]["last_touch"]
                                for b in slot_blocks), default=0),
@@ -272,6 +282,8 @@ def plan_eviction(snapshot: Dict[str, object], needed_blocks: int,
     blocks: Dict[int, dict] = snapshot["blocks"]  # type: ignore[assignment]
     bs = int(snapshot["block_size"])
     bpp = int(snapshot["bytes_per_position"])
+    ovh = int(snapshot.get("block_overhead_bytes", 0))
+    block_bytes = bs * bpp + ovh
     ranked = sorted(cands, key=lambda c: score_fn(c, snapshot, now),
                     reverse=True)
     refs = {b: info["refcount"] for b, info in blocks.items()}
@@ -291,7 +303,7 @@ def plan_eviction(snapshot: Dict[str, object], needed_blocks: int,
         entry = dict(cand)
         entry["score"] = score_fn(cand, snapshot, now)
         entry["blocks_freed"] = marginal
-        entry["bytes_freed"] = marginal * bs * bpp
+        entry["bytes_freed"] = marginal * block_bytes
         entry.update(candidate_costs(
             cand, flops_per_token=flops_per_token,
             swap_bytes_per_sec=swap_bytes_per_sec,
@@ -302,7 +314,7 @@ def plan_eviction(snapshot: Dict[str, object], needed_blocks: int,
         "needed_blocks": int(needed_blocks),
         "evicted": evicted,
         "blocks_freed": freed,
-        "bytes_freed": freed * bs * bpp,
+        "bytes_freed": freed * block_bytes,
         "swap_bytes_total": sum(e["swap_bytes"] for e in evicted),
         "recompute_flops_total": sum(e["recompute_flops"]
                                      for e in evicted),
@@ -422,7 +434,8 @@ class KVObservatory:
             now = time.monotonic()
         bs = int(snapshot["block_size"])
         bpp = int(snapshot["bytes_per_position"])
-        block_bytes = bs * bpp
+        block_bytes = bs * bpp \
+            + int(snapshot.get("block_overhead_bytes", 0))
         blocks_free = int(snapshot["blocks_free"])
         # every mapped block belongs to >= 1 resident request, so
         # evicting all residents reclaims the entire mapped pool

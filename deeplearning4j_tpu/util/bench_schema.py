@@ -38,7 +38,13 @@ forced contention — CPU-runnable and always present; measured entries
 must prove the in-bench assertions held: conserved=True,
 tokens_identical=True and sync_parity=True for the ledger-on/off A/B,
 >= 1 interference edge, and cause_totals_s keyed by EXACTLY the closed
-cause taxonomy telemetry/blame.py defines).
+cause taxonomy telemetry/blame.py defines). ISSUE 15 adds
+`quantized_kv` (the int8-KV + weight-only-int8 A/B — CPU-runnable and
+always present; measured entries must prove sync_parity=True, carry
+throughput NEXT TO its accuracy cost — divergence count under the
+disclosed 2% gate plus max_abs_logprob_delta — a pool-byte ratio in
+(0, 0.5), and a byte-equal capacity probe where the quantized pool
+holds at least as many resident sequences).
 bench.py calls
 `assert_valid` on the dict it is about to print, and
 tests/test_bench_schema.py re-validates the committed artifact, so the
@@ -404,6 +410,57 @@ def validate_artifact(art: dict) -> List[str]:
                         and pair[1] >= 0):
                     errs.append(f"blame_attribution.{side}.top[{i}] must be "
                                 "a [cause-from-taxonomy, seconds>=0] pair")
+
+    # Quantized KV A/B (ISSUE 15): CPU-runnable, so always present; when
+    # measured it must prove the in-bench sync-parity assertion held and
+    # carry the ACCURACY numbers next to the throughput ones — a quant
+    # speedup reported without its divergence count is not a result. The
+    # pool-byte ratio must show a real shrink (int8 payload + scale
+    # overhead < half of any float pool it displaces), and divergence is
+    # bounded: the disclosed gate is < 2% of greedy tokens.
+    qk = e.get("quantized_kv")
+    if not isinstance(qk, dict):
+        errs.append("extra['quantized_kv'] missing or not a dict (the "
+                    "quantized-KV A/B is CPU-runnable — emit error/skipped "
+                    "entries rather than dropping it)")
+    elif "error" not in qk and "skipped_reason" not in qk:
+        if not isinstance(qk.get("platform"), str):
+            errs.append("extra['quantized_kv'] has no 'platform' label")
+        if qk.get("sync_parity") is not True:
+            errs.append("quantized_kv.sync_parity must be True — the "
+                        "quantize seam added a host sync")
+        for k in ("tokens_per_sec_quant", "tokens_per_sec_float",
+                  "kv_bytes_per_token_quant", "kv_bytes_per_token_float",
+                  "max_abs_logprob_delta"):
+            if not _is_num(qk.get(k)) or qk.get(k, -1) < 0:
+                errs.append(f"quantized_kv.{k} missing or negative")
+        ratio = qk.get("kv_pool_bytes_ratio")
+        if not _is_num(ratio) or not (0 < ratio < 0.5):
+            errs.append("quantized_kv.kv_pool_bytes_ratio must be in "
+                        "(0, 0.5) — the int8 pool (payload + scales) is "
+                        "a strict shrink vs any float dtype; >= 0.5 "
+                        "means a dequantized copy or scale bloat")
+        div, tot = qk.get("greedy_tokens_diverged"), \
+            qk.get("greedy_tokens_total")
+        if not _is_num(div) or not _is_num(tot) or tot <= 0:
+            errs.append("quantized_kv divergence counters missing "
+                        "(greedy_tokens_diverged / greedy_tokens_total)")
+        elif div > 0.02 * tot:
+            errs.append(f"quantized_kv greedy divergence {div}/{tot} "
+                        "exceeds the disclosed 2% gate — quantization "
+                        "is changing outputs, not just bytes")
+        cap = qk.get("capacity_probe")
+        if not isinstance(cap, dict) \
+                or not _is_num(cap.get("resident_seqs_max_quant")) \
+                or not _is_num(cap.get("resident_seqs_max_float")):
+            errs.append("quantized_kv.capacity_probe missing resident-"
+                        "sequence counts (the byte-equal capacity face "
+                        "of the bytes/token reduction)")
+        elif cap["resident_seqs_max_quant"] \
+                < cap["resident_seqs_max_float"]:
+            errs.append("quantized_kv.capacity_probe: quantized pool at "
+                        "an equal byte budget holds FEWER sequences — "
+                        "byte accounting or admission regressed")
 
     # every measurement dict carries a platform label
     for name, entry in e.items():

@@ -358,6 +358,47 @@ def _blame_attribution_lines(ba) -> list:
     return lines
 
 
+def _quantized_kv_lines(qk) -> list:
+    """Quantized-KV section from extra['quantized_kv'] (ISSUE 15): the
+    int8-KV + weight-only-int8 A/B, rendered with the accuracy numbers
+    IN the same bullet as the throughput ones — a quant speedup quoted
+    without its divergence count is not a result."""
+    if not isinstance(qk, dict) or "tokens_per_sec_quant" not in qk:
+        if isinstance(qk, dict) and (qk.get("skipped_reason")
+                                     or qk.get("error")):
+            return [f"- Quantized KV: "
+                    f"{qk.get('skipped_reason') or qk.get('error')} "
+                    f"(platform: {qk.get('platform', '?')})."]
+        return []
+    cap = qk.get("capacity_probe") or {}
+    div = qk.get("greedy_tokens_diverged", 0)
+    tot = qk.get("greedy_tokens_total", 0)
+    line = (
+        f"- Quantized KV A/B (ISSUE 15, {qk.get('platform', '?')}): int8 "
+        f"KV pool (per-head-per-block scales, dequantized inside the "
+        f"decode kernel) + weight-only int8 decode matmuls vs the float "
+        f"engine at identical seed/schedule: "
+        f"{qk.get('tokens_per_sec_quant', 0):,.1f} vs "
+        f"{qk.get('tokens_per_sec_float', 0):,.1f} tok/s "
+        f"({_pct(qk.get('tokens_per_sec_delta_frac'))}), KV "
+        f"{qk.get('kv_bytes_per_token_quant', 0):,.0f} vs "
+        f"{qk.get('kv_bytes_per_token_float', 0):,.0f} bytes/token "
+        f"(pool ratio {qk.get('kv_pool_bytes_ratio', 0):.3f} on this "
+        f"host's float dtype). Accuracy next to the speed: {div}/{tot} "
+        f"greedy tokens diverged, max |Δlogprob| "
+        f"{qk.get('max_abs_logprob_delta', 0):.4f}; quant-on/off host "
+        f"syncs **bit-identical** (asserted in-bench).")
+    if cap.get("resident_seqs_max_quant") is not None:
+        line += (
+            f" Byte-equal capacity probe: the same "
+            f"{cap.get('pool_byte_budget', 0):,}-byte pool budget holds "
+            f"{cap['resident_seqs_max_quant']} resident sequences "
+            f"quantized vs {cap.get('resident_seqs_max_float', '?')} "
+            f"float. `DL4J_TPU_KV_QUANT` / `DL4J_TPU_W8` — see PERF.md "
+            f"\"Quantized KV cost model\".")
+    return [line]
+
+
 def render_block(art: dict) -> str:
     """Markdown bullet block rendered VERBATIM into README.md and PERF.md."""
     e = art["extra"]
@@ -515,6 +556,7 @@ def render_block(art: dict) -> str:
     lines.extend(_kv_observatory_lines(e.get("kv_observatory")))
     lines.extend(_kv_lifecycle_lines(e.get("kv_lifecycle")))
     lines.extend(_blame_attribution_lines(e.get("blame_attribution")))
+    lines.extend(_quantized_kv_lines(e.get("quantized_kv")))
     lines.extend(_roofline_table_lines(e.get("roofline_table")))
     lines.append(
         f"- ParallelWrapper ResNet50: {pw['images_per_sec']:,.0f} img/s — "

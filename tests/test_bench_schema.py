@@ -98,6 +98,19 @@ def _minimal_art():
                                       ["jit_compile", 0.4]]},
                 "attainers": {"n": 3,
                               "top": [["decode_compute", 0.3]]}},
+            "quantized_kv": {
+                "platform": "cpu", "sync_parity": True,
+                "tokens_per_sec_quant": 900.0,
+                "tokens_per_sec_float": 1000.0,
+                "kv_bytes_per_token_quant": 257.0,
+                "kv_bytes_per_token_float": 1024.0,
+                "kv_pool_bytes_ratio": 0.251,
+                "greedy_tokens_diverged": 1,
+                "greedy_tokens_total": 128,
+                "max_abs_logprob_delta": 0.0024,
+                "capacity_probe": {"pool_byte_budget": 36864,
+                                   "resident_seqs_max_float": 2,
+                                   "resident_seqs_max_quant": 12}},
             "roofline_table": [
                 {"function": "train_step", "platform": "tpu",
                  "flops": 1e12, "bytes_accessed": 1e9,
@@ -426,6 +439,48 @@ def test_blame_attribution_rules():
     assert validate_artifact(art) == []
     art["extra"]["blame_attribution"] = {"platform": "cpu",
                                          "skipped_reason": "why not"}
+    assert validate_artifact(art) == []
+
+
+def test_quantized_kv_rules():
+    """ISSUE 15: the quantized-KV A/B must always exist; a measured entry
+    must prove the in-bench sync-parity assertion held, carry accuracy
+    next to throughput (divergence under the disclosed 2% gate), show a
+    real pool shrink (< 0.5 of the float pool), and a byte-equal
+    capacity probe where quant holds >= as many resident sequences;
+    errored/skipped entries are exempt."""
+    art = _minimal_art()
+    del art["extra"]["quantized_kv"]
+    assert any("quantized_kv" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["quantized_kv"]["sync_parity"] = False
+    assert any("sync_parity" in e for e in validate_artifact(art))
+    # a dequantized copy (ratio >= 0.5) fails the gate
+    art = _minimal_art()
+    art["extra"]["quantized_kv"]["kv_pool_bytes_ratio"] = 0.75
+    assert any("kv_pool_bytes_ratio" in e for e in validate_artifact(art))
+    # divergence above the disclosed 2% gate fails
+    art = _minimal_art()
+    art["extra"]["quantized_kv"]["greedy_tokens_diverged"] = 50
+    assert any("divergence" in e for e in validate_artifact(art))
+    # accuracy numbers cannot be dropped
+    art = _minimal_art()
+    del art["extra"]["quantized_kv"]["max_abs_logprob_delta"]
+    assert any("max_abs_logprob_delta" in e for e in validate_artifact(art))
+    # capacity probe must exist and must not show quant holding FEWER
+    art = _minimal_art()
+    del art["extra"]["quantized_kv"]["capacity_probe"]
+    assert any("capacity_probe" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["quantized_kv"]["capacity_probe"][
+        "resident_seqs_max_quant"] = 1
+    assert any("FEWER" in e for e in validate_artifact(art))
+    # errored/skipped runs are exempt
+    art = _minimal_art()
+    art["extra"]["quantized_kv"] = {"error": "ValueError: boom"}
+    assert validate_artifact(art) == []
+    art["extra"]["quantized_kv"] = {"platform": "cpu",
+                                    "skipped_reason": "why not"}
     assert validate_artifact(art) == []
 
 

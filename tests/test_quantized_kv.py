@@ -1,0 +1,562 @@
+"""Quantized KV cache + weight-only int8 tests (ISSUE 15).
+
+Three layers of guarantees:
+
+- PRIMITIVES (serving/quant.py): the symmetric int8 quantizer's error is
+  bounded by scale/2, and the load-bearing bit-exactness property
+  `round((q * s) / s) == q` holds for every int8 payload value — the
+  read-modify-write cache mutations and every lifecycle round trip
+  (swap, prefix store, npz spill) lean on it.
+
+- KERNEL (ops/decode_attention.py): the Pallas split-K kernel consuming
+  int8 pools + SMEM scale tiles matches the QUANTIZED dense oracle
+  (dequantize per gathered block in fp64) to <= 1e-5 across the same
+  GQA/MQA/sliding-window/spec-Q sweep the float kernel is tested on.
+  The oracle itself stays within the quantization step of the float
+  oracle, so accuracy is GATED, not hoped for.
+
+- SYSTEM: a randomized quantized-pool stress (test_block_table.py
+  style — COW fork, copy-on-reject, swap-evict/restore with scales)
+  asserting int8 payload + scale bit-integrity after every op; engine
+  end-to-end quant-on/off greedy parity with bit-identical host-sync
+  counts and the HBM-gauge assertion that the quantized pool's
+  footprint is the int8-payload fraction of the float pool (never a
+  materialized dequantized copy); TP=2 token parity on forced host
+  devices with scales sharded alongside their heads.
+"""
+import random
+from collections import Counter
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.decode_attention import (
+    decode_attention_dense_paged, decode_attention_dense_spec_paged,
+    flash_decode_attention_paged, flash_decode_attention_spec_paged)
+from deeplearning4j_tpu.serving import Request, ServingEngine, kv_cache
+from deeplearning4j_tpu.serving import quant
+from deeplearning4j_tpu.serving.kv_cache import KVCache
+from deeplearning4j_tpu.serving.lifecycle import (HostBlockPool,
+                                                  PersistentPrefixStore)
+from deeplearning4j_tpu.telemetry.kv_observatory import attribute_pool
+from tests.test_serving import _build_net
+
+
+def _rand(shape, seed):
+    return jnp.asarray(np.random.RandomState(seed).randn(*shape))
+
+
+# ------------------------------------------------------------ primitives
+def test_kv_quantize_error_bounded_by_half_step():
+    x = _rand((5, 8, 3, 4), 0) * 3.0
+    q, s = quant.kv_quantize(x)
+    assert q.dtype == quant.PAYLOAD_DTYPE and s.dtype == quant.SCALE_DTYPE
+    assert q.shape == x.shape and s.shape == (5, 3)
+    err = np.abs(np.asarray(quant.kv_dequantize(q, s)) - np.asarray(x))
+    bound = np.asarray(s)[:, None, :, None] / 2 + 1e-12
+    assert np.all(err <= bound)
+
+
+def test_int8_payload_dequant_requant_bit_exact():
+    """round((q*s)/s) == q for every int8 value across wild scales — the
+    property that makes every RMW write-back and lifecycle round trip
+    bit-exact at an unchanged scale."""
+    q = jnp.tile(jnp.arange(-127, 128, dtype=jnp.int8), (5,))
+    for sv in (1e-6, 3e-3, 0.7, 1.0, 13.0, 8192.0):
+        s = jnp.full(q.shape, sv, quant.SCALE_DTYPE)
+        rt = jnp.round(q.astype(quant.SCALE_DTYPE) * s / s)
+        np.testing.assert_array_equal(np.asarray(rt, np.int8),
+                                      np.asarray(q))
+
+
+def test_all_zero_block_gets_unit_scale():
+    q, s = quant.kv_quantize(jnp.zeros((2, 4, 2, 3)))
+    np.testing.assert_array_equal(np.asarray(s), 1.0)
+    np.testing.assert_array_equal(np.asarray(q), 0)
+
+
+def test_weight_only_int8_matmul_matches_dequantized_weight():
+    w = _rand((16, 12), 1)
+    x = _rand((5, 16), 2)
+    wq, s = quant.quantize_weight(w)
+    assert wq.dtype == jnp.int8 and s.shape == (12,)
+    ref = x @ (wq.astype(x.dtype) * s.astype(x.dtype)[None, :])
+    out = quant.int8_matmul(x, wq, s)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-10, atol=1e-12)
+    # quantization error itself is bounded: per-channel half step
+    err = np.abs(np.asarray(wq.astype(jnp.float64) * s[None, :] - w))
+    assert np.all(err <= np.asarray(s)[None, :] / 2 + 1e-12)
+
+
+def test_env_knob_resolution(monkeypatch):
+    assert quant.resolve_kv_quant(True) and not quant.resolve_kv_quant(False)
+    monkeypatch.setenv("DL4J_TPU_KV_QUANT", "1")
+    assert quant.resolve_kv_quant(None)
+    monkeypatch.setenv("DL4J_TPU_KV_QUANT", "off")
+    assert not quant.resolve_kv_quant(None)
+    monkeypatch.setenv("DL4J_TPU_W8", "1")
+    assert quant.resolve_quant_weights(None)
+    assert not quant.resolve_quant_weights(False)
+
+
+# ------------------------------------------------------ kernel vs oracle
+def _quant_paged_case(S, H, Hk, D, bs, bps, window, seed=0, Q=0):
+    """The float _paged_case geometry, with the pool quantized per
+    head-per-block exactly as serving/kv_cache.py stores it."""
+    nb = S * bps + 1
+    kp, ks = quant.kv_quantize(_rand((nb, bs, Hk, D), seed + 1))
+    vp, vs = quant.kv_quantize(_rand((nb, bs, Hk, D), seed + 2))
+    rng = np.random.RandomState(seed + 3)
+    bt = jnp.asarray(rng.permutation(nb - 1)[:S * bps].reshape(S, bps),
+                     jnp.int32)
+    L = bps * bs
+    if Q:
+        q = _rand((S, Q, H, D), seed)
+        # (S,) visible length of query 0; query i sees j < vis + i
+        vis = jnp.asarray(rng.randint(1, L - Q + 1, size=(S,)), jnp.int32)
+    else:
+        q = _rand((S, H, D), seed)
+        vis = jnp.asarray([(7 * (i + 1)) % L + 1 for i in range(S)],
+                          jnp.int32)
+        vis = vis.at[0].set(1).at[S - 1].set(L)
+    return q, kp, vp, ks, vs, bt, vis, 1.0 / np.sqrt(D), window
+
+
+QUANT_SWEEP = [
+    # (S, H, Hk, D, bs, bps, window)
+    (3, 4, 4, 16, 16, 4, 0),    # MHA
+    (3, 4, 2, 16, 16, 4, 0),    # GQA group 2
+    (2, 4, 1, 8, 8, 4, 0),      # MQA, minimum kernel block
+    (3, 4, 2, 16, 16, 4, 5),    # GQA + sliding window
+    (2, 2, 2, 16, 32, 3, 3),    # MHA + window, odd block count
+]
+
+
+@pytest.mark.parametrize("S,H,Hk,D,bs,bps,window", QUANT_SWEEP)
+def test_quantized_kernel_matches_quantized_oracle(S, H, Hk, D, bs, bps,
+                                                   window):
+    q, kp, vp, ks, vs, bt, vis, scale, w = _quant_paged_case(
+        S, H, Hk, D, bs, bps, window)
+    ref = decode_attention_dense_paged(q, kp, vp, bt, vis, scale, w,
+                                       k_scale=ks, v_scale=vs)
+    out = flash_decode_attention_paged(q, kp, vp, bt, vis, scale, w,
+                                       k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=0)
+
+
+@pytest.mark.parametrize("S,H,Hk,D,bs,bps,window", QUANT_SWEEP[:4])
+def test_quantized_spec_kernel_matches_quantized_oracle(S, H, Hk, D, bs,
+                                                        bps, window):
+    q, kp, vp, ks, vs, bt, vis, scale, w = _quant_paged_case(
+        S, H, Hk, D, bs, bps, window, Q=3)
+    ref = decode_attention_dense_spec_paged(q, kp, vp, bt, vis, scale, w,
+                                            k_scale=ks, v_scale=vs)
+    out = flash_decode_attention_spec_paged(q, kp, vp, bt, vis, scale, w,
+                                            k_scale=ks, v_scale=vs)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=1e-5, rtol=0)
+
+
+def test_quantized_oracle_within_quant_step_of_float_oracle():
+    """Accuracy gate for the quantization itself: the quantized oracle's
+    output stays within a few quantization steps of the float oracle on
+    the SAME underlying pool content."""
+    S, H, Hk, D, bs, bps = 3, 4, 2, 16, 16, 4
+    nb = S * bps + 1
+    kf = _rand((nb, bs, Hk, D), 11)
+    vf = _rand((nb, bs, Hk, D), 12)
+    kp, ks = quant.kv_quantize(kf)
+    vp, vs = quant.kv_quantize(vf)
+    rng = np.random.RandomState(13)
+    bt = jnp.asarray(rng.permutation(nb - 1)[:S * bps].reshape(S, bps),
+                     jnp.int32)
+    vis = jnp.asarray([5, 17, bps * bs], jnp.int32)
+    q = _rand((S, H, D), 10)
+    scale = 1.0 / np.sqrt(D)
+    ref = decode_attention_dense_paged(q, kf, vf, bt, vis, scale, 0)
+    out = decode_attention_dense_paged(q, kp, vp, bt, vis, scale, 0,
+                                       k_scale=ks, v_scale=vs)
+    # |V| <= ~3 sigma and attention outputs are convex combinations of V
+    # rows, each off by <= scale/2 ~= 3/127/2: a loose 0.1 gate that a
+    # rescaling/aliasing bug would blow through by orders of magnitude
+    assert float(jnp.max(jnp.abs(out - ref))) < 0.1
+
+
+# --------------------------------------------------- byte accounting
+def test_quantized_cache_bytes_derive_from_actual_dtypes():
+    c = KVCache(n_layers=2, max_seqs=3, max_len=8, n_kv_heads=2, head_dim=4,
+                dtype=jnp.float32, kv_quant=True)
+    assert c.kv_quant and kv_cache.is_quantized(c.state)
+    assert c.state["k"].dtype == jnp.int8
+    # payload bytes/position from ACTUAL array dtypes (satellite fix):
+    # int8 k + int8 v = 1 + 1 byte per (layer, head, dim) element
+    assert c.bytes_per_position == 2 * 2 * 4 * (1 + 1)
+    # scale overhead: fp32 k_scale + v_scale per (layer, head) per block
+    assert c.block_overhead_bytes == 2 * 2 * (4 + 4)
+    state_bytes = sum(int(np.prod(c.state[n].shape))
+                      * c.state[n].dtype.itemsize
+                      for n in ("k", "v", "k_scale", "v_scale"))
+    assert c.bytes() == state_bytes
+    # the quantized pool is a fraction of the float pool, never a
+    # dequantized copy: fp32 baseline payload is 4x the int8 payload
+    f = KVCache(n_layers=2, max_seqs=3, max_len=8, n_kv_heads=2, head_dim=4,
+                dtype=jnp.float32)
+    assert f.block_overhead_bytes == 0
+    ratio = c.bytes() / f.bytes()
+    assert ratio < 0.5, ratio
+    snap = c.pool_snapshot()
+    assert snap["bytes_per_position"] == c.bytes_per_position
+    assert snap["block_overhead_bytes"] == c.block_overhead_bytes
+
+
+def test_attribute_pool_conserves_scale_overhead():
+    c = KVCache(n_layers=1, max_seqs=4, max_len=32, n_kv_heads=2,
+                head_dim=4, dtype=jnp.float32, block_size=4, num_blocks=16,
+                kv_quant=True)
+    plan = c.admit("a", n_positions=11)
+    assert plan is not None
+    att = attribute_pool(c.pool_snapshot(
+        live_positions={plan.slot: 6}))
+    assert att["conserved"], att
+    block_bytes = 4 * c.bytes_per_position + c.block_overhead_bytes
+    assert att["pool_bytes"] == 16 * block_bytes
+    # 11 positions reserve 3 blocks: 6 live -> blocks 0,1 live (block 1
+    # partially: its overhead counts as live), block 2 reserved waste
+    assert att["waste_reserved_bytes"] == block_bytes
+    assert att["private_live_bytes"] == 6 * c.bytes_per_position \
+        + 2 * c.block_overhead_bytes
+    assert att["waste_tail_bytes"] == 2 * c.bytes_per_position
+
+
+# ---------------------------------------------------- randomized stress
+def test_randomized_quantized_pool_stress():
+    """COW fork, copy-on-reject, swap-evict/restore WITH scales: after
+    every op each live slot's int8 payload and fp32 scales are
+    bit-identical to quantizing its token-determined pattern — writes
+    to other slots, COW copies, and host-pool round trips never perturb
+    a single stored byte."""
+    rng = random.Random(2026)
+    bs = 4
+    c = KVCache(n_layers=1, max_seqs=6, max_len=64, n_kv_heads=1,
+                head_dim=2, dtype=jnp.float32, block_size=bs,
+                num_blocks=28, prefix_share=True, kv_quant=True)
+    pool = HostBlockPool(capacity_bytes=1 << 24)
+    families = [[rng.randrange(50) for _ in range(14)] for _ in range(3)]
+    live, reserved = {}, {}
+    key_seq = [0]
+
+    def pattern(tokens):
+        n = len(tokens)
+        base = np.asarray(tokens, np.float32)[:, None, None]
+        pos = np.arange(n, dtype=np.float32)[:, None, None] / 128.0
+        k = np.broadcast_to(base + pos, (n, 1, 2)).copy()
+        return k, k + 1000.0
+
+    def padded_blocks(tokens):
+        """(nblk, bs, 1, 2) float pattern blocks, zero-padded like real
+        prefill — the exact input the quantize seam sees."""
+        k_pat, v_pat = pattern(tokens)
+        pad = -len(tokens) % bs
+        if pad:
+            z = np.zeros((pad, 1, 2), np.float32)
+            k_pat = np.concatenate([k_pat, z])
+            v_pat = np.concatenate([v_pat, z])
+        nblk = len(k_pat) // bs
+        return (k_pat.reshape(nblk, bs, 1, 2),
+                v_pat.reshape(nblk, bs, 1, 2))
+
+    def write_pattern(slot, tokens):
+        kb, vb = padded_blocks(tokens)
+        c.state = kv_cache.write_prefill(
+            c.state, 0, slot, jnp.asarray(kb.reshape(-1, 1, 2)),
+            jnp.asarray(vb.reshape(-1, 1, 2)))
+        c.state = kv_cache.set_length(c.state, slot, len(tokens))
+
+    def check_all():
+        counts = Counter(b for blocks in c._slot_blocks.values()
+                         for b in blocks)
+        assert c.trash_block not in counts
+        for b in range(c.num_blocks):
+            assert c.allocator.refcount(b) == counts.get(b, 0)
+        att = attribute_pool(c.pool_snapshot(
+            live_positions={s: len(t) for s, t in live.items()}))
+        assert att["conserved"], att
+        assert pool.bytes_used == sum(n for _, _, n in
+                                      pool._entries.values())
+        k = np.asarray(c.state["k"][0])
+        v = np.asarray(c.state["v"][0])
+        ks = np.asarray(c.state["k_scale"][0])
+        vs = np.asarray(c.state["v_scale"][0])
+        assert k.dtype == np.int8
+        for slot, tokens in live.items():
+            kb, vb = padded_blocks(tokens)
+            kq, ksq = quant.kv_quantize(jnp.asarray(kb))
+            vq, vsq = quant.kv_quantize(jnp.asarray(vb))
+            row = c._slot_blocks[slot]
+            for li in range(-(-len(tokens) // bs)):
+                np.testing.assert_array_equal(k[row[li]],
+                                              np.asarray(kq[li]))
+                np.testing.assert_array_equal(v[row[li]],
+                                              np.asarray(vq[li]))
+                np.testing.assert_array_equal(ks[row[li]],
+                                              np.asarray(ksq[li]))
+                np.testing.assert_array_equal(vs[row[li]],
+                                              np.asarray(vsq[li]))
+
+    saw_restore = saw_cow = 0
+    for _ in range(120):
+        r = rng.random()
+        if r < 0.4 or not live:
+            fam = rng.choice(families)
+            cut = rng.randrange(4, len(fam) + 1)
+            tokens = fam[:cut] + [rng.randrange(50)
+                                  for _ in range(rng.randrange(0, 3))]
+            n_pos = min(c.max_len, len(tokens) + rng.randrange(1, 9))
+            plan = c.admit("o", n_positions=n_pos, prompt=tokens)
+            if plan is not None:
+                write_pattern(plan.slot, tokens)
+                c.register_prefix(plan.slot, tokens)
+                live[plan.slot] = tokens
+                reserved[plan.slot] = n_pos
+        elif r < 0.55:                               # copy-on-reject
+            slot = rng.choice(sorted(live))
+            n = len(live[slot])
+            before = c.cow_copies_total
+            c.ensure_writable(slot, max(0, n - 2), n)
+            saw_cow += c.cow_copies_total - before
+        elif r < 0.7:                                # recompute-evict
+            slot = rng.choice(sorted(live))
+            del live[slot], reserved[slot]
+            c.free(slot)
+        else:                                        # swap-evict + restore
+            slot = rng.choice(sorted(live))
+            tokens, n_pos = live.pop(slot), reserved.pop(slot)
+            row = list(c._slot_blocks[slot])
+            k_blk, v_blk, ks_blk, vs_blk = kv_cache.gather_blocks(
+                c.state, row, with_scales=True)
+            nbytes = int(np.asarray(k_blk).nbytes * 2)
+            key = key_seq[0] = key_seq[0] + 1
+            pool.put(key, k_blk, v_blk, nbytes,
+                     k_scale=ks_blk, v_scale=vs_blk)
+            c.free(slot)
+            check_all()
+            plan = c.admit("o", n_positions=n_pos, prompt=tokens)
+            if plan is None:
+                pool.drop(key)
+            else:
+                sc = pool.fetch_scales(key)
+                assert sc is not None
+                k_host, v_host = pool.fetch(key)
+                new_row = c._slot_blocks[plan.slot]
+                lis = [li for li in range(len(new_row))
+                       if li * bs < len(tokens)
+                       and c.allocator.refcount(new_row[li]) == 1]
+                if lis:
+                    c.state = kv_cache.restore_blocks(
+                        c.state, [new_row[li] for li in lis],
+                        k_host[:, lis], v_host[:, lis],
+                        k_scale=sc[0][:, lis], v_scale=sc[1][:, lis])
+                c.state = kv_cache.set_length(c.state, plan.slot,
+                                              len(tokens))
+                c.register_prefix(plan.slot, tokens)
+                live[plan.slot] = tokens
+                reserved[plan.slot] = n_pos
+                saw_restore += 1
+        check_all()
+
+    assert saw_restore > 0 and saw_cow > 0           # the paths ran
+    for slot in sorted(live):
+        c.free(slot)
+    assert c.blocks_free == c.num_blocks
+    assert pool.bytes_used >= 0
+    assert c.shared_blocks_total > 0 and c.cow_copies_total > 0
+
+
+def test_restore_blocks_on_quantized_pool_requires_scales():
+    c = KVCache(n_layers=1, max_seqs=2, max_len=16, n_kv_heads=1,
+                head_dim=2, dtype=jnp.float32, block_size=4, num_blocks=8,
+                kv_quant=True)
+    plan = c.admit("a", n_positions=4)
+    row = c._slot_blocks[plan.slot]
+    k, v, ks, vs = kv_cache.gather_blocks(c.state, row, with_scales=True)
+    with pytest.raises(ValueError, match="quantized"):
+        kv_cache.restore_blocks(c.state, row, k, v)
+    # with scales the round trip is bit-exact
+    c.state = kv_cache.restore_blocks(c.state, row, k, v,
+                                      k_scale=ks, v_scale=vs)
+
+
+def test_prefix_store_round_trips_quantized_blocks_bit_exactly(tmp_path):
+    store = PersistentPrefixStore(path=str(tmp_path / "spill.npz"))
+    k = jnp.asarray(np.random.RandomState(0).randint(
+        -127, 128, size=(2, 4, 1, 2)), jnp.int8)
+    v = jnp.asarray(np.random.RandomState(1).randint(
+        -127, 128, size=(2, 4, 1, 2)), jnp.int8)
+    ks = jnp.asarray([[0.3], [1.7]], jnp.float32)
+    vs = jnp.asarray([[2.5], [0.01]], jnp.float32)
+    dig = b"\x01" * 20
+    store.put(dig, k, v, k.nbytes + v.nbytes + ks.nbytes + vs.nbytes,
+              block_shape=k.shape, k_scale=ks, v_scale=vs)
+    assert store.block_dtype == "int8"
+    store.save()
+    re = PersistentPrefixStore(path=str(tmp_path / "spill.npz"))
+    assert re.load() == 1 and re.block_dtype == "int8"
+    kk, vv = re.fetch([dig])
+    sc = re.fetch_scales([dig])
+    np.testing.assert_array_equal(kk[:, 0], np.asarray(k))
+    np.testing.assert_array_equal(vv[:, 0], np.asarray(v))
+    np.testing.assert_array_equal(sc[0][:, 0], np.asarray(ks))
+    np.testing.assert_array_equal(sc[1][:, 0], np.asarray(vs))
+    # a float entry (no scales) reports None, not garbage
+    store2 = PersistentPrefixStore()
+    store2.put(b"\x02" * 20, jnp.zeros((2, 4, 1, 2)),
+               jnp.zeros((2, 4, 1, 2)), 128)
+    assert store2.fetch_scales([b"\x02" * 20]) is None
+
+
+# ------------------------------------------------------------- engine e2e
+PROMPTS = [[1, 2, 3, 4, 5], [7, 3, 2], [1, 2, 3, 4, 5, 6, 7, 8, 9]]
+
+
+def _serve(net, **kw):
+    eng = ServingEngine(net, max_seqs=4, max_len=32, seed=0,
+                        capture_logprobs=True, **kw)
+    res = eng.generate([Request(p, max_new_tokens=6, temperature=0.0)
+                        for p in PROMPTS])
+    return res, eng
+
+
+def test_engine_quant_on_off_parity_syncs_and_pool_bytes():
+    net = _build_net(n_kv=2)
+    base, e0 = _serve(net)
+    quanted, e1 = _serve(net, kv_quant=True)
+    t0 = [r.tokens for r in base]
+    t1 = [r.tokens for r in quanted]
+    # greedy divergence gate: disclosed threshold is ZERO on this model
+    assert t0 == t1, f"greedy divergence: {t0} vs {t1}"
+    # logit fidelity: captured logprob rows stay close to the float run
+    deltas = [np.max(np.abs(np.asarray(a) - np.asarray(b)))
+              for ra, rb in zip(base, quanted)
+              for a, b in zip(ra.logprobs, rb.logprobs)]
+    assert max(deltas) < 0.05, max(deltas)
+    # quant on/off host-sync sequence is bit-identical (zero added syncs)
+    assert e0.stats()["host_syncs"] == e1.stats()["host_syncs"]
+    # HBM gauge: the quantized pool is the int8 fraction of the fp64
+    # pool (1/8 payload + fp32 scale overhead) — a materialized
+    # dequantized pool anywhere would blow this bound
+    b0, b1 = e0.decoder.cache.bytes(), e1.decoder.cache.bytes()
+    assert b1 < 0.2 * b0, (b0, b1)
+    assert e1._g_kv_total.value == b1
+
+
+def test_engine_weight_only_int8_decode():
+    net = _build_net(n_kv=2)
+    base, _ = _serve(net)
+    w8, e1 = _serve(net, quant_weights=True)
+    assert [r.tokens for r in base] == [r.tokens for r in w8]
+    # the decoder's attention projections really are int8 + scales;
+    # the output head stays float (accuracy-critical, not bandwidth-bound)
+    attn = [p for p in e1.decoder.params if "w_q" in p]
+    assert attn and all(p["w_q"].dtype == jnp.int8
+                        and p["w_q_scale"].shape == (p["w_q"].shape[1],)
+                        for p in attn)
+    head = [p for p in e1.decoder.params if "W" in p]
+    assert head and all(p["W"].dtype != jnp.int8 for p in head)
+
+
+def test_engine_quant_both_knobs_stacked():
+    net = _build_net(n_kv=2)
+    base, _ = _serve(net)
+    both, eng = _serve(net, kv_quant=True, quant_weights=True)
+    assert [r.tokens for r in base] == [r.tokens for r in both]
+    assert eng.decoder.cache.kv_quant
+
+
+def _life_engine(net, **kw):
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("seed", 3)
+    kw.setdefault("decode_chunk", 1)
+    kw.setdefault("overlap", False)
+    kw.setdefault("kv_block", 4)
+    kw.setdefault("kv_quant", True)
+    return ServingEngine(net, **kw)
+
+
+LIFE_PROMPTS = [[1, 2, 3, 4, 5, 6], [7, 8, 9, 10, 11, 12],
+                [2, 4, 6, 8, 10, 12], [9, 7, 5, 3, 1, 2]]
+
+
+def test_quantized_swap_eviction_token_parity():
+    """Forced exhaustion on a QUANTIZED pool, swap flavor: preempted int8
+    blocks + their scales round-trip through the host pool and the greedy
+    stream is bit-identical to the unpressured quantized run."""
+    net = _build_net(n_kv=2)
+    ref_eng = _life_engine(net)
+    ref = ref_eng.generate([Request(list(p), max_new_tokens=10)
+                            for p in LIFE_PROMPTS])
+    ref_eng.shutdown()
+    eng = _life_engine(net, kv_blocks=9, kv_evict="lru",
+                       kv_evict_mode="swap", kv_swap_bytes=1 << 24)
+    res = eng.generate([Request(list(p), max_new_tokens=10)
+                        for p in LIFE_PROMPTS])
+    assert [r.tokens for r in res] == [r.tokens for r in ref]
+    s = eng.stats()
+    assert s["kv_evictions_swap"] > 0 and s["kv_swap_out_bytes"] > 0
+    # swap nbytes accounting includes the per-block scale overhead
+    cache = eng.decoder.cache
+    blk = cache.block_size * cache.bytes_per_position \
+        + cache.block_overhead_bytes
+    assert s["kv_swap_out_bytes"] % blk == 0
+    assert eng.lifecycle.host_pool.n_entries == 0    # drained
+    assert cache.blocks_free == 9
+    eng.shutdown()
+
+
+def test_quantized_prefix_store_restart_and_dtype_guard(tmp_path):
+    """A quantized engine's prefix store spills int8 blocks + scales to
+    npz and a fresh quantized engine restores them (hits fire, tokens
+    identical); a FLOAT engine refuses the int8 store via the recorded
+    block dtype instead of restoring garbage into its float pool."""
+    path = str(tmp_path / "store.npz")
+    net = _build_net(n_kv=2)
+    system = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]    # three full blocks
+    req = lambda: Request(list(system) + [7, 9], max_new_tokens=6)  # noqa
+    e1 = _life_engine(net, prefix_store=path)
+    r1 = e1.generate([req()])
+    assert e1.prefix_store.block_dtype == "int8"
+    e1.shutdown()                                    # spills the store
+    e2 = _life_engine(net, prefix_store=path)
+    assert e2.prefix_store is not None \
+        and e2.prefix_store.block_dtype == "int8"
+    r2 = e2.generate([req()])
+    assert [r.tokens for r in r2] == [r.tokens for r in r1]
+    assert e2.stats()["prefix_store_hits"] > 0
+    e2.shutdown()
+    # dtype guard: a float engine handed the int8 spill drops the store
+    e3 = _life_engine(net, prefix_store=path, kv_quant=False)
+    assert e3.prefix_store is None
+    e3.shutdown()
+
+
+def test_tp2_quantized_token_parity(forced_host_devices):
+    from deeplearning4j_tpu.serving.sharding import ShardedServingEngine
+    net = _build_net(n_kv=2)
+    base, e0 = _serve(net, kv_quant=True)
+    eng = ShardedServingEngine(net, max_seqs=4, max_len=32, seed=0, tp=2,
+                               kv_quant=True, capture_logprobs=True)
+    res = eng.generate([Request(p, max_new_tokens=6, temperature=0.0)
+                        for p in PROMPTS])
+    assert [r.tokens for r in base] == [r.tokens for r in res]
+    assert e0.stats()["host_syncs"] == eng.stats()["host_syncs"]
+    # scale arrays are sharded with their heads, not replicated
+    assert "k_scale" in eng._cache_specs
+    assert eng._cache_specs["k_scale"] == \
+        type(eng._cache_specs["k_scale"])(None, None, "tensor")
+    # per-device pool bytes halve with TP like the payload does
+    assert eng._g_kv_total.value == eng.decoder.cache.bytes() // 2

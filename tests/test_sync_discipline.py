@@ -48,7 +48,11 @@ HOT_PATH_MODULES = sorted(
      # swap gathers are dispatched on the hot path — every host
      # materialization (preempt readback, swap-in, prefix-store fetch)
      # must be an annotated, counted pressure-path sync
-     PKG / "serving" / "lifecycle.py"]
+     PKG / "serving" / "lifecycle.py",
+     # int8 quantization seam (ISSUE 15): kv_quantize/kv_dequantize run
+     # inside every jitted cache write and the weight-only matmuls inside
+     # every decode step — this module must stay pure device math
+     PKG / "serving" / "quant.py"]
     + list((PKG / "telemetry").glob("*.py")))
 
 ANNOTATION = "sync-ok:"
@@ -121,7 +125,10 @@ def test_all_hot_path_modules_exist():
             "registry.py", "training.py", "kv_cache.py",
             "block_table.py", "slo.py", "flight_recorder.py",
             "loadgen.py", "sharding.py", "spec.py",
-            "kv_observatory.py", "lifecycle.py", "blame.py"} <= names
+            "kv_observatory.py", "lifecycle.py", "blame.py",
+            # ISSUE 15: the int8 quantize/dequantize seam rides inside
+            # every jitted cache write and decode matmul
+            "quant.py"} <= names
 
 
 # ------------------------------------------------ scanner self-tests
