@@ -1857,6 +1857,173 @@ def bench_quantized_kv(vocab=32, d_model=128, heads=2, kv_heads=1,
     }
 
 
+def bench_prefix_radix(vocab=32, d_model=128, heads=2, kv_heads=1,
+                       n_sessions=4, system_prompt_len=224,
+                       new_tokens=16, kv_block=16, max_seqs=6,
+                       max_len=512):
+    """Radix prefix cache A/B (ISSUE 16): the SAME seeded multi-turn /
+    forked session workload served twice through identically configured
+    engines — radix tree ON vs OFF — with greedy sampling. The linear
+    registry only shares prefixes between CONCURRENTLY resident
+    requests; a session's next turn arrives after the previous one
+    retired and freed its blocks, so radix-off re-prefills the whole
+    history every turn. Radix-on retains retired prompt blocks in the
+    tree and serves every follow-up turn's history from them.
+
+    Gates (asserted, not reported — the PR 7 protocol): per-turn greedy
+    token parity between the two modes, and host_syncs/tokens_out
+    BIT-parity (the tree is pure host bookkeeping; a hidden readback
+    would change the sync count). Headline: analytic prefill FLOPs saved
+    on follow-up turns (XLA cost_analysis at the compiled buckets —
+    full-prefill cost at the prompt's bucket vs suffix-only shared
+    prefill at the engine's (Tsp, kvb) buckets), which must be >= 80%
+    on this chat mix, plus fork-turn prefix hits > 0 (a forked agent
+    branch shares every pre-fork block without recompute)."""
+    import dataclasses as _dc
+    import time as _time
+
+    from deeplearning4j_tpu import (
+        Activation, InputType, NeuralNetConfiguration, RnnOutputLayer,
+        Sgd, WeightInit)
+    from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import ServingEngine
+    from deeplearning4j_tpu.serving.loadgen import (SessionSpec,
+                                                    build_sessions,
+                                                    run_sessions)
+    from deeplearning4j_tpu.telemetry import profiler
+    from deeplearning4j_tpu.util import costs as _costs
+
+    b = (NeuralNetConfiguration.Builder().seed(42)
+         .weight_init(WeightInit.XAVIER)
+         .updater(Sgd(learning_rate=1e-3)).list())
+    for _ in range(2):
+        b.layer(SelfAttentionLayer(n_out=d_model, n_heads=heads,
+                                   n_kv_heads=kv_heads, causal=True,
+                                   block_size=0))
+    b.layer(RnnOutputLayer(n_out=vocab, activation=Activation.SOFTMAX))
+    net = MultiLayerNetwork(
+        b.set_input_type(InputType.recurrent(vocab)).build()).init()
+
+    spec = SessionSpec(
+        n_sessions=n_sessions, rate=50.0,
+        turns_mix=((3, 0.5), (4, 0.5)),
+        user_len_mix=((16, 0.5), (24, 0.5)),
+        max_new_tokens_mix=((new_tokens, 1.0),),
+        system_prompt_len=system_prompt_len, n_system_prompts=2,
+        fork_frac=0.5, scenario="chat", seed=0, vocab=vocab)
+    # zero the start offsets: every session is eligible immediately, so
+    # the closed-loop driver's submit/complete order is event-driven and
+    # identical on both sides (wall-clock start gaps could reorder
+    # admissions between runs whose step() times differ)
+    plans = [_dc.replace(p, t_start=0.0) for p in build_sessions(spec)]
+
+    was_enabled = profiler.enabled()
+    profiler.configure(enabled=True)   # file prefill/prefill_shared flops
+    try:
+        def serve(radix):
+            eng = ServingEngine(net, max_seqs=max_seqs, max_len=max_len,
+                                seed=0, overlap=False, prefill_chunk=0,
+                                kv_block=kv_block, prefix_share=True,
+                                prefix_radix=radix)
+            run_sessions(eng, plans)       # warmup: compile every bucket
+            if radix:
+                eng.decoder.cache.registry.reclaim_all()
+            eng.metrics.reset()
+            t0 = _time.perf_counter()
+            r = run_sessions(eng, plans)
+            wall = _time.perf_counter() - t0
+            st = eng.stats()
+            return {"result": r, "stats": st, "wall_s": wall,
+                    "decoder": eng.decoder,
+                    "by_turn": {(o.session_id, o.turn_idx): o
+                                for o in r.outcomes}}
+
+        on, off = serve(True), serve(False)
+        assert set(on["by_turn"]) == set(off["by_turn"])
+        for key, o_on in on["by_turn"].items():
+            assert o_on.tokens == off["by_turn"][key].tokens, \
+                f"radix changed decoded tokens at {key} — parity violation"
+        sp_on = (on["stats"]["host_syncs"], on["stats"]["tokens_out"])
+        sp_off = (off["stats"]["host_syncs"], off["stats"]["tokens_out"])
+        assert sp_on == sp_off, \
+            f"host-sync parity violation: radix-on {sp_on} != off {sp_off}"
+
+        dec = on["decoder"]
+        followups = [o for k, o in sorted(on["by_turn"].items())
+                     if o.turn_idx]
+        flops_full = flops_shared = 0.0
+        for o in followups:
+            full = _costs.get_costs(
+                f"prefill_b{dec.prefill_bucket(o.prompt_len)}") or {}
+            f_full = full.get("flops", 0.0)
+            if o.shared_prefix_tokens > 0:
+                tsp, kvb = dec.shared_buckets(o.prompt_len,
+                                              o.shared_prefix_tokens)
+                shared = _costs.get_costs(
+                    f"prefill_shared_b{tsp}k{kvb}") or {}
+                f_shared = shared.get("flops", f_full)
+            else:
+                f_shared = f_full
+            flops_full += f_full
+            flops_shared += f_shared
+        saved_frac = (1 - flops_shared / flops_full) if flops_full else 0.0
+        assert saved_frac >= 0.8, \
+            f"radix saved only {saved_frac:.1%} of follow-up prefill FLOPs"
+        hit_frac = (on["result"].shared_prefix_tokens
+                    / max(1, on["result"].prompt_tokens))
+        fork_hits = sum(o.shared_prefix_tokens
+                        for o in on["result"].outcomes
+                        if o.session_id.endswith("f"))
+        assert fork_hits > 0, "fork turns shared no prefix blocks"
+
+        def _ttft(side):
+            vals = [o.ttft_s for o in side["result"].outcomes
+                    if o.turn_idx and o.ttft_s is not None]
+            return float(np.mean(vals)) * 1e3 if vals else None
+
+        reg = dec.cache.registry
+        return {
+            "workload": f"{n_sessions} seeded sessions, 3-4 turns, "
+                        f"{system_prompt_len}-token shared system "
+                        f"prompts (2 cohorts), 50% fork after a seeded "
+                        f"turn, {new_tokens} new tokens/turn, greedy",
+            "n_turns": on["result"].n_turns,
+            "n_fork_branches": sum(
+                1 for p in plans if p.fork_at),
+            "token_parity": True,
+            "sync_parity": True,
+            "host_syncs_per_token": round(
+                sp_on[0] / max(1, sp_on[1]), 4),
+            "followup_prefill_flops_full": flops_full,
+            "followup_prefill_flops_radix": flops_shared,
+            "flops_saved_frac": round(saved_frac, 4),
+            "hit_token_frac": round(hit_frac, 4),
+            "prefix_hit_tokens": on["result"].shared_prefix_tokens,
+            "prefix_hit_tokens_off": off["result"].shared_prefix_tokens,
+            "fork_prefix_hit_tokens": fork_hits,
+            "prefix_lineage_hits": on["stats"]["prefix_lineage_hits"],
+            "ttft_followup_mean_ms_on": _ttft(on),
+            "ttft_followup_mean_ms_off": _ttft(off),
+            "wall_s_on": round(on["wall_s"], 3),
+            "wall_s_off": round(off["wall_s"], 3),
+            "tree": {"blocks_cached": on["stats"]["kv_blocks_cached"],
+                     "nodes": reg.n_nodes,
+                     "blocks_indexed": reg.n_blocks_indexed,
+                     "overhead_bytes": reg.overhead_bytes()},
+            "note": ("same seeded session graph both sides; token parity "
+                     "and host-sync BIT-parity asserted, not reported; "
+                     "FLOPs from XLA cost_analysis at the compiled "
+                     "buckets (full prefill at the prompt bucket vs "
+                     "suffix-only shared prefill at the engine's "
+                     "(Tsp, kvb) buckets) — wall/TTFT on this CPU-sized "
+                     "config demonstrate the mechanism, not TPU-scale "
+                     "wins (PERF.md 'Radix prefix cache cost model')"),
+        }
+    finally:
+        profiler.configure(enabled=was_enabled)
+
+
 def bench_sharded_serving(vocab=32, d_model=64, heads=4, kv_heads=2,
                           tp=2, max_seqs=4, n_requests=24, seed=0,
                           overload_factor=10.0, repeats=3,
@@ -2254,6 +2421,10 @@ def main():
         quant_kv = bench_quantized_kv()
     except Exception as e:
         quant_kv = {"error": f"{type(e).__name__}: {e}"}
+    try:  # radix prefix cache: multi-turn/fork cross-turn reuse (ISSUE 16)
+        radix_ab = bench_prefix_radix()
+    except Exception as e:
+        radix_ab = {"error": f"{type(e).__name__}: {e}"}
     try:  # multi-chip sharded serving (ISSUE 10): TP parity + replica A/B
         sharded = bench_sharded_serving()
         if "skipped" not in sharded:
@@ -2351,6 +2522,10 @@ def main():
             # pre-rounded; always present — CPU-runnable quantized-KV A/B:
             # throughput NEXT TO the accuracy it costs (ISSUE 15)
             "quantized_kv": quant_kv,
+            # pre-rounded; always present — CPU-runnable radix prefix
+            # cache A/B on a seeded multi-turn/fork session mix: token +
+            # host-sync parity asserted in-bench (ISSUE 16)
+            "prefix_radix": radix_ab,
             "decode_tokens_per_sec": round(
                 decode.get("decode_tokens_per_sec", 0.0), 1),
             "serving_profile": serving_profile,

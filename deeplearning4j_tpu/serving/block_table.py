@@ -197,6 +197,13 @@ class PrefixRegistry:
         self._tail: Dict[bytes, int] = {}    # exact-prompt digest -> block
         self._claims: Dict[int, List[Tuple[str, bytes]]] = {}  # invalidation
         self._pool: Optional[weakref.ref] = None
+        # per-lineage hit counting (ISSUE 16 satellite): first registration
+        # wins used to SHADOW re-registrations silently — a popular prefix
+        # re-filed by every sharer looked exactly as cold as a one-off. A
+        # re-registered already-claimed digest now counts one hit (the
+        # mapping is unchanged; the new copy holds identical content).
+        self.lineage_hits_total = 0
+        self._lineage_hits: Dict[str, int] = {}
 
     def bind_pool(self, pool: object) -> "PrefixRegistry":
         """Claim this registry for one block pool (idempotent per pool).
@@ -235,29 +242,43 @@ class PrefixRegistry:
         return n_full * bs, blocks
 
     def register(self, tokens: Sequence[int], phys_blocks: Sequence[int]
-                 ) -> None:
+                 ) -> int:
         """File every prompt block of a just-prefilled request.
         `phys_blocks` is the slot's logical->physical row (it may extend
         past the prompt into decode reservation — only prompt blocks are
         read). First registration wins: an already-claimed digest keeps
-        its existing block (the new copy holds identical content)."""
+        its existing block (the new copy holds identical content) and
+        counts one LINEAGE HIT. Returns the number of hits recorded."""
         bs = self.block_size
         n_full = len(tokens) // bs
         h = None
+        hits = 0
         for i in range(n_full):
             h = _block_digest(h, tokens[i * bs:(i + 1) * bs])
-            self._claim("full", h.digest(), phys_blocks[i])
+            hits += self._claim("full", h.digest(), phys_blocks[i])
         tail = tokens[n_full * bs:]
         if tail:
             d = _block_digest(h, tail, tail=True).digest()
-            self._claim("tail", d, phys_blocks[n_full])
+            hits += self._claim("tail", d, phys_blocks[n_full])
+        self.lineage_hits_total += hits
+        return hits
 
-    def _claim(self, kind: str, digest: bytes, block: int) -> None:
+    def _claim(self, kind: str, digest: bytes, block: int) -> int:
         index = self._full if kind == "full" else self._tail
         if digest in index:
-            return                      # first registration wins
+            # first registration wins — but the shadowed re-registration
+            # IS the popularity signal (ISSUE 16 satellite): tally it
+            hx = digest.hex()
+            self._lineage_hits[hx] = self._lineage_hits.get(hx, 0) + 1
+            return 1
         index[digest] = block
         self._claims.setdefault(block, []).append((kind, digest))
+        return 0
+
+    def lineage_hit_counts(self) -> Dict[str, int]:
+        """Per-digest re-registration tallies (the popular-prefix signal
+        an eviction policy can weight by)."""
+        return dict(self._lineage_hits)
 
     def forget(self, block: int) -> None:
         """Invalidate every claim backed by `block` (called the moment the

@@ -216,7 +216,8 @@ class StackDecoder:
                  prefix_share: Optional[bool] = None,
                  prefix_registry=None, paged_attention=None,
                  paged_spec_attention=None, kv_quant: Optional[bool] = None,
-                 quant_weights: Optional[bool] = None):
+                 quant_weights: Optional[bool] = None,
+                 prefix_radix: Optional[bool] = None):
         layers, params = _extract_stack(net)
         self.layers = layers
         self.dtype = jnp.dtype(dtype) if dtype is not None else net.dtype
@@ -258,7 +259,8 @@ class StackDecoder:
                                       num_blocks=num_blocks,
                                       prefix_share=prefix_share,
                                       prefix_registry=prefix_registry,
-                                      kv_quant=kv_quant)
+                                      kv_quant=kv_quant,
+                                      prefix_radix=prefix_radix)
         # Attention seam (ISSUE 10): the sharded engine swaps in a
         # shard_map-wrapped kernel with the same signature as
         # decode_attention_paged; the default is the single-mesh helper.

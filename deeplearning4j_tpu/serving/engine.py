@@ -137,6 +137,12 @@ class Request:
     temperature: float = 0.0
     eos_id: Optional[int] = None
     timeout_s: Optional[float] = None
+    # multi-turn attribution (ISSUE 16 satellite): opaque session handle +
+    # turn index stamped by the caller (loadgen session driver); threaded
+    # onto the GenerationResult / timeline so per-session latency is
+    # joinable in the blame ledger. Never read by the scheduler.
+    session_id: Optional[str] = None
+    turn_idx: Optional[int] = None
 
 
 @dataclass
@@ -177,6 +183,13 @@ class GenerationResult:
     kv_bytes_reserved: int = 0
     kv_bytes_live: int = 0
     kv_bytes_shared_prefix: int = 0
+    # prompt positions served from resident shared-prefix blocks at the
+    # LAST admission (ISSUE 16): the token-level view of
+    # kv_bytes_shared_prefix, what the radix A/B bench sums per turn
+    shared_prefix_tokens: int = 0
+    # multi-turn attribution (ISSUE 16 satellite): copied from the Request
+    session_id: Optional[str] = None
+    turn_idx: Optional[int] = None
 
     def timeline_phases(self) -> Dict[str, float]:
         """Total seconds per phase (post-hoc latency decomposition)."""
@@ -421,6 +434,7 @@ class ServingEngine:
                  prefix_store=None,
                  kv_quant: Optional[bool] = None,
                  quant_weights: Optional[bool] = None,
+                 prefix_radix: Optional[bool] = None,
                  name: Optional[str] = None):
         self.decoder = self._build_decoder(net, max_seqs, max_len,
                                            dtype=dtype,
@@ -429,7 +443,8 @@ class ServingEngine:
                                            prefix_share=prefix_share,
                                            prefix_registry=prefix_registry,
                                            kv_quant=kv_quant,
-                                           quant_weights=quant_weights)
+                                           quant_weights=quant_weights,
+                                           prefix_radix=prefix_radix)
         if embed is None:
             if self.decoder.n_in is None:
                 raise ValueError("stack has no n_in; pass embed=")
@@ -538,6 +553,11 @@ class ServingEngine:
         self._c_prefix_tokens = self.metrics.counter(
             "serving.prefix_shared_tokens", "prompt positions whose KV "
             "bytes AND prefill compute were skipped via prefix sharing")
+        self._c_lineage_hits = self.metrics.counter(
+            "serving.kv.prefix_lineage_hits", "prefix re-registrations "
+            "that landed on an already-claimed digest (first registration "
+            "wins; the shadowed re-file is the popular-prefix signal the "
+            "eviction policy reads, ISSUE 16)")
         self._h_ttft = self.metrics.histogram(
             "serving.ttft_s", "submit -> first token (s)",
             buckets=telemetry.DEFAULT_S_BUCKETS)
@@ -696,6 +716,16 @@ class ServingEngine:
                     self.prefix_store.block_dtype = expect_dt
                 elif self.prefix_store.block_dtype != expect_dt:
                     self.prefix_store = None
+        if (self.prefix_store is not None and cache.prefix_radix
+                and getattr(self.prefix_store, "evict_policy", None)
+                is None):
+            # ONE tree-wide LRU (ISSUE 16): the radix tree's heat decides
+            # store eviction instead of the store's private byte-cap LRU —
+            # orphan digests (no known lineage) go first, then the coldest
+            # lineage. On a group-shared store the first radix replica to
+            # construct wins the hook; digests that replica never saw
+            # evict as orphans, which is the desired cold-first order.
+            self.prefix_store.evict_policy = cache.registry.store_victim
         self._c_evict_rec = self.metrics.counter(
             "serving.kv.evictions_recompute", "preemptions reclaimed by "
             "freeing blocks and replaying prefill at readmission")
@@ -782,6 +812,9 @@ class ServingEngine:
                     "kv_bytes_waste": self._g_kv_waste.value,
                     "prefix_hits": self._c_prefix_hits.value,
                     "prefix_shared_tokens": self._c_prefix_tokens.value,
+                    "prefix_lineage_hits": self._c_lineage_hits.value,
+                    "kv_blocks_cached": snap.get("blocks_cached", 0),
+                    "prefix_radix": int(self.decoder.cache.prefix_radix),
                     "admission_retries": self._c_adm_retries.value,
                     "resident_seqs_max": self._resident_seqs_max,
                     "spec_decode": int(self.spec_decode),
@@ -1073,7 +1106,9 @@ class ServingEngine:
         are consumed. Lock held."""
         req, slot = act.req, act.slot
         seq = self._admission_sequence(act)
-        self.decoder.cache.register_prefix(slot, seq)
+        hits = self.decoder.cache.register_prefix(slot, seq)
+        if hits:
+            self._c_lineage_hits.inc(hits)
         self._offer_prefix_store(act, seq)
         if act.resume is not None:
             self._finish_resume(act, t_pf_mono, extras)
@@ -1235,11 +1270,17 @@ class ServingEngine:
             tps = None
         # a span, not an instant: covers the history-row readback + block
         # free, so timeline coverage stays gap-free through retirement
-        act.timeline.append({"phase": "retire", "t0": t_ret0, "t1": now,
-                             "reason": reason, "tokens": n,
-                             "kv_bytes_reserved": kv_reserved,
-                             "kv_bytes_live": kv_live,
-                             "kv_bytes_shared": kv_shared})
+        ret_ev = {"phase": "retire", "t0": t_ret0, "t1": now,
+                  "reason": reason, "tokens": n,
+                  "kv_bytes_reserved": kv_reserved,
+                  "kv_bytes_live": kv_live,
+                  "kv_bytes_shared": kv_shared}
+        if req.session_id is not None:
+            # session join key (ISSUE 16 satellite): lets the blame ledger
+            # and flight recorder group turns of one conversation
+            ret_ev["session_id"] = req.session_id
+            ret_ev["turn_idx"] = req.turn_idx
+        act.timeline.append(ret_ev)
         qw = act.t_admit - act.t_submit if act.t_admit else None
         res = GenerationResult(row, reason, len(req.tokens), lps,
                                ttft_s=ttft, tokens_per_sec=tps,
@@ -1248,7 +1289,10 @@ class ServingEngine:
                                timeline=act.timeline,
                                kv_bytes_reserved=kv_reserved,
                                kv_bytes_live=kv_live,
-                               kv_bytes_shared_prefix=kv_shared)
+                               kv_bytes_shared_prefix=kv_shared,
+                               shared_prefix_tokens=act.shared_len,
+                               session_id=req.session_id,
+                               turn_idx=req.turn_idx)
         act.fut._set(res)
         self._c_retires.inc()
         if tps is not None:
@@ -1431,7 +1475,9 @@ class ServingEngine:
                 k_host[:, lis], v_host[:, lis], **skw)
         cache.state = _kvc.set_length(cache.state, slot, live)
         cache.touch_blocks(slot, 0, live)
-        cache.register_prefix(slot, self._admission_sequence(act))
+        hits = cache.register_prefix(slot, self._admission_sequence(act))
+        if hits:
+            self._c_lineage_hits.inc(hits)
         act.resume = None
         act.n_generated = n
         act.prefilled = plen

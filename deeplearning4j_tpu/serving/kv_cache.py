@@ -108,6 +108,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.serving import quant
+from deeplearning4j_tpu.serving import radix_tree
 from deeplearning4j_tpu.serving.block_table import (BlockAllocator,
                                                     PrefixRegistry)
 
@@ -509,7 +510,8 @@ class KVCache:
                  num_blocks: Optional[int] = None,
                  prefix_share: Optional[bool] = None,
                  prefix_registry: Optional[PrefixRegistry] = None,
-                 kv_quant: Optional[bool] = None):
+                 kv_quant: Optional[bool] = None,
+                 prefix_radix: Optional[bool] = None):
         if max_seqs < 1 or max_len < 1:
             raise ValueError(f"bad cache shape: max_seqs={max_seqs}, "
                              f"max_len={max_len}")
@@ -549,9 +551,19 @@ class KVCache:
                     f"{prefix_registry.block_size} != cache block_size "
                     f"{self.block_size}")
             self.registry = prefix_registry
+        elif radix_tree.resolve_prefix_radix(prefix_radix):
+            # radix prefix cache (ISSUE 16): drop-in registry whose tree
+            # RETAINS registered prompt blocks past their owners'
+            # retirement (the tree holds its own allocator reference), so
+            # follow-up turns and forks COW-share retired histories.
+            # admit() reclaims cold retained blocks under pool pressure.
+            self.registry = radix_tree.RadixPrefixTree(self.block_size)
         else:
             self.registry = PrefixRegistry(self.block_size)
         self.registry.bind_pool(self)
+        # keyed off the ACTUAL registry (an injected radix tree enables
+        # retention semantics too, e.g. from a ShardedServingGroup)
+        self.prefix_radix = bool(getattr(self.registry, "is_radix", False))
         self._owner: Dict[int, object] = {}   # slot -> opaque request handle
         self._slot_blocks: Dict[int, List[int]] = {}   # slot -> mapped blocks
         # reverse index for attribution (ISSUE 12): block -> slots mapping
@@ -604,7 +616,23 @@ class KVCache:
                     cow_src = mblocks[n_full]
             else:
                 shared_len = 0
-        fresh = self.allocator.alloc_many(need - len(shared_blocks))
+        n_fresh = need - len(shared_blocks)
+        fresh = self.allocator.alloc_many(n_fresh)
+        if fresh is None and self.prefix_radix:
+            # radix retention (ISSUE 16): retired prompt blocks stay in
+            # the pool under the tree's reference — under pressure the
+            # cache eats its own cold cache (coldest leaves first) before
+            # rejecting an admission. Blocks this admission is about to
+            # map are protected. Evicting cache is a benign side effect
+            # of a failed reservation; the all-or-nothing contract still
+            # holds for SLOT/block state.
+            protect = set(shared_blocks)
+            if cow_src is not None:
+                protect.add(cow_src)
+            short = n_fresh - self.allocator.n_free
+            if short > 0 and self.registry.reclaim(short,
+                                                   protect=protect) > 0:
+                fresh = self.allocator.alloc_many(n_fresh)
         if fresh is None:
             return None
         slot = heapq.heappop(self._free_slots)
@@ -670,18 +698,27 @@ class KVCache:
             row[:len(row_blocks)] = row_blocks
             self.state = set_block_table(self.state, slot, row)
             self._block_sharers[old].discard(slot)
+            if not self._block_sharers[old]:
+                # possible under radix retention: refcount 2 = one slot +
+                # the tree's own reference, so the last SLOT just left
+                del self._block_sharers[old]
             self._block_sharers.setdefault(fresh[0], set()).add(slot)
             self.allocator.decref(old)     # refcount >= 2: never frees here
             self.cow_copies_total += 1
             copied += 1
         return copied
 
-    def register_prefix(self, slot: int, prompt: Sequence[int]) -> None:
+    def register_prefix(self, slot: int, prompt: Sequence[int]) -> int:
         """File the slot's prompt blocks in the prefix registry (call AFTER
         dispatching the prefill — by the time any sharer's device reads
-        run, the writes are ordered ahead of them)."""
+        run, the writes are ordered ahead of them). Under a radix registry
+        this is also the retention point: the tree increfs newly claimed
+        full prompt blocks so they outlive the slot. Returns the lineage
+        hits recorded (re-registrations of already-claimed digests)."""
         if self.prefix_share and len(prompt) >= 2:
-            self.registry.register(prompt, self._slot_blocks[slot])
+            return int(self.registry.register(
+                prompt, self._slot_blocks[slot]) or 0)
+        return 0
 
     def free(self, slot: int) -> None:
         """Return a slot and its block reservations. Shared blocks only
@@ -767,6 +804,13 @@ class KVCache:
                 "deadline": getattr(owner, "deadline", None),
                 "t_submit": getattr(owner, "t_submit", None),
             }
+        # radix retention (ISSUE 16): blocks held ONLY by the tree's own
+        # reference belong to no slot — they surface here so attribution
+        # (cached_prefix_bytes) and conservation stay exact. Empty under
+        # the linear registry, keeping pre-radix snapshots bit-identical
+        # aside from the constant "blocks_cached": 0 total.
+        cached = (self.registry.retained_blocks()
+                  if self.prefix_radix else frozenset())
         snap: Dict[str, object] = {
             "clock": alloc.clock,
             "num_blocks": self.num_blocks,
@@ -775,6 +819,7 @@ class KVCache:
             "block_overhead_bytes": self.block_overhead_bytes,
             "blocks_free": alloc.n_free,
             "blocks_shared": alloc.n_shared,
+            "blocks_cached": len(cached),
             "slots_free": len(self._free_slots),
             "slots_active": self.max_seqs - len(self._free_slots),
             "slots": slots,
@@ -785,10 +830,11 @@ class KVCache:
                     "refcount": alloc.refcount(b),
                     "last_touch": alloc.last_touch(b),
                     "alloc_epoch": alloc.alloc_epoch(b),
-                    "sharers": sorted(sharers),
+                    "sharers": sorted(self._block_sharers.get(b, ())),
+                    "cached": b in cached,
                     "lineage": self.registry.lineage(b),
                 }
-                for b, sharers in sorted(self._block_sharers.items())
+                for b in sorted(set(self._block_sharers) | set(cached))
             }
         return snap
 
@@ -808,6 +854,13 @@ class KVCache:
     @property
     def blocks_shared(self) -> int:
         return self.allocator.n_shared
+
+    @property
+    def blocks_cached(self) -> int:
+        """Blocks retained by the radix tree's own reference (0 under the
+        linear registry)."""
+        return len(self.registry.retained_blocks()) \
+            if self.prefix_radix else 0
 
     def active_slots(self) -> List[int]:
         return sorted(self._owner)

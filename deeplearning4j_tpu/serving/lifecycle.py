@@ -67,6 +67,8 @@ from __future__ import annotations
 
 import os
 import time
+import warnings
+import zipfile
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
@@ -169,6 +171,13 @@ class PersistentPrefixStore:
         # geometry guard also compares this so an int8 (quantized) spill
         # is never restored into a float pool or vice versa
         self.block_dtype: Optional[str] = None
+        # optional victim chooser (ISSUE 16): called with the live
+        # OrderedDict of entries, returns the digest to evict (or None
+        # for the default LRU head). The engine wires the radix tree's
+        # `store_victim` here so ONE tree-wide heat order governs both
+        # device-pool reclaim and store eviction, replacing the store's
+        # private recency order.
+        self.evict_policy: Optional[Callable[..., Optional[bytes]]] = None
 
     # ------------------------------------------------------------ lookup
     def covered(self, digests: Sequence[bytes]) -> int:
@@ -215,7 +224,15 @@ class PersistentPrefixStore:
             return
         while self.capacity_bytes and self._entries \
                 and self.bytes_used + nbytes > self.capacity_bytes:
-            old_d, (_, _, old) = self._entries.popitem(last=False)
+            old_d = None
+            if self.evict_policy is not None:
+                old_d = self.evict_policy(self._entries)
+                if old_d is not None and old_d not in self._entries:
+                    old_d = None   # stale advice → fall back to LRU head
+            if old_d is None:
+                old_d, (_, _, old) = self._entries.popitem(last=False)
+            else:
+                _, _, old = self._entries.pop(old_d)
             self._scales.pop(old_d, None)
             self.bytes_used -= old
         self._entries[digest] = (k_block, v_block, nbytes)
@@ -268,37 +285,65 @@ class PersistentPrefixStore:
                 arrays[f"ks_{d.hex()}"] = np.asarray(sc[0])
                 arrays[f"vs_{d.hex()}"] = np.asarray(sc[1])  # sync-ok: spill
         # write through a handle: np.savez(str) appends ".npz" to a bare
-        # path, which load() (os.path.exists on the SAME string) would miss
-        with open(path, "wb") as f:
-            np.savez(f, **arrays)
+        # path, which load() (os.path.exists on the SAME string) would miss.
+        # Crash-safe spill (ISSUE 16 satellite): write a sibling temp file
+        # and rename into place — a crash mid-write leaves the previous
+        # spill intact instead of a truncated zip at the canonical path.
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
         return path
 
     def load(self, path: Optional[str] = None) -> int:
         """Load entries from an npz spill file (missing file = empty
-        store, not an error). Returns the number of blocks loaded."""
+        store, not an error). A truncated or corrupt spill — a crash that
+        predates the atomic rename, disk-full, bit rot — warns and starts
+        empty rather than killing engine construction (ISSUE 16
+        satellite: the store is a cache; losing it costs recompute, not
+        correctness). Returns the number of blocks loaded."""
         path = path or self.path
         if not path or not os.path.exists(path):
             return 0
         loaded = 0
-        with np.load(path) as z:
-            for name in z.files:
-                if not name.startswith("k_"):
-                    continue
-                hexd = name[2:]
-                vname = f"v_{hexd}"
-                if vname not in z.files:
-                    continue
-                k = z[name]
-                v = z[vname]
-                nbytes = k.nbytes + v.nbytes
-                kw = {}
-                ksn, vsn = f"ks_{hexd}", f"vs_{hexd}"
-                if ksn in z.files and vsn in z.files:
-                    kw = {"k_scale": z[ksn], "v_scale": z[vsn]}
-                    nbytes += z[ksn].nbytes + z[vsn].nbytes
-                self.put(bytes.fromhex(hexd), k, v, nbytes,
-                         block_shape=k.shape, **kw)
-                loaded += 1
+        try:
+            with np.load(path) as z:
+                for name in z.files:
+                    if not name.startswith("k_"):
+                        continue
+                    hexd = name[2:]
+                    vname = f"v_{hexd}"
+                    if vname not in z.files:
+                        continue
+                    k = z[name]
+                    v = z[vname]
+                    nbytes = k.nbytes + v.nbytes
+                    kw = {}
+                    ksn, vsn = f"ks_{hexd}", f"vs_{hexd}"
+                    if ksn in z.files and vsn in z.files:
+                        kw = {"k_scale": z[ksn], "v_scale": z[vsn]}
+                        nbytes += z[ksn].nbytes + z[vsn].nbytes
+                    self.put(bytes.fromhex(hexd), k, v, nbytes,
+                             block_shape=k.shape, **kw)
+                    loaded += 1
+        except (zipfile.BadZipFile, ValueError, OSError, EOFError,
+                KeyError) as e:
+            warnings.warn(
+                f"prefix-store spill at {path!r} unreadable ({e!r}); "
+                "starting with an empty store", stacklevel=2)
+            # drop any partially ingested entries — a half-loaded chain
+            # would satisfy covered() for a prefix it can't fully restore
+            self._entries.clear()
+            self._scales.clear()
+            self.bytes_used = 0
+            return 0
         return loaded
 
     @property

@@ -30,6 +30,26 @@ Two layers, split so the schedule is reproducible independent of the run:
 The loadgen reads only host-side values (futures, host timestamps) — it
 adds zero device syncs of its own (tests/test_sync_discipline.py scans
 this module).
+
+SESSION WORKLOADS (ISSUE 16). Multi-turn chat and agent tool-call loops
+are what a prefix cache is FOR, and neither is expressible as an
+open-loop arrival list: turn N+1's prompt embeds turn N's generated
+reply, so the schedule cannot be precomputed. A third layer models them:
+
+- `build_sessions(spec)` — a PURE function of `SessionSpec` + seed
+  producing `SessionPlan`s: per-session Poisson start times, turn counts
+  and user-message lengths from mixes, a shared system-prompt template
+  drawn per cohort (the cross-SESSION sharing a radix tree also
+  captures), and seeded fork decisions — a forked session replays the
+  same conversation up to `fork_at` completed turns, then branches
+  (the agent tree-search shape; fork turns share every pre-fork block).
+- `run_sessions(engine, plans)` — CLOSED-LOOP per session (a user reads
+  the reply before typing the next message; an agent consumes the tool
+  result before the next call), open across sessions. Each branch
+  resubmits its full grown history + the next user/tool message as a
+  fresh `Request` carrying (session_id, turn_idx); with the radix prefix
+  tree on, everything but the new suffix is served from retained blocks,
+  and that is exactly the cross-turn KV reuse `bench.py` measures.
 """
 from __future__ import annotations
 
@@ -95,6 +115,13 @@ class RequestOutcome:
     tokens_per_sec: Optional[float] = None
     cohort: Optional[int] = None
     timeline: Optional[List[dict]] = None
+    # session fields (ISSUE 16): set by run_sessions, default-None for
+    # the open-loop path so slo.py's duck type is unchanged
+    session_id: Optional[str] = None
+    turn_idx: Optional[int] = None
+    prompt_len: int = 0
+    shared_prefix_tokens: int = 0        # engine-reported prefix hit
+    tokens: Optional[List[int]] = None   # generated row (parity checks)
 
 
 @dataclass
@@ -243,3 +270,245 @@ def run(engine, schedule: Sequence[ScheduledRequest]) -> LoadResult:
 def run_spec(engine, spec: LoadSpec) -> LoadResult:
     """Convenience: build the schedule and run it."""
     return run(engine, build_schedule(spec))
+
+
+# ====================================================== session workloads
+@dataclass(frozen=True)
+class SessionTurn:
+    """One user (or tool-result) message in a session and the reply cap."""
+    user_tokens: Tuple[int, ...]
+    max_new_tokens: int
+
+
+@dataclass(frozen=True)
+class SessionPlan:
+    """One planned session: the deterministic half of a multi-turn
+    conversation (what the 'user' will say; the replies come from the
+    engine at run time). `fork_at` > 0 plans an agent-style branch: after
+    `fork_at` turns complete, a second branch continues from a COPY of
+    the history with its own turns — with the radix tree on, every
+    pre-fork block is shared between the branches."""
+    session_id: str
+    t_start: float                       # seconds from run start
+    turns: Tuple[SessionTurn, ...]
+    cohort: int = 0                      # which system-prompt template
+    fork_at: int = 0                     # completed turns at branch point
+    fork_turns: Tuple[SessionTurn, ...] = ()
+    think_time_s: float = 0.0            # reply -> next-message gap
+    temperature: float = 0.0
+    timeout_s: Optional[float] = None
+    scenario: str = "chat"               # "chat" | "agent" (labeling)
+
+
+@dataclass(frozen=True)
+class SessionSpec:
+    """Session workload description: everything `build_sessions` needs."""
+    n_sessions: int
+    rate: float = 4.0                    # session starts / s (Poisson)
+    turns_mix: LengthMix = ((3, 1.0),)   # turns per session
+    user_len_mix: LengthMix = ((24, 1.0),)
+    max_new_tokens_mix: LengthMix = ((16, 1.0),)
+    # shared system prompt: every session's turn-0 message is prefixed
+    # with one of n_system_prompts fixed templates (cross-session reuse)
+    system_prompt_len: int = 0
+    n_system_prompts: int = 1
+    # agent forking: this fraction of multi-turn sessions branch after a
+    # seeded number of completed turns (tree-search / tool-retry shape)
+    fork_frac: float = 0.0
+    fork_turns_mix: LengthMix = ((1, 1.0),)
+    scenario: str = "chat"
+    think_time_s: float = 0.0
+    seed: Optional[int] = None           # None -> $DL4J_TPU_LOADGEN_SEED
+    vocab: int = 32
+    temperature: float = 0.0
+    timeout_s: Optional[float] = None
+
+
+@dataclass
+class SessionLoadResult:
+    outcomes: List[RequestOutcome]       # one per completed turn
+    n_sessions: int                      # branches counted separately
+    n_turns: int                         # completed turns across branches
+    wall_s: float
+    prompt_tokens: int                   # total submitted prompt tokens
+    followup_prompt_tokens: int          # prompt tokens on turn_idx > 0
+    shared_prefix_tokens: int            # engine-reported prefix hits
+    new_tokens: int                      # generated tokens
+
+
+def build_sessions(spec: SessionSpec) -> List[SessionPlan]:
+    """Session plans as a pure function of (spec, seed): one seeded
+    RandomState, fixed draw order — identical spec + seed reproduces the
+    same session graph (starts, turn counts, every message, every fork)
+    exactly, which is what lets bench.py replay the SAME workload with
+    the radix tree on and off."""
+    if spec.n_sessions < 1 or spec.rate <= 0:
+        raise ValueError("n_sessions >= 1 and rate > 0 required")
+    rng = np.random.RandomState(resolve_seed(spec.seed))
+    starts = np.cumsum(rng.exponential(1.0 / spec.rate,
+                                       size=spec.n_sessions))
+    sys_prompts: List[Tuple[int, ...]] = []
+    if spec.system_prompt_len > 0:
+        sys_prompts = [
+            tuple(rng.randint(0, spec.vocab,
+                              size=spec.system_prompt_len).tolist())
+            for _ in range(max(1, spec.n_system_prompts))]
+
+    def _turn(first: bool, cohort: int) -> SessionTurn:
+        ulen = _draw(rng, spec.user_len_mix)
+        toks = tuple(rng.randint(0, spec.vocab, size=ulen).tolist())
+        if first and sys_prompts:
+            toks = sys_prompts[cohort] + toks
+        return SessionTurn(toks, _draw(rng, spec.max_new_tokens_mix))
+
+    plans: List[SessionPlan] = []
+    for s in range(spec.n_sessions):
+        n_turns = max(1, _draw(rng, spec.turns_mix))
+        cohort = int(rng.randint(len(sys_prompts))) if sys_prompts else 0
+        turns = tuple(_turn(i == 0, cohort) for i in range(n_turns))
+        fork_at, fork_turns = 0, ()
+        # sync-ok: host RNG draw
+        if n_turns >= 2 and float(rng.uniform()) < spec.fork_frac:
+            fork_at = int(rng.randint(1, n_turns))
+            n_fork = max(1, _draw(rng, spec.fork_turns_mix))
+            fork_turns = tuple(_turn(False, cohort)
+                               for _ in range(n_fork))
+        t_start = float(starts[s])  # sync-ok: host numpy array built above
+        plans.append(SessionPlan(
+            session_id=f"s{s}", t_start=t_start, turns=turns,
+            cohort=cohort, fork_at=fork_at, fork_turns=fork_turns,
+            think_time_s=spec.think_time_s, temperature=spec.temperature,
+            timeout_s=spec.timeout_s, scenario=spec.scenario))
+    return plans
+
+
+def _poll(fut) -> Optional[object]:
+    """Non-blocking future read: the result if retired, else None."""
+    try:
+        return fut.get(timeout=0)
+    except TimeoutError:
+        return None
+
+
+class _Branch:
+    """Run-time state of one conversation branch (a session, or the
+    forked continuation of one)."""
+
+    __slots__ = ("sid", "plan", "turns", "history", "next_turn",
+                 "turn_base", "ready_t", "fut", "t_submit", "done")
+
+    def __init__(self, sid: str, plan: SessionPlan,
+                 turns: Tuple[SessionTurn, ...], history: List[int],
+                 turn_base: int, ready_t: float):
+        self.sid = sid
+        self.plan = plan
+        self.turns = turns
+        self.history = history           # full conversation so far
+        self.next_turn = 0               # index into `turns`
+        self.turn_base = turn_base       # global turn_idx of turns[0]
+        self.ready_t = ready_t
+        self.fut = None
+        self.t_submit = 0.0
+        self.done = False
+
+
+def run_sessions(engine, plans: Sequence[SessionPlan]
+                 ) -> SessionLoadResult:
+    """Closed-loop session driver: each branch waits for its reply (and
+    think time) before the next turn; branches and sessions overlap
+    freely. Every turn resubmits the FULL grown history + the next
+    message as a fresh Request stamped (session_id, turn_idx) — the
+    prefix cache, not the loadgen, is responsible for not recomputing
+    the shared past. Host-side only: futures and wall clocks."""
+    outcomes: List[RequestOutcome] = []
+    branches: List[_Branch] = []
+    pending = sorted(plans, key=lambda p: p.t_start)
+    pi = 0
+    t0 = time.monotonic()
+    while pi < len(pending) or any(not b.done for b in branches):
+        now = time.monotonic() - t0
+        while pi < len(pending) and pending[pi].t_start <= now:
+            p = pending[pi]
+            branches.append(_Branch(p.session_id, p, p.turns, [], 0,
+                                    p.t_start))
+            pi += 1
+        progressed = False
+        for b in branches:
+            if b.done or b.fut is not None:
+                continue
+            if b.next_turn >= len(b.turns):
+                b.done = True
+                continue
+            if b.ready_t > now:
+                continue
+            turn = b.turns[b.next_turn]
+            b.history.extend(turn.user_tokens)
+            b.t_submit = time.monotonic() - t0
+            b.fut = engine.submit(Request(
+                list(b.history), max_new_tokens=turn.max_new_tokens,
+                temperature=b.plan.temperature,
+                timeout_s=b.plan.timeout_s, session_id=b.sid,
+                turn_idx=b.turn_base + b.next_turn))
+            progressed = True
+        busy = engine.step()
+        now = time.monotonic() - t0
+        for b in branches:
+            if b.fut is None:
+                continue
+            res = _poll(b.fut)
+            if res is None:
+                continue
+            b.fut = None
+            tidx = b.turn_base + b.next_turn
+            outcomes.append(RequestOutcome(
+                req_id=res.req_id, t_offered=b.plan.t_start,
+                t_submit=b.t_submit, lateness_s=0.0,
+                finish_reason=res.finish_reason,
+                n_tokens=len(res.tokens), ttft_s=res.ttft_s,
+                queue_wait_s=res.queue_wait_s,
+                admission_retries=res.admission_retries,
+                tokens_per_sec=res.tokens_per_sec,
+                cohort=b.plan.cohort, timeline=res.timeline,
+                session_id=b.sid, turn_idx=tidx,
+                prompt_len=res.prompt_len,
+                shared_prefix_tokens=res.shared_prefix_tokens,
+                tokens=list(res.tokens)))
+            if res.timeline:
+                outcomes[-1].latency_s = (
+                    max(e["t1"] for e in res.timeline)
+                    - min(e["t0"] for e in res.timeline))
+            if res.finish_reason not in ("eos", "length"):
+                b.done = True            # timeout/shutdown: abandon branch
+                continue
+            b.history.extend(res.tokens)
+            b.next_turn += 1
+            b.ready_t = now + b.plan.think_time_s
+            progressed = True
+            if (b.sid == b.plan.session_id and b.plan.fork_at
+                    and b.next_turn == b.plan.fork_at):
+                # branch point: the fork continues from a COPY of the
+                # history — pre-fork blocks are shared, not recomputed
+                branches.append(_Branch(
+                    b.plan.session_id + "f", b.plan, b.plan.fork_turns,
+                    list(b.history), b.plan.fork_at, b.ready_t))
+            if b.next_turn >= len(b.turns):
+                b.done = True
+        if not busy and not progressed:
+            time.sleep(0.0005)           # everyone thinking / waiting
+    wall_s = time.monotonic() - t0
+    return SessionLoadResult(
+        outcomes=outcomes,
+        n_sessions=len(branches),
+        n_turns=len(outcomes),
+        wall_s=wall_s,
+        prompt_tokens=sum(o.prompt_len for o in outcomes),
+        followup_prompt_tokens=sum(o.prompt_len for o in outcomes
+                                   if o.turn_idx),
+        shared_prefix_tokens=sum(o.shared_prefix_tokens
+                                 for o in outcomes),
+        new_tokens=sum(o.n_tokens for o in outcomes))
+
+
+def run_session_spec(engine, spec: SessionSpec) -> SessionLoadResult:
+    """Convenience: build the session plans and run them."""
+    return run_sessions(engine, build_sessions(spec))

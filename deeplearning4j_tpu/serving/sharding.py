@@ -54,6 +54,8 @@ from deeplearning4j_tpu.ops.decode_attention import (paged_decode_specs,
 from deeplearning4j_tpu.parallel.mesh import (compat_shard_map, make_mesh,
                                               replica_submeshes)
 from deeplearning4j_tpu.serving.block_table import PrefixRegistry
+from deeplearning4j_tpu.serving.radix_tree import (RadixPrefixTree,
+                                                   resolve_prefix_radix)
 from deeplearning4j_tpu.serving.decode import (StackDecoder,
                                                decode_attention_paged,
                                                decode_attention_spec_paged)
@@ -89,6 +91,8 @@ GROUP_SUMMED_KEYS: Tuple[str, ...] = (
     "kv_evictions_recompute", "kv_evictions_swap", "kv_preemptions",
     "kv_swap_out_bytes", "kv_swap_in_bytes", "kv_host_pool_bytes",
     "prefix_store_hits", "prefix_store_tokens",
+    # ISSUE 16: radix-tree residency + popular-prefix signal, fleet-wide
+    "prefix_lineage_hits", "kv_blocks_cached",
     # ISSUE 14: group snapshot_seq = per-replica scheduler-iteration
     # counters summed — still strictly monotonic while any replica steps,
     # so scrapers can detect stale/torn fleet snapshots the same way
@@ -505,8 +509,15 @@ class ShardedServingGroup:
         block_size = resolve_block_size(engine_kw.get("kv_block"), max_len)
         # per-replica registry handles: owned (bound) by each replica's KV
         # pool, read by the router for affinity — block ids never cross
-        # replicas (see block_table.PrefixRegistry.bind_pool)
-        self.registries = [PrefixRegistry(block_size)
+        # replicas (see block_table.PrefixRegistry.bind_pool). With the
+        # radix tree on (ISSUE 16) each replica gets its own tree; the
+        # router's longest-prefix affinity then routes a session's next
+        # turn to the replica RETAINING its history, which is what makes
+        # cross-turn reuse survive replica fan-out.
+        reg_cls = (RadixPrefixTree
+                   if resolve_prefix_radix(engine_kw.get("prefix_radix"))
+                   else PrefixRegistry)
+        self.registries = [reg_cls(block_size)
                            for _ in range(self.replicas)]
         # ONE persistent prefix store for the whole group (ISSUE 13):
         # unlike PrefixRegistry entries, store entries are content-keyed
@@ -667,7 +678,7 @@ class ShardedServingGroup:
             attribute_pool
         fleet = {"pool_bytes": 0, "free_bytes": 0, "shared_bytes": 0,
                  "private_live_bytes": 0, "waste_tail_bytes": 0,
-                 "waste_reserved_bytes": 0}
+                 "waste_reserved_bytes": 0, "cached_prefix_bytes": 0}
         per: List[Dict[str, object]] = []
         fracs: List[float] = []
         for r, engine in enumerate(self.engines):

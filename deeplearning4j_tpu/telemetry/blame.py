@@ -404,6 +404,13 @@ def blame_report(results: Iterable, slo=None, top: int = 3) -> dict:
     per_cohort: Dict[str, List[dict]] = {}
     for i, r in enumerate(results):
         c = _get(r, "cohort", None)
+        if c is None:
+            # session workloads (ISSUE 16): turns carry session_id, not a
+            # loadgen cohort — join them so "which conversation ate the
+            # latency" reads straight off the per-cohort ledger
+            sid = _get(r, "session_id", None)
+            if sid is not None:
+                c = f"session:{sid}"
         if c is not None:
             per_cohort.setdefault(str(c), []).append(entries[i])
     cohorts = {c: {"n": len(es),

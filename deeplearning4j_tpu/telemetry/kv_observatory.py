@@ -93,7 +93,17 @@ def attribute_pool(snapshot: Dict[str, object]) -> Dict[str, object]:
     the positional payload: each block's bytes become
     `bs * bpp + overhead`, and a partially-live private block's overhead
     is attributed to the LIVE side (the scales exist because the block
-    holds live content), keeping the conservation sum exact."""
+    holds live content), keeping the conservation sum exact.
+
+    With the radix prefix tree on (ISSUE 16) a block may be RETAINED by
+    the tree after every request sharing it retired: refcount 1, mapped
+    into no slot, flagged `cached` in the snapshot. Those bytes are a
+    sixth partition term, `cached_prefix_bytes` — spent memory, but
+    reclaimable on demand (radix reclaim frees them before admission
+    fails) and the entire source of cross-turn prefill savings. A cached
+    block still mapped by a live slot has refcount >= 2 and counts as
+    shared, exactly as before; with the tree off the term is zero and
+    the original five-way partition is unchanged."""
     bs = int(snapshot["block_size"])
     bpp = int(snapshot["bytes_per_position"])
     ovh = int(snapshot.get("block_overhead_bytes", 0))
@@ -103,13 +113,17 @@ def attribute_pool(snapshot: Dict[str, object]) -> Dict[str, object]:
     free_bytes = int(snapshot["blocks_free"]) * block_bytes
     shared_bytes = sum(block_bytes for b in blocks.values()
                        if b["refcount"] >= 2)
+    # radix-retained blocks no live slot maps (refcount 1 = the tree's
+    # own reference): the cross-turn cache residency term (ISSUE 16)
+    cached_bytes = sum(block_bytes for b in blocks.values()
+                       if b.get("cached") and b["refcount"] == 1)
     private_live = 0
     waste_tail = 0
     waste_reserved = 0
     per_slot: Dict[int, Dict[str, int]] = {}
     by_lineage: Dict[str, int] = {}
     for b in blocks.values():
-        if b["refcount"] >= 2:
+        if b["refcount"] >= 2 or (b.get("cached") and b["refcount"] == 1):
             key = b["lineage"] or "<unregistered>"
             by_lineage[key] = by_lineage.get(key, 0) + block_bytes
     for slot, info in snapshot["slots"].items():  # type: ignore[union-attr]
@@ -138,7 +152,7 @@ def attribute_pool(snapshot: Dict[str, object]) -> Dict[str, object]:
                           "shared_bytes": slot_shared,
                           "waste_bytes": slot_waste}
     total = (free_bytes + shared_bytes + private_live
-             + waste_tail + waste_reserved)
+             + waste_tail + waste_reserved + cached_bytes)
     return {
         "pool_bytes": pool_bytes,
         "free_bytes": free_bytes,
@@ -146,6 +160,7 @@ def attribute_pool(snapshot: Dict[str, object]) -> Dict[str, object]:
         "private_live_bytes": private_live,
         "waste_tail_bytes": waste_tail,
         "waste_reserved_bytes": waste_reserved,
+        "cached_prefix_bytes": cached_bytes,
         "per_slot": per_slot,
         "shared_by_lineage": by_lineage,
         "conserved": total == pool_bytes,

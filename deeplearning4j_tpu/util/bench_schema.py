@@ -44,7 +44,12 @@ always present; measured entries must prove sync_parity=True, carry
 throughput NEXT TO its accuracy cost — divergence count under the
 disclosed 2% gate plus max_abs_logprob_delta — a pool-byte ratio in
 (0, 0.5), and a byte-equal capacity probe where the quantized pool
-holds at least as many resident sequences).
+holds at least as many resident sequences). ISSUE 16 adds
+`prefix_radix` (the radix-tree prefix cache A/B on a seeded
+multi-turn/fork session mix — CPU-runnable and always present;
+measured entries must prove token_parity=True AND sync_parity=True, a
+hit_token_frac and flops_saved_frac in [0, 1], and
+fork_prefix_hit_tokens > 0).
 bench.py calls
 `assert_valid` on the dict it is about to print, and
 tests/test_bench_schema.py re-validates the committed artifact, so the
@@ -461,6 +466,42 @@ def validate_artifact(art: dict) -> List[str]:
             errs.append("quantized_kv.capacity_probe: quantized pool at "
                         "an equal byte budget holds FEWER sequences — "
                         "byte accounting or admission regressed")
+
+    # prefix_radix (ISSUE 16): the radix-tree prefix cache A/B on a
+    # seeded multi-turn/fork session mix. When measured it must prove
+    # BOTH in-bench parity assertions held (greedy tokens AND the
+    # host-sync count — the tree is host bookkeeping; a hidden readback
+    # is a regression even at equal tokens), report a sane hit-token
+    # fraction, and show fork branches actually shared pre-fork blocks —
+    # a radix cache whose forks re-prefill is just the linear registry
+    # with extra steps.
+    pr = e.get("prefix_radix")
+    if not isinstance(pr, dict):
+        errs.append("extra['prefix_radix'] missing or not a dict (the "
+                    "radix prefix-cache A/B is CPU-runnable — emit "
+                    "error/skipped entries rather than dropping it)")
+    elif "error" not in pr and "skipped_reason" not in pr:
+        if not isinstance(pr.get("platform"), str):
+            errs.append("extra['prefix_radix'] has no 'platform' label")
+        if pr.get("token_parity") is not True:
+            errs.append("prefix_radix.token_parity must be True — the "
+                        "radix tree changed decoded tokens")
+        if pr.get("sync_parity") is not True:
+            errs.append("prefix_radix.sync_parity must be True — the "
+                        "radix tree added a host sync")
+        hit = pr.get("hit_token_frac")
+        if not _is_num(hit) or not (0 <= hit <= 1):
+            errs.append("prefix_radix.hit_token_frac must be a number "
+                        "in [0, 1] (prefix hit tokens / prompt tokens)")
+        saved = pr.get("flops_saved_frac")
+        if not _is_num(saved) or not (0 <= saved <= 1):
+            errs.append("prefix_radix.flops_saved_frac must be a number "
+                        "in [0, 1] (follow-up prefill FLOPs saved)")
+        fork = pr.get("fork_prefix_hit_tokens")
+        if not _is_num(fork) or fork <= 0:
+            errs.append("prefix_radix.fork_prefix_hit_tokens must be "
+                        "> 0 — forked branches shared no pre-fork "
+                        "blocks")
 
     # every measurement dict carries a platform label
     for name, entry in e.items():

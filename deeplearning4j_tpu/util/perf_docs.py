@@ -399,6 +399,39 @@ def _quantized_kv_lines(qk) -> list:
     return [line]
 
 
+def _prefix_radix_lines(pr) -> list:
+    """Radix prefix-cache section from extra['prefix_radix'] (ISSUE 16):
+    the multi-turn/fork session A/B — cross-turn reuse the linear
+    registry structurally cannot deliver, with the parity gates named in
+    the same bullet as the savings."""
+    if not isinstance(pr, dict) or "flops_saved_frac" not in pr:
+        if isinstance(pr, dict) and (pr.get("skipped_reason")
+                                     or pr.get("error")):
+            return [f"- Radix prefix cache: "
+                    f"{pr.get('skipped_reason') or pr.get('error')} "
+                    f"(platform: {pr.get('platform', '?')})."]
+        return []
+    tree = pr.get("tree") or {}
+    return [(
+        f"- Radix prefix cache A/B (ISSUE 16, {pr.get('platform', '?')}): "
+        f"{pr.get('workload', 'seeded session mix')} served radix-on vs "
+        f"radix-off: **{_pct(pr.get('flops_saved_frac'))} of follow-up-"
+        f"turn prefill FLOPs saved** ({pr.get('prefix_hit_tokens', 0):,} "
+        f"prefix hit tokens, {_pct(pr.get('hit_token_frac'))} of all "
+        f"prompt tokens; linear registry managed "
+        f"{pr.get('prefix_hit_tokens_off', 0):,}), follow-up TTFT "
+        f"{pr.get('ttft_followup_mean_ms_on', 0):.1f} vs "
+        f"{pr.get('ttft_followup_mean_ms_off', 0):.1f} ms. Fork branches "
+        f"shared {pr.get('fork_prefix_hit_tokens', 0):,} pre-fork tokens "
+        f"without recompute. Greedy tokens AND host-sync counts "
+        f"**bit-identical** on/off (asserted in-bench). Tree residency: "
+        f"{tree.get('blocks_cached', 0)} retained blocks in "
+        f"{tree.get('nodes', 0)} nodes, "
+        f"{tree.get('overhead_bytes', 0):,} host bytes. "
+        f"`DL4J_TPU_PREFIX_RADIX` — see PERF.md \"Radix prefix cache "
+        f"cost model\".")]
+
+
 def render_block(art: dict) -> str:
     """Markdown bullet block rendered VERBATIM into README.md and PERF.md."""
     e = art["extra"]
@@ -557,6 +590,7 @@ def render_block(art: dict) -> str:
     lines.extend(_kv_lifecycle_lines(e.get("kv_lifecycle")))
     lines.extend(_blame_attribution_lines(e.get("blame_attribution")))
     lines.extend(_quantized_kv_lines(e.get("quantized_kv")))
+    lines.extend(_prefix_radix_lines(e.get("prefix_radix")))
     lines.extend(_roofline_table_lines(e.get("roofline_table")))
     lines.append(
         f"- ParallelWrapper ResNet50: {pw['images_per_sec']:,.0f} img/s — "

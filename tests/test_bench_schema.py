@@ -111,6 +111,11 @@ def _minimal_art():
                 "capacity_probe": {"pool_byte_budget": 36864,
                                    "resident_seqs_max_float": 2,
                                    "resident_seqs_max_quant": 12}},
+            "prefix_radix": {
+                "platform": "cpu", "token_parity": True,
+                "sync_parity": True, "hit_token_frac": 0.77,
+                "flops_saved_frac": 0.88, "prefix_hit_tokens": 3120,
+                "fork_prefix_hit_tokens": 320},
             "roofline_table": [
                 {"function": "train_step", "platform": "tpu",
                  "flops": 1e12, "bytes_accessed": 1e9,
@@ -480,6 +485,40 @@ def test_quantized_kv_rules():
     art["extra"]["quantized_kv"] = {"error": "ValueError: boom"}
     assert validate_artifact(art) == []
     art["extra"]["quantized_kv"] = {"platform": "cpu",
+                                    "skipped_reason": "why not"}
+    assert validate_artifact(art) == []
+
+
+def test_prefix_radix_rules():
+    """ISSUE 16: the radix prefix-cache A/B must always exist; a measured
+    entry must prove BOTH in-bench parity assertions held (greedy tokens
+    and host-sync counts), carry sane fractions, and show the fork
+    branch actually shared pre-fork history; errored/skipped exempt."""
+    art = _minimal_art()
+    del art["extra"]["prefix_radix"]
+    assert any("prefix_radix" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["prefix_radix"]["token_parity"] = False
+    assert any("token_parity" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["prefix_radix"]["sync_parity"] = False
+    assert any("sync_parity" in e for e in validate_artifact(art))
+    for frac_key in ("hit_token_frac", "flops_saved_frac"):
+        art = _minimal_art()
+        art["extra"]["prefix_radix"][frac_key] = 1.2
+        assert any(frac_key in e for e in validate_artifact(art))
+        art = _minimal_art()
+        del art["extra"]["prefix_radix"][frac_key]
+        assert any(frac_key in e for e in validate_artifact(art))
+    # a fork that shared nothing means the radix tree didn't do its job
+    art = _minimal_art()
+    art["extra"]["prefix_radix"]["fork_prefix_hit_tokens"] = 0
+    assert any("fork" in e for e in validate_artifact(art))
+    # errored/skipped runs are exempt
+    art = _minimal_art()
+    art["extra"]["prefix_radix"] = {"error": "ValueError: boom"}
+    assert validate_artifact(art) == []
+    art["extra"]["prefix_radix"] = {"platform": "cpu",
                                     "skipped_reason": "why not"}
     assert validate_artifact(art) == []
 

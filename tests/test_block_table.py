@@ -93,9 +93,12 @@ def test_randomized_alloc_free_fork_stress():
     covers (no aliasing across unrelated requests)."""
     rng = random.Random(1234)
     bs = 4
+    # the reference model here IS the linear registry (refcount == slot
+    # mappings); the radix twin with tree retention lives in
+    # tests/test_radix_tree.py::test_randomized_radix_stress_vs_reference
     c = KVCache(n_layers=1, max_seqs=8, max_len=64, n_kv_heads=1,
                 head_dim=2, dtype=jnp.float32, block_size=bs,
-                num_blocks=40, prefix_share=True)
+                num_blocks=40, prefix_share=True, prefix_radix=False)
     families = [[rng.randrange(50) for _ in range(14)] for _ in range(3)]
     live = {}                                     # slot -> prompt tokens
 
@@ -168,9 +171,10 @@ def test_randomized_evict_swap_restore_stress():
     refcounts intact)."""
     rng = random.Random(2024)
     bs = 4
+    # linear-registry reference (see the alloc/free/fork stress note)
     c = KVCache(n_layers=1, max_seqs=6, max_len=64, n_kv_heads=1,
                 head_dim=2, dtype=jnp.float32, block_size=bs,
-                num_blocks=28, prefix_share=True)
+                num_blocks=28, prefix_share=True, prefix_radix=False)
     pool = HostBlockPool(capacity_bytes=1 << 24)
     families = [[rng.randrange(50) for _ in range(14)] for _ in range(3)]
     live, reserved = {}, {}          # slot -> tokens / reserved positions
@@ -296,9 +300,10 @@ def test_heat_attribution_reference_simulator_stress():
     trash-routed write (no mapping change, no touch) changes nothing."""
     rng = random.Random(4321)
     bs = 4
+    # linear-registry reference (see the alloc/free/fork stress note)
     c = KVCache(n_layers=1, max_seqs=8, max_len=64, n_kv_heads=1,
                 head_dim=2, dtype=jnp.float32, block_size=bs,
-                num_blocks=40, prefix_share=True)
+                num_blocks=40, prefix_share=True, prefix_radix=False)
     families = [[rng.randrange(50) for _ in range(14)] for _ in range(3)]
     live = {}                        # slot -> prompt tokens
     reserved = {}                    # slot -> reserved positions
@@ -438,9 +443,10 @@ def test_copy_on_reject_never_mutates_shared_blocks():
     after every acceptor's draft write."""
     rng = random.Random(99)
     bs, S, plen = 4, 6, 12
+    # linear-registry reference (see the alloc/free/fork stress note)
     c = KVCache(n_layers=1, max_seqs=S, max_len=32, n_kv_heads=1,
                 head_dim=2, dtype=jnp.float32, block_size=bs,
-                num_blocks=56, prefix_share=True)
+                num_blocks=56, prefix_share=True, prefix_radix=False)
     prompt = [rng.randrange(50) for _ in range(plen)]
     k_pat = np.arange(plen * 2, dtype=np.float32).reshape(plen, 1, 2)
     v_pat = k_pat + 100.0

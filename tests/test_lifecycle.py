@@ -86,7 +86,9 @@ def test_token_parity_evicted_vs_never_evicted(mode):
     spans = [e["phase"] for r in res for e in r.timeline]
     assert "preempt" in spans
     # drained: the host pool holds nothing and the pool fully recovers
+    # (radix mode retains retired prompt blocks — reclaim before asserting)
     assert eng.lifecycle.host_pool.n_entries == 0
+    getattr(eng.decoder.cache.registry, "reclaim_all", lambda: 0)()
     assert eng.decoder.cache.blocks_free == 9
     eng.shutdown()
 

@@ -242,9 +242,12 @@ def test_randomized_quantized_pool_stress():
     a single stored byte."""
     rng = random.Random(2026)
     bs = 4
+    # linear-registry reference model (refcount == slot mappings); the
+    # radix-retention twin lives in tests/test_radix_tree.py
     c = KVCache(n_layers=1, max_seqs=6, max_len=64, n_kv_heads=1,
                 head_dim=2, dtype=jnp.float32, block_size=bs,
-                num_blocks=28, prefix_share=True, kv_quant=True)
+                num_blocks=28, prefix_share=True, kv_quant=True,
+                prefix_radix=False)
     pool = HostBlockPool(capacity_bytes=1 << 24)
     families = [[rng.randrange(50) for _ in range(14)] for _ in range(3)]
     live, reserved = {}, {}
@@ -514,6 +517,7 @@ def test_quantized_swap_eviction_token_parity():
         + cache.block_overhead_bytes
     assert s["kv_swap_out_bytes"] % blk == 0
     assert eng.lifecycle.host_pool.n_entries == 0    # drained
+    getattr(cache.registry, "reclaim_all", lambda: 0)()   # radix retention
     assert cache.blocks_free == 9
     eng.shutdown()
 

@@ -52,7 +52,12 @@ HOT_PATH_MODULES = sorted(
      # int8 quantization seam (ISSUE 15): kv_quantize/kv_dequantize run
      # inside every jitted cache write and the weight-only matmuls inside
      # every decode step — this module must stay pure device math
-     PKG / "serving" / "quant.py"]
+     PKG / "serving" / "quant.py",
+     # radix prefix tree (ISSUE 16): match/register run at every
+     # admission and reclaim inside the admission-failure path — the
+     # tree is pure host bookkeeping over token ints and block ids, and
+     # must stay that way (it never imports jax)
+     PKG / "serving" / "radix_tree.py"]
     + list((PKG / "telemetry").glob("*.py")))
 
 ANNOTATION = "sync-ok:"
@@ -128,7 +133,9 @@ def test_all_hot_path_modules_exist():
             "kv_observatory.py", "lifecycle.py", "blame.py",
             # ISSUE 15: the int8 quantize/dequantize seam rides inside
             # every jitted cache write and decode matmul
-            "quant.py"} <= names
+            "quant.py",
+            # ISSUE 16: the radix prefix tree runs at every admission
+            "radix_tree.py"} <= names
 
 
 # ------------------------------------------------ scanner self-tests
