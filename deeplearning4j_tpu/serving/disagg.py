@@ -1,0 +1,133 @@
+"""Disaggregated prefill/decode serving (ISSUE 17; DistServe, OSDI'24).
+
+Chunked prefill (PR 9, Sarathi-Serve) bounds prefill/decode
+interference by slicing prompts; disaggregation ELIMINATES it by
+dedicating whole replica-group rows to one phase. `DisaggregatedPolicy`
+splits the group's replicas into PREFILL rows and DECODE rows:
+
+- route: new requests land on a prefill row (prefix affinity -> cohort
+  -> published heat -> least-loaded, all restricted to prefill rows).
+- The prefill row runs admission + prefill (chunked or monolithic) and
+  samples the FIRST token there — TTFT is paid where prefill capacity
+  lives, never behind another request's decode batch.
+- transfer: the finished request's LIVE KV blocks leave the prefill row
+  through the PR 13 gather seam (int8 scales from PR 15 ride along) and
+  restore into a decode row's pool (`ServingEngine._resume_transfer`,
+  the swap-in path re-aimed across replicas); decode continues there
+  bit-identically — replicas share weights, so greedy streams match the
+  colocated run token for token. The decode row is chosen hot-first:
+  published lineage heat through the shared `PersistentPrefixStore`
+  (ISSUE 17 satellite), then resident-prefix match, then least-loaded.
+
+The tradeoff this buys (and the bench_disagg_ab A/B measures): decode
+rows never stall behind prefill dispatches — TPOT tails tighten — at
+the cost of transfer bytes (live blocks x bytes/block over the host
+path) and HALVED per-phase capacity (a TTFT-heavy long-prompt mix
+saturates the lone prefill row while colocated prefills on every row).
+TTFT-heavy and TPOT-heavy mixes therefore pick DIFFERENT winners;
+PERF.md carries the cost model.
+
+Every transfer lands a `kv_transfer` timeline span (bytes, blocks,
+queue depth, wall) and blame cause on BOTH sides, so the PR 14
+conservation invariant closes over disaggregated requests too.
+
+Sync discipline: pure host bookkeeping — no jax import, no device
+access (tests/test_sync_discipline.py scans this module). The device
+work (gather/restore) stays in engine.py where it is counted.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+from deeplearning4j_tpu.serving.policy import ColocatedPolicy
+
+__all__ = ["DisaggregatedPolicy", "resolve_prefill_replicas"]
+
+
+def resolve_prefill_replicas(prefill_replicas=None) -> int:
+    """Constructor resolution of the prefill-row count: explicit
+    argument wins, else a numeric `DL4J_TPU_DISAGG` value, else 1."""
+    if prefill_replicas is None:
+        env = os.environ.get("DL4J_TPU_DISAGG", "")
+        prefill_replicas = int(env) if env.isdigit() and int(env) > 0 else 1
+    return max(1, int(prefill_replicas))
+
+
+class DisaggregatedPolicy(ColocatedPolicy):
+    """Prefill/decode role split over a ShardedServingGroup.
+
+    Rows [0, prefill_replicas) serve PREFILL, the rest DECODE. A group
+    with fewer than 2 replicas cannot split — the policy degrades to
+    colocated behavior (no roles, no transfer), keeping single-replica
+    construction safe."""
+
+    def __init__(self, prefill_replicas: Optional[int] = None, *,
+                 slo=None, ttl: Optional[int] = None,
+                 ttl_s: Optional[float] = None):
+        super().__init__(slo=slo, ttl=ttl, ttl_s=ttl_s)
+        self.prefill_replicas = resolve_prefill_replicas(prefill_replicas)
+        self.prefill: Tuple[int, ...] = ()
+        self.decode: Tuple[int, ...] = ()
+        self._t_rr = 0                  # transfer-target rotation cursor
+
+    def bind(self, n_replicas: int) -> "DisaggregatedPolicy":
+        super().bind(n_replicas)
+        if n_replicas < 2:
+            self.prefill = self.decode = ()
+            return self
+        n_pref = min(self.prefill_replicas, n_replicas - 1)
+        self.prefill = tuple(range(n_pref))
+        self.decode = tuple(range(n_pref, n_replicas))
+        return self
+
+    @property
+    def disaggregated(self) -> bool:
+        return bool(self.prefill and self.decode)
+
+    def role(self, replica: int) -> str:
+        if not self.disaggregated:
+            return "colocated"
+        return "prefill" if replica in self.prefill else "decode"
+
+    # ------------------------------------------------------------ routing
+    def route_candidates(self, fleet_view: dict) -> List[int]:
+        if not self.disaggregated:
+            return super().route_candidates(fleet_view)
+        return list(self.prefill)
+
+    # ----------------------------------------------------------- transfer
+    def transfer(self, finished_prefill_view: dict) -> Optional[int]:
+        """Pick the DECODE row a finished prefill continues on: hottest
+        published lineage first (the row most likely to still hold — or
+        cheaply restore — this prefix), else the row whose registry
+        holds a resident match, else least-loaded with rotation."""
+        if not self.disaggregated:
+            return None
+        cands = [r for r in self.decode
+                 if r != finished_prefill_view.get("src")]
+        if not cands:
+            cands = list(self.decode)
+        tokens = list(finished_prefill_view["tokens"])
+        hot = self._heat_choice(tokens, finished_prefill_view, cands)
+        if hot is not None:
+            return hot
+        regs = finished_prefill_view["registries"]
+        best, best_len = -1, 0
+        for r in cands:
+            matched = regs[r].match(tokens)[0]
+            if matched > best_len:
+                best, best_len = r, matched
+        if best >= 0:
+            return best
+        stats_fn = finished_prefill_view["stats_fn"]
+        order = [cands[(self._t_rr + i) % len(cands)]
+                 for i in range(len(cands))]
+        self._t_rr = (self._t_rr + 1) % len(cands)
+        chosen, chosen_load = order[0], None
+        for r in order:
+            snap = stats_fn(r)
+            load = snap["queue_depth"] + snap["active_slots"]
+            if chosen_load is None or load < chosen_load:
+                chosen, chosen_load = r, load
+        return chosen

@@ -115,6 +115,8 @@ from deeplearning4j_tpu.serving.block_table import chain_digests
 from deeplearning4j_tpu.serving.decode import StackDecoder, one_hot_embedder
 from deeplearning4j_tpu.serving.lifecycle import (resolve_lifecycle,
                                                   resolve_prefix_store)
+from deeplearning4j_tpu.serving.policy import (ColocatedPolicy,
+                                               resolve_radix_ttl)
 from deeplearning4j_tpu.serving.sampler import (Sampler, sample_tokens,
                                                 spec_accept_tokens)
 
@@ -435,6 +437,8 @@ class ServingEngine:
                  kv_quant: Optional[bool] = None,
                  quant_weights: Optional[bool] = None,
                  prefix_radix: Optional[bool] = None,
+                 policy=None,
+                 radix_ttl: Optional[int] = None,
                  name: Optional[str] = None):
         self.decoder = self._build_decoder(net, max_seqs, max_len,
                                            dtype=dtype,
@@ -726,6 +730,21 @@ class ServingEngine:
             # construct wins the hook; digests that replica never saw
             # evict as orphans, which is the desired cold-first order.
             self.prefix_store.evict_policy = cache.registry.store_victim
+        # scheduling policy (ISSUE 17): ONE object consulted at every
+        # scheduling decision point — admission (preempt vs deny-with-
+        # hint), background eviction (radix TTL), and — on a group —
+        # routing and prefill->decode transfer. A bare engine defaults
+        # to ColocatedPolicy, the exact pre-ISSUE-17 inline behavior;
+        # a ShardedServingGroup hands every engine ITS policy instance.
+        self.policy = policy if policy is not None else ColocatedPolicy()
+        self._radix_ttl = resolve_radix_ttl(radix_ttl)
+        # disaggregation seams (ISSUE 17): role is a label the group
+        # stamps ("prefill"/"decode"); _transfer_cb, when set, receives
+        # each freshly-prefilled request so the group can ship its live
+        # KV to a decode replica (ColocatedPolicy leaves both unset and
+        # the hot path is unchanged).
+        self.role = "colocated"
+        self._transfer_cb: Optional[Callable] = None
         self._c_evict_rec = self.metrics.counter(
             "serving.kv.evictions_recompute", "preemptions reclaimed by "
             "freeing blocks and replaying prefill at readmission")
@@ -750,6 +769,24 @@ class ServingEngine:
         self._c_pstore_tokens = self.metrics.counter(
             "serving.prefix_store_tokens", "prompt positions restored from "
             "the persistent prefix store (prefill compute skipped)")
+        self._c_xfer_out = self.metrics.counter(
+            "serving.kv.transfer_out", "finished prefills whose live KV "
+            "left this replica for a decode replica (ISSUE 17)")
+        self._c_xfer_in = self.metrics.counter(
+            "serving.kv.transfer_in", "transferred requests whose live KV "
+            "restored into this replica's pool for decode")
+        self._c_xfer_bytes = self.metrics.counter(
+            "serving.kv.transfer_bytes", "KV bytes migrated across "
+            "replicas by prefill->decode disaggregation")
+        self._c_ttl_expired = self.metrics.counter(
+            "serving.kv.ttl_expired_blocks", "radix-retained prefix blocks "
+            "released by the policy's TTL drain (ISSUE 17 satellite)")
+        self._c_role_pf = self.metrics.counter(
+            "serving.role_prefill_requests", "admissions served while this "
+            "replica held the PREFILL role")
+        self._c_role_dec = self.metrics.counter(
+            "serving.role_decode_requests", "admissions served while this "
+            "replica held the DECODE role (transferred continuations)")
         _tmemory.poll("serving.engine_init", registry=self.metrics)
 
     # ----------------------------------------------- sharding seams (ISSUE 10)
@@ -832,7 +869,12 @@ class ServingEngine:
                         self.lifecycle.host_pool.bytes_used
                         if self.lifecycle is not None else 0),
                     "prefix_store_hits": self._c_pstore_hits.value,
-                    "prefix_store_tokens": self._c_pstore_tokens.value}
+                    "prefix_store_tokens": self._c_pstore_tokens.value,
+                    "kv_transfer_out": self._c_xfer_out.value,
+                    "kv_transfer_in": self._c_xfer_in.value,
+                    "kv_transfer_bytes": self._c_xfer_bytes.value,
+                    "role_prefill_requests": self._c_role_pf.value,
+                    "role_decode_requests": self._c_role_dec.value}
 
     def kv_pool_snapshot(self, include_blocks: bool = True
                          ) -> Dict[str, object]:
@@ -965,17 +1007,30 @@ class ServingEngine:
                         max_new_tokens=req.max_new_tokens,
                         blocks_needed=-(-(plen + req.max_new_tokens) // bs),
                         queue_depth=len(self._queue), retries=act.retries)
-                # REAL eviction (ISSUE 13): when the lifecycle manager is
-                # on and the observatory's plan says preempting residents
-                # would cover this request, do it and retry immediately —
-                # at most one round per request per _admit call (victims
-                # requeue at the back, so the retried admission holds its
-                # reservation and the loop always terminates)
-                if self.lifecycle is not None \
-                        and act.req_id not in evicted_for \
-                        and self._make_room(act):
-                    evicted_for.add(act.req_id)
-                    continue
+                # scheduling-policy consult (ISSUE 17): REAL eviction
+                # (ISSUE 13) moved behind the policy's `admit` decision
+                # point — ColocatedPolicy preserves the plan-then-preempt
+                # behavior exactly (and, with an `slo`, holds preemption
+                # back while the admittee still has TTFT slack). At most
+                # one preemption round per request per _admit call
+                # (victims requeue at the back, so the retried admission
+                # holds its reservation and the loop always terminates).
+                if act.req_id not in evicted_for:
+                    decision = self.policy.admit(
+                        act.req, self._admission_view(act, t_adm0))
+                    if decision.kind == "preempt" \
+                            and self._execute_evictions(decision.victims):
+                        evicted_for.add(act.req_id)
+                        continue
+                    if decision.hint and act.kv_rejection is not None:
+                        # deny-with-hint forensics ride the rejection
+                        # instant: what a reclaim round could free, and
+                        # the backoff after which preemption would fire
+                        act.kv_rejection.setdefault(
+                            "hint_reclaimable_bytes",
+                            decision.hint.get("reclaimable_bytes", 0))
+                        act.kv_rejection["hint_retry_after_s"] = \
+                            decision.hint.get("retry_after_s", 0.0)
                 break
             self._queue.pop(0)
             slot = plan.slot
@@ -1010,11 +1065,21 @@ class ServingEngine:
             self._resident_seqs_max = max(self._resident_seqs_max,
                                           len(self._by_slot))
             self._c_admits.inc()
+            if self.role == "prefill":
+                self._c_role_pf.inc()
+            elif self.role == "decode":
+                self._c_role_dec.inc()
             telemetry.instant("admit", req=act.req_id, slot=slot, plen=plen,
                               retries=act.retries, queued=len(self._queue))
             if act.resume is not None and act.resume["mode"] == "swap":
                 # swap reactivation: restore block bytes, no prefill at all
                 self._resume_swap(act, plan, t_adm0)
+                continue
+            if act.resume is not None and act.resume["mode"] == "transfer":
+                # disaggregated continuation (ISSUE 17): this replica is
+                # the DECODE side — scatter the transferred live KV into
+                # the fresh reservation, no prefill at all
+                self._resume_transfer(act, plan, t_adm0)
                 continue
             if self.prefix_store is not None and act.resume is None:
                 # persistent prefix store (ISSUE 13): restore stored blocks
@@ -1110,6 +1175,7 @@ class ServingEngine:
         if hits:
             self._c_lineage_hits.inc(hits)
         self._offer_prefix_store(act, seq)
+        self._publish_heat(seq)
         if act.resume is not None:
             self._finish_resume(act, t_pf_mono, extras)
             return
@@ -1152,6 +1218,12 @@ class ServingEngine:
             if self._dev_active is not None:
                 self._dev_active = self._dev_active.at[slot].set(False)
             self._retire(slot, "shutdown")  # reason fixed inside
+            return
+        if self._transfer_cb is not None:
+            # disaggregated prefill (ISSUE 17): this replica only
+            # prefills — ship the live KV + first token to the decode
+            # replica the policy picks (the group's callback)
+            self._transfer_out(slot, act, first)
 
     def _prefill_step(self) -> None:
         """Run AT MOST ONE prefill chunk per scheduler iteration (the head
@@ -1332,16 +1404,16 @@ class ServingEngine:
         return list(act.req.tokens) + \
             [int(t) for t in act.resume["tokens"][:-1]]
 
-    def _make_room(self, act: _Active) -> bool:
-        """Try to preempt resident requests so the head-of-queue
-        admission can succeed (lock held). Victim selection is the
-        observatory's `plan_eviction` — the exact scoring the dry-run
-        reports, now acting for real — restricted to DECODE-ACTIVE slots
-        (a mid-prefill slot holds no resumable decode state and is never
-        preempted). Returns True when at least one victim was preempted;
-        the caller retries admission immediately. Victims requeue at the
-        BACK of the queue, so the retried head holds its full reservation
-        and always progresses — no preemption livelock."""
+    def _admission_view(self, act: _Active, t_adm0: float) -> dict:
+        """Pool-pressure view for the policy's `admit` decision point
+        (lock held): the head-of-queue block shortfall, the preemptable
+        slot set — DECODE-ACTIVE slots only (a mid-prefill slot holds no
+        resumable decode state and is never preempted) — the admittee's
+        queue age (the SLO-slack input), and the bytes a reclaim round
+        could free (the deny hint). `snapshot_fn` is LAZY: the pool
+        snapshot is only taken when the policy actually plans victims,
+        so a lifecycle-less engine pays dict arithmetic and nothing
+        else."""
         cache = self.decoder.cache
         req = act.req
         bs = cache.block_size
@@ -1350,17 +1422,32 @@ class ServingEngine:
         if cache.n_free == 0:
             # slot (not block) exhaustion: any one victim frees a slot
             shortfall = max(shortfall, 1)
-        if shortfall <= 0:
-            return False
         eligible = {s for s, a in self._by_slot.items()
                     if self._active_mask[s] and a.n_generated >= 1}
-        if not eligible:
-            return False
-        snap = cache.pool_snapshot(live_positions=self._live_kv_positions())
-        plan = self.lifecycle.plan(snap, shortfall, eligible=eligible)
-        if not plan["evicted"] or not plan["satisfies"]:
-            return False
+        reclaimable = (cache.num_blocks - cache.blocks_free) * (
+            bs * self._kv_bytes_per_pos + self._kv_block_overhead)
+        return {"lifecycle": self.lifecycle,
+                "shortfall": shortfall,
+                "eligible": eligible,
+                "now": t_adm0,
+                "t_submit": act.resume["t_requeue"]
+                if act.resume is not None else act.t_submit,
+                "reclaimable_bytes": reclaimable,
+                "snapshot_fn": lambda: cache.pool_snapshot(
+                    live_positions=self._live_kv_positions())}
+
+    def _execute_evictions(self, plan: dict) -> bool:
+        """Execute a policy preemption plan (lock held). Victim
+        selection was the observatory's `plan_eviction` — the exact
+        scoring the dry-run reports, now acting for real. Returns True
+        when at least one victim was preempted; the caller retries
+        admission immediately. Victims requeue at the BACK of the
+        queue, so the retried head holds its full reservation and
+        always progresses — no preemption livelock."""
+        cache = self.decoder.cache
+        bs = cache.block_size
         bpp = self._kv_bytes_per_pos
+        preempted = False
         for victim in plan["evicted"]:
             slot = victim["slot"]
             a = self._by_slot.get(slot)
@@ -1369,7 +1456,8 @@ class ServingEngine:
             nbytes = victim["blocks_total"] * bs * bpp
             mode = self.lifecycle.choose_mode(victim, nbytes)
             self._preempt(slot, mode, victim)
-        return True
+            preempted = True
+        return preempted
 
     def _preempt(self, slot: int, mode: str, victim: dict) -> None:
         """Preempt the resident request in `slot` under the scheduler
@@ -1532,6 +1620,205 @@ class ServingEngine:
             if self._dev_active is not None:
                 self._dev_active = self._dev_active.at[slot].set(False)
             self._retire(slot, "length")
+
+    def _transfer_out(self, slot: int, act: _Active, first: int) -> None:
+        """Disaggregated hand-off, export side (ISSUE 17): prefill and
+        the first token are done on THIS (prefill-role) replica — gather
+        the request's live KV blocks (int8 scales ride along on a
+        quantized pool, exactly as swap-out), free the slot, and hand
+        the request to the group's transfer callback, which routes it
+        into a decode replica's queue (`_adopt` -> `_resume_transfer`).
+        The gathers are lazy device slices pinned by functional cache
+        updates — dispatches, not syncs; the import side counts the one
+        transfer materialization. Lock held (this engine's only)."""
+        cache = self.decoder.cache
+        bs = cache.block_size
+        self._by_slot.pop(slot)
+        self._active_mask[slot] = False
+        if self._dev_active is not None:
+            self._dev_active = self._dev_active.at[slot].set(False)
+        if self._spec_index is not None:
+            self._spec_index.drop(slot)
+        # live KV = prompt positions only: the first token's KV is
+        # written by its own next decode step on the TARGET replica,
+        # exactly where the colocated run would write it
+        live = len(act.req.tokens)
+        n_live = -(-live // bs)
+        blocks = list(cache._slot_blocks[slot])[:n_live]
+        ks_blk = vs_blk = None
+        if _kvc.is_quantized(cache.state):
+            k_blk, v_blk, ks_blk, vs_blk = _kvc.gather_blocks(
+                cache.state, blocks, with_scales=True)
+        else:
+            k_blk, v_blk = _kvc.gather_blocks(cache.state, blocks)
+        nbytes = n_live * (bs * self._kv_bytes_per_pos
+                           + self._kv_block_overhead)
+        cache.free(slot)
+        now = time.monotonic()
+        act.resume = {"mode": "transfer", "tokens": [first],
+                      "t_requeue": now, "nbytes": nbytes,
+                      "k": k_blk, "v": v_blk,
+                      "k_scale": ks_blk, "v_scale": vs_blk,
+                      "blocks": n_live, "src": self.replica_id}
+        act.n_generated = 0
+        act.prefilled = 0
+        act.shared_len = 0
+        act.slot = -1
+        # a span tiling first-token -> hand-off: the target's "queue"
+        # span starts at this t1, so the ISSUE 14 conservation
+        # invariant stays closed across the migration
+        act.timeline.append({"phase": "kv_transfer", "t0": act.t_first,
+                             "t1": now, "dir": "out", "bytes": nbytes,
+                             "blocks": n_live})
+        self._c_xfer_out.inc()
+        self._c_xfer_bytes.inc(nbytes)
+        self._update_kv_resident()
+        telemetry.instant("kv_transfer_out", req=act.req_id, slot=slot,
+                          bytes=nbytes)
+        # hand off LAST: once adopted, the target engine's scheduler
+        # thread owns `act` — nothing here may touch it after this call
+        self._transfer_cb(act)
+
+    def _adopt(self, act: _Active) -> None:
+        """Accept a transferred request into this replica's queue (the
+        DECODE side of a disaggregated hand-off). Called from the
+        SOURCE replica's scheduler thread; takes only THIS engine's
+        lock, and the group wiring keeps prefill->decode lock order
+        one-directional (decode engines never call into prefill
+        engines), so no lock cycle exists."""
+        with self._work:
+            if self._stop.is_set():
+                # fleet shutting down mid-flight: resolve the future
+                # with what exists rather than strand the client
+                act.fut._set(GenerationResult(
+                    [int(t) for t in act.resume["tokens"]], "shutdown",
+                    len(act.req.tokens), req_id=act.req_id,
+                    timeline=act.timeline))
+                return
+            self._queue.append(act)
+            telemetry.instant("kv_transfer_adopt", req=act.req_id,
+                              queued=len(self._queue))
+            self._work.notify()
+
+    def _resume_transfer(self, act: _Active, plan, t_adm0: float) -> None:
+        """Disaggregated hand-off, import side (ISSUE 17): the freshly
+        admitted row's private blocks get the transferred bytes
+        scattered in (scales too on a quantized pool), device lengths
+        land exactly where the colocated run's post-prefill lengths sit
+        (prompt positions — the first token's KV is written by its own
+        next decode step), and decode continues bit-identically under
+        greedy sampling. Blocks the new admission mapped SHARED
+        (refcount >= 2) are skipped — the registry certifies they hold
+        this exact prefix — as in swap-in. The np.asarray
+        materialization here is THE counted sync of the whole transfer
+        (the export side only dispatched lazy gathers). Lock held."""
+        cache = self.decoder.cache
+        req, slot = act.req, act.slot
+        plen = len(req.tokens)
+        gen = [int(t) for t in act.resume["tokens"]]
+        n = len(gen)               # 1: the prefill-side first token
+        live = plen + n - 1        # == plen
+        nbytes = act.resume["nbytes"]
+        qd = len(self._queue)
+        with telemetry.span("host_sync", what="kv_transfer_in", slot=slot):
+            # sync-ok: transfer-import materialization (disagg path only)
+            k_host = np.asarray(act.resume["k"])
+            # sync-ok: same transfer materialization (one counted sync)
+            v_host = np.asarray(act.resume["v"])
+            scales = None
+            if act.resume["k_scale"] is not None:
+                # sync-ok: int8 scales ride the same counted transfer sync
+                scales = (np.asarray(act.resume["k_scale"]),
+                          # sync-ok: same counted transfer sync
+                          np.asarray(act.resume["v_scale"]))
+        self._c_syncs.inc()
+        row = cache._slot_blocks[slot]
+        bs = cache.block_size
+        lis = [li for li in range(min(len(row), k_host.shape[1]))
+               if li * bs < live and cache.allocator.refcount(row[li]) == 1]
+        if lis:
+            skw = {} if scales is None else {
+                "k_scale": scales[0][:, lis], "v_scale": scales[1][:, lis]}
+            cache.state = _kvc.restore_blocks(
+                cache.state, [row[li] for li in lis],
+                k_host[:, lis], v_host[:, lis], **skw)
+        cache.state = _kvc.set_length(cache.state, slot, live)
+        cache.touch_blocks(slot, 0, live)
+        hits = cache.register_prefix(slot, self._admission_sequence(act))
+        if hits:
+            self._c_lineage_hits.inc(hits)
+        self._publish_heat(list(req.tokens))
+        src = act.resume["src"]
+        t_requeue = act.resume["t_requeue"]
+        act.resume = None
+        act.n_generated = n
+        act.prefilled = plen
+        self._hist = self._hist.at[slot, :n].set(
+            jnp.asarray(np.asarray(gen, np.int32)))  # sync-ok: host list
+        self._last = self._last.at[slot].set(int(gen[-1]))
+        self._active_mask[slot] = True
+        if self._dev_active is not None:
+            self._dev_active = self._dev_active.at[slot].set(True)
+        if self._spec_index is not None:
+            self._spec_index.reset(slot, req.tokens)
+            self._spec_index.extend(slot, gen)
+        now = time.monotonic()
+        act.timeline.append({"phase": "kv_transfer", "t0": t_adm0,
+                             "t1": now, "dir": "in", "blocks": len(lis),
+                             "bytes": nbytes, "src": src,
+                             "queue_depth": qd,
+                             "wall_s": now - t_requeue})
+        self._c_xfer_in.inc()
+        telemetry.instant("kv_transfer_in", req=act.req_id, slot=slot,
+                          src=src, bytes=nbytes)
+        self._update_kv_resident()
+        # backstop: a transferred request wanted >= 2 tokens (1-token
+        # requests retire on the prefill side) — but retire cleanly
+        if n >= req.max_new_tokens or (req.eos_id is not None
+                                       and gen[-1] == req.eos_id):
+            self._active_mask[slot] = False
+            if self._dev_active is not None:
+                self._dev_active = self._dev_active.at[slot].set(False)
+            self._retire(slot, "length")
+
+    def _publish_heat(self, seq: List[int]) -> None:
+        """Publish this replica's lineage heat on the group-shared
+        store's routing bus (ISSUE 17 satellite): one increment per
+        full prompt-block digest, read back by the policies'
+        `_heat_choice` routing stage. Host dict arithmetic only — a
+        bare engine (replica_id None) or a store without the bus skips
+        in two attribute reads."""
+        store = self.prefix_store
+        if store is None or self.replica_id is None \
+                or not hasattr(store, "publish_heat"):
+            return
+        bs = self.decoder.cache.block_size
+        if len(seq) < bs:
+            return
+        for d in chain_digests(seq, bs):
+            store.publish_heat(d, self.replica_id)
+
+    def _policy_evict(self) -> None:
+        """Background-eviction decision point (ISSUE 17), consulted
+        once per scheduler iteration between the heat tick and
+        admission: ColocatedPolicy drains radix-retained prefix blocks
+        whose lineage went cold past the TTL (ISSUE 17 satellite).
+        Zero-cost when no TTL is armed anywhere — the common case
+        short-circuits on attribute reads. Lock held."""
+        pol = self.policy
+        if self._radix_ttl is None and getattr(pol, "ttl", None) is None \
+                and getattr(pol, "ttl_s", None) is None:
+            return
+        cache = self.decoder.cache
+        reg = getattr(cache, "registry", None)
+        if reg is None or not getattr(reg, "is_radix", False):
+            return
+        freed = pol.evict({"registry": reg,
+                           "clock": cache.allocator.clock,
+                           "now": time.monotonic(),
+                           "ttl": self._radix_ttl})
+        if freed:
+            self._c_ttl_expired.inc(freed)
 
     def _restore_from_store(self, act: _Active, plan, shared: int) -> int:
         """Extend the resident registry's shared coverage with blocks
@@ -1757,6 +2044,7 @@ class ServingEngine:
             # heat clock: one tick per scheduler iteration (a host int —
             # the unit every block heat stamp is expressed in)
             self.decoder.cache.allocator.tick()
+            self._policy_evict()
             self._admit()
             if not self._by_slot:
                 return bool(self._queue)

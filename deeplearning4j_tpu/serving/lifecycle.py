@@ -178,6 +178,36 @@ class PersistentPrefixStore:
         # device-pool reclaim and store eviction, replacing the store's
         # private recency order.
         self.evict_policy: Optional[Callable[..., Optional[bytes]]] = None
+        # cross-replica heat bus (ISSUE 17 satellite): per-digest,
+        # per-replica publication counts — replicas stamp the lineages
+        # they prefill, the router's prefix affinity reads them. A
+        # routing HINT, not content: ephemeral (never spilled to npz)
+        # and unguarded like the entry dict (GIL-atomic dict ops; the
+        # engines already share this object across replica threads).
+        self._heat: Dict[bytes, Dict[int, float]] = {}
+
+    # ----------------------------------------------- cross-replica heat
+    def publish_heat(self, digest: bytes, replica: int,
+                     inc: float = 1.0) -> None:
+        """Record that `replica` just served (prefilled or restored) the
+        lineage block addressed by `digest`."""
+        per = self._heat.setdefault(digest, {})
+        # sync-ok: inc is a host float (heat increments, never a buffer)
+        per[int(replica)] = per.get(int(replica), 0.0) + float(inc)
+
+    def route_heat(self, digests: Sequence[bytes]) -> Dict[int, float]:
+        """Accumulated published heat per replica over the LEADING
+        digests of a prompt's chain (stops at the first digest no
+        replica ever published — a longer match never hides behind a
+        gap). The router picks the max; empty dict = no signal."""
+        out: Dict[int, float] = {}
+        for d in digests:
+            per = self._heat.get(d)
+            if not per:
+                break
+            for r, h in per.items():
+                out[r] = out.get(r, 0.0) + h
+        return out
 
     # ------------------------------------------------------------ lookup
     def covered(self, digests: Sequence[bytes]) -> int:

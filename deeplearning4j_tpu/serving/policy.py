@@ -1,0 +1,302 @@
+"""Pluggable scheduler policy (ISSUE 17): ONE interface for every
+scheduling decision the serving stack makes.
+
+Before this module, the four scheduling decisions lived as if-chains
+spread across three files: routing in `ShardedServingGroup._route`
+(sharding.py), preempt-or-wait in `ServingEngine._make_room`
+(engine.py), radix reclaim inside `KVCache.admit` (kv_cache.py), and no
+transfer decision at all. `SchedulingPolicy` names them as four
+decision points consulted by the engine/group at the exact places the
+if-chains used to run:
+
+- ``route(request, fleet_view) -> (replica, reason)`` — which replica a
+  new request lands on (group scope).
+- ``admit(request, pool_view) -> AdmissionDecision`` — what to do when
+  the head-of-queue block reservation FAILS: keep waiting
+  (``deny_with_hint``, carrying the forensics hint = reclaimable bytes +
+  suggested retry), or ``preempt`` residents (the lifecycle plan rides
+  the decision), or ``accept`` = retry immediately with no action.
+- ``evict(pressure_view) -> int`` — background cache-pressure /
+  idle-drain work, consulted once per scheduler iteration; this is
+  where the radix TTL (ISSUE 17 satellite) lives: retained prefix
+  blocks whose lineage went cold for longer than ``ttl`` allocator
+  ticks (or ``ttl_s`` wall seconds) are released, so an idle fleet
+  drains its cached-prefix bytes without admission pressure.
+- ``transfer(finished_prefill_view) -> replica | None`` — where a
+  just-prefilled request should DECODE. `ColocatedPolicy` returns None
+  (decode where prefill ran); `DisaggregatedPolicy` (serving/disagg.py)
+  returns a decode-role replica and the engine ships the live KV there.
+
+`ColocatedPolicy` re-expresses the existing behaviors EXACTLY (the
+refactor is behavior-preserving by test): resident-prefix affinity ->
+cohort -> least-loaded routing (PR 10), plan-then-preempt under KV
+exhaustion (PR 13), radix reclaim + the new TTL (PR 16/17). The one
+addition every policy shares is published-heat affinity (ISSUE 17
+satellite): when no replica holds a RESIDENT matching prefix, the
+router consults the lineage heat replicas publish through the shared
+`PersistentPrefixStore` — a replica that recently served this lineage
+(bytes restorable from the store, tree possibly still warm) beats a
+colder least-loaded one.
+
+Views are plain dicts built by the engine/group from host bookkeeping
+it already holds — consulting a policy adds zero device syncs. Routing
+state (cohort map, round-robin cursors) lives ON the policy instance:
+one policy object serves one group for its lifetime.
+
+Sync discipline: pure host bookkeeping — no jax import, no device
+access (tests/test_sync_discipline.py scans this module).
+"""
+from __future__ import annotations
+
+import os
+from collections import OrderedDict
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from deeplearning4j_tpu.serving.block_table import chain_digests
+
+__all__ = [
+    "AdmissionDecision", "SchedulingPolicy", "ColocatedPolicy",
+    "resolve_policy", "resolve_radix_ttl",
+]
+
+
+def resolve_radix_ttl(ttl=None) -> Optional[int]:
+    """Constructor resolution of the radix-retention TTL knob
+    (allocator ticks = scheduler iterations): explicit argument wins,
+    else `DL4J_TPU_RADIX_TTL` (empty/0 = no TTL — retained blocks live
+    until pressure reclaim, the pre-ISSUE-17 behavior)."""
+    if ttl is None:
+        env = os.environ.get("DL4J_TPU_RADIX_TTL", "")
+        ttl = int(env) if env not in ("", "0", "off") else 0
+    ttl = int(ttl)
+    return ttl if ttl > 0 else None
+
+
+@dataclass
+class AdmissionDecision:
+    """Outcome of the ``admit`` decision point.
+
+    kind = "accept": retry the reservation next iteration, no action.
+    kind = "deny_with_hint": keep the request queued; `hint` carries
+        the forensics the caller files on the rejection record —
+        ``reclaimable_bytes`` (pool bytes a reclaim/preemption round
+        could free) and ``retry_after_s`` (suggested client backoff:
+        the admittee's remaining SLO slack, after which the policy
+        would escalate to preemption).
+    kind = "preempt": `victims` is the lifecycle eviction plan
+        (telemetry.kv_observatory.plan_eviction shape) the engine
+        executes, then retries the reservation immediately.
+    """
+    kind: str
+    victims: Optional[dict] = None
+    hint: Optional[dict] = None
+
+    @classmethod
+    def accept(cls) -> "AdmissionDecision":
+        return cls("accept")
+
+    @classmethod
+    def deny(cls, hint: Optional[dict] = None) -> "AdmissionDecision":
+        return cls("deny_with_hint", hint=hint)
+
+    @classmethod
+    def preempt(cls, plan: dict) -> "AdmissionDecision":
+        return cls("preempt", victims=plan)
+
+
+class SchedulingPolicy:
+    """Base interface. Subclasses override the four decision points;
+    the defaults are the no-op choices (route round-robin-less to 0,
+    deny on pressure, no eviction, no transfer) so a minimal custom
+    policy only implements what it cares about."""
+
+    def bind(self, n_replicas: int) -> "SchedulingPolicy":
+        """Called once by the group that adopts this policy, before any
+        routing. Default: record the fleet size."""
+        self.n_replicas = int(n_replicas)
+        return self
+
+    def role(self, replica: int) -> str:
+        """Replica role label: "colocated" (prefill AND decode),
+        "prefill", or "decode"."""
+        return "colocated"
+
+    # ---------------------------------------------------- decision points
+    def route(self, request, fleet_view: dict) -> Tuple[int, str]:
+        return 0, "static"
+
+    def admit(self, request, pool_view: dict) -> AdmissionDecision:
+        return AdmissionDecision.deny()
+
+    def evict(self, pressure_view: dict) -> int:
+        return 0
+
+    def transfer(self, finished_prefill_view: dict) -> Optional[int]:
+        return None
+
+
+class ColocatedPolicy(SchedulingPolicy):
+    """The default policy: every replica both prefills and decodes.
+
+    Re-expresses the pre-ISSUE-17 inline behaviors:
+
+    * route — resident-prefix affinity (the replica whose registry
+      holds the longest matching RESIDENT prefix) -> cohort affinity
+      (prompts sharing a leading block follow the first of their kind)
+      -> published-heat affinity (ISSUE 17 satellite; skipped when the
+      group has no shared store or nothing was published) ->
+      least-loaded with a rotating round-robin tie-break.
+    * admit — with no lifecycle manager: deny (wait in FIFO order).
+      With one: plan victims via `lifecycle.plan` and preempt when the
+      plan satisfies the shortfall — UNLESS an `slo` was given and the
+      admittee still has TTFT slack (`SLO.slack_s`), in which case the
+      cheap choice is deny-with-hint and preemption is saved for
+      requests about to blow their budget (ISSUE 17 satellite: the
+      PR 13 eviction-aware-admission leftover).
+    * evict — radix TTL drain: release retained prefix blocks whose
+      node went untouched for > ttl allocator ticks / ttl_s seconds.
+    """
+
+    _COHORT_CAP = 4096      # FIFO bound on the cohort-affinity map
+
+    def __init__(self, *, slo=None, ttl: Optional[int] = None,
+                 ttl_s: Optional[float] = None):
+        self.slo = slo
+        self.ttl = resolve_radix_ttl(ttl)
+        self.ttl_s = ttl_s
+        self.n_replicas = 1
+        self._cohorts: "OrderedDict[tuple, int]" = OrderedDict()
+        self._rr = 0
+
+    # ------------------------------------------------------------ routing
+    def route_candidates(self, fleet_view: dict) -> List[int]:
+        """Replicas a NEW request may land on (disagg narrows this to
+        the prefill rows)."""
+        return list(range(fleet_view["n"]))
+
+    def _heat_choice(self, tokens: List[int], fleet_view: dict,
+                     cands: List[int]) -> Optional[int]:
+        """Hottest replica by published lineage heat (shared-store bus),
+        restricted to `cands`. None when nothing was published."""
+        store = fleet_view.get("store")
+        bs = fleet_view["block_size"]
+        if store is None or len(tokens) < bs \
+                or not hasattr(store, "route_heat"):
+            return None
+        heat = store.route_heat(chain_digests(tokens, bs))
+        heat = {r: h for r, h in heat.items() if r in cands and h > 0}
+        if not heat:
+            return None
+        # deterministic: max heat, lowest replica index breaking ties
+        return min(sorted(heat), key=lambda r: -heat[r])
+
+    def route(self, request, fleet_view: dict) -> Tuple[int, str]:
+        tokens = list(request.tokens)
+        cands = self.route_candidates(fleet_view)
+        regs = fleet_view["registries"]
+        best, best_len = -1, 0
+        for r in cands:
+            matched = regs[r].match(tokens)[0]
+            if matched > best_len:
+                best, best_len = r, matched
+        if best >= 0:
+            return best, "prefix_affinity"
+        bs = fleet_view["block_size"]
+        cohort = tuple(tokens[:bs]) if len(tokens) > bs else None
+        if cohort is not None and cohort in self._cohorts:
+            chosen = self._cohorts[cohort]
+            if chosen in cands:
+                self._cohorts.move_to_end(cohort)
+                return chosen, "cohort"
+            del self._cohorts[cohort]   # stale entry from another role set
+        hot = self._heat_choice(tokens, fleet_view, cands)
+        if hot is not None:
+            self._remember_cohort(cohort, hot)
+            return hot, "heat"
+        chosen = self._least_loaded(fleet_view, cands)
+        self._remember_cohort(cohort, chosen)
+        return chosen, "least_loaded"
+
+    def _least_loaded(self, fleet_view: dict, cands: List[int]) -> int:
+        stats_fn = fleet_view["stats_fn"]
+        order = [cands[(self._rr + i) % len(cands)]
+                 for i in range(len(cands))]
+        self._rr = (self._rr + 1) % len(cands)
+        chosen, chosen_load = order[0], None
+        for r in order:
+            snap = stats_fn(r)
+            load = snap["queue_depth"] + snap["active_slots"]
+            if chosen_load is None or load < chosen_load:
+                chosen, chosen_load = r, load
+        return chosen
+
+    def _remember_cohort(self, cohort, replica: int) -> None:
+        if cohort is None:
+            return
+        self._cohorts[cohort] = replica
+        while len(self._cohorts) > self._COHORT_CAP:
+            self._cohorts.popitem(last=False)
+
+    # ---------------------------------------------------------- admission
+    def admit(self, request, pool_view: dict) -> AdmissionDecision:
+        lifecycle = pool_view.get("lifecycle")
+        hint = {"reclaimable_bytes": pool_view.get("reclaimable_bytes", 0),
+                "retry_after_s": 0.0}
+        if lifecycle is None:
+            return AdmissionDecision.deny(hint)
+        if self.slo is not None:
+            waited = pool_view["now"] - pool_view["t_submit"]
+            slack = self.slo.slack_s(waited)
+            if slack > 0:
+                # the admittee can still make its TTFT budget by waiting
+                # for a natural retirement — deny is the cheap branch;
+                # escalate to preemption once the slack is gone
+                hint["retry_after_s"] = slack
+                return AdmissionDecision.deny(hint)
+        shortfall = pool_view["shortfall"]
+        eligible = pool_view["eligible"]
+        if shortfall <= 0 or not eligible:
+            return AdmissionDecision.deny(hint)
+        plan = lifecycle.plan(pool_view["snapshot_fn"](), shortfall,
+                              eligible=eligible)
+        if not plan["evicted"] or not plan["satisfies"]:
+            return AdmissionDecision.deny(hint)
+        return AdmissionDecision.preempt(plan)
+
+    # ----------------------------------------------------------- eviction
+    def evict(self, pressure_view: dict) -> int:
+        reg = pressure_view.get("registry")
+        ttl = self.ttl if self.ttl is not None else pressure_view.get("ttl")
+        ttl_s = self.ttl_s if self.ttl_s is not None \
+            else pressure_view.get("ttl_s")
+        if reg is None or not hasattr(reg, "expire") \
+                or (ttl is None and ttl_s is None):
+            return 0
+        return reg.expire(ttl, ttl_s=ttl_s,
+                          clock=pressure_view.get("clock"),
+                          now=pressure_view.get("now"))
+
+
+def resolve_policy(policy=None, *, slo=None) -> SchedulingPolicy:
+    """Constructor resolution of the group/engine policy knob: an
+    instance passes through; "colocated"/"disagg" name the built-ins;
+    None consults `DL4J_TPU_DISAGG` (empty/0/off = colocated; a
+    positive integer = disaggregated with that many PREFILL rows)."""
+    if policy is None:
+        env = os.environ.get("DL4J_TPU_DISAGG", "")
+        if env not in ("", "0", "off"):
+            from deeplearning4j_tpu.serving.disagg import DisaggregatedPolicy
+            n_pref = int(env) if env.isdigit() else 1
+            return DisaggregatedPolicy(prefill_replicas=max(1, n_pref),
+                                       slo=slo)
+        return ColocatedPolicy(slo=slo)
+    if isinstance(policy, str):
+        if policy == "colocated":
+            return ColocatedPolicy(slo=slo)
+        if policy == "disagg":
+            from deeplearning4j_tpu.serving.disagg import DisaggregatedPolicy
+            return DisaggregatedPolicy(slo=slo)
+        raise ValueError(f"unknown scheduling policy {policy!r} "
+                         "(expected 'colocated', 'disagg', or an instance)")
+    return policy

@@ -60,6 +60,7 @@ integers.
 from __future__ import annotations
 
 import os
+import time
 import weakref
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -89,7 +90,7 @@ class _Node:
     into tail digests without re-hashing the whole prefix)."""
 
     __slots__ = ("parent", "tok_blocks", "phys", "digests", "hobjs",
-                 "children", "last_touch", "hits")
+                 "children", "last_touch", "last_touch_wall", "hits")
 
     def __init__(self, parent: Optional["_Node"]):
         self.parent = parent
@@ -99,6 +100,9 @@ class _Node:
         self.hobjs: List[object] = []
         self.children: Dict[_BlockKey, "_Node"] = {}
         self.last_touch = 0
+        # wall-clock twin of last_touch (ISSUE 17): the TTL expiry knob
+        # can be expressed in seconds as well as allocator ticks
+        self.last_touch_wall = time.monotonic()
         self.hits = 0
 
     def depth(self) -> int:
@@ -187,6 +191,7 @@ class RadixPrefixTree:
             blocks.append(node.phys[j])
             h = node.hobjs[j]
             node.last_touch = clock
+            node.last_touch_wall = time.monotonic()
             i += 1
             j += 1
         if i == n_full:
@@ -249,6 +254,7 @@ class RadixPrefixTree:
                 hx = node.digests[j].hex()
                 self._lineage_hits[hx] = self._lineage_hits.get(hx, 0) + 1
             node.last_touch = clock
+            node.last_touch_wall = time.monotonic()
             j += 1
         tail = tokens[n_full * bs:]
         if tail:
@@ -283,6 +289,7 @@ class RadixPrefixTree:
         child.hobjs = node.hobjs[j:]
         child.children = node.children
         child.last_touch = node.last_touch
+        child.last_touch_wall = node.last_touch_wall
         child.hits = node.hits
         for c in child.children.values():
             c.parent = child
@@ -383,6 +390,37 @@ class RadixPrefixTree:
             if freed >= n_blocks:
                 break
             if self.release(b):
+                freed += 1
+        return freed
+
+    def expire(self, ttl: Optional[int] = None, *,
+               ttl_s: Optional[float] = None,
+               clock: Optional[int] = None,
+               now: Optional[float] = None) -> int:
+        """TTL drain (ISSUE 17 satellite): release every retained block
+        whose owning node went UNTOUCHED for more than `ttl` allocator
+        ticks (scheduler iterations) — and/or `ttl_s` wall-clock
+        seconds — so an idle fleet eventually returns its cached-prefix
+        bytes to the free list without admission pressure. A block is
+        expired when ANY enabled dimension exceeds its budget; blocks a
+        slot still maps (refcount > 1) are never touched, and a node
+        re-stamped by match()/register() heat survives. Returns the
+        number of blocks freed."""
+        if self._pool is None or not self._retained \
+                or (ttl is None and ttl_s is None):
+            return 0
+        alloc = self._pool_obj().allocator
+        clk = alloc.clock if clock is None else clock
+        wall = time.monotonic() if now is None else now
+        freed = 0
+        for b in list(self._retained):
+            ent = self._by_block.get(b)
+            if ent is None or alloc.refcount(b) != 1:
+                continue
+            node, _j = ent
+            stale = (ttl is not None and clk - node.last_touch > ttl) or \
+                (ttl_s is not None and wall - node.last_touch_wall > ttl_s)
+            if stale and self.release(b):
                 freed += 1
         return freed
 

@@ -39,7 +39,6 @@ from __future__ import annotations
 import os
 import re
 import threading
-from collections import OrderedDict
 from concurrent.futures import ThreadPoolExecutor
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -62,6 +61,7 @@ from deeplearning4j_tpu.serving.decode import (StackDecoder,
 from deeplearning4j_tpu.serving.engine import Request, ServingEngine
 from deeplearning4j_tpu.serving.kv_cache import resolve_block_size
 from deeplearning4j_tpu.serving.lifecycle import resolve_prefix_store
+from deeplearning4j_tpu.serving.policy import resolve_policy
 
 __all__ = [
     "match_partition_rules", "make_shard_and_gather_fns", "named_tree_map",
@@ -93,6 +93,10 @@ GROUP_SUMMED_KEYS: Tuple[str, ...] = (
     "prefix_store_hits", "prefix_store_tokens",
     # ISSUE 16: radix-tree residency + popular-prefix signal, fleet-wide
     "prefix_lineage_hits", "kv_blocks_cached",
+    # ISSUE 17: disaggregated prefill/decode — cross-replica KV
+    # migration volume and the per-role admission split
+    "kv_transfer_out", "kv_transfer_in", "kv_transfer_bytes",
+    "role_prefill_requests", "role_decode_requests",
     # ISSUE 14: group snapshot_seq = per-replica scheduler-iteration
     # counters summed — still strictly monotonic while any replica steps,
     # so scrapers can detect stale/torn fleet snapshots the same way
@@ -455,18 +459,21 @@ class ShardedServingGroup:
     child registry is parented here, so the process /metrics exposition
     aggregates the fleet while per-replica stats stay isolated).
 
-    Routing order (under the group lock, host-only — zero device syncs):
-    1. prefix affinity — the replica whose PrefixRegistry already holds
-       the longest matching resident prefix (read-only match(); COW
-       prefix hits then happen inside that replica's own pool);
-    2. cohort affinity — prompts whose leading KV block matches a prompt
-       routed earlier follow it, so a cohort's FIRST prompt seeds the
-       registry the rest will hit (without this, upfront submissions of
-       identical prompts would scatter and forfeit sharing);
-    3. least-loaded (queue_depth + active_slots from stats()) with a
-       rotating round-robin tie-break."""
-
-    _COHORT_CAP = 4096      # FIFO bound on the cohort-affinity map
+    Scheduling decisions live on ONE policy object (ISSUE 17,
+    serving/policy.py), consulted under the group lock (host-only —
+    zero device syncs). The default `ColocatedPolicy` routes exactly as
+    the group always did: prefix affinity (the replica whose
+    PrefixRegistry already holds the longest matching resident prefix)
+    -> cohort affinity (prompts sharing a leading KV block follow the
+    first of their kind, so a cohort's FIRST prompt seeds the registry
+    the rest will hit) -> published-heat affinity (ISSUE 17 satellite:
+    lineage heat replicas publish through the shared prefix store) ->
+    least-loaded (queue_depth + active_slots) with a rotating
+    round-robin tie-break. `DisaggregatedPolicy` (serving/disagg.py,
+    or env DL4J_TPU_DISAGG=<n>) splits the replicas into PREFILL and
+    DECODE roles: new requests route to prefill rows only, and each
+    finished prefill's live KV ships to a decode row through the
+    engines' transfer seam (`_transfer_from`)."""
 
     def __init__(self, net, max_seqs: int, max_len: int, *,
                  replicas: Optional[int] = None, tp: Optional[int] = None,
@@ -488,6 +495,13 @@ class ShardedServingGroup:
         self._c_affinity = self.metrics.counter(
             "serving.router_prefix_affinity", "requests routed to a replica "
             "because its registry already held a matching resident prefix")
+        self._c_heat = self.metrics.counter(
+            "serving.router_heat_affinity", "requests routed to a replica "
+            "by published lineage heat (no resident match anywhere, but "
+            "this replica recently served the prefix — ISSUE 17)")
+        self._c_transfers = self.metrics.counter(
+            "serving.router_transfers", "finished prefills handed from a "
+            "prefill-role replica to a decode-role replica (ISSUE 17)")
         # fleet KV gauges (ISSUE 12): group-level names are disjoint from
         # the per-engine serving.kv.* observatory gauges, so the parented
         # prometheus exposition shows both layers without double counting
@@ -527,6 +541,12 @@ class ShardedServingGroup:
         # own from the environment
         self.prefix_store = resolve_prefix_store(
             engine_kw.pop("prefix_store", None))
+        # ONE scheduling-policy object for the whole group (ISSUE 17):
+        # routing state (cohort map, rotation cursors) lives on it, and
+        # every engine consults the SAME instance at its own decision
+        # points (admission, TTL eviction)
+        self.policy = resolve_policy(engine_kw.pop("policy", None)) \
+            .bind(self.replicas)
         self.engines: List[ShardedServingEngine] = []
         base_name = engine_kw.pop("name", None) or "replica"
         for r, submesh in enumerate(replica_submeshes(self.mesh,
@@ -537,16 +557,22 @@ class ShardedServingGroup:
                 metrics_parent=self.metrics,
                 prefix_registry=self.registries[r],
                 prefix_store=self.prefix_store,
+                policy=self.policy,
                 name=f"{base_name}{r}",
                 **engine_kw)
             # replica identity (ISSUE 14 satellite): labels the engine's
             # tracer track and flight-recorder records so multi-replica
             # Perfetto dumps are distinguishable
             eng.replica_id = r
+            # disaggregation wiring (ISSUE 17): prefill-role engines get
+            # the transfer callback that ships each finished prefill's
+            # live KV to the decode row the policy picks
+            eng.role = self.policy.role(r)
+            if eng.role == "prefill":
+                eng._transfer_cb = \
+                    lambda act, _r=r: self._transfer_from(_r, act)
             self.engines.append(eng)
         self._lock = threading.Lock()
-        self._rr = 0
-        self._cohorts: "OrderedDict[tuple, int]" = OrderedDict()
         # replicas are independent chips: drive them CONCURRENTLY per
         # step() so one replica's chunk dispatch never serializes behind
         # another's (each engine is only ever stepped by one worker at a
@@ -559,36 +585,42 @@ class ShardedServingGroup:
             if workers > 1 else None)
 
     # ------------------------------------------------------------ routing
+    def _fleet_view(self) -> Dict[str, object]:
+        """The host-bookkeeping view the policy's route/transfer
+        decisions read. `stats_fn` is lazy (one engine-lock snapshot
+        per replica the policy actually inspects), so affinity hits
+        never pay a stats() sweep — exactly the pre-policy behavior."""
+        return {"registries": self.registries,
+                "block_size": self.registries[0].block_size,
+                "n": self.replicas,
+                "store": self.prefix_store,
+                "stats_fn": lambda r: self.engines[r].stats()}
+
     def _route(self, req: Request) -> int:
-        tokens = list(req.tokens)
-        best, best_len = -1, 0
-        for r, reg in enumerate(self.registries):
-            matched = reg.match(tokens)[0]
-            if matched > best_len:
-                best, best_len = r, matched
-        if best >= 0:
+        replica, reason = self.policy.route(req, self._fleet_view())
+        if reason == "prefix_affinity":
             self._c_affinity.inc()
-            return best
-        block_size = self.registries[0].block_size
-        cohort = tuple(tokens[:block_size]) if len(tokens) > block_size \
-            else None
-        if cohort is not None and cohort in self._cohorts:
-            self._cohorts.move_to_end(cohort)
-            return self._cohorts[cohort]
-        order = [(self._rr + i) % self.replicas
-                 for i in range(self.replicas)]
-        self._rr = (self._rr + 1) % self.replicas
-        chosen, chosen_load = order[0], None
-        for r in order:
-            snap = self.engines[r].stats()
-            load = snap["queue_depth"] + snap["active_slots"]
-            if chosen_load is None or load < chosen_load:
-                chosen, chosen_load = r, load
-        if cohort is not None:
-            self._cohorts[cohort] = chosen
-            while len(self._cohorts) > self._COHORT_CAP:
-                self._cohorts.popitem(last=False)
-        return chosen
+        elif reason == "heat":
+            self._c_heat.inc()
+        return replica
+
+    def _transfer_from(self, src: int, act) -> None:
+        """Prefill->decode hand-off (ISSUE 17), called from the SOURCE
+        engine's scheduler thread with that engine's lock held: consult
+        the policy for the decode target and adopt the request there.
+        Deliberately takes NO group lock — the only lock acquired is
+        the TARGET engine's (`_adopt`), keeping lock order prefill ->
+        decode, one-directional (decode engines never call into prefill
+        engines), so no cycle with submit's group-lock -> engine-lock
+        path exists."""
+        view = self._fleet_view()
+        view["tokens"] = list(act.req.tokens)
+        view["src"] = src
+        target = self.policy.transfer(view)
+        self._c_transfers.inc()
+        # target is always a decode row when the callback is wired; the
+        # src fallback is a safety net (src engine's RLock re-enters)
+        self.engines[src if target is None else target]._adopt(act)
 
     # --------------------------------------------------- engine-shaped API
     def submit(self, request):
@@ -647,6 +679,10 @@ class ShardedServingGroup:
             "replicas": self.replicas, "tp": self.tp,
             "router_requests": self._c_routed.value,
             "router_prefix_affinity": self._c_affinity.value,
+            "router_heat_affinity": self._c_heat.value,
+            "router_transfers": self._c_transfers.value,
+            "policy": type(self.policy).__name__,
+            "roles": [self.policy.role(r) for r in range(self.replicas)],
             "per_replica": per,
         }
         for key in GROUP_SUMMED_KEYS:

@@ -27,6 +27,11 @@ cause                                   charged for
 ``preempt_recompute``                   recompute-mode preemption spans +
                                         resumed re-prefill
 ``preempt_swap_io``                     swap-mode preemption + swap-in
+``kv_transfer``                         disaggregated prefill->decode KV
+                                        migration: the export gather on
+                                        the prefill replica and the
+                                        import restore on the decode
+                                        replica (ISSUE 17)
 ``scheduler_other``                     admission bookkeeping and any
                                         residual scheduler gap
 ======================================  =================================
@@ -75,6 +80,7 @@ CAUSES: Tuple[str, ...] = (
     "jit_compile",
     "preempt_recompute",
     "preempt_swap_io",
+    "kv_transfer",
     "scheduler_other",
 )
 
@@ -114,6 +120,8 @@ def event_cause(ev: dict) -> str:
             else "preempt_recompute"
     if ph == "swap_in":
         return "preempt_swap_io"
+    if ph == "kv_transfer":
+        return "kv_transfer"
     if ph == "retire":
         return "host_sync"
     return "scheduler_other"

@@ -41,6 +41,15 @@ class SLO:
     def describe(self) -> str:
         return f"ttft<={self.ttft_s:.3g}s, tpot<={self.tpot_s:.3g}s"
 
+    def slack_s(self, waited_s: float) -> float:
+        """Remaining TTFT budget after `waited_s` seconds in queue —
+        the signal eviction-aware admission (serving/policy.py,
+        ISSUE 17) keys deny-with-hint vs preempt off: positive slack
+        means the request can still attain by waiting, zero/negative
+        means only preemption can save it."""
+        # sync-ok: waited_s is a host wall-clock difference
+        return self.ttft_s - float(waited_s)
+
 
 #: finish reasons that count as a completed (servable) request
 _OK_REASONS = ("eos", "length")

@@ -49,7 +49,14 @@ holds at least as many resident sequences). ISSUE 16 adds
 multi-turn/fork session mix — CPU-runnable and always present;
 measured entries must prove token_parity=True AND sync_parity=True, a
 hit_token_frac and flops_saved_frac in [0, 1], and
-fork_prefix_hit_tokens > 0).
+fork_prefix_hit_tokens > 0). ISSUE 17 adds `serving_disagg_ab` (the
+disaggregated prefill/decode A/B on the same seeded schedules —
+CPU-runnable and always present; measured entries must prove
+token_parity=True, carry BOTH mixes (ttft_heavy + tpot_heavy) with
+colocated/disagg sides and a winner each, a boolean different_winners
+headline — reported honestly whichever way it lands — and a transfer
+block with positive migrated bytes, else the disagg side never
+actually disaggregated).
 bench.py calls
 `assert_valid` on the dict it is about to print, and
 tests/test_bench_schema.py re-validates the committed artifact, so the
@@ -502,6 +509,59 @@ def validate_artifact(art: dict) -> List[str]:
             errs.append("prefix_radix.fork_prefix_hit_tokens must be "
                         "> 0 — forked branches shared no pre-fork "
                         "blocks")
+
+    # disaggregated prefill/decode A/B (ISSUE 17): CPU-runnable on forced
+    # host devices, so always present; when measured the parity gate must
+    # have held (a disagg run that drifts from colocated tokens is a
+    # broken transfer seam, not a data point), both workload mixes must be
+    # present with both sides' goodput and a declared winner, the
+    # different-winners headline must be an explicit boolean (an honest
+    # "False" beats a silently dropped mix), and the transfer block must
+    # show KV bytes actually migrated
+    da = e.get("serving_disagg_ab")
+    if not isinstance(da, dict):
+        errs.append("extra['serving_disagg_ab'] missing or not a dict "
+                    "(the disagg A/B runs on forced host devices — emit "
+                    "error/skipped entries rather than dropping it)")
+    elif "error" not in da and "skipped_reason" not in da:
+        if not isinstance(da.get("platform"), str):
+            errs.append("extra['serving_disagg_ab'] has no 'platform' "
+                        "label")
+        if da.get("token_parity") is not True:
+            errs.append("serving_disagg_ab.token_parity must be True — "
+                        "the disagg group drifted from the colocated "
+                        "greedy token stream")
+        if not isinstance(da.get("different_winners"), bool):
+            errs.append("serving_disagg_ab.different_winners must be an "
+                        "explicit boolean (disclose the loss rather than "
+                        "omitting the claim)")
+        mixes = da.get("mixes")
+        if not isinstance(mixes, dict):
+            errs.append("serving_disagg_ab.mixes missing or not a dict")
+        else:
+            for mix in ("ttft_heavy", "tpot_heavy"):
+                row = mixes.get(mix)
+                if not isinstance(row, dict):
+                    errs.append(f"serving_disagg_ab.mixes.{mix} missing "
+                                "or not a dict (both mixes must run)")
+                    continue
+                if row.get("winner") not in ("colocated", "disagg",
+                                             "tie"):
+                    errs.append(f"serving_disagg_ab.mixes.{mix}.winner "
+                                "must be 'colocated', 'disagg', or 'tie'")
+                for side in ("colocated", "disagg"):
+                    s = row.get(side)
+                    if not isinstance(s, dict) or not all(
+                            _is_num(s.get(k)) for k in
+                            ("goodput", "ttft_p99_s")):
+                        errs.append(f"serving_disagg_ab.mixes.{mix}."
+                                    f"{side} must carry numeric goodput/"
+                                    "ttft_p99_s")
+        tr = da.get("transfer")
+        if not isinstance(tr, dict) or not _is_num(tr.get("bytes")) \
+                or tr.get("bytes", 0) <= 0:
+            errs.append("serving_disagg_ab.transfer.bytes missing or "
+                        "<= 0 — the disagg side never migrated any KV")
 
     # every measurement dict carries a platform label
     for name, entry in e.items():

@@ -116,6 +116,24 @@ def _minimal_art():
                 "sync_parity": True, "hit_token_frac": 0.77,
                 "flops_saved_frac": 0.88, "prefix_hit_tokens": 3120,
                 "fork_prefix_hit_tokens": 320},
+            "serving_disagg_ab": {
+                "platform": "cpu", "token_parity": True,
+                "different_winners": True,
+                "transfer": {"requests": 6, "bytes": 49152,
+                             "bytes_per_request": 8192},
+                "mixes": {
+                    "ttft_heavy": {
+                        "winner": "colocated",
+                        "colocated": {"goodput": 20.0,
+                                      "ttft_p99_s": 0.05},
+                        "disagg": {"goodput": 12.0,
+                                   "ttft_p99_s": 0.09}},
+                    "tpot_heavy": {
+                        "winner": "disagg",
+                        "colocated": {"goodput": 8.0,
+                                      "ttft_p99_s": 0.04},
+                        "disagg": {"goodput": 11.0,
+                                   "ttft_p99_s": 0.05}}}},
             "roofline_table": [
                 {"function": "train_step", "platform": "tpu",
                  "flops": 1e12, "bytes_accessed": 1e9,
@@ -520,6 +538,47 @@ def test_prefix_radix_rules():
     assert validate_artifact(art) == []
     art["extra"]["prefix_radix"] = {"platform": "cpu",
                                     "skipped_reason": "why not"}
+    assert validate_artifact(art) == []
+
+
+def test_serving_disagg_ab_rules():
+    """ISSUE 17: the disagg A/B must always exist; a measured entry must
+    prove token parity held, state the different-winners headline as an
+    explicit boolean (an honest False beats a dropped mix), carry BOTH
+    mixes with per-side goodput/TTFT and a winner each, and show KV
+    actually migrated; errored/skipped exempt."""
+    art = _minimal_art()
+    del art["extra"]["serving_disagg_ab"]
+    assert any("serving_disagg_ab" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["serving_disagg_ab"]["token_parity"] = False
+    assert any("token_parity" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["serving_disagg_ab"]["different_winners"] = "yes"
+    assert any("different_winners" in e for e in validate_artifact(art))
+    for mix in ("ttft_heavy", "tpot_heavy"):
+        art = _minimal_art()
+        del art["extra"]["serving_disagg_ab"]["mixes"][mix]
+        assert any(mix in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["serving_disagg_ab"]["mixes"]["ttft_heavy"]["winner"] = \
+        "both"
+    assert any("winner" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["serving_disagg_ab"]["mixes"]["tpot_heavy"][
+        "disagg"]["goodput"]
+    assert any("tpot_heavy" in e and "goodput" in e
+               for e in validate_artifact(art))
+    # zero transferred bytes means the disagg side never disaggregated
+    art = _minimal_art()
+    art["extra"]["serving_disagg_ab"]["transfer"]["bytes"] = 0
+    assert any("transfer" in e for e in validate_artifact(art))
+    # errored/skipped runs are exempt
+    art = _minimal_art()
+    art["extra"]["serving_disagg_ab"] = {"error": "ValueError: boom"}
+    assert validate_artifact(art) == []
+    art["extra"]["serving_disagg_ab"] = {"platform": "cpu",
+                                         "skipped_reason": "1 device"}
     assert validate_artifact(art) == []
 
 

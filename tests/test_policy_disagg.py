@@ -1,0 +1,426 @@
+"""Scheduler-policy subsystem + disaggregated prefill/decode (ISSUE 17).
+
+The load-bearing guarantees:
+
+- PARITY: a 2-replica disaggregated group (prefill row 0, decode row 1)
+  produces bit-identical greedy token streams to the colocated group and
+  the single engine on the same prompts — the live KV transfer
+  round-trips exactly (incl. int8 scales), and the first token's KV is
+  rewritten by its own decode step exactly where the colocated run
+  writes it.
+- CONSERVATION: every disaggregated request's blame entry still closes
+  (cause seconds sum to latency) with the new `kv_transfer` cause
+  strictly positive — the hand-off tiles the timeline, never hides in
+  it.
+- POLICY: `ColocatedPolicy` reproduces the legacy routing order
+  (prefix affinity -> cohort -> heat -> least-loaded) and the legacy
+  plan-then-preempt admission; with an SLO it denies-with-hint while
+  the admittee still has TTFT slack and escalates to preemption only
+  after.
+- TTL: radix-retained prefix blocks survive while their lineage stays
+  hot and drain once cold for longer than the TTL (ticks or wall).
+"""
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving import Request, ServingEngine
+from deeplearning4j_tpu.serving.disagg import (DisaggregatedPolicy,
+                                               resolve_prefill_replicas)
+from deeplearning4j_tpu.serving.lifecycle import PersistentPrefixStore
+from deeplearning4j_tpu.serving.policy import (AdmissionDecision,
+                                               ColocatedPolicy,
+                                               SchedulingPolicy,
+                                               resolve_policy,
+                                               resolve_radix_ttl)
+from deeplearning4j_tpu.serving.sharding import ShardedServingGroup
+from deeplearning4j_tpu.telemetry import blame
+from deeplearning4j_tpu.telemetry.slo import SLO
+
+from tests.test_serving import _build_net
+
+PROMPTS = [[1, 2, 3, 4, 5], [5, 4, 3], [2, 2, 7, 1], [9, 8, 7, 6, 5, 4]]
+
+
+def _tokens(results):
+    return [r.tokens for r in results]
+
+
+def _engine(net, **kw):
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("seed", 3)
+    kw.setdefault("decode_chunk", 1)
+    kw.setdefault("overlap", False)
+    kw.setdefault("kv_block", 4)
+    kw.setdefault("prefix_share", True)
+    return ServingEngine(net, **kw)
+
+
+# ------------------------------------------------------ resolution knobs
+def test_resolve_policy_env_and_names(monkeypatch):
+    assert isinstance(resolve_policy(None), ColocatedPolicy)
+    assert not isinstance(resolve_policy(None), DisaggregatedPolicy)
+    assert isinstance(resolve_policy("disagg"), DisaggregatedPolicy)
+    inst = ColocatedPolicy()
+    assert resolve_policy(inst) is inst       # instance passes through
+    monkeypatch.setenv("DL4J_TPU_DISAGG", "2")
+    pol = resolve_policy(None)
+    assert isinstance(pol, DisaggregatedPolicy)
+    assert pol.prefill_replicas == 2
+    assert resolve_prefill_replicas(None) == 2
+    monkeypatch.setenv("DL4J_TPU_DISAGG", "0")
+    assert isinstance(resolve_policy(None), ColocatedPolicy)
+    with pytest.raises(ValueError, match="unknown scheduling policy"):
+        resolve_policy("nope")
+
+
+def test_resolve_radix_ttl(monkeypatch):
+    assert resolve_radix_ttl(None) is None
+    assert resolve_radix_ttl(7) == 7
+    monkeypatch.setenv("DL4J_TPU_RADIX_TTL", "5")
+    assert resolve_radix_ttl(None) == 5
+    assert resolve_radix_ttl(2) == 2          # explicit beats env
+    monkeypatch.setenv("DL4J_TPU_RADIX_TTL", "0")
+    assert resolve_radix_ttl(None) is None
+
+
+def test_disagg_bind_roles_and_degenerate():
+    pol = DisaggregatedPolicy(prefill_replicas=1).bind(4)
+    assert pol.prefill == (0,) and pol.decode == (1, 2, 3)
+    assert pol.disaggregated
+    assert [pol.role(r) for r in range(4)] == \
+        ["prefill", "decode", "decode", "decode"]
+    # more prefill rows than replicas-1: clamped so decode is never empty
+    wide = DisaggregatedPolicy(prefill_replicas=9).bind(3)
+    assert wide.prefill == (0, 1) and wide.decode == (2,)
+    # a 1-replica group cannot split: degrade to colocated, no transfer
+    solo = DisaggregatedPolicy().bind(1)
+    assert not solo.disaggregated
+    assert solo.role(0) == "colocated"
+    assert solo.transfer({"tokens": [1, 2], "src": 0}) is None
+
+
+# --------------------------------------------------------- routing units
+class _FakeReg:
+    """match()-shaped stand-in: returns a preset resident match length."""
+
+    def __init__(self, matched=0):
+        self.matched = matched
+
+    def match(self, tokens):
+        return min(self.matched, len(tokens)), []
+
+
+def _view(regs, loads, store=None, bs=4):
+    stats = [{"queue_depth": q, "active_slots": a} for q, a in loads]
+    return {"registries": regs, "block_size": bs, "n": len(regs),
+            "store": store, "stats_fn": lambda r: stats[r]}
+
+
+def test_route_prefix_affinity_beats_load():
+    pol = ColocatedPolicy().bind(2)
+    view = _view([_FakeReg(0), _FakeReg(4)], [(0, 0), (9, 9)])
+    assert pol.route(Request([1, 2, 3, 4, 5, 6]), view) == \
+        (1, "prefix_affinity")
+
+
+def test_route_cohort_follows_first_then_least_loaded():
+    pol = ColocatedPolicy().bind(2)
+    regs = [_FakeReg(0), _FakeReg(0)]
+    # first of the cohort: no resident match anywhere -> least-loaded
+    r0, why0 = pol.route(Request([1, 2, 3, 4, 5, 6]),
+                         _view(regs, [(3, 1), (0, 0)]))
+    assert (r0, why0) == (1, "least_loaded")
+    # same leading block follows it even when loads now favor replica 0
+    r1, why1 = pol.route(Request([1, 2, 3, 4, 9, 9]),
+                         _view(regs, [(0, 0), (5, 5)]))
+    assert (r1, why1) == (1, "cohort")
+
+
+def test_route_heat_beats_least_loaded():
+    """ISSUE 17 satellite: with no resident match and no cohort, the
+    replica with published lineage heat wins over a colder less-loaded
+    one — heat rides the group-shared PersistentPrefixStore."""
+    from deeplearning4j_tpu.serving.block_table import chain_digests
+    store = PersistentPrefixStore(capacity_bytes=1 << 20)
+    prompt = [1, 2, 3, 4, 5, 6]
+    for d in chain_digests(prompt, 4):
+        store.publish_heat(d, 1)
+    pol = ColocatedPolicy().bind(2)
+    view = _view([_FakeReg(0), _FakeReg(0)], [(0, 0), (9, 9)], store=store)
+    assert pol.route(Request(list(prompt)), view) == (1, "heat")
+    # heat over the leading digests only: an unpublished FIRST block
+    # means no heat signal at all
+    cold = pol._heat_choice([7, 7, 7, 7, 1, 2], view, [0, 1])
+    assert cold is None
+    # transfer target selection reads the same bus
+    dis = DisaggregatedPolicy(prefill_replicas=1).bind(3)
+    tview = _view([_FakeReg(0)] * 3, [(0, 0), (9, 9), (0, 0)], store=store)
+    tview.update(tokens=list(prompt), src=0)
+    assert dis.transfer(tview) == 1           # hot decode row beats cold
+
+
+def test_disagg_routes_new_requests_to_prefill_rows_only():
+    pol = DisaggregatedPolicy(prefill_replicas=1).bind(3)
+    # even with a resident match on a DECODE row, new requests must land
+    # on a prefill row (decode rows never run prefill)
+    view = _view([_FakeReg(0), _FakeReg(4), _FakeReg(0)],
+                 [(5, 5), (0, 0), (0, 0)])
+    replica, why = pol.route(Request([1, 2, 3, 4, 5, 6]), view)
+    assert replica == 0 and why == "least_loaded"
+
+
+# ------------------------------------------------------- admission units
+def test_admit_denies_without_lifecycle_and_preempts_with_plan():
+    pol = ColocatedPolicy()
+    dec = pol.admit(Request([1, 2]), {"lifecycle": None,
+                                      "reclaimable_bytes": 128})
+    assert dec.kind == "deny_with_hint"
+    assert dec.hint["reclaimable_bytes"] == 128
+
+    class _Life:
+        def plan(self, snap, shortfall, eligible=None):
+            return {"evicted": [{"slot": 0}], "satisfies": True}
+
+    view = {"lifecycle": _Life(), "shortfall": 2, "eligible": {0},
+            "now": 10.0, "t_submit": 9.0, "reclaimable_bytes": 0,
+            "snapshot_fn": lambda: {}}
+    assert pol.admit(Request([1, 2]), view).kind == "preempt"
+    # same pressure, but the admittee still has TTFT slack: deny + hint
+    slow = ColocatedPolicy(slo=SLO(ttft_s=100.0, tpot_s=1.0))
+    dec = slow.admit(Request([1, 2]), view)
+    assert dec.kind == "deny_with_hint"
+    assert dec.hint["retry_after_s"] == pytest.approx(99.0)
+    # slack exhausted: escalate to preemption
+    tight = ColocatedPolicy(slo=SLO(ttft_s=0.5, tpot_s=1.0))
+    assert tight.admit(Request([1, 2]), view).kind == "preempt"
+    assert AdmissionDecision.accept().kind == "accept"
+
+
+def test_engine_slo_slack_holds_preemption_back():
+    """ISSUE 17 satellite (the PR 13 leftover), deny branch: under KV
+    exhaustion with a lifecycle manager armed, a policy whose SLO still
+    has slack chooses deny-with-hint — zero preemptions, requests wait
+    in FIFO order for natural retirements, and the rejection record
+    carries the hint forensics."""
+    net = _build_net(n_kv=2)
+    ref = _engine(net).generate([Request(list(p), max_new_tokens=10)
+                                 for p in PROMPTS])
+    eng = _engine(net, kv_blocks=9, kv_evict="lru", kv_swap_bytes=1 << 24,
+                  policy=ColocatedPolicy(slo=SLO(ttft_s=1e9, tpot_s=1e9)))
+    res = eng.generate([Request(list(p), max_new_tokens=10)
+                        for p in PROMPTS])
+    assert _tokens(res) == _tokens(ref)
+    assert eng.stats()["kv_preemptions"] == 0       # slack held it back
+    rejs = [e for r in res for e in r.timeline
+            if e["phase"] == "kv_rejection"]
+    assert rejs, "exhaustion must have produced a rejection record"
+    assert all("hint_retry_after_s" in e
+               and e["hint_reclaimable_bytes"] > 0 for e in rejs)
+    eng.shutdown()
+
+
+def test_engine_slo_slack_exhausted_preempts():
+    """Preempt branch: a zero-TTFT SLO means every blocked admittee is
+    already out of slack — the policy escalates immediately and behaves
+    exactly like the legacy always-preempt path (token parity incl.)"""
+    net = _build_net(n_kv=2)
+    ref = _engine(net).generate([Request(list(p), max_new_tokens=10)
+                                 for p in PROMPTS])
+    eng = _engine(net, kv_blocks=9, kv_evict="lru", kv_swap_bytes=1 << 24,
+                  policy=ColocatedPolicy(slo=SLO(ttft_s=0.0, tpot_s=1e9)))
+    res = eng.generate([Request(list(p), max_new_tokens=10)
+                        for p in PROMPTS])
+    assert _tokens(res) == _tokens(ref)
+    assert eng.stats()["kv_preemptions"] > 0
+    eng.shutdown()
+
+
+# ---------------------------------------------------------- radix TTL
+def test_radix_ttl_expires_cold_retained_blocks():
+    net = _build_net(n_kv=2)
+    eng = _engine(net, prefix_radix=True, radix_ttl=3)
+    eng.generate([Request([1, 2, 3, 4, 5, 6, 7, 8], max_new_tokens=4)])
+    assert eng.stats()["kv_blocks_cached"] > 0      # retained after retire
+    for _ in range(6):                              # cold: ticks past TTL
+        eng.step()
+    assert eng.stats()["kv_blocks_cached"] == 0
+    assert eng.metrics.get("serving.kv.ttl_expired_blocks").value > 0
+    eng.shutdown()
+
+
+def test_radix_ttl_survives_under_heat():
+    """Retained blocks whose lineage keeps matching stay resident: each
+    re-serve restamps the nodes, so a hot prefix outlives any number of
+    TTL windows while traffic recurs within the TTL."""
+    net = _build_net(n_kv=2)
+    eng = _engine(net, prefix_radix=True, radix_ttl=4)
+    prompt = [1, 2, 3, 4, 5, 6, 7, 8]
+    for _ in range(4):                              # re-serve inside TTL
+        eng.generate([Request(list(prompt), max_new_tokens=2)])
+        eng.step()                                  # one cold tick only
+        assert eng.stats()["kv_blocks_cached"] > 0
+    for _ in range(7):                              # now go cold
+        eng.step()
+    assert eng.stats()["kv_blocks_cached"] == 0
+    eng.shutdown()
+
+
+def test_radix_ttl_wall_clock_variant():
+    net = _build_net(n_kv=2)
+    eng = _engine(net, prefix_radix=True,
+                  policy=ColocatedPolicy(ttl_s=1e-9))
+    eng.generate([Request([1, 2, 3, 4, 5, 6, 7, 8], max_new_tokens=4)])
+    eng.step()                                      # any wall delta > ttl_s
+    assert eng.stats()["kv_blocks_cached"] == 0
+    eng.shutdown()
+
+
+def test_radix_expire_ignores_live_blocks():
+    """expire() must never release a block a resident slot still maps
+    (refcount > 1): TTL drains RETAINED-only lineage, not live KV."""
+    net = _build_net(n_kv=2)
+    eng = _engine(net, prefix_radix=True, radix_ttl=1)
+    f = eng.submit(Request([1, 2, 3, 4, 5, 6, 7, 8], max_new_tokens=8))
+    for _ in range(4):                              # mid-generation ticks
+        eng.step()
+    assert eng.decoder.cache.blocks_free < eng.decoder.cache.num_blocks
+    eng.drain()
+    f.get(timeout=0)
+    eng.shutdown()
+
+
+# ----------------------------------------------- disaggregated serving
+@pytest.mark.parametrize("kv_quant", [False, True])
+def test_disagg_token_parity_and_transfer_flow(forced_host_devices,
+                                               kv_quant):
+    """The acceptance bar: greedy token streams are bit-identical
+    disagg-vs-colocated-vs-single (int8 KV pools included — the scales
+    ride the transfer), every request flows prefill-row -> decode-row,
+    and the transfer volume is visible in the fleet stats."""
+    net = _build_net(n_kv=2)
+    kw = dict(dtype="float64", kv_quant=kv_quant)
+    ref = ServingEngine(net, 4, 64, **kw).generate(PROMPTS,
+                                                   max_new_tokens=8)
+    col = ShardedServingGroup(net, 4, 64, replicas=2, tp=1, **kw)
+    got_c = col.generate(PROMPTS, max_new_tokens=8)
+    dis = ShardedServingGroup(net, 4, 64, replicas=2, tp=1,
+                              policy="disagg", **kw)
+    got_d = dis.generate(PROMPTS, max_new_tokens=8)
+    assert _tokens(got_c) == _tokens(ref)
+    assert _tokens(got_d) == _tokens(ref)
+    st = dis.stats()
+    assert st["policy"] == "DisaggregatedPolicy"
+    assert st["roles"] == ["prefill", "decode"]
+    assert st["kv_transfer_out"] == len(PROMPTS)
+    assert st["kv_transfer_in"] == len(PROMPTS)
+    assert st["router_transfers"] == len(PROMPTS)
+    assert st["kv_transfer_bytes"] > 0
+    assert st["role_prefill_requests"] == len(PROMPTS)
+    assert st["role_decode_requests"] == len(PROMPTS)
+    # per-role split: replica 0 never decodes a transfer in, replica 1
+    # never exports one
+    pf, dec = st["per_replica"]
+    assert pf["kv_transfer_out"] == len(PROMPTS) and \
+        pf["kv_transfer_in"] == 0
+    assert dec["kv_transfer_in"] == len(PROMPTS) and \
+        dec["kv_transfer_out"] == 0
+    col.shutdown()
+    dis.shutdown()
+
+
+def test_disagg_blame_conservation_and_kv_transfer_cause(
+        forced_host_devices):
+    """ISSUE 14 invariant across the migration: every disaggregated
+    request's blame entry closes exactly, with a strictly positive
+    `kv_transfer` cause (both hand-off spans map to it) and gap-free
+    coverage from submit to retire."""
+    net = _build_net(n_kv=2)
+    dis = ShardedServingGroup(net, 4, 64, dtype="float64", replicas=2,
+                              tp=1, policy="disagg")
+    res = dis.generate(PROMPTS, max_new_tokens=8)
+    for r in res:
+        entry = blame.blame_timeline(r.timeline, req_id=r.req_id)
+        blame.assert_conserved(entry)
+        assert entry["causes"].get("kv_transfer", 0.0) > 0.0
+        phases = [e["phase"] for e in r.timeline]
+        assert phases.count("kv_transfer") == 2   # out + in
+        out = next(e for e in r.timeline
+                   if e["phase"] == "kv_transfer" and e["dir"] == "out")
+        inn = next(e for e in r.timeline
+                   if e["phase"] == "kv_transfer" and e["dir"] == "in")
+        assert out["bytes"] == inn["bytes"] > 0
+        assert inn["src"] == 0 and inn["wall_s"] >= 0.0
+    ledger = blame.build_ledger(res)
+    assert ledger["conserved"]
+    assert ledger["totals"]["kv_transfer"] > 0.0
+    dis.shutdown()
+
+
+def test_disagg_midstream_submission_parity(forced_host_devices):
+    """Requests arriving while decode rows are mid-stream still match
+    the colocated run token-for-token (greedy)."""
+    net = _build_net(n_kv=2)
+
+    def drive(grp):
+        f0 = grp.submit(Request([1, 2, 3, 4, 5, 6, 7], max_new_tokens=12))
+        for _ in range(3):
+            grp.step()
+        f1 = grp.submit(Request([3, 1, 4, 1, 5], max_new_tokens=6))
+        grp.drain()
+        out = [f0.get(timeout=0).tokens, f1.get(timeout=0).tokens]
+        grp.shutdown()
+        return out
+
+    kw = dict(dtype="float64", replicas=2, tp=1, overlap=False)
+    ref = drive(ShardedServingGroup(net, 4, 64, **kw))
+    got = drive(ShardedServingGroup(net, 4, 64, policy="disagg", **kw))
+    assert got == ref
+
+
+def test_disagg_single_token_requests_retire_on_prefill_row(
+        forced_host_devices):
+    """max_new_tokens=1 finishes at the first token — no transfer is
+    ever exported for it."""
+    net = _build_net(n_kv=2)
+    dis = ShardedServingGroup(net, 4, 64, dtype="float64", replicas=2,
+                              tp=1, policy="disagg")
+    res = dis.generate(PROMPTS, max_new_tokens=1)
+    assert all(len(r.tokens) == 1 for r in res)
+    st = dis.stats()
+    assert st["kv_transfer_out"] == 0
+    assert st["role_prefill_requests"] == len(PROMPTS)
+    assert st["role_decode_requests"] == 0
+    dis.shutdown()
+
+
+def test_disagg_env_knob_selects_policy(forced_host_devices, monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_DISAGG", "1")
+    net = _build_net(n_kv=2)
+    grp = ShardedServingGroup(net, 4, 64, dtype="float64", replicas=2,
+                              tp=1)
+    assert grp.stats()["roles"] == ["prefill", "decode"]
+    res = grp.generate(PROMPTS[:2], max_new_tokens=4)
+    assert grp.stats()["kv_transfer_out"] == 2
+    assert all(len(r.tokens) == 4 for r in res)
+    grp.shutdown()
+
+
+def test_custom_policy_minimal_subclass(forced_host_devices):
+    """The subsystem is pluggable: a minimal SchedulingPolicy that only
+    overrides route() drives the group (admission falls back to the
+    base deny = legacy FIFO wait)."""
+
+    class PinToZero(SchedulingPolicy):
+        def route(self, request, fleet_view):
+            return 0, "pinned"
+
+    net = _build_net(n_kv=2)
+    grp = ShardedServingGroup(net, 4, 64, dtype="float64", replicas=2,
+                              tp=1, policy=PinToZero())
+    grp.generate(PROMPTS, max_new_tokens=4)
+    per = grp.stats()["per_replica"]
+    assert per[0]["tokens_out"] > 0 and per[1]["tokens_out"] == 0
+    grp.shutdown()

@@ -235,6 +235,12 @@ def test_group_stats_aggregates_pinned_keys(forced_host_devices):
             "kv_preemptions", "kv_swap_out_bytes", "kv_swap_in_bytes",
             "kv_host_pool_bytes", "prefix_store_hits",
             "prefix_store_tokens"} <= set(GROUP_SUMMED_KEYS)
+    # ISSUE 17: disaggregation transfer volume + role split ride the same
+    # pinned list (colocated group: all zero, but the keys must aggregate)
+    assert {"kv_transfer_out", "kv_transfer_in", "kv_transfer_bytes",
+            "role_prefill_requests",
+            "role_decode_requests"} <= set(GROUP_SUMMED_KEYS)
+    assert st["kv_transfer_out"] == 0 and st["kv_transfer_bytes"] == 0
     # lifecycle off in this group: every lifecycle counter sums to zero
     assert st["kv_preemptions"] == 0 and st["kv_host_pool_bytes"] == 0
 

@@ -57,7 +57,14 @@ HOT_PATH_MODULES = sorted(
      # admission and reclaim inside the admission-failure path — the
      # tree is pure host bookkeeping over token ints and block ids, and
      # must stay that way (it never imports jax)
-     PKG / "serving" / "radix_tree.py"]
+     PKG / "serving" / "radix_tree.py",
+     # scheduling policy + disaggregation (ISSUE 17): consulted at every
+     # routing/admission decision and once per scheduler iteration
+     # (evict) — the views are host dicts and the decisions pure host
+     # bookkeeping; neither module may ever import jax or read a device
+     # buffer (the gather/restore device work stays in engine.py)
+     PKG / "serving" / "policy.py",
+     PKG / "serving" / "disagg.py"]
     + list((PKG / "telemetry").glob("*.py")))
 
 ANNOTATION = "sync-ok:"
@@ -135,7 +142,10 @@ def test_all_hot_path_modules_exist():
             # every jitted cache write and decode matmul
             "quant.py",
             # ISSUE 16: the radix prefix tree runs at every admission
-            "radix_tree.py"} <= names
+            "radix_tree.py",
+            # ISSUE 17: the policy subsystem runs at every scheduling
+            # decision point and must stay pure host bookkeeping
+            "policy.py", "disagg.py"} <= names
 
 
 # ------------------------------------------------ scanner self-tests
