@@ -433,6 +433,9 @@ class ServingEngine:
                  kv_evict=None,
                  kv_swap_bytes: Optional[int] = None,
                  kv_evict_mode: str = "auto",
+                 kv_disk=None,
+                 kv_disk_bytes: Optional[int] = None,
+                 kv_swap_async: Optional[bool] = None,
                  prefix_store=None,
                  kv_quant: Optional[bool] = None,
                  quant_weights: Optional[bool] = None,
@@ -696,7 +699,23 @@ class ServingEngine:
         # the no-pressure sync sequence is bit-identical (parity-tested).
         self.lifecycle = resolve_lifecycle(kv_evict, kv_swap_bytes,
                                            kv_evict_mode,
-                                           flops_per_token=2.0 * n_params)
+                                           flops_per_token=2.0 * n_params,
+                                           kv_disk=kv_disk,
+                                           kv_disk_bytes=kv_disk_bytes)
+        # async swap-out (ISSUE 18): preemption dispatches the gather and
+        # DEFERS the history readback + payload materialization to the
+        # next chunk boundary (_harvest_swaps) instead of stalling the
+        # scheduler mid-pressure. On by default; kv_swap_async=False (or
+        # DL4J_TPU_KV_SWAP_ASYNC=0) restores the synchronous preempt —
+        # the bench A/B baseline.
+        if kv_swap_async is None:
+            kv_swap_async = os.environ.get(
+                "DL4J_TPU_KV_SWAP_ASYNC", "1") not in ("", "0")
+        self.kv_swap_async = bool(kv_swap_async)
+        # swap-preempted victims awaiting their chunk-boundary harvest:
+        # not in _queue, not in _by_slot — limbo entries the harvest
+        # requeues (records carry the pinned lazy hist reference)
+        self._pending_swaps: List[dict] = []
         # persistent prefix store (ISSUE 13): content-addressed host KV
         # block bytes keyed by the registry's chain digests — survives
         # restarts (npz spill) and spans ShardedServingGroup replicas
@@ -730,6 +749,21 @@ class ServingEngine:
             # construct wins the hook; digests that replica never saw
             # evict as orphans, which is the desired cold-first order.
             self.prefix_store.evict_policy = cache.registry.store_victim
+        if self.prefix_store is not None \
+                and getattr(self.prefix_store, "disk", None) is None:
+            # hierarchical spill-through (ISSUE 18): the store's byte-cap
+            # victims demote into the SAME disk tier the lifecycle
+            # manager rebalances into, so one cap governs everything
+            # below host RAM. A store-only engine (no lifecycle) still
+            # gets a tier when the kv_disk knobs are set.
+            if self.lifecycle is not None \
+                    and self.lifecycle.disk_pool is not None:
+                self.prefix_store.disk = self.lifecycle.disk_pool
+            elif self.lifecycle is None:
+                from deeplearning4j_tpu.serving.kv_disk import \
+                    resolve_disk_pool
+                self.prefix_store.disk = resolve_disk_pool(kv_disk,
+                                                           kv_disk_bytes)
         # scheduling policy (ISSUE 17): ONE object consulted at every
         # scheduling decision point — admission (preempt vs deny-with-
         # hint), background eviction (radix TTL), and — on a group —
@@ -787,6 +821,39 @@ class ServingEngine:
         self._c_role_dec = self.metrics.counter(
             "serving.role_decode_requests", "admissions served while this "
             "replica held the DECODE role (transferred continuations)")
+        self._g_disk_pool = self.metrics.gauge(
+            "serving.kv.disk_pool_bytes", "spill-directory bytes currently "
+            "held by the disk tier (swap + prefix-store entries)")
+        self._c_disk_demote = self.metrics.counter(
+            "serving.kv.disk_demotions", "host-pool entries demoted to the "
+            "disk tier under host-RAM pressure")
+        self._c_disk_promote = self.metrics.counter(
+            "serving.kv.disk_promotions", "disk-tier entries promoted back "
+            "through host RAM at swap-in / prefix restore")
+        self._c_swap_harvest = self.metrics.counter(
+            "serving.kv.swap_async_harvests", "async swap-outs whose bytes "
+            "were harvested at a later chunk boundary (deferred syncs)")
+        self._c_swap_lost = self.metrics.counter(
+            "serving.kv.swap_lost", "swap-preempted requests whose payload "
+            "vanished (corrupt spill) and fell back to recompute")
+        self._g_swap_gbps = self.metrics.gauge(
+            "serving.kv.measured_swap_gbps", "measured device<->host swap "
+            "bandwidth in GB/s (init calibration, then the running "
+            "swap-in/harvest average)")
+        if self.lifecycle is not None:
+            # swap-bandwidth calibration (ISSUE 18 satellite): one tiny
+            # warmup gather round-trip replaces DEFAULT_SWAP_BYTES_PER_SEC
+            # in every recompute-vs-swap verdict with what THIS host
+            # actually moves. Init is a phase boundary: the readback is
+            # deliberately NOT counted in host_syncs so the no-pressure
+            # serve loop stays bit-identical to a lifecycle-off engine.
+            t_cal = time.perf_counter()
+            _cal_k, _cal_v = _kvc.gather_blocks(cache.state, [0])
+            # sync-ok: init-time bandwidth calibration (phase boundary)
+            cal_bytes = np.asarray(_cal_k).nbytes + np.asarray(_cal_v).nbytes
+            self.lifecycle.calibrate(cal_bytes,
+                                     time.perf_counter() - t_cal)
+            self._g_swap_gbps.set(self.lifecycle.calibrated_gbps)
         _tmemory.poll("serving.engine_init", registry=self.metrics)
 
     # ----------------------------------------------- sharding seams (ISSUE 10)
@@ -874,7 +941,17 @@ class ServingEngine:
                     "kv_transfer_in": self._c_xfer_in.value,
                     "kv_transfer_bytes": self._c_xfer_bytes.value,
                     "role_prefill_requests": self._c_role_pf.value,
-                    "role_decode_requests": self._c_role_dec.value}
+                    "role_decode_requests": self._c_role_dec.value,
+                    "kv_disk_pool_bytes": (
+                        self.lifecycle.disk_pool.bytes_used
+                        if self.lifecycle is not None
+                        and self.lifecycle.disk_pool is not None else 0),
+                    "kv_disk_demotions": self._c_disk_demote.value,
+                    "kv_disk_promotions": self._c_disk_promote.value,
+                    "kv_swap_harvests": self._c_swap_harvest.value,
+                    "kv_pending_swaps": len(self._pending_swaps),
+                    "kv_swap_lost": self._c_swap_lost.value,
+                    "kv_measured_swap_gbps": self._g_swap_gbps.value}
 
     def kv_pool_snapshot(self, include_blocks: bool = True
                          ) -> Dict[str, object]:
@@ -964,6 +1041,14 @@ class ServingEngine:
                                        timeline=act.timeline)
                 act.fut._set(res)
                 self._record_flight(res)
+                if act.resume is not None and act.resume["mode"] == "swap" \
+                        and self.lifecycle is not None:
+                    # the timed-out victim's parked bytes can never be
+                    # restored — forget them on every tier (they would
+                    # otherwise leak host-pool / disk capacity forever)
+                    self.lifecycle.drop(act.req_id)
+                    self._g_host_pool.set(
+                        self.lifecycle.host_pool.bytes_used)
                 continue
             req = act.req
             plen = len(req.tokens)
@@ -1071,6 +1156,13 @@ class ServingEngine:
                 self._c_role_dec.inc()
             telemetry.instant("admit", req=act.req_id, slot=slot, plen=plen,
                               retries=act.retries, queued=len(self._queue))
+            if act.resume is not None and act.resume["mode"] == "swap" \
+                    and not self.lifecycle.has_swap(act.req_id):
+                # lost spill (e.g. a disk entry that rotted after the
+                # demotion): fall back to recompute-resume — re-prefill
+                # over prompt + history costs compute, never tokens
+                self._c_swap_lost.inc()
+                act.resume["mode"] = "recompute"
             if act.resume is not None and act.resume["mode"] == "swap":
                 # swap reactivation: restore block bytes, no prefill at all
                 self._resume_swap(act, plan, t_adm0)
@@ -1445,15 +1537,16 @@ class ServingEngine:
         queue, so the retried head holds its full reservation and
         always progresses — no preemption livelock."""
         cache = self.decoder.cache
-        bs = cache.block_size
-        bpp = self._kv_bytes_per_pos
         preempted = False
         for victim in plan["evicted"]:
             slot = victim["slot"]
             a = self._by_slot.get(slot)
             if a is None or not self._active_mask[slot]:
                 continue
-            nbytes = victim["blocks_total"] * bs * bpp
+            # block_bytes threads the int8 payload shrink AND the
+            # per-block scale overhead through the recompute-vs-swap
+            # verdict — the same formula _preempt charges the pool with
+            nbytes = victim["blocks_total"] * cache.block_bytes
             mode = self.lifecycle.choose_mode(victim, nbytes)
             self._preempt(slot, mode, victim)
             preempted = True
@@ -1468,7 +1561,15 @@ class ServingEngine:
         reservation, requeue at the back. Pending overlapped results for
         this slot are discarded by _finish_steps' identity check; under
         greedy sampling a token lost to a one-chunk-stale readback
-        regenerates bit-identically on resume."""
+        regenerates bit-identically on resume.
+
+        ASYNC swap-out (ISSUE 18, kv_swap_async): the history readback
+        — which in overlapped mode blocks on the chunk still in flight —
+        and the host-pool payload materialization are both DEFERRED: the
+        victim parks in `_pending_swaps` holding the pinned lazy hist
+        reference, and `_harvest_swaps` collects the bytes at the next
+        chunk boundary. The preempt itself is then pure dispatch +
+        bookkeeping: zero device syncs at the pressure moment."""
         cache = self.decoder.cache
         act = self._by_slot.pop(slot)
         self._active_mask[slot] = False
@@ -1477,15 +1578,11 @@ class ServingEngine:
         if self._spec_index is not None:
             self._spec_index.drop(slot)
         n = act.n_generated
-        with telemetry.span("host_sync", what="preempt_hist", slot=slot):
-            # the no-pressure sync sequence never reaches here
-            # sync-ok: preemption history readback (pressure path only)
-            gen = np.asarray(self._hist[slot])[:n].tolist()
-        self._c_syncs.inc()
         t_prev = act.timeline[-1]["t1"] if act.timeline else act.t_submit
-        nbytes = victim["blocks_total"] * (
-            cache.block_size * self._kv_bytes_per_pos
-            + self._kv_block_overhead)
+        # block_bytes folds in the int8 shrink + per-block scale overhead
+        # — the identical formula _execute_evictions fed choose_mode
+        nbytes = victim["blocks_total"] * cache.block_bytes
+        async_swap = mode == "swap" and self.kv_swap_async
         if mode == "swap":
             # gather BEFORE free: the dispatch pins the blocks' bytes
             # even though the ids return to the free list right after
@@ -1503,26 +1600,115 @@ class ServingEngine:
         else:
             self.lifecycle.evictions_recompute += 1
             self._c_evict_rec.inc()
+        if async_swap:
+            gen = None   # deferred: _harvest_swaps reads the pinned row
+            hist_ref = self._hist
+        else:
+            with telemetry.span("host_sync", what="preempt_hist",
+                                slot=slot):
+                # the no-pressure sync sequence never reaches here
+                # sync-ok: preemption history readback (pressure path only)
+                gen = np.asarray(self._hist[slot])[:n].tolist()
+            self._c_syncs.inc()
         self._c_preempt.inc()
         self._g_host_pool.set(self.lifecycle.host_pool.bytes_used)
         cache.free(slot)
         now = time.monotonic()
-        act.resume = {"mode": mode, "tokens": gen, "t_requeue": now,
-                      "nbytes": nbytes}
         act.n_generated = 0
         act.prefilled = 0
         act.shared_len = 0
         act.preemptions += 1
         # a span tiling from the request's previous event; the requeued
-        # "queue" phase starts at this t1, keeping coverage gap-free
+        # "queue" phase (or the async victim's "swap_pending" limbo)
+        # starts at this t1, keeping coverage gap-free
         act.timeline.append({"phase": "preempt", "t0": t_prev, "t1": now,
                              "mode": mode, "score": victim.get("score"),
                              "blocks_freed": victim.get("blocks_freed"),
                              "bytes": nbytes,
                              "policy": self.lifecycle.policy})
-        telemetry.instant("preempt", req=act.req_id, slot=slot, mode=mode)
-        self._queue.append(act)
+        telemetry.instant("preempt", req=act.req_id, slot=slot, mode=mode,
+                          deferred=async_swap)
+        if async_swap:
+            # limbo: not queued, not resident — harvested at the next
+            # chunk boundary, requeued there
+            self._pending_swaps.append({
+                "act": act, "slot": slot, "hist": hist_ref, "n": n,
+                "nbytes": nbytes, "t0": now})
+        else:
+            if mode == "swap":
+                # sync mode pays demotion INSIDE the preemption stall —
+                # the baseline the bench A/B measures async against
+                now = self._rebalance_disk(act, now)
+            act.resume = {"mode": mode, "tokens": gen, "t_requeue": now,
+                          "nbytes": nbytes}
+            self._queue.append(act)
         self._update_kv_resident()
+
+    def _rebalance_disk(self, act: Optional[_Active], t0: float) -> float:
+        """Demote cold host-pool entries to the disk tier until the pool
+        is back under its byte cap (lock held; no-op without a disk tier
+        or under cap). Materializes + writes npz files — a pressure
+        path, counted as one sync when anything demoted. Appends a
+        "disk_demote" span tiling [t0, end] to `act`'s timeline (blamed
+        to preempt_disk_io) and returns the end wall clock, so callers
+        keep the victim's coverage gap-free."""
+        if self.lifecycle is None or self.lifecycle.disk_pool is None:
+            return t0
+        res = self.lifecycle.rebalance()
+        if not res["demotions"]:
+            return t0
+        self._c_syncs.inc()
+        self._c_disk_demote.inc(res["demotions"])
+        self._g_disk_pool.set(self.lifecycle.disk_pool.bytes_used)
+        self._g_host_pool.set(self.lifecycle.host_pool.bytes_used)
+        t1 = time.monotonic()
+        if act is not None:
+            act.timeline.append({"phase": "disk_demote", "t0": t0,
+                                 "t1": t1, "demotions": res["demotions"],
+                                 "bytes": res["bytes"]})
+        return t1
+
+    def _harvest_swaps(self) -> None:
+        """Chunk-boundary harvest of async swap-outs (lock held): each
+        parked victim's pinned history row and host-pool payload are
+        materialized HERE — after the chunk that was in flight at
+        preempt time retired — then the host pool rebalances into the
+        disk tier and the victim requeues at the back. Same counted
+        sync budget as the synchronous path, moved off the pressure
+        moment. Spans tile the limbo gap-free: "swap_pending" (waiting
+        for the boundary; the scheduler was NOT stalled, blamed to
+        queue_wait) then "swap_out_async" (the deferred materialization,
+        blamed to preempt_swap_io), then disk demotion if any."""
+        if not self._pending_swaps:
+            return
+        pendings, self._pending_swaps = self._pending_swaps, []
+        for rec in pendings:
+            act = rec["act"]
+            t_h0 = time.monotonic()
+            with telemetry.span("host_sync", what="swap_harvest",
+                                slot=rec["slot"]):
+                # sync-ok: deferred swap-out harvest (pressure path only)
+                gen = np.asarray(
+                    rec["hist"][rec["slot"]])[:rec["n"]].tolist()
+                self.lifecycle.harvest(act.req_id)
+            self._c_syncs.inc()
+            self._c_swap_harvest.inc()
+            t_h1 = time.monotonic()
+            act.timeline.append({"phase": "swap_pending", "t0": rec["t0"],
+                                 "t1": t_h0})
+            act.timeline.append({"phase": "swap_out_async", "t0": t_h0,
+                                 "t1": t_h1, "bytes": rec["nbytes"],
+                                 "tokens": len(gen)})
+            t_req = self._rebalance_disk(act, t_h1)
+            act.resume = {"mode": "swap", "tokens": gen,
+                          "t_requeue": t_req, "nbytes": rec["nbytes"]}
+            self._queue.append(act)
+            telemetry.instant("swap_harvest", req=act.req_id,
+                              bytes=rec["nbytes"])
+        self._g_host_pool.set(self.lifecycle.host_pool.bytes_used)
+        gbps = self.lifecycle.measured_swap_gbps()
+        if gbps:
+            self._g_swap_gbps.set(gbps)
 
     def _resume_swap(self, act: _Active, plan, t_adm0: float) -> None:
         """Reactivate a swap-preempted request with NO prefill: the
@@ -1543,14 +1729,21 @@ class ServingEngine:
         live = plen + n - 1
         nbytes = act.resume["nbytes"]
         with telemetry.span("host_sync", what="swap_in", slot=slot):
-            # scales peek BEFORE fetch pops them (quantized pool only;
-            # rides the same counted swap-in materialization)
-            scales = self.lifecycle.host_pool.fetch_scales(act.req_id)
+            # whichever tier holds the bytes: host fetch, or disk
+            # promotion (disk -> host here, host -> device below)
             # sync-ok: swap-in materialization (pressure path only)
-            k_host, v_host = self.lifecycle.swap_in(act.req_id, nbytes)
+            k_host, v_host, scales, sw_info = self.lifecycle.swap_in(
+                act.req_id, nbytes)
         self._c_syncs.inc()
         self._c_swap_in.inc(nbytes)
         self._g_host_pool.set(self.lifecycle.host_pool.bytes_used)
+        if sw_info["tier"] == "disk":
+            self._c_disk_promote.inc()
+            self._g_disk_pool.set(
+                self.lifecycle.disk_pool.bytes_used)
+        gbps = self.lifecycle.measured_swap_gbps()
+        if gbps:
+            self._g_swap_gbps.set(gbps)
         row = cache._slot_blocks[slot]
         bs = cache.block_size
         lis = [li for li in range(min(len(row), k_host.shape[1]))
@@ -1579,9 +1772,18 @@ class ServingEngine:
             self._spec_index.reset(slot, req.tokens)
             self._spec_index.extend(slot, gen)
         now = time.monotonic()
-        act.timeline.append({"phase": "swap_in", "t0": t_adm0, "t1": now,
+        t_mid = t_adm0
+        if sw_info["tier"] == "disk":
+            # split the restore: the npz read is disk-IO blame
+            # (preempt_disk_io), the remainder is the device restore
+            # (preempt_swap_io) — together they tile [t_adm0, now]
+            t_mid = min(now, t_adm0 + sw_info["disk_wall_s"])
+            act.timeline.append({"phase": "disk_promote", "t0": t_adm0,
+                                 "t1": t_mid, "bytes": nbytes})
+        act.timeline.append({"phase": "swap_in", "t0": t_mid, "t1": now,
                              "blocks": len(lis), "bytes": nbytes,
-                             "resumed_tokens": n})
+                             "resumed_tokens": n,
+                             "tier": sw_info["tier"]})
         self._update_kv_resident()
 
     def _finish_resume(self, act: _Active, t_pf_mono: float,
@@ -2047,12 +2249,16 @@ class ServingEngine:
             self._policy_evict()
             self._admit()
             if not self._by_slot:
+                # no chunk will run this iteration — this IS the boundary
+                # for any victim parked by the admission's preemptions
+                self._harvest_swaps()
                 return bool(self._queue)
             self._expire_timeouts()
             self._prefill_step()
             if not self._active_mask.any():
                 # nothing decode-active: every resident slot is mid-prefill
                 # (or the final chunk's 1-token request just retired)
+                self._harvest_swaps()
                 return bool(self._by_slot or self._queue)
             # decode-active slots only: a partially-prefilled slot must not
             # be judged by a chunk dispatched while it was still inactive
@@ -2062,7 +2268,9 @@ class ServingEngine:
                         if self._active_mask[s]}
             active = jnp.asarray(self._active_mask)
             if self.spec_decode:
-                return self._spec_step(snapshot, active, t_iter0)
+                more = self._spec_step(snapshot, active, t_iter0)
+                self._harvest_swaps()
+                return more or bool(self._queue)
             k_eff = self._chunk_size()
             t_chunk = time.perf_counter()
             self._h_chunk_k.observe(k_eff)
@@ -2122,6 +2330,10 @@ class ServingEngine:
                                      "wall_s": chunk_ms / 1e3,
                                      "iter": self._iter_id,
                                      "compile": miss})
+            # chunk boundary: the dispatch above retired, so any victim
+            # parked at this iteration's preemptions harvests WITHOUT
+            # waiting on in-flight work (async swap-out, ISSUE 18)
+            self._harvest_swaps()
             return bool(self._by_slot or self._queue)
 
     def _spec_step(self, snapshot: Dict[int, _Active], active,
@@ -2344,6 +2556,12 @@ class ServingEngine:
                                                  "wall_s": chunk_ms / 1e3,
                                                  "iter": it_prev,
                                                  "compile": miss_prev})
+                    # chunk boundary: the masks above just materialized, so
+                    # any victim parked by this iteration's preemptions has
+                    # its pinned hist (the output of that same chunk) ready
+                    # — the harvest is a copy, not a stall — and requeued
+                    # victims are visible to the exit check below
+                    self._harvest_swaps()
                     pending = dispatched
                     if pending is None and not (self._by_slot or self._queue):
                         return
@@ -2413,6 +2631,18 @@ class ServingEngine:
                 for slot in list(self._by_slot):
                     self._active_mask[slot] = False
                     self._retire(slot, "shutdown")
+                # limbo victims (async swap-out awaiting harvest): resolve
+                # WITHOUT materializing — their bytes are never needed —
+                # and forget the parked payload on every tier
+                for rec in self._pending_swaps:
+                    act = rec["act"]
+                    act.fut._set(GenerationResult(
+                        [], "shutdown", len(act.req.tokens),
+                        req_id=act.req_id, admission_retries=act.retries,
+                        timeline=act.timeline))
+                    if self.lifecycle is not None:
+                        self.lifecycle.drop(act.req_id)
+                self._pending_swaps.clear()
                 for act in self._queue:
                     now = time.monotonic()
                     # requeued-after-preemption: tile from t_requeue, the
@@ -2426,6 +2656,12 @@ class ServingEngine:
                         [], "shutdown", len(act.req.tokens),
                         req_id=act.req_id, admission_retries=act.retries,
                         timeline=act.timeline))
+                    if act.resume is not None \
+                            and act.resume["mode"] == "swap" \
+                            and self.lifecycle is not None:
+                        # a swapped-out queued request's parked bytes
+                        # would otherwise leak host-pool/disk capacity
+                        self.lifecycle.drop(act.req_id)
                 self._queue.clear()
             elif self._by_slot or self._queue:
                 self.drain()

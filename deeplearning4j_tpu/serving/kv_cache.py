@@ -895,9 +895,19 @@ class KVCache:
             self.state["k_scale"].dtype.itemsize +
             self.state["v_scale"].dtype.itemsize)
 
+    @property
+    def block_bytes(self) -> int:
+        """Swap/transfer payload bytes of ONE physical block: positions
+        times the dtype-derived per-position cost plus the per-block
+        scale overhead (quantized pools, ISSUE 15). The single formula
+        every byte consumer on the pressure path shares (ISSUE 18) —
+        eviction cost terms, preempt accounting, and the host/disk tier
+        caps all agree because they multiply this, so the int8 shrink
+        (~4x vs fp32) threads through `choose_mode` automatically."""
+        return self.block_size * self.bytes_per_position \
+            + self.block_overhead_bytes
+
     def bytes(self) -> int:
         """Device HBM held by the k/v buffers (num_blocks + the trash
         block), scales included — the PERF.md paged footprint formula."""
-        return (self.num_blocks + 1) * (
-            self.block_size * self.bytes_per_position +
-            self.block_overhead_bytes)
+        return (self.num_blocks + 1) * self.block_bytes

@@ -1,0 +1,240 @@
+"""Disk tier of the hierarchical KV storage ladder (ISSUE 18).
+
+The lifecycle manager's `HostBlockPool` (serving/lifecycle.py) is a
+capacity-capped host-RAM shelf for swapped-out KV block bytes; below it
+sits this `DiskBlockPool` — a spill DIRECTORY holding one npz file per
+entry, so cold sessions and cold prefix blocks survive host-pool
+pressure at ~zero HBM and ~zero host-RAM cost:
+
+    HBM (paged KVCache)  --gather/scatter-->  HostBlockPool (RAM)
+                                                   |  demote on pressure
+                                                   v  promote on swap-in
+                                              DiskBlockPool (npz files)
+
+Two key namespaces share one pool: swap entries (int request ids, files
+``swap_<id>.npz``) and prefix-store entries (sha1 chain digests, files
+``pfx_<hex>.npz``) — `PersistentPrefixStore` spills through the SAME
+tier the lifecycle manager demotes into, so one byte cap governs
+everything below RAM.
+
+Crash safety mirrors the PR 16 npz store: every write lands in a
+sibling ``.tmp`` file and `os.replace`s into place (kill mid-demotion
+leaves the previous entry intact, never a truncated zip at the
+canonical path); construction over an existing spill directory sweeps
+leftover ``.tmp`` files, drops stale ``swap_`` entries (request ids are
+process-scoped — a dead engine's swaps are unrestorable), and ingests
+``pfx_`` entries tolerantly (a corrupt or truncated file warns and is
+ignored, not fatal); `fetch()` of an entry whose file rotted after the
+put warns and raises ``KeyError`` so callers treat it as a miss (the
+engine falls back to recompute — losing a spill costs compute, never
+correctness).
+
+Sync discipline: `put()` materializes lazy device arrays before the npz
+write — only ever reached on PRESSURE paths (host-pool demotion, store
+spill-through), annotated and counted by the callers like every other
+pressure-path sync.
+
+Env knobs: ``DL4J_TPU_KV_DISK`` (spill directory; setting it enables
+the tier), ``DL4J_TPU_KV_DISK_BYTES`` (byte cap, default 1 GiB).
+"""
+from __future__ import annotations
+
+import os
+import warnings
+import zipfile
+from collections import OrderedDict
+from typing import Optional, Tuple
+
+import numpy as np
+
+__all__ = ["DiskBlockPool", "resolve_disk_pool", "DEFAULT_DISK_BYTES"]
+
+#: Default spill-directory byte cap (DL4J_TPU_KV_DISK_BYTES overrides).
+DEFAULT_DISK_BYTES = 1 << 30
+
+#: Exception set a rotten npz read can raise — identical to the
+#: PersistentPrefixStore.load tolerance (PR 16).
+_READ_ERRORS = (zipfile.BadZipFile, ValueError, OSError, EOFError, KeyError)
+
+
+def _fname(key) -> str:
+    """Collision-free filename per key namespace: request ids (ints, or
+    caller-supplied strings — hex-encoded to stay filesystem-safe) are
+    swap entries, bytes digests are prefix-store entries."""
+    if isinstance(key, bytes):
+        return f"pfx_{key.hex()}.npz"
+    if isinstance(key, str):
+        return f"swap_x{key.encode('utf-8').hex()}.npz"
+    return f"swap_{int(key)}.npz"
+
+
+class DiskBlockPool:
+    """Byte-capped spill directory of KV block bytes, one npz per entry.
+
+    LRU over entries (an `OrderedDict` of key -> file bytes); `put()`
+    evicts cold files to stay under the cap. Accounting uses ACTUAL
+    file sizes (what the disk holds), not the nominal device bytes the
+    host pool charges — the two differ by npz framing and, on quantized
+    pools, by the scale arrays riding along."""
+
+    def __init__(self, directory: str,
+                 capacity_bytes: int = DEFAULT_DISK_BYTES):
+        self.directory = str(directory)
+        self.capacity_bytes = max(0, int(capacity_bytes))
+        os.makedirs(self.directory, exist_ok=True)
+        self._entries: "OrderedDict[object, int]" = OrderedDict()
+        self.bytes_used = 0
+        # lifetime counters the lifecycle manager mirrors into stats
+        self.n_writes = 0
+        self.bytes_written = 0
+        self.n_corrupt = 0
+        self._scan()
+
+    # --------------------------------------------------------- recovery
+    def _scan(self) -> None:
+        """Recover an existing spill directory: sweep crash leftovers
+        (``.tmp`` from a kill mid-demotion), drop stale ``swap_`` files
+        (request ids don't survive the process that minted them), and
+        ingest ``pfx_`` entries — tolerantly: a file the zip reader
+        rejects warns and is removed rather than poisoning the pool."""
+        for name in sorted(os.listdir(self.directory)):
+            path = os.path.join(self.directory, name)
+            if name.endswith(".tmp") or name.startswith("swap_"):
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                continue
+            if not (name.startswith("pfx_") and name.endswith(".npz")):
+                continue
+            try:
+                with np.load(path) as z:
+                    _ = z.files            # forces the zip directory read
+                digest = bytes.fromhex(name[len("pfx_"):-len(".npz")])
+            except _READ_ERRORS as e:
+                self.n_corrupt += 1
+                warnings.warn(
+                    f"disk KV spill {path!r} unreadable ({e!r}); treating "
+                    "as empty and removing it", stacklevel=2)
+                try:
+                    os.remove(path)
+                except OSError:
+                    pass
+                continue
+            nbytes = os.path.getsize(path)
+            self._entries[digest] = nbytes
+            self.bytes_used += nbytes
+
+    # ------------------------------------------------------------ access
+    def _path(self, key) -> str:
+        return os.path.join(self.directory, _fname(key))
+
+    def can_fit(self, nbytes: int) -> bool:
+        return (self.capacity_bytes > 0
+                and self.bytes_used + int(nbytes) <= self.capacity_bytes)
+
+    def __contains__(self, key) -> bool:
+        return key in self._entries
+
+    @property
+    def n_entries(self) -> int:
+        return len(self._entries)
+
+    def put(self, key, k_blocks, v_blocks, nbytes: int,
+            k_scale=None, v_scale=None) -> int:
+        """Spill one entry to its npz file (crash-safe: sibling tmp +
+        atomic rename), evicting LRU entries to stay under the cap.
+        Materializes lazy device arrays — demotion is a PRESSURE path,
+        callers count the sync. Returns the file bytes written."""
+        if key in self._entries:
+            self.drop(key)
+        # sync-ok: disk demotion materialization (pressure path only)
+        arrays = {"k": np.asarray(k_blocks), "v": np.asarray(v_blocks)}
+        if k_scale is not None:
+            # sync-ok: disk demotion materialization (pressure path only)
+            arrays["ks"] = np.asarray(k_scale)
+            arrays["vs"] = np.asarray(v_scale)  # sync-ok: demotion path
+        path = self._path(key)
+        tmp = path + ".tmp"
+        try:
+            with open(tmp, "wb") as f:
+                np.savez(f, **arrays)
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+        file_bytes = os.path.getsize(path)
+        while self.capacity_bytes and self._entries \
+                and self.bytes_used + file_bytes > self.capacity_bytes:
+            old_key, _ = next(iter(self._entries.items()))
+            self.drop(old_key)
+        self._entries[key] = file_bytes
+        self.bytes_used += file_bytes
+        self.n_writes += 1
+        self.bytes_written += file_bytes
+        return file_bytes
+
+    def fetch(self, key) -> Tuple[np.ndarray, np.ndarray,
+                                  Optional[Tuple[np.ndarray, np.ndarray]]]:
+        """Remove and read one entry: (k, v, scales-or-None). The whole
+        file is decoded into host arrays BEFORE the entry is dropped, so
+        a read error leaves no partially-promoted state — the entry is
+        removed (it is unrestorable), a warning fires, and ``KeyError``
+        tells the caller to treat it as a miss."""
+        if key not in self._entries:
+            raise KeyError(key)
+        path = self._path(key)
+        try:
+            with np.load(path) as z:
+                k, v = z["k"], z["v"]
+                sc = None
+                if "ks" in z.files and "vs" in z.files:
+                    sc = (z["ks"], z["vs"])
+        except _READ_ERRORS as e:
+            self.n_corrupt += 1
+            self.drop(key)
+            warnings.warn(
+                f"disk KV spill {path!r} unreadable ({e!r}); entry "
+                "dropped, caller falls back", stacklevel=2)
+            raise KeyError(key) from e
+        self.drop(key)
+        return k, v, sc
+
+    def peek_nbytes(self, key) -> int:
+        """File bytes an entry occupies (LRU-touching peek)."""
+        n = self._entries[key]
+        self._entries.move_to_end(key)
+        return n
+
+    def drop(self, key) -> None:
+        n = self._entries.pop(key, None)
+        if n is None:
+            return
+        self.bytes_used -= n
+        try:
+            os.remove(self._path(key))
+        except OSError:
+            pass
+
+
+def resolve_disk_pool(kv_disk=None, kv_disk_bytes: Optional[int] = None
+                      ) -> Optional[DiskBlockPool]:
+    """Engine-constructor resolution of the disk-tier knobs: an instance
+    passes through (a ShardedServingGroup may hand one pool to every
+    replica), a string is the spill directory, None defers to
+    ``DL4J_TPU_KV_DISK`` (empty/"0" = no disk tier — no pool, no code
+    on any path). ``kv_disk_bytes`` caps the directory (None defers to
+    ``DL4J_TPU_KV_DISK_BYTES``, default 1 GiB)."""
+    if isinstance(kv_disk, DiskBlockPool):
+        return kv_disk
+    if kv_disk is None:
+        kv_disk = os.environ.get("DL4J_TPU_KV_DISK", "")
+    if not kv_disk or kv_disk == "0":
+        return None
+    if kv_disk_bytes is None:
+        kv_disk_bytes = int(os.environ.get("DL4J_TPU_KV_DISK_BYTES",
+                                           str(DEFAULT_DISK_BYTES)))
+    return DiskBlockPool(str(kv_disk), capacity_bytes=int(kv_disk_bytes))

@@ -59,9 +59,24 @@ spans and "swap_in" restores to `preempt_swap_io` (swap mode) or
 preemption and readmission tiles from `resume["t_requeue"]` so the
 partition of submit->retire stays exact under pressure.
 
+Hierarchical storage (ISSUE 18): below the `HostBlockPool` sits an
+optional `DiskBlockPool` (serving/kv_disk.py) — LRU host entries DEMOTE
+to npz spill files under host-pool pressure (`rebalance()`), and a
+swap-in whose entry went cold PROMOTES it disk -> host -> device; the
+`PersistentPrefixStore` spills through the same tier. Swap-out itself
+is ASYNC: the engine defers the victim's history readback and payload
+materialization to the next chunk boundary (`harvest()`), so preemption
+never stalls the scheduler on a device->host copy, and the engine's
+init-time warmup round-trip `calibrate()`s the cost model's
+swap bandwidth instead of trusting DEFAULT_SWAP_BYTES_PER_SEC.
+
 Env knobs: `DL4J_TPU_KV_EVICT` (policy name, empty/0/off disables),
-`DL4J_TPU_KV_SWAP_BYTES` (host-pool cap in bytes; 0 = recompute-only),
-`DL4J_TPU_PREFIX_STORE` (spill-file path, also enables the store).
+`DL4J_TPU_KV_SWAP_BYTES` (host-pool cap in bytes; 0 = recompute-only
+unless the disk tier is armed), `DL4J_TPU_PREFIX_STORE` (spill-file
+path, also enables the store), `DL4J_TPU_KV_DISK` (spill directory,
+arms the disk tier), `DL4J_TPU_KV_DISK_BYTES` (disk cap, default
+1 GiB), `DL4J_TPU_KV_SWAP_ASYNC` (engine knob: deferred harvest on/off,
+default on).
 """
 from __future__ import annotations
 
@@ -91,7 +106,10 @@ class HostBlockPool:
 
     def __init__(self, capacity_bytes: int = 0):
         self.capacity_bytes = max(0, int(capacity_bytes))
-        self._entries: Dict[object, Tuple[object, object, int]] = {}
+        # insertion-ordered (OrderedDict): the demotion path spills the
+        # LRU entry to the disk tier under host-pool pressure (ISSUE 18)
+        self._entries: "OrderedDict[object, Tuple[object, object, int]]" = \
+            OrderedDict()
         # quantized pools (ISSUE 15) ride their per-head-per-block scales
         # alongside the payload; a side dict keeps `_entries` 3-tuples
         self._scales: Dict[object, Tuple[object, object]] = {}
@@ -122,13 +140,52 @@ class HostBlockPool:
 
     def fetch(self, key) -> Tuple[np.ndarray, np.ndarray]:
         """Remove and MATERIALIZE one entry (the swap-in device->host
-        copy happens here; the caller times it and counts the sync)."""
-        k, v, n = self._entries.pop(key)
-        self._scales.pop(key, None)
-        self.bytes_used -= n
+        copy happens here; the caller times it and counts the sync).
+
+        The materialization PEEKS before it pops (ISSUE 18 satellite):
+        a restore that raises mid-flight — device OOM, a poisoned lazy
+        array — used to lose the entry permanently because the pop and
+        the byte decrement ran first; now the entry survives and the
+        swap-in can be retried or fall back to recompute."""
+        k, v, n = self._entries[key]
         # counted+timed by the engine via KVLifecycleManager.swap_in
         # sync-ok: swap-in materialization (pressure path)
-        return np.asarray(k), np.asarray(v)
+        k_np = np.asarray(k)
+        v_np = np.asarray(v)  # sync-ok: swap-in materialization
+        del self._entries[key]
+        self._scales.pop(key, None)
+        self.bytes_used -= n
+        return k_np, v_np
+
+    def materialize(self, key) -> int:
+        """Convert one entry's lazy device arrays into real host numpy
+        IN PLACE — the deferred swap-out harvest (ISSUE 18): the engine
+        calls this at the next chunk boundary after preemption, so the
+        device->host copy overlaps scheduling instead of stalling it,
+        and later demotion to disk never touches the device. Idempotent
+        (already-materialized entries are a no-op copy; an entry a
+        rebalance already demoted to disk is a no-op — the disk put
+        materialized it). Returns the entry's nominal bytes."""
+        if key not in self._entries:
+            return 0
+        k, v, n = self._entries[key]
+        # sync-ok: deferred swap-out harvest (pressure path only)
+        self._entries[key] = (np.asarray(k), np.asarray(v), n)
+        sc = self._scales.get(key)
+        if sc is not None:
+            # sync-ok: deferred swap-out harvest (pressure path only)
+            self._scales[key] = (np.asarray(sc[0]), np.asarray(sc[1]))
+        return n
+
+    def pop_lru(self) -> Tuple[object, object, object, int,
+                               Optional[Tuple[object, object]]]:
+        """Remove and return the least-recently-inserted entry as
+        (key, k, v, nbytes, scales-or-None) — the demotion path hands
+        it to the disk tier."""
+        key, (k, v, n) = self._entries.popitem(last=False)
+        sc = self._scales.pop(key, None)
+        self.bytes_used -= n
+        return key, k, v, n, sc
 
     def drop(self, key) -> None:
         ent = self._entries.pop(key, None)
@@ -178,6 +235,15 @@ class PersistentPrefixStore:
         # device-pool reclaim and store eviction, replacing the store's
         # private recency order.
         self.evict_policy: Optional[Callable[..., Optional[bytes]]] = None
+        # disk spill-through (ISSUE 18): when set (the engine wires the
+        # lifecycle manager's DiskBlockPool here), byte-cap eviction
+        # DEMOTES the victim entry to disk instead of discarding it, and
+        # covered() PROMOTES disk-resident digests back — so cold
+        # prefixes survive at ~zero host-RAM cost. Counters are
+        # lifetime, mirrored into engine stats.
+        self.disk = None
+        self.disk_demotions = 0
+        self.disk_promotions = 0
         # cross-replica heat bus (ISSUE 17 satellite): per-digest,
         # per-replica publication counts — replicas stamp the lineages
         # they prefill, the router's prefix affinity reads them. A
@@ -213,14 +279,41 @@ class PersistentPrefixStore:
     def covered(self, digests: Sequence[bytes]) -> int:
         """How many LEADING digests the store holds (chain property: a
         usable restore is always a prefix of the chain). Touches the hit
-        entries' LRU position."""
+        entries' LRU position. With a disk tier wired (ISSUE 18), a
+        digest missing from RAM but spilled on disk is PROMOTED back
+        into the store — disk -> host here, host -> device at the
+        caller's fetch()+restore — so coverage extends through the
+        spill; a corrupt spill file simply ends the covered prefix (the
+        chain property keeps a partial promotion safe)."""
         n = 0
         for d in digests:
-            if d not in self._entries:
+            if d not in self._entries and not self._promote(d):
                 break
             self._entries.move_to_end(d)
             n += 1
         return n
+
+    def _promote(self, digest: bytes) -> bool:
+        """Try to pull one digest's bytes back from the disk tier into
+        the RAM store (pressure path — the disk read is the promotion
+        cost `covered()` pays to extend a restore). Returns False when
+        there is no disk tier, the digest isn't spilled, or its file is
+        unreadable (fetch drops it and warns)."""
+        if self.disk is None or digest not in self.disk:
+            return False
+        try:
+            k, v, sc = self.disk.fetch(digest)
+        except KeyError:
+            return False
+        nbytes = k.nbytes + v.nbytes
+        if sc is not None:
+            nbytes += sc[0].nbytes + sc[1].nbytes
+        kw = {} if sc is None else {"k_scale": sc[0], "v_scale": sc[1]}
+        self.put(digest, k, v, nbytes, block_shape=k.shape, **kw)
+        if digest not in self._entries:      # put refused (cap too small)
+            return False
+        self.disk_promotions += 1
+        return True
 
     def missing(self, digests: Sequence[bytes]) -> List[int]:
         """Indices of `digests` not yet stored (the offer path gathers
@@ -260,11 +353,20 @@ class PersistentPrefixStore:
                 if old_d is not None and old_d not in self._entries:
                     old_d = None   # stale advice → fall back to LRU head
             if old_d is None:
-                old_d, (_, _, old) = self._entries.popitem(last=False)
+                old_d, (old_k, old_v, old) = self._entries.popitem(last=False)
             else:
-                _, _, old = self._entries.pop(old_d)
-            self._scales.pop(old_d, None)
+                old_k, old_v, old = self._entries.pop(old_d)
+            old_sc = self._scales.pop(old_d, None)
             self.bytes_used -= old
+            if self.disk is not None and self.disk.can_fit(old):
+                # spill-through (ISSUE 18): the byte-cap victim demotes
+                # to the disk tier instead of vanishing; covered() can
+                # promote it back later. disk.put materializes lazy
+                # entries — store eviction is a pressure path.
+                self.disk.put(old_d, old_k, old_v, old,
+                              k_scale=None if old_sc is None else old_sc[0],
+                              v_scale=None if old_sc is None else old_sc[1])
+                self.disk_demotions += 1
         self._entries[digest] = (k_block, v_block, nbytes)
         if k_scale is not None:
             self._scales[digest] = (k_scale, v_scale)
@@ -396,7 +498,8 @@ class KVLifecycleManager:
                  mode: str = "auto", *, flops_per_token: float = 0.0,
                  swap_bytes_per_sec: float = DEFAULT_SWAP_BYTES_PER_SEC,
                  flops_per_sec: float = DEFAULT_FLOPS_PER_SEC,
-                 score_fn: Optional[Callable] = None):
+                 score_fn: Optional[Callable] = None,
+                 disk_pool=None):
         if score_fn is None:
             if policy not in DEFAULT_POLICIES:
                 raise ValueError(
@@ -412,12 +515,25 @@ class KVLifecycleManager:
         self.swap_bytes_per_sec = float(swap_bytes_per_sec)  # sync-ok: scalar
         self.flops_per_sec = float(flops_per_sec)       # sync-ok: scalar
         self.host_pool = HostBlockPool(swap_bytes)
+        # disk tier (ISSUE 18): a DiskBlockPool below the host pool —
+        # None means no tier, no disk code on any path
+        self.disk_pool = disk_pool
         # accounting the engine mirrors into serving.kv.* metrics
         self.evictions_recompute = 0
         self.evictions_swap = 0
         self.swap_out_bytes = 0
         self.swap_in_bytes = 0
         self.swap_wall_s = 0.0      # measured swap-in materialization wall
+        # hierarchical-tier accounting (ISSUE 18)
+        self.harvests = 0           # deferred swap-out materializations
+        self.harvest_wall_s = 0.0
+        self.disk_demotions = 0     # host -> disk spills
+        self.disk_promotions = 0    # disk -> host restores
+        self.disk_wall_s = 0.0      # disk read+write wall
+        self.demoted_bytes = 0
+        # init-time calibrated host-link bandwidth (GB/s), None until
+        # the engine's warmup round-trip ran (ISSUE 18 satellite)
+        self.calibrated_gbps: Optional[float] = None
 
     # ------------------------------------------------------------- plan
     def plan(self, snapshot: Dict[str, object], needed_blocks: int, *,
@@ -431,13 +547,35 @@ class KVLifecycleManager:
                              flops_per_sec=self.flops_per_sec,
                              eligible=eligible, policy=self.policy)
 
+    def can_absorb(self, nbytes: int) -> bool:
+        """Can the storage hierarchy hold `nbytes` more of swap payload?
+        True when the host pool fits it directly, or (disk tier armed,
+        ISSUE 18) when demoting LRU host entries to disk makes room —
+        the swap-cost term `choose_mode` consults, so a quantized pool's
+        ~4x smaller payloads fit (and swap wins the cost race) long
+        after float payloads stopped fitting."""
+        if self.host_pool.can_fit(nbytes):
+            return True
+        if self.disk_pool is None:
+            return False
+        nbytes = int(nbytes)
+        disk_free = self.disk_pool.capacity_bytes - self.disk_pool.bytes_used
+        if nbytes <= self.host_pool.capacity_bytes:
+            # demotion makes room: the overflow moves to disk
+            overflow = self.host_pool.bytes_used + nbytes \
+                - self.host_pool.capacity_bytes
+            return overflow <= disk_free
+        # payload larger than the whole host pool: spill straight to disk
+        return nbytes <= disk_free
+
     def choose_mode(self, victim: dict, nbytes: int) -> str:
         """recompute vs swap for one plan entry: forced by `mode`, or
         (auto) the cost model's `cheaper` verdict — either way swap is
-        only taken when the host pool can hold the bytes."""
+        only taken when the storage hierarchy (host pool, plus the disk
+        tier via demotion when armed) can hold the bytes."""
         if self.mode == "recompute":
             return "recompute"
-        fits = self.host_pool.can_fit(nbytes)
+        fits = self.can_absorb(nbytes)
         if self.mode == "swap":
             return "swap" if fits else "recompute"
         return "swap" if (victim.get("cheaper") == "swap" and fits) \
@@ -447,38 +585,136 @@ class KVLifecycleManager:
     def swap_out(self, key, k_blocks, v_blocks, nbytes: int,
                  k_scale=None, v_scale=None) -> None:
         """File a victim's gathered block bytes (lazy device arrays) in
-        the host pool; bytes are charged now, copied at swap-in. A
-        quantized pool (ISSUE 15) hands over per-head-per-block scales
-        with the int8 payload so the restore is bit-exact."""
+        the host pool; bytes are charged now, copied at harvest/swap-in.
+        A quantized pool (ISSUE 15) hands over per-head-per-block scales
+        with the int8 payload so the restore is bit-exact. NEVER
+        materializes — the pool may run transiently over cap until the
+        next `rebalance()` demotes LRU entries to disk (ISSUE 18), so
+        the preempt-time dispatch stays stall-free."""
         self.host_pool.put(key, k_blocks, v_blocks, nbytes,
                            k_scale=k_scale, v_scale=v_scale)
         self.evictions_swap += 1
         self.swap_out_bytes += int(nbytes)
 
-    def swap_in(self, key, nbytes: int) -> Tuple[np.ndarray, np.ndarray]:
-        """Materialize a swapped request's bytes for restore, timing the
-        device->host copy (the measured host-link bandwidth)."""
+    def rebalance(self) -> dict:
+        """Demote LRU host-pool entries to the disk tier until the pool
+        is back under its byte cap (no-op without a disk tier, or when
+        already under cap). Materializes lazy entries — a pressure path;
+        the engine calls this at preempt time (sync swap mode) or at the
+        deferred harvest (async), and charges the wall to the blame
+        ledger's disk-IO cause. Returns {demotions, bytes, wall_s}."""
+        out = {"demotions": 0, "bytes": 0, "wall_s": 0.0}
+        if self.disk_pool is None \
+                or self.host_pool.bytes_used <= self.host_pool.capacity_bytes:
+            return out
         t0 = time.perf_counter()
-        k, v = self.host_pool.fetch(key)
-        self.swap_wall_s += time.perf_counter() - t0
+        while self.host_pool.bytes_used > self.host_pool.capacity_bytes \
+                and self.host_pool.n_entries:
+            key, k, v, n, sc = self.host_pool.pop_lru()
+            if not self.disk_pool.can_fit(n):
+                # disk full: keep the entry host-resident (re-file at the
+                # LRU head position is lost, but bytes stay correct)
+                self.host_pool.put(key, k, v, n,
+                                   k_scale=None if sc is None else sc[0],
+                                   v_scale=None if sc is None else sc[1])
+                break
+            self.disk_pool.put(key, k, v, n,
+                               k_scale=None if sc is None else sc[0],
+                               v_scale=None if sc is None else sc[1])
+            out["demotions"] += 1
+            out["bytes"] += n
+        out["wall_s"] = time.perf_counter() - t0
+        self.disk_demotions += out["demotions"]
+        self.demoted_bytes += out["bytes"]
+        self.disk_wall_s += out["wall_s"]
+        return out
+
+    def harvest(self, key) -> None:
+        """Deferred swap-out harvest (ISSUE 18): materialize a swapped
+        entry's bytes host-side at a chunk boundary — the device->host
+        copy the synchronous path paid inside the preemption stall."""
+        t0 = time.perf_counter()
+        self.host_pool.materialize(key)
+        self.harvest_wall_s += time.perf_counter() - t0
+        self.harvests += 1
+
+    def has_swap(self, key) -> bool:
+        """Is `key`'s swap payload restorable from ANY tier? False means
+        the entry was lost (e.g. a corrupt disk spill) — the engine
+        falls back to recompute-resume, costing compute, not tokens."""
+        return key in self.host_pool or (
+            self.disk_pool is not None and key in self.disk_pool)
+
+    def drop(self, key) -> None:
+        """Forget a swapped entry on every tier (timeout / shutdown of a
+        swapped-out request — its bytes will never be restored)."""
+        self.host_pool.drop(key)
+        if self.disk_pool is not None:
+            self.disk_pool.drop(key)
+
+    def swap_in(self, key, nbytes: int
+                ) -> Tuple[np.ndarray, np.ndarray,
+                           Optional[Tuple[np.ndarray, np.ndarray]], dict]:
+        """Materialize a swapped request's bytes for restore from
+        whichever tier holds them: (k, v, scales-or-None, info).
+        info = {"tier": "host"|"disk", "wall_s", "disk_wall_s"} — the
+        engine splits the blame span on it (device-gather vs disk-IO).
+        A disk hit is the promotion path (disk -> host here, host ->
+        device at the caller's scatter). Raises KeyError when no tier
+        holds the entry (lost spill)."""
+        t0 = time.perf_counter()
+        tier, disk_wall = "host", 0.0
+        if key in self.host_pool:
+            scales = self.host_pool.fetch_scales(key)
+            k, v = self.host_pool.fetch(key)
+        elif self.disk_pool is not None and key in self.disk_pool:
+            tier = "disk"
+            k, v, scales = self.disk_pool.fetch(key)   # KeyError if corrupt
+            disk_wall = time.perf_counter() - t0
+            self.disk_wall_s += disk_wall
+            self.disk_promotions += 1
+        else:
+            raise KeyError(key)
+        wall = time.perf_counter() - t0
+        self.swap_wall_s += wall
         self.swap_in_bytes += int(nbytes)
-        return k, v
+        return k, v, scales, {"tier": tier, "wall_s": wall,
+                              "disk_wall_s": disk_wall}
+
+    # ------------------------------------------------------ measurement
+    def calibrate(self, nbytes: int, wall_s: float) -> float:
+        """Install the engine-init warmup round-trip measurement
+        (ISSUE 18 satellite): one tiny gather+materialize replaces the
+        hardcoded DEFAULT_SWAP_BYTES_PER_SEC guess in every subsequent
+        `plan()`/`choose_mode()` cost verdict. Returns the bandwidth in
+        bytes/sec (floored to keep the cost model finite)."""
+        # sync-ok: host ints/floats from the caller's timer, no device read
+        bps = max(1e6, float(nbytes) / max(1e-9, float(wall_s)))
+        self.swap_bytes_per_sec = bps
+        self.calibrated_gbps = bps / 1e9
+        return bps
 
     def measured_swap_gbps(self) -> Optional[float]:
-        """Swap-in bytes / materialization wall, in GB/s — None until a
-        swap round-trip has actually run."""
-        if self.swap_in_bytes <= 0 or self.swap_wall_s <= 0:
+        """Swap-in bytes / materialization wall (harvest wall included —
+        an async-harvested entry's device->host copy happened there), in
+        GB/s — None until a swap round-trip has actually run."""
+        wall = self.swap_wall_s + self.harvest_wall_s
+        if self.swap_in_bytes <= 0 or wall <= 0:
             return None
-        return self.swap_in_bytes / self.swap_wall_s / 1e9
+        return self.swap_in_bytes / wall / 1e9
 
 
 def resolve_lifecycle(kv_evict, kv_swap_bytes, kv_evict_mode: str = "auto",
-                      *, flops_per_token: float = 0.0
+                      *, flops_per_token: float = 0.0,
+                      kv_disk=None, kv_disk_bytes: Optional[int] = None
                       ) -> Optional[KVLifecycleManager]:
     """Engine-constructor resolution of the lifecycle knobs: `kv_evict`
     is a policy name (or True for the default lru), None defers to
     `DL4J_TPU_KV_EVICT`; empty/"0"/"off" disables — and disabled means
-    NO manager, no code on any path (the bit-parity guarantee)."""
+    NO manager, no code on any path (the bit-parity guarantee).
+    `kv_disk`/`kv_disk_bytes` (ISSUE 18) arm the disk tier below the
+    host pool — a DiskBlockPool instance, a spill directory, or None to
+    defer to `DL4J_TPU_KV_DISK`/`DL4J_TPU_KV_DISK_BYTES`."""
     if kv_evict is None:
         kv_evict = os.environ.get("DL4J_TPU_KV_EVICT", "")
     if isinstance(kv_evict, KVLifecycleManager):
@@ -489,10 +725,13 @@ def resolve_lifecycle(kv_evict, kv_swap_bytes, kv_evict_mode: str = "auto",
         return None
     if kv_swap_bytes is None:
         kv_swap_bytes = int(os.environ.get("DL4J_TPU_KV_SWAP_BYTES", "0"))
+    from deeplearning4j_tpu.serving.kv_disk import resolve_disk_pool
     return KVLifecycleManager(policy=str(kv_evict),
                               swap_bytes=int(kv_swap_bytes),
                               mode=kv_evict_mode,
-                              flops_per_token=flops_per_token)
+                              flops_per_token=flops_per_token,
+                              disk_pool=resolve_disk_pool(kv_disk,
+                                                          kv_disk_bytes))
 
 
 def resolve_prefix_store(prefix_store) -> Optional[PersistentPrefixStore]:

@@ -245,6 +245,16 @@ class ColocatedPolicy(SchedulingPolicy):
                 "retry_after_s": 0.0}
         if lifecycle is None:
             return AdmissionDecision.deny(hint)
+        # hierarchical-storage headroom (ISSUE 18): bytes the swap
+        # ladder (host pool free + disk tier free) could still absorb —
+        # forensics for the deny record, telling operators whether a
+        # preemption round would land on swap or degrade to recompute
+        hp = lifecycle.host_pool
+        headroom = max(0, hp.capacity_bytes - hp.bytes_used)
+        if getattr(lifecycle, "disk_pool", None) is not None:
+            headroom += max(0, lifecycle.disk_pool.capacity_bytes
+                            - lifecycle.disk_pool.bytes_used)
+        hint["swap_headroom_bytes"] = headroom
         if self.slo is not None:
             waited = pool_view["now"] - pool_view["t_submit"]
             slack = self.slo.slack_s(waited)

@@ -97,6 +97,10 @@ GROUP_SUMMED_KEYS: Tuple[str, ...] = (
     # migration volume and the per-role admission split
     "kv_transfer_out", "kv_transfer_in", "kv_transfer_bytes",
     "role_prefill_requests", "role_decode_requests",
+    # ISSUE 18: hierarchical KV storage — disk-tier traffic, async
+    # swap-out harvests, and lost-spill recompute fallbacks, fleet-wide
+    "kv_disk_pool_bytes", "kv_disk_demotions", "kv_disk_promotions",
+    "kv_swap_harvests", "kv_pending_swaps", "kv_swap_lost",
     # ISSUE 14: group snapshot_seq = per-replica scheduler-iteration
     # counters summed — still strictly monotonic while any replica steps,
     # so scrapers can detect stale/torn fleet snapshots the same way

@@ -26,7 +26,15 @@ cause                                   charged for
                                         XLA compile (``compile: True``)
 ``preempt_recompute``                   recompute-mode preemption spans +
                                         resumed re-prefill
-``preempt_swap_io``                     swap-mode preemption + swap-in
+``preempt_swap_io``                     swap-mode preemption + swap-in +
+                                        the deferred async harvest
+                                        (``swap_out_async``) — the
+                                        device-gather side of swap IO
+``preempt_disk_io``                     the disk-tier side (ISSUE 18):
+                                        host->disk demotion spans
+                                        (``disk_demote``) and disk->host
+                                        promotion at swap-in
+                                        (``disk_promote``)
 ``kv_transfer``                         disaggregated prefill->decode KV
                                         migration: the export gather on
                                         the prefill replica and the
@@ -80,6 +88,7 @@ CAUSES: Tuple[str, ...] = (
     "jit_compile",
     "preempt_recompute",
     "preempt_swap_io",
+    "preempt_disk_io",
     "kv_transfer",
     "scheduler_other",
 )
@@ -118,8 +127,15 @@ def event_cause(ev: dict) -> str:
     if ph == "preempt":
         return "preempt_swap_io" if ev.get("mode") == "swap" \
             else "preempt_recompute"
-    if ph == "swap_in":
+    if ph in ("swap_in", "swap_out_async"):
         return "preempt_swap_io"
+    if ph in ("disk_demote", "disk_promote"):
+        return "preempt_disk_io"
+    if ph == "swap_pending":
+        # async swap-out limbo (ISSUE 18): the victim waits for its
+        # chunk-boundary harvest with the scheduler NOT stalled — queue
+        # time, exactly like the requeue wait that follows it
+        return "queue_wait"
     if ph == "kv_transfer":
         return "kv_transfer"
     if ph == "retire":

@@ -55,7 +55,11 @@ N_HEAT_DECILES = 10
 # reference rates for the recompute-vs-swap estimates (PERF.md): a PCIe4
 # x16-class host link and a mid-size accelerator's usable matmul rate.
 # They set the swap/recompute VERDICT scale, not any measured number —
-# both are overridable per observatory.
+# both are overridable per observatory. A lifecycle-armed engine
+# overrides the swap rate at init (ISSUE 18): one tiny warmup gather
+# round-trip feeds KVLifecycleManager.calibrate(), so the REAL engine's
+# verdicts use this host's measured bandwidth; the default below only
+# governs dry-run forensics and manager instances built by hand.
 DEFAULT_SWAP_BYTES_PER_SEC = 16e9
 DEFAULT_FLOPS_PER_SEC = 100e12
 # block-age histogram buckets, in scheduler iterations

@@ -56,7 +56,13 @@ token_parity=True, carry BOTH mixes (ttft_heavy + tpot_heavy) with
 colocated/disagg sides and a winner each, a boolean different_winners
 headline — reported honestly whichever way it lands — and a transfer
 block with positive migrated bytes, else the disagg side never
-actually disaggregated).
+actually disaggregated). ISSUE 18 adds `kv_hierarchy` (the three-tier
+HBM→host→disk overcommit run — CPU-runnable and always present;
+measured entries must prove token parity + conservation + drained
+pools for BOTH swap pipelines, real disk demotions AND promotions,
+an async pipeline that harvested >= 1 deferred readback and reduced
+p99 preempt_swap_io blame vs sync, a >= 3x int8 spill-byte shrink,
+and a calibrated swap bandwidth).
 bench.py calls
 `assert_valid` on the dict it is about to print, and
 tests/test_bench_schema.py re-validates the committed artifact, so the
@@ -372,6 +378,79 @@ def validate_artifact(art: dict) -> List[str]:
             if swap.get("host_pool_drained") is not True:
                 errs.append("kv_lifecycle.swap.host_pool_drained must be "
                             "True — swapped blocks leaked in host RAM")
+
+    # Hierarchical KV storage (ISSUE 18): CPU-runnable three-tier
+    # overcommit run, so always present; when measured BOTH swap
+    # pipelines (async and sync) must prove the in-bench assertions held
+    # (token parity vs the never-evicted reference, completion,
+    # conservation every iteration, drained pools, zero stranded spill
+    # files), both must have actually demoted to AND promoted from the
+    # disk tier (else the host-pool cap never forced the third tier),
+    # the async side must have harvested >= 1 deferred readback and
+    # REDUCED p99 preempt_swap_io blame vs sync on the same schedule,
+    # and the int8 spill must move >= 3x fewer bytes per eviction than
+    # float through the same ladder
+    kh = e.get("kv_hierarchy")
+    if not isinstance(kh, dict):
+        errs.append("extra['kv_hierarchy'] missing or not a dict (the "
+                    "three-tier overcommit run is CPU-runnable — emit "
+                    "error/skipped entries rather than dropping it)")
+    elif "error" not in kh and "skipped_reason" not in kh:
+        if not isinstance(kh.get("platform"), str):
+            errs.append("extra['kv_hierarchy'] has no 'platform' label")
+        if not _is_num(kh.get("overcommit")) or kh.get("overcommit", 0) < 2:
+            errs.append("kv_hierarchy.overcommit missing or < 2 — the "
+                        "workload never forced real pool exhaustion")
+        for mode in ("async", "sync"):
+            row = kh.get(mode)
+            if not isinstance(row, dict):
+                errs.append(f"kv_hierarchy.{mode} missing or not a dict")
+                continue
+            for flag in ("tokens_identical", "all_completed",
+                         "conserved_every_step", "host_pool_drained",
+                         "no_stranded_spills"):
+                if row.get(flag) is not True:
+                    errs.append(f"kv_hierarchy.{mode}.{flag} must be True")
+            for k in ("preemptions", "disk_demotions", "disk_promotions"):
+                if not _is_num(row.get(k)) or row.get(k, 0) < 1:
+                    errs.append(f"kv_hierarchy.{mode}.{k} missing or < 1 "
+                                "— the three-tier ladder was never "
+                                "exercised")
+        arow = kh.get("async")
+        if isinstance(arow, dict) and (
+                not _is_num(arow.get("harvests"))
+                or arow.get("harvests", 0) < 1):
+            errs.append("kv_hierarchy.async.harvests missing or < 1 — "
+                        "the async pipeline never deferred a readback")
+        ab = kh.get("async_vs_sync")
+        if not isinstance(ab, dict):
+            errs.append("kv_hierarchy.async_vs_sync missing or not a dict")
+        else:
+            if ab.get("async_p99_reduced") is not True:
+                errs.append("kv_hierarchy.async_vs_sync.async_p99_reduced "
+                            "must be True — the deferred harvest did not "
+                            "beat the blocking readback")
+            for k in ("p99_preempt_swap_io_s_async",
+                      "p99_preempt_swap_io_s_sync"):
+                if not _is_num(ab.get(k)) or ab.get(k, -1) < 0:
+                    errs.append(f"kv_hierarchy.async_vs_sync.{k} missing "
+                                "or negative")
+        qs = kh.get("quant_spill")
+        if not isinstance(qs, dict):
+            errs.append("kv_hierarchy.quant_spill missing or not a dict")
+        else:
+            if qs.get("tokens_identical") is not True:
+                errs.append("kv_hierarchy.quant_spill.tokens_identical "
+                            "must be True (vs the int8 never-evicted "
+                            "reference)")
+            ratio = qs.get("spill_bytes_ratio")
+            if not _is_num(ratio) or ratio < 3.0:
+                errs.append("kv_hierarchy.quant_spill.spill_bytes_ratio "
+                            "missing or < 3 — the int8 shrink never "
+                            "reached the swap path")
+        if not _is_num(kh.get("measured_swap_gbps")):
+            errs.append("kv_hierarchy.measured_swap_gbps missing or not "
+                        "a number — no calibration round-trip was timed")
 
     # Latency blame ledger (ISSUE 14): CPU-runnable forced-contention
     # attribution run, so always present; when measured it must prove the

@@ -310,6 +310,49 @@ def _kv_lifecycle_lines(kl) -> list:
     return [line]
 
 
+def _kv_hierarchy_lines(kh) -> list:
+    """Hierarchical KV section from extra['kv_hierarchy'] (ISSUE 18):
+    the three-tier (HBM -> host -> disk) overcommit run where every
+    swapped victim spills through the disk tier, rendered with the two
+    headline measurements — the async-vs-sync p99 swap-blame A/B and
+    the int8 spill-byte shrink."""
+    if not isinstance(kh, dict) or not isinstance(kh.get("async"), dict):
+        if isinstance(kh, dict) and (kh.get("skipped_reason")
+                                     or kh.get("error")):
+            return [f"- Hierarchical KV storage: "
+                    f"{kh.get('skipped_reason') or kh.get('error')} "
+                    f"(platform: {kh.get('platform', '?')})."]
+        return []
+    a, ab = kh["async"], kh.get("async_vs_sync", {})
+    qs = kh.get("quant_spill", {})
+    gbps = kh.get("measured_swap_gbps")
+    line = (
+        f"- Hierarchical KV storage (ISSUE 18, {kh.get('platform', '?')}, "
+        f"{kh.get('overcommit', '?')}x overcommit over a "
+        f"{kh.get('host_pool_bytes', '?')}-byte host pool): every swap "
+        f"demotes through the DISK tier and promotes back "
+        f"({a.get('disk_demotions', 0)} demotions / "
+        f"{a.get('disk_promotions', 0)} promotions, async side) with "
+        f"greedy tokens **bit-identical** to the never-evicted reference "
+        f"for BOTH swap pipelines. Async swap-out (dispatch at "
+        f"preemption, harvest at the next chunk boundary — "
+        f"{a.get('harvests', 0)} deferred readbacks) cuts p99 "
+        f"`preempt_swap_io` blame to "
+        f"{(ab.get('p99_preempt_swap_io_s_async') or 0) * 1e3:.2f} ms vs "
+        f"{(ab.get('p99_preempt_swap_io_s_sync') or 0) * 1e3:.2f} ms "
+        f"blocking, and the int8 tier spills "
+        f"{qs.get('spill_bytes_ratio', '?')}x fewer bytes per eviction "
+        f"({qs.get('bytes_per_eviction_int8', 0):,.0f} vs "
+        f"{qs.get('bytes_per_eviction_float', 0):,.0f})"
+        + (f"; calibrated swap round-trip {gbps:.2f} GB/s"
+           if gbps is not None else "")
+        + ". Conservation, completion, drained pools and zero stranded "
+        "spill files asserted in-bench. `DL4J_TPU_KV_DISK` / "
+        "`DL4J_TPU_KV_DISK_BYTES` / `DL4J_TPU_KV_SWAP_ASYNC` — see "
+        "README \"Hierarchical KV storage\".")
+    return [line]
+
+
 def _blame_attribution_lines(ba) -> list:
     """Latency blame section from extra['blame_attribution'] (ISSUE 14):
     the forced-contention run where every request's submit->retire wall
@@ -633,6 +676,7 @@ def render_block(art: dict) -> str:
     lines.extend(_spec_decode_lines(e.get("serving_spec_decode")))
     lines.extend(_kv_observatory_lines(e.get("kv_observatory")))
     lines.extend(_kv_lifecycle_lines(e.get("kv_lifecycle")))
+    lines.extend(_kv_hierarchy_lines(e.get("kv_hierarchy")))
     lines.extend(_blame_attribution_lines(e.get("blame_attribution")))
     lines.extend(_quantized_kv_lines(e.get("quantized_kv")))
     lines.extend(_prefix_radix_lines(e.get("prefix_radix")))

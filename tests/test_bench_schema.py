@@ -88,6 +88,29 @@ def _minimal_art():
                          "evictions_recompute": 0, "evictions_swap": 160,
                          "measured_swap_gbps": 0.5,
                          "host_pool_drained": True}},
+            "kv_hierarchy": {
+                "platform": "cpu", "overcommit": 3.0, "kv_blocks": 10,
+                "host_pool_bytes": 1024,
+                "async": {"tokens_identical": True, "all_completed": True,
+                          "conserved_every_step": True, "preemptions": 32,
+                          "evictions_swap": 32, "harvests": 32,
+                          "disk_demotions": 32, "disk_promotions": 32,
+                          "host_pool_drained": True,
+                          "no_stranded_spills": True},
+                "sync": {"tokens_identical": True, "all_completed": True,
+                         "conserved_every_step": True, "preemptions": 160,
+                         "evictions_swap": 160, "harvests": 0,
+                         "disk_demotions": 160, "disk_promotions": 160,
+                         "host_pool_drained": True,
+                         "no_stranded_spills": True},
+                "async_vs_sync": {"p99_preempt_swap_io_s_async": 0.62,
+                                  "p99_preempt_swap_io_s_sync": 0.67,
+                                  "async_p99_reduced": True},
+                "quant_spill": {"bytes_per_eviction_float": 10240.0,
+                                "bytes_per_eviction_int8": 2640.0,
+                                "spill_bytes_ratio": 3.88,
+                                "tokens_identical": True},
+                "measured_swap_gbps": 0.013},
             "blame_attribution": {
                 "platform": "cpu", "conserved": True,
                 "tokens_identical": True, "sync_parity": True,
@@ -417,6 +440,57 @@ def test_kv_lifecycle_rules():
     art["extra"]["kv_lifecycle"] = {"error": "ValueError: boom"}
     assert validate_artifact(art) == []
     art["extra"]["kv_lifecycle"] = {"platform": "cpu",
+                                    "skipped_reason": "why not"}
+    assert validate_artifact(art) == []
+
+
+def test_kv_hierarchy_rules():
+    """ISSUE 18: the three-tier overcommit run must always exist; a
+    measured entry must prove parity/conservation/drained pools for
+    BOTH swap pipelines, real disk demotions AND promotions, an async
+    side that harvested deferred readbacks and reduced p99 swap blame,
+    a >= 3x int8 spill shrink, and a calibrated bandwidth;
+    errored/skipped entries are exempt."""
+    art = _minimal_art()
+    del art["extra"]["kv_hierarchy"]
+    assert any("kv_hierarchy" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_hierarchy"]["overcommit"] = 1.5
+    assert any("overcommit" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_hierarchy"]["async"]["tokens_identical"] = False
+    assert any("async.tokens_identical" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_hierarchy"]["sync"]["disk_demotions"] = 0
+    assert any("sync.disk_demotions" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_hierarchy"]["async"]["disk_promotions"] = 0
+    assert any("async.disk_promotions" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_hierarchy"]["async"]["harvests"] = 0
+    assert any("harvests" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_hierarchy"]["async"]["no_stranded_spills"] = False
+    assert any("no_stranded_spills" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_hierarchy"]["async_vs_sync"]["async_p99_reduced"] = False
+    assert any("async_p99_reduced" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["kv_hierarchy"]["async_vs_sync"][
+        "p99_preempt_swap_io_s_sync"]
+    assert any("p99_preempt_swap_io_s_sync" in e
+               for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_hierarchy"]["quant_spill"]["spill_bytes_ratio"] = 2.4
+    assert any("spill_bytes_ratio" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["kv_hierarchy"]["measured_swap_gbps"]
+    assert any("kv_hierarchy.measured_swap_gbps" in e
+               for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["kv_hierarchy"] = {"error": "ValueError: boom"}
+    assert validate_artifact(art) == []
+    art["extra"]["kv_hierarchy"] = {"platform": "cpu",
                                     "skipped_reason": "why not"}
     assert validate_artifact(art) == []
 

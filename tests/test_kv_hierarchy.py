@@ -1,0 +1,438 @@
+"""Hierarchical KV storage tests (ISSUE 18): the disk tier below the
+host pool, async swap-out harvesting, quantized spill accounting, and
+the calibrated swap-bandwidth cost model.
+
+The load-bearing guarantees:
+
+- TOKEN PARITY THROUGH THE FULL LADDER: greedy token streams are
+  bit-identical to a never-evicted run when victims round-trip
+  HBM -> host pool -> disk -> host -> HBM, with async swap-out on or
+  off (the acceptance bar).
+- CRASH SAFETY: a kill mid-demotion leaves a ``.tmp`` the next pool
+  construction sweeps; corrupt or truncated spill files load as empty
+  with a warning (never an exception); a read error leaves no
+  partially-promoted entry.
+- LOST SPILLS COST COMPUTE, NOT TOKENS: a swap payload that vanishes
+  flips the victim to recompute-resume and the stream stays correct.
+- QUANTIZED SPILL: with int8 KV on, swap traffic shrinks >= 3x vs the
+  float engine for the same schedule.
+- HOST-POOL FETCH is non-destructive on failure (the ISSUE 18
+  satellite regression): an entry whose materialization raises stays
+  in the pool, bytes intact.
+"""
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.serving.engine import Request, ServingEngine
+from deeplearning4j_tpu.serving.kv_disk import (DiskBlockPool,
+                                                resolve_disk_pool)
+from deeplearning4j_tpu.serving.lifecycle import (HostBlockPool,
+                                                  KVLifecycleManager,
+                                                  PersistentPrefixStore)
+from deeplearning4j_tpu.telemetry import blame
+from deeplearning4j_tpu.telemetry.kv_observatory import \
+    DEFAULT_SWAP_BYTES_PER_SEC
+
+from tests.test_serving import _build_net
+
+PROMPTS = [[1, 2, 3, 4, 5, 6], [7, 8, 9, 10, 11, 12],
+           [2, 4, 6, 8, 10, 12], [9, 7, 5, 3, 1, 2]]
+
+
+def _engine(net, **kw):
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("seed", 3)
+    kw.setdefault("decode_chunk", 1)
+    kw.setdefault("overlap", False)
+    kw.setdefault("kv_block", 4)
+    kw.setdefault("prefix_share", True)
+    return ServingEngine(net, **kw)
+
+
+def _tokens(results):
+    return [r.tokens for r in results]
+
+
+def _rt(shape=(2, 3, 4, 2), seed=0):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal(shape).astype(np.float32)
+
+
+# ------------------------------------------- host pool fetch regression
+class _Boom:
+    """An array-like whose materialization fails — stands in for a lazy
+    device value whose readback raises mid-restore."""
+
+    def __array__(self, dtype=None, copy=None):
+        raise RuntimeError("materialization failed")
+
+
+def test_host_pool_fetch_is_non_destructive_on_failure():
+    """The satellite regression: fetch() used to pop the entry and
+    decrement bytes BEFORE materializing, so a failed restore lost the
+    payload forever. Now it peeks, materializes, and only then removes."""
+    pool = HostBlockPool(capacity_bytes=1 << 20)
+    pool.put("req", _Boom(), _Boom(), 256)
+    with pytest.raises(RuntimeError):
+        pool.fetch("req")
+    # the entry survived the failed restore, bytes intact
+    assert "req" in pool and pool.bytes_used == 256
+    # a good payload still round-trips after the failure
+    pool.drop("req")
+    k, v = _rt(seed=1), _rt(seed=2)
+    pool.put("req", k, v, 256)
+    k2, v2 = pool.fetch("req")
+    np.testing.assert_array_equal(k2, k)
+    np.testing.assert_array_equal(v2, v)
+    assert pool.bytes_used == 0 and pool.n_entries == 0
+
+
+def test_host_pool_materialize_and_pop_lru():
+    pool = HostBlockPool(capacity_bytes=1 << 20)
+    pool.put("a", _rt(seed=3), _rt(seed=4), 100)
+    pool.put("b", _rt(seed=5), _rt(seed=6), 50)
+    assert pool.materialize("a") == 100           # in-place, idempotent
+    assert pool.materialize("a") == 100
+    assert pool.materialize("missing") == 0       # demoted-under-us: no-op
+    key, k, v, n, sc = pool.pop_lru()             # insertion order: "a"
+    assert key == "a" and n == 100 and sc is None
+    assert pool.bytes_used == 50 and pool.n_entries == 1
+
+
+# ------------------------------------------------------ disk tier units
+def test_disk_pool_round_trip_both_namespaces(tmp_path):
+    pool = DiskBlockPool(str(tmp_path), capacity_bytes=1 << 20)
+    k, v = _rt(seed=7), _rt(seed=8)
+    ks, vs = _rt((2, 3, 4), 9), _rt((2, 3, 4), 10)
+    pool.put(7, k, v, k.nbytes + v.nbytes)                 # swap namespace
+    pool.put(b"\x01\x02", k, v, k.nbytes + v.nbytes,       # prefix digest
+             k_scale=ks, v_scale=vs)
+    assert 7 in pool and b"\x01\x02" in pool and pool.n_entries == 2
+    assert pool.bytes_used > 0 and pool.can_fit(1 << 10)
+    k2, v2, sc = pool.fetch(7)
+    np.testing.assert_array_equal(k2, k)
+    np.testing.assert_array_equal(v2, v)
+    assert sc is None
+    k3, v3, sc3 = pool.fetch(b"\x01\x02")
+    np.testing.assert_array_equal(k3, k)
+    np.testing.assert_array_equal(sc3[0], ks)
+    np.testing.assert_array_equal(sc3[1], vs)
+    # fetch removes: entries, bytes, and the files themselves
+    assert pool.n_entries == 0 and pool.bytes_used == 0
+    assert [f for f in os.listdir(str(tmp_path)) if f.endswith(".npz")] == []
+    with pytest.raises(KeyError):
+        pool.fetch(7)
+
+
+def test_disk_pool_lru_eviction_under_cap(tmp_path):
+    pool = DiskBlockPool(str(tmp_path), capacity_bytes=1 << 20)
+    big = _rt((64, 64), 11)
+    pool.put(1, big, big, 2 * big.nbytes)
+    one_entry = pool.bytes_used
+    pool.capacity_bytes = int(one_entry * 1.5)    # room for ~1.5 entries
+    pool.put(2, big, big, 2 * big.nbytes)         # evicts the LRU (key 1)
+    assert 1 not in pool and 2 in pool
+    assert pool.bytes_used <= pool.capacity_bytes
+
+
+def test_disk_pool_crash_safety_recovery(tmp_path):
+    """Kill mid-demotion leaves a .tmp; a dead engine leaves swap_ files;
+    bitrot leaves a garbage pfx_ file. A fresh pool over the directory
+    sweeps all three — the corrupt one with a warning, never a raise."""
+    d = str(tmp_path)
+    good = DiskBlockPool(d, capacity_bytes=1 << 20)
+    k = _rt(seed=12)
+    good.put(b"\xaa", k, k, 2 * k.nbytes)
+    good.put(5, k, k, 2 * k.nbytes)
+    (tmp_path / "pfx_bb.npz.tmp").write_bytes(b"half-written demotion")
+    (tmp_path / "pfx_cc.npz").write_bytes(b"this is not a zip file")
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        fresh = DiskBlockPool(d, capacity_bytes=1 << 20)
+    assert any("unreadable" in str(x.message) for x in w)
+    assert fresh.n_corrupt == 1
+    # only the intact pfx_ entry survives: tmp swept, corrupt removed,
+    # the stale swap entry dropped (request ids are process-scoped)
+    assert fresh.n_entries == 1 and b"\xaa" in fresh and 5 not in fresh
+    keep_hex = b"\xaa".hex()
+    assert sorted(os.listdir(d)) == [f"pfx_{keep_hex}.npz"]
+    k2, v2, _ = fresh.fetch(b"\xaa")
+    np.testing.assert_array_equal(k2, k)
+
+
+def test_disk_pool_fetch_of_rotted_file_is_a_miss(tmp_path):
+    """A file that rots AFTER the put: fetch warns, drops the entry
+    (no partially-promoted state), and raises KeyError so the caller
+    treats it as a miss."""
+    pool = DiskBlockPool(str(tmp_path), capacity_bytes=1 << 20)
+    k = _rt(seed=13)
+    pool.put(9, k, k, 2 * k.nbytes)
+    path = os.path.join(str(tmp_path), "swap_9.npz")
+    with open(path, "wb") as f:
+        f.write(b"PK\x03\x04truncated")          # valid magic, rotten body
+    with warnings.catch_warnings(record=True) as w:
+        warnings.simplefilter("always")
+        with pytest.raises(KeyError):
+            pool.fetch(9)
+    assert any("unreadable" in str(x.message) for x in w)
+    assert 9 not in pool and pool.n_entries == 0 and pool.n_corrupt == 1
+    assert not os.path.exists(path)
+
+
+def test_resolve_disk_pool_knobs(tmp_path, monkeypatch):
+    monkeypatch.delenv("DL4J_TPU_KV_DISK", raising=False)
+    assert resolve_disk_pool(None) is None
+    assert resolve_disk_pool("") is None and resolve_disk_pool("0") is None
+    inst = DiskBlockPool(str(tmp_path / "a"))
+    assert resolve_disk_pool(inst) is inst
+    pool = resolve_disk_pool(str(tmp_path / "b"), 1 << 16)
+    assert pool.directory == str(tmp_path / "b")
+    assert pool.capacity_bytes == 1 << 16
+    monkeypatch.setenv("DL4J_TPU_KV_DISK", str(tmp_path / "c"))
+    monkeypatch.setenv("DL4J_TPU_KV_DISK_BYTES", str(1 << 20))
+    env_pool = resolve_disk_pool(None)
+    assert env_pool.capacity_bytes == 1 << 20
+    monkeypatch.setenv("DL4J_TPU_KV_DISK", "0")
+    assert resolve_disk_pool(None) is None
+
+
+# ------------------------------------ manager: demotion/promotion units
+def test_can_absorb_and_choose_mode_through_disk(tmp_path):
+    """choose_mode's swap verdict consults the WHOLE ladder: a payload
+    the host pool can't hold still swaps when demotion (or direct-disk
+    spill) makes room."""
+    no_disk = KVLifecycleManager(policy="lru", swap_bytes=100, mode="swap")
+    assert not no_disk.can_absorb(200)
+    mgr = KVLifecycleManager(
+        policy="lru", swap_bytes=100, mode="swap",
+        disk_pool=DiskBlockPool(str(tmp_path), capacity_bytes=1000))
+    assert mgr.can_absorb(50)          # host fits directly
+    mgr.host_pool.put("old", _rt(seed=14), _rt(seed=15), 80)
+    assert mgr.can_absorb(90)          # demoting "old" makes room
+    assert mgr.can_absorb(600)         # bigger than host cap: direct disk
+    assert not mgr.can_absorb(2000)    # bigger than the whole ladder
+    assert mgr.choose_mode({"cheaper": "recompute"}, 90) == "swap"
+    assert mgr.choose_mode({"cheaper": "swap"}, 2000) == "recompute"
+
+
+def test_rebalance_demotes_lru_and_swap_in_promotes(tmp_path):
+    mgr = KVLifecycleManager(
+        policy="lru", swap_bytes=300, mode="swap",
+        disk_pool=DiskBlockPool(str(tmp_path), capacity_bytes=1 << 20))
+    cold_k, cold_v = _rt(seed=16), _rt(seed=17)
+    mgr.swap_out("cold", cold_k, cold_v, 200)
+    mgr.swap_out("hot", _rt(seed=18), _rt(seed=19), 200)   # over cap: 400
+    assert mgr.host_pool.bytes_used == 400         # transient overshoot
+    res = mgr.rebalance()
+    assert res["demotions"] == 1 and res["bytes"] == 200
+    assert mgr.host_pool.bytes_used == 200         # back under cap
+    assert "cold" in mgr.disk_pool and "hot" in mgr.host_pool
+    assert mgr.has_swap("cold") and mgr.has_swap("hot")
+    k, v, sc, info = mgr.swap_in("cold", 200)      # the promotion path
+    assert info["tier"] == "disk" and info["disk_wall_s"] >= 0
+    np.testing.assert_array_equal(k, cold_k)
+    np.testing.assert_array_equal(v, cold_v)
+    assert mgr.disk_promotions == 1 and "cold" not in mgr.disk_pool
+    k2, v2, sc2, info2 = mgr.swap_in("hot", 200)
+    assert info2["tier"] == "host"
+    with pytest.raises(KeyError):
+        mgr.swap_in("gone", 10)
+    mgr.drop("gone")                               # tolerant on every tier
+
+
+def test_rebalance_without_disk_or_pressure_is_noop():
+    mgr = KVLifecycleManager(policy="lru", swap_bytes=1000, mode="swap")
+    mgr.swap_out("a", _rt(seed=20), _rt(seed=21), 100)
+    assert mgr.rebalance() == {"demotions": 0, "bytes": 0, "wall_s": 0.0}
+
+
+# --------------------------------- prefix store spill-through the tier
+def test_prefix_store_spills_through_disk_and_promotes_back(tmp_path):
+    store = PersistentPrefixStore(capacity_bytes=300)
+    store.disk = DiskBlockPool(str(tmp_path), capacity_bytes=1 << 20)
+    k0, v0 = _rt((1, 4, 1, 2), 22), _rt((1, 4, 1, 2), 23)
+    d0, d1 = b"\x01" * 4, b"\x02" * 4
+    store.put(d0, k0, v0, 200, block_shape=k0.shape)
+    store.put(d1, _rt((1, 4, 1, 2), 24), _rt((1, 4, 1, 2), 25), 200,
+              block_shape=k0.shape)               # evicts d0 -> disk
+    assert store.disk_demotions == 1 and d0 in store.disk
+    # covered() promotes the demoted digest back into RAM transparently
+    assert store.covered([d0]) == 1
+    assert store.disk_promotions == 1 and d0 not in store.disk
+    k2, v2 = store.fetch([d0])
+    np.testing.assert_array_equal(k2[:, 0], k0)
+    np.testing.assert_array_equal(v2[:, 0], v0)
+
+
+# --------------------------------------------- engine: the full ladder
+@pytest.mark.parametrize("kv_swap_async", [False, True])
+def test_token_parity_through_all_three_tiers(tmp_path, kv_swap_async):
+    """The acceptance bar: a host pool too small for even ONE victim
+    forces every swap through the disk tier (demotion at rebalance,
+    promotion at swap-in), async harvesting on or off — and the greedy
+    token streams stay bit-identical to the never-evicted run."""
+    net = _build_net(n_kv=2)
+    ref_eng = _engine(net)
+    ref = ref_eng.generate([Request(list(p), max_new_tokens=10)
+                            for p in PROMPTS])
+    ref_eng.shutdown()
+    eng = _engine(net, kv_blocks=9, kv_evict="lru", kv_evict_mode="swap",
+                  kv_swap_bytes=1 << 10,          # ~one block: forces disk
+                  kv_disk=str(tmp_path), kv_disk_bytes=1 << 24,
+                  kv_swap_async=kv_swap_async)
+    res = eng.generate([Request(list(p), max_new_tokens=10)
+                        for p in PROMPTS])
+    assert _tokens(res) == _tokens(ref)
+    assert [r.finish_reason for r in res] == ["length"] * 4
+    s = eng.stats()
+    assert s["kv_evictions_swap"] > 0
+    assert s["kv_disk_demotions"] > 0, "host pressure never reached disk"
+    assert s["kv_disk_promotions"] > 0, "no swap-in promoted from disk"
+    if kv_swap_async:
+        assert s["kv_swap_harvests"] > 0
+        assert s["kv_swap_harvests"] == eng.lifecycle.harvests
+    else:
+        assert s["kv_swap_harvests"] == 0
+    # fully drained: nothing parked on any tier, no limbo victims
+    assert s["kv_pending_swaps"] == 0
+    assert eng.lifecycle.host_pool.n_entries == 0
+    assert eng.lifecycle.disk_pool.n_entries == 0
+    # the spill directory holds no stranded files either
+    assert [f for f in os.listdir(str(tmp_path))
+            if f.endswith(".npz")] == []
+    eng.shutdown()
+
+
+def test_async_swap_spans_tile_and_blame_conserves():
+    """Async swap-out provenance: some preempted request carries the
+    deferred-harvest spans ("swap_pending" limbo then "swap_out_async"
+    materialization) tiling gap-free from the preempt span's end to the
+    requeue "queue" span's start — and the ledger still conserves."""
+    from deeplearning4j_tpu.telemetry.flight_recorder import max_gap_s
+    net = _build_net(n_kv=2)
+    eng = _engine(net, kv_blocks=9, kv_evict="lru", kv_evict_mode="swap",
+                  kv_swap_bytes=1 << 24, kv_swap_async=True)
+    res = eng.generate([Request(list(p), max_new_tokens=10)
+                        for p in PROMPTS])
+    assert eng.stats()["kv_swap_harvests"] > 0
+    saw_async = 0
+    for r in res:
+        phases = [e["phase"] for e in r.timeline]
+        if "swap_out_async" in phases:
+            saw_async += 1
+            for prev, ev in zip(r.timeline, r.timeline[1:]):
+                if prev["phase"] in ("preempt", "swap_pending",
+                                     "swap_out_async"):
+                    assert ev["t0"] == prev["t1"], (prev, ev)
+            i = phases.index("swap_out_async")
+            assert phases[i - 1] == "swap_pending"
+        period = max(e["t1"] - e["t0"] for e in r.timeline)
+        assert max_gap_s(r.timeline) <= max(period, 1e-3)
+        entry = blame.blame_timeline(r.timeline, req_id=r.req_id)
+        blame.assert_conserved(entry)
+    assert saw_async >= 1, "no request carried async swap spans"
+    eng.shutdown()
+
+
+def test_swap_lost_falls_back_to_recompute():
+    """A parked swap payload that vanishes (corrupt spill) must flip the
+    victim to recompute-resume: kv_swap_lost fires and the greedy stream
+    still matches the never-evicted run exactly."""
+    net = _build_net(n_kv=2)
+    ref_eng = _engine(net)
+    ref = ref_eng.generate([Request(list(p), max_new_tokens=10)
+                            for p in PROMPTS])
+    ref_eng.shutdown()
+    eng = _engine(net, kv_blocks=9, kv_evict="lru", kv_evict_mode="swap",
+                  kv_swap_bytes=1 << 24)
+    futs = [eng.submit(Request(list(p), max_new_tokens=10))
+            for p in PROMPTS]
+    lost = 0
+    for _ in range(3000):
+        busy = eng.step()
+        for a in eng._queue:
+            if a.resume is not None and a.resume["mode"] == "swap" \
+                    and eng.lifecycle.has_swap(a.req_id):
+                eng.lifecycle.drop(a.req_id)     # simulate a rotten spill
+                lost += 1
+        if not busy:
+            break
+    assert lost >= 1, "harness no longer forces a swap preemption"
+    res = [f.get(timeout=5) for f in futs]
+    assert _tokens(res) == _tokens(ref)
+    assert eng.stats()["kv_swap_lost"] >= 1
+    eng.shutdown()
+
+
+def test_calibration_replaces_default_bandwidth():
+    """Engine init runs one tiny gather round-trip and installs the
+    measured rate in the cost model — the 16 GB/s guess is gone, and the
+    measurement is visible in stats and the metrics gauge."""
+    net = _build_net(n_kv=2)
+    eng = _engine(net, kv_evict="lru", kv_swap_bytes=1 << 24)
+    assert eng.lifecycle.calibrated_gbps is not None
+    assert eng.lifecycle.calibrated_gbps > 0
+    assert eng.lifecycle.swap_bytes_per_sec != DEFAULT_SWAP_BYTES_PER_SEC
+    s = eng.stats()
+    assert s["kv_measured_swap_gbps"] == pytest.approx(
+        eng.lifecycle.calibrated_gbps)
+    eng.shutdown()
+    # a lifecycle-less engine skips calibration entirely (no gauge drift)
+    off = _engine(net)
+    assert off.stats()["kv_measured_swap_gbps"] == 0
+    off.shutdown()
+
+
+def test_quantized_spill_moves_3x_fewer_bytes(tmp_path):
+    """The int8 engine's swap traffic must be >= 3x smaller than the
+    float engine's for the same forced-eviction schedule — the byte
+    shrink choose_mode's swap-cost term is promised to see."""
+    net = _build_net(n_kv=2)
+    out = {}
+    for name, quant in (("float", False), ("int8", True)):
+        eng = _engine(net, kv_blocks=9, kv_evict="lru",
+                      kv_evict_mode="swap", kv_swap_bytes=1 << 24,
+                      kv_disk=str(tmp_path / name), kv_quant=quant)
+        eng.generate([Request(list(p), max_new_tokens=10)
+                      for p in PROMPTS])
+        s = eng.stats()
+        assert s["kv_evictions_swap"] > 0
+        assert s["kv_swap_out_bytes"] > 0
+        # the pool charge matches the unified per-block formula
+        out[name] = (s["kv_swap_out_bytes"], s["kv_evictions_swap"],
+                     eng.decoder.cache.block_bytes)
+        eng.shutdown()
+    per_ev_f = out["float"][0] / out["float"][1]
+    per_ev_q = out["int8"][0] / out["int8"][1]
+    assert per_ev_f / per_ev_q >= 3.0, (out, per_ev_f / per_ev_q)
+    # and the accounting unit itself shrinks by the same ratio
+    assert out["float"][2] / out["int8"][2] >= 3.0
+
+
+def test_shutdown_resolves_limbo_victims_and_drops_tiers(tmp_path):
+    """shutdown(wait=False) with victims parked in async limbo and
+    swapped requests still queued: every future resolves, and the host
+    pool + disk tier forget the unrestorable payloads (the leak fix)."""
+    net = _build_net(n_kv=2)
+    eng = _engine(net, kv_blocks=9, kv_evict="lru", kv_evict_mode="swap",
+                  kv_swap_bytes=1 << 24, kv_disk=str(tmp_path),
+                  kv_swap_async=True)
+    futs = [eng.submit(Request(list(p), max_new_tokens=12))
+            for p in PROMPTS * 2]
+    for _ in range(600):
+        eng.step()
+        if any(a.resume is not None and a.resume["mode"] == "swap"
+               for a in eng._queue):
+            break
+    else:
+        pytest.fail("harness no longer forces a swap preemption")
+    eng.shutdown(wait=False)
+    for f in futs:
+        f.get(timeout=5)                          # nothing stranded
+    assert eng._pending_swaps == []
+    assert eng.lifecycle.host_pool.n_entries == 0
+    assert eng.lifecycle.disk_pool.n_entries == 0

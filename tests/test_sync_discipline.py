@@ -64,7 +64,11 @@ HOT_PATH_MODULES = sorted(
      # bookkeeping; neither module may ever import jax or read a device
      # buffer (the gather/restore device work stays in engine.py)
      PKG / "serving" / "policy.py",
-     PKG / "serving" / "disagg.py"]
+     PKG / "serving" / "disagg.py",
+     # disk tier (ISSUE 18): demotion/promotion run on pressure paths
+     # under the scheduler lock — every materialization in the spill
+     # writer must be annotated (and counted by its engine callers)
+     PKG / "serving" / "kv_disk.py"]
     + list((PKG / "telemetry").glob("*.py")))
 
 ANNOTATION = "sync-ok:"
@@ -145,7 +149,10 @@ def test_all_hot_path_modules_exist():
             "radix_tree.py",
             # ISSUE 17: the policy subsystem runs at every scheduling
             # decision point and must stay pure host bookkeeping
-            "policy.py", "disagg.py"} <= names
+            "policy.py", "disagg.py",
+            # ISSUE 18: the disk spill tier materializes on pressure
+            # paths only — pinned so its syncs stay annotated
+            "kv_disk.py"} <= names
 
 
 # ------------------------------------------------ scanner self-tests
