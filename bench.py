@@ -1923,6 +1923,149 @@ def bench_blame_attribution(vocab=32, d_model=64, heads=2, kv_heads=1,
     }
 
 
+def bench_ts_alerts(vocab=32, d_model=64, heads=2, kv_heads=1,
+                    calm_n=2, burst_normal=4, burst_timed=6,
+                    prompt_len=6, new_tokens=8, window=8, seed=0):
+    """Windowed time-series + burn-rate alert discrimination (ISSUE 19).
+
+    Three-phase workload on one engine: calm (attainable requests),
+    FORCED OVERLOAD (a burst mixing normal requests with zero-budget
+    timeout requests — every timeout retires as an SLO violation, so the
+    short-window burn rate spikes DETERMINISTICALLY, independent of host
+    speed), then calm again. The bench ASSERTS (not reports):
+
+    - >= 1 ``overload`` alert whose iteration clock falls INSIDE the
+      burst phase, and ZERO alerts (of any kind) stamped inside either
+      calm phase — the multi-window monitor discriminates, it does not
+      just threshold noise;
+    - conservation: the series' final cumulative row equals the engine's
+      own counters exactly, and per-phase windowed deltas sum to the
+      whole-run totals;
+    - ts+alerts on-vs-off bit-parity: identical greedy tokens and
+      identical counted host syncs on the same three-phase schedule.
+
+    CPU-runnable; every artifact carries it."""
+    from deeplearning4j_tpu import (
+        Activation, InputType, NeuralNetConfiguration, RnnOutputLayer,
+        Sgd, WeightInit)
+    from deeplearning4j_tpu.nn.conf.layers.attention import SelfAttentionLayer
+    from deeplearning4j_tpu.nn.multilayer import MultiLayerNetwork
+    from deeplearning4j_tpu.serving import Request, ServingEngine
+    from deeplearning4j_tpu.telemetry.alerts import BurnRateMonitor
+    from deeplearning4j_tpu.telemetry.slo import SLO
+
+    b = (NeuralNetConfiguration.Builder().seed(42)
+         .weight_init(WeightInit.XAVIER)
+         .updater(Sgd(learning_rate=1e-3)).list())
+    for _ in range(2):
+        b.layer(SelfAttentionLayer(n_out=d_model, n_heads=heads,
+                                   n_kv_heads=kv_heads, causal=True,
+                                   block_size=0))
+    b.layer(RnnOutputLayer(n_out=vocab, activation=Activation.SOFTMAX))
+    net = MultiLayerNetwork(
+        b.set_input_type(InputType.recurrent(vocab)).build()).init()
+    rng = np.random.RandomState(seed)
+    max_len = 1 << (prompt_len + new_tokens - 1).bit_length()
+    calm1 = [rng.randint(0, vocab, prompt_len).tolist()
+             for _ in range(calm_n)]
+    burst = [rng.randint(0, vocab, prompt_len).tolist()
+             for _ in range(burst_normal + burst_timed)]
+    calm2 = [rng.randint(0, vocab, prompt_len).tolist()
+             for _ in range(calm_n)]
+    # generous SLO: calm requests always attain; the burst's violations
+    # come from the zero-budget timeouts (finish_reason "timeout" is a
+    # violation by definition), so the forcing is wall-clock-independent
+    slo = SLO(ttft_s=60.0, tpot_s=60.0)
+
+    def run(with_alerts):
+        mon = BurnRateMonitor(slo, short_window=window) \
+            if with_alerts else None
+        eng = ServingEngine(net, max_seqs=2, max_len=max_len, seed=0,
+                            decode_chunk=1, overlap=False,
+                            alerts=mon,
+                            ts_window=window if with_alerts else None)
+        tokens, clocks = [], []
+
+        def phase(prompts, timed=0):
+            futs = [eng.submit(Request(
+                list(p), max_new_tokens=new_tokens,
+                timeout_s=0.0 if i < timed else None))
+                for i, p in enumerate(prompts)]
+            while eng.step():
+                pass
+            clocks.append(eng.decoder.cache.allocator.clock)
+            tokens.extend(f.get().tokens for f in futs)
+
+        phase(calm1)
+        phase(burst, timed=burst_timed)       # timeouts listed FIRST
+        phase(calm2)
+        st = eng.stats()
+        eng.shutdown()
+        return tokens, st, clocks, mon, eng
+
+    tok_on, st_on, clocks, mon, eng_on = run(True)
+    tok_off, st_off, _, _, _ = run(False)
+    assert tok_on == tok_off, \
+        "ts+alerts on/off changed decoded tokens — parity violation"
+    assert st_on["host_syncs"] == st_off["host_syncs"], \
+        "ts+alerts added host syncs — sampling must be host-only"
+    c1, c2, c3 = clocks
+    alerts = mon.alerts()
+    overload_in_burst = [a for a in alerts
+                         if a.kind == "overload" and c1 < a.iter <= c2]
+    calm_alerts = [a for a in alerts if a.iter <= c1 or a.iter > c2]
+    assert len(overload_in_burst) >= 1, \
+        "forced overload fired no overload alert inside the burst phase"
+    assert not calm_alerts, \
+        f"alerts fired in a CALM phase: {[(a.kind, a.iter) for a in calm_alerts]}"
+    assert st_on["slo_violations"] == burst_timed, \
+        "violation count drifted from the forced timeout count"
+    # conservation: the series' last cumulative row IS the counter state
+    ts = eng_on.timeseries
+    whole = ts.window(len(ts))
+    assert whole.last("tokens_out") == st_on["tokens_out"]
+    assert whole.last("slo_violations") == st_on["slo_violations"]
+    assert whole.last("host_syncs") == st_on["host_syncs"]
+    # and disjoint per-phase deltas tile the run total exactly
+    rows = ts.series.tail(len(ts))
+    idx = {f: i for i, f in enumerate(ts.series.fields)}
+    for field in ("tokens_out", "retirements", "slo_violations"):
+        col = rows[:, idx[field]]
+        cuts = [0, len(col) // 3, 2 * len(col) // 3, len(col) - 1]
+        parts = sum(col[b] - col[a] for a, b in zip(cuts, cuts[1:]))
+        assert parts == col[-1] - col[0], \
+            f"windowed {field} deltas failed conservation"
+    peak_burn = max(a.value for a in overload_in_burst)
+    return {
+        "platform": _platform(),
+        "workload": (f"{calm_n} calm + ({burst_normal} normal + "
+                     f"{burst_timed} zero-budget-timeout) burst + "
+                     f"{calm_n} calm, {new_tokens} greedy tokens, "
+                     f"short window {window} iters (long {window * 10})"),
+        "short_window": window,
+        "phase_clocks": {"calm1": [1, c1], "burst": [c1 + 1, c2],
+                         "calm2": [c2 + 1, c3]},
+        "overload_alerts_in_burst": len(overload_in_burst),
+        "alerts_in_calm": 0,             # asserted above
+        "alerts_total": st_on["alerts_total"],
+        "alert_kinds": mon.counts(),
+        "peak_burn_rate_short": round(peak_burn, 4),
+        "slo_violations": st_on["slo_violations"],
+        "conservation": True,            # asserted above
+        "tokens_identical": True,        # asserted vs alerts-off run
+        "sync_parity": True,             # asserted vs alerts-off run
+        "host_syncs": st_on["host_syncs"],
+        "ts_samples": st_on["ts"]["samples"],
+        "tokens_per_s_short_window": round(st_on["ts"]["tokens_per_s"], 2),
+        "note": ("overload-in-burst/zero-in-calm, conservation (final "
+                 "series row == engine counters; disjoint window deltas "
+                 "tile the totals), and on/off token + host-sync "
+                 "bit-parity are ASSERTED; violations are forced via "
+                 "zero-budget timeout requests in the middle phase, so "
+                 "the burn-rate spike is deterministic on any host"),
+    }
+
+
 def bench_quantized_kv(vocab=32, d_model=128, heads=2, kv_heads=1,
                        n_requests=4, prompt_len=48, new_tokens=32,
                        rounds=3, seed=0):
@@ -2857,6 +3000,12 @@ def main():
         quant_kv = bench_quantized_kv()
     except Exception as e:
         quant_kv = {"error": f"{type(e).__name__}: {e}"}
+    try:  # windowed time-series + burn-rate alert discrimination (ISSUE 19):
+        # forced-overload middle phase must page, calm phases must stay
+        # silent; conservation + on/off bit-parity asserted inside
+        ts_alerts = bench_ts_alerts()
+    except Exception as e:
+        ts_alerts = {"error": f"{type(e).__name__}: {e}"}
     try:  # radix prefix cache: multi-turn/fork cross-turn reuse (ISSUE 16)
         radix_ab = bench_prefix_radix()
     except Exception as e:
@@ -2976,6 +3125,12 @@ def main():
             # parity asserted in-bench, per-mix winners disclosed
             # whichever way they land (ISSUE 17)
             "serving_disagg_ab": disagg_ab,
+            # pre-rounded; always present — CPU-runnable forced-overload
+            # alert discrimination: >=1 overload page inside the burst,
+            # zero alerts in calm phases, windowed-delta conservation and
+            # ts+alerts on/off token + host-sync bit-parity all asserted
+            # in-bench (ISSUE 19)
+            "ts_alerts": ts_alerts,
             "decode_tokens_per_sec": round(
                 decode.get("decode_tokens_per_sec", 0.0), 1),
             "serving_profile": serving_profile,

@@ -442,6 +442,9 @@ class ServingEngine:
                  prefix_radix: Optional[bool] = None,
                  policy=None,
                  radix_ttl: Optional[int] = None,
+                 timeseries=None,
+                 ts_window: Optional[int] = None,
+                 alerts=None,
                  name: Optional[str] = None):
         self.decoder = self._build_decoder(net, max_seqs, max_len,
                                            dtype=dtype,
@@ -854,6 +857,67 @@ class ServingEngine:
             self.lifecycle.calibrate(cal_bytes,
                                      time.perf_counter() - t_cal)
             self._g_swap_gbps.set(self.lifecycle.calibrated_gbps)
+        # windowed time-series + burn-rate alerts (ISSUE 19): one sample
+        # per scheduler iteration over host-visible state only — counter
+        # values, histogram ring quantiles, queue bookkeeping — so the
+        # on-vs-off token/sync sequence is BIT-identical (parity-tested).
+        # Disabled (the default) means None objects and zero code on any
+        # scheduler path. Enable via timeseries=/alerts= or DL4J_TPU_TS /
+        # DL4J_TPU_ALERTS; alerts imply the series they evaluate over.
+        from deeplearning4j_tpu.telemetry import alerts as _alerts_mod
+        from deeplearning4j_tpu.telemetry import timeseries as _ts_mod
+        self.alerts = _alerts_mod.resolve_alerts(
+            alerts, slo=getattr(self.policy, "slo", None),
+            short_window=ts_window)
+        if self.alerts is not None and timeseries is None:
+            timeseries = True
+        if isinstance(timeseries, bool) or timeseries is None:
+            self.timeseries = _ts_mod.ServingTimeSeries(
+                short_window=ts_window) \
+                if _ts_mod.resolve_ts_enabled(timeseries) else None
+        else:
+            self.timeseries = timeseries
+        # the budget `serving.slo_violations` counts against: the
+        # monitor's, else the admission policy's, else the flight
+        # recorder's — whichever budget this engine already knows
+        self._slo_budget = None
+        for src in (self.alerts, self.policy, self.flight_recorder):
+            budget = getattr(src, "slo", None)
+            if budget is not None:
+                self._slo_budget = budget
+                break
+        self._c_slo_viol = self.metrics.counter(
+            "serving.slo_violations", "retired requests that violated "
+            "the configured SLO budget (counted host-side at retirement; "
+            "0 when no budget is configured)")
+        self._c_alerts = self.metrics.counter(
+            "serving.alerts_total", "burn-rate monitor alerts emitted "
+            "(ISSUE 19)")
+        self._h_tpot = self.metrics.histogram(
+            "serving.tpot_s", "decode time-per-output-token per retired "
+            "request (latency minus TTFT over tokens after the first)",
+            buckets=telemetry.DEFAULT_S_BUCKETS)
+        self._ts_gauges: Dict[str, object] = {}
+        self._ts_blame_gauges: Dict[str, object] = {}
+        if self.timeseries is not None:
+            for key in (self.timeseries.RATE_KEYS
+                        + self.timeseries.LEVEL_KEYS
+                        + ("tokens_per_s_long",)):
+                self._ts_gauges[key] = self.metrics.gauge(
+                    f"serving.ts.{key}", "windowed time-series reading "
+                    "(ISSUE 19; short-window rates, rolling quantiles)")
+        if self.alerts is not None:
+            self._g_burn_short = self.metrics.gauge(
+                "serving.alerts.burn_rate_short", "SLO burn rate over "
+                "the short (page-worthy) window")
+            self._g_burn_long = self.metrics.gauge(
+                "serving.alerts.burn_rate_long", "SLO burn rate over "
+                "the long (ticket-worthy) window")
+            self._c_alert_kind = {
+                kind: self.metrics.counter(
+                    f"serving.alerts.{kind}",
+                    f"'{kind}' alerts emitted by the burn-rate monitor")
+                for kind in _alerts_mod.ALERT_KINDS}
         _tmemory.poll("serving.engine_init", registry=self.metrics)
 
     # ----------------------------------------------- sharding seams (ISSUE 10)
@@ -897,7 +961,17 @@ class ServingEngine:
             # shared / slot totals all describe the same instant, where
             # separate property reads could straddle an admission
             snap = self.decoder.cache.pool_snapshot(include_blocks=False)
+            # windowed time-series summary + per-metric last-update
+            # stamps (ISSUE 19): `ts` is None when the layer is off;
+            # `metric_stamps` carries {name: {wall_s, iter}} for every
+            # written metric (the snapshot-side `_last_update` sibling)
+            ts_summary = (self.timeseries.summary()
+                          if self.timeseries is not None else None)
             return {"host_syncs": syncs, "tokens_out": toks,
+                    "slo_violations": self._c_slo_viol.value,
+                    "alerts_total": self._c_alerts.value,
+                    "ts": ts_summary,
+                    "metric_stamps": self.metrics.stamps(),
                     "snapshot_seq": self._snapshot_seq,
                     "decode_chunk": self.decode_chunk,
                     "prefill_chunk": self.prefill_chunk,
@@ -1040,6 +1114,15 @@ class ServingEngine:
                                        admission_retries=act.retries,
                                        timeline=act.timeline)
                 act.fut._set(res)
+                # a queue-shed request IS a retirement — and, under an SLO,
+                # always a violation (it expired before its first token, so
+                # the TTFT budget is blown by definition). Without these the
+                # burn-rate monitor is blind to the canonical overload
+                # signature: load shedding out of the queue (ISSUE 19)
+                self._c_retires.inc()
+                self._c_timeouts.inc()
+                if self._slo_budget is not None:
+                    self._c_slo_viol.inc()
                 self._record_flight(res)
                 if act.resume is not None and act.resume["mode"] == "swap" \
                         and self.lifecycle is not None:
@@ -1461,6 +1544,20 @@ class ServingEngine:
         self._c_retires.inc()
         if tps is not None:
             self._h_tps.observe(tps)
+        # TPOT + SLO verdict (ISSUE 19): host arithmetic over timestamps
+        # already taken — the burn-rate monitor's violation feed. The
+        # verdict mirrors telemetry.slo.request_attains: completed
+        # normally, TTFT within budget, decode TPOT within budget.
+        tpot = span / (n - 1) if n > 1 and span > 0 else None
+        if tpot is not None:
+            self._h_tpot.observe(tpot)
+        budget = self._slo_budget
+        if budget is not None:
+            attained = (reason in ("eos", "length")
+                        and ttft is not None and ttft <= budget.ttft_s
+                        and (tpot is None or tpot <= budget.tpot_s))
+            if not attained:
+                self._c_slo_viol.inc()
         self._update_kv_resident()
         telemetry.instant("retire", req=act.req_id, slot=slot, reason=reason,
                           tokens=n)
@@ -1472,6 +1569,81 @@ class ServingEngine:
         scheduler already took, so recording adds zero device syncs)."""
         if self.flight_recorder is not None:
             self.flight_recorder.record(result, source=self.name)
+
+    def _ts_sample(self) -> None:
+        """One windowed-time-series sample per scheduler iteration
+        (ISSUE 19; lock held, called on every `step()` exit path so
+        queue-only iterations still sample — starvation is visible
+        precisely when nothing decodes). Reads HOST state only: counter
+        values, histogram ring quantiles, queue bookkeeping, the
+        allocator's iteration clock — zero device syncs, so timeseries/
+        alerts on-vs-off stays token- and sync-bit-identical
+        (parity-tested at K=1 and K=8)."""
+        ts = self.timeseries
+        if ts is None:
+            return
+        now = time.monotonic()
+        clock = self.decoder.cache.allocator.clock
+        self.metrics.iter_clock = clock   # last-update stamps (satellite)
+        oldest = 0.0
+        if self._queue:
+            t0 = min(a.resume["t_requeue"] if a.resume is not None
+                     else a.t_submit for a in self._queue)
+            oldest = max(0.0, now - t0)
+
+        def _q(h, q, default=0.0):
+            v = h.quantile(q)
+            return default if v is None else v
+
+        ts.sample({
+            "iter": clock, "wall_s": now,
+            "tokens_out": self._c_tokens.value,
+            "admissions": self._c_admits.value,
+            "retirements": self._c_retires.value,
+            "preemptions": self._c_preempt.value,
+            "admission_retries": self._c_adm_retries.value,
+            "host_syncs": self._c_syncs.value,
+            "slo_violations": self._c_slo_viol.value,
+            "queue_wait_sum_s": self._h_queue_wait.sum,
+            "decode_stall_sum_ms": self._h_stall.sum,
+            "decode_chunk_sum_ms": self._h_chunk_ms.sum,
+            "queue_depth": len(self._queue),
+            "active_slots": len(self._by_slot),
+            "oldest_wait_s": oldest,
+            "ttft_p50_s": _q(self._h_ttft, 0.5),
+            "ttft_p99_s": _q(self._h_ttft, 0.99),
+            "tpot_p50_s": _q(self._h_tpot, 0.5),
+            "tpot_p99_s": _q(self._h_tpot, 0.99),
+            "decode_stall_p99_ms": _q(self._h_stall, 0.99),
+            "queue_wait_p99_s": _q(self._h_queue_wait, 0.99),
+        })
+        summ = ts.summary()
+        for key, g in self._ts_gauges.items():
+            g.set(summ[key])
+        for cause, frac in summ["blame_shares"].items():
+            g = self._ts_blame_gauges.get(cause)
+            if g is None:
+                g = self.metrics.gauge(
+                    f"serving.ts.blame_share_{cause}", "windowed blame-"
+                    "cause share of attributed wall (ISSUE 19)")
+                self._ts_blame_gauges[cause] = g
+            g.set(frac)
+        mon = self.alerts
+        if mon is None:
+            return
+        fired = mon.evaluate(ts, iter_id=clock, wall_s=now)
+        self._g_burn_short.set(mon.burn_rate_short)
+        self._g_burn_long.set(mon.burn_rate_long)
+        for a in fired:
+            self._c_alerts.inc()
+            self._c_alert_kind[a.kind].inc()
+            telemetry.instant("alert", kind=a.kind, severity=a.severity,
+                              value=round(a.value, 4),
+                              threshold=a.threshold, iter=a.iter)
+            if self.flight_recorder is not None:
+                note = a.to_dict()
+                note["source"] = self.name
+                self.flight_recorder.note_alert(note)
 
     def _live_kv_positions(self) -> Dict[int, int]:
         """Per-slot KV positions actually WRITTEN, matching the device's
@@ -1525,6 +1697,12 @@ class ServingEngine:
                 "t_submit": act.resume["t_requeue"]
                 if act.resume is not None else act.t_submit,
                 "reclaimable_bytes": reclaimable,
+                # live short-window burn rate (ISSUE 19): the policy's
+                # deny hint stretches its retry_after_s under overload
+                # instead of quoting the static SLO slack (None when no
+                # monitor runs — the hint falls back to plain slack)
+                "burn_rate_short": (self.alerts.burn_rate_short
+                                    if self.alerts is not None else None),
                 "snapshot_fn": lambda: cache.pool_snapshot(
                     live_positions=self._live_kv_positions())}
 
@@ -2252,6 +2430,7 @@ class ServingEngine:
                 # no chunk will run this iteration — this IS the boundary
                 # for any victim parked by the admission's preemptions
                 self._harvest_swaps()
+                self._ts_sample()
                 return bool(self._queue)
             self._expire_timeouts()
             self._prefill_step()
@@ -2259,6 +2438,7 @@ class ServingEngine:
                 # nothing decode-active: every resident slot is mid-prefill
                 # (or the final chunk's 1-token request just retired)
                 self._harvest_swaps()
+                self._ts_sample()
                 return bool(self._by_slot or self._queue)
             # decode-active slots only: a partially-prefilled slot must not
             # be judged by a chunk dispatched while it was still inactive
@@ -2270,6 +2450,7 @@ class ServingEngine:
             if self.spec_decode:
                 more = self._spec_step(snapshot, active, t_iter0)
                 self._harvest_swaps()
+                self._ts_sample()
                 return more or bool(self._queue)
             k_eff = self._chunk_size()
             t_chunk = time.perf_counter()
@@ -2334,6 +2515,7 @@ class ServingEngine:
             # parked at this iteration's preemptions harvests WITHOUT
             # waiting on in-flight work (async swap-out, ISSUE 18)
             self._harvest_swaps()
+            self._ts_sample()
             return bool(self._by_slot or self._queue)
 
     def _spec_step(self, snapshot: Dict[int, _Active], active,

@@ -54,6 +54,7 @@ from dataclasses import dataclass
 from typing import Dict, List, Optional, Tuple
 
 from deeplearning4j_tpu.serving.block_table import chain_digests
+from deeplearning4j_tpu.telemetry.alerts import retry_after_from_burn
 
 __all__ = [
     "AdmissionDecision", "SchedulingPolicy", "ColocatedPolicy",
@@ -261,8 +262,13 @@ class ColocatedPolicy(SchedulingPolicy):
             if slack > 0:
                 # the admittee can still make its TTFT budget by waiting
                 # for a natural retirement — deny is the cheap branch;
-                # escalate to preemption once the slack is gone
-                hint["retry_after_s"] = slack
+                # escalate to preemption once the slack is gone. The
+                # backoff hint reads the LIVE short-window burn rate
+                # (ISSUE 19) when a monitor runs: an overloaded engine
+                # stretches retry_after_s beyond the static SLO slack so
+                # client retries don't pile onto the overload.
+                hint["retry_after_s"] = retry_after_from_burn(
+                    slack, pool_view.get("burn_rate_short"))
                 return AdmissionDecision.deny(hint)
         shortfall = pool_view["shortfall"]
         eligible = pool_view["eligible"]

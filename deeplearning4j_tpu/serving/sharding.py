@@ -105,6 +105,9 @@ GROUP_SUMMED_KEYS: Tuple[str, ...] = (
     # counters summed — still strictly monotonic while any replica steps,
     # so scrapers can detect stale/torn fleet snapshots the same way
     "snapshot_seq",
+    # ISSUE 19: SLO verdicts and burn-rate alerts, fleet-wide (both are
+    # plain counters that read 0 on engines without a budget/monitor)
+    "slo_violations", "alerts_total",
 )
 
 
@@ -744,6 +747,33 @@ class ShardedServingGroup:
         return {**fleet, "imbalance": imbalance, "per_replica": per,
                 "conserved": all(p["attribution"]["conserved"]
                                  for p in per)}
+
+    def fleet_timeseries(self) -> Dict[str, object]:
+        """Fleet time-series view (ISSUE 19): merge every timeseries-
+        enabled replica's windowed summary into ONE fleet row —
+        rates/queue depths SUM (fleet throughput is the sum of replica
+        throughputs), quantiles/ages take the MAX (the fleet tail is its
+        worst replica) — published as serving.ts.fleet_* gauges on the
+        group registry next to the per-replica serving.ts.* gauges the
+        engines publish themselves. Per-replica summaries ride along
+        under `per_replica` so a hot replica is visible next to an idle
+        one. Host-side arithmetic only — zero device reads."""
+        from deeplearning4j_tpu.telemetry.timeseries import fleet_summary
+        summaries = []
+        for engine in self.engines:
+            if engine.timeseries is not None:
+                with engine._lock:
+                    summaries.append(engine.timeseries.summary())
+        fleet = fleet_summary(summaries)
+        for key in ("tokens_per_s", "retirements_per_s",
+                    "preemptions_per_s", "queue_depth", "oldest_wait_s",
+                    "ttft_p99_s", "tpot_p99_s"):
+            if key in fleet:
+                self.metrics.gauge(
+                    f"serving.ts.fleet_{key}", "fleet-merged windowed "
+                    "time-series reading (ISSUE 19)").set(fleet[key])
+        fleet["per_replica"] = summaries
+        return fleet
 
     def blame_report(self, results, slo=None, top: int = 3
                      ) -> Dict[str, object]:

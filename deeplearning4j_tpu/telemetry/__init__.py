@@ -41,6 +41,16 @@ Env toggles:
   ISSUE 12) to every new ServingEngine: serving.kv.* heat/attribution
   gauges, admission-rejection forensics, and the eviction dry-run scorer.
   Off by default.
+- DL4J_TPU_TS=1 attaches a windowed time-series layer (timeseries.py,
+  ISSUE 19) to every new ServingEngine: one bounded ring-buffer sample
+  per scheduler iteration, serving.ts.* windowed-rate/quantile gauges.
+  DL4J_TPU_TS_WINDOW sets the short window in iterations (default 30;
+  long window = 10x). Off by default.
+- DL4J_TPU_ALERTS=1 attaches a multi-window SLO burn-rate monitor
+  (alerts.py, ISSUE 19) — implies the time-series layer; typed
+  overload/goodput-regression/KV-pressure-spiral/starvation alerts into
+  a bounded log, serving.alerts.* metrics, and flight-recorder Perfetto
+  instants. Off by default.
 """
 from __future__ import annotations
 
@@ -60,7 +70,7 @@ __all__ = [
     "instant", "enabled", "configure", "maybe_export_trace", "metrics_route",
     "PROMETHEUS_CONTENT_TYPE", "sanitize_component", "set_track", "health",
     "profiler", "memory", "slo", "flight_recorder", "kv_observatory",
-    "blame",
+    "blame", "timeseries", "alerts",
 ]
 
 from deeplearning4j_tpu.telemetry.registry import sanitize_component  # noqa: E402,F401
@@ -70,10 +80,11 @@ def __getattr__(name):
     # health (ISSUE 5) / profiler / memory (ISSUE 6) import jax (lazily in
     # the ISSUE 6 pair's case, but profiler also pulls util.costs) — loaded
     # on first attribute access so registry/tracing users stay jax-free.
-    # slo / flight_recorder (ISSUE 8) / blame (ISSUE 14) are jax-free but
-    # rarely needed, so they load lazily too
+    # slo / flight_recorder (ISSUE 8) / blame (ISSUE 14) / timeseries /
+    # alerts (ISSUE 19) are jax-free but rarely needed, so they load
+    # lazily too
     if name in ("health", "profiler", "memory", "slo", "flight_recorder",
-                "kv_observatory", "blame"):
+                "kv_observatory", "blame", "timeseries", "alerts"):
         import importlib
         return importlib.import_module(
             f"deeplearning4j_tpu.telemetry.{name}")

@@ -1,0 +1,256 @@
+"""Multi-window SLO burn-rate monitor + typed fleet alerts (ISSUE 19).
+
+Burn rate is the SRE framing of "how fast are we spending the error
+budget": with an error-budget fraction `budget_frac` (default 10% of
+requests may violate the SLO), a window whose violation fraction is
+exactly `budget_frac` burns at 1.0 — sustainable forever; 2.0 spends a
+month of budget in two weeks. Two windows make the signal actionable
+(single-window alerting is either too twitchy or too slow):
+
+- SHORT window (~30 scheduler iterations): a burn spike here is
+  PAGE-worthy — the engine is overloaded RIGHT NOW and admission should
+  shed load (the policy's deny hint reads this, see
+  `retry_after_from_burn`).
+- LONG window (~300 iterations): sustained burn is TICKET-worthy — a
+  goodput regression that survived averaging, not a blip.
+
+`BurnRateMonitor.evaluate()` runs once per scheduler iteration against
+the `ServingTimeSeries` (telemetry/timeseries.py) and emits typed
+alerts:
+
+- ``overload``            short-window burn >= page threshold (page)
+- ``goodput_regression``  long-window burn >= ticket threshold (ticket)
+- ``kv_pressure_spiral``  windowed admission-rejection + preemption
+                          per-iteration rate over threshold — the pool
+                          is evicting to admit and rejecting what it
+                          admits for (page)
+- ``starvation``          the oldest queued request's age exceeded a
+                          multiple of the TTFT budget — FIFO progress
+                          stalled (page)
+
+Alerts land in a BOUNDED log (oldest dropped, drops counted), dedup on
+rising edges (a condition that stays true re-fires only every
+`refire_iters`), and the engine forwards them to the flight recorder's
+Perfetto dump as instants and to `serving.alerts.*` metrics.
+
+Sync discipline: pure host arithmetic over the sampled series — no jax
+import, zero device syncs (pinned in tests/test_sync_discipline.py;
+alerts-on-vs-off token/sync bit-parity asserted in tests and bench).
+"""
+from __future__ import annotations
+
+import math
+import os
+from collections import deque
+from dataclasses import asdict, dataclass
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.telemetry.timeseries import (ServingTimeSeries,
+                                                     Window,
+                                                     resolve_ts_window)
+
+__all__ = [
+    "ALERT_KINDS", "Alert", "BurnRateMonitor", "retry_after_from_burn",
+    "resolve_alerts",
+]
+
+#: closed alert taxonomy — tests and the bench schema key off these
+ALERT_KINDS = ("overload", "goodput_regression", "kv_pressure_spiral",
+               "starvation")
+
+#: hint multiplier cap: a melted fleet should back clients off, not
+#: quote them an hour (retry_after_from_burn)
+_MAX_BURN_BACKOFF = 10.0
+
+
+@dataclass(frozen=True)
+class Alert:
+    """One typed alert. `iter` is the allocator's scheduler-iteration
+    clock at emission, `wall_s` the host monotonic timestamp; `value`
+    crossed `threshold` over a `window_iters`-sample window."""
+    kind: str
+    severity: str            # "page" | "ticket"
+    iter: int
+    wall_s: float
+    value: float
+    threshold: float
+    window_iters: int
+    message: str
+
+    def to_dict(self) -> Dict[str, object]:
+        return asdict(self)
+
+
+def retry_after_from_burn(slack_s: float, burn: Optional[float]) -> float:
+    """Deny-hint backoff from live pressure (replaces the static
+    SLO-slack figure in ColocatedPolicy.admit, ISSUE 19): at burn 0 the
+    hint is exactly the admittee's remaining TTFT slack (the pre-ISSUE-19
+    figure); a burning engine stretches the backoff proportionally so
+    retries don't pile onto an overload. Degenerate inputs (no monitor,
+    non-finite burn) fall back to the plain slack."""
+    # sync-ok: host wall-clock slack arithmetic
+    base = max(0.0, float(slack_s))
+    if burn is None:
+        return base
+    # sync-ok: host burn-rate scalar
+    b = float(burn)
+    if not math.isfinite(b) or b <= 0.0:
+        return base
+    return base * (1.0 + min(b, _MAX_BURN_BACKOFF))
+
+
+class BurnRateMonitor:
+    """Evaluate burn-rate / pressure / starvation conditions over a
+    `ServingTimeSeries`, once per scheduler iteration.
+
+    slo:            telemetry.slo.SLO budget the engine counts
+                    `serving.slo_violations` against. None = burn stays
+                    0 (only the pressure spiral can fire).
+    budget_frac:    error budget as a fraction of retirements (default
+                    0.1: one violation in ten burns at 1.0).
+    page_burn:      short-window burn threshold for ``overload``.
+    ticket_burn:    long-window burn threshold for ``goodput_regression``.
+    pressure_per_iter: rejected-reservation + preemption events per
+                    iteration for ``kv_pressure_spiral`` (unitless —
+                    robust across host speeds).
+    starvation_factor: oldest queued age > factor * slo.ttft_s fires
+                    ``starvation`` (needs an slo).
+    log_capacity:   alert-log bound (oldest dropped, `dropped` counts).
+    refire_iters:   re-emission period while a condition STAYS true
+                    (default: the long window).
+    """
+
+    def __init__(self, slo=None, *, short_window: Optional[int] = None,
+                 long_window: Optional[int] = None,
+                 budget_frac: float = 0.1,
+                 page_burn: float = 1.0, ticket_burn: float = 1.0,
+                 pressure_per_iter: float = 0.5,
+                 starvation_factor: float = 3.0,
+                 log_capacity: int = 256,
+                 refire_iters: Optional[int] = None):
+        if not 0.0 < budget_frac <= 1.0:
+            raise ValueError(f"budget_frac in (0, 1] required, got "
+                             f"{budget_frac}")
+        if log_capacity < 1:
+            raise ValueError("log_capacity >= 1 required")
+        self.slo = slo
+        self.short_window = resolve_ts_window(short_window)
+        self.long_window = int(long_window) if long_window else \
+            self.short_window * 10
+        # sync-ok: constructor threshold scalars (host config values)
+        self.budget_frac = float(budget_frac)
+        self.page_burn = float(page_burn)          # sync-ok: host config
+        self.ticket_burn = float(ticket_burn)      # sync-ok: host config
+        # sync-ok: host config
+        self.pressure_per_iter = float(pressure_per_iter)
+        # sync-ok: host config
+        self.starvation_factor = float(starvation_factor)
+        self.log_capacity = int(log_capacity)
+        self.refire_iters = int(refire_iters) if refire_iters else \
+            self.long_window
+        self._log: deque = deque()
+        self._firing: Dict[str, bool] = {}
+        self._last_emit: Dict[str, int] = {}
+        self.dropped = 0
+        self.n_alerts = 0
+        # last-evaluated burn rates, published as gauges and read by the
+        # admission policy through the pool view (burn_rate_short)
+        self.burn_rate_short = 0.0
+        self.burn_rate_long = 0.0
+
+    # ------------------------------------------------------------- queries
+    def alerts(self) -> List[Alert]:
+        """Retained alerts, oldest first (bounded; see `dropped`)."""
+        return list(self._log)
+
+    def counts(self) -> Dict[str, int]:
+        """Retained-alert counts per kind (zero-filled taxonomy)."""
+        out = {k: 0 for k in ALERT_KINDS}
+        for a in self._log:
+            out[a.kind] = out.get(a.kind, 0) + 1
+        return out
+
+    # ---------------------------------------------------------- evaluation
+    def burn(self, window: Window) -> float:
+        """Burn rate over one window: (violations / retirements) /
+        budget_frac. A window that retired nothing burns 0.0 — no
+        completions means no budget verdicts, not an emergency."""
+        retired = window.delta("retirements")
+        if retired <= 0.0:
+            return 0.0
+        viol = max(0.0, window.delta("slo_violations"))
+        return (viol / retired) / self.budget_frac
+
+    def evaluate(self, ts: ServingTimeSeries, *, iter_id: int,
+                 wall_s: float) -> List[Alert]:
+        """One per-iteration pass: recompute both burn rates, emit any
+        newly-firing alerts. Returns the alerts emitted THIS call."""
+        short = ts.window(self.short_window)
+        long_w = ts.window(self.long_window)
+        self.burn_rate_short = self.burn(short)
+        self.burn_rate_long = self.burn(long_w)
+        fired: List[Alert] = []
+        self._edge(fired, "overload", "page", self.burn_rate_short,
+                   self.page_burn, short, iter_id, wall_s,
+                   f"short-window SLO burn {self.burn_rate_short:.2f}x "
+                   f"(budget_frac={self.budget_frac:g})")
+        self._edge(fired, "goodput_regression", "ticket",
+                   self.burn_rate_long, self.ticket_burn, long_w,
+                   iter_id, wall_s,
+                   f"long-window SLO burn {self.burn_rate_long:.2f}x "
+                   f"sustained over {self.long_window} iters")
+        pressure = short.per_iter("admission_retries") \
+            + short.per_iter("preemptions")
+        self._edge(fired, "kv_pressure_spiral", "page", pressure,
+                   self.pressure_per_iter, short, iter_id, wall_s,
+                   f"{pressure:.2f} rejected/preempting events per "
+                   f"iteration — KV pool thrashing")
+        if self.slo is not None:
+            oldest = short.last("oldest_wait_s")
+            budget = self.starvation_factor * self.slo.ttft_s
+            self._edge(fired, "starvation", "page", oldest, budget,
+                       short, iter_id, wall_s,
+                       f"oldest queued request {oldest:.3f}s > "
+                       f"{self.starvation_factor:g}x TTFT budget")
+        return fired
+
+    def _edge(self, fired: List[Alert], kind: str, severity: str,
+              value: float, threshold: float, window: Window,
+              iter_id: int, wall_s: float, message: str) -> None:
+        """Rising-edge dedup: emit on False->True transitions, re-emit a
+        still-true condition only every `refire_iters`."""
+        if threshold <= 0.0 or value < threshold:
+            self._firing[kind] = False
+            return
+        if self._firing.get(kind) and \
+                iter_id - self._last_emit.get(kind, 0) < self.refire_iters:
+            return
+        self._firing[kind] = True
+        self._last_emit[kind] = int(iter_id)
+        # sync-ok: host series scalars
+        a = Alert(kind, severity, int(iter_id), float(wall_s),
+                  # sync-ok: host series scalars
+                  float(value), float(threshold), window.n, message)
+        if len(self._log) >= self.log_capacity:
+            self._log.popleft()
+            self.dropped += 1
+        self._log.append(a)
+        self.n_alerts += 1
+        fired.append(a)
+
+
+def resolve_alerts(alerts=None, *, slo=None,
+                   short_window: Optional[int] = None
+                   ) -> Optional[BurnRateMonitor]:
+    """Constructor resolution of the engine's alerts knob: a
+    BurnRateMonitor instance passes through; True builds a default
+    monitor over `slo`; None consults `DL4J_TPU_ALERTS` (empty/0/off =
+    disabled — no monitor object, no code on any scheduler path)."""
+    if alerts is None:
+        if os.environ.get("DL4J_TPU_ALERTS", "") in ("", "0", "off"):
+            return None
+        alerts = True
+    if isinstance(alerts, bool):
+        return BurnRateMonitor(slo, short_window=short_window) \
+            if alerts else None
+    return alerts

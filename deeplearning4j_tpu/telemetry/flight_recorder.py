@@ -93,6 +93,10 @@ class FlightRecorder:
         # min-heap of (ttft_key, tiebreak, record): the root is the LEAST
         # bad retained request, evicted when a worse one arrives
         self._worst: List[tuple] = []
+        # burn-rate monitor alerts (ISSUE 19): bounded FIFO of alert
+        # dicts, rendered onto the Perfetto dump as global instants on a
+        # dedicated alerts track
+        self._alerts: deque = deque(maxlen=self.capacity)
         self._seq = 0
         self.n_seen = 0
         self.n_violations = 0
@@ -134,7 +138,17 @@ class FlightRecorder:
                 kept = True
         return kept
 
+    def note_alert(self, alert: dict) -> None:
+        """Retain one burn-rate alert (telemetry/alerts.py Alert dict —
+        kind/severity/iter/wall_s/...). Bounded FIFO; pure host list
+        bookkeeping, zero added syncs."""
+        self._alerts.append(dict(alert))
+
     # ------------------------------------------------------------- queries
+    def alerts(self) -> List[dict]:
+        """Retained alert notes, oldest first."""
+        return list(self._alerts)
+
     def records(self) -> List[dict]:
         """Retained records, deduplicated (a request can be both a violator
         and a worst-TTFT holder), worst TTFT first. req_ids are per-engine
@@ -166,6 +180,7 @@ class FlightRecorder:
         recs = self.records()
         t0s = [cov[0] for rec in recs
                for cov in (coverage(rec["timeline"]),) if cov]
+        t0s += [a["wall_s"] for a in self._alerts if "wall_s" in a]
         epoch = min(t0s) if t0s else 0.0
         sources = sorted({rec.get("source") for rec in recs},
                          key=lambda s: (s is not None, str(s)))
@@ -206,9 +221,25 @@ class FlightRecorder:
                 else:
                     ev.append({**base, "ph": "X",
                                "dur": round(dur * 1e6, 3), "args": args})
+        if self._alerts:
+            # burn-rate alerts (ISSUE 19): one dedicated track of GLOBAL
+            # instants so overload/starvation markers line up against
+            # the per-request timelines that suffered them
+            apid = max(pid_of.values()) + 1
+            ev.append({"ph": "M", "pid": apid, "name": "process_name",
+                       "args": {"name": "serving alerts (ISSUE 19)"}})
+            for a in self._alerts:
+                ev.append({"ph": "i", "s": "g", "pid": apid, "tid": 0,
+                           "name": f"ALERT {a.get('kind')} "
+                                   f"({a.get('severity')})",
+                           "cat": "alert",
+                           "ts": round((a.get("wall_s", epoch) - epoch)
+                                       * 1e6, 3),
+                           "args": dict(a)})
         return {"traceEvents": ev, "displayTimeUnit": "ms",
                 "otherData": {"n_seen": self.n_seen,
                               "n_violations": self.n_violations,
+                              "n_alerts": len(self._alerts),
                               "slo": None if self.slo is None
                               else {"ttft_s": self.slo.ttft_s,
                                     "tpot_s": self.slo.tpot_s}}}
@@ -222,4 +253,5 @@ class FlightRecorder:
     def clear(self) -> None:
         self._violators.clear()
         self._worst.clear()
+        self._alerts.clear()
         self.n_seen = self.n_violations = 0

@@ -27,6 +27,7 @@ from __future__ import annotations
 import bisect
 import math
 import threading
+import time
 import weakref
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -41,52 +42,88 @@ DEFAULT_S_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
 _RING = 1024              # exact-quantile window per histogram
 
 
-class Counter:
+class _Stamped:
+    """Per-metric last-update stamp (ISSUE 19 satellite): without one, a
+    gauge publishes its last-written value forever and a scraper cannot
+    tell a live reading from a dead one. Every write records the host
+    wall clock plus the owning registry's `iter_clock` (the scheduler-
+    iteration clock the serving engine assigns each `step()`); a metric
+    never written keeps `_stamp_wall is None`."""
+    __slots__ = ()
+
+    def _stamp(self) -> None:
+        self._stamp_wall = time.monotonic()
+        reg = self._reg
+        if reg is not None:
+            self._stamp_iter = reg.iter_clock
+
+    @property
+    def last_update(self) -> Optional[dict]:
+        """{"wall_s", "iter"} of the most recent write, or None if the
+        metric was never written."""
+        if self._stamp_wall is None:
+            return None
+        return {"wall_s": self._stamp_wall, "iter": self._stamp_iter}
+
+
+class Counter(_Stamped):
     """Monotonic (resettable) event counter. Single-writer, lock-free."""
-    __slots__ = ("name", "help", "_value")
+    __slots__ = ("name", "help", "_value", "_stamp_wall", "_stamp_iter",
+                 "_reg")
 
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
         self._value = 0
+        self._stamp_wall = None
+        self._stamp_iter = 0
+        self._reg = None
 
     def inc(self, n: int = 1) -> None:
         self._value += n
+        self._stamp()
 
     def reset(self, value: int = 0) -> None:
         self._value = int(value)
+        self._stamp()
 
     @property
     def value(self) -> int:
         return self._value
 
 
-class Gauge:
+class Gauge(_Stamped):
     """Last-set instantaneous value. Lock-free."""
-    __slots__ = ("name", "help", "_value")
+    __slots__ = ("name", "help", "_value", "_stamp_wall", "_stamp_iter",
+                 "_reg")
 
     def __init__(self, name: str, help: str = ""):
         self.name = name
         self.help = help
         self._value = 0.0
+        self._stamp_wall = None
+        self._stamp_iter = 0
+        self._reg = None
 
     def set(self, value: float) -> None:
         self._value = float(value)  # sync-ok: caller passes host values
+        self._stamp()
 
     def reset(self, value: float = 0.0) -> None:
         self._value = float(value)  # sync-ok: caller passes host values
+        self._stamp()
 
     @property
     def value(self) -> float:
         return self._value
 
 
-class Histogram:
+class Histogram(_Stamped):
     """Fixed-bucket latency histogram with a preallocated ring buffer of
     recent raw observations (exact quantiles over the last `_RING` samples;
     bucket interpolation would lose precision exactly where p99 matters)."""
     __slots__ = ("name", "help", "bounds", "_counts", "_sum", "_ring",
-                 "_written")
+                 "_written", "_stamp_wall", "_stamp_iter", "_reg")
 
     def __init__(self, name: str, help: str = "",
                  buckets: Sequence[float] = DEFAULT_MS_BUCKETS):
@@ -100,6 +137,9 @@ class Histogram:
         self._sum = 0.0
         self._ring = np.zeros(_RING, np.float64)
         self._written = 0
+        self._stamp_wall = None
+        self._stamp_iter = 0
+        self._reg = None
 
     def observe(self, value: float) -> None:
         v = float(value)  # sync-ok: caller passes host values
@@ -107,11 +147,13 @@ class Histogram:
         self._sum += v
         self._ring[self._written % _RING] = v
         self._written += 1
+        self._stamp()
 
     def reset(self) -> None:
         self._counts[:] = 0
         self._sum = 0.0
         self._written = 0
+        self._stamp()
 
     @property
     def count(self) -> int:
@@ -153,6 +195,11 @@ class MetricsRegistry:
         self._metrics: Dict[str, object] = {}
         self._lock = threading.Lock()           # registration only
         self._children: List[weakref.ref] = []
+        # scheduler-iteration clock (ISSUE 19): the serving engine
+        # assigns the allocator's tick here each step(), so every metric
+        # write stamps which iteration it happened in (0 = no iteration
+        # clock, e.g. training registries)
+        self.iter_clock = 0
         if parent is not None:
             parent._adopt(self)
 
@@ -168,6 +215,7 @@ class MetricsRegistry:
                 m = self._metrics.get(name)
                 if m is None:
                     m = cls(name, **kw)
+                    m._reg = self       # stamp source for iter_clock
                     self._metrics[name] = m
         if not isinstance(m, cls):
             raise TypeError(f"metric {name!r} already registered as "
@@ -200,6 +248,19 @@ class MetricsRegistry:
         out: Dict[str, object] = {}
         for name, m in list(self._metrics.items()):
             out[name] = m.snapshot() if isinstance(m, Histogram) else m.value
+        return out
+
+    def stamps(self) -> Dict[str, dict]:
+        """Per-metric last-update stamps (ISSUE 19 satellite): {name:
+        {"wall_s": monotonic write time, "iter": scheduler iteration}}
+        for every metric written at least once — the snapshot-side
+        counterpart of the `_last_update` exposition sibling, carried by
+        ServingEngine.stats()."""
+        out: Dict[str, dict] = {}
+        for name, m in list(self._metrics.items()):
+            lu = m.last_update
+            if lu is not None:
+                out[name] = lu
         return out
 
     # ------------------------------------------------------- exposition
@@ -260,6 +321,20 @@ class MetricsRegistry:
             elif isinstance(first, Gauge):
                 lines.append(f"# TYPE {pname} gauge")
                 lines.append(f"{pname} {_fmt(ms[-1].value)}")
+                # gauge-staleness sibling (ISSUE 19 satellite): gauges
+                # publish their last-written value forever, so expose
+                # WHEN that write happened — max stamp across instances,
+                # on both clocks; never-written gauges stay sibling-less
+                # (a fabricated 0 would read as "updated at epoch")
+                stamped = [m for m in ms if m._stamp_wall is not None]
+                if stamped:
+                    lines.append(f"# TYPE {pname}_last_update gauge")
+                    lines.append(
+                        f'{pname}_last_update{{clock="iter"}} '
+                        f'{max(m._stamp_iter for m in stamped)}')
+                    lines.append(
+                        f'{pname}_last_update{{clock="wall_s"}} '
+                        f'{_fmt(max(m._stamp_wall for m in stamped))}')
             elif isinstance(first, Histogram):
                 lines.append(f"# TYPE {pname} histogram")
                 bounds = first.bounds

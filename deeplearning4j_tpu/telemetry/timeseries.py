@@ -1,0 +1,352 @@
+"""Windowed time-series over the metrics registry (ISSUE 19).
+
+Every metric the stack publishes is cumulative over the whole run, so a
+ten-second goodput collapse mid-run is invisible until the final SLO
+evaluation. This module makes the trend observable LIVE: a bounded
+ring-buffer series sampled once per scheduler iteration — Orca-style
+iteration-level scheduling gives a natural, sync-free sampling tick —
+keyed to BOTH the allocator's iteration clock (`BlockAllocator.tick()`,
+ISSUE 12) and a host wall-clock timestamp, deriving per-window rates
+(tokens/s, admissions/s, preemptions/s), rolling quantiles from the
+registry's existing histogram ring buffers (TTFT, TPOT, decode stall,
+queue wait), and windowed blame-cause shares.
+
+Sample rows hold two kinds of field:
+
+- CUMULATIVE fields are monotone counter readings (``serving.tokens_out``
+  and friends). A window derives deltas and rates from its first/last
+  rows, so windowed deltas CONSERVE against the cumulative counter by
+  construction — `delta over [i, j] == cum[j] - cum[i]` and consecutive
+  disjoint windows sum to the total (property-tested).
+- GAUGE fields are instantaneous readings (queue depth, oldest queued
+  age, rolling quantiles); a window reports last/max/mean.
+
+Rate math is hardened for degenerate windows (ISSUE 19 satellite): a
+window with < 2 samples, zero wall span, or non-finite inputs rates to
+0.0 — never a raise and never an inf/NaN that would poison a gauge.
+
+Sync discipline: everything here is host arithmetic over values the
+scheduler already holds (Python ints/floats, numpy rings) — no jax
+import, zero device syncs; the engine's on-vs-off token/sync bit-parity
+is asserted in tests/test_timeseries_alerts.py and `bench_ts_alerts`.
+"""
+from __future__ import annotations
+
+import math
+import os
+from typing import Dict, Iterable, List, Optional, Sequence
+
+import numpy as np
+
+__all__ = [
+    "CLOCK_FIELDS", "CUMULATIVE_FIELDS", "GAUGE_FIELDS", "FIELDS",
+    "RingSeries", "Window", "ServingTimeSeries", "fleet_summary",
+    "resolve_ts_enabled", "resolve_ts_window",
+]
+
+#: the two sampling clocks every row carries: the allocator's
+#: scheduler-iteration tick and the host monotonic wall clock
+CLOCK_FIELDS = ("iter", "wall_s")
+
+#: monotone counter readings (windows derive deltas / rates)
+CUMULATIVE_FIELDS = (
+    "tokens_out", "admissions", "retirements", "preemptions",
+    "admission_retries", "host_syncs", "slo_violations",
+    # histogram SUMS (seconds/ms of attributed wall) backing the
+    # windowed blame-cause shares
+    "queue_wait_sum_s", "decode_stall_sum_ms", "decode_chunk_sum_ms",
+)
+
+#: instantaneous readings (windows report last/max/mean)
+GAUGE_FIELDS = (
+    "queue_depth", "active_slots", "oldest_wait_s",
+    "ttft_p50_s", "ttft_p99_s", "tpot_p50_s", "tpot_p99_s",
+    "decode_stall_p99_ms", "queue_wait_p99_s",
+)
+
+FIELDS = CLOCK_FIELDS + CUMULATIVE_FIELDS + GAUGE_FIELDS
+
+DEFAULT_SHORT_WINDOW = 30     # iterations — the page-worthy window
+LONG_WINDOW_FACTOR = 10       # long window = 10x short (~300 iters)
+
+
+def resolve_ts_enabled(flag=None) -> bool:
+    """Constructor resolution of the time-series knob: explicit argument
+    wins, else `DL4J_TPU_TS` (empty/0/off = disabled)."""
+    if flag is not None:
+        return bool(flag)
+    return os.environ.get("DL4J_TPU_TS", "") not in ("", "0", "off")
+
+
+def resolve_ts_window(window=None) -> int:
+    """Short-window length in scheduler iterations: explicit argument
+    wins, else `DL4J_TPU_TS_WINDOW` (empty/0/off = default 30)."""
+    if window is None:
+        env = os.environ.get("DL4J_TPU_TS_WINDOW", "")
+        window = int(env) if env not in ("", "0", "off") else \
+            DEFAULT_SHORT_WINDOW
+    window = int(window)
+    if window < 2:
+        raise ValueError(f"ts window must be >= 2 iterations, got {window}")
+    return window
+
+
+def _finite(v: float) -> float:
+    """Gauge-safe scalar: non-finite inputs become 0.0 (never emit
+    inf/NaN into a published gauge — ISSUE 19 satellite)."""
+    # sync-ok: host scalar hygiene, value already materialized
+    f = float(v)
+    return f if math.isfinite(f) else 0.0
+
+
+class RingSeries:
+    """Fixed-capacity ring of sample rows (one row per scheduler
+    iteration, `n_fields` float64 columns). Preallocated: steady-state
+    appends allocate nothing. Oldest rows overwrite silently — the
+    series answers "what happened recently", the cumulative registry
+    answers "what happened ever"."""
+
+    __slots__ = ("fields", "capacity", "_index", "_data", "_written")
+
+    def __init__(self, fields: Sequence[str], capacity: int):
+        if capacity < 2:
+            raise ValueError(f"capacity must be >= 2, got {capacity}")
+        self.fields = tuple(fields)
+        self.capacity = int(capacity)
+        self._index = {f: i for i, f in enumerate(self.fields)}
+        self._data = np.zeros((self.capacity, len(self.fields)), np.float64)
+        self._written = 0
+
+    def __len__(self) -> int:
+        return min(self._written, self.capacity)
+
+    @property
+    def written(self) -> int:
+        """Total rows ever appended (>= len once the ring wraps)."""
+        return self._written
+
+    def append(self, values: Dict[str, float]) -> None:
+        row = self._data[self._written % self.capacity]
+        row[:] = 0.0
+        for f, v in values.items():
+            i = self._index.get(f)
+            if i is not None:
+                row[i] = _finite(v)
+        self._written += 1
+
+    def tail(self, n: int) -> np.ndarray:
+        """The most recent `min(n, len)` rows, oldest first (a copy —
+        safe to hold across appends)."""
+        have = len(self)
+        n = max(0, min(int(n), have))
+        if n == 0:
+            return self._data[:0].copy()
+        end = self._written % self.capacity
+        start = (end - n) % self.capacity
+        if start < end or end == 0:
+            stop = end if end else self.capacity
+            return self._data[start:stop].copy()
+        return np.concatenate([self._data[start:], self._data[:end]])
+
+    def window(self, n: int) -> "Window":
+        """View over the most recent `n` rows (fewer early in a run)."""
+        return Window(self.tail(n), self._index)
+
+
+class Window:
+    """Derived view over a contiguous run of sample rows.
+
+    Cumulative fields: `delta` (last - first) and `rate` (delta / wall
+    span). Gauge fields: `last` / `max` / `mean`. All reads are guarded:
+    an empty or single-row window deltas to 0.0 and rates to 0.0."""
+
+    __slots__ = ("_rows", "_index")
+
+    def __init__(self, rows: np.ndarray, index: Dict[str, int]):
+        self._rows = rows
+        self._index = index
+
+    @property
+    def n(self) -> int:
+        return int(self._rows.shape[0])
+
+    def _col(self, field: str) -> np.ndarray:
+        return self._rows[:, self._index[field]]
+
+    def first(self, field: str) -> float:
+        c = self._col(field)
+        # sync-ok: host ring-buffer scalar
+        return float(c[0]) if c.size else 0.0
+
+    def last(self, field: str) -> float:
+        c = self._col(field)
+        # sync-ok: host ring-buffer scalar
+        return float(c[-1]) if c.size else 0.0
+
+    def max(self, field: str) -> float:
+        c = self._col(field)
+        # sync-ok: host ring-buffer scalar
+        return float(c.max()) if c.size else 0.0
+
+    def mean(self, field: str) -> float:
+        c = self._col(field)
+        # sync-ok: host ring-buffer scalar
+        return float(c.mean()) if c.size else 0.0
+
+    def delta(self, field: str) -> float:
+        """last - first of a cumulative field over the window (0.0 for
+        windows of < 2 samples — no span, no delta)."""
+        if self.n < 2:
+            return 0.0
+        return _finite(self.last(field) - self.first(field))
+
+    def span_s(self) -> float:
+        """Wall-clock span covered by the window."""
+        return self.delta("wall_s")
+
+    def iters(self) -> float:
+        """Scheduler iterations covered by the window."""
+        return self.delta("iter")
+
+    def rate(self, field: str) -> float:
+        """Per-second rate of a cumulative field over the window's wall
+        span. Degenerate windows (< 2 samples, zero/negative span,
+        non-finite inputs) rate to 0.0 — never raise, never inf/NaN."""
+        span = self.span_s()
+        if self.n < 2 or span <= 0.0:
+            return 0.0
+        return _finite(self.delta(field) / span)
+
+    def per_iter(self, field: str) -> float:
+        """Per-iteration rate of a cumulative field (unitless — robust
+        across hosts of different speed, the alert-threshold clock)."""
+        iters = self.iters()
+        if self.n < 2 or iters <= 0.0:
+            return 0.0
+        return _finite(self.delta(field) / iters)
+
+
+class ServingTimeSeries:
+    """The engine-facing series: FIELDS rows sampled once per `step()`,
+    short/long windows sized for the burn-rate monitor, and a summary
+    dict feeding `serving.ts.*` gauges + `stats()["ts"]`.
+
+    Ring capacity defaults to 2x the long window so the long window is
+    always fully backed once warm."""
+
+    def __init__(self, *, short_window: Optional[int] = None,
+                 long_window: Optional[int] = None,
+                 capacity: Optional[int] = None):
+        self.short_window = resolve_ts_window(short_window)
+        self.long_window = int(long_window) if long_window else \
+            self.short_window * LONG_WINDOW_FACTOR
+        if self.long_window < self.short_window:
+            raise ValueError("long_window must be >= short_window")
+        if capacity is None:
+            capacity = max(2 * self.long_window, 64)
+        self.series = RingSeries(FIELDS, capacity)
+
+    def __len__(self) -> int:
+        return len(self.series)
+
+    def sample(self, values: Dict[str, float]) -> None:
+        """Append one per-iteration row (missing fields read 0.0)."""
+        self.series.append(values)
+
+    def window(self, n: int) -> Window:
+        return self.series.window(n)
+
+    def short(self) -> Window:
+        return self.window(self.short_window)
+
+    def long(self) -> Window:
+        return self.window(self.long_window)
+
+    # ------------------------------------------------------------ derived
+    def blame_shares(self, window: Optional[Window] = None
+                     ) -> Dict[str, float]:
+        """Windowed blame-cause shares, keyed by telemetry/blame.py cause
+        names: the fraction of attributed wall (histogram-sum deltas over
+        the window) each cause carried. Empty when the window attributed
+        nothing — emitting fabricated zeros would read as "measured and
+        clean"."""
+        w = window if window is not None else self.short()
+        qw = max(0.0, w.delta("queue_wait_sum_s"))
+        stall = max(0.0, w.delta("decode_stall_sum_ms")) / 1e3
+        dec = max(0.0, w.delta("decode_chunk_sum_ms")) / 1e3
+        total = qw + stall + dec
+        if total <= 0.0:
+            return {}
+        return {"queue_wait": qw / total,
+                "prefill_chunk_interference": stall / total,
+                "decode_compute": dec / total}
+
+    #: summary keys that are per-second rates over the SHORT window
+    RATE_KEYS = ("tokens_per_s", "admissions_per_s", "retirements_per_s",
+                 "preemptions_per_s", "admission_retries_per_s")
+    #: summary keys that are instantaneous / quantile gauges
+    LEVEL_KEYS = ("queue_depth", "active_slots", "oldest_wait_s",
+                  "ttft_p50_s", "ttft_p99_s", "tpot_p50_s", "tpot_p99_s")
+
+    def summary(self) -> Dict[str, object]:
+        """One host-side summary row: short-window rates, long-window
+        throughput (the regression baseline), current levels/quantiles,
+        and the windowed blame shares."""
+        w, lw = self.short(), self.long()
+        out: Dict[str, object] = {
+            "samples": len(self.series),
+            "iter": w.last("iter"),
+            "wall_s": w.last("wall_s"),
+            "short_window": self.short_window,
+            "long_window": self.long_window,
+            "tokens_per_s": w.rate("tokens_out"),
+            "admissions_per_s": w.rate("admissions"),
+            "retirements_per_s": w.rate("retirements"),
+            "preemptions_per_s": w.rate("preemptions"),
+            "admission_retries_per_s": w.rate("admission_retries"),
+            "tokens_per_s_long": lw.rate("tokens_out"),
+            "retirements_per_s_long": lw.rate("retirements"),
+        }
+        for k in self.LEVEL_KEYS:
+            out[k] = w.last(k)
+        out["blame_shares"] = self.blame_shares(w)
+        return out
+
+
+#: fleet merge semantics: rates and queue depths SUM across replicas
+#: (fleet throughput is the sum of replica throughputs); quantiles and
+#: ages take the MAX (the fleet tail is its worst replica — a mean would
+#: hide the exact replica an alert should point at)
+FLEET_SUM_KEYS = ServingTimeSeries.RATE_KEYS + (
+    "tokens_per_s_long", "retirements_per_s_long",
+    "queue_depth", "active_slots", "samples")
+FLEET_MAX_KEYS = ("oldest_wait_s", "ttft_p50_s", "ttft_p99_s",
+                  "tpot_p50_s", "tpot_p99_s", "iter", "wall_s")
+
+
+def fleet_summary(summaries: Iterable[Dict[str, object]]
+                  ) -> Dict[str, object]:
+    """Merge per-replica `ServingTimeSeries.summary()` dicts into ONE
+    fleet row (ShardedServingGroup.fleet_timeseries). Blame shares merge
+    as the share-weighted mean and renormalize to sum 1."""
+    rows: List[Dict[str, object]] = [dict(s) for s in summaries]
+    out: Dict[str, object] = {"replicas": len(rows)}
+    if not rows:
+        return out
+    for k in FLEET_SUM_KEYS:
+        # sync-ok: host summary-dict scalars
+        out[k] = _finite(sum(float(r.get(k, 0.0) or 0.0) for r in rows))
+    for k in FLEET_MAX_KEYS:
+        # sync-ok: host summary-dict scalars
+        out[k] = _finite(max(float(r.get(k, 0.0) or 0.0) for r in rows))
+    out["short_window"] = rows[0].get("short_window")
+    out["long_window"] = rows[0].get("long_window")
+    shares: Dict[str, float] = {}
+    for r in rows:
+        for cause, frac in (r.get("blame_shares") or {}).items():
+            # sync-ok: host blame-share fraction
+            shares[cause] = shares.get(cause, 0.0) + float(frac)
+    total = sum(shares.values())
+    out["blame_shares"] = ({c: v / total for c, v in shares.items()}
+                           if total > 0 else {})
+    return out

@@ -62,7 +62,12 @@ measured entries must prove token parity + conservation + drained
 pools for BOTH swap pipelines, real disk demotions AND promotions,
 an async pipeline that harvested >= 1 deferred readback and reduced
 p99 preempt_swap_io blame vs sync, a >= 3x int8 spill-byte shrink,
-and a calibrated swap bandwidth).
+and a calibrated swap bandwidth). ISSUE 19 adds `ts_alerts` (the
+forced-overload alert-discrimination run — CPU-runnable and always
+present; measured entries must prove >= 1 overload page stamped inside
+the burst phase, alerts_in_calm == 0, windowed-delta conservation,
+ts+alerts on/off token + host-sync bit-parity, and an alert_kinds dict
+keyed by EXACTLY the closed taxonomy telemetry/alerts.py defines).
 bench.py calls
 `assert_valid` on the dict it is about to print, and
 tests/test_bench_schema.py re-validates the committed artifact, so the
@@ -451,6 +456,49 @@ def validate_artifact(art: dict) -> List[str]:
         if not _is_num(kh.get("measured_swap_gbps")):
             errs.append("kv_hierarchy.measured_swap_gbps missing or not "
                         "a number — no calibration round-trip was timed")
+
+    # Windowed time-series + burn-rate alerts (ISSUE 19): CPU-runnable
+    # forced-overload discrimination run, so always present; when
+    # measured it must prove the in-bench assertions held (>= 1 overload
+    # page whose iteration falls INSIDE the forced-overload burst, ZERO
+    # alerts stamped in either calm phase, windowed-delta conservation
+    # against the engine's own counters, and ts+alerts on/off token +
+    # host-sync bit-parity) and keep the alert taxonomy CLOSED — a new
+    # kind must be added to telemetry/alerts.py ALERT_KINDS, never
+    # invented ad hoc in the bench output
+    ta = e.get("ts_alerts")
+    if not isinstance(ta, dict):
+        errs.append("extra['ts_alerts'] missing or not a dict (the "
+                    "forced-overload alert run is CPU-runnable — emit "
+                    "error/skipped entries rather than dropping it)")
+    elif "error" not in ta and "skipped_reason" not in ta:
+        from deeplearning4j_tpu.telemetry.alerts import ALERT_KINDS
+        if not isinstance(ta.get("platform"), str):
+            errs.append("extra['ts_alerts'] has no 'platform' label")
+        for flag in ("conservation", "tokens_identical", "sync_parity"):
+            if ta.get(flag) is not True:
+                errs.append(f"ts_alerts.{flag} must be True — the "
+                            "in-bench invariant assertion did not hold")
+        if not _is_num(ta.get("overload_alerts_in_burst")) \
+                or ta.get("overload_alerts_in_burst", 0) < 1:
+            errs.append("ts_alerts.overload_alerts_in_burst missing or "
+                        "< 1 — the forced overload never paged")
+        if ta.get("alerts_in_calm") != 0:
+            errs.append("ts_alerts.alerts_in_calm must be 0 — the "
+                        "monitor alerted on a calm phase (threshold "
+                        "noise, not discrimination)")
+        kinds = ta.get("alert_kinds")
+        if not isinstance(kinds, dict) or set(kinds) != set(ALERT_KINDS):
+            errs.append("ts_alerts.alert_kinds must be keyed by exactly "
+                        "the closed alert taxonomy "
+                        "(telemetry/alerts.py ALERT_KINDS)")
+        elif any(not _is_num(v) or v < 0 for v in kinds.values()):
+            errs.append("ts_alerts.alert_kinds values must be "
+                        "non-negative counts")
+        for k in ("peak_burn_rate_short", "slo_violations",
+                  "ts_samples", "host_syncs", "short_window"):
+            if not _is_num(ta.get(k)) or ta.get(k, -1) < 0:
+                errs.append(f"ts_alerts.{k} missing or negative")
 
     # Latency blame ledger (ISSUE 14): CPU-runnable forced-contention
     # attribution run, so always present; when measured it must prove the

@@ -520,6 +520,39 @@ def _disagg_ab_lines(da) -> list:
         f"see PERF.md \"Disaggregation cost model\".")]
 
 
+def _ts_alerts_lines(ta) -> list:
+    """Burn-rate alert section from extra['ts_alerts'] (ISSUE 19): the
+    three-phase calm/overload/calm run where the multi-window monitor
+    must page DURING the forced overload and stay silent in both calm
+    phases — discrimination, conservation and on/off bit-parity are all
+    asserted in-bench, so the rendered line is a proof summary, not a
+    sample."""
+    if not isinstance(ta, dict) or "alert_kinds" not in ta:
+        if isinstance(ta, dict) and (ta.get("skipped_reason")
+                                     or ta.get("error")):
+            return [f"- SLO burn-rate alerts: "
+                    f"{ta.get('skipped_reason') or ta.get('error')} "
+                    f"(platform: {ta.get('platform', '?')})."]
+        return []
+    kinds = ta.get("alert_kinds") or {}
+    fired = ", ".join(f"`{k}` x{v}" for k, v in kinds.items() if v) \
+        or "none retained"
+    return [(
+        f"- SLO burn-rate alerts (ISSUE 19, {ta.get('platform', '?')}): "
+        f"three-phase calm/overload/calm run ({ta.get('workload', '?')}) "
+        f"— the short-window monitor paged "
+        f"{ta.get('overload_alerts_in_burst', 0)}x INSIDE the forced "
+        f"overload (peak burn {ta.get('peak_burn_rate_short', 0):g}x "
+        f"budget over {ta.get('short_window', '?')} iters) and emitted "
+        f"**zero** alerts in either calm phase. Alerts retained: {fired}. "
+        f"Windowed deltas conserve against the engine's own counters and "
+        f"ts+alerts on/off greedy tokens + host syncs are "
+        f"**bit-identical** ({ta.get('host_syncs', '?')} syncs, "
+        f"{ta.get('ts_samples', '?')} samples) — all asserted in-bench. "
+        f"`DL4J_TPU_TS` / `DL4J_TPU_TS_WINDOW` / `DL4J_TPU_ALERTS` — "
+        f"see PERF.md \"Live SLO burn-rate methodology\".")]
+
+
 def render_block(art: dict) -> str:
     """Markdown bullet block rendered VERBATIM into README.md and PERF.md."""
     e = art["extra"]
@@ -681,6 +714,7 @@ def render_block(art: dict) -> str:
     lines.extend(_quantized_kv_lines(e.get("quantized_kv")))
     lines.extend(_prefix_radix_lines(e.get("prefix_radix")))
     lines.extend(_disagg_ab_lines(e.get("serving_disagg_ab")))
+    lines.extend(_ts_alerts_lines(e.get("ts_alerts")))
     lines.extend(_roofline_table_lines(e.get("roofline_table")))
     lines.append(
         f"- ParallelWrapper ResNet50: {pw['images_per_sec']:,.0f} img/s — "

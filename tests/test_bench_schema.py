@@ -139,6 +139,14 @@ def _minimal_art():
                 "sync_parity": True, "hit_token_frac": 0.77,
                 "flops_saved_frac": 0.88, "prefix_hit_tokens": 3120,
                 "fork_prefix_hit_tokens": 320},
+            "ts_alerts": {
+                "platform": "cpu", "conservation": True,
+                "tokens_identical": True, "sync_parity": True,
+                "overload_alerts_in_burst": 1, "alerts_in_calm": 0,
+                "alert_kinds": {"overload": 1, "goodput_regression": 1,
+                                "kv_pressure_spiral": 1, "starvation": 0},
+                "peak_burn_rate_short": 7.5, "slo_violations": 6,
+                "ts_samples": 28, "host_syncs": 36, "short_window": 8},
             "serving_disagg_ab": {
                 "platform": "cpu", "token_parity": True,
                 "different_winners": True,
@@ -615,6 +623,48 @@ def test_prefix_radix_rules():
     assert validate_artifact(art) == []
 
 
+def test_ts_alerts_rules():
+    """ISSUE 19: the forced-overload alert run must always exist; a
+    measured entry must prove the in-bench assertions held (>= 1
+    overload page inside the burst, zero calm-phase alerts, windowed
+    conservation, on/off token + host-sync parity) and keep the alert
+    taxonomy closed — kinds come from telemetry/alerts.py ALERT_KINDS,
+    never invented in bench output; errored/skipped exempt."""
+    art = _minimal_art()
+    del art["extra"]["ts_alerts"]
+    assert any("ts_alerts" in e for e in validate_artifact(art))
+    for flag in ("conservation", "tokens_identical", "sync_parity"):
+        art = _minimal_art()
+        art["extra"]["ts_alerts"][flag] = False
+        assert any(f"ts_alerts.{flag}" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["ts_alerts"]["overload_alerts_in_burst"] = 0
+    assert any("never paged" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["ts_alerts"]["alerts_in_calm"] = 2
+    assert any("calm" in e for e in validate_artifact(art))
+    # closed taxonomy: a missing kind and an invented kind both fail
+    art = _minimal_art()
+    del art["extra"]["ts_alerts"]["alert_kinds"]["starvation"]
+    assert any("closed alert taxonomy" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["ts_alerts"]["alert_kinds"]["vibes"] = 1
+    assert any("closed alert taxonomy" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["ts_alerts"]["alert_kinds"]["overload"] = -1
+    assert any("non-negative" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["ts_alerts"]["peak_burn_rate_short"]
+    assert any("peak_burn_rate_short" in e for e in validate_artifact(art))
+    # errored/skipped runs are exempt
+    art = _minimal_art()
+    art["extra"]["ts_alerts"] = {"error": "ValueError: boom"}
+    assert validate_artifact(art) == []
+    art["extra"]["ts_alerts"] = {"platform": "cpu",
+                                 "skipped_reason": "why not"}
+    assert validate_artifact(art) == []
+
+
 def test_serving_disagg_ab_rules():
     """ISSUE 17: the disagg A/B must always exist; a measured entry must
     prove token parity held, state the different-winners headline as an
@@ -746,3 +796,10 @@ def test_committed_artifact_passes_schema():
     assert sp["tokens_identical"] is True
     assert 0.0 < sp["accept_rate"] <= 1.0
     assert sp["spec_tokens_accepted"] > 0
+    # ISSUE 19 acceptance: the committed forced-overload run paged inside
+    # the burst, stayed silent in both calm phases, and held parity
+    ta = e["ts_alerts"]
+    assert "error" not in ta and "skipped_reason" not in ta
+    assert ta["overload_alerts_in_burst"] >= 1
+    assert ta["alerts_in_calm"] == 0
+    assert ta["tokens_identical"] is True and ta["sync_parity"] is True

@@ -180,7 +180,16 @@ def test_admit_denies_without_lifecycle_and_preempts_with_plan():
     assert dec.kind == "deny_with_hint"
     assert dec.hint["reclaimable_bytes"] == 128
 
+    class _Pool:
+        capacity_bytes = 4096
+        bytes_used = 1024
+
     class _Life:
+        # admit() reads the swap-ladder headroom (ISSUE 18) off the
+        # lifecycle before branching — the fake needs a host pool
+        host_pool = _Pool()
+        disk_pool = None
+
         def plan(self, snap, shortfall, eligible=None):
             return {"evicted": [{"slot": 0}], "satisfies": True}
 

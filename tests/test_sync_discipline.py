@@ -152,7 +152,12 @@ def test_all_hot_path_modules_exist():
             "policy.py", "disagg.py",
             # ISSUE 18: the disk spill tier materializes on pressure
             # paths only — pinned so its syncs stay annotated
-            "kv_disk.py"} <= names
+            "kv_disk.py",
+            # ISSUE 19: the windowed time-series layer samples once per
+            # scheduler iteration and the burn-rate monitor evaluates on
+            # every sample — both must stay pure host arithmetic (the
+            # on-vs-off token/sync bit-parity depends on it)
+            "timeseries.py", "alerts.py"} <= names
 
 
 # ------------------------------------------------ scanner self-tests

@@ -1,0 +1,587 @@
+"""Windowed time-series + burn-rate alert tests (ISSUE 19).
+
+Layers under test, cheapest first:
+
+- RingSeries / Window math on synthetic rows: wrap-around, degenerate
+  windows (0/1 samples, zero span) rate to 0.0 — never inf/NaN — and a
+  RANDOMIZED conservation property: windowed deltas always equal the
+  cumulative counter difference, and consecutive disjoint windows sum to
+  the whole-run total.
+- BurnRateMonitor on seeded synthetic series: the load-bearing
+  multi-window discrimination (a short-window burst pages as
+  ``overload`` while the long window stays under the ticket threshold),
+  rising-edge dedup + refire, and the bounded alert log.
+- ServingEngine integration on a tiny CPU net: one sample per scheduler
+  iteration keyed to the allocator clock, forced overload fires alerts
+  into stats()/metrics/flight recorder, and the hard invariant — ts and
+  alerts on-vs-off change NO tokens and add ZERO host syncs, at
+  decode_chunk K in {1, 8}.
+- Fleet aggregation (fleet_summary + ShardedServingGroup): rates SUM,
+  quantiles/ages MAX, blame shares renormalize.
+- Satellites: registry `_last_update` gauge-staleness siblings,
+  stats()["metric_stamps"], and the burn-aware policy deny hint.
+"""
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.serving import Request, ServingEngine
+from deeplearning4j_tpu.telemetry.alerts import (ALERT_KINDS,
+                                                 BurnRateMonitor,
+                                                 resolve_alerts,
+                                                 retry_after_from_burn)
+from deeplearning4j_tpu.telemetry.registry import MetricsRegistry
+from deeplearning4j_tpu.telemetry.slo import SLO
+from deeplearning4j_tpu.telemetry.timeseries import (FIELDS, RingSeries,
+                                                     ServingTimeSeries,
+                                                     fleet_summary,
+                                                     resolve_ts_enabled,
+                                                     resolve_ts_window)
+from tests.test_telemetry import _build_net
+
+IMPOSSIBLE = SLO(ttft_s=1e-9, tpot_s=1e-9)     # everything violates
+GENEROUS = SLO(ttft_s=60.0, tpot_s=60.0)       # nothing violates
+
+
+def _engine(**kw):
+    cfg = dict(max_seqs=2, max_len=64, seed=0, decode_chunk=4,
+               overlap=False)
+    cfg.update(kw)
+    return ServingEngine(_build_net(), **cfg)
+
+
+# ------------------------------------------------------------ ring series
+def test_ring_series_append_tail_and_wrap():
+    rs = RingSeries(("a", "b"), capacity=4)
+    for i in range(6):                        # wraps: keeps rows 2..5
+        rs.append({"a": i, "b": 10 * i})
+    assert len(rs) == 4 and rs.written == 6
+    tail = rs.tail(4)
+    assert tail[:, 0].tolist() == [2.0, 3.0, 4.0, 5.0]
+    assert tail[:, 1].tolist() == [20.0, 30.0, 40.0, 50.0]
+    # a shorter tail, and over-asking clamps to what exists
+    assert rs.tail(2)[:, 0].tolist() == [4.0, 5.0]
+    assert rs.tail(99).shape == (4, 2)
+    assert rs.tail(0).shape == (0, 2)
+    # unknown fields are ignored, missing fields read 0.0
+    rs.append({"a": 7, "zzz": 1.0})
+    assert rs.tail(1)[0].tolist() == [7.0, 0.0]
+
+
+def test_ring_series_rejects_degenerate_capacity():
+    with pytest.raises(ValueError):
+        RingSeries(("a",), capacity=1)
+    with pytest.raises(ValueError):
+        resolve_ts_window(1)
+
+
+def test_window_degenerate_rates_are_zero_never_nan():
+    """ISSUE 19 satellite: 0/1-sample and zero-span windows rate to 0.0."""
+    rs = RingSeries(FIELDS, capacity=8)
+    w = rs.window(5)                          # empty
+    assert w.n == 0
+    assert w.delta("tokens_out") == 0.0 and w.rate("tokens_out") == 0.0
+    assert w.last("queue_depth") == 0.0 and w.max("queue_depth") == 0.0
+    rs.append({"iter": 1, "wall_s": 5.0, "tokens_out": 100})
+    w = rs.window(5)                          # single sample: no span
+    assert w.n == 1 and w.rate("tokens_out") == 0.0
+    assert w.per_iter("tokens_out") == 0.0
+    # two samples at the SAME wall instant: zero span, rate stays 0.0
+    rs.append({"iter": 2, "wall_s": 5.0, "tokens_out": 200})
+    w = rs.window(5)
+    assert w.delta("tokens_out") == 100.0
+    assert w.rate("tokens_out") == 0.0        # not inf
+    # non-finite samples are scrubbed at append time
+    rs.append({"iter": 3, "wall_s": float("inf"), "tokens_out": float("nan")})
+    w = rs.window(5)
+    assert np.isfinite(w.rate("tokens_out"))
+    assert np.isfinite(w.last("wall_s"))
+
+
+def test_windowed_deltas_conserve_randomized():
+    """Conservation property: for ANY cut points, window deltas equal the
+    cumulative difference, and consecutive disjoint windows sum to the
+    run total (the ring is large enough to hold the whole run here)."""
+    rng = np.random.default_rng(19)
+    n = 200
+    ts = ServingTimeSeries(short_window=5, capacity=n + 8)
+    cum = {"tokens_out": 0.0, "retirements": 0.0, "preemptions": 0.0}
+    hist = []
+    wall = 0.0
+    for i in range(n):
+        wall += float(rng.uniform(0.001, 0.05))
+        for k in cum:
+            cum[k] += float(rng.integers(0, 5))
+        hist.append(dict(cum))
+        ts.sample({"iter": i + 1, "wall_s": wall, **cum})
+    # arbitrary window sizes: delta == cum[last] - cum[first]
+    for _ in range(50):
+        size = int(rng.integers(2, n))
+        w = ts.window(size)
+        for k in cum:
+            assert w.delta(k) == pytest.approx(
+                hist[-1][k] - hist[-size][k])
+    # disjoint consecutive windows tile the run: deltas sum to the total
+    rows = ts.series.tail(n)
+    idx = {f: i for i, f in enumerate(ts.series.fields)}
+    cuts = sorted(set([0, n - 1]) | set(
+        int(c) for c in rng.integers(1, n - 1, size=6)))
+    for k in cum:
+        col = rows[:, idx[k]]
+        parts = [col[b] - col[a] for a, b in zip(cuts, cuts[1:])]
+        assert sum(parts) == pytest.approx(cum[k] - hist[0][k])
+
+
+def test_blame_shares_empty_when_nothing_attributed():
+    ts = ServingTimeSeries(short_window=4)
+    for i in range(6):
+        ts.sample({"iter": i, "wall_s": 0.1 * i})
+    assert ts.blame_shares() == {}
+    # attribute some wall: shares normalize to 1 over the known causes
+    for i in range(6, 12):
+        ts.sample({"iter": i, "wall_s": 0.1 * i,
+                   "queue_wait_sum_s": 0.3 * i,
+                   "decode_chunk_sum_ms": 100.0 * i})
+    shares = ts.blame_shares()
+    assert set(shares) == {"queue_wait", "prefill_chunk_interference",
+                           "decode_compute"}
+    assert sum(shares.values()) == pytest.approx(1.0)
+
+
+def test_ts_env_knobs(monkeypatch):
+    monkeypatch.delenv("DL4J_TPU_TS", raising=False)
+    monkeypatch.delenv("DL4J_TPU_TS_WINDOW", raising=False)
+    assert resolve_ts_enabled() is False
+    assert resolve_ts_enabled(True) is True   # explicit arg wins
+    monkeypatch.setenv("DL4J_TPU_TS", "1")
+    assert resolve_ts_enabled() is True
+    assert resolve_ts_enabled(False) is False
+    assert resolve_ts_window() == 30
+    monkeypatch.setenv("DL4J_TPU_TS_WINDOW", "12")
+    assert resolve_ts_window() == 12
+    assert resolve_ts_window(6) == 6
+
+
+# ------------------------------------------------------- burn-rate monitor
+def _seed_series(ts, n, *, viol_from=None, retire_per_iter=1.0):
+    """n samples, one retirement per iteration; iterations >= viol_from
+    also violate (100% violation rate from that point)."""
+    viol = 0.0
+    for i in range(1, n + 1):
+        if viol_from is not None and i >= viol_from:
+            viol += retire_per_iter
+        ts.sample({"iter": i, "wall_s": 0.01 * i,
+                   "retirements": retire_per_iter * i,
+                   "slo_violations": viol})
+
+
+def test_short_window_burst_pages_long_window_does_not_ticket():
+    """The tentpole discrimination: a fresh burst violates the SHORT
+    window (page: overload) while the LONG window, diluted by the healthy
+    history, stays under the ticket threshold (no goodput_regression)."""
+    ts = ServingTimeSeries(short_window=5, long_window=50)
+    mon = BurnRateMonitor(GENEROUS, short_window=5, long_window=50)
+    _seed_series(ts, 58, viol_from=56)        # 3 bad iters at the end
+    fired = mon.evaluate(ts, iter_id=58, wall_s=0.58)
+    kinds = {a.kind for a in fired}
+    assert "overload" in kinds
+    assert "goodput_regression" not in kinds
+    # short: 3 violations / 4 retired deltas -> burn 7.5; long: 3/49
+    assert mon.burn_rate_short > mon.page_burn
+    assert mon.burn_rate_long < mon.ticket_burn
+    over = next(a for a in fired if a.kind == "overload")
+    assert over.severity == "page" and over.iter == 58
+    assert over.value == pytest.approx(mon.burn_rate_short)
+
+
+def test_sustained_burn_tickets_goodput_regression():
+    ts = ServingTimeSeries(short_window=5, long_window=50)
+    mon = BurnRateMonitor(GENEROUS, short_window=5, long_window=50)
+    _seed_series(ts, 80, viol_from=1)         # violating from the start
+    fired = mon.evaluate(ts, iter_id=80, wall_s=0.8)
+    kinds = {a.kind for a in fired}
+    assert {"overload", "goodput_regression"} <= kinds
+    ticket = next(a for a in fired if a.kind == "goodput_regression")
+    assert ticket.severity == "ticket"
+
+
+def test_burn_zero_when_nothing_retired():
+    ts = ServingTimeSeries(short_window=5)
+    mon = BurnRateMonitor(GENEROUS, short_window=5)
+    for i in range(1, 10):                    # queue-only iterations
+        ts.sample({"iter": i, "wall_s": 0.01 * i})
+    assert mon.evaluate(ts, iter_id=9, wall_s=0.09) == []
+    assert mon.burn_rate_short == 0.0 and mon.burn_rate_long == 0.0
+
+
+def test_rising_edge_dedup_and_refire():
+    """A condition that STAYS true emits once, then again only after
+    refire_iters; clearing and re-crossing re-emits immediately."""
+    ts = ServingTimeSeries(short_window=5, long_window=50)
+    mon = BurnRateMonitor(GENEROUS, short_window=5, long_window=50,
+                          refire_iters=100)
+    _seed_series(ts, 58, viol_from=56)
+    assert any(a.kind == "overload"
+               for a in mon.evaluate(ts, iter_id=58, wall_s=0.58))
+    # still burning next iterations: deduped
+    for it in (59, 60, 61):
+        ts.sample({"iter": it, "wall_s": 0.01 * it,
+                   "retirements": it, "slo_violations": it - 55})
+        assert not any(a.kind == "overload"
+                       for a in mon.evaluate(ts, iter_id=it,
+                                             wall_s=0.01 * it))
+    # condition clears (healthy samples wash the short window)...
+    for it in range(62, 70):
+        ts.sample({"iter": it, "wall_s": 0.01 * it,
+                   "retirements": it, "slo_violations": 6.0})
+        mon.evaluate(ts, iter_id=it, wall_s=0.01 * it)
+    assert mon.burn_rate_short == 0.0
+    # ...then re-crosses: rising edge emits again well before refire
+    for it in range(70, 75):
+        ts.sample({"iter": it, "wall_s": 0.01 * it,
+                   "retirements": it, "slo_violations": 6.0 + (it - 69)})
+    fired = mon.evaluate(ts, iter_id=74, wall_s=0.74)
+    assert any(a.kind == "overload" for a in fired)
+    assert sum(a.kind == "overload" for a in mon.alerts()) == 2
+
+
+def test_refire_reemits_persistent_condition():
+    ts = ServingTimeSeries(short_window=5, long_window=50)
+    mon = BurnRateMonitor(GENEROUS, short_window=5, long_window=50,
+                          refire_iters=10)
+    _seed_series(ts, 56, viol_from=1)
+    mon.evaluate(ts, iter_id=56, wall_s=0.56)
+    for it in range(57, 70):
+        ts.sample({"iter": it, "wall_s": 0.01 * it,
+                   "retirements": it, "slo_violations": it})
+        mon.evaluate(ts, iter_id=it, wall_s=0.01 * it)
+    overloads = [a.iter for a in mon.alerts() if a.kind == "overload"]
+    assert overloads == [56, 66]              # refire exactly every 10
+
+def test_alert_log_bounded_with_drop_counter():
+    ts = ServingTimeSeries(short_window=5, long_window=50)
+    mon = BurnRateMonitor(GENEROUS, short_window=5, long_window=50,
+                          log_capacity=3, refire_iters=1)
+    _seed_series(ts, 56, viol_from=1)
+    for it in range(56, 66):                  # refire=1: one per evaluate
+        mon.evaluate(ts, iter_id=it, wall_s=0.01 * it)
+    assert len(mon.alerts()) == 3             # bounded
+    assert mon.dropped > 0
+    assert mon.n_alerts == len(mon.alerts()) + mon.dropped
+    # counts() keys the full taxonomy even for kinds never fired
+    assert set(mon.counts()) == set(ALERT_KINDS)
+
+
+def test_pressure_spiral_fires_without_slo():
+    """kv_pressure_spiral keys off admission-retry/preemption rates, not
+    the SLO — a monitor with slo=None can still page on pool thrash."""
+    ts = ServingTimeSeries(short_window=5)
+    mon = BurnRateMonitor(None, short_window=5, pressure_per_iter=0.5)
+    for i in range(1, 8):
+        ts.sample({"iter": i, "wall_s": 0.01 * i,
+                   "admission_retries": 2 * i, "preemptions": i})
+    fired = mon.evaluate(ts, iter_id=7, wall_s=0.07)
+    assert [a.kind for a in fired] == ["kv_pressure_spiral"]
+    assert fired[0].severity == "page"
+
+
+def test_starvation_requires_slo_and_old_head():
+    ts = ServingTimeSeries(short_window=5)
+    slo = SLO(ttft_s=0.1, tpot_s=1.0)
+    mon = BurnRateMonitor(slo, short_window=5, starvation_factor=3.0)
+    for i in range(1, 8):
+        ts.sample({"iter": i, "wall_s": 0.01 * i, "oldest_wait_s": 0.05})
+    assert mon.evaluate(ts, iter_id=7, wall_s=0.07) == []
+    ts.sample({"iter": 8, "wall_s": 0.08, "oldest_wait_s": 0.5})
+    fired = mon.evaluate(ts, iter_id=8, wall_s=0.08)
+    assert [a.kind for a in fired] == ["starvation"]
+    assert fired[0].threshold == pytest.approx(0.3)
+
+
+def test_monitor_rejects_bad_config():
+    with pytest.raises(ValueError):
+        BurnRateMonitor(budget_frac=0.0)
+    with pytest.raises(ValueError):
+        BurnRateMonitor(budget_frac=1.5)
+    with pytest.raises(ValueError):
+        BurnRateMonitor(log_capacity=0)
+
+
+def test_retry_after_from_burn_hint_math():
+    # no monitor / unknown burn: the plain static slack
+    assert retry_after_from_burn(0.5, None) == 0.5
+    assert retry_after_from_burn(0.5, 0.0) == 0.5
+    assert retry_after_from_burn(0.5, float("nan")) == 0.5
+    assert retry_after_from_burn(-1.0, None) == 0.0    # clamped
+    # burning engine stretches the backoff proportionally, capped at 10x
+    assert retry_after_from_burn(0.5, 2.0) == pytest.approx(1.5)
+    assert retry_after_from_burn(0.5, 1e9) == pytest.approx(5.5)
+
+
+def test_alerts_env_knob(monkeypatch):
+    monkeypatch.delenv("DL4J_TPU_ALERTS", raising=False)
+    assert resolve_alerts() is None
+    monkeypatch.setenv("DL4J_TPU_ALERTS", "1")
+    mon = resolve_alerts(slo=GENEROUS, short_window=7)
+    assert isinstance(mon, BurnRateMonitor)
+    assert mon.slo is GENEROUS and mon.short_window == 7
+    monkeypatch.setenv("DL4J_TPU_ALERTS", "0")
+    assert resolve_alerts() is None
+    # an explicit instance always wins
+    mine = BurnRateMonitor(short_window=4)
+    assert resolve_alerts(mine) is mine
+
+
+# ------------------------------------------------------ engine integration
+def test_engine_samples_once_per_iteration_on_allocator_clock():
+    eng = _engine(timeseries=True, ts_window=4)
+    eng.generate([Request([1, 2, 3], max_new_tokens=6),
+                  Request([4, 5, 6, 7], max_new_tokens=6)])
+    st = eng.stats()
+    ts = st["ts"]
+    assert ts is not None and ts["samples"] >= 2
+    # the series clock IS the allocator's scheduler-iteration clock
+    assert ts["iter"] == eng.decoder.cache.allocator.clock
+    assert ts["samples"] == len(eng.timeseries)
+    assert ts["tokens_per_s"] >= 0.0
+    assert ts["short_window"] == 4 and ts["long_window"] == 40
+    # windowed delta conserves against the cumulative counter: the full
+    # ring covers the whole (short) run here
+    w = eng.timeseries.window(len(eng.timeseries))
+    assert w.last("tokens_out") == st["tokens_out"]
+    assert w.last("retirements") == eng._c_retires.value
+    # serving.ts.* gauges published
+    snap = eng.metrics.snapshot()
+    assert "serving.ts.tokens_per_s" in snap
+    assert "serving.ts.queue_depth" in snap
+    eng.shutdown()
+
+
+def test_engine_ts_off_by_default_and_stats_none():
+    eng = _engine()
+    eng.generate([Request([1, 2, 3], max_new_tokens=4)])
+    st = eng.stats()
+    assert eng.timeseries is None and eng.alerts is None
+    assert st["ts"] is None
+    assert "serving.ts.tokens_per_s" not in eng.metrics.snapshot()
+    eng.shutdown()
+
+
+def test_engine_forced_overload_fires_alerts():
+    """An impossible SLO makes every retirement a violation: the short
+    window burns immediately and ``overload`` pages into the metrics,
+    stats() and the flight recorder."""
+    from deeplearning4j_tpu.telemetry.flight_recorder import FlightRecorder
+    fr = FlightRecorder(capacity=8, worst_k=4)
+    mon = BurnRateMonitor(IMPOSSIBLE, short_window=4)
+    eng = _engine(alerts=mon, ts_window=4, flight_recorder=fr)
+    assert eng.timeseries is not None         # alerts imply the series
+    eng.generate([Request([1, 2, 3], max_new_tokens=8)
+                  for _ in range(4)])
+    st = eng.stats()
+    assert st["slo_violations"] == 4          # every request violated
+    assert st["alerts_total"] >= 1
+    assert any(a.kind == "overload" for a in mon.alerts())
+    snap = eng.metrics.snapshot()
+    assert snap["serving.alerts.burn_rate_short"] > 1.0
+    assert snap["serving.alerts.overload"] >= 1
+    assert snap["serving.alerts_total"] == st["alerts_total"]
+    # the recorder retained the alert notes; the Perfetto dump renders
+    # them as global instants on a dedicated track
+    assert any(a["kind"] == "overload" for a in fr.alerts())
+    trace = fr.perfetto()
+    marks = [e for e in trace["traceEvents"]
+             if e.get("cat") == "alert" and e["ph"] == "i"]
+    assert marks and all(e["s"] == "g" for e in marks)
+    assert trace["otherData"]["n_alerts"] == len(fr.alerts())
+    eng.shutdown()
+
+
+def test_engine_healthy_run_fires_nothing():
+    mon = BurnRateMonitor(GENEROUS, short_window=4)
+    eng = _engine(alerts=mon, ts_window=4)
+    eng.generate([Request([1, 2, 3], max_new_tokens=6)])
+    assert eng.stats()["alerts_total"] == 0
+    assert mon.alerts() == []
+    assert eng.stats()["slo_violations"] == 0
+    eng.shutdown()
+
+
+@pytest.mark.parametrize("chunk", [1, 8])
+def test_host_syncs_and_tokens_bit_parity_ts_on_vs_off(chunk):
+    """The hard invariant (tentpole acceptance): the sampling layer AND
+    the monitor read only host-visible state — greedy tokens and
+    host_syncs are BIT-identical with everything on vs everything off,
+    at decode_chunk K in {1, 8}."""
+    prompts = [[1, 2, 3, 4, 5], [6, 7, 8]]
+
+    def serve(**kw):
+        telemetry.tracer().clear()
+        eng = ServingEngine(_build_net(), max_seqs=2, max_len=64, seed=4,
+                            decode_chunk=chunk, overlap=False, **kw)
+        res = eng.generate([Request(list(p), max_new_tokens=10)
+                            for p in prompts])
+        eng.shutdown()
+        return [r.tokens for r in res], eng.stats()
+
+    toks_on, st_on = serve(alerts=BurnRateMonitor(IMPOSSIBLE,
+                                                  short_window=4),
+                           ts_window=4)
+    toks_off, st_off = serve()
+    assert toks_on == toks_off
+    assert st_on["host_syncs"] == st_off["host_syncs"]
+    assert st_on["host_syncs_per_token"] == st_off["host_syncs_per_token"]
+    # and the instrumented run really did sample + violate
+    assert st_on["ts"]["samples"] > 0
+    assert st_on["slo_violations"] == len(prompts)
+
+
+def test_result_tokens_per_sec_never_inf_nan():
+    """ISSUE 19 satellite audit: per-request throughput is None or a
+    finite positive float — never inf/NaN, even for 1-token requests
+    (no decode span)."""
+    eng = _engine(timeseries=True, ts_window=4)
+    res = eng.generate([Request([1, 2, 3], max_new_tokens=1),
+                        Request([4, 5, 6], max_new_tokens=8)])
+    for r in res:
+        assert r.tokens_per_sec is None or (
+            np.isfinite(r.tokens_per_sec) and r.tokens_per_sec > 0)
+    eng.shutdown()
+
+
+# ------------------------------------------------------- fleet aggregation
+def test_fleet_summary_sums_rates_maxes_quantiles():
+    a = {"samples": 10, "iter": 100, "wall_s": 5.0, "short_window": 4,
+         "long_window": 40, "tokens_per_s": 50.0, "admissions_per_s": 2.0,
+         "retirements_per_s": 2.0, "preemptions_per_s": 0.0,
+         "admission_retries_per_s": 0.0, "tokens_per_s_long": 45.0,
+         "retirements_per_s_long": 1.5, "queue_depth": 3.0,
+         "active_slots": 2.0, "oldest_wait_s": 0.2, "ttft_p50_s": 0.01,
+         "ttft_p99_s": 0.05, "tpot_p50_s": 0.002, "tpot_p99_s": 0.004,
+         "blame_shares": {"queue_wait": 0.5, "decode_compute": 0.5}}
+    b = dict(a, tokens_per_s=30.0, queue_depth=1.0, ttft_p99_s=0.2,
+             oldest_wait_s=0.05, iter=90,
+             blame_shares={"decode_compute": 1.0})
+    fleet = fleet_summary([a, b])
+    assert fleet["replicas"] == 2
+    assert fleet["tokens_per_s"] == pytest.approx(80.0)      # sum
+    assert fleet["queue_depth"] == pytest.approx(4.0)        # sum
+    assert fleet["samples"] == 20                            # sum
+    assert fleet["ttft_p99_s"] == pytest.approx(0.2)         # max (worst)
+    assert fleet["oldest_wait_s"] == pytest.approx(0.2)      # max
+    assert fleet["iter"] == 100                              # max
+    assert fleet["short_window"] == 4
+    # blame: share-weighted merge renormalized to 1
+    assert fleet["blame_shares"]["decode_compute"] == pytest.approx(0.75)
+    assert fleet["blame_shares"]["queue_wait"] == pytest.approx(0.25)
+    assert sum(fleet["blame_shares"].values()) == pytest.approx(1.0)
+    # empty fleet: just the replica count, no fabricated zeros
+    assert fleet_summary([]) == {"replicas": 0}
+
+
+def test_group_fleet_timeseries(forced_host_devices):
+    from deeplearning4j_tpu.serving.sharding import ShardedServingGroup
+    from tests.test_serving import _build_net as _net
+    grp = ShardedServingGroup(_net(n_kv=2), 4, 64, replicas=2, tp=1,
+                              dtype="float64", timeseries=True,
+                              ts_window=4)
+    grp.generate([[1, 2, 3, 4], [5, 6, 7], [2, 4, 6], [8, 6, 4, 2]],
+                 max_new_tokens=4)
+    fleet = grp.fleet_timeseries()
+    assert fleet["replicas"] == 2
+    assert len(fleet["per_replica"]) == 2
+    # fleet totals are the per-replica sums
+    assert fleet["samples"] == sum(s["samples"]
+                                   for s in fleet["per_replica"])
+    assert fleet["tokens_per_s"] == pytest.approx(
+        sum(s["tokens_per_s"] for s in fleet["per_replica"]))
+    assert fleet["ttft_p99_s"] == max(s["ttft_p99_s"]
+                                      for s in fleet["per_replica"])
+    # fleet gauges published on the group registry
+    snap = grp.metrics.snapshot()
+    assert "serving.ts.fleet_tokens_per_s" in snap
+    # group stats() sums the new per-engine counters
+    st = grp.stats()
+    assert st["slo_violations"] == sum(s["slo_violations"]
+                                       for s in st["per_replica"])
+    assert st["alerts_total"] == 0
+    grp.shutdown()
+
+
+# ----------------------------------------------------- satellite: staleness
+def test_gauge_last_update_exposition_sibling():
+    reg = MetricsRegistry()
+    reg.iter_clock = 7
+    g = reg.gauge("pool.depth", "depth")
+    never = reg.gauge("pool.never_written", "never")
+    g.set(3.0)
+    text = reg.prometheus_text()
+    assert "pool_depth 3" in text
+    assert '# TYPE pool_depth_last_update gauge' in text
+    assert 'pool_depth_last_update{clock="iter"} 7' in text
+    assert 'pool_depth_last_update{clock="wall_s"}' in text
+    # a never-written gauge gets NO sibling (a fabricated 0 would read
+    # as "updated at epoch")
+    assert "pool_never_written_last_update" not in text
+    assert never.last_update is None
+    # counters/histograms carry stamps in snapshots but NOT exposition
+    # siblings (the round-trip reference parse pins the family set)
+    c = reg.counter("pool.events")
+    c.inc()
+    assert "pool_events_last_update" not in reg.prometheus_text()
+    stamps = reg.stamps()
+    assert stamps["pool.events"]["iter"] == 7
+    assert stamps["pool.depth"]["wall_s"] > 0
+    assert "pool.never_written" not in stamps
+
+
+def test_engine_stats_carry_metric_stamps():
+    eng = _engine(timeseries=True, ts_window=4)
+    eng.generate([Request([1, 2, 3], max_new_tokens=4)])
+    st = eng.stats()
+    stamps = st["metric_stamps"]
+    assert stamps["serving.tokens_out"]["iter"] > 0
+    # the stamp's iteration clock tracks the allocator clock
+    assert stamps["serving.tokens_out"]["iter"] \
+        <= eng.decoder.cache.allocator.clock
+    eng.shutdown()
+
+
+# ------------------------------------------------- satellite: policy hint
+def test_policy_deny_hint_stretches_with_burn():
+    from types import SimpleNamespace
+    from deeplearning4j_tpu.serving.policy import ColocatedPolicy
+    pol = ColocatedPolicy(slo=SLO(ttft_s=1.0, tpot_s=1.0))
+    lc = SimpleNamespace(host_pool=SimpleNamespace(capacity_bytes=0,
+                                                   bytes_used=0),
+                         disk_pool=None)
+    view = {"lifecycle": lc, "reclaimable_bytes": 0, "now": 10.0,
+            "t_submit": 9.5, "shortfall": 1, "eligible": (),
+            "snapshot_fn": lambda: None}
+    # no monitor: the hint is the plain static slack (0.5s left)
+    d0 = pol.admit(None, dict(view, burn_rate_short=None))
+    assert d0.kind == "deny_with_hint"
+    assert d0.hint["retry_after_s"] == pytest.approx(0.5)
+    # a burning engine stretches the same slack
+    d1 = pol.admit(None, dict(view, burn_rate_short=2.0))
+    assert d1.hint["retry_after_s"] == pytest.approx(1.5)
+    assert d1.hint["retry_after_s"] > d0.hint["retry_after_s"]
+
+
+def test_engine_admission_view_carries_burn_rate():
+    from types import SimpleNamespace
+    mon = BurnRateMonitor(IMPOSSIBLE, short_window=4)
+    eng = _engine(alerts=mon, ts_window=4, max_seqs=1)
+    eng.generate([Request([1, 2, 3], max_new_tokens=6) for _ in range(3)])
+    act = SimpleNamespace(req=Request([1, 2, 3], max_new_tokens=4),
+                          resume=None, t_submit=0.0)
+    with eng._lock:
+        view = eng._admission_view(act, 0.0)
+    assert view["burn_rate_short"] == mon.burn_rate_short
+    assert mon.burn_rate_short > 0.0          # the forced overload burned
+    eng.shutdown()
+    eng2 = _engine()
+    with eng2._lock:
+        view2 = eng2._admission_view(act, 0.0)
+    assert view2["burn_rate_short"] is None
+    eng2.shutdown()
