@@ -34,6 +34,12 @@ conservation invariant closes over disaggregated requests too.
 Sync discipline: pure host bookkeeping — no jax import, no device
 access (tests/test_sync_discipline.py scans this module). The device
 work (gather/restore) stays in engine.py where it is counted.
+
+Determinism contract (ISSUE 20): route/transfer verdicts are pure
+functions of the fleet view and the policy's own cursor state — no wall
+clock, no RNG (the test_sync_discipline determinism scan pins this), so
+a journaled group run replays bit-exactly by forcing the recorded
+verdicts through serving/replay.py's ReplayPolicy in consult order.
 """
 from __future__ import annotations
 

@@ -445,6 +445,7 @@ class ServingEngine:
                  timeseries=None,
                  ts_window: Optional[int] = None,
                  alerts=None,
+                 journal=None,
                  name: Optional[str] = None):
         self.decoder = self._build_decoder(net, max_seqs, max_len,
                                            dtype=dtype,
@@ -918,6 +919,20 @@ class ServingEngine:
                     f"serving.alerts.{kind}",
                     f"'{kind}' alerts emitted by the burn-rate monitor")
                 for kind in _alerts_mod.ALERT_KINDS}
+        # scheduler decision journal (ISSUE 20): every nondeterministic
+        # input and policy verdict as a typed record keyed to the
+        # allocator tick clock, replayable via serving/replay.py. Same
+        # contract as the layers above: off (the default) is None and
+        # zero code on scheduler paths — journaling on-vs-off is
+        # host-sync and token bit-parity. Enable via journal= or
+        # DL4J_TPU_JOURNAL; DL4J_TPU_JOURNAL_BYTES caps retention.
+        from deeplearning4j_tpu.telemetry import journal as _journal_mod
+        self.journal = _journal_mod.resolve_journal(journal)
+        self._incidents: List[str] = []   # frozen incident-bundle paths
+        # replay director seam (serving/replay.py): when installed, the
+        # wall-deadline shed/expire predicates and the measured-bandwidth
+        # preempt-mode choice read journaled outcomes instead
+        self._replay = None
         _tmemory.poll("serving.engine_init", registry=self.metrics)
 
     # ----------------------------------------------- sharding seams (ISSUE 10)
@@ -1025,7 +1040,10 @@ class ServingEngine:
                     "kv_swap_harvests": self._c_swap_harvest.value,
                     "kv_pending_swaps": len(self._pending_swaps),
                     "kv_swap_lost": self._c_swap_lost.value,
-                    "kv_measured_swap_gbps": self._g_swap_gbps.value}
+                    "kv_measured_swap_gbps": self._g_swap_gbps.value,
+                    "journal": (self.journal.stats()
+                                if self.journal is not None else None),
+                    "incidents": list(self._incidents)}
 
     def kv_pool_snapshot(self, include_blocks: bool = True
                          ) -> Dict[str, object]:
@@ -1068,6 +1086,16 @@ class ServingEngine:
                           t_submit=time.monotonic(),
                           req_id=self._next_req_id)
             self._queue.append(act)
+            if self.journal is not None:
+                # the one nondeterministic INPUT (everything else the
+                # journal holds is a decision): token ids + knobs + the
+                # submit tick, enough for replay to re-create the request
+                self._jrec("arrival", req=act.req_id,
+                           tokens=[int(t) for t in req.tokens],
+                           max_new=int(req.max_new_tokens),
+                           temp=req.temperature, eos=req.eos_id,
+                           timeout_s=req.timeout_s,
+                           session=req.session_id, turn=req.turn_idx)
             telemetry.instant("submit", req=act.req_id, plen=plen,
                               queued=len(self._queue))
             self._work.notify()
@@ -1088,9 +1116,20 @@ class ServingEngine:
         evicted_for: set = set()       # one eviction round per request/call
         while self._queue:
             act = self._queue[0]
-            if act.deadline is not None and time.monotonic() > act.deadline:
+            # queue-shed deadline: one of the two wall-clock predicates in
+            # the scheduler (the other is _expire_timeouts) — under replay
+            # the director supplies the journaled outcome instead, which
+            # is what removes the wall clock from the loop (ISSUE 20)
+            timed_out = act.deadline is not None \
+                and time.monotonic() > act.deadline
+            if self._replay is not None:
+                timed_out = self._replay.should_shed(
+                    act.req_id, cache.allocator.clock)
+            if timed_out:
                 self._queue.pop(0)
                 now = time.monotonic()
+                jseq = self._jrec("shed", req=act.req_id,
+                                  retries=act.retries)
                 # a preempted request that times out while requeued still
                 # returns the tokens it had generated before eviction
                 toks_out = [int(t) for t in act.resume["tokens"]] \
@@ -1105,9 +1144,11 @@ class ServingEngine:
                 if act.kv_rejection is not None:
                     act.timeline.append(act.kv_rejection)
                     act.kv_rejection = None
-                act.timeline.append({"phase": "retire", "t0": now, "t1": now,
-                                     "reason": "timeout",
-                                     "tokens": len(toks_out)})
+                ev = {"phase": "retire", "t0": now, "t1": now,
+                      "reason": "timeout", "tokens": len(toks_out)}
+                if jseq is not None:
+                    ev["journal_seq"] = jseq
+                act.timeline.append(ev)
                 res = GenerationResult(toks_out, "timeout",
                                        len(act.req.tokens),
                                        req_id=act.req_id,
@@ -1186,6 +1227,22 @@ class ServingEngine:
                 if act.req_id not in evicted_for:
                     decision = self.policy.admit(
                         act.req, self._admission_view(act, t_adm0))
+                    if self.journal is not None:
+                        # the verdict with enough of the eviction plan to
+                        # re-execute it: ReplayPolicy (serving/replay.py)
+                        # replays these instead of consulting heuristics
+                        vs = [{"slot": v["slot"], "req_id": v.get("req_id"),
+                               "blocks_total": v.get("blocks_total"),
+                               "blocks_freed": v.get("blocks_freed")}
+                              for v in (decision.victims or
+                                        {}).get("evicted", ())]
+                        hint = decision.hint or {}
+                        self._jrec("admission", req=act.req_id,
+                                   verdict=decision.kind, victims=vs,
+                                   reclaimable_bytes=hint.get(
+                                       "reclaimable_bytes", 0),
+                                   retry_after_s=hint.get(
+                                       "retry_after_s", 0.0))
                     if decision.kind == "preempt" \
                             and self._execute_evictions(decision.victims):
                         evicted_for.add(act.req_id)
@@ -1239,6 +1296,12 @@ class ServingEngine:
                 self._c_role_dec.inc()
             telemetry.instant("admit", req=act.req_id, slot=slot, plen=plen,
                               retries=act.retries, queued=len(self._queue))
+            jseq_admit = self._jrec("admit", req=act.req_id, slot=slot,
+                                    blocks=plan.n_blocks, shared=shared,
+                                    retries=act.retries,
+                                    resume=(act.resume["mode"]
+                                            if act.resume is not None
+                                            else None))
             if act.resume is not None and act.resume["mode"] == "swap" \
                     and not self.lifecycle.has_swap(act.req_id):
                 # lost spill (e.g. a disk entry that rotted after the
@@ -1266,11 +1329,13 @@ class ServingEngine:
                 # the prompt pass is deferred — one bounded chunk per
                 # scheduler iteration (_prefill_step) interleaved with
                 # resident decode, instead of one decode-stalling dispatch
-                act.timeline.append(
-                    {"phase": "admission", "t0": t_adm0,
-                     "t1": time.monotonic(), "slot": slot,
-                     "blocks": plan.n_blocks, "shared": shared,
-                     "iter": self._iter_id})
+                ev = {"phase": "admission", "t0": t_adm0,
+                      "t1": time.monotonic(), "slot": slot,
+                      "blocks": plan.n_blocks, "shared": shared,
+                      "iter": self._iter_id}
+                if jseq_admit is not None:
+                    ev["journal_seq"] = jseq_admit
+                act.timeline.append(ev)
                 self._prefilling.append(act)
                 self._update_kv_resident()
                 continue
@@ -1295,10 +1360,13 @@ class ServingEngine:
                                 bucket=bucket) if miss else telemetry.NULL_SPAN
             t_pf = time.perf_counter()
             t_pf_mono = time.monotonic()
-            act.timeline.append({"phase": "admission", "t0": t_adm0,
-                                 "t1": t_pf_mono, "slot": slot,
-                                 "blocks": plan.n_blocks, "shared": shared,
-                                 "iter": self._iter_id})
+            ev_adm = {"phase": "admission", "t0": t_adm0,
+                      "t1": t_pf_mono, "slot": slot,
+                      "blocks": plan.n_blocks, "shared": shared,
+                      "iter": self._iter_id}
+            if jseq_admit is not None:
+                ev_adm["journal_seq"] = jseq_admit
+            act.timeline.append(ev_adm)
             had_active = bool(self._active_mask.any())
             with cm, telemetry.span("prefill", req=act.req_id, slot=slot,
                                     plen=plen_eff, bucket=bucket,
@@ -1450,6 +1518,10 @@ class ServingEngine:
               "iter": self._iter_id, "wall_s": wall_ms / 1e3}
         if miss:
             ev["compile"] = True
+        jseq = self._jrec("pf_chunk", req=act.req_id, slot=slot,
+                          chunk=act.n_chunks, start=start, end=end)
+        if jseq is not None:
+            ev["journal_seq"] = jseq
         act.timeline.append(ev)
         act.n_chunks += 1
         act.prefilled = end
@@ -1643,7 +1715,54 @@ class ServingEngine:
             if self.flight_recorder is not None:
                 note = a.to_dict()
                 note["source"] = self.name
+                if self.journal is not None:
+                    # cross-link: the journal record boundary at firing
+                    # time — every record with seq <= this belongs to the
+                    # history that produced the alert
+                    note["journal_seq"] = self.journal.seq
                 self.flight_recorder.note_alert(note)
+        if fired and self.journal is not None:
+            # incident capture (ISSUE 20): freeze the journal tail (the
+            # monitor's long window of iterations) into a replayable
+            # bundle next to the flight-recorder Perfetto dump. No-op
+            # unless an incident root is configured (journal dir or
+            # DL4J_TPU_INCIDENT_DIR) — and pure host file I/O when it is.
+            notes = [dict(a.to_dict(), source=self.name) for a in fired]
+            bundle = self.journal.freeze_incident(
+                notes, tail_iters=mon.long_window,
+                flight_recorder=self.flight_recorder)
+            if bundle is not None:
+                self._incidents.append(bundle)
+                telemetry.instant("incident", bundle=bundle,
+                                  kinds=[a.kind for a in fired])
+
+    # ------------------------------------------- decision journal (ISSUE 20)
+    def _jrec(self, kind: str, **fields):
+        """Append one typed record to the decision journal, keyed to the
+        allocator tick clock; returns its seq (the timeline cross-link)
+        or None when journaling is off. Pure host dict bookkeeping —
+        zero device syncs, so journaling on-vs-off is token and
+        host-sync bit-parity (the tentpole invariant)."""
+        j = self.journal
+        if j is None:
+            return None
+        return j.record(kind, tick=self.decoder.cache.allocator.clock,
+                        **fields)
+
+    def _journal_iter(self) -> None:
+        """One per-iteration state row per scheduler iteration (every
+        `step()` exit path, like _ts_sample): pool blocks free, queue /
+        active depth, cumulative counted syncs and tokens. Replay
+        compares these rows tick-for-tick — per-iteration pool-byte
+        conservation and host-sync parity fall out of record equality."""
+        j = self.journal
+        if j is None:
+            return
+        cache = self.decoder.cache
+        j.record("iter", tick=cache.allocator.clock,
+                 q=len(self._queue), act=len(self._by_slot),
+                 free=cache.blocks_free, syncs=self._c_syncs.value,
+                 toks=self._c_tokens.value)
 
     def _live_kv_positions(self) -> Dict[int, int]:
         """Per-slot KV positions actually WRITTEN, matching the device's
@@ -1693,6 +1812,13 @@ class ServingEngine:
         return {"lifecycle": self.lifecycle,
                 "shortfall": shortfall,
                 "eligible": eligible,
+                # consult identity (ISSUE 20): which request on which
+                # replica is asking — ReplayPolicy matches the journaled
+                # admission stream by these (group engines consult
+                # concurrently under their own locks)
+                "req_id": getattr(act, "req_id",
+                                  getattr(req, "req_id", None)),
+                "replica": self.replica_id,
                 "now": t_adm0,
                 "t_submit": act.resume["t_requeue"]
                 if act.resume is not None else act.t_submit,
@@ -1725,7 +1851,14 @@ class ServingEngine:
             # per-block scale overhead through the recompute-vs-swap
             # verdict — the same formula _preempt charges the pool with
             nbytes = victim["blocks_total"] * cache.block_bytes
-            mode = self.lifecycle.choose_mode(victim, nbytes)
+            # recompute-vs-swap rides MEASURED swap bandwidth — the one
+            # lifecycle verdict wall time leaks into. Replay forces the
+            # journaled mode (the journal's "preempt" record) instead of
+            # re-deciding from this host's calibration (ISSUE 20).
+            if self._replay is not None:
+                mode = self._replay.preempt_mode(a.req_id)
+            else:
+                mode = self.lifecycle.choose_mode(victim, nbytes)
             self._preempt(slot, mode, victim)
             preempted = True
         return preempted
@@ -1799,11 +1932,19 @@ class ServingEngine:
         # a span tiling from the request's previous event; the requeued
         # "queue" phase (or the async victim's "swap_pending" limbo)
         # starts at this t1, keeping coverage gap-free
-        act.timeline.append({"phase": "preempt", "t0": t_prev, "t1": now,
-                             "mode": mode, "score": victim.get("score"),
-                             "blocks_freed": victim.get("blocks_freed"),
-                             "bytes": nbytes,
-                             "policy": self.lifecycle.policy})
+        jseq = self._jrec("preempt", req=act.req_id, slot=slot, mode=mode,
+                          bytes=nbytes,
+                          blocks_freed=victim.get("blocks_freed"))
+        ev = {"phase": "preempt", "t0": t_prev, "t1": now,
+              "mode": mode, "score": victim.get("score"),
+              "blocks_freed": victim.get("blocks_freed"),
+              "bytes": nbytes,
+              "policy": self.lifecycle.policy}
+        if jseq is not None:
+            # Perfetto cross-link (ISSUE 20 satellite): the span carries
+            # the seq of the journal record that scheduled it
+            ev["journal_seq"] = jseq
+        act.timeline.append(ev)
         telemetry.instant("preempt", req=act.req_id, slot=slot, mode=mode,
                           deferred=async_swap)
         if async_swap:
@@ -2047,9 +2188,14 @@ class ServingEngine:
         # a span tiling first-token -> hand-off: the target's "queue"
         # span starts at this t1, so the ISSUE 14 conservation
         # invariant stays closed across the migration
-        act.timeline.append({"phase": "kv_transfer", "t0": act.t_first,
-                             "t1": now, "dir": "out", "bytes": nbytes,
-                             "blocks": n_live})
+        jseq = self._jrec("xfer_out", req=act.req_id, slot=slot,
+                          bytes=nbytes, blocks=n_live)
+        ev = {"phase": "kv_transfer", "t0": act.t_first,
+              "t1": now, "dir": "out", "bytes": nbytes,
+              "blocks": n_live}
+        if jseq is not None:
+            ev["journal_seq"] = jseq
+        act.timeline.append(ev)
         self._c_xfer_out.inc()
         self._c_xfer_bytes.inc(nbytes)
         self._update_kv_resident()
@@ -2143,11 +2289,16 @@ class ServingEngine:
             self._spec_index.reset(slot, req.tokens)
             self._spec_index.extend(slot, gen)
         now = time.monotonic()
-        act.timeline.append({"phase": "kv_transfer", "t0": t_adm0,
-                             "t1": now, "dir": "in", "blocks": len(lis),
-                             "bytes": nbytes, "src": src,
-                             "queue_depth": qd,
-                             "wall_s": now - t_requeue})
+        jseq = self._jrec("xfer_in", req=act.req_id, slot=slot,
+                          bytes=nbytes, blocks=len(lis), src=src)
+        ev = {"phase": "kv_transfer", "t0": t_adm0,
+              "t1": now, "dir": "in", "blocks": len(lis),
+              "bytes": nbytes, "src": src,
+              "queue_depth": qd,
+              "wall_s": now - t_requeue}
+        if jseq is not None:
+            ev["journal_seq"] = jseq
+        act.timeline.append(ev)
         self._c_xfer_in.inc()
         telemetry.instant("kv_transfer_in", req=act.req_id, slot=slot,
                           src=src, bytes=nbytes)
@@ -2195,10 +2346,14 @@ class ServingEngine:
             return
         freed = pol.evict({"registry": reg,
                            "clock": cache.allocator.clock,
+                           # det-ok: wall TTL (ttl_s) input; the default
+                           # tick TTL never reads it, and replay verifies
+                           # the sweep via the journaled "ttl" record
                            "now": time.monotonic(),
                            "ttl": self._radix_ttl})
         if freed:
             self._c_ttl_expired.inc(freed)
+            self._jrec("ttl", freed=freed)
 
     def _restore_from_store(self, act: _Active, plan, shared: int) -> int:
         """Extend the resident registry's shared coverage with blocks
@@ -2330,14 +2485,21 @@ class ServingEngine:
 
     def _expire_timeouts(self) -> None:
         """Retire timed-out requests before spending device time on them.
-        Lock held."""
+        The second wall-clock predicate in the scheduler (with the queue
+        shed) — a replay director supplies the journaled outcome instead
+        (ISSUE 20). Lock held."""
         now = time.monotonic()
+        clock = self.decoder.cache.allocator.clock
         for slot, act in list(self._by_slot.items()):
-            if act.deadline is not None and now > act.deadline:
+            expired = act.deadline is not None and now > act.deadline
+            if self._replay is not None:
+                expired = self._replay.should_expire(act.req_id, clock)
+            if expired:
                 self._active_mask[slot] = False
                 if self._dev_active is not None:
                     self._dev_active = self._dev_active.at[slot].set(False)
                 self._c_timeouts.inc()
+                self._jrec("expire", req=act.req_id, slot=slot)
                 self._retire(slot, "timeout")
 
     def _chunk_size(self) -> int:
@@ -2431,6 +2593,7 @@ class ServingEngine:
                 # for any victim parked by the admission's preemptions
                 self._harvest_swaps()
                 self._ts_sample()
+                self._journal_iter()
                 return bool(self._queue)
             self._expire_timeouts()
             self._prefill_step()
@@ -2439,6 +2602,7 @@ class ServingEngine:
                 # (or the final chunk's 1-token request just retired)
                 self._harvest_swaps()
                 self._ts_sample()
+                self._journal_iter()
                 return bool(self._by_slot or self._queue)
             # decode-active slots only: a partially-prefilled slot must not
             # be judged by a chunk dispatched while it was still inactive
@@ -2451,6 +2615,7 @@ class ServingEngine:
                 more = self._spec_step(snapshot, active, t_iter0)
                 self._harvest_swaps()
                 self._ts_sample()
+                self._journal_iter()
                 return more or bool(self._queue)
             k_eff = self._chunk_size()
             t_chunk = time.perf_counter()
@@ -2516,6 +2681,7 @@ class ServingEngine:
             # waiting on in-flight work (async swap-out, ISSUE 18)
             self._harvest_swaps()
             self._ts_sample()
+            self._journal_iter()
             return bool(self._by_slot or self._queue)
 
     def _spec_step(self, snapshot: Dict[int, _Active], active,
@@ -2591,6 +2757,16 @@ class ServingEngine:
         # chain keys consumed = deepest commit across slots (chunk
         # semantics: shared per-offset keys, effective-depth advance)
         self.sampler.advance(int(c_np.max()))
+        if self.journal is not None:
+            # draft proposals + accept counts per slot: recomputed live
+            # on replay (the n-gram index is deterministic given the
+            # committed history), journaled so divergence checking
+            # covers the speculative path too. String slot keys keep
+            # in-memory records identical to their JSONL round-trip.
+            self._jrec("spec",
+                       drafts={str(s): int(dl_np[s]) for s in snapshot},
+                       accepted={str(s): int(acc_np[s]) for s in snapshot},
+                       committed={str(s): int(c_np[s]) for s in snapshot})
         chunk_ms = (time.perf_counter() - t_chunk) * 1e3
         self._h_chunk_ms.observe(chunk_ms)
         if _profiler.enabled():
@@ -2744,6 +2920,7 @@ class ServingEngine:
                     # — the harvest is a copy, not a stall — and requeued
                     # victims are visible to the exit check below
                     self._harvest_swaps()
+                    self._journal_iter()
                     pending = dispatched
                     if pending is None and not (self._by_slot or self._queue):
                         return
@@ -2851,5 +3028,9 @@ class ServingEngine:
             # spill the prefix store so prompts survive the restart
             # (ISSUE 13) — shutdown is a phase boundary, syncs are fine
             self.prefix_store.save()
+        if self.journal is not None:
+            # seal the buffered tail segment so a post-shutdown load sees
+            # every record (tmp+rename, same crash discipline as DiskBlockPool)
+            self.journal.flush()
 
     _drain_on_stop = True

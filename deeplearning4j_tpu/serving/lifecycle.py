@@ -607,7 +607,7 @@ class KVLifecycleManager:
         if self.disk_pool is None \
                 or self.host_pool.bytes_used <= self.host_pool.capacity_bytes:
             return out
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()   # det-ok: blame-ledger disk-IO wall
         while self.host_pool.bytes_used > self.host_pool.capacity_bytes \
                 and self.host_pool.n_entries:
             key, k, v, n, sc = self.host_pool.pop_lru()
@@ -623,7 +623,7 @@ class KVLifecycleManager:
                                v_scale=None if sc is None else sc[1])
             out["demotions"] += 1
             out["bytes"] += n
-        out["wall_s"] = time.perf_counter() - t0
+        out["wall_s"] = time.perf_counter() - t0   # det-ok: measurement
         self.disk_demotions += out["demotions"]
         self.demoted_bytes += out["bytes"]
         self.disk_wall_s += out["wall_s"]
@@ -633,9 +633,9 @@ class KVLifecycleManager:
         """Deferred swap-out harvest (ISSUE 18): materialize a swapped
         entry's bytes host-side at a chunk boundary — the device->host
         copy the synchronous path paid inside the preemption stall."""
-        t0 = time.perf_counter()
+        t0 = time.perf_counter()   # det-ok: harvest wall measurement
         self.host_pool.materialize(key)
-        self.harvest_wall_s += time.perf_counter() - t0
+        self.harvest_wall_s += time.perf_counter() - t0   # det-ok: same
         self.harvests += 1
 
     def has_swap(self, key) -> bool:
@@ -662,7 +662,9 @@ class KVLifecycleManager:
         A disk hit is the promotion path (disk -> host here, host ->
         device at the caller's scatter). Raises KeyError when no tier
         holds the entry (lost spill)."""
-        t0 = time.perf_counter()
+        # the wall here feeds choose_mode's measured GB/s, whose verdict
+        # replay forces from the journal
+        t0 = time.perf_counter()   # det-ok: bandwidth calibration
         tier, disk_wall = "host", 0.0
         if key in self.host_pool:
             scales = self.host_pool.fetch_scales(key)
@@ -670,12 +672,12 @@ class KVLifecycleManager:
         elif self.disk_pool is not None and key in self.disk_pool:
             tier = "disk"
             k, v, scales = self.disk_pool.fetch(key)   # KeyError if corrupt
-            disk_wall = time.perf_counter() - t0
+            disk_wall = time.perf_counter() - t0   # det-ok: measurement
             self.disk_wall_s += disk_wall
             self.disk_promotions += 1
         else:
             raise KeyError(key)
-        wall = time.perf_counter() - t0
+        wall = time.perf_counter() - t0   # det-ok: measurement
         self.swap_wall_s += wall
         self.swap_in_bytes += int(nbytes)
         return k, v, scales, {"tier": tier, "wall_s": wall,

@@ -174,7 +174,9 @@ def build_schedule(spec: LoadSpec) -> List[ScheduledRequest]:
     """The full arrival schedule as a pure function of (spec, seed): all
     randomness flows through one seeded RandomState in a fixed draw order,
     so the same spec + seed reproduces the same schedule exactly."""
-    rng = np.random.RandomState(resolve_seed(spec.seed))
+    # the ONE seeded generator — every draw flows through it in a fixed
+    # order, so the schedule is a pure function of (spec, seed)
+    rng = np.random.RandomState(resolve_seed(spec.seed))   # det-ok: seeded
     arrivals = _arrivals(rng, spec)
     cohorts: List[Tuple[int, ...]] = []
     if spec.shared_frac > 0 and spec.shared_prefix_len > 0:
@@ -217,14 +219,17 @@ def run(engine, schedule: Sequence[ScheduledRequest]) -> LoadResult:
     n = len(schedule)
     outs: List[Optional[RequestOutcome]] = [None] * n
     futs: List[Optional[object]] = [None] * n
-    t0 = time.monotonic()
+    # the open-loop submit loop IS a wall-clock pacer by design —
+    # arrivals land on real time; replay reproduces them on the tick
+    # clock from the engine's journaled arrival records instead
+    t0 = time.monotonic()   # det-ok: open-loop pacer origin
     i = 0
     busy = True
     while i < n or busy:
-        now = time.monotonic() - t0
+        now = time.monotonic() - t0   # det-ok: submit pacing
         while i < n and schedule[i].t_arrival <= now:
             sr = schedule[i]
-            t_sub = time.monotonic() - t0
+            t_sub = time.monotonic() - t0   # det-ok: submit stamp
             futs[i] = engine.submit(Request(
                 list(sr.tokens), max_new_tokens=sr.max_new_tokens,
                 temperature=sr.temperature, timeout_s=sr.timeout_s))
@@ -232,13 +237,14 @@ def run(engine, schedule: Sequence[ScheduledRequest]) -> LoadResult:
                 req_id=-1, t_offered=sr.t_arrival, t_submit=t_sub,
                 lateness_s=t_sub - sr.t_arrival, cohort=sr.cohort)
             i += 1
-            now = time.monotonic() - t0
+            now = time.monotonic() - t0   # det-ok: submit pacing
         busy = engine.step()
         if not busy and i < n:
+            # det-ok: idle-nap pacing
             wait = schedule[i].t_arrival - (time.monotonic() - t0)
             if wait > 0:                 # idle engine: nap until the next
                 time.sleep(min(wait, 0.002))   # arrival, in small slices
-    wall_s = time.monotonic() - t0
+    wall_s = time.monotonic() - t0   # det-ok: run-wall measurement
     n_done = 0
     lateness: List[float] = []
     for k, fut in enumerate(futs):
@@ -344,6 +350,7 @@ def build_sessions(spec: SessionSpec) -> List[SessionPlan]:
     the radix tree on and off."""
     if spec.n_sessions < 1 or spec.rate <= 0:
         raise ValueError("n_sessions >= 1 and rate > 0 required")
+    # det-ok: single seeded generator, fixed draw order (see docstring)
     rng = np.random.RandomState(resolve_seed(spec.seed))
     starts = np.cumsum(rng.exponential(1.0 / spec.rate,
                                        size=spec.n_sessions))
@@ -424,9 +431,9 @@ def run_sessions(engine, plans: Sequence[SessionPlan]
     branches: List[_Branch] = []
     pending = sorted(plans, key=lambda p: p.t_start)
     pi = 0
-    t0 = time.monotonic()
+    t0 = time.monotonic()   # det-ok: session pacer (see run() note)
     while pi < len(pending) or any(not b.done for b in branches):
-        now = time.monotonic() - t0
+        now = time.monotonic() - t0   # det-ok: submit pacing
         while pi < len(pending) and pending[pi].t_start <= now:
             p = pending[pi]
             branches.append(_Branch(p.session_id, p, p.turns, [], 0,
@@ -443,7 +450,7 @@ def run_sessions(engine, plans: Sequence[SessionPlan]
                 continue
             turn = b.turns[b.next_turn]
             b.history.extend(turn.user_tokens)
-            b.t_submit = time.monotonic() - t0
+            b.t_submit = time.monotonic() - t0   # det-ok: submit stamp
             b.fut = engine.submit(Request(
                 list(b.history), max_new_tokens=turn.max_new_tokens,
                 temperature=b.plan.temperature,
@@ -451,7 +458,7 @@ def run_sessions(engine, plans: Sequence[SessionPlan]
                 turn_idx=b.turn_base + b.next_turn))
             progressed = True
         busy = engine.step()
-        now = time.monotonic() - t0
+        now = time.monotonic() - t0   # det-ok: think-time pacing
         for b in branches:
             if b.fut is None:
                 continue
@@ -495,7 +502,7 @@ def run_sessions(engine, plans: Sequence[SessionPlan]
                 b.done = True
         if not busy and not progressed:
             time.sleep(0.0005)           # everyone thinking / waiting
-    wall_s = time.monotonic() - t0
+    wall_s = time.monotonic() - t0   # det-ok: run-wall measurement
     return SessionLoadResult(
         outcomes=outcomes,
         n_sessions=len(branches),

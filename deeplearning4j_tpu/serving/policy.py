@@ -244,6 +244,26 @@ class ColocatedPolicy(SchedulingPolicy):
         lifecycle = pool_view.get("lifecycle")
         hint = {"reclaimable_bytes": pool_view.get("reclaimable_bytes", 0),
                 "retry_after_s": 0.0}
+        # SLO-slack backoff hint FIRST (ISSUE 20 satellite): computed
+        # before the lifecycle gate so a no-lifecycle engine's denies —
+        # and, with the timeseries monitor off (DL4J_TPU_TS=0,
+        # burn_rate_short absent), a burn-less engine's denies — still
+        # carry the static PR 17 slack hint instead of 0.0.
+        slack = 0.0
+        if self.slo is not None and pool_view.get("now") is not None:
+            waited = pool_view["now"] - pool_view["t_submit"]
+            slack = self.slo.slack_s(waited)
+            if slack > 0:
+                # the admittee can still make its TTFT budget by waiting
+                # for a natural retirement — deny is the cheap branch;
+                # escalate to preemption once the slack is gone. The
+                # backoff hint reads the LIVE short-window burn rate
+                # (ISSUE 19) when a monitor runs: an overloaded engine
+                # stretches retry_after_s beyond the static SLO slack so
+                # client retries don't pile onto the overload; with no
+                # monitor it degrades to the slack itself.
+                hint["retry_after_s"] = retry_after_from_burn(
+                    slack, pool_view.get("burn_rate_short"))
         if lifecycle is None:
             return AdmissionDecision.deny(hint)
         # hierarchical-storage headroom (ISSUE 18): bytes the swap
@@ -256,20 +276,8 @@ class ColocatedPolicy(SchedulingPolicy):
             headroom += max(0, lifecycle.disk_pool.capacity_bytes
                             - lifecycle.disk_pool.bytes_used)
         hint["swap_headroom_bytes"] = headroom
-        if self.slo is not None:
-            waited = pool_view["now"] - pool_view["t_submit"]
-            slack = self.slo.slack_s(waited)
-            if slack > 0:
-                # the admittee can still make its TTFT budget by waiting
-                # for a natural retirement — deny is the cheap branch;
-                # escalate to preemption once the slack is gone. The
-                # backoff hint reads the LIVE short-window burn rate
-                # (ISSUE 19) when a monitor runs: an overloaded engine
-                # stretches retry_after_s beyond the static SLO slack so
-                # client retries don't pile onto the overload.
-                hint["retry_after_s"] = retry_after_from_burn(
-                    slack, pool_view.get("burn_rate_short"))
-                return AdmissionDecision.deny(hint)
+        if self.slo is not None and slack > 0:
+            return AdmissionDecision.deny(hint)
         shortfall = pool_view["shortfall"]
         eligible = pool_view["eligible"]
         if shortfall <= 0 or not eligible:

@@ -1,0 +1,415 @@
+"""Deterministic replay of a journaled serving run (ISSUE 20).
+
+The decision journal (telemetry/journal.py) records every
+nondeterministic input the scheduler consumed: arrivals with their
+submit tick, routing choices, admission verdicts with eviction plans,
+preempt modes (the one lifecycle decision fed by measured bandwidth),
+queue sheds and slot timeouts (the two wall-deadline predicates), and
+transfer destinations. Everything else the engine does — block
+reservation plans, chunked-prefill splitting, spec-decode drafts and
+accepts, radix TTL sweeps by tick — is a deterministic function of
+engine state once those inputs are pinned, so it is journaled for
+VERIFICATION (divergence checking) but recomputed live on replay.
+
+``Replayer`` reconstructs a run on a FRESH engine or group built with
+the same configuration: arrivals are re-submitted when the allocator
+tick clock reaches their recorded submit tick (wall clock is out of the
+loop entirely), recorded verdicts are forced through two seams —
+
+- ``ReplayPolicy`` wraps the run's SchedulingPolicy and answers
+  route/admit/transfer from the journal instead of consulting the inner
+  policy's heuristics;
+- an ``EngineDirector`` installed as ``engine._replay`` replaces the
+  wall-deadline shed/expire predicates and the measured-bandwidth
+  preempt-mode choice with the journaled outcomes.
+
+The replayed engine journals its own decision stream; comparing it
+against the recording (``localize_divergence``) verifies per-iteration
+pool-byte conservation and host-sync counts, and — when a live run
+really does diverge (an injected policy change, a code regression) —
+binary-searches the first iteration whose cumulative decision digest
+differs, then reports the first mismatching record pair.
+
+Replay requires synchronous stepping (``overlap=False`` — overlapped
+dispatch consumes sampler keys unconditionally) and, for groups, serial
+stepping (``serial_step=True``) so cross-replica transfer adoption
+order is a function of replica index, not thread scheduling.
+
+Sync discipline: pure host bookkeeping — no jax import, no device
+access, no wall-clock reads (tests/test_sync_discipline.py scans this
+module).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+from deeplearning4j_tpu.telemetry.journal import (DecisionJournal,
+                                                  canonical)
+from deeplearning4j_tpu.serving.policy import (AdmissionDecision,
+                                               SchedulingPolicy)
+
+__all__ = ["ReplayMismatch", "EngineDirector", "ReplayPolicy",
+           "Replayer", "ReplayReport", "localize_divergence",
+           "replay_incident"]
+
+GROUP_REPLICA = -1      # journal.replica of the group-level journal
+
+
+class ReplayMismatch(AssertionError):
+    """The live engine asked for a decision the journal cannot supply —
+    the run diverged from the recording before this consult."""
+
+
+class EngineDirector:
+    """Journaled outcomes for ONE engine's in-engine decision points.
+
+    Installed as ``engine._replay``; the engine consults it instead of
+    the two wall-deadline predicates (queue shed, slot timeout) and the
+    measured-bandwidth preempt-mode choice. Consults are matched in
+    journal order — iteration-level scheduling guarantees the replayed
+    engine asks in exactly the recorded sequence as long as its state
+    has not diverged."""
+
+    def __init__(self, records: Sequence[dict]):
+        self.admissions = deque(r for r in records
+                                if r["kind"] == "admission")
+        self.sheds = deque(r for r in records if r["kind"] == "shed")
+        self.expires = deque(r for r in records if r["kind"] == "expire")
+        self.preempts = deque(r for r in records
+                              if r["kind"] == "preempt")
+
+    def should_shed(self, req_id: int, tick: int) -> bool:
+        q = self.sheds
+        if q and q[0]["tick"] == tick and q[0]["req"] == req_id:
+            q.popleft()
+            return True
+        return False
+
+    def should_expire(self, req_id: int, tick: int) -> bool:
+        q = self.expires
+        if q and q[0]["tick"] == tick and q[0]["req"] == req_id:
+            q.popleft()
+            return True
+        return False
+
+    def preempt_mode(self, req_id: int) -> str:
+        if not self.preempts:
+            raise ReplayMismatch(
+                f"preemption of req {req_id} not in journal")
+        rec = self.preempts.popleft()
+        if rec["req"] != req_id:
+            raise ReplayMismatch(
+                f"preempt order diverged: journal has req {rec['req']} "
+                f"(seq {rec['seq']}), live engine preempts req {req_id}")
+        return rec["mode"]
+
+    def next_admission(self, req_id: int) -> dict:
+        if not self.admissions:
+            raise ReplayMismatch(
+                f"admission consult for req {req_id} past end of journal")
+        rec = self.admissions.popleft()
+        if rec["req"] != req_id:
+            raise ReplayMismatch(
+                f"admission order diverged: journal has req {rec['req']} "
+                f"(seq {rec['seq']}), live engine consults for "
+                f"req {req_id}")
+        return rec
+
+
+class ReplayPolicy(SchedulingPolicy):
+    """SchedulingPolicy that answers route/admit/transfer from the
+    journal. bind/role/evict delegate to the recorded run's policy (the
+    TTL sweep is tick-deterministic; roles shape engine construction),
+    and SLO/TTL attributes mirror the inner policy because the engine
+    reads them for budget accounting."""
+
+    def __init__(self, inner: SchedulingPolicy, *,
+                 routes: Sequence[dict] = (),
+                 transfers: Sequence[dict] = (),
+                 directors: Optional[Dict[Optional[int],
+                                          EngineDirector]] = None):
+        self.inner = inner
+        self.slo = getattr(inner, "slo", None)
+        self.ttl = getattr(inner, "ttl", None)
+        self.ttl_s = getattr(inner, "ttl_s", None)
+        self.n_replicas = getattr(inner, "n_replicas", 1)
+        self._routes = deque(routes)
+        self._transfers = deque(transfers)
+        self._directors = dict(directors or {})
+
+    def bind(self, n_replicas: int) -> "ReplayPolicy":
+        self.inner.bind(n_replicas)
+        self.n_replicas = int(n_replicas)
+        return self
+
+    def role(self, replica: int) -> str:
+        return self.inner.role(replica)
+
+    def evict(self, pressure_view: dict) -> int:
+        return self.inner.evict(pressure_view)
+
+    def _director(self, replica) -> EngineDirector:
+        d = self._directors.get(replica)
+        if d is None and len(self._directors) == 1:
+            d = next(iter(self._directors.values()))
+        if d is None:
+            raise ReplayMismatch(
+                f"no director for replica {replica!r} "
+                f"(have {sorted(map(str, self._directors))})")
+        return d
+
+    # ---------------------------------------------------- decision points
+    def route(self, request, fleet_view: dict):
+        if not self._routes:
+            raise ReplayMismatch("route consult past end of journal")
+        rec = self._routes.popleft()
+        return rec["dst"], rec["reason"]
+
+    def admit(self, request, pool_view: dict) -> AdmissionDecision:
+        rec = self._director(pool_view.get("replica")).next_admission(
+            pool_view["req_id"])
+        if rec["verdict"] == "preempt":
+            plan = {"evicted": [dict(v) for v in rec["victims"]],
+                    "satisfies": True}
+            return AdmissionDecision.preempt(plan)
+        hint = {"reclaimable_bytes": rec.get("reclaimable_bytes", 0),
+                "retry_after_s": rec.get("retry_after_s", 0.0)}
+        return AdmissionDecision.deny(hint)
+
+    def transfer(self, finished_prefill_view: dict) -> Optional[int]:
+        if not self._transfers:
+            raise ReplayMismatch("transfer consult past end of journal")
+        return self._transfers.popleft()["dst"]
+
+
+@dataclass
+class ReplayReport:
+    """Outcome of one replay: results in re-submission order, the live
+    journal stream, and the recorded-vs-live divergence (None = the
+    replay reproduced every decision)."""
+    results: List[object] = field(default_factory=list)
+    records: List[dict] = field(default_factory=list)
+    stats: dict = field(default_factory=dict)
+    divergence: Optional[dict] = None
+
+    @property
+    def token_streams(self) -> List[List[int]]:
+        return [r.tokens for r in self.results]
+
+
+def _request_from(rec: dict):
+    from deeplearning4j_tpu.serving.engine import Request
+    return Request(tokens=list(rec["tokens"]),
+                   max_new_tokens=rec["max_new"],
+                   temperature=rec.get("temp", 0.0),
+                   eos_id=rec.get("eos"),
+                   timeout_s=rec.get("timeout_s"),
+                   session_id=rec.get("session"),
+                   turn_idx=rec.get("turn"))
+
+
+def _ensure_journal(engine) -> None:
+    if engine.journal is None:
+        engine.journal = DecisionJournal(replica=engine.replica_id)
+
+
+class Replayer:
+    """Re-run a journaled decision stream on a fresh engine or group.
+
+    The caller provides the target built with the SAME model, seed and
+    engine knobs as the recording (the journal pins decisions, not
+    configuration). ``replay()`` drives a single engine; for a
+    ShardedServingGroup use ``replay_group()`` with the merged fleet
+    records (``group.fleet_journal()``)."""
+
+    def __init__(self, records: Sequence[dict]):
+        self.records = [dict(r) for r in records]
+
+    # ------------------------------------------------------ single engine
+    def replay(self, engine) -> ReplayReport:
+        recs = self.records
+        director = EngineDirector(recs)
+        engine._replay = director
+        engine.policy = ReplayPolicy(
+            engine.policy, directors={engine.replica_id: director})
+        _ensure_journal(engine)
+        arrivals = deque(sorted(
+            (r for r in recs if r["kind"] == "arrival"),
+            key=lambda r: r["seq"]))
+        futs = []
+        busy = True
+        while arrivals or busy:
+            clock = engine.decoder.cache.allocator.clock
+            while arrivals and arrivals[0]["tick"] <= clock:
+                futs.append(engine.submit(
+                    _request_from(arrivals.popleft())))
+            busy = engine.step()
+        results = [f.get(timeout=60.0) for f in futs]
+        live = engine.journal.records()
+        return ReplayReport(results=results, records=live,
+                            stats=engine.stats(),
+                            divergence=localize_divergence(recs, live))
+
+    # --------------------------------------------------------------- group
+    def replay_group(self, group) -> ReplayReport:
+        recs = self.records
+        group_recs = [r for r in recs
+                      if r.get("replica", GROUP_REPLICA) == GROUP_REPLICA]
+        routes = [r for r in group_recs if r["kind"] == "route"]
+        transfers = [r for r in group_recs if r["kind"] == "transfer"]
+        by_rep: Dict[int, List[dict]] = {}
+        for r in recs:
+            rep = r.get("replica", GROUP_REPLICA)
+            if rep != GROUP_REPLICA:
+                by_rep.setdefault(rep, []).append(r)
+        directors = {rep: EngineDirector(rs)
+                     for rep, rs in by_rep.items()}
+        rp = ReplayPolicy(group.policy, routes=routes,
+                          transfers=transfers, directors=directors)
+        group.policy = rp
+        if group.journal is None:
+            # the replayed group journals its own route/transfer stream
+            # (through ReplayPolicy's forced verdicts) so the live fleet
+            # merge is comparable record-for-record with the recording
+            group.journal = DecisionJournal(replica=GROUP_REPLICA)
+        for eng in group.engines:
+            eng.policy = rp
+            eng._replay = directors.setdefault(
+                eng.replica_id, EngineDirector(()))
+            _ensure_journal(eng)
+        # pair each route record with the routed replica's next arrival:
+        # group.submit holds the group lock across route+submit, so the
+        # per-replica arrival order in seq order IS the route order
+        arr_by_rep = {rep: deque(sorted(
+            (r for r in rs if r["kind"] == "arrival"),
+            key=lambda r: r["seq"])) for rep, rs in by_rep.items()}
+        pending = deque()
+        for rt in routes:
+            q = arr_by_rep.get(rt["dst"])
+            if not q:
+                raise ReplayMismatch(
+                    f"route to replica {rt['dst']} (seq {rt['seq']}) has "
+                    "no matching arrival record")
+            pending.append(q.popleft())
+        futs = []
+        busy = True
+        while pending or busy:
+            while pending:
+                head = pending[0]
+                eng = group.engines[head["replica"]]
+                if eng.decoder.cache.allocator.clock < head["tick"]:
+                    break
+                futs.append(group.submit(
+                    _request_from(pending.popleft())))
+            busy = group.step()
+        results = [f.get(timeout=60.0) for f in futs]
+        live = group.fleet_journal()
+        return ReplayReport(results=results, records=live,
+                            stats=group.stats(),
+                            divergence=localize_divergence(recs, live))
+
+
+# ---------------------------------------------------- divergence localizer
+def _digest_by_tick(records: Sequence[dict]) -> Dict[int, str]:
+    """Cumulative canonical-record digest at the END of each tick: a
+    prefix fingerprint the localizer can binary-search."""
+    out: Dict[int, str] = {}
+    h = hashlib.sha1()
+    last = None
+    for rec in records:
+        t = rec["tick"]
+        if last is not None and t != last:
+            out[last] = h.hexdigest()
+        h.update(json.dumps(canonical(rec), sort_keys=True,
+                            separators=(",", ":")).encode())
+        last = t
+    if last is not None:
+        out[last] = h.hexdigest()
+    return out
+
+
+def localize_divergence(recorded: Sequence[dict],
+                        live: Sequence[dict], *,
+                        snapshot_fn=None) -> Optional[dict]:
+    """First iteration where the live decision stream departs from the
+    journal, or None when the streams agree record-for-record.
+
+    Binary-searches cumulative per-tick digests for the first tick whose
+    prefix fingerprint differs (a missing or extra record surfaces at
+    the tick it occurred), then scans that prefix pairwise for the first
+    mismatching record. The report carries both records, the per-tick
+    "iter" pool rows on each side (pool-byte conservation + host-sync
+    forensics), and — when the caller passes ``snapshot_fn`` (e.g. the
+    live engine's ``kv_pool_snapshot``) — the KV-observatory snapshot
+    at the divergent tick."""
+    rec_d = _digest_by_tick(recorded)
+    live_d = _digest_by_tick(live)
+    ticks = sorted(set(rec_d) | set(live_d))
+    if not ticks:
+        return None
+
+    def _at(dig: Dict[int, str], order: List[int], t: int) -> str:
+        # cumulative digest carried forward over ticks with no records
+        best = ""
+        for tt in order:
+            if tt > t:
+                break
+            if tt in dig:
+                best = dig[tt]
+        return best
+
+    lo, hi = 0, len(ticks) - 1
+    if _at(rec_d, ticks, ticks[hi]) == _at(live_d, ticks, ticks[hi]):
+        if len(recorded) == len(live):
+            return None
+        bad_tick = ticks[hi]            # same digests, trailing extras
+    else:
+        while lo < hi:                  # first tick whose prefix differs
+            mid = (lo + hi) // 2
+            if _at(rec_d, ticks, ticks[mid]) == \
+                    _at(live_d, ticks, ticks[mid]):
+                lo = mid + 1
+            else:
+                hi = mid
+        bad_tick = ticks[lo]
+    rec_pre = [r for r in recorded if r["tick"] <= bad_tick]
+    live_pre = [r for r in live if r["tick"] <= bad_tick]
+    idx, rec_bad, live_bad = None, None, None
+    for i in range(max(len(rec_pre), len(live_pre))):
+        a = rec_pre[i] if i < len(rec_pre) else None
+        b = live_pre[i] if i < len(live_pre) else None
+        if (a is None or b is None
+                or canonical(a) != canonical(b)):
+            idx, rec_bad, live_bad = i, a, b
+            break
+    if idx is None:
+        return None
+
+    def _iter_rows(stream):
+        return [r for r in stream
+                if r["kind"] == "iter" and r["tick"] == bad_tick]
+
+    return {
+        "tick": bad_tick,
+        "index": idx,
+        "recorded": rec_bad,
+        "live": live_bad,
+        "recorded_iter": _iter_rows(recorded),
+        "live_iter": _iter_rows(live),
+        "snapshot": snapshot_fn() if snapshot_fn is not None else None,
+    }
+
+
+# ------------------------------------------------------- incident replay
+def replay_incident(bundle_dir: str, engine) -> ReplayReport:
+    """Replay an incident bundle's frozen journal tail
+    (``journal_tail.jsonl``) on a fresh engine built with the recorded
+    run's configuration — the runnable-regression form of an alert."""
+    import os
+    records = DecisionJournal.load(
+        os.path.join(bundle_dir, "journal_tail.jsonl"))
+    return Replayer(records).replay(engine)

@@ -48,6 +48,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.telemetry import journal as _journal
 from deeplearning4j_tpu.ops.decode_attention import (paged_decode_specs,
                                                      paged_spec_decode_specs)
 from deeplearning4j_tpu.parallel.mesh import (compat_shard_map, make_mesh,
@@ -554,6 +555,29 @@ class ShardedServingGroup:
         # points (admission, TTL eviction)
         self.policy = resolve_policy(engine_kw.pop("policy", None)) \
             .bind(self.replicas)
+        # ONE group-level decision journal (ISSUE 20, replica=-1): it owns
+        # the cross-replica records (route/transfer) while each engine
+        # journals its own admission/preempt/spec stream into a child
+        # journal (a replica<r> subdirectory when persisting).
+        # fleet_journal() merges them ordered by (tick, replica, seq).
+        self.journal = _journal.resolve_journal(
+            engine_kw.pop("journal", None), replica=-1)
+        # group-journal records arrive from submit (group lock held) AND
+        # from prefill engines' scheduler threads (_transfer_from, engine
+        # lock held — taking the group lock there would deadlock against
+        # submit's group-lock -> engine-lock order), so they serialize on
+        # a dedicated leaf lock instead
+        self._jlock = threading.Lock()
+        # serial_step (ISSUE 20, env DL4J_TPU_GROUP_SERIAL): force
+        # index-ordered serial stepping so cross-replica interactions
+        # (prefill->decode KV adoption) land at a deterministic point in
+        # every replica's tick stream — both journal recording and replay
+        # of a group run require it
+        serial = engine_kw.pop("serial_step", None)
+        if serial is None:
+            serial = os.environ.get(
+                "DL4J_TPU_GROUP_SERIAL", "") not in ("", "0", "off")
+        self.serial_step = bool(serial)
         self.engines: List[ShardedServingEngine] = []
         base_name = engine_kw.pop("name", None) or "replica"
         for r, submesh in enumerate(replica_submeshes(self.mesh,
@@ -566,6 +590,8 @@ class ShardedServingGroup:
                 prefix_store=self.prefix_store,
                 policy=self.policy,
                 name=f"{base_name}{r}",
+                journal=(_journal.child_journal(self.journal, r)
+                         if self.journal is not None else False),
                 **engine_kw)
             # replica identity (ISSUE 14 satellite): labels the engine's
             # tracer track and flight-recorder records so multi-replica
@@ -586,7 +612,8 @@ class ShardedServingGroup:
         # time — step() joins before returning). On a single-core host the
         # threads would only time-slice one processor and the contention
         # is pure loss, so the fan-out is capped at the core count.
-        workers = min(self.replicas, os.cpu_count() or 1)
+        workers = 1 if self.serial_step \
+            else min(self.replicas, os.cpu_count() or 1)
         self._pool = (ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix="dl4j-replica")
             if workers > 1 else None)
@@ -609,6 +636,15 @@ class ShardedServingGroup:
             self._c_affinity.inc()
         elif reason == "heat":
             self._c_heat.inc()
+        if self.journal is not None:
+            # tick = the ROUTED replica's allocator clock: the replayer
+            # paces this arrival against that same clock (host attribute
+            # read — no device touch)
+            with self._jlock:
+                self.journal.record(
+                    "route",
+                    tick=self.engines[replica].decoder.cache.allocator.clock,
+                    dst=replica, reason=reason, plen=len(req.tokens))
         return replica
 
     def _transfer_from(self, src: int, act) -> None:
@@ -625,9 +661,18 @@ class ShardedServingGroup:
         view["src"] = src
         target = self.policy.transfer(view)
         self._c_transfers.inc()
+        dst = src if target is None else target
+        if self.journal is not None:
+            # journaled BEFORE the adopt so the transfer verdict precedes
+            # the destination's xfer_in record in seq order
+            with self._jlock:
+                self.journal.record(
+                    "transfer",
+                    tick=self.engines[src].decoder.cache.allocator.clock,
+                    src=src, dst=dst, req=act.req_id)
         # target is always a decode row when the callback is wired; the
         # src fallback is a safety net (src engine's RLock re-enters)
-        self.engines[src if target is None else target]._adopt(act)
+        self.engines[dst]._adopt(act)
 
     # --------------------------------------------------- engine-shaped API
     def submit(self, request):
@@ -674,6 +719,17 @@ class ShardedServingGroup:
             engine.shutdown(wait=wait)
         if self._pool is not None:
             self._pool.shutdown(wait=wait)
+        if self.journal is not None:
+            self.journal.flush()
+
+    def fleet_journal(self) -> List[dict]:
+        """The merged fleet decision stream (ISSUE 20): group-level
+        route/transfer records (replica=-1) interleaved with every
+        replica's own journal, ordered by (tick, replica, seq) — the
+        input serving/replay.py's group replayer consumes."""
+        journals = [j for j in [self.journal]
+                    + [e.journal for e in self.engines] if j is not None]
+        return _journal.merge_fleet(journals)
 
     def stats(self) -> Dict[str, object]:
         """Fleet view: lifetime counters summed across replicas
@@ -690,6 +746,8 @@ class ShardedServingGroup:
             "router_transfers": self._c_transfers.value,
             "policy": type(self.policy).__name__,
             "roles": [self.policy.role(r) for r in range(self.replicas)],
+            "journal": (self.journal.stats()
+                        if self.journal is not None else None),
             "per_replica": per,
         }
         for key in GROUP_SUMMED_KEYS:

@@ -25,6 +25,13 @@ Env knobs (read by the engine):
 - `DL4J_TPU_SPEC_DECODE=1` enables speculative decode (default off);
 - `DL4J_TPU_SPEC_DRAFT`    max draft tokens per step (default 4);
 - `DL4J_TPU_SPEC_NGRAM`    longest suffix gram to match (default 3).
+
+Determinism contract (ISSUE 20): proposals are a pure function of the
+committed token history — no wall clock, no RNG (the
+test_sync_discipline determinism scan pins this) — so a replayed run
+re-derives identical drafts from identical histories; the engine still
+journals per-iteration draft/accept/commit counts ("spec" records) so
+the divergence localizer can pinpoint a drafting change directly.
 """
 from __future__ import annotations
 

@@ -58,6 +58,14 @@ __all__ = [
 ALERT_KINDS = ("overload", "goodput_regression", "kv_pressure_spiral",
                "starvation")
 
+#: the alert kinds whose firing conditions are pure functions of the
+#: per-iteration decision stream (ISSUE 20): a replayed incident bundle
+#: re-fires exactly these. ``starvation`` is excluded — it reads the
+#: live wall clock (oldest_wait_s), so a faster/slower replay host can
+#: legitimately flip its verdict.
+REPLAY_DETERMINISTIC_KINDS = frozenset(
+    ("overload", "goodput_regression", "kv_pressure_spiral"))
+
 #: hint multiplier cap: a melted fleet should back clients off, not
 #: quote them an hour (retry_after_from_burn)
 _MAX_BURN_BACKOFF = 10.0

@@ -167,6 +167,22 @@ class FlightRecorder:
         """The n worst-TTFT retained records."""
         return self.records()[:n]
 
+    def journal_seqs(self) -> List[int]:
+        """Every decision-journal seq cross-linked from the retained
+        timelines and alert notes (ISSUE 20), ascending. The engine
+        stamps ``journal_seq`` into the timeline events a journaled
+        decision produced (admission, preempt, prefill chunk, queue-shed
+        retire, KV transfer) and into every alert note, and perfetto()
+        forwards timeline keys into span args — so a retained violator's
+        Perfetto trace joins each span back to the exact journal record
+        that scheduled it, and this accessor gives the join set."""
+        seqs = {e["journal_seq"] for rec in self.records()
+                for e in rec["timeline"]
+                if e.get("journal_seq") is not None}
+        seqs |= {a["journal_seq"] for a in self._alerts
+                 if a.get("journal_seq") is not None}
+        return sorted(seqs)
+
     # ------------------------------------------------------------- perfetto
     def perfetto(self) -> Dict[str, object]:
         """Chrome-trace/Perfetto JSON object: one pid per recording

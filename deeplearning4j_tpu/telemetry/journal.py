@@ -1,0 +1,385 @@
+"""Scheduler decision journal (ISSUE 20): record every nondeterministic
+input and policy verdict, keyed to the BlockAllocator tick clock.
+
+Orca-style iteration-level scheduling makes a serving run a pure function
+of its per-iteration decision stream: given the same arrivals (token ids +
+knobs, by submit tick) and the same verdicts (routing, admission/
+preemption plans, queue sheds, preempt modes, transfer destinations),
+greedy decoding reproduces the exact token streams and the exact counted
+host syncs. The ``DecisionJournal`` captures that stream as compact typed
+records so any live run — and in particular any run that fired a
+burn-rate alert (telemetry/alerts.py) — can be replayed bit-exactly on a
+fresh engine or group (serving/replay.py).
+
+Design rules (the usual observability contract):
+
+- HOST-ONLY: ``record()`` is dict bookkeeping + an optional buffered
+  serialization — it never touches a device value, so journaling on vs
+  off is host-sync and token bit-parity (the engine guards every hook
+  with ``if self.journal is not None``).
+- DETERMINISTIC RECORDS: no wall-clock timestamps inside records — the
+  only clock is the allocator tick. The single wall-derived field that
+  does appear (an admission deny's ``retry_after_s`` backpressure hint)
+  is stripped by ``canonical()`` before any record comparison.
+- BOUNDED + CRASH-SAFE: records optionally persist as append-only JSONL
+  segments written whole via the DiskBlockPool tmp+rename idiom
+  (serving/kv_disk.py) and rotated under a byte cap — a crash can lose
+  at most the unflushed tail, never corrupt a published segment. The
+  in-memory ring obeys the same cap; drops are counted, never silent.
+
+Env knobs: ``DL4J_TPU_JOURNAL`` ("1" = in-memory journal, any other
+non-off value = persistence directory), ``DL4J_TPU_JOURNAL_BYTES`` (cap,
+default 16 MiB), ``DL4J_TPU_INCIDENT_DIR`` (incident-bundle root;
+defaults to ``<journal dir>/incidents`` when persisting).
+
+This module deliberately imports neither jax nor numpy.
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+DEFAULT_JOURNAL_BYTES = 16 << 20      # 16 MiB in-memory / on-disk cap
+_SEGMENT_FRACTION = 8                 # segment target = cap / 8
+
+#: Fields stripped before comparing a live record against a recorded one:
+#: ``seq`` restates position (a single divergence shifts every later
+#: seq), ``retry_after_s`` is the one wall-derived value a record may
+#: carry (the SLO-slack backpressure hint).
+NONCANONICAL_FIELDS = ("seq", "retry_after_s")
+
+
+def canonical(rec: dict) -> dict:
+    """A record with position/wall-derived fields stripped — the equality
+    domain for replay verification and divergence localization."""
+    return {k: v for k, v in rec.items() if k not in NONCANONICAL_FIELDS}
+
+
+class DecisionJournal:
+    """Append-only journal of typed scheduler-decision records.
+
+    Every record is a plain dict carrying ``seq`` (1-based, per-journal
+    monotonic, no gaps), ``tick`` (the allocator clock when the decision
+    was taken), ``kind`` (the record type), plus kind-specific fields.
+    ``replica`` identifies the producing journal in fleet merges (-1 is
+    the group-level journal that owns route/transfer records).
+    """
+
+    def __init__(self, path: Optional[str] = None, *,
+                 byte_cap: Optional[int] = None,
+                 replica: Optional[int] = None,
+                 incident_dir: Optional[str] = None):
+        if byte_cap is None:
+            byte_cap = DEFAULT_JOURNAL_BYTES
+        if byte_cap < 4096:
+            raise ValueError("journal byte_cap must be >= 4096 bytes")
+        self.path = path
+        self.byte_cap = int(byte_cap)
+        self.replica = replica
+        self.seq = 0                  # last seq handed out
+        self.dropped = 0              # in-memory records evicted by cap
+        self.dropped_segments = 0     # on-disk segments rotated out
+        self.wall_spent_s = 0.0       # host time inside record()/flush()
+        self._mem: deque = deque()
+        self._mem_bytes = 0
+        self._buf: List[str] = []     # serialized lines pending a segment
+        self._buf_bytes = 0
+        self._seg_idx = 0
+        self._segments: List[tuple] = []   # (path, bytes)
+        if path is not None:
+            os.makedirs(path, exist_ok=True)
+            self._recover()
+
+    # ------------------------------------------------------------- record
+    def record(self, kind: str, *, tick: int, **fields) -> int:
+        """Append one typed record; returns its seq (the Perfetto
+        cross-link id stamped into timeline events as ``journal_seq``)."""
+        t0 = time.perf_counter()   # det-ok: overhead self-measurement
+        self.seq += 1
+        rec = {"seq": self.seq, "tick": int(tick), "kind": kind}
+        rec.update(fields)
+        line = json.dumps(rec, separators=(",", ":"))
+        nbytes = len(line) + 1
+        self._mem.append((rec, nbytes))
+        self._mem_bytes += nbytes
+        while self._mem_bytes > self.byte_cap and len(self._mem) > 1:
+            _, old = self._mem.popleft()
+            self._mem_bytes -= old
+            self.dropped += 1
+        if self.path is not None:
+            self._buf.append(line)
+            self._buf_bytes += nbytes
+            if self._buf_bytes >= max(4096,
+                                      self.byte_cap // _SEGMENT_FRACTION):
+                self._write_segment()
+        self.wall_spent_s += time.perf_counter() - t0   # det-ok: same
+        return rec["seq"]
+
+    def records(self) -> List[dict]:
+        """The retained records, oldest first (complete iff dropped==0)."""
+        return [r for r, _ in self._mem]
+
+    def __len__(self) -> int:
+        return len(self._mem)
+
+    @property
+    def last_tick(self) -> int:
+        return self._mem[-1][0]["tick"] if self._mem else 0
+
+    def tail(self, n_iters: int) -> List[dict]:
+        """Records from the last ``n_iters`` scheduler iterations."""
+        cut = self.last_tick - max(0, int(n_iters)) + 1
+        return [r for r, _ in self._mem if r["tick"] >= cut]
+
+    def stats(self) -> Dict[str, object]:
+        return {"records": self.seq, "retained": len(self._mem),
+                "bytes": self._mem_bytes, "dropped": self.dropped,
+                "dropped_segments": self.dropped_segments,
+                "segments": len(self._segments),
+                "last_tick": self.last_tick, "replica": self.replica,
+                "wall_spent_s": self.wall_spent_s}
+
+    # ------------------------------------------------------- persistence
+    def flush(self) -> None:
+        """Publish buffered records as a sealed segment (tmp+rename)."""
+        t0 = time.perf_counter()   # det-ok: overhead self-measurement
+        if self.path is not None and self._buf:
+            self._write_segment()
+        self.wall_spent_s += time.perf_counter() - t0   # det-ok: same
+
+    close = flush
+
+    def _write_segment(self) -> None:
+        self._seg_idx += 1
+        seg = os.path.join(self.path,
+                           "journal-%06d.jsonl" % self._seg_idx)
+        tmp = seg + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                f.write("\n".join(self._buf) + "\n")
+            os.replace(tmp, seg)       # atomic publish (kv_disk idiom)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+        self._segments.append((seg, self._buf_bytes))
+        self._buf = []
+        self._buf_bytes = 0
+        total = sum(b for _, b in self._segments)
+        while total > self.byte_cap and len(self._segments) > 1:
+            old, b = self._segments.pop(0)
+            total -= b
+            self.dropped_segments += 1
+            try:
+                os.remove(old)
+            except OSError:
+                pass
+
+    def _recover(self) -> None:
+        """Construction sweep: drop orphaned tmp files from a crash and
+        adopt any sealed segments already present (resume appending
+        after them)."""
+        for name in sorted(os.listdir(self.path)):
+            full = os.path.join(self.path, name)
+            if name.endswith(".tmp"):
+                try:
+                    os.remove(full)
+                except OSError:
+                    pass
+            elif name.startswith("journal-") and name.endswith(".jsonl"):
+                try:
+                    idx = int(name[len("journal-"):-len(".jsonl")])
+                except ValueError:
+                    continue
+                self._seg_idx = max(self._seg_idx, idx)
+                self._segments.append((full, os.path.getsize(full)))
+
+    # ---------------------------------------------------------- incidents
+    def freeze_incident(self, alerts: Sequence[dict], *,
+                        tail_iters: int,
+                        incident_dir: Optional[str] = None,
+                        flight_recorder=None) -> Optional[str]:
+        """Freeze the journal tail into an incident bundle.
+
+        Called by the engine when an alert fires: writes
+        ``incident-t<tick>[-r<replica>]/`` under the incident root with
+        ``journal_tail.jsonl`` (the last ``tail_iters`` iterations,
+        replayable via serving/replay.py), ``incident.json`` (the alert
+        dicts + req_id/tick/seq cross-links), and — when a flight
+        recorder is attached — its Perfetto dump as ``trace.json``.
+        Returns the bundle path, or None when no incident root is
+        configured.
+        """
+        root = incident_dir or resolve_incident_dir(self.path)
+        if root is None:
+            return None
+        tick = self.last_tick
+        name = "incident-t%08d" % tick
+        if self.replica is not None and self.replica >= 0:
+            name += "-r%d" % self.replica
+        bundle = os.path.join(root, name)
+        os.makedirs(bundle, exist_ok=True)
+        tail = self.tail(tail_iters)
+        tail_path = os.path.join(bundle, "journal_tail.jsonl")
+        tmp = tail_path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                for rec in tail:
+                    f.write(json.dumps(rec, separators=(",", ":")) + "\n")
+            os.replace(tmp, tail_path)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+        trace_name = None
+        if flight_recorder is not None:
+            trace_name = "trace.json"
+            flight_recorder.dump(os.path.join(bundle, trace_name))
+        meta = {
+            "tick": tick,
+            "window_iters": int(tail_iters),
+            "replica": self.replica,
+            "alerts": list(alerts),
+            "records": len(tail),
+            "seq_range": [tail[0]["seq"], tail[-1]["seq"]] if tail
+                         else None,
+            "req_ids": sorted({r["req"] for r in tail if "req" in r}),
+            "trace": trace_name,
+        }
+        meta_path = os.path.join(bundle, "incident.json")
+        tmp = meta_path + ".tmp"
+        try:
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(meta, f, indent=1, sort_keys=True)
+            os.replace(tmp, meta_path)
+        finally:
+            if os.path.exists(tmp):
+                try:
+                    os.remove(tmp)
+                except OSError:
+                    pass
+        return bundle
+
+    # ------------------------------------------------------------ loading
+    @staticmethod
+    def load(path: str) -> List[dict]:
+        """Records from a journal directory (all sealed segments, in
+        order) or a single .jsonl file (e.g. an incident bundle's
+        ``journal_tail.jsonl``). A truncated final line — the crash
+        signature — is tolerated and dropped."""
+        files: List[str] = []
+        if os.path.isdir(path):
+            files = [os.path.join(path, n) for n in sorted(os.listdir(path))
+                     if n.startswith("journal-") and n.endswith(".jsonl")]
+        else:
+            files = [path]
+        out: List[dict] = []
+        for fp in files:
+            with open(fp, "r", encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        out.append(json.loads(line))
+                    except ValueError:
+                        break          # truncated tail: crash-tolerant
+        return out
+
+
+# ------------------------------------------------------------ fleet merge
+def _replica_key(rec: dict) -> int:
+    r = rec.get("replica")
+    return r if isinstance(r, int) else -1
+
+
+def merge_fleet(journals: Sequence[DecisionJournal]) -> List[dict]:
+    """Merge per-replica journals (plus the group journal, replica=-1)
+    into one stream ordered by (tick, replica, seq); every record gains
+    a ``replica`` field. Per-replica seqs stay gap-free — the satellite
+    ordering test pins both properties."""
+    merged: List[dict] = []
+    for j in journals:
+        rep = j.replica if j.replica is not None else -1
+        for rec in j.records():
+            r = dict(rec)
+            r.setdefault("replica", rep)
+            merged.append(r)
+    merged.sort(key=lambda r: (r["tick"], _replica_key(r), r["seq"]))
+    return merged
+
+
+def merge_records(streams: Dict[int, Sequence[dict]]) -> List[dict]:
+    """merge_fleet over already-loaded record lists keyed by replica."""
+    merged: List[dict] = []
+    for rep, recs in streams.items():
+        for rec in recs:
+            r = dict(rec)
+            r.setdefault("replica", rep)
+            merged.append(r)
+    merged.sort(key=lambda r: (r["tick"], _replica_key(r), r["seq"]))
+    return merged
+
+
+# -------------------------------------------------------------- resolvers
+def resolve_journal_bytes(byte_cap: Optional[int] = None) -> int:
+    if byte_cap is not None:
+        return int(byte_cap)
+    raw = os.environ.get("DL4J_TPU_JOURNAL_BYTES", "")
+    if raw:
+        return int(raw)
+    return DEFAULT_JOURNAL_BYTES
+
+
+def resolve_incident_dir(journal_path: Optional[str] = None
+                         ) -> Optional[str]:
+    raw = os.environ.get("DL4J_TPU_INCIDENT_DIR", "")
+    if raw:
+        return raw
+    if journal_path:
+        return os.path.join(journal_path, "incidents")
+    return None
+
+
+def resolve_journal(journal=None, *, replica: Optional[int] = None,
+                    byte_cap: Optional[int] = None
+                    ) -> Optional[DecisionJournal]:
+    """Constructor-knob resolution, same contract as resolve_alerts /
+    resolve_disk_pool: an explicit DecisionJournal wins; True = in-memory
+    journal; a string = persistence directory; False = off regardless of
+    env; None consults ``DL4J_TPU_JOURNAL`` ("", "0", "off" = off, "1" =
+    in-memory, anything else = directory path)."""
+    if isinstance(journal, DecisionJournal):
+        if replica is not None and journal.replica is None:
+            journal.replica = replica
+        return journal
+    if journal is False:
+        return None
+    if journal is None:
+        raw = os.environ.get("DL4J_TPU_JOURNAL", "")
+        if raw in ("", "0", "off"):
+            return None
+        journal = True if raw == "1" else raw
+    if journal is True:
+        return DecisionJournal(byte_cap=resolve_journal_bytes(byte_cap),
+                               replica=replica)
+    return DecisionJournal(str(journal),
+                           byte_cap=resolve_journal_bytes(byte_cap),
+                           replica=replica)
+
+
+def child_journal(parent: DecisionJournal,
+                  replica: int) -> DecisionJournal:
+    """A per-replica journal under a group journal: same byte cap, a
+    ``replica<r>`` subdirectory when the parent persists."""
+    sub = None
+    if parent.path is not None:
+        sub = os.path.join(parent.path, "replica%d" % replica)
+    return DecisionJournal(sub, byte_cap=parent.byte_cap, replica=replica)

@@ -68,6 +68,11 @@ present; measured entries must prove >= 1 overload page stamped inside
 the burst phase, alerts_in_calm == 0, windowed-delta conservation,
 ts+alerts on/off token + host-sync bit-parity, and an alert_kinds dict
 keyed by EXACTLY the closed taxonomy telemetry/alerts.py defines).
+ISSUE 20 adds `journal_replay` (the decision-journal record/replay
+round-trip on the same forced-overload schedule — CPU-runnable and
+always present; measured entries must prove bit-identical replayed
+tokens, deterministic-alert-count parity, a None divergence localizer,
+and journal overhead under 1% of the recorded wall).
 bench.py calls
 `assert_valid` on the dict it is about to print, and
 tests/test_bench_schema.py re-validates the committed artifact, so the
@@ -499,6 +504,35 @@ def validate_artifact(art: dict) -> List[str]:
                   "ts_samples", "host_syncs", "short_window"):
             if not _is_num(ta.get(k)) or ta.get(k, -1) < 0:
                 errs.append(f"ts_alerts.{k} missing or negative")
+
+    # Decision journal record/replay (ISSUE 20): CPU-runnable round-trip
+    # on the forced-overload schedule, so always present; when measured
+    # it must prove the in-bench assertions held (bit-identical replayed
+    # tokens, deterministic-alert-count parity, divergence localizer
+    # None) and that journaling stayed an observability cost — under 1%
+    # of the recorded run's wall (O(decisions) host dict appends, never
+    # O(tokens) of device work)
+    jr = e.get("journal_replay")
+    if not isinstance(jr, dict):
+        errs.append("extra['journal_replay'] missing or not a dict (the "
+                    "record/replay round-trip is CPU-runnable — emit "
+                    "error/skipped entries rather than dropping it)")
+    elif "error" not in jr and "skipped_reason" not in jr:
+        if not isinstance(jr.get("platform"), str):
+            errs.append("extra['journal_replay'] has no 'platform' label")
+        for flag in ("replay_token_parity", "alert_parity",
+                     "divergence_free"):
+            if jr.get(flag) is not True:
+                errs.append(f"journal_replay.{flag} must be True — the "
+                            "in-bench replay assertion did not hold")
+        if not _is_num(jr.get("overhead_frac")) \
+                or not 0 <= jr.get("overhead_frac", -1) < 0.01:
+            errs.append("journal_replay.overhead_frac missing or >= 0.01 "
+                        "— journaling must cost < 1% of recorded wall")
+        for k in ("records", "journal_bytes", "host_syncs"):
+            if not _is_num(jr.get(k)) or jr.get(k, 0) <= 0:
+                errs.append(f"journal_replay.{k} missing or not positive "
+                            "— the recorded run journaled nothing")
 
     # Latency blame ledger (ISSUE 14): CPU-runnable forced-contention
     # attribution run, so always present; when measured it must prove the

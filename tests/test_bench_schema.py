@@ -147,6 +147,11 @@ def _minimal_art():
                                 "kv_pressure_spiral": 1, "starvation": 0},
                 "peak_burn_rate_short": 7.5, "slo_violations": 6,
                 "ts_samples": 28, "host_syncs": 36, "short_window": 8},
+            "journal_replay": {
+                "platform": "cpu", "replay_token_parity": True,
+                "alert_parity": True, "divergence_free": True,
+                "overhead_frac": 0.0009, "records": 63,
+                "journal_bytes": 6357, "host_syncs": 36},
             "serving_disagg_ab": {
                 "platform": "cpu", "token_parity": True,
                 "different_winners": True,
@@ -665,6 +670,38 @@ def test_ts_alerts_rules():
     assert validate_artifact(art) == []
 
 
+def test_journal_replay_rules():
+    """ISSUE 20: the record/replay round-trip must always exist; a
+    measured entry must prove the in-bench assertions held (replayed
+    token parity, deterministic-alert parity, divergence localizer
+    None) and the <1% journal-overhead bound; errored/skipped exempt."""
+    art = _minimal_art()
+    del art["extra"]["journal_replay"]
+    assert any("journal_replay" in e for e in validate_artifact(art))
+    for flag in ("replay_token_parity", "alert_parity",
+                 "divergence_free"):
+        art = _minimal_art()
+        art["extra"]["journal_replay"][flag] = False
+        assert any(f"journal_replay.{flag}" in e
+                   for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["journal_replay"]["overhead_frac"] = 0.02
+    assert any("overhead_frac" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    del art["extra"]["journal_replay"]["overhead_frac"]
+    assert any("overhead_frac" in e for e in validate_artifact(art))
+    art = _minimal_art()
+    art["extra"]["journal_replay"]["records"] = 0
+    assert any("journaled nothing" in e for e in validate_artifact(art))
+    # errored/skipped runs are exempt
+    art = _minimal_art()
+    art["extra"]["journal_replay"] = {"error": "ValueError: boom"}
+    assert validate_artifact(art) == []
+    art["extra"]["journal_replay"] = {"platform": "cpu",
+                                      "skipped_reason": "why not"}
+    assert validate_artifact(art) == []
+
+
 def test_serving_disagg_ab_rules():
     """ISSUE 17: the disagg A/B must always exist; a measured entry must
     prove token parity held, state the different-winners headline as an
@@ -803,3 +840,11 @@ def test_committed_artifact_passes_schema():
     assert ta["overload_alerts_in_burst"] >= 1
     assert ta["alerts_in_calm"] == 0
     assert ta["tokens_identical"] is True and ta["sync_parity"] is True
+    # ISSUE 20 acceptance: the committed record/replay round-trip held
+    # token + alert parity with a clean localizer at <1% journal cost
+    jr = e["journal_replay"]
+    assert "error" not in jr and "skipped_reason" not in jr
+    assert jr["replay_token_parity"] is True
+    assert jr["alert_parity"] is True and jr["divergence_free"] is True
+    assert 0 <= jr["overhead_frac"] < 0.01
+    assert jr["records"] > 0 and jr["journal_bytes"] > 0

@@ -1,0 +1,439 @@
+"""Scheduler decision journal + deterministic replay (ISSUE 20).
+
+Layers under test, cheapest first:
+
+- DecisionJournal mechanics: seq/tick/kind typed records, tail windows,
+  in-memory byte-cap eviction with drop counters, tmp+rename segment
+  rotation under the cap, crash recovery (orphan tmp sweep + truncated
+  final line tolerated), env-knob resolution, and the fleet merge's
+  (tick, replica, seq) ordering with gap-free per-replica seqs.
+- Single-engine record -> replay: bit-identical greedy token streams and
+  host-sync counts on a fresh engine, with the divergence localizer
+  returning None on a faithful replay — including under forced
+  preemption where journaled admission verdicts and eviction plans are
+  forced through the ReplayPolicy/EngineDirector seams.
+- The tentpole invariant: journaling on-vs-off changes NO tokens and
+  adds ZERO host syncs (all hooks are host-side dict appends).
+- Satellites: the policy deny hint survives `DL4J_TPU_TS=0` (degrades
+  to the static SLO-slack hint instead of going missing), flight
+  recorder spans cross-link to journal records via `journal_seq`,
+  2-replica disagg group replay (token + transfer-byte parity, merged
+  fleet ordering), divergence localization of an injected policy
+  mutation, and incident capture: an alert firing freezes a replayable
+  journal tail whose replay re-fires the same deterministic alert kinds.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import telemetry
+from deeplearning4j_tpu.serving import Request, ServingEngine
+from deeplearning4j_tpu.serving.policy import ColocatedPolicy
+from deeplearning4j_tpu.serving.replay import (EngineDirector,
+                                               Replayer,
+                                               ReplayMismatch,
+                                               localize_divergence,
+                                               replay_incident)
+from deeplearning4j_tpu.serving.sharding import ShardedServingGroup
+from deeplearning4j_tpu.telemetry.alerts import (BurnRateMonitor,
+                                                 REPLAY_DETERMINISTIC_KINDS)
+from deeplearning4j_tpu.telemetry.journal import (DecisionJournal,
+                                                  canonical,
+                                                  merge_fleet,
+                                                  merge_records,
+                                                  resolve_journal)
+from deeplearning4j_tpu.telemetry.slo import SLO
+
+from tests.test_serving import _build_net
+
+PROMPTS = [[1, 2, 3, 4, 5, 6], [7, 8, 9, 10, 11, 12],
+           [2, 4, 6, 8, 10, 12], [9, 7, 5, 3, 1, 2]]
+IMPOSSIBLE = SLO(ttft_s=1e-9, tpot_s=1e-9)     # everything violates
+
+# forces eviction pressure: 4 blocks/request reservation, 9 free blocks
+PRESSURE = dict(kv_blocks=9, kv_evict="lru", kv_swap_bytes=1 << 24)
+
+
+def _engine(net, **kw):
+    kw.setdefault("max_seqs", 4)
+    kw.setdefault("max_len", 64)
+    kw.setdefault("seed", 3)
+    kw.setdefault("decode_chunk", 1)
+    kw.setdefault("overlap", False)
+    kw.setdefault("kv_block", 4)
+    kw.setdefault("prefix_share", True)
+    return ServingEngine(net, **kw)
+
+
+def _reqs(max_new=10):
+    return [Request(list(p), max_new_tokens=max_new) for p in PROMPTS]
+
+
+def _tokens(results):
+    return [r.tokens for r in results]
+
+
+# ======================================================= journal mechanics
+def test_journal_records_tail_and_canonical():
+    j = DecisionJournal()
+    assert j.record("arrival", tick=0, req="r0") == 1
+    assert j.record("admit", tick=1, req="r0", slot=0) == 2
+    assert j.record("iter", tick=2, q=0, act=1) == 3
+    assert len(j) == 3 and j.last_tick == 2
+    recs = j.records()
+    assert [r["seq"] for r in recs] == [1, 2, 3]          # gap-free
+    assert [r["kind"] for r in recs] == ["arrival", "admit", "iter"]
+    assert j.tail(2) == recs[1:]                          # ticks 1..2
+    # seq and the wall-derived retry hint are outside the equality domain
+    assert canonical({"seq": 9, "tick": 1, "kind": "admission",
+                      "retry_after_s": 0.25, "verdict": "deny_with_hint"}) \
+        == {"tick": 1, "kind": "admission", "verdict": "deny_with_hint"}
+    st = j.stats()
+    assert st["records"] == 3 and st["dropped"] == 0
+    assert st["segments"] == 0 and st["wall_spent_s"] >= 0.0
+
+
+def test_journal_memory_byte_cap_evicts_oldest():
+    j = DecisionJournal(byte_cap=4096)
+    pad = "x" * 64
+    for i in range(200):
+        j.record("iter", tick=i, pad=pad)
+    assert j.seq == 200
+    st = j.stats()
+    assert st["dropped"] > 0 and st["retained"] < 200
+    assert st["retained"] + st["dropped"] == 200
+    assert st["bytes"] <= 4096 + 128        # one record of slack at most
+    recs = j.records()
+    assert recs[-1]["seq"] == 200           # newest always retained
+    assert recs[0]["seq"] == 200 - len(recs) + 1    # contiguous tail
+
+
+def test_journal_disk_segments_rotation_and_crash_recovery(tmp_path):
+    root = str(tmp_path / "jr")
+    j = DecisionJournal(root, byte_cap=4096)
+    pad = "y" * 64
+    for i in range(200):
+        j.record("iter", tick=i, pad=pad)
+    j.flush()
+    segs = sorted(n for n in os.listdir(root) if n.endswith(".jsonl"))
+    assert segs                                   # sealed tmp+rename
+    assert not [n for n in os.listdir(root) if n.endswith(".tmp")]
+    assert j.stats()["dropped_segments"] > 0      # rotated under the cap
+    on_disk = DecisionJournal.load(root)
+    assert on_disk and on_disk[-1]["seq"] == 200
+    assert sum(os.path.getsize(os.path.join(root, n))
+               for n in segs) <= 4096 + 4096      # cap + one open segment
+    # crash signature: an orphaned tmp and a truncated final line
+    (tmp_path / "jr" / "journal-999999.jsonl.tmp").write_text("garbage")
+    with open(os.path.join(root, segs[-1]), "a", encoding="utf-8") as f:
+        f.write('{"seq": 201, "tick": 999, "ki')      # torn write
+    j2 = DecisionJournal(root, byte_cap=4096)         # recovery sweep
+    assert not [n for n in os.listdir(root) if n.endswith(".tmp")]
+    recovered = DecisionJournal.load(root)
+    assert recovered[-1]["seq"] == 200                # torn line dropped
+    # appends resume after the adopted segments, no index collision
+    j2.record("iter", tick=1000)
+    j2.flush()
+    assert DecisionJournal.load(root)[-1]["tick"] == 1000
+
+
+def test_resolve_journal_knob_matrix(tmp_path, monkeypatch):
+    monkeypatch.delenv("DL4J_TPU_JOURNAL", raising=False)
+    monkeypatch.delenv("DL4J_TPU_JOURNAL_BYTES", raising=False)
+    assert resolve_journal() is None                  # default off
+    monkeypatch.setenv("DL4J_TPU_JOURNAL", "0")
+    assert resolve_journal() is None
+    monkeypatch.setenv("DL4J_TPU_JOURNAL", "1")
+    j = resolve_journal(replica=2)
+    assert isinstance(j, DecisionJournal) and j.path is None
+    assert j.replica == 2
+    assert resolve_journal(False) is None             # explicit off wins
+    monkeypatch.setenv("DL4J_TPU_JOURNAL", str(tmp_path / "env_jr"))
+    monkeypatch.setenv("DL4J_TPU_JOURNAL_BYTES", "8192")
+    jd = resolve_journal()
+    assert jd.path == str(tmp_path / "env_jr") and jd.byte_cap == 8192
+    mine = DecisionJournal()
+    assert resolve_journal(mine, replica=1) is mine   # instance wins
+    assert mine.replica == 1                          # ...and is stamped
+    with pytest.raises(ValueError):
+        DecisionJournal(byte_cap=16)                  # below the floor
+
+
+def test_merge_fleet_orders_by_tick_replica_seq():
+    grp = DecisionJournal(replica=-1)
+    r0 = DecisionJournal(replica=0)
+    r1 = DecisionJournal(replica=1)
+    grp.record("route", tick=0, dst=1)
+    r1.record("arrival", tick=0, req="a")
+    r0.record("arrival", tick=0, req="b")
+    r1.record("iter", tick=1)
+    r0.record("iter", tick=1)
+    grp.record("transfer", tick=1, src=0, dst=1)
+    merged = merge_fleet([grp, r0, r1])
+    keys = [(m["tick"], m["replica"], m["seq"]) for m in merged]
+    assert keys == sorted(keys)
+    # group records (replica -1) lead their tick
+    assert [m["kind"] for m in merged[:3]] \
+        == ["route", "arrival", "arrival"]
+    assert merged[1]["replica"] == 0 and merged[2]["replica"] == 1
+    # merge_records round-trips the same ordering from loaded streams
+    again = merge_records({-1: grp.records(), 0: r0.records(),
+                           1: r1.records()})
+    assert [canonical(m) for m in again] == [canonical(m) for m in merged]
+
+
+# ================================================== single-engine replay
+def test_single_engine_replay_bit_identical():
+    net = _build_net(n_kv=2)
+    eng = _engine(net, journal=True)
+    res0 = eng.generate(_reqs())
+    recs = eng.journal.records()
+    s0 = eng.stats()
+    assert {r["kind"] for r in recs} >= {"arrival", "admit", "iter"}
+    assert s0["journal"]["records"] == len(recs)
+    eng.shutdown()
+
+    fresh = _engine(net)
+    rep = Replayer(recs).replay(fresh)
+    assert rep.token_streams == _tokens(res0)         # bit-identical
+    assert rep.divergence is None
+    assert rep.stats["host_syncs"] == s0["host_syncs"]
+    assert rep.stats["tokens_out"] == s0["tokens_out"]
+    fresh.shutdown()
+
+
+def test_preemption_replay_forces_journaled_eviction_plan():
+    """Under KV pressure the recorded run preempts; replay must force the
+    journaled admission verdicts, victim sets, and swap/recompute modes
+    through the director seam — heuristics are never re-consulted — and
+    still land bit-identical tokens and host syncs."""
+    net = _build_net(n_kv=2)
+    kw = dict(PRESSURE, kv_evict_mode="swap")
+    eng = _engine(net, journal=True, **kw)
+    res0 = eng.generate(_reqs())
+    recs = eng.journal.records()
+    s0 = eng.stats()
+    assert s0["kv_preemptions"] >= 1
+    assert any(r["kind"] == "preempt" for r in recs)
+    assert any(r["kind"] == "admission" and r["victims"]
+               for r in recs)
+    eng.shutdown()
+
+    fresh = _engine(net, **kw)
+    rep = Replayer(recs).replay(fresh)
+    assert rep.token_streams == _tokens(res0)
+    assert rep.divergence is None
+    assert rep.stats["host_syncs"] == s0["host_syncs"]
+    assert rep.stats["kv_preemptions"] == s0["kv_preemptions"]
+    fresh.shutdown()
+
+
+def test_journal_on_vs_off_token_and_host_sync_bit_parity():
+    """The tentpole invariant: every journal hook is a host-side dict
+    append behind `if self.journal is not None` — recording a run
+    changes NO tokens and adds ZERO host syncs."""
+    net = _build_net(n_kv=2)
+
+    def serve(**kw):
+        telemetry.tracer().clear()
+        eng = _engine(net, **PRESSURE, **kw)
+        res = eng.generate(_reqs())
+        st = eng.stats()
+        eng.shutdown()
+        return _tokens(res), st["host_syncs"]
+
+    tok_off, sync_off = serve()
+    tok_on, sync_on = serve(journal=True)
+    assert tok_on == tok_off
+    assert sync_on == sync_off
+
+
+# ==================================== satellite: deny hint with TS off
+def test_deny_hint_survives_timeseries_disabled(monkeypatch):
+    """ISSUE 20 satellite (ISSUE 19 leftover): with `DL4J_TPU_TS=0` the
+    admission deny hint must degrade to the static SLO-slack hint (PR 17)
+    instead of going missing — the burn-rate stretch is telemetry, the
+    hint itself is not."""
+    monkeypatch.setenv("DL4J_TPU_TS", "0")
+    net = _build_net(n_kv=2)
+    eng = _engine(net, **PRESSURE,
+                  policy=ColocatedPolicy(slo=SLO(ttft_s=1e9, tpot_s=1e9)))
+    assert eng.timeseries is None                 # knob honored
+    res = eng.generate(_reqs())
+    assert eng.stats()["kv_preemptions"] == 0     # slack held it back
+    rejs = [e for r in res for e in r.timeline
+            if e["phase"] == "kv_rejection"]
+    assert rejs, "KV exhaustion must produce rejection records"
+    assert all(e["hint_retry_after_s"] > 0.0 for e in rejs)
+    eng.shutdown()
+
+
+# ============================= satellite: flight-recorder cross-linking
+def test_flight_recorder_spans_carry_journal_seq():
+    from deeplearning4j_tpu.telemetry.flight_recorder import FlightRecorder
+    net = _build_net(n_kv=2)
+    fr = FlightRecorder(capacity=16, worst_k=8)
+    eng = _engine(net, journal=True, flight_recorder=fr, **PRESSURE)
+    eng.generate(_reqs())
+    assert eng.stats()["kv_preemptions"] >= 1
+    seqs = fr.journal_seqs()
+    assert seqs, "retained timelines must cross-link journal records"
+    assert all(1 <= s <= eng.journal.seq for s in seqs)
+    # the cross-link survives into the Perfetto dump as a span arg
+    trace = fr.perfetto()
+    linked = [e for e in trace["traceEvents"]
+              if e.get("args", {}).get("journal_seq") is not None]
+    assert linked
+    assert {e["args"]["journal_seq"] for e in linked} <= set(seqs)
+    eng.shutdown()
+
+
+# =========================================== satellite: group replay
+def test_group_replay_disagg_with_transfers_and_preemptions():
+    """Record a 2-replica disaggregated group under KV pressure (>= 1
+    live KV transfer, >= 1 preemption), replay on a fresh group: per-
+    replica token parity, transfer byte parity, and the merged fleet
+    journal ordered by (tick, replica) with gap-free per-replica seqs."""
+    prompts = PROMPTS + [[3, 1, 4, 1, 5, 9], [2, 6, 5, 3, 5, 8]]
+    net = _build_net(n_kv=2)
+    kw = dict(dtype="float64", policy="disagg", serial_step=True,
+              kv_block=4, **PRESSURE)
+    grp = ShardedServingGroup(net, 4, 64, replicas=2, tp=1,
+                              journal=True, **kw)
+    res0 = grp.generate(prompts, max_new_tokens=10)
+    merged = grp.fleet_journal()
+    s0 = grp.stats()
+    assert s0["kv_preemptions"] >= 1 and s0["kv_transfer_out"] >= 1
+    kinds = {r["kind"] for r in merged}
+    assert kinds >= {"route", "transfer", "xfer_out", "xfer_in",
+                     "arrival", "admission", "preempt"}
+    # merged stream ordered by (tick, replica, seq)...
+    keys = [(r["tick"], r["replica"], r["seq"]) for r in merged]
+    assert keys == sorted(keys)
+    # ...with gap-free per-replica seqs (nothing lost in the merge)
+    for rep_id in (-1, 0, 1):
+        seqs = [r["seq"] for r in merged if r["replica"] == rep_id]
+        assert seqs == list(range(1, len(seqs) + 1))
+    grp.shutdown()
+
+    fresh = ShardedServingGroup(net, 4, 64, replicas=2, tp=1, **kw)
+    rep = Replayer(merged).replay_group(fresh)
+    assert rep.token_streams == _tokens(res0)         # per-replica parity
+    assert rep.divergence is None
+    assert rep.stats["host_syncs"] == s0["host_syncs"]
+    assert rep.stats["kv_transfer_bytes"] == s0["kv_transfer_bytes"]
+    assert rep.stats["kv_transfer_out"] == s0["kv_transfer_out"]
+    fresh.shutdown()
+
+
+# ====================================== satellite: divergence localizer
+def test_localizer_pinpoints_injected_record_mutation():
+    net = _build_net(n_kv=2)
+    eng = _engine(net, journal=True)
+    eng.generate(_reqs(max_new=6))
+    recs = eng.journal.records()
+    eng.shutdown()
+    assert localize_divergence(recs, recs) is None    # self-identity
+    # inject a mutation into one decision record mid-stream
+    idx = next(i for i, r in enumerate(recs)
+               if r["kind"] == "iter" and i > len(recs) // 2)
+    mut = [dict(r) for r in recs]
+    mut[idx]["toks"] = mut[idx].get("toks", 0) + 1
+    div = localize_divergence(recs, mut)
+    assert div is not None
+    assert div["index"] == idx and div["tick"] == recs[idx]["tick"]
+    assert canonical(div["recorded"]) == canonical(recs[idx])
+    assert canonical(div["live"]) == canonical(mut[idx])
+
+
+def test_localizer_pinpoints_live_policy_mutation():
+    """Acceptance: record under a slack-rich SLO (deny-with-hint), then
+    run the same workload live under a zero-slack SLO (preempt) — the
+    localizer lands exactly on the first admission verdict that flipped,
+    not merely somewhere downstream of it."""
+    net = _build_net(n_kv=2)
+
+    def run(slo):
+        eng = _engine(net, journal=True, **PRESSURE,
+                      policy=ColocatedPolicy(slo=slo))
+        eng.generate(_reqs())
+        recs = eng.journal.records()
+        eng.shutdown()
+        return recs
+
+    recorded = run(SLO(ttft_s=1e9, tpot_s=1e9))       # always-deny
+    live = run(SLO(ttft_s=0.0, tpot_s=1e9))           # always-preempt
+    div = localize_divergence(recorded, live)
+    assert div is not None
+    assert div["recorded"]["kind"] == "admission"
+    assert div["live"]["kind"] == "admission"
+    assert div["recorded"]["verdict"] == "deny_with_hint"
+    assert div["live"]["verdict"] == "preempt"
+    first_adm = next(i for i, r in enumerate(recorded)
+                     if r["kind"] == "admission")
+    assert div["index"] == first_adm
+
+
+def test_director_raises_on_out_of_order_replay():
+    d = EngineDirector([{"seq": 1, "tick": 0, "kind": "admission",
+                         "req": "a", "verdict": "deny_with_hint",
+                         "victims": [], "reclaimable_bytes": 0},
+                        {"seq": 2, "tick": 1, "kind": "preempt",
+                         "req": "b", "mode": "swap"}])
+    with pytest.raises(ReplayMismatch):
+        d.preempt_mode("not-b")                       # wrong victim
+    with pytest.raises(ReplayMismatch):
+        d.next_admission("not-a")                     # wrong admittee
+
+
+# ============================================ satellite: incident capture
+def test_alert_freezes_incident_bundle_and_replay_refires(tmp_path):
+    """An alert firing freezes the journal tail into an incident bundle
+    next to the flight-recorder dump; replaying the bundle on a fresh
+    engine re-fires the same deterministic alert kinds and reproduces
+    the recorded token streams."""
+    from deeplearning4j_tpu.telemetry.flight_recorder import FlightRecorder
+    net = _build_net(n_kv=2)
+
+    def monitor():
+        # starvation reads live queue wall-age: excluded from the replay
+        # contract (REPLAY_DETERMINISTIC_KINDS), silenced here
+        return BurnRateMonitor(IMPOSSIBLE, short_window=4,
+                               long_window=400, starvation_factor=1e9)
+
+    mon = monitor()
+    fr = FlightRecorder(capacity=16, worst_k=8)
+    eng = _engine(net, journal=str(tmp_path / "jr"), alerts=mon,
+                  flight_recorder=fr)
+    res0 = eng.generate(_reqs())
+    incidents = eng.stats()["incidents"]
+    assert incidents, "the impossible SLO must have paged"
+    bundle = incidents[-1]
+    eng.shutdown()
+
+    tail = DecisionJournal.load(os.path.join(bundle, "journal_tail.jsonl"))
+    assert tail and any(r["kind"] == "arrival" for r in tail)
+    meta = json.loads(
+        (tmp_path / "jr" / "incidents").joinpath(
+            os.path.basename(bundle), "incident.json").read_text())
+    assert meta["records"] == len(tail)
+    fired = {a["kind"] for a in meta["alerts"]}
+    assert "overload" in fired
+    assert meta["req_ids"]                  # req_id cross-links present
+    # the Perfetto dump rides in the same bundle, cross-linked by seq
+    trace = json.loads(
+        (tmp_path / "jr" / "incidents").joinpath(
+            os.path.basename(bundle), "trace.json").read_text())
+    assert trace["traceEvents"]
+
+    mon2 = monitor()
+    fresh = _engine(net, alerts=mon2)
+    rep = replay_incident(bundle, fresh)
+    assert rep.token_streams == _tokens(res0)     # the runnable regression
+    refired = {a.kind for a in mon2.alerts()} & REPLAY_DETERMINISTIC_KINDS
+    live = {a.kind for a in mon.alerts()} & REPLAY_DETERMINISTIC_KINDS
+    assert "overload" in refired
+    assert refired == live
+    fresh.shutdown()

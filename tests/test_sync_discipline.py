@@ -68,10 +68,88 @@ HOT_PATH_MODULES = sorted(
      # disk tier (ISSUE 18): demotion/promotion run on pressure paths
      # under the scheduler lock — every materialization in the spill
      # writer must be annotated (and counted by its engine callers)
-     PKG / "serving" / "kv_disk.py"]
+     PKG / "serving" / "kv_disk.py",
+     # decision replay (ISSUE 20): the replayer drives the same scheduler
+     # hot loop; its directors/policy wrapper are pure host bookkeeping
+     # over journaled dicts and must never read a device buffer
+     PKG / "serving" / "replay.py"]
     + list((PKG / "telemetry").glob("*.py")))
 
 ANNOTATION = "sync-ok:"
+
+# ------------------------------------------------ determinism discipline
+# ISSUE 20: deterministic replay depends on the allocator tick clock being
+# the only time source in scheduler DECISION logic. Wall clocks and ad-hoc
+# RNG in the decision modules are replay hazards, so the scan below flags
+# `time.time(`, `RandomState(`, and `random.<attr>(` calls in every
+# decision-path module, and additionally `time.monotonic(` /
+# `time.perf_counter(` in the STRICT modules — those whose every code path
+# is a decision path. Legitimate wall sites (loadgen's open-loop pacer,
+# lifecycle's bandwidth calibration, the journal's own overhead
+# self-measurement) carry a ``# det-ok: <reason>`` annotation.
+DET_ANNOTATION = "det-ok"
+
+DET_MODULES = sorted(
+    [PKG / "serving" / "engine.py",
+     PKG / "serving" / "lifecycle.py",
+     PKG / "serving" / "policy.py",
+     PKG / "serving" / "disagg.py",
+     PKG / "serving" / "spec.py",
+     PKG / "serving" / "loadgen.py",
+     PKG / "serving" / "replay.py",
+     PKG / "telemetry" / "journal.py",
+     PKG / "telemetry" / "alerts.py"])
+
+# engine.py is deliberately NOT strict: its monotonic reads are timeline
+# stamps and SLO bookkeeping (observability outputs, not decision inputs)
+# and the two wall-driven verdicts it does take — queue-shed and slot
+# timeout — are journaled and replay-forced (serving/replay.py directors)
+DET_STRICT_MODULES = sorted(
+    [PKG / "serving" / "lifecycle.py",
+     PKG / "serving" / "policy.py",
+     PKG / "serving" / "disagg.py",
+     PKG / "serving" / "spec.py",
+     PKG / "serving" / "loadgen.py",
+     PKG / "serving" / "replay.py",
+     PKG / "telemetry" / "journal.py"])
+
+
+def scan_determinism(src: str, strict: bool = False):
+    """Return [(line, pattern)] for unannotated wall-clock/RNG calls."""
+    toks = list(tokenize.generate_tokens(io.StringIO(src).readline))
+    comments = {}
+    for t in toks:
+        if t.type == tokenize.COMMENT:
+            comments[t.start[0]] = t.string
+    violations = []
+    for i, t in enumerate(toks):
+        if t.type != tokenize.NAME:
+            continue
+        nxt = toks[i + 1] if i + 1 < len(toks) else None
+        if nxt is None or nxt.type != tokenize.OP or nxt.string != "(":
+            continue
+        prev = toks[i - 1] if i > 0 else None
+        prev_is_dot = prev is not None and prev.type == tokenize.OP \
+            and prev.string == "."
+        holder = toks[i - 2].string if prev_is_dot and i >= 2 \
+            and toks[i - 2].type == tokenize.NAME else None
+        if t.string == "RandomState":
+            pattern = "RandomState("
+        elif holder == "time" and t.string == "time":
+            pattern = "time.time("
+        elif holder == "random":
+            pattern = f"random.{t.string}("
+        elif strict and holder == "time" \
+                and t.string in ("monotonic", "perf_counter"):
+            pattern = f"time.{t.string}("
+        else:
+            continue
+        line = t.start[0]
+        if any(DET_ANNOTATION in comments.get(ln, "")
+               for ln in (line, line - 1)):
+            continue
+        violations.append((line, pattern))
+    return violations
 
 
 def scan_source(src: str):
@@ -157,7 +235,41 @@ def test_all_hot_path_modules_exist():
             # scheduler iteration and the burn-rate monitor evaluates on
             # every sample — both must stay pure host arithmetic (the
             # on-vs-off token/sync bit-parity depends on it)
-            "timeseries.py", "alerts.py"} <= names
+            "timeseries.py", "alerts.py",
+            # ISSUE 20: the decision journal records on every scheduler
+            # decision path and the replayer re-drives the hot loop —
+            # both must stay host-only (journal.py never imports jax)
+            "journal.py", "replay.py"} <= names
+    for p in DET_MODULES + DET_STRICT_MODULES:
+        assert p.is_file(), f"determinism-scanned module missing: {p}"
+
+
+# ------------------------------------------- determinism-discipline scan
+@pytest.mark.parametrize("path", DET_MODULES,
+                         ids=[str(p.relative_to(REPO))
+                              for p in DET_MODULES])
+def test_decision_module_has_no_unannotated_wall_or_rng(path):
+    violations = scan_determinism(path.read_text())
+    msg = "\n".join(
+        f"  {path.relative_to(REPO)}:{ln}: {pat} without '# det-ok: "
+        f"<reason>' on the same or preceding line" for ln, pat in violations)
+    assert not violations, (
+        f"unannotated wall-clock/RNG calls in a decision-path module — "
+        f"replay correctness needs the tick clock to be the only time "
+        f"source in decision logic:\n{msg}")
+
+
+@pytest.mark.parametrize("path", DET_STRICT_MODULES,
+                         ids=[str(p.relative_to(REPO))
+                              for p in DET_STRICT_MODULES])
+def test_strict_decision_module_has_no_unannotated_monotonic(path):
+    violations = scan_determinism(path.read_text(), strict=True)
+    msg = "\n".join(
+        f"  {path.relative_to(REPO)}:{ln}: {pat} without '# det-ok: "
+        f"<reason>' on the same or preceding line" for ln, pat in violations)
+    assert not violations, (
+        f"unannotated monotonic/perf_counter reads in a strict decision "
+        f"module:\n{msg}")
 
 
 # ------------------------------------------------ scanner self-tests
@@ -185,3 +297,30 @@ def test_scanner_ignores_docstrings():
     src = '"""mentions float(score) and np.asarray(buf) and\n' \
           '.block_until_ready() in prose."""\n'
     assert scan_source(src) == []
+
+
+def test_det_scanner_catches_each_pattern():
+    bad = ("t = time.time()\n"
+           "rng = np.random.RandomState(0)\n"
+           "x = random.random()\n")
+    pats = {p for _, p in scan_determinism(bad)}
+    assert pats == {"time.time(", "RandomState(", "random.random("}
+
+
+def test_det_scanner_strict_flags_monotonic_only_in_strict_mode():
+    src = ("a = time.monotonic()\n"
+           "b = time.perf_counter()\n")
+    assert scan_determinism(src) == []
+    pats = {p for _, p in scan_determinism(src, strict=True)}
+    assert pats == {"time.monotonic(", "time.perf_counter("}
+
+
+def test_det_scanner_honors_annotations_and_ignores_prose():
+    ok = ("t0 = time.time()  # det-ok: wall pacer\n"
+          "# det-ok: one seeded generator, fixed draw order\n"
+          "rng = np.random.RandomState(seed)\n"
+          "w = time.monotonic()  # det-ok: measurement\n"
+          's = "time.time() inside a string"\n'
+          "# time.time() inside a comment\n"
+          "rng.uniform()\n")
+    assert scan_determinism(ok, strict=True) == []
